@@ -1,0 +1,36 @@
+"""hivemall_tpu — a TPU-native (JAX/XLA/Pallas/pjit) machine-learning framework
+with the capability surface of Hivemall (reference: maropu/hivemall, whose tree is
+the deprecation stub of the Apache Hivemall lineage; see SURVEY.md for the full
+component inventory this package implements).
+
+Design thesis (SURVEY.md §1): Hivemall expresses ML as a catalog of SQL functions —
+trainers are streaming UDTFs, prediction is a join, parallelism is the engine's.
+The TPU rebuild keeps the *catalog* (names, option grammars, semantics) as the
+public surface, and replaces the execution substrate:
+
+- per-row JVM math            -> batched, jitted JAX kernels on TPU
+- open-addressing hash models -> dense hashed parameter tables in HBM (bf16/f32)
+- MixServer async averaging   -> lax.pmean over ICI at -mix_threshold cadence,
+                                 plus an async host mix service for DCN
+- Hive/Spark engine           -> a thin Arrow/numpy columnar frame + input pipeline
+
+Package map (SURVEY.md §8):
+  utils/     hashing (bit-exact murmur3), option-string parser, primitives
+  io/        LIBSVM/CSV readers, padded sparse batches, amplify/replay cache
+  ftvec/     feature engineering catalog (hashing, scaling, crossing, trans, ...)
+  ops/       jitted kernels: losses, optimizers, schedules, sparse dots, pallas
+  models/    trainer "UDTFs" (linear, FM/FFM, MF/BPR, word2vec, trees, LDA, ...)
+  parallel/  device mesh, mix (psum cadence, argmin-KLD), host mix service
+  frame/     evaluation UDAFs, tools/* long tail, each_top_k
+  catalog/   define-all manifest: SQL name -> callable + option grammar
+  cli/       train/predict runners
+"""
+
+__version__ = "0.1.0"
+
+VERSION = __version__
+
+
+def hivemall_version() -> str:
+    """SQL: hivemall_version() — version UDF (reference: hivemall.VersionUDF)."""
+    return __version__

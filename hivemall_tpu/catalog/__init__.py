@@ -1,0 +1,8 @@
+from .registry import (  # noqa: F401
+    FunctionEntry,
+    register,
+    lookup,
+    define_all,
+    all_functions,
+    help_for,
+)

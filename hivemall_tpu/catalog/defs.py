@@ -1,0 +1,26 @@
+"""define-all — registration of every implemented catalog function.
+
+The rebuild's analog of resources/ddl/define-all.hive (SURVEY.md §2 L6). Grouped
+and ordered to mirror the reference's DDL sections; grows as capabilities land.
+Option grammars are declared next to the implementing modules and imported here.
+"""
+
+from .registry import register
+
+# --- top-level / misc -------------------------------------------------------
+register("hivemall_version", "UDF", "hivemall_tpu:hivemall_version",
+         description="framework version string",
+         reference="hivemall.HivemallVersionUDF")
+
+# --- ftvec.hashing (SURVEY.md §3.12) ---------------------------------------
+register("mhash", "UDF", "hivemall_tpu.utils.hashing:mhash",
+         description="MurmurHash3 a word into [1, 2^24]",
+         reference="hivemall.ftvec.hashing.MurmurHash3UDF")
+
+# --- ftvec.amplify ----------------------------------------------------------
+register("amplify", "UDTF", "hivemall_tpu.io.amplify:amplify",
+         description="emit each row xtimes (multi-epoch under one-pass SQL)",
+         reference="hivemall.ftvec.amplify.AmplifierUDTF")
+register("rand_amplify", "UDTF", "hivemall_tpu.io.amplify:rand_amplify",
+         description="amplify + within-buffer shuffle",
+         reference="hivemall.ftvec.amplify.RandomAmplifierUDTF")

@@ -1,0 +1,110 @@
+"""The function catalog — hivemall_tpu's `define-all` surface.
+
+Reference: resources/ddl/define-all.hive registers ~300 SQL functions, one
+``CREATE TEMPORARY FUNCTION name AS 'java.class'`` per capability (SURVEY.md
+§2 L6, §3.18). That manifest is the API contract the rebuild keeps: every
+implemented capability registers here under its reference SQL name, with its
+option grammar, kind (UDF / UDAF / UDTF), and a pointer to the implementing
+callable. ``define_all()`` renders the manifest; the conformance test walks it.
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.options import OptionSpec
+
+__all__ = ["FunctionEntry", "register", "lookup", "define_all", "all_functions",
+           "help_for"]
+
+
+@dataclass
+class FunctionEntry:
+    name: str                      # SQL name, e.g. "train_classifier"
+    kind: str                      # "UDF" | "UDAF" | "UDTF"
+    target: str                    # "module:attr" import path of the callable/class
+    description: str = ""
+    reference: str = ""            # upstream class, e.g. "hivemall.classifier.GeneralClassifierUDTF"
+    options: Optional[OptionSpec] = None
+    aliases: List[str] = field(default_factory=list)
+
+    def resolve(self) -> Any:
+        mod, _, attr = self.target.partition(":")
+        return getattr(importlib.import_module(mod), attr)
+
+
+_REGISTRY: Dict[str, FunctionEntry] = {}
+_ALIASES: Dict[str, str] = {}
+
+
+def register(name: str, kind: str, target: str, *, description: str = "",
+             reference: str = "", options: Optional[OptionSpec] = None,
+             aliases: Optional[List[str]] = None) -> FunctionEntry:
+    if options is not None and not options.func_name:
+        options.func_name = name
+    e = FunctionEntry(name, kind, target, description, reference,
+                      options, list(aliases or []))
+    if name in _REGISTRY or name in _ALIASES:
+        raise ValueError(f"catalog collision: {name!r} is already registered")
+    _REGISTRY[name] = e
+    for a in e.aliases:
+        if a in _REGISTRY or a in _ALIASES:
+            raise ValueError(
+                f"catalog collision: alias {a!r} of {name!r} already taken")
+        _ALIASES[a] = name
+    return e
+
+
+def lookup(name: str) -> FunctionEntry:
+    key = _ALIASES.get(name, name)
+    if key not in _REGISTRY:
+        _ensure_loaded()
+        key = _ALIASES.get(name, name)
+    if key not in _REGISTRY:
+        raise KeyError(f"function {name!r} is not registered (see define_all())")
+    return _REGISTRY[key]
+
+
+def help_for(name: str) -> str:
+    e = lookup(name)
+    head = f"{e.name} ({e.kind}) — {e.description}"
+    if e.reference:
+        head += f"\n  reference: {e.reference}"
+    if e.options:
+        head += "\n" + e.options.usage()
+    return head
+
+
+_LOADED = False
+
+# Modules whose import populates the registry (the rebuild's define-all.hive).
+_CATALOG_MODULES = [
+    "hivemall_tpu.catalog.defs",
+]
+
+
+def _ensure_loaded() -> None:
+    global _LOADED
+    if _LOADED:
+        return
+    _LOADED = True
+    for m in _CATALOG_MODULES:
+        importlib.import_module(m)
+
+
+def all_functions() -> Dict[str, FunctionEntry]:
+    _ensure_loaded()
+    return dict(sorted(_REGISTRY.items()))
+
+
+def define_all() -> str:
+    """Render the manifest — the analog of resources/ddl/define-all.hive."""
+    lines = []
+    for e in all_functions().values():
+        lines.append(f"CREATE FUNCTION {e.name} AS '{e.target}';  -- {e.kind}"
+                     + (f" ref={e.reference}" if e.reference else ""))
+        for a in e.aliases:
+            lines.append(f"CREATE FUNCTION {a} AS '{e.target}';  -- alias of {e.name}")
+    return "\n".join(lines)

@@ -1,0 +1,61 @@
+"""amplify / rand_amplify — epochs-and-shuffle as dataset transforms.
+
+Reference: hivemall.ftvec.amplify.{AmplifierUDTF,RandomAmplifierUDTF}
+(SURVEY.md §3.12): under one-pass map-only SQL, multi-epoch training is
+expressed by emitting each row ``xtimes`` and shuffling within a bounded
+buffer. Here the same names become SparseDataset -> SparseDataset transforms
+feeding the TPU input pipeline; trainers' ``-iters`` option is the direct
+(preferred) route, these exist for catalog parity and pipeline composition.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .sparse import SparseDataset
+
+__all__ = ["amplify", "rand_amplify"]
+
+
+def _take(ds: SparseDataset, order: np.ndarray) -> SparseDataset:
+    lens = np.diff(ds.indptr)
+    new_indptr = np.zeros(len(order) + 1, np.int64)
+    new_indptr[1:] = np.cumsum(lens[order])
+    total = int(new_indptr[-1])
+    idx = np.empty(total, np.int32)
+    val = np.empty(total, np.float32)
+    fld = np.empty(total, np.int32) if ds.fields is not None else None
+    for k, r in enumerate(order):
+        s, e = ds.indptr[r], ds.indptr[r + 1]
+        t = new_indptr[k]
+        idx[t:t + (e - s)] = ds.indices[s:e]
+        val[t:t + (e - s)] = ds.values[s:e]
+        if fld is not None:
+            fld[t:t + (e - s)] = ds.fields[s:e]
+    return SparseDataset(idx, new_indptr, val, ds.labels[order], fld)
+
+
+def amplify(ds: SparseDataset, xtimes: int) -> SparseDataset:
+    """SQL: amplify(xtimes, *) — emit each row xtimes consecutively
+    (r0,r0,...,r1,r1,... — the reference's per-row duplication order, which is
+    what rand_amplify's bounded-buffer shuffle exists to break up)."""
+    if xtimes <= 1:
+        return ds
+    order = np.repeat(np.arange(len(ds)), xtimes)
+    return _take(ds, order)
+
+
+def rand_amplify(ds: SparseDataset, xtimes: int, bufsize: int = 1000,
+                 seed: int = 42) -> SparseDataset:
+    """SQL: rand_amplify(xtimes, bufsize, *) — amplify then shuffle within a
+    sliding buffer of ``bufsize`` rows (bounded-memory shuffle, matching the
+    reference's within-buffer semantics rather than a global permutation)."""
+    amped = amplify(ds, xtimes)
+    n = len(amped)
+    rng = np.random.default_rng(seed)
+    order = np.arange(n)
+    for s in range(0, n, bufsize):
+        seg = order[s:s + bufsize]
+        rng.shuffle(seg)
+        order[s:s + bufsize] = seg
+    return _take(amped, order)

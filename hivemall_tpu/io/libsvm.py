@@ -1,0 +1,94 @@
+"""LIBSVM reader/writer — the a9a/news20-style ingest path.
+
+Reference analog: the test-resource LIBSVM snippets Hivemall trains on
+(SURVEY.md §5 item 2) plus the Hive-side EXPLODE/parse queries. A fast C++
+parser in native/ takes over when built; this numpy path is the fallback and
+the semantic definition.
+"""
+
+from __future__ import annotations
+
+import gzip
+from typing import Optional, TextIO, Tuple
+
+import numpy as np
+
+from .sparse import SparseDataset
+
+__all__ = ["read_libsvm", "write_libsvm"]
+
+
+def _open(path: str, mode: str = "rt"):
+    if path.endswith(".gz"):
+        return gzip.open(path, mode)
+    return open(path, mode)
+
+
+def read_libsvm(path: str, *, zero_based: bool = False,
+                binary_labels: bool = True) -> SparseDataset:
+    """Read a LIBSVM file into a SparseDataset.
+
+    Labels: by default +1/-1 style labels are kept as floats (trainers decide
+    their own label convention); indices are shifted +1 if ``zero_based`` so
+    id 0 stays the padding/bias slot.
+    """
+    try:
+        from ..utils.native import parse_libsvm_native
+        parsed = parse_libsvm_native(path, zero_based=zero_based)
+        if parsed is not None:
+            return parsed
+    except ImportError:
+        pass
+    labels = []
+    indices = []
+    values = []
+    indptr = [0]
+    shift = 1 if zero_based else 0
+    with _open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                i, _, v = tok.partition(":")
+                indices.append(int(i) + shift)
+                values.append(float(v) if v else 1.0)
+            indptr.append(len(indices))
+    return SparseDataset(
+        np.asarray(indices, np.int32), np.asarray(indptr, np.int64),
+        np.asarray(values, np.float32), np.asarray(labels, np.float32))
+
+
+def write_libsvm(ds: SparseDataset, path: str) -> None:
+    with _open(path, "wt") as f:
+        for r in range(len(ds)):
+            idx, val = ds.row(r)
+            feats = " ".join(f"{int(i)}:{float(v):g}" for i, v in zip(idx, val))
+            lab = ds.labels[r]
+            lab_s = f"{int(lab)}" if float(lab).is_integer() else f"{lab:g}"
+            f.write(f"{lab_s} {feats}\n")
+
+
+def synthetic_classification(n: int, dim: int, *, density: float = 0.1,
+                             seed: int = 0, noise: float = 0.1
+                             ) -> Tuple[SparseDataset, np.ndarray]:
+    """Generate an a9a-like sparse binary classification set (labels ±1).
+
+    Returns (dataset, true_weights) for convergence-smoke tests (SURVEY.md §5:
+    "loss decreases; AUC above threshold" rather than exact numbers).
+    """
+    rng = np.random.default_rng(seed)
+    w = rng.normal(0, 1, dim + 1).astype(np.float32)
+    w[0] = 0.0  # padding slot never carries weight
+    nnz = max(1, int(density * dim))
+    indices = np.zeros((n, nnz), np.int64)
+    for r in range(n):
+        indices[r] = rng.choice(dim, nnz, replace=False) + 1
+    values = rng.uniform(0.5, 1.5, (n, nnz)).astype(np.float32)
+    margin = (w[indices] * values).sum(1) + rng.normal(0, noise, n)
+    labels = np.where(margin > 0, 1.0, -1.0).astype(np.float32)
+    indptr = np.arange(0, (n + 1) * nnz, nnz, dtype=np.int64)
+    return SparseDataset(indices.ravel().astype(np.int32), indptr,
+                         values.ravel(), labels), w

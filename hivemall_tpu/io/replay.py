@@ -1,0 +1,66 @@
+"""ReplayCache — the NioStatefulSegment analog: spill-to-disk epoch replay.
+
+Reference: hivemall/utils/io/NioStatefulSegment [U] lets a one-pass UDTF run
+``-iters > 1`` by recording the row stream to local disk on epoch 1 and
+replaying it for epochs 2..N (SURVEY.md §3.20, §4.4). Here the same job is done
+with a memory-mapped .npz shard: the first pass over a streaming source
+materializes CSR arrays; later epochs re-open the mmap and re-shuffle.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Iterator, Optional
+
+import numpy as np
+
+from .sparse import SparseDataset
+
+__all__ = ["ReplayCache"]
+
+
+class ReplayCache:
+    def __init__(self, dir: Optional[str] = None):
+        self._dir = dir or tempfile.mkdtemp(prefix="hmtpu_replay_")
+        self._path: Optional[str] = None
+
+    _ARRAYS = ("indices", "indptr", "values", "labels", "fields")
+
+    def record(self, ds: SparseDataset) -> str:
+        """Spill a dataset to disk; returns the shard directory.
+
+        Each CSR array goes to its own .npy file (NOT a zipped .npz — numpy
+        silently ignores mmap_mode for .npz, which would defeat the whole
+        spill-to-disk point) so replay() can truly memory-map them.
+        """
+        self._path = os.path.join(self._dir, "shard0")
+        os.makedirs(self._path, exist_ok=True)
+        for name in self._ARRAYS:
+            arr = getattr(ds, name)
+            if arr is not None:
+                np.save(os.path.join(self._path, name + ".npy"), arr)
+        return self._path
+
+    def replay(self) -> SparseDataset:
+        """Re-open the spilled shard memory-mapped (read-only)."""
+        if self._path is None:
+            raise RuntimeError("nothing recorded")
+
+        def load(name):
+            p = os.path.join(self._path, name + ".npy")
+            return np.load(p, mmap_mode="r") if os.path.exists(p) else None
+
+        return SparseDataset(*(load(n) for n in self._ARRAYS))
+
+    def epochs(self, ds: SparseDataset, iters: int, batch_size: int,
+               **kw) -> Iterator:
+        """First epoch streams ``ds`` while recording; epochs 2..iters replay."""
+        self.record(ds)
+        yield from ds.batches(batch_size, epochs=1, **kw)
+        if iters > 1:
+            replayed = self.replay()
+            for ep in range(1, iters):
+                kw2 = dict(kw)
+                kw2["seed"] = kw.get("seed", 42) + ep
+                yield from replayed.batches(batch_size, epochs=1, **kw2)

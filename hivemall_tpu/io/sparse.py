@@ -1,0 +1,189 @@
+"""Columnar sparse-example substrate: padded (idx, val) batches for TPU.
+
+This replaces the reference's per-row ``FeatureValue[]`` parse inside
+GenericUDTF.process() (SURVEY.md §4.1 hot path): variable-length feature lists
+become fixed-shape ``int32[B, L]`` index / ``float32[B, L]`` value arrays padded
+with (idx=0, val=0). Index 0 is reserved — feature ids start at 1 (mhash range
+[1, N]) and ``add_bias`` uses a dedicated bias slot — and every kernel scales by
+``val``, so zero-valued padding is arithmetically inert in forward and update.
+
+Static shapes are what XLA needs: every batch from one dataset is padded to a
+single fixed row length L (the dataset max, or an explicit ``max_len``), so jit
+traces exactly one shape per (B, L) configuration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["SparseBatch", "SparseDataset", "pad_examples", "parse_feature_strings"]
+
+
+@dataclass
+class SparseBatch:
+    """One padded minibatch. ``field`` is present only for FFM-style features."""
+
+    idx: np.ndarray                  # int32 [B, L], 0 = padding
+    val: np.ndarray                  # float32 [B, L]
+    label: np.ndarray                # float32 [B]
+    field: Optional[np.ndarray] = None  # int32 [B, L], FFM only
+    n_valid: Optional[int] = None    # rows < n_valid are real; rest are padding
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.idx.shape[0])
+
+    @property
+    def row_mask(self) -> np.ndarray:
+        b = self.batch_size
+        n = b if self.n_valid is None else self.n_valid
+        return (np.arange(b) < n).astype(np.float32)
+
+
+def parse_feature_strings(features: Sequence[str],
+                          *, int_feature: bool = False,
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse one row of ``"idx:val"`` / ``"idx"`` feature strings.
+
+    Reference semantics: hivemall.model.FeatureValue.parse — a bare ``"idx"``
+    means value 1.0 (categorical); ``"idx:val"`` splits on the LAST ':' so that
+    string feature names containing ':' still parse (SURVEY.md §3.1).
+    """
+    idx: List[int] = []
+    val: List[float] = []
+    from ..utils.hashing import mhash
+    for f in features:
+        if f is None or f == "":
+            continue
+        name, sep, v = str(f).rpartition(":")
+        if not sep:
+            name, v = str(f), "1.0"
+        try:
+            i = int(name)
+        except ValueError:
+            if int_feature:
+                raise ValueError(
+                    f"-int_feature is set but feature name {name!r} is not an "
+                    f"integer index")
+            i = mhash(name)
+        idx.append(i)
+        val.append(float(v))
+    return np.asarray(idx, np.int32), np.asarray(val, np.float32)
+
+
+def pad_examples(rows: Sequence[Tuple[np.ndarray, np.ndarray]],
+                 labels: Sequence[float],
+                 max_len: Optional[int] = None,
+                 fields: Optional[Sequence[np.ndarray]] = None,
+                 truncate: bool = False) -> SparseBatch:
+    """Pad a list of (idx, val) rows to a rectangular SparseBatch.
+
+    Rows longer than ``max_len`` raise unless ``truncate=True`` is explicit —
+    silent feature loss is never the default.
+    """
+    B = len(rows)
+    L = max_len or max((len(r[0]) for r in rows), default=1)
+    L = max(L, 1)
+    idx = np.zeros((B, L), np.int32)
+    val = np.zeros((B, L), np.float32)
+    fld = np.zeros((B, L), np.int32) if fields is not None else None
+    for b, (i, v) in enumerate(rows):
+        if len(i) > L and not truncate:
+            raise ValueError(
+                f"row {b} has {len(i)} features > max_len={L}; pass "
+                f"truncate=True to drop the excess explicitly")
+        n = min(len(i), L)
+        idx[b, :n] = i[:n]
+        val[b, :n] = v[:n]
+        if fld is not None:
+            fld[b, :n] = fields[b][:n]
+    return SparseBatch(idx, val, np.asarray(labels, np.float32), fld, n_valid=B)
+
+
+class SparseDataset:
+    """In-memory sparse dataset with epoch/shuffle/minibatch iteration.
+
+    Plays the role of the engine feeding rows into the UDTF plus the
+    NioStatefulSegment replay buffer for ``-iters > 1`` (SURVEY.md §3.20):
+    holding the parsed CSR arrays in host RAM, re-shuffling per epoch, and
+    emitting fixed-shape padded batches (short final batch is padded up and
+    carries ``n_valid`` so loss masks it out).
+    """
+
+    def __init__(self, indices: np.ndarray, indptr: np.ndarray,
+                 values: np.ndarray, labels: np.ndarray,
+                 fields: Optional[np.ndarray] = None):
+        self.indices = np.asarray(indices, np.int32)    # flat feature ids
+        self.indptr = np.asarray(indptr, np.int64)      # row offsets, len = n+1
+        self.values = np.asarray(values, np.float32)
+        self.labels = np.asarray(labels, np.float32)
+        self.fields = None if fields is None else np.asarray(fields, np.int32)
+
+    @classmethod
+    def from_rows(cls, rows: Sequence[Tuple[np.ndarray, np.ndarray]],
+                  labels: Sequence[float],
+                  fields: Optional[Sequence[np.ndarray]] = None
+                  ) -> "SparseDataset":
+        indptr = np.zeros(len(rows) + 1, np.int64)
+        for i, (ix, _) in enumerate(rows):
+            indptr[i + 1] = indptr[i] + len(ix)
+        indices = np.concatenate([np.asarray(r[0], np.int32) for r in rows]) \
+            if rows else np.zeros(0, np.int32)
+        values = np.concatenate([np.asarray(r[1], np.float32) for r in rows]) \
+            if rows else np.zeros(0, np.float32)
+        flds = None
+        if fields is not None:
+            flds = np.concatenate([np.asarray(f, np.int32) for f in fields]) \
+                if len(fields) else np.zeros(0, np.int32)
+        return cls(indices, indptr, values, np.asarray(labels, np.float32), flds)
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    @property
+    def max_row_len(self) -> int:
+        if len(self) == 0:
+            return 1
+        return int(np.max(np.diff(self.indptr)))
+
+    def row(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
+        s, e = self.indptr[i], self.indptr[i + 1]
+        return self.indices[s:e], self.values[s:e]
+
+    def batches(self, batch_size: int, *, epochs: int = 1, shuffle: bool = False,
+                seed: int = 42, max_len: Optional[int] = None,
+                drop_remainder: bool = False,
+                truncate: bool = False) -> Iterator[SparseBatch]:
+        n = len(self)
+        L = max(1, max_len or self.max_row_len)
+        if max_len is not None and not truncate and self.max_row_len > L:
+            raise ValueError(
+                f"max_len={L} would drop features from rows up to "
+                f"{self.max_row_len} long; pass truncate=True to allow")
+        rng = np.random.default_rng(seed)
+        lens = np.diff(self.indptr).astype(np.int64)
+        for ep in range(epochs):
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            for s in range(0, n, batch_size):
+                take = order[s: s + batch_size]
+                nv = len(take)
+                if nv < batch_size and drop_remainder:
+                    break
+                idx = np.zeros((batch_size, L), np.int32)
+                val = np.zeros((batch_size, L), np.float32)
+                fld = np.zeros((batch_size, L), np.int32) \
+                    if self.fields is not None else None
+                for b, r in enumerate(take):
+                    st = self.indptr[r]
+                    m = min(int(lens[r]), L)
+                    idx[b, :m] = self.indices[st: st + m]
+                    val[b, :m] = self.values[st: st + m]
+                    if fld is not None:
+                        fld[b, :m] = self.fields[st: st + m]
+                lab = np.zeros(batch_size, np.float32)
+                lab[:nv] = self.labels[take]
+                yield SparseBatch(idx, val, lab, fld,
+                                  n_valid=nv if nv < batch_size else None)

@@ -1,0 +1,6 @@
+"""Utility substrate (reference layer L1, SURVEY.md §2/§3.20).
+
+The reference's collection classes (Int2FloatOpenHashTable, HalfFloat fp16 codec,
+NioStatefulSegment) collapse into JAX/numpy arrays and the io/ replay cache; what
+remains here is what must be semantically exact: MurmurHash3 and option parsing.
+"""

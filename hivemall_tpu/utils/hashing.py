@@ -1,0 +1,165 @@
+"""MurmurHash3 (x86_32) — bit-exact scalar + vectorized implementations.
+
+Reference: hivemall/utils/hashing/MurmurHash3.java [U], used by
+ftvec.hashing (mhash / feature_hashing) to map arbitrary feature names into
+[1, 2^24] (SURVEY.md §3.12, §3.20 — "must be bit-exact in the rebuild").
+
+Two code paths with identical results:
+  - ``murmurhash3_x86_32(data, seed)``: scalar, pure Python, any byte length.
+  - ``murmurhash3_batch(list_of_bytes, seed)``: numpy-vectorized over many keys
+    (the host ingest hot path; a C++ ctypes kernel in native/ accelerates this
+    further when built — see hivemall_tpu.utils.native).
+
+Verified against the canonical public test vectors of the MurmurHash3_x86_32
+reference implementation (Austin Appleby's smhasher), see tests/test_hashing.py.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, List, Sequence
+
+import numpy as np
+
+__all__ = [
+    "murmurhash3_x86_32",
+    "murmurhash3_batch",
+    "mhash",
+    "DEFAULT_NUM_FEATURES",
+]
+
+# Hivemall's mhash default key space: 2^24 (SURVEY.md §3.12 — hashing trick
+# bounding the feature dimension; ids land in [1, 2^24]).
+DEFAULT_NUM_FEATURES = 1 << 24
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_M32 = 0xFFFFFFFF
+
+
+def murmurhash3_x86_32(data: bytes | str, seed: int = 0) -> int:
+    """MurmurHash3_x86_32 of ``data`` with ``seed``; returns unsigned 32-bit int."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    h = seed & _M32
+    n = len(data)
+    nblocks = n >> 2
+    # body: 4-byte little-endian blocks
+    for (k,) in struct.iter_unpack("<I", data[: nblocks * 4]):
+        k = (k * _C1) & _M32
+        k = ((k << 15) | (k >> 17)) & _M32
+        k = (k * _C2) & _M32
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & _M32
+        h = (h * 5 + 0xE6546B64) & _M32
+    # tail
+    tail = data[nblocks * 4 :]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * _C1) & _M32
+        k = ((k << 15) | (k >> 17)) & _M32
+        k = (k * _C2) & _M32
+        h ^= k
+    # finalization mix
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
+
+
+def murmurhash3_batch(keys: Sequence[bytes | str], seed: int = 0) -> np.ndarray:
+    """Hash many keys; returns uint32 array. Vectorized over same-length groups.
+
+    Strategy: bucket keys by byte length, pack each bucket into a (n, L) uint8
+    matrix, and run the whole murmur3 pipeline with numpy uint32 arithmetic —
+    identical rounds for every key of the same length, so fully vectorizable.
+    """
+    enc: List[bytes] = [k.encode("utf-8") if isinstance(k, str) else k for k in keys]
+    out = np.empty(len(enc), dtype=np.uint32)
+    if not enc:
+        return out
+    by_len: dict[int, list[int]] = {}
+    for i, b in enumerate(enc):
+        by_len.setdefault(len(b), []).append(i)
+    for L, idxs in by_len.items():
+        mat = np.frombuffer(
+            b"".join(enc[i] for i in idxs), dtype=np.uint8
+        ).reshape(len(idxs), L) if L > 0 else np.zeros((len(idxs), 0), np.uint8)
+        out[idxs] = _mmh3_fixed_len(mat, seed)
+    return out
+
+
+def _rotl32(x: np.ndarray, r: int) -> np.ndarray:
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _mmh3_fixed_len(mat: np.ndarray, seed: int) -> np.ndarray:
+    """Vectorized murmur3 over an (n, L) uint8 matrix of same-length keys."""
+    n, L = mat.shape
+    with np.errstate(over="ignore"):
+        h = np.full(n, seed, dtype=np.uint32)
+        c1 = np.uint32(_C1)
+        c2 = np.uint32(_C2)
+        nblocks = L >> 2
+        if nblocks:
+            blocks = mat[:, : nblocks * 4].reshape(n, nblocks, 4).astype(np.uint32)
+            ks = (
+                blocks[:, :, 0]
+                | (blocks[:, :, 1] << np.uint32(8))
+                | (blocks[:, :, 2] << np.uint32(16))
+                | (blocks[:, :, 3] << np.uint32(24))
+            )
+            for j in range(nblocks):
+                k = ks[:, j] * c1
+                k = _rotl32(k, 15) * c2
+                h ^= k
+                h = _rotl32(h, 13) * np.uint32(5) + np.uint32(0xE6546B64)
+        tail = mat[:, nblocks * 4 :].astype(np.uint32)
+        t = L & 3
+        if t:
+            k = np.zeros(n, dtype=np.uint32)
+            if t >= 3:
+                k ^= tail[:, 2] << np.uint32(16)
+            if t >= 2:
+                k ^= tail[:, 1] << np.uint32(8)
+            k ^= tail[:, 0]
+            k *= c1
+            k = _rotl32(k, 15) * c2
+            h ^= k
+        h ^= np.uint32(L)
+        h ^= h >> np.uint32(16)
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> np.uint32(13)
+        h *= np.uint32(0xC2B2AE35)
+        h ^= h >> np.uint32(16)
+    return h
+
+
+def mhash(word: str | bytes, num_features: int = DEFAULT_NUM_FEATURES,
+          seed: int = 0) -> int:
+    """SQL: mhash(word) — murmur3 the word into [1, num_features].
+
+    Reference: hivemall.ftvec.hashing.MurmurHash3UDF [U]. The signed 32-bit hash
+    is reduced mod num_features (non-negative residue) and shifted by +1 so that
+    index 0 stays free for the ``add_bias`` constant feature "0:1.0".
+    """
+    h = murmurhash3_x86_32(word, seed)
+    signed = h - (1 << 32) if h >= (1 << 31) else h
+    return signed % num_features + 1
+
+
+def mhash_batch(words: Sequence[str | bytes],
+                num_features: int = DEFAULT_NUM_FEATURES,
+                seed: int = 0) -> np.ndarray:
+    """Vectorized mhash; returns int64 array of ids in [1, num_features]."""
+    h = murmurhash3_batch(words, seed).astype(np.int64)
+    signed = np.where(h >= (1 << 31), h - (1 << 32), h)
+    return signed % num_features + 1

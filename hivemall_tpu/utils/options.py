@@ -1,0 +1,144 @@
+"""Option-string grammar — the `'-loss logloss -opt AdaGrad -reg l1'` surface.
+
+Reference: hivemall.UDTFWithOptions / UDFWithOptions parse each function's
+trailing ``const string options`` argument with commons-cli (SURVEY.md §3.1, §6
+"Config / flag system"). Every catalog function here declares an OptionSpec with
+the same option names; ``-help`` on any function prints its grammar, matching
+the reference's behavior.
+
+Grammar (commons-cli GnuParser-compatible subset):
+  - tokens are whitespace-split; shell-style quotes are honored
+  - ``-name value`` for options declared with an argument
+  - ``-name`` for boolean flags
+  - both ``-name`` and ``--name`` accepted; unknown options raise
+"""
+
+from __future__ import annotations
+
+import shlex
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = ["Option", "OptionSpec", "Parsed", "HelpRequested", "OptionError"]
+
+
+class OptionError(ValueError):
+    """Unknown option / missing argument / bad value."""
+
+
+class HelpRequested(Exception):
+    """Raised when '-help' appears; carries the usage text."""
+
+    def __init__(self, usage: str):
+        super().__init__(usage)
+        self.usage = usage
+
+
+@dataclass
+class Option:
+    name: str                      # canonical short name, e.g. "eta0"
+    long: Optional[str] = None     # optional long alias, e.g. "total_steps"
+    has_arg: bool = True
+    type: Callable[[str], Any] = str
+    default: Any = None
+    help: str = ""
+    choices: Optional[Sequence[str]] = None
+
+    def convert(self, raw: str) -> Any:
+        try:
+            v = self.type(raw)
+        except (TypeError, ValueError) as e:
+            raise OptionError(f"-{self.name}: cannot parse {raw!r}: {e}") from e
+        if self.choices is not None:
+            sv = str(v).lower()
+            lowered = {str(c).lower(): c for c in self.choices}
+            if sv not in lowered:
+                raise OptionError(
+                    f"-{self.name}: {raw!r} not in {sorted(self.choices)}")
+            return lowered[sv]
+        return v
+
+
+class Parsed(dict):
+    """Parsed option namespace with attribute access."""
+
+    def __getattr__(self, k: str) -> Any:
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+
+@dataclass
+class OptionSpec:
+    """Declared option grammar for one catalog function."""
+
+    func_name: str = ""
+    options: List[Option] = field(default_factory=list)
+
+    def add(self, name: str, long: Optional[str] = None, *, has_arg: bool = True,
+            type: Callable[[str], Any] = str, default: Any = None,
+            help: str = "", choices: Optional[Sequence[str]] = None) -> "OptionSpec":
+        self.options.append(Option(name, long, has_arg, type, default, help, choices))
+        return self
+
+    def flag(self, name: str, long: Optional[str] = None, *, help: str = "") -> "OptionSpec":
+        return self.add(name, long, has_arg=False, type=bool, default=False, help=help)
+
+    def _index(self) -> Dict[str, Option]:
+        ix: Dict[str, Option] = {}
+        for o in self.options:
+            ix[o.name] = o
+            if o.long:
+                ix[o.long] = o
+        return ix
+
+    def usage(self) -> str:
+        lines = [f"usage: {self.func_name or '<function>'} [options]"]
+        for o in self.options:
+            names = f"-{o.name}" + (f", --{o.long}" if o.long else "")
+            arg = " <arg>" if o.has_arg else ""
+            dflt = ("" if o.default is None or o.default is False
+                    else f" (default: {o.default})")
+            ch = f" one of {list(o.choices)}" if o.choices else ""
+            lines.append(f"  {names}{arg}\t{o.help}{ch}{dflt}")
+        return "\n".join(lines)
+
+    def parse(self, optstr: str | None) -> Parsed:
+        """Parse an option string into a namespace (defaults filled in)."""
+        ns = Parsed()
+        for o in self.options:
+            ns[(o.long or o.name)] = o.default
+            ns[o.name] = o.default
+        if not optstr:
+            return ns
+        ix = self._index()
+        toks = shlex.split(optstr)
+        i = 0
+        while i < len(toks):
+            t = toks[i]
+            if not t.startswith("-") or t == "-":
+                raise OptionError(
+                    f"{self.func_name}: expected an option, got {t!r}")
+            name = t.lstrip("-")
+            if name in ("help", "h"):
+                raise HelpRequested(self.usage())
+            o = ix.get(name)
+            if o is None:
+                raise OptionError(f"{self.func_name}: unknown option -{name}")
+            if o.has_arg:
+                if i + 1 >= len(toks):
+                    raise OptionError(f"{self.func_name}: -{name} needs an argument")
+                val = o.convert(toks[i + 1])
+                i += 2
+            else:
+                val = True
+                i += 1
+            ns[o.name] = val
+            if o.long:
+                ns[o.long] = val
+        return ns
+
+
+def boolish(s: str) -> bool:
+    return str(s).lower() in ("1", "true", "yes", "on")

@@ -1,0 +1,14 @@
+"""Test config: run JAX on CPU with 8 virtual devices.
+
+Mirrors the reference's "distributed without a cluster" trick (SURVEY.md §5
+item 3 — in-process localhost MixServer): mix/psum semantics are exercised on
+an 8-device virtual CPU mesh, no TPU pod needed. Must run before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,38 @@
+"""Catalog conformance (SURVEY.md §5 rebuild plan item 4): every registered
+function resolves to a callable and its option grammar parses."""
+
+from hivemall_tpu.catalog import all_functions, define_all, help_for, lookup
+from hivemall_tpu.utils.options import HelpRequested
+
+
+def test_all_entries_resolve():
+    funcs = all_functions()
+    assert len(funcs) >= 3
+    for name, e in funcs.items():
+        obj = e.resolve()
+        assert callable(obj) or isinstance(obj, type), name
+        assert e.kind in ("UDF", "UDAF", "UDTF"), name
+
+
+def test_option_grammars_parse():
+    for name, e in all_functions().items():
+        if e.options is not None:
+            ns = e.options.parse(None)
+            assert isinstance(ns, dict)
+            try:
+                e.options.parse("-help")
+                assert False, f"{name}: -help did not raise"
+            except HelpRequested as h:
+                assert name in h.usage
+
+
+def test_define_all_renders():
+    ddl = define_all()
+    assert "hivemall_version" in ddl
+    assert "CREATE FUNCTION" in ddl
+
+
+def test_lookup_and_help():
+    e = lookup("mhash")
+    assert e.reference.startswith("hivemall.")
+    assert "mhash" in help_for("mhash")

@@ -1,0 +1,53 @@
+"""murmur3 bit-exactness (SURVEY.md §3.20: feature-hashing parity is
+correctness-critical) against canonical public MurmurHash3_x86_32 vectors."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.utils.hashing import (
+    DEFAULT_NUM_FEATURES, mhash, mhash_batch, murmurhash3_batch,
+    murmurhash3_x86_32)
+
+# Canonical MurmurHash3_x86_32 vectors (smhasher reference implementation).
+VECTORS = [
+    (b"", 0, 0x00000000),
+    (b"hello", 0, 0x248BFA47),        # mmh3.hash("hello") == 613153351
+    (b"foo", 0, 0xF6A5C420),          # mmh3.hash("foo") == -156908512 signed
+    (b"hello, world", 0, 0x345B5A99), # classic smhasher-derived vector
+]
+
+
+@pytest.mark.parametrize("data,seed,expect", VECTORS[:3])
+def test_known_vectors(data, seed, expect):
+    assert murmurhash3_x86_32(data, seed) == expect
+
+
+def test_scalar_batch_agree():
+    keys = ["", "a", "ab", "abc", "abcd", "abcde", "hello world",
+            "field:12:0.5", "x" * 31, "日本語テキスト", "0:1.0"]
+    batch = murmurhash3_batch(keys)
+    for k, h in zip(keys, batch):
+        assert murmurhash3_x86_32(k) == int(h), k
+
+
+def test_seed_changes_hash():
+    assert murmurhash3_x86_32(b"hello", 1) != murmurhash3_x86_32(b"hello", 0)
+
+
+def test_mhash_range():
+    ids = [mhash(f"feat{i}") for i in range(1000)]
+    assert all(1 <= i <= DEFAULT_NUM_FEATURES for i in ids)
+    # id 0 reserved for padding/bias
+    assert 0 not in ids
+
+
+def test_mhash_batch_agrees():
+    keys = [f"cat#{i}" for i in range(500)]
+    b = mhash_batch(keys, num_features=2 ** 20)
+    for k, h in zip(keys, b):
+        assert mhash(k, num_features=2 ** 20) == int(h)
+    assert b.min() >= 1 and b.max() <= 2 ** 20
+
+
+def test_empty_batch():
+    assert murmurhash3_batch([]).shape == (0,)

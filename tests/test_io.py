@@ -1,0 +1,87 @@
+import numpy as np
+
+from hivemall_tpu.io import (ReplayCache, SparseDataset, amplify, rand_amplify,
+                             read_libsvm, write_libsvm)
+from hivemall_tpu.io.libsvm import synthetic_classification
+from hivemall_tpu.io.sparse import parse_feature_strings
+
+
+def small_ds():
+    rows = [(np.array([1, 5]), np.array([1.0, 2.0])),
+            (np.array([2]), np.array([0.5])),
+            (np.array([1, 2, 3]), np.array([1., 1., 1.]))]
+    return SparseDataset.from_rows(rows, [1.0, -1.0, 1.0])
+
+
+def test_roundtrip_libsvm(tmp_path):
+    ds = small_ds()
+    p = str(tmp_path / "t.libsvm")
+    write_libsvm(ds, p)
+    ds2 = read_libsvm(p)
+    assert np.array_equal(ds.indices, ds2.indices)
+    assert np.array_equal(ds.indptr, ds2.indptr)
+    assert np.allclose(ds.values, ds2.values)
+    assert np.allclose(ds.labels, ds2.labels)
+
+
+def test_batches_padding():
+    ds = small_ds()
+    batches = list(ds.batches(2))
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert b0.idx.shape == (2, 3)
+    assert b0.idx[1, 1] == 0 and b0.val[1, 1] == 0.0   # padding
+    b1 = batches[1]
+    assert b1.n_valid == 1
+    assert b1.row_mask.tolist() == [1.0, 0.0]
+
+
+def test_batches_shuffle_covers_all():
+    ds, _ = synthetic_classification(100, 50, seed=1)
+    seen = []
+    for b in ds.batches(32, shuffle=True, seed=7):
+        nv = b.n_valid or b.batch_size
+        seen.extend(b.label[:nv].tolist())
+    assert len(seen) == 100
+
+
+def test_amplify():
+    ds = small_ds()
+    a = amplify(ds, 3)
+    assert len(a) == 9
+    # reference AmplifierUDTF order: each row emitted xtimes consecutively
+    assert np.allclose(a.labels, np.repeat(ds.labels, 3))
+    r0, r1 = a.row(0), a.row(1)
+    assert np.array_equal(r0[0], r1[0])
+    assert np.array_equal(a.row(3)[0], ds.row(1)[0])
+
+
+def test_rand_amplify_preserves_multiset():
+    ds = small_ds()
+    a = rand_amplify(ds, 2, bufsize=4, seed=0)
+    assert len(a) == 6
+    assert sorted(a.labels.tolist()) == sorted((ds.labels.tolist() * 2))
+
+
+def test_replay_cache():
+    ds = small_ds()
+    cache = ReplayCache()
+    batches = list(cache.epochs(ds, iters=3, batch_size=2, shuffle=True))
+    total = sum((b.n_valid or b.batch_size) for b in batches)
+    assert total == 9
+
+
+def test_parse_feature_strings():
+    idx, val = parse_feature_strings(["1:0.5", "7", "0:1.0"])
+    assert idx.tolist() == [1, 7, 0]
+    assert np.allclose(val, [0.5, 1.0, 1.0])
+    # hashed string features land in [1, 2^24]
+    idx2, val2 = parse_feature_strings(["height:1.7", "cat#tokyo"])
+    assert (idx2 >= 1).all()
+    assert np.allclose(val2, [1.7, 1.0])
+
+
+def test_synthetic_separable():
+    ds, w = synthetic_classification(200, 30, seed=3)
+    assert len(ds) == 200
+    assert set(np.unique(ds.labels)) <= {-1.0, 1.0}

@@ -1,0 +1,54 @@
+import pytest
+
+from hivemall_tpu.utils.options import (HelpRequested, OptionError, OptionSpec)
+
+
+def spec():
+    return (OptionSpec("train_classifier")
+            .add("loss", "loss_function", default="hingeloss",
+                 help="loss function")
+            .add("opt", "optimizer", default="sgd")
+            .add("eta0", type=float, default=0.1)
+            .add("iters", "iterations", type=int, default=1)
+            .flag("dense", "densemodel", help="use dense model"))
+
+
+def test_defaults():
+    ns = spec().parse(None)
+    assert ns.loss == "hingeloss" and ns.eta0 == 0.1 and ns.dense is False
+
+
+def test_parse_mixed():
+    ns = spec().parse("-loss logloss -opt AdaGrad -eta0 0.5 -dense -iters 10")
+    assert ns.loss == "logloss"
+    assert ns.opt == "AdaGrad"
+    assert ns.eta0 == 0.5
+    assert ns.dense is True
+    assert ns.iters == 10 and ns.iterations == 10  # long alias mirrors
+
+
+def test_long_names():
+    ns = spec().parse("--iterations 3 --densemodel")
+    assert ns.iters == 3 and ns.dense is True
+
+
+def test_unknown_raises():
+    with pytest.raises(OptionError):
+        spec().parse("-nope 1")
+
+
+def test_missing_arg_raises():
+    with pytest.raises(OptionError):
+        spec().parse("-eta0")
+
+
+def test_help():
+    with pytest.raises(HelpRequested) as e:
+        spec().parse("-help")
+    assert "train_classifier" in e.value.usage
+    assert "-loss" in e.value.usage
+
+
+def test_quoted_values():
+    ns = OptionSpec("f").add("mix").parse("-mix 'host1,host2'")
+    assert ns.mix == "host1,host2"
