@@ -17,6 +17,63 @@ register("mhash", "UDF", "hivemall_tpu.utils.hashing:mhash",
          description="MurmurHash3 a word into [1, 2^24]",
          reference="hivemall.ftvec.hashing.MurmurHash3UDF")
 
+# --- general trainers (SURVEY.md §3.3, §3.5) -------------------------------
+
+
+def _learner(name, cls_path, ref, desc):
+    from importlib import import_module
+    mod, _, attr = cls_path.partition(":")
+    cls = getattr(import_module(mod), attr)
+    register(name, "UDTF", cls_path, description=desc, reference=ref,
+             options=cls.spec())
+
+
+_learner("train_classifier", "hivemall_tpu.models.linear:GeneralClassifier",
+         "hivemall.classifier.GeneralClassifierUDTF",
+         "general binary classifier: pluggable loss x optimizer x reg")
+_learner("train_regressor", "hivemall_tpu.models.linear:GeneralRegressor",
+         "hivemall.regression.GeneralRegressorUDTF",
+         "general regressor: pluggable loss x optimizer x reg")
+_learner("train_logregr", "hivemall_tpu.models.linear:LogressTrainer",
+         "hivemall.regression.LogressUDTF",
+         "logistic regression by SGD")
+_learner("train_adagrad_regr",
+         "hivemall_tpu.models.linear:AdaGradLogisticTrainer",
+         "hivemall.regression.AdaGradUDTF",
+         "logistic regression with AdaGrad")
+_learner("train_adadelta_regr",
+         "hivemall_tpu.models.linear:AdaDeltaLogisticTrainer",
+         "hivemall.regression.AdaDeltaUDTF",
+         "logistic regression with AdaDelta")
+
+# --- evaluation (SURVEY.md §3.14) ------------------------------------------
+for _name, _fn, _ref, _desc in [
+    ("auc", "auc", "hivemall.evaluation.AUCUDAF", "ROC AUC"),
+    ("logloss", "logloss", "hivemall.evaluation.LogarithmicLossUDAF",
+     "mean logarithmic loss"),
+    ("fmeasure", "fmeasure", "hivemall.evaluation.FMeasureUDAF", "F-measure"),
+    ("f1score", "f1score", "hivemall.evaluation.FMeasureUDAF", "F1 score"),
+    ("mae", "mae", "hivemall.evaluation.MeanAbsoluteErrorUDAF",
+     "mean absolute error"),
+    ("mse", "mse", "hivemall.evaluation.MeanSquaredErrorUDAF",
+     "mean squared error"),
+    ("rmse", "rmse", "hivemall.evaluation.RootMeanSquaredErrorUDAF",
+     "root mean squared error"),
+    ("r2", "r2", "hivemall.evaluation.R2UDAF", "coefficient of determination"),
+    ("precision_at", "precision_at", "hivemall.evaluation.PrecisionUDAF",
+     "precision@k over recommendation lists"),
+    ("recall_at", "recall_at", "hivemall.evaluation.RecallUDAF",
+     "recall@k over recommendation lists"),
+    ("hitrate", "hitrate", "hivemall.evaluation.HitRateUDAF", "hit rate@k"),
+    ("mrr", "mrr", "hivemall.evaluation.MRRUDAF", "mean reciprocal rank"),
+    ("average_precision", "average_precision", "hivemall.evaluation.MAPUDAF",
+     "average precision@k"),
+    ("ndcg", "ndcg", "hivemall.evaluation.NDCGUDAF",
+     "normalized DCG (binary or graded)"),
+]:
+    register(_name, "UDAF", f"hivemall_tpu.frame.evaluation:{_fn}",
+             description=_desc, reference=_ref)
+
 # --- ftvec.amplify ----------------------------------------------------------
 register("amplify", "UDTF", "hivemall_tpu.io.amplify:amplify",
          description="emit each row xtimes (multi-epoch under one-pass SQL)",

@@ -1,0 +1,3 @@
+from .evaluation import (  # noqa: F401
+    auc, logloss, f1score, fmeasure, mae, mse, rmse, r2,
+    precision_at, recall_at, hitrate, mrr, average_precision, ndcg)

@@ -1,0 +1,159 @@
+"""Evaluation metrics — the UDAF set, as columnar numpy reductions.
+
+Reference: hivemall.evaluation (SURVEY.md §3.14): AUCUDAF,
+LogarithmicLossUDAF, FMeasureUDAF, MAE/MSE/RMSE/R2 UDAFs, and the ranking
+measures (BinaryResponsesMeasures / GradedResponsesMeasures): precision_at,
+recall_at, hitrate, mrr, average_precision, ndcg.
+
+Point metrics take (labels, predictions) arrays — the rebuild of streaming
+aggregation over rows is a vectorized reduction over columns. Ranking metrics
+take (recommended list, ground-truth list) pairs per user.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+__all__ = ["auc", "logloss", "f1score", "fmeasure", "mae", "mse", "rmse", "r2",
+           "precision_at", "recall_at", "hitrate", "mrr", "average_precision",
+           "ndcg"]
+
+
+# --- binary / point metrics -------------------------------------------------
+
+def auc(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Binary ROC AUC via the rank statistic (ties get midranks) —
+    equivalent to the reference's score-sorted streaming trapezoid."""
+    y = np.asarray(labels).astype(np.float64)
+    y = (y > 0).astype(np.float64)
+    s = np.asarray(scores).astype(np.float64)
+    n_pos = y.sum()
+    n_neg = len(y) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    order = np.argsort(s, kind="mergesort")
+    ranks = np.empty(len(s), np.float64)
+    ranks[order] = np.arange(1, len(s) + 1)
+    # midranks for ties
+    sorted_s = s[order]
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and sorted_s[j + 1] == sorted_s[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    return float((ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2)
+                 / (n_pos * n_neg))
+
+
+def logloss(labels: np.ndarray, probs: np.ndarray, eps: float = 1e-15) -> float:
+    """Mean logarithmic loss over P(y=1) predictions; labels 0/1 or ±1."""
+    y = (np.asarray(labels) > 0).astype(np.float64)
+    p = np.clip(np.asarray(probs).astype(np.float64), eps, 1 - eps)
+    return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+
+
+def fmeasure(actual: np.ndarray, predicted: np.ndarray,
+             beta: float = 1.0) -> float:
+    """F-measure over binary labels (0/1 or ±1)."""
+    a = np.asarray(actual) > 0
+    p = np.asarray(predicted) > 0
+    tp = float(np.sum(a & p))
+    fp = float(np.sum(~a & p))
+    fn = float(np.sum(a & ~p))
+    b2 = beta * beta
+    denom = (1 + b2) * tp + b2 * fn + fp
+    return float((1 + b2) * tp / denom) if denom > 0 else 0.0
+
+
+def f1score(actual: np.ndarray, predicted: np.ndarray) -> float:
+    return fmeasure(actual, predicted, beta=1.0)
+
+
+def mae(actual: np.ndarray, predicted: np.ndarray) -> float:
+    return float(np.mean(np.abs(np.asarray(actual, np.float64)
+                                - np.asarray(predicted, np.float64))))
+
+
+def mse(actual: np.ndarray, predicted: np.ndarray) -> float:
+    d = np.asarray(actual, np.float64) - np.asarray(predicted, np.float64)
+    return float(np.mean(d * d))
+
+
+def rmse(actual: np.ndarray, predicted: np.ndarray) -> float:
+    return float(np.sqrt(mse(actual, predicted)))
+
+
+def r2(actual: np.ndarray, predicted: np.ndarray) -> float:
+    a = np.asarray(actual, np.float64)
+    ss_res = float(np.sum((a - np.asarray(predicted, np.float64)) ** 2))
+    ss_tot = float(np.sum((a - a.mean()) ** 2))
+    return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+
+
+# --- ranking metrics (recommended list vs ground-truth list) ---------------
+
+def _trunc(recommended: Sequence, k: int | None) -> Sequence:
+    return recommended if not k else recommended[:k]
+
+
+def precision_at(recommended: Sequence, truth: Sequence, k: int = 0) -> float:
+    rec = _trunc(recommended, k)
+    if not rec:
+        return 0.0
+    t = set(truth)
+    return sum(1 for r in rec if r in t) / len(rec)
+
+
+def recall_at(recommended: Sequence, truth: Sequence, k: int = 0) -> float:
+    if not truth:
+        return 0.0
+    t = set(truth)
+    rec = _trunc(recommended, k)
+    return sum(1 for r in rec if r in t) / len(t)
+
+
+def hitrate(recommended: Sequence, truth: Sequence, k: int = 0) -> float:
+    t = set(truth)
+    return 1.0 if any(r in t for r in _trunc(recommended, k)) else 0.0
+
+
+def mrr(recommended: Sequence, truth: Sequence, k: int = 0) -> float:
+    t = set(truth)
+    for i, r in enumerate(_trunc(recommended, k)):
+        if r in t:
+            return 1.0 / (i + 1)
+    return 0.0
+
+
+def average_precision(recommended: Sequence, truth: Sequence,
+                      k: int = 0) -> float:
+    t = set(truth)
+    if not t:
+        return 0.0
+    hits = 0
+    s = 0.0
+    for i, r in enumerate(_trunc(recommended, k)):
+        if r in t:
+            hits += 1
+            s += hits / (i + 1)
+    return s / min(len(t), len(_trunc(recommended, k))) if hits else 0.0
+
+
+def ndcg(recommended: Sequence, truth: Sequence, k: int = 0) -> float:
+    """Binary-relevance NDCG; graded form via dict truth {item: gain}."""
+    rec = _trunc(recommended, k)
+    if isinstance(truth, dict):
+        gains = [float(truth.get(r, 0.0)) for r in rec]
+        ideal = sorted((float(g) for g in truth.values()), reverse=True)
+    else:
+        t = set(truth)
+        gains = [1.0 if r in t else 0.0 for r in rec]
+        ideal = [1.0] * min(len(t), len(rec) if rec else len(t))
+    dcg = sum(g / np.log2(i + 2) for i, g in enumerate(gains))
+    idcg = sum(g / np.log2(i + 2) for i, g in enumerate(ideal[:len(rec) or None]))
+    return float(dcg / idcg) if idcg > 0 else 0.0

@@ -1,0 +1,3 @@
+from .base import LearnerBase, learner_option_spec  # noqa: F401
+from .linear import (GeneralClassifier, GeneralRegressor, LogressTrainer,  # noqa: F401
+                     AdaGradLogisticTrainer, AdaDeltaLogisticTrainer)

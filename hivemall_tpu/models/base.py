@@ -1,0 +1,248 @@
+"""LearnerBase — the trainer-UDTF lifecycle over TPU minibatch kernels.
+
+Reference: hivemall.LearnerBaseUDTF + UDTFWithOptions (SURVEY.md §3.1, §4.1):
+a trainer is fed rows one at a time (``process``), holds model state, and at
+``close()`` emits the model as (feature, weight) rows. The rebuild keeps that
+exact lifecycle — tests drive trainers the way the reference's unit tests
+drive UDTFs by hand (SURVEY.md §5.1) — and adds a columnar fast path
+(``fit(dataset)``) that skips per-row Python entirely.
+
+Streaming semantics: rows buffer into fixed-shape minibatches (power-of-two
+padded length so jit traces a few shapes); each full buffer dispatches one
+jitted step. ``-iters > 1`` replays the recorded stream for further epochs
+with reshuffling, the NioStatefulSegment analog.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..io.sparse import SparseBatch, SparseDataset
+from ..utils.hashing import mhash
+from ..utils.options import OptionSpec, Parsed
+
+__all__ = ["LearnerBase", "learner_option_spec"]
+
+
+def learner_option_spec(name: str, *, classification: bool,
+                        default_loss: str) -> OptionSpec:
+    """The shared trainer grammar (reference: LearnerBaseUDTF +
+    GeneralLearnerBaseUDTF options)."""
+    s = OptionSpec(name)
+    s.add("loss", "loss_function", default=default_loss,
+          help="loss function")
+    s.add("opt", "optimizer", default="adagrad", help="optimizer")
+    s.add("reg", "regularization", default="rda",
+          help="regularization: no|l1|l2|elasticnet|rda")
+    s.add("lambda", type=float, default=1e-6, help="regularization strength")
+    s.add("l1_ratio", type=float, default=0.5, help="elasticnet mixing")
+    s.add("eta", default="inverse", help="eta scheme: fixed|simple|inverse")
+    s.add("eta0", type=float, default=0.1, help="initial learning rate")
+    s.add("total_steps", type=int, default=10_000, help="simple-eta horizon")
+    s.add("power_t", type=float, default=0.1, help="inverse-eta exponent")
+    s.add("iters", "iterations", type=int, default=1, help="epochs")
+    s.add("mini_batch", "mini_batch_size", type=int, default=256,
+          help="minibatch size dispatched per jitted step")
+    s.add("dims", "feature_dimensions", type=int, default=1 << 24,
+          help="model table size (hashed feature space)")
+    s.flag("dense", "densemodel",
+           help="accepted for reference compatibility (model is always a "
+                "dense TPU table)")
+    s.flag("disable_halffloat",
+           help="keep float32 weights (default); unset-able via -halffloat")
+    s.flag("halffloat", help="store weights as bfloat16 (HalfFloat analog)")
+    s.flag("int_feature", help="features are integer indices, no hashing")
+    s.add("mix", default=None, help="mix cohort spec (parallel.mix)")
+    s.add("mix_threshold", type=int, default=16,
+          help="local updates between mix exchanges")
+    s.add("mix_session", default=None, help="mix session/group id")
+    s.add("loadmodel", default=None, help="warm-start from a saved model table")
+    s.flag("cv", help="track cumulative loss for convergence check")
+    return s
+
+
+class LearnerBase:
+    """Subclasses set NAME/CLASSIFICATION/DEFAULT_LOSS and _build/_step."""
+
+    NAME = "learner"
+    CLASSIFICATION = True
+    DEFAULT_LOSS = "hingeloss"
+
+    @classmethod
+    def spec(cls) -> OptionSpec:
+        return learner_option_spec(cls.NAME, classification=cls.CLASSIFICATION,
+                                   default_loss=cls.DEFAULT_LOSS)
+
+    def __init__(self, options: str = ""):
+        self.opts: Parsed = self.spec().parse(options)
+        self.dims = int(self.opts.dims)
+        self._names: Dict[int, str] = {}      # hashed id -> original name
+        self._buf_rows: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._buf_labels: List[float] = []
+        self._all_rows: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._all_labels: List[float] = []
+        self._t = 0                           # global step (batches seen)
+        self._loss_sum = 0.0
+        self._examples = 0
+        self._mixer = None
+        self._init_state()
+        if self.opts.loadmodel:
+            self._warm_start(self.opts.loadmodel)
+
+    # -- subclass surface ----------------------------------------------------
+    def _init_state(self) -> None:
+        raise NotImplementedError
+
+    def _train_batch(self, batch: SparseBatch) -> float:
+        """Run one jitted step; returns summed loss over valid rows."""
+        raise NotImplementedError
+
+    def _finalized_weights(self) -> np.ndarray:
+        raise NotImplementedError
+
+    # -- UDTF lifecycle ------------------------------------------------------
+    def process(self, features: Sequence[str] | Tuple[np.ndarray, np.ndarray],
+                label: float) -> None:
+        """Feed one row: features as "name:value" strings (or pre-parsed
+        (idx, val) arrays), label per trainer convention."""
+        idx, val = self._parse_row(features)
+        y = self._convert_label(label)
+        self._buf_rows.append((idx, val))
+        self._buf_labels.append(y)
+        if len(self._buf_rows) >= int(self.opts.mini_batch):
+            self._flush()
+
+    def close(self) -> Iterator[Tuple[str, float]]:
+        """Flush, run extra epochs (-iters), emit model rows."""
+        self._flush()
+        iters = int(self.opts.iters)
+        if iters > 1 and self._all_rows:
+            ds = SparseDataset.from_rows(self._all_rows, self._all_labels)
+            for ep in range(1, iters):
+                for b in ds.batches(int(self.opts.mini_batch), shuffle=True,
+                                    seed=42 + ep):
+                    self._dispatch(b)
+        yield from self.model_rows()
+
+    # -- columnar fast path --------------------------------------------------
+    def fit(self, ds: SparseDataset, *, epochs: Optional[int] = None,
+            shuffle: bool = True) -> "LearnerBase":
+        epochs = int(self.opts.iters) if epochs is None else epochs
+        bs = int(self.opts.mini_batch)
+        labels = self._convert_labels(ds.labels)
+        ds = SparseDataset(ds.indices, ds.indptr, ds.values, labels, ds.fields)
+        for ep in range(epochs):
+            for b in ds.batches(bs, shuffle=shuffle, seed=42 + ep):
+                self._dispatch(b)
+        return self
+
+    # -- shared plumbing -----------------------------------------------------
+    def _parse_row(self, features) -> Tuple[np.ndarray, np.ndarray]:
+        if (isinstance(features, tuple) and len(features) == 2
+                and isinstance(features[0], np.ndarray)):
+            return features
+        idx: List[int] = []
+        val: List[float] = []
+        for f in features:
+            if f is None or f == "":
+                continue
+            name, sep, v = str(f).rpartition(":")
+            if not sep:
+                name, v = str(f), "1"
+            try:
+                i = int(name)
+            except ValueError:
+                if self.opts.int_feature:
+                    raise ValueError(
+                        f"-int_feature set but feature {name!r} not an int")
+                i = mhash(name, self.dims - 1)  # ids in [1, dims-1]
+                self._names.setdefault(i, name)
+            idx.append(i)
+            val.append(float(v))
+        return np.asarray(idx, np.int32), np.asarray(val, np.float32)
+
+    def _convert_label(self, label: float) -> float:
+        if self.CLASSIFICATION:
+            return 1.0 if float(label) > 0 else -1.0
+        return float(label)
+
+    def _convert_labels(self, labels: np.ndarray) -> np.ndarray:
+        if self.CLASSIFICATION:
+            return np.where(labels > 0, 1.0, -1.0).astype(np.float32)
+        return labels.astype(np.float32)
+
+    @staticmethod
+    def _pow2_len(n: int) -> int:
+        L = 1
+        while L < n:
+            L <<= 1
+        return L
+
+    def _flush(self) -> None:
+        if not self._buf_rows:
+            return
+        rows, labels = self._buf_rows, self._buf_labels
+        self._buf_rows, self._buf_labels = [], []
+        if int(self.opts.iters) > 1:
+            self._all_rows.extend(rows)
+            self._all_labels.extend(labels)
+        B = int(self.opts.mini_batch)
+        L = self._pow2_len(max(1, max(len(r[0]) for r in rows)))
+        idx = np.zeros((B, L), np.int32)
+        val = np.zeros((B, L), np.float32)
+        lab = np.zeros(B, np.float32)
+        for b, (i, v) in enumerate(rows):
+            idx[b, :len(i)] = i
+            val[b, :len(v)] = v
+            lab[b] = labels[b]
+        nv = len(rows)
+        self._dispatch(SparseBatch(idx, val, lab,
+                                   n_valid=nv if nv < B else None))
+
+    def _dispatch(self, batch: SparseBatch) -> None:
+        nv = batch.n_valid or batch.batch_size
+        loss_sum = self._train_batch(batch)
+        self._t += 1
+        self._loss_sum += float(loss_sum)
+        self._examples += nv
+        if self._mixer is not None:
+            self._mixer.maybe_mix(self)
+
+    @property
+    def cumulative_loss(self) -> float:
+        return self._loss_sum / max(1, self._examples)
+
+    # -- model emission (the close()-time forward of (feature, weight)) -----
+    def model_rows(self) -> Iterator[Tuple[str, float]]:
+        w = np.asarray(self._finalized_weights())
+        nz = np.nonzero(w)[0]
+        for i in nz:
+            yield self._names.get(int(i), str(int(i))), float(w[i])
+
+    def model_table(self) -> Dict[str, float]:
+        return dict(self.model_rows())
+
+    def _warm_start(self, path: str) -> None:
+        """-loadmodel: read a previously saved model table (feature\tweight)."""
+        w = np.asarray(self._finalized_weights()).copy()
+        with open(path) as f:
+            for line in f:
+                feat, _, weight = line.rstrip("\n").partition("\t")
+                try:
+                    i = int(feat)
+                except ValueError:
+                    i = mhash(feat, self.dims - 1)
+                    self._names.setdefault(i, feat)
+                if 0 <= i < len(w):
+                    w[i] = float(weight)
+        self._load_weights(w)
+
+    def save_model(self, path: str) -> None:
+        with open(path, "w") as f:
+            for feat, weight in self.model_rows():
+                f.write(f"{feat}\t{weight:.9g}\n")
+
+    def _load_weights(self, w: np.ndarray) -> None:
+        raise NotImplementedError

@@ -1,0 +1,136 @@
+"""General linear trainers — train_classifier / train_regressor and the
+historical logistic-regression family.
+
+Reference classes (SURVEY.md §3.3, §3.5):
+  - hivemall.classifier.GeneralClassifierUDTF  (train_classifier) [B]
+  - hivemall.regression.GeneralRegressorUDTF   (train_regressor)  [B]
+  - hivemall.regression.LogressUDTF            (logress / train_logregr)
+  - hivemall.regression.AdaGradUDTF            (train_adagrad_regr)
+  - hivemall.regression.AdaDeltaUDTF           (train_adadelta_regr)
+
+Pluggable loss x optimizer x regularization over a dense hashed weight table;
+one jitted step per minibatch (ops.linear). bf16 storage via -halffloat is the
+HalfFloat analog (SURVEY.md §3.20).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.sparse import SparseBatch, SparseDataset
+from ..ops.linear import make_linear_predict, make_linear_step
+from ..ops.losses import get_loss
+from ..ops.optimizers import make_optimizer
+from .base import LearnerBase
+
+__all__ = ["GeneralClassifier", "GeneralRegressor", "LogressTrainer",
+           "AdaGradLogisticTrainer", "AdaDeltaLogisticTrainer"]
+
+
+class _LinearLearner(LearnerBase):
+    """Shared machinery for dense-table linear trainers."""
+
+    FIXED_LOSS: Optional[str] = None       # set by historical subclasses
+    FIXED_OPT: Optional[str] = None
+    ZERO_ONE_LABELS = False                # logress-style 0/1 labels
+
+    def _init_state(self) -> None:
+        o = self.opts
+        self.loss = get_loss(self.FIXED_LOSS or o.loss)
+        if self.CLASSIFICATION and not self.loss.for_classification:
+            raise ValueError(f"loss {self.loss.name} is regression-only")
+        opt_name = self.FIXED_OPT or o.opt
+        self.optimizer = make_optimizer(
+            opt_name, eta_scheme=o.eta, eta0=o.eta0,
+            total_steps=o.total_steps, power_t=o.power_t,
+            reg=o.reg, lam=o["lambda"], l1_ratio=o.l1_ratio)
+        dtype = jnp.bfloat16 if o.halffloat else jnp.float32
+        self.w = jnp.zeros(self.dims, dtype)
+        self.opt_state = self.optimizer.init(self.dims)
+        self._step = make_linear_step(self.loss, self.optimizer)
+        self._predict = make_linear_predict()
+
+    def _convert_label(self, label: float) -> float:
+        if self.ZERO_ONE_LABELS:
+            # logress semantics: float target in [0,1]; map to ±1 margin space
+            return 1.0 if float(label) > 0.5 else -1.0
+        return super()._convert_label(label)
+
+    def _train_batch(self, batch: SparseBatch) -> float:
+        self.w, self.opt_state, loss_sum = self._step(
+            self.w, self.opt_state, float(self._t),
+            batch.idx, batch.val, batch.label, batch.row_mask)
+        return float(loss_sum)
+
+    def _finalized_weights(self) -> np.ndarray:
+        w = self.optimizer.finalize(self.w.astype(jnp.float32), self.opt_state)
+        return np.asarray(w)
+
+    def _load_weights(self, w: np.ndarray) -> None:
+        self.w = jnp.asarray(w, self.w.dtype)
+
+    # -- scoring (the predict-is-a-join path, SURVEY.md §4.2) ---------------
+    def decision_function(self, ds: SparseDataset) -> np.ndarray:
+        w = jnp.asarray(self._finalized_weights())
+        out = np.empty(len(ds), np.float32)
+        bs = int(self.opts.mini_batch)
+        for s, b in zip(range(0, len(ds), bs), ds.batches(bs, shuffle=False)):
+            nv = b.n_valid or b.batch_size
+            out[s:s + nv] = np.asarray(self._predict(w, b.idx, b.val))[:nv]
+        return out
+
+    def predict_proba(self, ds: SparseDataset) -> np.ndarray:
+        return _sigmoid(self.decision_function(ds))
+
+
+def _sigmoid(x: np.ndarray) -> np.ndarray:
+    return np.where(x >= 0, 1.0 / (1.0 + np.exp(-x)),
+                    np.exp(x) / (1.0 + np.exp(x)))
+
+
+class GeneralClassifier(_LinearLearner):
+    """SQL: train_classifier — reference hivemall.classifier.GeneralClassifierUDTF."""
+    NAME = "train_classifier"
+    CLASSIFICATION = True
+    DEFAULT_LOSS = "hingeloss"
+
+
+class GeneralRegressor(_LinearLearner):
+    """SQL: train_regressor — reference hivemall.regression.GeneralRegressorUDTF."""
+    NAME = "train_regressor"
+    CLASSIFICATION = False
+    DEFAULT_LOSS = "squaredloss"
+
+
+class LogressTrainer(_LinearLearner):
+    """SQL: logress / train_logregr — reference hivemall.regression.LogressUDTF.
+    Logistic regression by SGD, the historically canonical Hivemall example."""
+    NAME = "train_logregr"
+    CLASSIFICATION = True
+    DEFAULT_LOSS = "logloss"
+    FIXED_LOSS = "logloss"
+    FIXED_OPT = "sgd"
+    ZERO_ONE_LABELS = True
+
+    @classmethod
+    def spec(cls):
+        s = super().spec()
+        for o in s.options:        # logress default regularization is none
+            if o.name == "reg":
+                o.default = "no"
+        return s
+
+
+class AdaGradLogisticTrainer(LogressTrainer):
+    """SQL: train_adagrad_regr — reference hivemall.regression.AdaGradUDTF."""
+    NAME = "train_adagrad_regr"
+    FIXED_OPT = "adagrad"
+
+
+class AdaDeltaLogisticTrainer(LogressTrainer):
+    """SQL: train_adadelta_regr — reference hivemall.regression.AdaDeltaUDTF."""
+    NAME = "train_adadelta_regr"
+    FIXED_OPT = "adadelta"

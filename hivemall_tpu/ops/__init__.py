@@ -1,0 +1,3 @@
+from .losses import LOSSES, get_loss  # noqa: F401
+from .optimizers import OPTIMIZERS, make_optimizer  # noqa: F401
+from .schedules import make_eta  # noqa: F401

@@ -1,0 +1,63 @@
+"""Linear-model minibatch kernels: sparse dot forward + scatter-add update.
+
+This is the TPU replacement for the reference's per-row hot path
+(SURVEY.md §4.1: parse -> sparse dot -> dloss -> per-feature Optimizer.update):
+one jitted call takes a padded (idx, val) minibatch, computes margins with a
+gather, scatter-adds the per-row gradients into a dense [N] gradient, and runs
+the optimizer's elementwise table update. Gradients accumulate by SUM within
+the batch (gradient accumulation of the reference's per-row steps, one
+optimizer-state advance per batch — the semantic delta vs strict per-row
+sequential updates is documented in SURVEY.md §8 "hard parts").
+
+Padding convention: (idx=0, val=0) slots contribute zero to margin and
+gradient. Slot 0 doubles as the ``add_bias`` feature ("0:1.0") — a real bias
+row has val=1 there, so it trains; padding has val=0, so it doesn't.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .losses import Loss
+from .optimizers import Optimizer
+
+__all__ = ["make_linear_step", "linear_margin", "make_linear_predict"]
+
+
+def linear_margin(w: jnp.ndarray, idx: jnp.ndarray, val: jnp.ndarray
+                  ) -> jnp.ndarray:
+    """margin[b] = sum_l w[idx[b,l]] * val[b,l] — batched sparse dot."""
+    return (w[idx].astype(jnp.float32) * val).sum(axis=-1)
+
+
+def make_linear_step(loss: Loss, optimizer: Optimizer) -> Callable:
+    """Build the jitted train step: (w, opt_state, t, batch) -> updated."""
+
+    @jax.jit
+    def step(w, opt_state, t, idx, val, label, row_mask):
+        wf = w.astype(jnp.float32)
+        margin = linear_margin(wf, idx, val)
+        d = loss.dloss(margin, label) * row_mask            # [B]
+        g = jnp.zeros_like(wf).at[idx.ravel()].add(
+            (d[:, None] * val).ravel())                     # dense [N] grad
+        w_new, opt_state = optimizer.update(wf, g, opt_state, t)
+        loss_sum = (loss.loss(margin, label) * row_mask).sum()
+        return w_new.astype(w.dtype), opt_state, loss_sum
+
+    return step
+
+
+def make_linear_predict() -> Callable:
+    """Jitted scoring kernel: gather + segment-sum (+ sigmoid handled by
+    caller). This is the rebuild of the reference's predict-is-a-join query
+    (SURVEY.md §4.2) as an embedding-style lookup."""
+
+    @jax.jit
+    def predict(w, idx, val):
+        return linear_margin(w.astype(jnp.float32), idx, val)
+
+    return predict

@@ -1,0 +1,168 @@
+"""Loss functions — loss() + dloss() pairs, jax-traceable and batched.
+
+Reference: hivemall.optimizer.LossFunctions (SURVEY.md §3.2): HingeLoss,
+LogLoss, SquaredLoss, SquaredHingeLoss, ModifiedHuberLoss, HuberLoss,
+QuantileLoss, EpsilonInsensitiveLoss, SquaredEpsilonInsensitiveLoss.
+
+Conventions (matching the reference):
+- classification losses take (predicted margin p, label y∈{-1,+1}) and work on
+  z = p*y; ``dloss`` is d(loss)/dp.
+- regression losses take (predicted p, target y).
+All functions are elementwise over arrays, so one jitted step evaluates the
+whole minibatch on the VPU; gradients flow through dloss explicitly (no
+autodiff needed on the hot path, though both routes agree — see tests).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Loss", "LOSSES", "get_loss"]
+
+
+@dataclass(frozen=True)
+class Loss:
+    name: str
+    loss: Callable          # (p, y) -> per-example loss
+    dloss: Callable         # (p, y) -> d loss / d p
+    for_classification: bool = True
+    for_regression: bool = True
+
+
+def _hinge_loss(p, y, threshold=1.0):
+    return jnp.maximum(0.0, threshold - p * y)
+
+
+def _hinge_dloss(p, y, threshold=1.0):
+    return jnp.where(p * y < threshold, -y, 0.0)
+
+
+def _logloss(p, y):
+    # log(1 + exp(-z)), numerically stable via softplus
+    return jax.nn.softplus(-p * y)
+
+
+def _logloss_dloss(p, y):
+    # d/dp softplus(-py) = -y * sigmoid(-py)
+    return -y * jax.nn.sigmoid(-p * y)
+
+
+def _squared_loss(p, y):
+    d = p - y
+    return 0.5 * d * d
+
+
+def _squared_dloss(p, y):
+    return p - y
+
+
+def _squared_hinge_loss(p, y):
+    h = jnp.maximum(0.0, 1.0 - p * y)
+    return h * h
+
+
+def _squared_hinge_dloss(p, y):
+    return jnp.where(p * y < 1.0, -2.0 * y * (1.0 - p * y), 0.0)
+
+
+def _modified_huber_loss(p, y):
+    z = p * y
+    h = jnp.maximum(0.0, 1.0 - z)
+    return jnp.where(z >= -1.0, h * h, -4.0 * z)
+
+
+def _modified_huber_dloss(p, y):
+    z = p * y
+    return jnp.where(z >= 1.0, 0.0,
+                     jnp.where(z >= -1.0, -2.0 * y * (1.0 - z), -4.0 * y))
+
+
+def _huber_loss(p, y, delta=1.0):
+    d = jnp.abs(y - p)
+    return jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+
+
+def _huber_dloss(p, y, delta=1.0):
+    d = p - y
+    return jnp.clip(d, -delta, delta)
+
+
+def _quantile_loss(p, y, tau=0.5):
+    e = y - p
+    return jnp.where(e > 0, tau * e, (tau - 1.0) * e)
+
+
+def _quantile_dloss(p, y, tau=0.5):
+    e = y - p
+    return jnp.where(e > 0, -tau, 1.0 - tau)
+
+
+def _eps_insensitive_loss(p, y, eps=0.1):
+    return jnp.maximum(0.0, jnp.abs(y - p) - eps)
+
+
+def _eps_insensitive_dloss(p, y, eps=0.1):
+    e = p - y
+    return jnp.where(e > eps, 1.0, jnp.where(e < -eps, -1.0, 0.0))
+
+
+def _sq_eps_insensitive_loss(p, y, eps=0.1):
+    h = jnp.maximum(0.0, jnp.abs(y - p) - eps)
+    return h * h
+
+
+def _sq_eps_insensitive_dloss(p, y, eps=0.1):
+    e = p - y
+    return jnp.where(e > eps, 2.0 * (e - eps),
+                     jnp.where(e < -eps, 2.0 * (e + eps), 0.0))
+
+
+LOSSES: Dict[str, Loss] = {
+    "hingeloss": Loss("hingeloss", _hinge_loss, _hinge_dloss,
+                      for_regression=False),
+    "logloss": Loss("logloss", _logloss, _logloss_dloss),
+    "squaredloss": Loss("squaredloss", _squared_loss, _squared_dloss),
+    "squaredhingeloss": Loss("squaredhingeloss", _squared_hinge_loss,
+                             _squared_hinge_dloss, for_regression=False),
+    "modifiedhuberloss": Loss("modifiedhuberloss", _modified_huber_loss,
+                              _modified_huber_dloss, for_regression=False),
+    "huberloss": Loss("huberloss", _huber_loss, _huber_dloss,
+                      for_classification=False),
+    "quantileloss": Loss("quantileloss", _quantile_loss, _quantile_dloss,
+                         for_classification=False),
+    "epsilon_insensitive_loss": Loss(
+        "epsilon_insensitive_loss", _eps_insensitive_loss,
+        _eps_insensitive_dloss, for_classification=False),
+    "squared_epsilon_insensitive_loss": Loss(
+        "squared_epsilon_insensitive_loss", _sq_eps_insensitive_loss,
+        _sq_eps_insensitive_dloss, for_classification=False),
+}
+
+# accepted spellings, matching the reference's LossFunctions.getLossFunction
+_ALIASES = {
+    "hinge": "hingeloss",
+    "log": "logloss",
+    "logistic": "logloss",
+    "logisticloss": "logloss",
+    "squared": "squaredloss",
+    "squaredhinge": "squaredhingeloss",
+    "modifiedhuber": "modifiedhuberloss",
+    "huber": "huberloss",
+    "quantile": "quantileloss",
+    "epsiloninsensitiveloss": "epsilon_insensitive_loss",
+    "squaredepsiloninsensitiveloss": "squared_epsilon_insensitive_loss",
+}
+
+
+def get_loss(name: str) -> Loss:
+    key = str(name).lower().replace("-", "").replace("_", "")
+    canon = {k.replace("_", ""): k for k in LOSSES}
+    if key in canon:
+        return LOSSES[canon[key]]
+    if key in _ALIASES:
+        return LOSSES[_ALIASES[key]]
+    raise ValueError(f"unknown loss {name!r}; one of {sorted(LOSSES)}")

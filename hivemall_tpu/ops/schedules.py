@@ -1,0 +1,33 @@
+"""Learning-rate schedules — the EtaEstimator family.
+
+Reference: hivemall.optimizer.EtaEstimator (SURVEY.md §3.2): fixed / simple /
+inverse-power schedules selected by ``-eta`` with ``-eta0``, ``-total_steps``,
+``-power_t``. Each returns a jax-traceable eta(t) with t the global step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+__all__ = ["make_eta"]
+
+
+def make_eta(scheme: str = "inverse", eta0: float = 0.1,
+             total_steps: int = 10_000, power_t: float = 0.1,
+             ) -> Callable:
+    """Build eta(t).
+
+    - ``fixed``:   eta0
+    - ``simple``:  eta0 / (1 + t / total_steps)
+    - ``inverse`` (invscaling): eta0 / (1 + t)^power_t
+    """
+    s = str(scheme).lower()
+    if s == "fixed":
+        return lambda t: jnp.asarray(eta0, jnp.float32)
+    if s == "simple":
+        return lambda t: eta0 / (1.0 + t / float(total_steps))
+    if s in ("inverse", "inv", "invscaling"):
+        return lambda t: eta0 / jnp.power(1.0 + t, power_t)
+    raise ValueError(f"unknown eta scheme {scheme!r}: fixed|simple|inverse")

@@ -1,0 +1,49 @@
+"""Sharded training steps — GSPMD-partitioned minibatch updates.
+
+The data-parallel rebuild of the reference's one-replica-per-map-task scheme
+(SURVEY.md §3.17 row 1): the batch is sharded over the ``dp`` mesh axis, the
+dense weight/optimizer tables over ``tp`` (feature-dim sharding), and XLA's
+partitioner inserts the collectives (the scatter-add of per-shard gradients
+becomes an all-reduce over dp — exactly the psum that replaces MixServer
+averaging, at every-step cadence; configurable cadence lives in parallel.mix).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.losses import get_loss
+
+__all__ = ["make_dp_linear_step"]
+
+
+def make_dp_linear_step(mesh: Mesh, *, loss_name: str = "logloss",
+                        eta0: float = 0.1):
+    """AdaGrad logistic step, jit-partitioned over (dp, tp).
+
+    in shardings: w, gg over P('tp'); idx, val over P('dp', None); label P('dp').
+    """
+    loss = get_loss(loss_name)
+
+    @partial(
+        jax.jit,
+        in_shardings=(NamedSharding(mesh, P("tp")), NamedSharding(mesh, P("tp")),
+                      NamedSharding(mesh, P("dp", None)),
+                      NamedSharding(mesh, P("dp", None)),
+                      NamedSharding(mesh, P("dp"))),
+        out_shardings=(NamedSharding(mesh, P("tp")),
+                       NamedSharding(mesh, P("tp")), None),
+    )
+    def step(w, gg, idx, val, label):
+        margin = (w[idx] * val).sum(-1)
+        d = loss.dloss(margin, label)
+        g = jnp.zeros_like(w).at[idx.ravel()].add((d[:, None] * val).ravel())
+        gg2 = gg + g * g
+        w2 = w - eta0 * g / (jnp.sqrt(gg2) + 1e-6)
+        return w2, gg2, loss.loss(margin, label).mean()
+
+    return step

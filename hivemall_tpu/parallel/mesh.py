@@ -1,0 +1,32 @@
+"""Device-mesh construction — the parallelism substrate.
+
+Reference context (SURVEY.md §3.17): the reference's only compute parallelism
+is data-parallel map tasks plus the async MixServer. The rebuild's axes:
+
+  dp — data parallel (engine-task analog): batch sharded, grads psum-mixed
+  tp — feature/table parallel: the hashed weight table (and FFM (feature,
+       field) latent tables) sharded across devices; the framework's
+       "context-parallel" analog is this feature-dim axis (SURVEY.md §6)
+
+Collectives ride ICI within a slice; DCN handled by jax.distributed + the
+async host mix service (parallel.mix_service).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh"]
+
+
+def make_mesh(dp: int = 1, tp: int = 1, devices=None) -> Mesh:
+    """Build a (dp, tp) mesh over the first dp*tp visible devices."""
+    devices = devices if devices is not None else jax.devices()
+    need = dp * tp
+    if len(devices) < need:
+        raise ValueError(f"mesh dp={dp} tp={tp} needs {need} devices, "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(dp, tp)
+    return Mesh(arr, ("dp", "tp"))

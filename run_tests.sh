@@ -1,0 +1,6 @@
+#!/bin/sh
+# Run the test suite on CPU (8 virtual devices), never touching the TPU
+# tunnel: PALLAS_AXON_POOL_IPS triggers a relay dial at interpreter boot via
+# sitecustomize, and the relay is single-client — tests must stay off it.
+exec env -u PALLAS_AXON_POOL_IPS -u JAX_PLATFORMS \
+    python -m pytest tests/ -q "$@"

@@ -7,7 +7,11 @@ an 8-device virtual CPU mesh, no TPU pod needed. Must run before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the session environment pins JAX_PLATFORMS=axon (the tunneled
+# TPU chip), which (a) makes every jitted test compile over the tunnel and
+# (b) deadlocks if two processes touch it concurrently. Tests always run on
+# the virtual 8-device CPU mesh; only bench.py uses the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,61 @@
+import numpy as np
+
+from hivemall_tpu.frame.evaluation import (auc, average_precision, f1score,
+                                           hitrate, logloss, mae, mrr, mse,
+                                           ndcg, precision_at, r2, recall_at,
+                                           rmse)
+
+
+def test_auc_perfect_and_random():
+    y = np.array([1, 1, 0, 0])
+    assert auc(y, np.array([0.9, 0.8, 0.2, 0.1])) == 1.0
+    assert auc(y, np.array([0.1, 0.2, 0.8, 0.9])) == 0.0
+    assert auc(y, np.array([0.5, 0.5, 0.5, 0.5])) == 0.5  # ties -> midrank
+
+
+def test_auc_pm1_labels():
+    y = np.array([1, -1, 1, -1])
+    s = np.array([0.7, 0.3, 0.6, 0.4])
+    assert auc(y, s) == 1.0
+
+
+def test_logloss_known():
+    y = np.array([1, 0])
+    p = np.array([0.8, 0.2])
+    expect = -(np.log(0.8) + np.log(0.8)) / 2
+    assert abs(logloss(y, p) - expect) < 1e-12
+
+
+def test_f1():
+    a = np.array([1, 1, 0, 0])
+    p = np.array([1, 0, 1, 0])
+    assert abs(f1score(a, p) - 0.5) < 1e-12
+
+
+def test_regression_metrics():
+    a = np.array([1.0, 2.0, 3.0])
+    p = np.array([1.0, 2.0, 4.0])
+    assert abs(mae(a, p) - 1 / 3) < 1e-12
+    assert abs(mse(a, p) - 1 / 3) < 1e-12
+    assert abs(rmse(a, p) - np.sqrt(1 / 3)) < 1e-12
+    assert r2(a, a) == 1.0
+    assert r2(a, p) < 1.0
+
+
+def test_ranking_metrics():
+    rec = ["a", "b", "c", "d"]
+    truth = ["b", "d", "e"]
+    assert abs(precision_at(rec, truth, 2) - 0.5) < 1e-12
+    assert abs(recall_at(rec, truth, 4) - 2 / 3) < 1e-12
+    assert hitrate(rec, truth, 1) == 0.0
+    assert hitrate(rec, truth, 2) == 1.0
+    assert abs(mrr(rec, truth) - 0.5) < 1e-12
+    ap = average_precision(rec, truth)
+    assert abs(ap - (0.5 + 0.5) / 3) < 1e-12
+
+
+def test_ndcg_binary_and_graded():
+    assert ndcg(["a", "b"], ["a", "b"]) == 1.0
+    assert ndcg(["b", "a"], {"a": 3.0, "b": 1.0}, 2) < 1.0
+    assert ndcg(["a", "b"], {"a": 3.0, "b": 1.0}, 2) == 1.0
+    assert ndcg([], ["a"]) == 0.0

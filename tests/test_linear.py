@@ -1,0 +1,107 @@
+"""End-to-end linear trainers: UDTF lifecycle + columnar fit + convergence
+(SURVEY.md §5: golden-convergence smoke — loss decreases, AUC above threshold)."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.catalog import lookup
+from hivemall_tpu.frame.evaluation import auc, logloss
+from hivemall_tpu.io.libsvm import synthetic_classification
+from hivemall_tpu.models.linear import (GeneralClassifier, GeneralRegressor,
+                                        LogressTrainer)
+
+
+def test_udtf_lifecycle_tiny():
+    """Drive the trainer exactly as the reference's unit tests drive UDTFs:
+    initialize -> process(row)* -> close() collecting emitted model rows."""
+    clf = GeneralClassifier("-dims 256 -mini_batch 4 -eta0 0.5")
+    # AND-ish toy: feature 1 -> positive, feature 2 -> negative
+    rows = [(["1:1.0"], 1), (["2:1.0"], -1)] * 20
+    for f, y in rows:
+        clf.process(f, y)
+    model = dict(clf.close())
+    assert model["1"] > 0 > model["2"]
+
+
+def test_classifier_converges_synthetic():
+    ds, _ = synthetic_classification(2000, 100, seed=5)
+    clf = GeneralClassifier(
+        "-dims 256 -loss logloss -opt adagrad -reg no -eta fixed -eta0 0.3 "
+        "-mini_batch 64 -iters 3")
+    clf.fit(ds)
+    p = clf.predict_proba(ds)
+    a = auc(ds.labels, p)
+    ll = logloss(ds.labels, p)
+    assert a > 0.9, a
+    assert ll < 0.45, ll
+
+
+def test_hinge_rda_default_converges():
+    ds, _ = synthetic_classification(1500, 80, seed=7)
+    clf = GeneralClassifier("-dims 256 -eta0 0.3 -mini_batch 64 -iters 2")
+    clf.fit(ds)
+    assert auc(ds.labels, clf.decision_function(ds)) > 0.85
+
+
+def test_string_features_roundtrip():
+    clf = GeneralClassifier("-dims 4096 -mini_batch 2 -eta0 0.5")
+    for _ in range(10):
+        clf.process(["cat#tokyo", "height:1.2"], 1)
+        clf.process(["cat#osaka"], -1)
+    model = dict(clf.close())
+    assert "cat#tokyo" in model and "cat#osaka" in model
+    assert model["cat#tokyo"] > 0 > model["cat#osaka"]
+
+
+def test_regressor_fits_line():
+    rng = np.random.default_rng(0)
+    n = 500
+    x = rng.uniform(-1, 1, n).astype(np.float32)
+    rows = [(np.array([1], np.int32), np.array([xx], np.float32)) for xx in x]
+    from hivemall_tpu.io.sparse import SparseDataset
+    ds = SparseDataset.from_rows(rows, 3.0 * x)
+    reg = GeneralRegressor("-dims 16 -opt adagrad -reg no -eta fixed "
+                           "-eta0 0.5 -mini_batch 32 -iters 10")
+    reg.fit(ds)
+    w = reg._finalized_weights()
+    assert abs(w[1] - 3.0) < 0.2, w[1]
+
+
+def test_logress_zero_one_labels():
+    ds, _ = synthetic_classification(1000, 60, seed=9)
+    labels01 = (ds.labels > 0).astype(np.float32)
+    from hivemall_tpu.io.sparse import SparseDataset
+    ds01 = SparseDataset(ds.indices, ds.indptr, ds.values, labels01)
+    t = LogressTrainer("-dims 256 -eta fixed -eta0 0.5 -mini_batch 64 -iters 3")
+    t.fit(ds01)
+    assert auc(labels01, t.predict_proba(ds01)) > 0.85
+
+
+def test_warm_start_loadmodel(tmp_path):
+    ds, _ = synthetic_classification(800, 50, seed=11)
+    a_ = GeneralClassifier("-dims 128 -eta0 0.3 -mini_batch 64")
+    a_.fit(ds)
+    p = str(tmp_path / "model.tsv")
+    a_.save_model(p)
+    b_ = GeneralClassifier(f"-dims 128 -loadmodel {p}")
+    # warm-started model scores like the original without any training
+    np.testing.assert_allclose(b_.decision_function(ds),
+                               a_.decision_function(ds), rtol=1e-4, atol=1e-4)
+
+
+def test_catalog_resolves_trainers():
+    e = lookup("train_classifier")
+    cls = e.resolve()
+    assert cls is GeneralClassifier
+    assert e.options is not None
+    ns = e.options.parse("-loss logloss -opt adagrad")
+    assert ns.loss == "logloss"
+
+
+def test_halffloat_bf16():
+    ds, _ = synthetic_classification(500, 40, seed=13)
+    clf = GeneralClassifier("-dims 128 -halffloat -eta0 0.3 -mini_batch 64")
+    clf.fit(ds)
+    import jax.numpy as jnp
+    assert clf.w.dtype == jnp.bfloat16
+    assert auc(ds.labels, clf.decision_function(ds)) > 0.8

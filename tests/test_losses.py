@@ -1,0 +1,44 @@
+"""Loss loss()/dloss() consistency: explicit dloss must match autodiff."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hivemall_tpu.ops.losses import LOSSES, get_loss
+
+
+@pytest.mark.parametrize("name", sorted(LOSSES))
+def test_dloss_matches_autodiff(name):
+    loss = LOSSES[name]
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.normal(0, 2, 64), jnp.float32)
+    y = (jnp.asarray(rng.integers(0, 2, 64)) * 2 - 1).astype(jnp.float32) \
+        if loss.for_classification else \
+        jnp.asarray(rng.normal(0, 2, 64), jnp.float32)
+    auto = jax.grad(lambda pp: loss.loss(pp, y).sum())(p)
+    manual = loss.dloss(p, y)
+    # subgradient points (hinge kinks etc.) can disagree; mask exact kinks
+    ok = jnp.abs(auto - manual) < 1e-4
+    frac = float(ok.mean())
+    assert frac > 0.95, f"{name}: only {frac:.2f} agree"
+
+
+def test_logloss_stable_extreme():
+    loss = get_loss("logloss")
+    v = loss.loss(jnp.asarray([100.0, -100.0]), jnp.asarray([1.0, 1.0]))
+    assert np.isfinite(np.asarray(v)).all()
+    assert float(v[0]) < 1e-6 and float(v[1]) > 50
+
+
+def test_aliases():
+    assert get_loss("logistic").name == "logloss"
+    assert get_loss("hinge").name == "hingeloss"
+    assert get_loss("SquaredLoss").name == "squaredloss"
+    with pytest.raises(ValueError):
+        get_loss("nope")
+
+
+def test_classification_guard():
+    assert not get_loss("huberloss").for_classification
+    assert not get_loss("hingeloss").for_regression

@@ -1,0 +1,82 @@
+"""Optimizer updates: quadratic-bowl convergence + state semantics."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hivemall_tpu.ops.optimizers import OPTIMIZERS, make_optimizer
+
+
+def quad_converges(opt, steps=300, dim=8):
+    """min ||w - w*||^2 by gradient steps; returns final distance."""
+    rng = np.random.default_rng(1)
+    target = jnp.asarray(rng.normal(0, 1, dim), jnp.float32)
+    w = jnp.zeros(dim)
+    state = opt.init(dim)
+    for t in range(steps):
+        g = w - target
+        w, state = opt.update(w, g, state, float(t))
+    w = opt.finalize(w, state)
+    return float(jnp.abs(w - target).max()), target
+
+
+@pytest.mark.parametrize("name", ["sgd", "momentum", "nesterov", "adagrad",
+                                  "adam", "ftrl"])
+def test_converges_to_target(name):
+    opt = make_optimizer(name, eta_scheme="fixed", eta0=0.1, reg="no",
+                         ftrl_l1=0.0, ftrl_l2=0.0)
+    dist, _ = quad_converges(opt)
+    assert dist < 0.05, f"{name}: {dist}"
+
+
+def test_adadelta_makes_progress():
+    opt = make_optimizer("adadelta", reg="no")
+    dist, target = quad_converges(opt, steps=500)
+    assert dist < float(jnp.abs(target).max())
+
+
+def test_rda_sparsifies():
+    """l1-RDA must zero out coordinates whose average gradient < lambda."""
+    opt = make_optimizer("adagrad", reg="rda", lam=0.5, eta_scheme="fixed",
+                         eta0=0.1)
+    w = jnp.zeros(4)
+    state = opt.init(4)
+    # coordinate 0 has strong signal, coordinate 3 has tiny signal
+    for t in range(200):
+        g = jnp.asarray([-2.0, -1.0, 0.0, -0.01])
+        w, state = opt.update(w, g, state, float(t))
+    w = np.asarray(opt.finalize(w, state))
+    assert w[0] > 0 and w[3] == 0.0
+    assert opt.name == "adagrad_rda"  # '-opt adagrad -reg rda' upgrade
+
+
+def test_ftrl_l1_sparsifies():
+    opt = make_optimizer("ftrl", ftrl_l1=0.5, ftrl_alpha=0.5)
+    w = jnp.zeros(2)
+    state = opt.init(2)
+    for t in range(100):
+        g = w - jnp.asarray([3.0, 0.001])   # strong vs negligible pull
+        w, state = opt.update(w, g, state, float(t))
+    w = np.asarray(opt.finalize(w, state))
+    assert abs(w[0]) > 1.0 and w[1] == 0.0
+
+
+def test_l2_shrinks_weights():
+    opt_noreg = make_optimizer("sgd", reg="no", eta_scheme="fixed", eta0=0.1)
+    opt_l2 = make_optimizer("sgd", reg="l2", lam=0.5, eta_scheme="fixed",
+                            eta0=0.1)
+    for opt in (opt_noreg, opt_l2):
+        w = jnp.zeros(1)
+        s = opt.init(1)
+        for t in range(200):
+            w, s = opt.update(w, w - 2.0, s, float(t))
+        if opt is opt_noreg:
+            free = float(w[0])
+        else:
+            reg = float(w[0])
+    assert reg < free
+
+
+def test_unknown_raises():
+    with pytest.raises(ValueError):
+        make_optimizer("zzz")
