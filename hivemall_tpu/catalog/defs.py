@@ -74,6 +74,18 @@ for _name, _fn, _ref, _desc in [
     register(_name, "UDAF", f"hivemall_tpu.frame.evaluation:{_fn}",
              description=_desc, reference=_ref)
 
+# --- ensemble / model averaging (SURVEY.md §3.17) --------------------------
+register("voted_avg", "UDAF", "hivemall_tpu.parallel.averaging:voted_avg",
+         description="majority-sign-side mean of replica weights",
+         reference="hivemall.ensemble.bagging.VotedAvgUDAF")
+register("weight_voted_avg", "UDAF",
+         "hivemall_tpu.parallel.averaging:weight_voted_avg",
+         description="weight-mass-vote mean of replica weights",
+         reference="hivemall.ensemble.bagging.WeightVotedAvgUDAF")
+register("argmin_kld", "UDAF", "hivemall_tpu.parallel.averaging:argmin_kld",
+         description="precision-weighted merge of (weight, covar) rows",
+         reference="hivemall.ensemble.ArgminKLDistanceUDAF")
+
 # --- ftvec.amplify ----------------------------------------------------------
 register("amplify", "UDTF", "hivemall_tpu.io.amplify:amplify",
          description="emit each row xtimes (multi-epoch under one-pass SQL)",

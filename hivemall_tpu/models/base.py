@@ -87,6 +87,12 @@ class LearnerBase:
         self._loss_sum = 0.0
         self._examples = 0
         self._mixer = None
+        if self.opts.get("mix"):
+            from ..parallel.mix_service import MixClient
+            self._mixer = MixClient(
+                self.opts.mix,
+                group=self.opts.mix_session or self.NAME,
+                threshold=int(self.opts.mix_threshold))
         self._init_state()
         if self.opts.loadmodel:
             self._warm_start(self.opts.loadmodel)
@@ -124,6 +130,8 @@ class LearnerBase:
                 for b in ds.batches(int(self.opts.mini_batch), shuffle=True,
                                     seed=42 + ep):
                     self._dispatch(b)
+        if self._mixer is not None:
+            self._mixer.close_group()
         yield from self.model_rows()
 
     # -- columnar fast path --------------------------------------------------
@@ -208,6 +216,7 @@ class LearnerBase:
         self._loss_sum += float(loss_sum)
         self._examples += nv
         if self._mixer is not None:
+            self._mixer.touch(batch.idx[:nv])
             self._mixer.maybe_mix(self)
 
     @property

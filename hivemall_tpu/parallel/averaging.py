@@ -1,0 +1,70 @@
+"""Post-hoc model combination UDAFs — the GROUP BY feature ensemble path.
+
+Reference (SURVEY.md §3.17 row 3): per-replica model tables are merged by
+``GROUP BY feature`` + avg(weight) / voted_avg(weight) / weight_voted_avg /
+argmin_kld over the emitted rows (hivemall.ensemble.*UDAF). Inputs here are
+the per-group weight (and covar) arrays for one feature across replicas.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["voted_avg", "weight_voted_avg", "argmin_kld", "merge_model_tables"]
+
+
+def voted_avg(weights: Sequence[float]) -> float:
+    """Mean of the weights on the majority-sign side (reference:
+    hivemall.ensemble.bagging.VotedAvgUDAF)."""
+    w = np.asarray(list(weights), np.float64)
+    if w.size == 0:
+        return 0.0
+    pos = w > 0
+    neg = w < 0
+    if pos.sum() >= neg.sum():
+        sel = w[pos]
+        return float(sel.mean()) if sel.size else 0.0
+    return float(w[neg].mean())
+
+
+def weight_voted_avg(weights: Sequence[float]) -> float:
+    """Weight-magnitude-weighted vote (reference:
+    hivemall.ensemble.bagging.WeightVotedAvgUDAF): the side whose absolute
+    weight mass dominates wins; returns that side's mean."""
+    w = np.asarray(list(weights), np.float64)
+    if w.size == 0:
+        return 0.0
+    pos_mass = w[w > 0].sum()
+    neg_mass = -w[w < 0].sum()
+    sel = w[w > 0] if pos_mass >= neg_mass else w[w < 0]
+    return float(sel.mean()) if sel.size else 0.0
+
+
+def argmin_kld(weights: Sequence[float], covars: Sequence[float]
+               ) -> Tuple[float, float]:
+    """Precision-weighted merge of (weight, covar) rows (reference:
+    hivemall.ensemble.ArgminKLDistanceUDAF); see parallel.mix.argmin_kld_mix
+    for the on-mesh collective form."""
+    w = np.asarray(list(weights), np.float64)
+    c = np.asarray(list(covars), np.float64)
+    prec = 1.0 / c
+    s = prec.sum()
+    return float((w * prec).sum() / s), float(1.0 / s)
+
+
+def merge_model_tables(tables: Iterable[Dict[str, float]],
+                       how: str = "avg") -> Dict[str, float]:
+    """Merge per-replica model tables (the SQL GROUP BY feature rollup)."""
+    acc: Dict[str, List[float]] = {}
+    for t in tables:
+        for k, v in t.items():
+            acc.setdefault(k, []).append(v)
+    if how == "avg":
+        return {k: float(np.mean(v)) for k, v in acc.items()}
+    if how == "voted_avg":
+        return {k: voted_avg(v) for k, v in acc.items()}
+    if how == "weight_voted_avg":
+        return {k: weight_voted_avg(v) for k, v in acc.items()}
+    raise ValueError(f"unknown merge {how!r}")

@@ -1,0 +1,86 @@
+"""MIX on-mesh: parameter mixing as XLA collectives over ICI.
+
+Reference: the MixServer subsystem (SURVEY.md §3.16) — asynchronous
+parameter averaging over a custom Netty TCP protocol, with two combine ops:
+  - average:    plain update-count-weighted mean of weights
+  - argmin-KLD: precision-weighted mean for covariance-carrying models
+    (CW/AROW/SCW) — the KL-minimizing merge of Gaussian weight posteriors.
+
+TPU-native mapping [B]: within a slice, replicas live one-per-device on the
+``dp`` mesh axis and mix by ``lax.pmean``/``psum`` at ``-mix_threshold``-step
+cadence inside the jitted train loop (sync collectives over ICI at the same
+cadence the reference would hit the mix server). Cross-slice/host async mixing
+is parallel.mix_service (DCN path).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.losses import Loss
+from ..ops.optimizers import Optimizer
+
+__all__ = ["mix_average", "argmin_kld_mix", "make_replica_train_step"]
+
+
+def mix_average(w: jnp.ndarray, axis: str = "dp") -> jnp.ndarray:
+    """The MixServer 'average' event: plain mean across replicas."""
+    return lax.pmean(w, axis)
+
+
+def argmin_kld_mix(w: jnp.ndarray, covar: jnp.ndarray, axis: str = "dp",
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The 'argminKLD' event (reference: PartialArgminKLD): precision-weighted
+    mean — the argmin-KL merge of per-replica Gaussian posteriors
+    N(w_i, covar_i). Returns (w_mixed, covar_mixed) where covar_mixed is the
+    product-of-Gaussians posterior variance 1/sum(1/covar_i)."""
+    prec = 1.0 / covar
+    prec_sum = lax.psum(prec, axis)
+    w_mixed = lax.psum(w * prec, axis) / prec_sum
+    return w_mixed, 1.0 / prec_sum
+
+
+def make_replica_train_step(mesh: Mesh, loss: Loss, optimizer: Optimizer,
+                            mix_every: int = 16) -> Callable:
+    """Per-device independent replicas + cadence mixing — the closest TPU
+    analog of the reference's map-task replicas attached to a MixServer.
+
+    w: [dp, N] (one replica per device, spec P('dp', None)); the batch is
+    sharded over dp. Every ``mix_every`` steps the replicas pmean their
+    weights (reference: clock-threshold mix exchange, SURVEY.md §4.3);
+    optimizer state stays local, as MixServer never mixed it either.
+    """
+
+    def local_step(w, opt_state, t, idx, val, label):
+        w = w[0]                                    # [N] local replica
+        st = jax.tree_util.tree_map(lambda a: a[0], opt_state)
+        margin = (w[idx] * val).sum(-1)
+        d = loss.dloss(margin, label)
+        g = jnp.zeros_like(w).at[idx.ravel()].add((d[:, None] * val).ravel())
+        w2, st = optimizer.update(w, g, st, t)
+        do_mix = (t + 1.0) % mix_every == 0.0
+        w2 = lax.cond(do_mix, lambda x: lax.pmean(x, "dp"), lambda x: x, w2)
+        loss_sum = lax.psum(loss.loss(margin, label).sum(), "dp")
+        return (w2[None],
+                jax.tree_util.tree_map(lambda a: a[None], st), loss_sum)
+
+    # opt_state entries are [dp, N]-replicated per device as well
+    pspec_state = jax.tree_util.tree_map(lambda _: P("dp", None),
+                                         optimizer.init(1))
+
+    # check_vma off: the mix branch of lax.cond returns a pmean-replicated
+    # value while the skip branch stays device-varying; that asymmetry is
+    # exactly the cadence semantics we want.
+    return jax.jit(shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("dp", None), pspec_state, P(), P("dp", None),
+                  P("dp", None), P("dp")),
+        out_specs=(P("dp", None), pspec_state, P()),
+        check_vma=False))

@@ -1,0 +1,274 @@
+"""Async host mix service — the DCN-path MixServer rebuild.
+
+Reference: hivemall.mix.server.MixServer + client (SURVEY.md §3.16, §4.3):
+a standalone TCP server holding per-(group, feature) partial aggregates;
+clients send accumulated weight deltas when a per-weight clock passes
+``-mix_threshold`` and fold the returned global average back into the local
+model. Consistency: asynchronous, best-effort, fail-soft — a dead server
+degrades training to replica-local SGD, never stops it.
+
+This module reproduces that role for cross-slice (DCN) topologies where sync
+ICI collectives (parallel.mix) don't reach:
+
+- ``MixServer``: asyncio TCP server, same partial-aggregate semantics
+  (average + argmin-KLD), session GC by group.
+- ``MixClient``: attaches to a trainer (the ModelUpdateHandler analog);
+  every ``threshold`` dispatched batches it ships the touched features'
+  (weight, covar, delta-updates) and folds the mixed values back. Transport
+  errors permanently disable it (fail-soft), matching the reference.
+
+Wire format (MixMessage analog), length-prefixed little-endian frames:
+  u8 event (1=average, 2=argmin_kld, 3=closegroup), u16 group-utf8-len,
+  group bytes, u32 n, then n * (i64 key, f32 weight, f32 covar,
+  i32 delta_updates). Replies use the same frame shape.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["MixServer", "MixClient", "MixMessage", "EVENT_AVERAGE",
+           "EVENT_ARGMIN_KLD", "EVENT_CLOSEGROUP"]
+
+EVENT_AVERAGE = 1
+EVENT_ARGMIN_KLD = 2
+EVENT_CLOSEGROUP = 3
+
+_REC = struct.Struct("<qffi")
+_HDR = struct.Struct("<BH")
+_LEN = struct.Struct("<I")
+
+
+@dataclass
+class MixMessage:
+    event: int
+    group: str
+    keys: np.ndarray          # int64 [n]
+    weights: np.ndarray       # float32 [n]
+    covars: np.ndarray        # float32 [n]
+    deltas: np.ndarray        # int32 [n]
+
+    def encode(self) -> bytes:
+        g = self.group.encode("utf-8")
+        n = len(self.keys)
+        body = bytearray(_HDR.pack(self.event, len(g)))
+        body += g
+        body += struct.pack("<I", n)
+        for i in range(n):
+            body += _REC.pack(int(self.keys[i]), float(self.weights[i]),
+                              float(self.covars[i]), int(self.deltas[i]))
+        return _LEN.pack(len(body)) + bytes(body)
+
+    @classmethod
+    def decode(cls, body: bytes) -> "MixMessage":
+        event, glen = _HDR.unpack_from(body, 0)
+        off = _HDR.size
+        group = body[off:off + glen].decode("utf-8")
+        off += glen
+        (n,) = struct.unpack_from("<I", body, off)
+        off += 4
+        keys = np.empty(n, np.int64)
+        weights = np.empty(n, np.float32)
+        covars = np.empty(n, np.float32)
+        deltas = np.empty(n, np.int32)
+        for i in range(n):
+            k, w, c, d = _REC.unpack_from(body, off)
+            off += _REC.size
+            keys[i], weights[i], covars[i], deltas[i] = k, w, c, d
+        return cls(event, group, keys, weights, covars, deltas)
+
+
+@dataclass
+class _Partial:
+    """Per-(group, feature) running aggregate (reference: PartialResult /
+    PartialAverage / PartialArgminKLD)."""
+    sum_w_du: float = 0.0       # sum of weight * delta_updates
+    total_du: int = 0
+    sum_prec: float = 0.0       # argmin-KLD: sum of 1/covar
+    sum_w_prec: float = 0.0     # argmin-KLD: sum of w/covar
+
+    def fold_avg(self, w: float, du: int) -> None:
+        self.sum_w_du += w * max(1, du)
+        self.total_du += max(1, du)
+
+    def fold_kld(self, w: float, covar: float) -> None:
+        prec = 1.0 / max(1e-12, covar)
+        self.sum_prec += prec
+        self.sum_w_prec += w * prec
+
+    def mixed_avg(self) -> float:
+        return self.sum_w_du / max(1, self.total_du)
+
+    def mixed_kld(self) -> Tuple[float, float]:
+        return self.sum_w_prec / self.sum_prec, 1.0 / self.sum_prec
+
+
+class MixServer:
+    """In-process asyncio mix server. start()/stop() manage a daemon thread
+    running the event loop, so tests exercise the real TCP path on localhost
+    exactly like the reference's in-JVM MixServer tests (SURVEY.md §5.3)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port          # 0 = ephemeral; real port set on start
+        self._sessions: Dict[str, Dict[int, _Partial]] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._started = threading.Event()
+
+    # -- protocol ------------------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(_LEN.size)
+                (ln,) = _LEN.unpack(hdr)
+                msg = MixMessage.decode(await reader.readexactly(ln))
+                if msg.event == EVENT_CLOSEGROUP:
+                    self._sessions.pop(msg.group, None)
+                    continue
+                sess = self._sessions.setdefault(msg.group, {})
+                out_w = np.empty_like(msg.weights)
+                out_c = np.empty_like(msg.covars)
+                for i, k in enumerate(msg.keys):
+                    p = sess.setdefault(int(k), _Partial())
+                    if msg.event == EVENT_ARGMIN_KLD:
+                        p.fold_kld(float(msg.weights[i]), float(msg.covars[i]))
+                        out_w[i], out_c[i] = p.mixed_kld()
+                    else:
+                        p.fold_avg(float(msg.weights[i]), int(msg.deltas[i]))
+                        out_w[i] = p.mixed_avg()
+                        out_c[i] = 0.0
+                reply = MixMessage(msg.event, msg.group, msg.keys, out_w,
+                                   out_c, msg.deltas)
+                writer.write(reply.encode())
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "MixServer":
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def boot():
+                self._server = await asyncio.start_server(
+                    self._handle, self.host, self.port)
+                self.port = self._server.sockets[0].getsockname()[1]
+                self._started.set()
+
+            self._loop.run_until_complete(boot())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(5):
+            raise RuntimeError("mix server failed to start")
+        return self
+
+    def stop(self) -> None:
+        if self._loop:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class MixClient:
+    """Trainer-attached mix client (the ModelUpdateHandler analog).
+
+    Cadence: per-feature clocks would need an [N] counter array on device;
+    instead the client counts dispatched batches and, every ``threshold``
+    batches, ships all features touched since the last exchange with
+    delta_updates = batches elapsed (documented approximation of the
+    reference's per-weight clocks; convergence semantics match at minibatch
+    granularity). Any transport failure disables the client permanently —
+    training continues unmixed (fail-soft parity).
+    """
+
+    def __init__(self, hosts: str, group: str, threshold: int = 16,
+                 event: int = EVENT_AVERAGE, timeout: float = 2.0):
+        host, _, port = hosts.partition(":")
+        self.addr = (host or "127.0.0.1", int(port or 11212))
+        self.group = group
+        self.threshold = max(1, threshold)
+        self.event = event
+        self.timeout = timeout
+        self.alive = True
+        self.exchanges = 0
+        self._sock: Optional[socket.socket] = None
+        self._batches = 0
+        self._touched: set[int] = set()
+
+    def _connect(self) -> None:
+        if self._sock is None:
+            s = socket.create_connection(self.addr, timeout=self.timeout)
+            s.settimeout(self.timeout)
+            self._sock = s
+
+    def touch(self, keys: np.ndarray) -> None:
+        self._touched.update(int(k) for k in np.unique(keys) if k != 0)
+
+    def maybe_mix(self, trainer) -> None:
+        """Called by LearnerBase after each dispatched batch."""
+        if not self.alive:
+            return
+        self._batches += 1
+        if self._batches % self.threshold != 0 or not self._touched:
+            return
+        try:
+            keys = np.fromiter(self._touched, np.int64)
+            self._touched.clear()
+            w = np.array(trainer._finalized_weights())  # writable copy
+            covar = getattr(trainer, "covar_table", lambda: None)()
+            msg = MixMessage(
+                self.event, self.group, keys,
+                w[keys].astype(np.float32),
+                (np.asarray(covar)[keys].astype(np.float32)
+                 if covar is not None else np.ones(len(keys), np.float32)),
+                np.full(len(keys), self.threshold, np.int32))
+            self._connect()
+            self._sock.sendall(msg.encode())
+            reply = self._read_reply()
+            w[reply.keys] = reply.weights
+            trainer._load_weights(w)
+            self.exchanges += 1
+        except OSError:
+            self.alive = False     # fail-soft: keep training unmixed
+            self._sock = None
+
+    def _read_reply(self) -> MixMessage:
+        hdr = self._recvn(_LEN.size)
+        (ln,) = _LEN.unpack(hdr)
+        return MixMessage.decode(self._recvn(ln))
+
+    def _recvn(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise OSError("mix server closed connection")
+            buf += chunk
+        return buf
+
+    def close_group(self) -> None:
+        if self.alive and self._sock is not None:
+            try:
+                self._sock.sendall(MixMessage(
+                    EVENT_CLOSEGROUP, self.group, np.zeros(0, np.int64),
+                    np.zeros(0, np.float32), np.zeros(0, np.float32),
+                    np.zeros(0, np.int32)).encode())
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
