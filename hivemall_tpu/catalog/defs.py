@@ -74,6 +74,93 @@ for _name, _fn, _ref, _desc in [
     register(_name, "UDAF", f"hivemall_tpu.frame.evaluation:{_fn}",
              description=_desc, reference=_ref)
 
+# --- factorization machines (SURVEY.md §3.6) -------------------------------
+_learner("train_fm", "hivemall_tpu.models.fm:FMTrainer",
+         "hivemall.fm.FactorizationMachineUDTF",
+         "2-way factorization machine (SGD/AdaGrad/FTRL)")
+_learner("train_ffm", "hivemall_tpu.models.fm:FFMTrainer",
+         "hivemall.fm.FieldAwareFactorizationMachineUDTF",
+         "field-aware FM over field:index:value features")
+register("fm_predict", "UDAF", "hivemall_tpu.models.fm:fm_predict",
+         description="FM score from model tables",
+         reference="hivemall.fm.FMPredictGenericUDAF")
+register("ffm_predict", "UDF", "hivemall_tpu.models.fm:ffm_predict",
+         description="FFM score (pairwise field-crossed dots)",
+         reference="hivemall.fm.FFMPredictUDF")
+
+# --- ftvec (SURVEY.md §3.12) ------------------------------------------------
+for _name, _target, _ref, _desc, _kind in [
+    ("add_bias", "core:add_bias", "hivemall.ftvec.AddBiasUDF",
+     'append the constant bias feature "0:1.0"', "UDF"),
+    ("extract_feature", "core:extract_feature",
+     "hivemall.ftvec.ExtractFeatureUDF", "feature-string name part", "UDF"),
+    ("extract_weight", "core:extract_weight",
+     "hivemall.ftvec.ExtractWeightUDF", "feature-string value part", "UDF"),
+    ("feature", "core:feature", "hivemall.ftvec.FeatureUDF",
+     "build name:value", "UDF"),
+    ("add_feature_index", "core:add_feature_index",
+     "hivemall.ftvec.AddFeatureIndexUDF", "1-based index features", "UDF"),
+    ("sort_by_feature", "core:sort_by_feature",
+     "hivemall.ftvec.SortByFeatureUDF", "sort feature map by key", "UDF"),
+    ("feature_hashing", "hashing:feature_hashing",
+     "hivemall.ftvec.hashing.FeatureHashingUDF",
+     "murmur3-hash feature names into [1, 2^24]", "UDF"),
+    ("array_hash_values", "hashing:array_hash_values",
+     "hivemall.ftvec.hashing.ArrayHashValuesUDF", "hash each array item",
+     "UDF"),
+    ("prefixed_hash_values", "hashing:prefixed_hash_values",
+     "hivemall.ftvec.hashing.ArrayPrefixedHashValuesUDF",
+     "hash prefix#value items", "UDF"),
+    ("sha1", "hashing:sha1", "hivemall.ftvec.hashing.Sha1UDF",
+     "sha1 feature hash", "UDF"),
+    ("rescale", "scaling:rescale", "hivemall.ftvec.scaling.RescaleUDF",
+     "min-max rescale", "UDF"),
+    ("zscore", "scaling:zscore", "hivemall.ftvec.scaling.ZScoreUDF",
+     "z-score", "UDF"),
+    ("l1_normalize", "scaling:l1_normalize",
+     "hivemall.ftvec.scaling.L1NormalizationUDF", "unit L1 row norm", "UDF"),
+    ("l2_normalize", "scaling:l2_normalize",
+     "hivemall.ftvec.scaling.L2NormalizationUDF", "unit L2 row norm", "UDF"),
+    ("to_dense_features", "conv:to_dense_features",
+     "hivemall.ftvec.conv.ToDenseFeaturesUDF", "sparse->dense", "UDF"),
+    ("to_sparse_features", "conv:to_sparse_features",
+     "hivemall.ftvec.conv.ToSparseFeaturesUDF", "dense->sparse", "UDF"),
+    ("quantify", "conv:quantify", "hivemall.ftvec.conv.QuantifyColumnsUDTF",
+     "string columns -> dense int codes", "UDTF"),
+    ("polynomial_features", "pairing:polynomial_features",
+     "hivemall.ftvec.pairing.PolynomialFeaturesUDF", "feature crosses", "UDF"),
+    ("powered_features", "pairing:powered_features",
+     "hivemall.ftvec.pairing.PoweredFeaturesUDF", "power terms", "UDF"),
+    ("binarize_label", "trans:binarize_label",
+     "hivemall.ftvec.trans.BinarizeLabelUDTF",
+     "expand (pos,neg) counts to rows", "UDTF"),
+    ("categorical_features", "trans:categorical_features",
+     "hivemall.ftvec.trans.CategoricalFeaturesUDF", "col#value builders",
+     "UDF"),
+    ("quantitative_features", "trans:quantitative_features",
+     "hivemall.ftvec.trans.QuantitativeFeaturesUDF", "col:value builders",
+     "UDF"),
+    ("vectorize_features", "trans:vectorize_features",
+     "hivemall.ftvec.trans.VectorizeFeaturesUDF", "combined builders", "UDF"),
+    ("indexed_features", "trans:indexed_features",
+     "hivemall.ftvec.trans.IndexedFeatures", "1:v1 2:v2 ...", "UDF"),
+    ("onehot_encoding", "trans:onehot_encoding",
+     "hivemall.ftvec.trans.OnehotEncodingUDAF", "global one-hot map", "UDAF"),
+    ("ffm_features", "trans:ffm_features",
+     "hivemall.ftvec.trans.FFMFeaturesUDF",
+     "field:index:value triples for train_ffm", "UDF"),
+    ("chi2", "selection:chi2", "hivemall.ftvec.selection.ChiSquareUDF",
+     "chi-square feature selection", "UDF"),
+    ("snr", "selection:snr", "hivemall.ftvec.selection.SignalNoiseRatioUDAF",
+     "signal-to-noise ratio", "UDAF"),
+    ("build_bins", "binning:build_bins",
+     "hivemall.ftvec.binning.BuildBinsUDAF", "quantile bin edges", "UDAF"),
+    ("feature_binning", "binning:feature_binning",
+     "hivemall.ftvec.binning.FeatureBinningUDF", "value -> bin index", "UDF"),
+]:
+    register(_name, _kind, f"hivemall_tpu.ftvec.{_target}",
+             description=_desc, reference=_ref)
+
 # --- ensemble / model averaging (SURVEY.md §3.17) --------------------------
 register("voted_avg", "UDAF", "hivemall_tpu.parallel.averaging:voted_avg",
          description="majority-sign-side mean of replica weights",

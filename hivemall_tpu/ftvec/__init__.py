@@ -1,0 +1,12 @@
+from .core import (add_bias, add_feature_index, extract_feature,  # noqa: F401
+                   extract_weight, feature, sort_by_feature)
+from .hashing import (array_hash_values, feature_hashing,  # noqa: F401
+                      prefixed_hash_values, sha1)
+from .scaling import l1_normalize, l2_normalize, rescale, zscore  # noqa: F401
+from .conv import quantify, to_dense_features, to_sparse_features  # noqa: F401
+from .pairing import polynomial_features, powered_features  # noqa: F401
+from .trans import (binarize_label, categorical_features,  # noqa: F401
+                    ffm_features, indexed_features, onehot_encoding,
+                    quantitative_features, vectorize_features)
+from .selection import chi2, snr  # noqa: F401
+from .binning import build_bins, feature_binning  # noqa: F401

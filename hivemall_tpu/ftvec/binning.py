@@ -1,0 +1,35 @@
+"""ftvec.binning — quantile binning (SURVEY.md §3.12 binning row, v0.5-era).
+
+Reference: hivemall.ftvec.binning.{BuildBinsUDAF,FeatureBinningUDF}.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["build_bins", "feature_binning"]
+
+
+def build_bins(values: Sequence[float], num_bins: int,
+               auto_shrink: bool = False) -> List[float]:
+    """SQL: build_bins(value, num_bins[, auto_shrink]) UDAF -> quantile bin
+    edges [-inf, q1, ..., q_{n-1}, +inf]."""
+    v = np.asarray([x for x in values if x is not None], np.float64)
+    if num_bins < 2:
+        raise ValueError("num_bins must be >= 2")
+    qs = np.quantile(v, np.linspace(0, 1, num_bins + 1)[1:-1]) if v.size \
+        else np.zeros(num_bins - 1)
+    edges = [-np.inf] + list(qs) + [np.inf]
+    if auto_shrink:
+        uniq = sorted(set(edges))
+        edges = uniq if len(uniq) >= 2 else [-np.inf, np.inf]
+    return edges
+
+
+def feature_binning(value: float, bins: Sequence[float]) -> int:
+    """SQL: feature_binning(value, bins) -> bin index in [0, len(bins)-2]."""
+    b = np.asarray(bins, np.float64)
+    return int(np.clip(np.searchsorted(b, value, side="right") - 1,
+                       0, len(b) - 2))

@@ -1,0 +1,57 @@
+"""ftvec.conv — sparse<->dense conversion (SURVEY.md §3.12 conv row).
+
+Reference: hivemall.ftvec.conv.{ToDenseFeaturesUDF,ToSparseFeaturesUDF,
+QuantifyColumnsUDTF}.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from .core import _split
+
+__all__ = ["to_dense_features", "to_sparse_features", "quantify"]
+
+
+def to_dense_features(features: Sequence[str], size: int) -> List[float]:
+    """SQL: to_dense_features(features, size) — dense double[size+1] by index."""
+    out = [0.0] * (size + 1)
+    for f in features:
+        name, v = _split(f)
+        i = int(name)
+        if 0 <= i <= size:
+            out[i] = 1.0 if v is None else float(v)
+    return out
+
+
+def to_sparse_features(dense: Sequence[float]) -> List[str]:
+    """SQL: to_sparse_features(array<double>) — "i:v" for nonzero cells."""
+    return [f"{i}:{v}" for i, v in enumerate(dense) if v not in (None, 0.0)]
+
+
+class quantify:
+    """SQL: quantify(output_row, col1, col2, ...) — UDTF assigning dense int
+    codes to string columns over the whole stream (first-seen order), the
+    reference's QuantifyColumnsUDTF. Use as a stateful transform:
+
+        q = quantify()
+        coded_rows = [q(row) for row in rows]
+    """
+
+    def __init__(self) -> None:
+        self._maps: List[Dict[str, int]] = []
+
+    def __call__(self, row: Sequence) -> List[int]:
+        while len(self._maps) < len(row):
+            self._maps.append({})
+        out = []
+        for i, v in enumerate(row):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out.append(v)
+                continue
+            m = self._maps[i]
+            out.append(m.setdefault(v, len(m)))
+        return out
+
+    def mapping(self, col: int) -> Dict[str, int]:
+        return dict(self._maps[col])

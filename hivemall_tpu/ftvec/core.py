@@ -1,0 +1,59 @@
+"""ftvec root helpers — feature-string make/split (SURVEY.md §3.12 root row).
+
+Reference package: hivemall.ftvec.{AddBiasUDF,ExtractFeatureUDF,
+ExtractWeightUDF,FeatureUDF,AddFeatureIndexUDF,SortByFeatureUDF}.
+Feature strings are "name:value" (bare "name" means value 1.0), split on the
+LAST ':' so names may contain colons.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+__all__ = ["add_bias", "extract_feature", "extract_weight", "feature",
+           "add_feature_index", "sort_by_feature"]
+
+BIAS_CLAUSE = "0:1.0"
+
+
+def add_bias(features: Sequence[str]) -> List[str]:
+    """SQL: add_bias(features) — append the constant bias feature "0:1.0"."""
+    return list(features) + [BIAS_CLAUSE]
+
+
+def _split(f: str):
+    name, sep, v = str(f).rpartition(":")
+    if not sep:
+        return str(f), None
+    return name, v
+
+
+def extract_feature(feature_str: str) -> str:
+    """SQL: extract_feature("idx:val") -> "idx"."""
+    return _split(feature_str)[0]
+
+
+def extract_weight(feature_str: str) -> float:
+    """SQL: extract_weight("idx:val") -> val (1.0 when absent)."""
+    v = _split(feature_str)[1]
+    return 1.0 if v is None else float(v)
+
+
+def feature(name, value=None) -> str:
+    """SQL: feature(name[, value]) — build a "name:value" string."""
+    return str(name) if value is None else f"{name}:{value}"
+
+
+def add_feature_index(values: Sequence[float]) -> List[str]:
+    """SQL: add_feature_index(array<double>) -> ["1:v1", "2:v2", ...]."""
+    return [f"{i + 1}:{v}" for i, v in enumerate(values)]
+
+
+def sort_by_feature(feature_map: Dict) -> Dict:
+    """SQL: sort_by_feature(map) — map sorted by (int-able) feature key."""
+    def key(k):
+        try:
+            return (0, int(k))
+        except (TypeError, ValueError):
+            return (1, str(k))
+    return dict(sorted(feature_map.items(), key=lambda kv: key(kv[0])))

@@ -1,0 +1,71 @@
+"""ftvec.hashing — the hashing trick (SURVEY.md §3.12 hashing row) [B].
+
+Reference: hivemall.ftvec.hashing.{FeatureHashingUDF,MurmurHash3UDF,
+ArrayHashValuesUDF,ArrayPrefixedHashValuesUDF}, hivemall.tools.text Sha1UDF.
+murmur3 itself lives in utils.hashing (bit-exact, vectorized).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence
+
+from ..utils.hashing import DEFAULT_NUM_FEATURES, mhash
+from ..utils.options import OptionSpec
+
+__all__ = ["feature_hashing", "array_hash_values", "prefixed_hash_values",
+           "sha1"]
+
+FEATURE_HASHING_SPEC = (OptionSpec("feature_hashing")
+                        .add("features", "num_features", type=int,
+                             default=DEFAULT_NUM_FEATURES,
+                             help="hashed feature-space size"))
+
+
+def feature_hashing(features: Sequence[str], options: str = "") -> List[str]:
+    """SQL: feature_hashing(array<string>[, '-features N']).
+
+    Hash each non-integer feature name into [1, N] keeping values; integer
+    indices pass through untouched (so already-hashed or libsvm-style input
+    is stable under re-application), matching the reference UDF.
+    """
+    ns = FEATURE_HASHING_SPEC.parse(options)
+    n = int(ns.features)
+    out: List[str] = []
+    for f in features:
+        if f is None:
+            continue
+        name, sep, v = str(f).rpartition(":")
+        if not sep:
+            name, v = str(f), None
+        try:
+            int(name)
+            out.append(str(f))
+            continue
+        except ValueError:
+            pass
+        h = mhash(name, n)
+        out.append(f"{h}:{v}" if v is not None else str(h))
+    return out
+
+
+def array_hash_values(values: Sequence[str], prefix: Optional[str] = None,
+                      num_features: int = DEFAULT_NUM_FEATURES) -> List[int]:
+    """SQL: array_hash_values(array<string>[, prefix]) -> array<int>."""
+    p = prefix or ""
+    return [mhash(p + str(v), num_features) for v in values if v is not None]
+
+
+def prefixed_hash_values(values: Sequence[str], prefix: str,
+                         num_features: int = DEFAULT_NUM_FEATURES
+                         ) -> List[str]:
+    """SQL: prefixed_hash_values(array, prefix) -> ["<hash(prefix#v)>", ...]."""
+    return [str(mhash(f"{prefix}#{v}", num_features))
+            for v in values if v is not None]
+
+
+def sha1(word: str, num_features: int = DEFAULT_NUM_FEATURES) -> int:
+    """SQL: sha1(word) — SHA1-based feature hash into [1, N]."""
+    d = hashlib.sha1(str(word).encode("utf-8")).digest()
+    h = int.from_bytes(d[:4], "big", signed=True)
+    return h % num_features + 1

@@ -1,0 +1,61 @@
+"""ftvec.pairing — explicit feature crosses (SURVEY.md §3.12 pairing row).
+
+Reference: hivemall.ftvec.pairing.{PolynomialFeaturesUDF,PoweredFeaturesUDF}.
+"""
+
+from __future__ import annotations
+
+from itertools import combinations_with_replacement
+from typing import List, Sequence
+
+from ..utils.options import OptionSpec
+from .core import _split
+
+__all__ = ["polynomial_features", "powered_features"]
+
+POLY_SPEC = (OptionSpec("polynomial_features")
+             .add("degree", type=int, default=2, help="max cross degree")
+             .flag("interaction_only", help="exclude self-powers (x_i^2)")
+             .flag("truncate", help="drop terms that include a 0/1-valued "
+                                    "feature raised beyond power 1"))
+
+
+def polynomial_features(features: Sequence[str], options: str = "-degree 2"
+                        ) -> List[str]:
+    """SQL: polynomial_features(features, '-degree d [-interaction_only]
+    [-truncate]') — all monomials up to degree d over the row's features,
+    named "a^b^c" with multiplied values."""
+    ns = POLY_SPEC.parse(options)
+    d = int(ns.degree)
+    parsed = []
+    for f in features:
+        name, v = _split(f)
+        parsed.append((name, 1.0 if v is None else float(v)))
+    out = [f"{n}:{v}" for n, v in parsed]
+    for deg in range(2, d + 1):
+        for combo in combinations_with_replacement(range(len(parsed)), deg):
+            if ns.interaction_only and len(set(combo)) != len(combo):
+                continue
+            if ns.truncate and any(
+                    parsed[i][1] in (0.0, 1.0) and combo.count(i) > 1
+                    for i in combo):
+                continue
+            name = "^".join(parsed[i][0] for i in combo)
+            v = 1.0
+            for i in combo:
+                v *= parsed[i][1]
+            out.append(f"{name}:{v}")
+    return out
+
+
+def powered_features(features: Sequence[str], degree: int = 2) -> List[str]:
+    """SQL: powered_features(features, degree) — adds x_i^p terms named
+    "name^p" for p in [2, degree]."""
+    parsed = []
+    for f in features:
+        name, v = _split(f)
+        parsed.append((name, 1.0 if v is None else float(v)))
+    out = [f"{n}:{v}" for n, v in parsed]
+    for p in range(2, degree + 1):
+        out.extend(f"{n}^{p}:{v ** p}" for n, v in parsed)
+    return out

@@ -1,0 +1,53 @@
+"""ftvec.scaling — rescale/zscore/normalize (SURVEY.md §3.12 scaling row).
+
+Reference: hivemall.ftvec.scaling.{RescaleUDF,ZScoreUDF,L1NormalizationUDF,
+L2NormalizationUDF}. Scalar forms take raw doubles; the array forms operate
+on "name:value" feature strings (per-row normalization).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+from .core import _split
+
+__all__ = ["rescale", "zscore", "l1_normalize", "l2_normalize"]
+
+
+def rescale(value: float, minv: float, maxv: float) -> float:
+    """SQL: rescale(v, min, max) — min-max to [0, 1] (0.5 when min==max)."""
+    if maxv == minv:
+        return 0.5
+    return (float(value) - minv) / (maxv - minv)
+
+
+def zscore(value: float, mean: float, stddev: float) -> float:
+    """SQL: zscore(v, mean, stddev)."""
+    if stddev == 0.0:
+        return 0.0
+    return (float(value) - mean) / stddev
+
+
+def _norm(features: Sequence[str], p: int) -> List[str]:
+    parsed = []
+    for f in features:
+        name, v = _split(f)
+        parsed.append((name, 1.0 if v is None else float(v)))
+    if p == 1:
+        z = sum(abs(v) for _, v in parsed)
+    else:
+        z = math.sqrt(sum(v * v for _, v in parsed))
+    if z == 0.0:
+        return [f"{n}:0.0" for n, _ in parsed]
+    return [f"{n}:{v / z}" for n, v in parsed]
+
+
+def l1_normalize(features: Sequence[str]) -> List[str]:
+    """SQL: l1_normalize(features) — row scaled to unit L1 norm."""
+    return _norm(features, 1)
+
+
+def l2_normalize(features: Sequence[str]) -> List[str]:
+    """SQL: l2_normalize(features) — row scaled to unit L2 norm."""
+    return _norm(features, 2)

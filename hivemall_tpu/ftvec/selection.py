@@ -1,0 +1,90 @@
+"""ftvec.selection — chi2 / SNR feature selection (SURVEY.md §3.12 selection).
+
+Reference: hivemall.ftvec.selection.{ChiSquareUDF,SignalNoiseRatioUDAF},
+backed by tools.matrix transpose_and_dot accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["chi2", "snr"]
+
+
+def chi2(observed: np.ndarray, expected: np.ndarray
+         ) -> Tuple[np.ndarray, np.ndarray]:
+    """SQL: chi2(observed, expected) -> (chi2 stats, p-values) per feature.
+
+    observed/expected: [n_classes, n_features] aggregates (the reference
+    computes them with transpose_and_dot over one-hot labels x features).
+    """
+    obs = np.asarray(observed, np.float64)
+    exp = np.asarray(expected, np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        terms = np.where(exp > 0, (obs - exp) ** 2 / exp, 0.0)
+    stat = terms.sum(axis=0)
+    dof = max(1, obs.shape[0] - 1)
+    p = _chi2_sf(stat, dof)
+    return stat, p
+
+
+def _chi2_sf(x: np.ndarray, k: int) -> np.ndarray:
+    """Chi-square survival function via the regularized upper incomplete
+    gamma Q(k/2, x/2) (series/continued-fraction, no scipy dependency)."""
+    x = np.asarray(x, np.float64)
+    return np.vectorize(lambda v: _gammaincc(k / 2.0, v / 2.0))(x)
+
+
+def _gammaincc(a: float, x: float) -> float:
+    if x < 0 or a <= 0:
+        return 1.0
+    if x == 0:
+        return 1.0
+    import math
+    if x < a + 1:
+        # lower series -> P, return 1-P
+        term = 1.0 / a
+        s = term
+        for n in range(1, 500):
+            term *= x / (a + n)
+            s += term
+            if abs(term) < abs(s) * 1e-15:
+                break
+        P = s * math.exp(-x + a * math.log(x) - math.lgamma(a))
+        return max(0.0, 1.0 - P)
+    # continued fraction for Q
+    b = x + 1 - a
+    c = 1e300
+    d = 1.0 / b
+    h = d
+    for i in range(1, 500):
+        an = -i * (i - a)
+        b += 2
+        d = an * d + b
+        d = 1e-300 if abs(d) < 1e-300 else d
+        c = b + an / c
+        c = 1e-300 if abs(c) < 1e-300 else c
+        d = 1.0 / d
+        delta = d * c
+        h *= delta
+        if abs(delta - 1.0) < 1e-15:
+            break
+    return h * math.exp(-x + a * math.log(x) - math.lgamma(a))
+
+
+def snr(X: np.ndarray, labels: np.ndarray) -> np.ndarray:
+    """SQL: snr UDAF — per-feature signal-to-noise ratio across classes:
+    |mu_c1 - mu_c2| / (sd_c1 + sd_c2) summed over class pairs."""
+    X = np.asarray(X, np.float64)
+    labels = np.asarray(labels)
+    classes = np.unique(labels)
+    mus = np.stack([X[labels == c].mean(0) for c in classes])
+    sds = np.stack([X[labels == c].std(0) for c in classes])
+    out = np.zeros(X.shape[1])
+    for i in range(len(classes)):
+        for j in range(i + 1, len(classes)):
+            denom = sds[i] + sds[j]
+            out += np.where(denom > 0, np.abs(mus[i] - mus[j]) / denom, 0.0)
+    return out

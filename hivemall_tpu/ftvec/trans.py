@@ -1,0 +1,104 @@
+"""ftvec.trans — declarative row->feature-array builders (SURVEY.md §3.12
+trans row). ``ffm_features`` is load-bearing for train_ffm (BASELINE #2).
+
+Reference: hivemall.ftvec.trans.{BinarizeLabelUDTF,CategoricalFeaturesUDF,
+QuantitativeFeaturesUDF,VectorizeFeaturesUDF,IndexedFeatures,
+OnehotEncodingUDAF,FFMFeaturesUDF}.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..utils.hashing import DEFAULT_NUM_FEATURES, mhash
+
+__all__ = ["binarize_label", "categorical_features", "quantitative_features",
+           "vectorize_features", "indexed_features", "onehot_encoding",
+           "ffm_features"]
+
+
+def categorical_features(names: Sequence[str], *values) -> List[str]:
+    """SQL: categorical_features(array('col1',...), v1, ...) ->
+    ["col1#v1", ...] (None values skipped)."""
+    out = []
+    for n, v in zip(names, values):
+        if v is not None:
+            out.append(f"{n}#{v}")
+    return out
+
+
+def quantitative_features(names: Sequence[str], *values) -> List[str]:
+    """SQL: quantitative_features(array('col1',...), v1, ...) ->
+    ["col1:v1", ...]."""
+    out = []
+    for n, v in zip(names, values):
+        if v is not None:
+            out.append(f"{n}:{float(v)}")
+    return out
+
+
+def vectorize_features(names: Sequence[str], *values) -> List[str]:
+    """SQL: vectorize_features — categorical for strings, quantitative for
+    numbers (the reference's combined builder)."""
+    out = []
+    for n, v in zip(names, values):
+        if v is None:
+            continue
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            if float(v) != 0.0:
+                out.append(f"{n}:{float(v)}")
+        else:
+            out.append(f"{n}#{v}")
+    return out
+
+
+def indexed_features(*values) -> List[str]:
+    """SQL: indexed_features(v1, v2, ...) -> ["1:v1", "2:v2", ...]."""
+    return [f"{i + 1}:{float(v)}" for i, v in enumerate(values)
+            if v is not None]
+
+
+def binarize_label(pos_count: int, neg_count: int, *payload
+                   ) -> Iterator[Tuple]:
+    """SQL: binarize_label(pos, neg, features...) — UDTF expanding aggregated
+    (pos, neg) counts back into one row per observation with label 1/0."""
+    for _ in range(int(pos_count)):
+        yield tuple(payload) + (1,)
+    for _ in range(int(neg_count)):
+        yield tuple(payload) + (0,)
+
+
+def onehot_encoding(columns: Sequence[Sequence]) -> Dict:
+    """SQL: onehot_encoding(col1, col2, ...) UDAF — a global category->index
+    map per column, indices contiguous across columns (reference semantics:
+    sorted per column, offset by previous columns' cardinality)."""
+    out: Dict[int, Dict] = {}
+    offset = 1
+    for ci, col in enumerate(columns):
+        cats = sorted({v for v in col if v is not None}, key=str)
+        out[ci] = {c: offset + i for i, c in enumerate(cats)}
+        offset += len(cats)
+    return out
+
+
+def ffm_features(names: Sequence[str], *values,
+                 num_features: int = DEFAULT_NUM_FEATURES,
+                 num_fields: int = 1024) -> List[str]:
+    """SQL: ffm_features(array('col1',...), v1, ...) ->
+    ["<field>:<index>:<value>", ...] for train_ffm.
+
+    field = column position (0-based); index = hashed "col#value" for
+    categoricals / hashed "col" for numerics; value = 1 or the number.
+    Reference: hivemall.ftvec.trans.FFMFeaturesUDF."""
+    out = []
+    for fi, (n, v) in enumerate(zip(names, values)):
+        if v is None:
+            continue
+        field = fi % num_fields
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            idx = mhash(str(n), num_features - 1)
+            out.append(f"{field}:{idx}:{float(v)}")
+        else:
+            idx = mhash(f"{n}#{v}", num_features - 1)
+            out.append(f"{field}:{idx}:1")
+    return out

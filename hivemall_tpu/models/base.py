@@ -125,11 +125,15 @@ class LearnerBase:
         self._flush()
         iters = int(self.opts.iters)
         if iters > 1 and self._all_rows:
-            ds = SparseDataset.from_rows(self._all_rows, self._all_labels)
+            # epoch replay over the recorded stream (NioStatefulSegment analog)
+            rng = np.random.default_rng(42)
+            bs = int(self.opts.mini_batch)
             for ep in range(1, iters):
-                for b in ds.batches(int(self.opts.mini_batch), shuffle=True,
-                                    seed=42 + ep):
-                    self._dispatch(b)
+                order = rng.permutation(len(self._all_rows))
+                for s in range(0, len(order), bs):
+                    take = order[s:s + bs]
+                    self._flush_chunk([self._all_rows[i] for i in take],
+                                      [self._all_labels[i] for i in take])
         if self._mixer is not None:
             self._mixer.close_group()
         yield from self.model_rows()
@@ -196,6 +200,10 @@ class LearnerBase:
         if int(self.opts.iters) > 1:
             self._all_rows.extend(rows)
             self._all_labels.extend(labels)
+        self._flush_chunk(rows, labels)
+
+    def _flush_chunk(self, rows, labels) -> None:
+        """Pad one chunk of buffered rows into a SparseBatch and dispatch."""
         B = int(self.opts.mini_batch)
         L = self._pow2_len(max(1, max(len(r[0]) for r in rows)))
         idx = np.zeros((B, L), np.int32)

@@ -1,0 +1,304 @@
+"""train_fm / train_ffm — factorization-machine trainers (BASELINE config #2).
+
+Reference (SURVEY.md §3.6): hivemall.fm.FactorizationMachineUDTF (train_fm,
+options -factors/-iters/-eta*/-lambda*/-sigma/-classification/-int_feature),
+FieldAwareFactorizationMachineUDTF (train_ffm, "field:index:value" features,
+per-(feature,field) latent vectors, AdaGrad/FTRL), FMPredictGenericUDAF /
+FFMPredictUDF for scoring.
+
+TPU design: dense hashed tables w[N], V[N,K] (FM) / V[N,F,K] (FFM) in HBM,
+bf16-able; one jitted value_and_grad step per minibatch (ops.fm). The FFM
+(feature,field) table is the TP-sharding target for multi-chip (SURVEY.md §8
+M3); see parallel.dp / __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.sparse import SparseBatch, SparseDataset
+from ..ops.fm import ffm_score, fm_score, make_ffm_step, make_fm_step
+from ..ops.losses import get_loss
+from ..ops.optimizers import make_optimizer
+from ..utils.hashing import mhash
+from ..utils.options import OptionSpec
+from .base import LearnerBase, learner_option_spec
+
+__all__ = ["FMTrainer", "FFMTrainer", "fm_predict", "ffm_predict"]
+
+
+def _factor_spec(name: str, default_factors: int, default_opt: str
+                 ) -> OptionSpec:
+    s = learner_option_spec(name, classification=True,
+                            default_loss="squaredloss")
+    s.add("factors", "factor", type=int, default=default_factors,
+          help="latent dimension k")
+    s.add("sigma", type=float, default=0.1, help="init stddev for V")
+    s.flag("classification", help="optimize logloss on +-1 labels "
+                                  "(default: regression, squared loss)")
+    s.add("lambda0", type=float, default=0.01, help="L2 for w0")
+    s.add("lambda_w", type=float, default=0.01, help="L2 for linear weights")
+    s.add("lambda_v", type=float, default=0.01, help="L2 for latent factors")
+    s.add("min_target", type=float, default=None, help="clip regression target")
+    s.add("max_target", type=float, default=None, help="clip regression target")
+    s.add("seed", type=int, default=42, help="init seed")
+    for o in s.options:
+        if o.name == "opt":
+            o.default = default_opt
+        if o.name == "reg":
+            o.default = "no"       # factor models carry their own L2 lambdas
+    return s
+
+
+class FMTrainer(LearnerBase):
+    """SQL: train_fm — reference hivemall.fm.FactorizationMachineUDTF."""
+
+    NAME = "train_fm"
+    CLASSIFICATION = False     # label handling driven by -classification
+
+    @classmethod
+    def spec(cls) -> OptionSpec:
+        return _factor_spec(cls.NAME, default_factors=5, default_opt="sgd")
+
+    def _init_state(self) -> None:
+        o = self.opts
+        self.classification = bool(o.classification)
+        self.loss = get_loss("logloss" if self.classification
+                             else (o.loss or "squaredloss"))
+        self.optimizer = make_optimizer(
+            o.opt, eta_scheme=o.eta, eta0=o.eta0, total_steps=o.total_steps,
+            power_t=o.power_t, reg="no")
+        self.k = int(o.factors)
+        dtype = jnp.bfloat16 if o.halffloat else jnp.float32
+        key = jax.random.PRNGKey(int(o.seed))
+        self.params = {
+            "w0": jnp.zeros((), dtype),
+            "w": jnp.zeros(self.dims, dtype),
+            "V": (jax.random.normal(key, (self.dims, self.k)) *
+                  float(o.sigma)).astype(dtype),
+        }
+        self.opt_state = {k: self.optimizer.init(v.shape)
+                          for k, v in self.params.items()}
+        self._step = make_fm_step(self.loss, self.optimizer,
+                                  (o.lambda0, o.lambda_w, o.lambda_v))
+
+    def _convert_label(self, label: float) -> float:
+        if self.classification:
+            return 1.0 if float(label) > 0 else -1.0
+        y = float(label)
+        if self.opts.min_target is not None:
+            y = max(y, self.opts.min_target)
+        if self.opts.max_target is not None:
+            y = min(y, self.opts.max_target)
+        return y
+
+    def _convert_labels(self, labels: np.ndarray) -> np.ndarray:
+        if self.classification:
+            return np.where(labels > 0, 1.0, -1.0).astype(np.float32)
+        y = labels.astype(np.float32)
+        if self.opts.min_target is not None:
+            y = np.maximum(y, self.opts.min_target)
+        if self.opts.max_target is not None:
+            y = np.minimum(y, self.opts.max_target)
+        return y
+
+    def _batch_args(self, batch: SparseBatch) -> tuple:
+        return ()
+
+    def _train_batch(self, batch: SparseBatch) -> float:
+        self.params, self.opt_state, loss_sum = self._step(
+            self.params, self.opt_state, float(self._t), batch.idx, batch.val,
+            batch.label, batch.row_mask, *self._batch_args(batch))
+        return float(loss_sum)
+
+    # -- scoring -------------------------------------------------------------
+    def _score_batch(self, batch: SparseBatch) -> np.ndarray:
+        p = self.params
+        return np.asarray(fm_score(p["w0"], p["w"], p["V"],
+                                   batch.idx, batch.val))
+
+    def decision_function(self, ds: SparseDataset) -> np.ndarray:
+        out = np.empty(len(ds), np.float32)
+        bs = int(self.opts.mini_batch)
+        for s, b in zip(range(0, len(ds), bs), ds.batches(bs, shuffle=False)):
+            nv = b.n_valid or b.batch_size
+            out[s:s + nv] = self._score_batch(b)[:nv]
+        return out
+
+    def predict(self, ds: SparseDataset) -> np.ndarray:
+        phi = self.decision_function(ds)
+        if self.classification:
+            return 1.0 / (1.0 + np.exp(-phi))
+        return phi
+
+    # -- model emission: (feature, Wi, Vi[]) rows ---------------------------
+    def model_rows(self):
+        w = np.asarray(self.params["w"].astype(jnp.float32))
+        V = np.asarray(self.params["V"].astype(jnp.float32))
+        touched = np.nonzero((np.abs(V).sum(-1) > 0) | (w != 0))[0]
+        yield ("0", float(np.asarray(self.params["w0"])), None)
+        for i in touched:
+            if i == 0:
+                continue
+            yield (self._names.get(int(i), str(int(i))), float(w[i]),
+                   V[i].tolist())
+
+    def model_table(self):
+        return {row[0]: row[1:] for row in self.model_rows()}
+
+    def save_model(self, path: str) -> None:
+        """Binary model bundle (params + optimizer state), orbax-style npz."""
+        np.savez(path, **{k: np.asarray(v.astype(jnp.float32))
+                          for k, v in self.params.items()})
+
+    def _warm_start(self, path: str) -> None:
+        z = np.load(path if path.endswith(".npz") else path + ".npz")
+        for k in self.params:
+            self.params[k] = jnp.asarray(z[k], self.params[k].dtype)
+
+    def _finalized_weights(self) -> np.ndarray:
+        return np.asarray(self.params["w"].astype(jnp.float32))
+
+    def _load_weights(self, w: np.ndarray) -> None:
+        self.params["w"] = jnp.asarray(w, self.params["w"].dtype)
+
+
+class FFMTrainer(FMTrainer):
+    """SQL: train_ffm — reference hivemall.fm.FieldAwareFactorizationMachineUDTF.
+
+    Features are "field:index:value" triples (ftvec.trans.ffm_features);
+    latent table V[N, F, K] holds one k-vector per (feature, field)."""
+
+    NAME = "train_ffm"
+
+    @classmethod
+    def spec(cls) -> OptionSpec:
+        s = _factor_spec(cls.NAME, default_factors=4, default_opt="adagrad")
+        s.add("fields", "num_fields", type=int, default=64,
+              help="field-space size F")
+        s.flag("no_w0", help="drop the global bias term")
+        s.flag("no_wi", help="drop the linear terms (libffm-style)")
+        return s
+
+    def _init_state(self) -> None:
+        o = self.opts
+        self.classification = bool(o.classification)
+        self.loss = get_loss("logloss" if self.classification
+                             else (o.loss or "squaredloss"))
+        self.optimizer = make_optimizer(
+            o.opt, eta_scheme=o.eta, eta0=o.eta0, total_steps=o.total_steps,
+            power_t=o.power_t, reg="no")
+        self.k = int(o.factors)
+        self.F = int(o.fields)
+        dtype = jnp.bfloat16 if o.halffloat else jnp.float32
+        key = jax.random.PRNGKey(int(o.seed))
+        self.params = {
+            "w0": jnp.zeros((), dtype),
+            "w": jnp.zeros(self.dims, dtype),
+            "V": (jax.random.normal(key, (self.dims, self.F, self.k)) *
+                  float(o.sigma)).astype(dtype),
+        }
+        self.opt_state = {k: self.optimizer.init(v.shape)
+                          for k, v in self.params.items()}
+        self._step = make_ffm_step(self.loss, self.optimizer,
+                                   (o.lambda0, o.lambda_w, o.lambda_v))
+
+    def _batch_args(self, batch: SparseBatch) -> tuple:
+        if batch.field is None:
+            raise ValueError("train_ffm needs field ids; use "
+                             "'field:index:value' features (ffm_features)")
+        return (batch.field,)
+
+    def _parse_row(self, features):
+        """Parse "field:index:value" (value defaults to 1)."""
+        if (isinstance(features, tuple) and len(features) == 3):
+            return features           # (idx, val, field) pre-parsed
+        idx: List[int] = []
+        val: List[float] = []
+        fld: List[int] = []
+        for f in features:
+            if f is None or f == "":
+                continue
+            parts = str(f).split(":")
+            if len(parts) == 2:
+                fstr, istr, vstr = parts[0], parts[1], "1"
+            elif len(parts) >= 3:
+                fstr, istr, vstr = parts[0], parts[1], ":".join(parts[2:])
+            else:
+                raise ValueError(f"FFM feature needs field:index[:value]: {f!r}")
+            try:
+                fi = int(fstr)
+            except ValueError:
+                fi = mhash(fstr, self.F) - 1
+            try:
+                ii = int(istr)
+            except ValueError:
+                ii = mhash(istr, self.dims - 1)
+                self._names.setdefault(ii, istr)
+            idx.append(ii)
+            val.append(float(vstr))
+            fld.append(fi % self.F)
+        return (np.asarray(idx, np.int32), np.asarray(val, np.float32),
+                np.asarray(fld, np.int32))
+
+    def process(self, features, label) -> None:
+        idx, val, fld = self._parse_row(features)
+        self._buf_rows.append((idx, val, fld))
+        self._buf_labels.append(self._convert_label(label))
+        if len(self._buf_rows) >= int(self.opts.mini_batch):
+            self._flush()
+
+    def _flush_chunk(self, rows, labels) -> None:
+        B = int(self.opts.mini_batch)
+        L = self._pow2_len(max(1, max(len(r[0]) for r in rows)))
+        idx = np.zeros((B, L), np.int32)
+        val = np.zeros((B, L), np.float32)
+        fld = np.zeros((B, L), np.int32)
+        lab = np.zeros(B, np.float32)
+        for b, (i, v, f) in enumerate(rows):
+            idx[b, :len(i)] = i
+            val[b, :len(v)] = v
+            fld[b, :len(f)] = f
+            lab[b] = labels[b]
+        nv = len(rows)
+        self._dispatch(SparseBatch(idx, val, lab, fld,
+                                   n_valid=nv if nv < B else None))
+
+    def _score_batch(self, batch: SparseBatch) -> np.ndarray:
+        p = self.params
+        return np.asarray(ffm_score(p["w0"], p["w"], p["V"],
+                                    batch.idx, batch.val, batch.field))
+
+    def model_rows(self):
+        """(feature, field, Wi, Vi[k]) rows — the FFMPredictionModel surface."""
+        w = np.asarray(self.params["w"].astype(jnp.float32))
+        V = np.asarray(self.params["V"].astype(jnp.float32))
+        yield ("0", -1, float(np.asarray(self.params["w0"])), None)
+        touched = np.nonzero(np.abs(V).sum((1, 2)) > 0)[0]
+        for i in touched:
+            if i == 0:
+                continue
+            name = self._names.get(int(i), str(int(i)))
+            for f in range(self.F):
+                if np.abs(V[i, f]).sum() > 0:
+                    yield (name, f, float(w[i]), V[i, f].tolist())
+
+
+# --- standalone predict kernels (the UDAF/UDF reassembly path) -------------
+
+def fm_predict(w0, w, V, idx, val) -> np.ndarray:
+    """SQL: fm_predict — reference hivemall.fm.FMPredictGenericUDAF."""
+    return np.asarray(fm_score(jnp.asarray(w0), jnp.asarray(w),
+                               jnp.asarray(V), jnp.asarray(idx),
+                               jnp.asarray(val)))
+
+
+def ffm_predict(w0, w, V, idx, val, field) -> np.ndarray:
+    """SQL: ffm_predict — reference hivemall.fm.FFMPredictUDF."""
+    return np.asarray(ffm_score(jnp.asarray(w0), jnp.asarray(w),
+                                jnp.asarray(V), jnp.asarray(idx),
+                                jnp.asarray(val), jnp.asarray(field)))

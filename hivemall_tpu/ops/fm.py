@@ -1,0 +1,96 @@
+"""Factorization-machine kernels: FM and field-aware FM (FFM) on TPU.
+
+Reference: hivemall.fm (SURVEY.md §3.6, §4.4) — FactorizationMachineUDTF's
+per-row O(n*k) FM update and FieldAwareFactorizationMachineUDTF's O(n^2*k)
+pair loop over (feature, field) latent vectors in a packed-long hash table.
+
+TPU shape: the per-row loops become batched gathers + einsums —
+  FM:  gather V[idx] -> [B,L,K]; phi uses the (sum^2 - sum-of-squares)/2
+       identity, all MXU/VPU friendly.
+  FFM: the pair tensor A[b,i,j,:] = V[idx[b,i], field[b,j], :] is one flat
+       gather into V viewed [N*F, K]; interactions = einsum('bijk,bjik->bij')
+       masked to i<j. Padding (idx=0, val=0) self-cancels through val.
+Gradients via jax.grad: XLA turns the gathers' adjoints into scatter-adds on
+the dense tables — the batched analog of the reference's per-entry AdaGrad
+cell updates.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .losses import Loss
+from .optimizers import Optimizer
+
+__all__ = ["fm_score", "ffm_score", "make_fm_step", "make_ffm_step"]
+
+
+def fm_score(w0, w, V, idx, val):
+    """phi = w0 + sum_i w_i x_i + 1/2 sum_f [(sum_i v_if x_i)^2 - sum v^2 x^2].
+
+    Reference formula: FMPredictGenericUDAF (SURVEY.md §3.6 row 2)."""
+    wi = (w[idx].astype(jnp.float32) * val).sum(-1)
+    Vg = V[idx].astype(jnp.float32)                      # [B, L, K]
+    s = (Vg * val[..., None]).sum(1)                     # [B, K]
+    s2 = ((Vg * val[..., None]) ** 2).sum(1)             # [B, K]
+    return w0.astype(jnp.float32) + wi + 0.5 * (s * s - s2).sum(-1)
+
+
+def ffm_score(w0, w, V, idx, val, field):
+    """phi = w0 + sum_i w_i x_i + sum_{i<j} (V[i,f_j] . V[j,f_i]) x_i x_j.
+
+    V: [N, F, K]; idx/field: [B, L]. Reference: FFMPredictUDF pairwise
+    field-crossed dots (SURVEY.md §3.6 row 4)."""
+    B, L = idx.shape
+    N, F, K = V.shape
+    wi = (w[idx].astype(jnp.float32) * val).sum(-1)
+    V2 = V.reshape(N * F, K)
+    flat = idx[:, :, None] * F + field[:, None, :]       # [B, L(i), L(j)]
+    A = V2[flat].astype(jnp.float32)                     # [B, L, L, K]
+    inter = jnp.einsum("bijk,bjik->bij", A, A)
+    xx = val[:, :, None] * val[:, None, :]               # x_i x_j
+    iu = jnp.triu(jnp.ones((L, L), jnp.float32), k=1)    # i < j
+    return w0.astype(jnp.float32) + wi + (inter * xx * iu[None]).sum((1, 2))
+
+
+def _make_factor_step(score_fn: Callable, loss: Loss, optimizer: Optimizer,
+                      lambdas: Tuple[float, float, float]) -> Callable:
+    """Shared FM/FFM jitted step: value_and_grad + per-table optimizer.
+    The classification-vs-regression split is carried by ``loss`` (logloss on
+    +-1 labels vs squaredloss on targets), as in the reference's
+    -classification flag."""
+    lam0, lam_w, lam_v = lambdas
+
+    @jax.jit
+    def step(params, opt_state, t, idx, val, label, row_mask, *extra):
+        def batch_loss(p):
+            phi = score_fn(p["w0"], p["w"], p["V"], idx, val, *extra)
+            return (loss.loss(phi, label) * row_mask).sum()
+
+        loss_sum, grads = jax.value_and_grad(batch_loss)(params)
+        # L2 (reference: -lambda* FM hyperparams), folded into the gradient
+        grads = {"w0": grads["w0"] + lam0 * params["w0"],
+                 "w": grads["w"] + lam_w * params["w"],
+                 "V": grads["V"] + lam_v * params["V"]}
+        new_p = {}
+        new_s = {}
+        for k in ("w0", "w", "V"):
+            p32 = params[k].astype(jnp.float32)
+            nk, sk = optimizer.update(p32, grads[k].astype(jnp.float32),
+                                      opt_state[k], t)
+            new_p[k] = nk.astype(params[k].dtype)
+            new_s[k] = sk
+        return new_p, new_s, loss_sum
+
+    return step
+
+
+def make_fm_step(loss, optimizer, lambdas):
+    return _make_factor_step(fm_score, loss, optimizer, lambdas)
+
+
+def make_ffm_step(loss, optimizer, lambdas):
+    return _make_factor_step(ffm_score, loss, optimizer, lambdas)

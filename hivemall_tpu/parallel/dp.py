@@ -18,7 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.losses import get_loss
 
-__all__ = ["make_dp_linear_step"]
+__all__ = ["make_dp_linear_step", "make_dp_ffm_step"]
 
 
 def make_dp_linear_step(mesh: Mesh, *, loss_name: str = "logloss",
@@ -45,5 +45,44 @@ def make_dp_linear_step(mesh: Mesh, *, loss_name: str = "logloss",
         gg2 = gg + g * g
         w2 = w - eta0 * g / (jnp.sqrt(gg2) + 1e-6)
         return w2, gg2, loss.loss(margin, label).mean()
+
+    return step
+
+
+def make_dp_ffm_step(mesh: Mesh, *, eta0: float = 0.1):
+    """Full FFM training step partitioned over (dp, tp) — the flagship
+    multi-chip path (SURVEY.md §8 M3: (feature,field) table sharded TP-like,
+    batch DP, AdaGrad state co-sharded; XLA inserts the psum of partial
+    gradients and the gather collectives over ICI).
+
+    params: {"w0": (), "w": [N] P('tp'), "V": [N, F, K] P('tp', None, None)}
+    opt_state gg co-shaped/co-sharded; batch idx/val/field P('dp', None).
+    """
+    from ..ops.fm import ffm_score
+    from ..ops.losses import get_loss
+    loss = get_loss("logloss")
+
+    tp = NamedSharding(mesh, P("tp"))
+    tp3 = NamedSharding(mesh, P("tp", None, None))
+    dpb = NamedSharding(mesh, P("dp", None))
+    dpv = NamedSharding(mesh, P("dp"))
+    rep = NamedSharding(mesh, P())
+    pspec = {"w0": rep, "w": tp, "V": tp3}
+
+    @partial(jax.jit,
+             in_shardings=(pspec, pspec, dpb, dpb, dpb, dpv),
+             out_shardings=(pspec, pspec, None))
+    def step(params, gg, idx, val, field, label):
+        def batch_loss(p):
+            phi = ffm_score(p["w0"], p["w"], p["V"], idx, val, field)
+            return loss.loss(phi, label).sum()
+
+        lsum, grads = jax.value_and_grad(batch_loss)(params)
+        new_p, new_gg = {}, {}
+        for k in params:
+            g2 = gg[k] + grads[k] * grads[k]
+            new_p[k] = params[k] - eta0 * grads[k] / (jnp.sqrt(g2) + 1e-6)
+            new_gg[k] = g2
+        return new_p, new_gg, lsum
 
     return step

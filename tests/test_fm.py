@@ -1,0 +1,137 @@
+"""FM/FFM trainers: score-formula correctness vs a naive oracle + convergence
+on synthetic interaction data (SURVEY.md §5 golden-convergence style)."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.frame.evaluation import auc
+from hivemall_tpu.io.sparse import SparseDataset
+from hivemall_tpu.models.fm import FFMTrainer, FMTrainer
+
+
+def naive_fm_score(w0, w, V, idx, val):
+    """Direct per-row double loop oracle of the FM formula."""
+    out = []
+    for b in range(idx.shape[0]):
+        s = w0 + sum(w[idx[b, l]] * val[b, l] for l in range(idx.shape[1]))
+        for i in range(idx.shape[1]):
+            for j in range(i + 1, idx.shape[1]):
+                s += float(V[idx[b, i]] @ V[idx[b, j]]) * val[b, i] * val[b, j]
+        out.append(s)
+    return np.asarray(out)
+
+
+def naive_ffm_score(w0, w, V, idx, val, fld):
+    out = []
+    for b in range(idx.shape[0]):
+        s = w0 + sum(w[idx[b, l]] * val[b, l] for l in range(idx.shape[1]))
+        for i in range(idx.shape[1]):
+            for j in range(i + 1, idx.shape[1]):
+                s += float(V[idx[b, i], fld[b, j]] @ V[idx[b, j], fld[b, i]]
+                           ) * val[b, i] * val[b, j]
+        out.append(s)
+    return np.asarray(out)
+
+
+def test_fm_score_matches_oracle():
+    from hivemall_tpu.ops.fm import fm_score
+    rng = np.random.default_rng(0)
+    N, K, B, L = 20, 3, 7, 4
+    w0 = 0.3
+    w = rng.normal(0, 1, N).astype(np.float32)
+    V = rng.normal(0, 1, (N, K)).astype(np.float32)
+    idx = rng.integers(1, N, (B, L)).astype(np.int32)
+    val = rng.uniform(0.5, 1.5, (B, L)).astype(np.float32)
+    got = np.asarray(fm_score(np.float32(w0), w, V, idx, val))
+    want = naive_fm_score(w0, w, V, idx, val)
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
+def test_ffm_score_matches_oracle():
+    from hivemall_tpu.ops.fm import ffm_score
+    rng = np.random.default_rng(1)
+    N, F, K, B, L = 15, 5, 2, 6, 4
+    w0 = -0.2
+    w = rng.normal(0, 1, N).astype(np.float32)
+    V = rng.normal(0, 1, (N, F, K)).astype(np.float32)
+    idx = rng.integers(1, N, (B, L)).astype(np.int32)
+    val = rng.uniform(0.5, 1.5, (B, L)).astype(np.float32)
+    fld = rng.integers(0, F, (B, L)).astype(np.int32)
+    got = np.asarray(ffm_score(np.float32(w0), w, V, idx, val, fld))
+    want = naive_ffm_score(w0, w, V, idx, val, fld)
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
+def _xor_dataset(n=2000, seed=0):
+    """Pure interaction task: y = +1 iff exactly one of (f1, f2) present —
+    linear terms can't solve it, factors must."""
+    rng = np.random.default_rng(seed)
+    rows, fields, labels = [], [], []
+    for _ in range(n):
+        a, b = rng.integers(0, 2), rng.integers(0, 2)
+        idx = [1 if a else 2, 3 if b else 4]
+        rows.append((np.asarray(idx, np.int32), np.ones(2, np.float32)))
+        fields.append(np.asarray([0, 1], np.int32))
+        labels.append(1.0 if a != b else -1.0)
+    return rows, fields, labels
+
+
+def test_fm_learns_interactions():
+    rows, _, labels = _xor_dataset()
+    ds = SparseDataset.from_rows(rows, labels)
+    t = FMTrainer("-dims 16 -factors 4 -classification -opt adagrad "
+                  "-eta fixed -eta0 0.1 -mini_batch 64 -iters 8 -sigma 0.3 "
+                  "-lambda0 0 -lambda_w 0 -lambda_v 0")
+    t.fit(ds)
+    assert auc(np.asarray(labels), t.predict(ds)) > 0.95
+
+
+def test_ffm_learns_interactions():
+    rows, fields, labels = _xor_dataset()
+    ds = SparseDataset.from_rows(rows, labels, fields=fields)
+    t = FFMTrainer("-dims 16 -factors 4 -fields 4 -classification "
+                   "-opt adagrad -eta fixed -eta0 0.1 -mini_batch 64 "
+                   "-iters 8 -sigma 0.3 -lambda0 0 -lambda_w 0 -lambda_v 0")
+    t.fit(ds)
+    assert auc(np.asarray(labels), t.predict(ds)) > 0.95
+
+
+def test_ffm_udtf_lifecycle_with_string_features():
+    t = FFMTrainer("-dims 4096 -factors 2 -fields 8 -classification "
+                   "-mini_batch 8 -eta fixed -eta0 0.2 -sigma 0.2")
+    rng = np.random.default_rng(3)
+    for _ in range(200):
+        a, b = rng.integers(0, 2), rng.integers(0, 2)
+        feats = [f"0:u{a}:1", f"1:i{b}:1"]     # field:index:value strings
+        t.process(feats, 1 if a != b else -1)
+    rows = list(t.close())
+    assert rows[0][0] == "0"                   # w0 row first
+    names = {r[0] for r in rows}
+    assert any(n.startswith("u") for n in names)
+    assert any(n.startswith("i") for n in names)
+
+
+def test_fm_regression_targets():
+    rng = np.random.default_rng(5)
+    rows, labels = [], []
+    for _ in range(800):
+        i = int(rng.integers(1, 5))
+        rows.append((np.asarray([i], np.int32), np.ones(1, np.float32)))
+        labels.append(float(i))                # target = feature id
+    ds = SparseDataset.from_rows(rows, labels)
+    t = FMTrainer("-dims 8 -factors 2 -opt adagrad -eta fixed -eta0 0.5 "
+                  "-mini_batch 32 -iters 6 -lambda0 0 -lambda_w 0 -lambda_v 0")
+    t.fit(ds)
+    pred = t.predict(ds)
+    assert np.corrcoef(pred, np.asarray(labels))[0, 1] > 0.98
+
+
+def test_fm_save_warm_start(tmp_path):
+    rows, _, labels = _xor_dataset(300)
+    ds = SparseDataset.from_rows(rows, labels)
+    a = FMTrainer("-dims 16 -factors 2 -classification -mini_batch 64")
+    a.fit(ds)
+    p = str(tmp_path / "fm_model.npz")
+    a.save_model(p)
+    b = FMTrainer(f"-dims 16 -factors 2 -classification -loadmodel {p}")
+    np.testing.assert_allclose(a.predict(ds), b.predict(ds), atol=1e-5)

@@ -1,0 +1,107 @@
+import numpy as np
+import pytest
+
+from hivemall_tpu.ftvec import (add_bias, add_feature_index, build_bins,
+                                categorical_features, chi2, extract_feature,
+                                extract_weight, feature, feature_binning,
+                                feature_hashing, ffm_features,
+                                indexed_features, l1_normalize, l2_normalize,
+                                onehot_encoding, polynomial_features,
+                                powered_features, quantify,
+                                quantitative_features, rescale,
+                                sort_by_feature, to_dense_features,
+                                to_sparse_features, vectorize_features,
+                                zscore)
+
+
+def test_core_helpers():
+    assert add_bias(["1:2.0"]) == ["1:2.0", "0:1.0"]
+    assert extract_feature("height:1.7") == "height"
+    assert extract_weight("height:1.7") == 1.7
+    assert extract_weight("bare") == 1.0
+    assert feature("a", 2) == "a:2"
+    assert add_feature_index([0.5, 0.25]) == ["1:0.5", "2:0.25"]
+    assert list(sort_by_feature({"2": 1, "1": 2})) == ["1", "2"]
+
+
+def test_feature_hashing_semantics():
+    out = feature_hashing(["cat#tokyo", "10:0.5", "height:1.7"])
+    # integer index passes through; names hashed to ints keeping value
+    assert out[1] == "10:0.5"
+    h, v = out[2].rsplit(":", 1)
+    assert int(h) >= 1 and v == "1.7"
+    # idempotent on already-hashed output
+    assert feature_hashing(out) == out
+    # -features bounds the space
+    small = feature_hashing(["a", "b", "c"], "-features 8")
+    assert all(1 <= int(s) <= 8 for s in small)
+
+
+def test_scaling():
+    assert rescale(5, 0, 10) == 0.5
+    assert rescale(3, 3, 3) == 0.5
+    assert zscore(12, 10, 2) == 1.0
+    l1 = l1_normalize(["a:1", "b:3"])
+    assert l1 == ["a:0.25", "b:0.75"]
+    l2 = l2_normalize(["a:3", "b:4"])
+    assert [extract_weight(f) for f in l2] == [0.6, 0.8]
+
+
+def test_conv():
+    dense = to_dense_features(["1:0.5", "3:2.0"], 4)
+    assert dense == [0.0, 0.5, 0.0, 2.0, 0.0]
+    assert to_sparse_features(dense) == ["1:0.5", "3:2.0"]
+    q = quantify()
+    assert q(["a", 5]) == [0, 5]
+    assert q(["b", 6]) == [1, 6]
+    assert q(["a", 7]) == [0, 7]
+    assert q.mapping(0) == {"a": 0, "b": 1}
+
+
+def test_pairing():
+    out = polynomial_features(["a:2", "b:3"], "-degree 2")
+    assert "a^b:6.0" in out
+    assert "a^a:4.0" in out
+    io = polynomial_features(["a:2", "b:3"], "-degree 2 -interaction_only")
+    assert "a^a:4.0" not in io and "a^b:6.0" in io
+    pw = powered_features(["a:2"], 3)
+    assert "a^2:4.0" in pw and "a^3:8.0" in pw
+
+
+def test_trans():
+    assert categorical_features(["c1", "c2"], "x", None) == ["c1#x"]
+    assert quantitative_features(["q1"], 2) == ["q1:2.0"]
+    assert vectorize_features(["a", "b"], "x", 3) == ["a#x", "b:3.0"]
+    assert indexed_features(5, 7) == ["1:5.0", "2:7.0"]
+    rows = list(__import__("hivemall_tpu.ftvec.trans", fromlist=["binarize_label"]
+                           ).binarize_label(2, 1, "payload"))
+    assert rows == [("payload", 1), ("payload", 1), ("payload", 0)]
+    enc = onehot_encoding([["b", "a"], ["x"]])
+    assert enc[0] == {"a": 1, "b": 2} and enc[1] == {"x": 3}
+
+
+def test_ffm_features():
+    out = ffm_features(["user", "movie", "age"], "john", "m1", 25)
+    assert len(out) == 3
+    f0 = out[0].split(":")
+    assert f0[0] == "0" and f0[2] == "1"      # categorical -> value 1
+    f2 = out[2].split(":")
+    assert f2[0] == "2" and float(f2[2]) == 25.0
+
+
+def test_chi2_discriminates():
+    # feature 0 differs strongly across classes; feature 1 matches expectation
+    obs = np.asarray([[30.0, 10.0], [10.0, 10.0]])
+    exp = np.asarray([[20.0, 10.0], [20.0, 10.0]])
+    stat, p = chi2(obs, exp)
+    assert stat[0] > stat[1]
+    assert p[0] < 0.05 < p[1]
+
+
+def test_binning():
+    edges = build_bins(list(range(100)), 4)
+    assert edges[0] == -np.inf and edges[-1] == np.inf
+    assert len(edges) == 5
+    assert feature_binning(-5, edges) == 0
+    assert feature_binning(99, edges) == 3
+    assert feature_binning(50, edges) in (1, 2)
