@@ -161,6 +161,52 @@ for _name, _target, _ref, _desc, _kind in [
     register(_name, _kind, f"hivemall_tpu.ftvec.{_target}",
              description=_desc, reference=_ref)
 
+# --- matrix factorization / recommendation (SURVEY.md §3.7) ----------------
+
+
+def _mf(name, cls_path, ref, desc):
+    from importlib import import_module
+    mod, _, attr = cls_path.partition(":")
+    cls = getattr(import_module(mod), attr)
+    register(name, "UDTF", cls_path, description=desc, reference=ref,
+             options=cls.spec())
+
+
+_mf("train_mf_sgd", "hivemall_tpu.models.mf:MFTrainer",
+    "hivemall.mf.MatrixFactorizationSGDUDTF",
+    "biased MF (Funk/Koren) by SGD over (user,item,rating) stream")
+_mf("train_mf_adagrad", "hivemall_tpu.models.mf:MFAdaGradTrainer",
+    "hivemall.mf.MatrixFactorizationAdaGradUDTF",
+    "biased MF with AdaGrad")
+_mf("train_bprmf", "hivemall_tpu.models.mf:BPRMFTrainer",
+    "hivemall.mf.BPRMatrixFactorizationUDTF",
+    "Bayesian Personalized Ranking MF on (user,pos,neg) triples")
+register("mf_predict", "UDF", "hivemall_tpu.models.mf:mf_predict",
+         description="mu + bu + bi + Pu.Qi from joined factor rows",
+         reference="hivemall.mf.MFPredictUDF")
+register("bprmf_predict", "UDF", "hivemall_tpu.models.mf:bprmf_predict",
+         description="Pu.Qi + bi from joined factor rows",
+         reference="hivemall.mf.BPRMFPredictUDF")
+_mf("train_slim", "hivemall_tpu.models.slim:SlimTrainer",
+    "hivemall.recommend.SlimUDTF",
+    "sparse linear item-item recommender by all-columns coordinate descent")
+register("bpr_sampling", "UDTF", "hivemall_tpu.ftvec.ranking:bpr_sampling",
+         description="(user,pos,neg) negative-sampling triples",
+         reference="hivemall.ftvec.ranking.BprSamplingUDTF")
+register("item_pairs_sampling", "UDTF",
+         "hivemall_tpu.ftvec.ranking:item_pairs_sampling",
+         description="(pos,neg) item pair sampling",
+         reference="hivemall.ftvec.ranking.ItemPairsSamplingUDTF")
+register("populate_not_in", "UDTF",
+         "hivemall_tpu.ftvec.ranking:populate_not_in",
+         description="emit ids in [0,max] not in the given list",
+         reference="hivemall.ftvec.ranking.PopulateNotInUDTF")
+
+# --- embeddings (SURVEY.md §3.8) -------------------------------------------
+_mf("train_word2vec", "hivemall_tpu.models.word2vec:Word2VecTrainer",
+    "hivemall.embedding.Word2VecUDTF",
+    "SkipGram/CBOW negative-sampling word embeddings")
+
 # --- ensemble / model averaging (SURVEY.md §3.17) --------------------------
 register("voted_avg", "UDAF", "hivemall_tpu.parallel.averaging:voted_avg",
          description="majority-sign-side mean of replica weights",

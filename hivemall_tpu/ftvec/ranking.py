@@ -1,0 +1,56 @@
+"""ftvec.ranking — negative-sampling UDTFs for implicit-feedback training
+(SURVEY.md §3.7 last row).
+
+Reference: hivemall.ftvec.ranking.{BprSamplingUDTF,ItemPairsSamplingUDTF,
+PopulateNotInUDTF}: generate (user, pos, neg) / (pos, neg) training pairs
+from positive-only interaction lists.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["bpr_sampling", "item_pairs_sampling", "populate_not_in"]
+
+
+def bpr_sampling(user: int, pos_items: Sequence[int], max_item_id: int,
+                 sampling_rate: float = 1.0, seed: int | None = None
+                 ) -> Iterator[Tuple[int, int, int]]:
+    """SQL: bpr_sampling(user, pos_items, max_item_id[, rate]) — emit
+    (user, pos, neg) triples, neg uniform over items not in pos_items;
+    about rate * |pos| triples per user."""
+    pos = set(int(p) for p in pos_items)
+    if not pos or max_item_id <= len(pos) - 1:
+        return
+    rng = np.random.default_rng(seed)
+    n_emit = max(1, int(round(len(pos) * sampling_rate)))
+    pos_arr = np.fromiter(pos, np.int64)
+    for _ in range(n_emit):
+        p = int(pos_arr[rng.integers(len(pos_arr))])
+        while True:
+            n = int(rng.integers(0, max_item_id + 1))
+            if n not in pos:
+                break
+        yield (int(user), p, n)
+
+
+def item_pairs_sampling(pos_items: Sequence[int], max_item_id: int,
+                        sampling_rate: float = 1.0, seed: int | None = None
+                        ) -> Iterator[Tuple[int, int]]:
+    """SQL: item_pairs_sampling(pos_items, max_item_id[, rate]) — emit
+    (pos_item, neg_item) pairs."""
+    for _, p, n in bpr_sampling(0, pos_items, max_item_id, sampling_rate,
+                                seed):
+        yield (p, n)
+
+
+def populate_not_in(items: Sequence[int], max_item_id: int
+                    ) -> Iterator[int]:
+    """SQL: populate_not_in(items, max_item_id) — emit every id in
+    [0, max_item_id] not present in items."""
+    have = set(int(i) for i in items)
+    for i in range(max_item_id + 1):
+        if i not in have:
+            yield i

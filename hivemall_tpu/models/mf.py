@@ -1,0 +1,304 @@
+"""Matrix factorization — train_mf_sgd / train_mf_adagrad / train_bprmf
+(BASELINE config #3).
+
+Reference (SURVEY.md §3.7): hivemall.mf.OnlineMatrixFactorizationUDTF (base:
+streaming (user, item, rating) SGD over rank-k P/Q tables with biases and
+global mean -mu), MatrixFactorizationSGDUDTF / MatrixFactorizationAdaGradUDTF,
+BPRMatrixFactorizationUDTF (implicit feedback (u, pos, neg) ranking), and the
+MFPredictUDF / BPRMFPredictUDF scorers.
+
+TPU shape: P[U,K], Q[I,K], b_u[U], b_i[I] dense tables in HBM; one jitted
+value_and_grad step per (user, item, rating) minibatch; within-batch duplicate
+ids accumulate via scatter-add (gradient accumulation of the reference's
+sequential per-row updates).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.options import OptionSpec, Parsed
+
+__all__ = ["MFTrainer", "MFAdaGradTrainer", "BPRMFTrainer", "mf_predict",
+           "bprmf_predict"]
+
+
+def _mf_spec(name: str) -> OptionSpec:
+    s = OptionSpec(name)
+    s.add("factors", "factor", type=int, default=10, help="rank k")
+    s.add("mu", "mean_rating", type=float, default=0.0, help="global mean")
+    s.add("eta0", "eta", type=float, default=0.01, help="learning rate")
+    s.add("lambda", type=float, default=0.03, help="L2 regularization")
+    s.add("iters", "iterations", type=int, default=1, help="epochs")
+    s.add("mini_batch", type=int, default=1024, help="minibatch size")
+    s.add("users", "max_users", type=int, default=1 << 20,
+          help="user table size")
+    s.add("items", "max_items", type=int, default=1 << 20,
+          help="item table size")
+    s.add("sigma", type=float, default=0.1, help="factor init stddev")
+    s.add("seed", type=int, default=31, help="init seed")
+    s.flag("disable_bias", help="drop user/item bias terms")
+    s.flag("halffloat", help="bf16 factor tables")
+    return s
+
+
+class MFTrainer:
+    """SQL: train_mf_sgd — reference hivemall.mf.MatrixFactorizationSGDUDTF."""
+
+    NAME = "train_mf_sgd"
+    ADAGRAD = False
+
+    @classmethod
+    def spec(cls) -> OptionSpec:
+        return _mf_spec(cls.NAME)
+
+    def __init__(self, options: str = ""):
+        self.opts: Parsed = self.spec().parse(options)
+        o = self.opts
+        self.k = int(o.factors)
+        # bracket access: "items" would hit dict.items on the Parsed namespace
+        self.U, self.I = int(o["users"]), int(o["items"])
+        dtype = jnp.bfloat16 if o.halffloat else jnp.float32
+        key = jax.random.PRNGKey(int(o.seed))
+        k1, k2 = jax.random.split(key)
+        sig = float(o.sigma)
+        self.params = {
+            "P": (jax.random.normal(k1, (self.U, self.k)) * sig).astype(dtype),
+            "Q": (jax.random.normal(k2, (self.I, self.k)) * sig).astype(dtype),
+            "bu": jnp.zeros(self.U, jnp.float32),
+            "bi": jnp.zeros(self.I, jnp.float32),
+        }
+        self.gg = ({k: jnp.zeros(v.shape, jnp.float32)
+                    for k, v in self.params.items()} if self.ADAGRAD else None)
+        self._step = self._make_step()
+        self._t = 0
+        self._buf: List[Tuple[int, int, float]] = []
+        self._all: List[Tuple[int, int, float]] = []
+        self.cum_loss = 0.0
+        self.n_seen = 0
+
+    def _make_step(self):
+        o = self.opts
+        lam = float(o["lambda"])
+        eta0 = float(o.eta0)
+        mu = float(o.mu)
+        use_bias = not o.disable_bias
+        adagrad = self.ADAGRAD
+
+        @jax.jit
+        def step(params, gg, t, u, i, r, mask):
+            def batch_loss(p):
+                pu = p["P"][u].astype(jnp.float32)        # [B, K]
+                qi = p["Q"][i].astype(jnp.float32)
+                pred = mu + (pu * qi).sum(-1)
+                if use_bias:
+                    pred = pred + p["bu"][u] + p["bi"][i]
+                err = (r - pred) * mask
+                reg = lam * ((pu * pu).sum() + (qi * qi).sum()
+                             + ((p["bu"][u] ** 2).sum()
+                                + (p["bi"][i] ** 2).sum() if use_bias else 0.0))
+                return 0.5 * (err * err).sum() + reg
+
+            loss, grads = jax.value_and_grad(batch_loss)(params)
+            new_p, new_gg = {}, {}
+            for k in params:
+                g = grads[k].astype(jnp.float32)
+                if adagrad:
+                    g2 = gg[k] + g * g
+                    upd = eta0 * g / (jnp.sqrt(g2) + 1e-6)
+                    new_gg[k] = g2
+                else:
+                    upd = eta0 * g
+                new_p[k] = (params[k].astype(jnp.float32) - upd
+                            ).astype(params[k].dtype)
+            return new_p, (new_gg if adagrad else gg), loss
+
+        return step
+
+    # -- UDTF lifecycle ------------------------------------------------------
+    def process(self, user: int, item: int, rating: float) -> None:
+        self._buf.append((int(user), int(item), float(rating)))
+        if len(self._buf) >= int(self.opts.mini_batch):
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buf:
+            return
+        chunk = self._buf
+        self._buf = []
+        if int(self.opts.iters) > 1:
+            self._all.extend(chunk)
+        self._dispatch(chunk)
+
+    def _dispatch(self, chunk: List[Tuple[int, int, float]]) -> None:
+        B = int(self.opts.mini_batch)
+        u = np.zeros(B, np.int32)
+        i = np.zeros(B, np.int32)
+        r = np.zeros(B, np.float32)
+        m = np.zeros(B, np.float32)
+        n = len(chunk)
+        u[:n] = [c[0] for c in chunk]
+        i[:n] = [c[1] for c in chunk]
+        r[:n] = [c[2] for c in chunk]
+        m[:n] = 1.0
+        self.params, self.gg, loss = self._step(
+            self.params, self.gg, float(self._t), u, i, r, m)
+        self._t += 1
+        self.cum_loss += float(loss)
+        self.n_seen += n
+
+    def close(self) -> Iterator[Tuple]:
+        self._flush()
+        iters = int(self.opts.iters)
+        if iters > 1 and self._all:
+            rng = np.random.default_rng(42)
+            bs = int(self.opts.mini_batch)
+            for ep in range(1, iters):
+                order = rng.permutation(len(self._all))
+                for s in range(0, len(order), bs):
+                    self._dispatch([self._all[j] for j in order[s:s + bs]])
+        yield from self.model_rows()
+
+    def fit(self, users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
+            *, epochs: Optional[int] = None, shuffle: bool = True
+            ) -> "MFTrainer":
+        epochs = int(self.opts.iters) if epochs is None else epochs
+        bs = int(self.opts.mini_batch)
+        n = len(users)
+        rng = np.random.default_rng(42)
+        for ep in range(epochs):
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            for s in range(0, n, bs):
+                take = order[s:s + bs]
+                self._dispatch(list(zip(users[take], items[take],
+                                        ratings[take])))
+        return self
+
+    # -- scoring / emission --------------------------------------------------
+    def predict(self, users, items) -> np.ndarray:
+        p = self.params
+        u = np.asarray(users, np.int32)
+        i = np.asarray(items, np.int32)
+        pu = np.asarray(p["P"].astype(jnp.float32))[u]
+        qi = np.asarray(p["Q"].astype(jnp.float32))[i]
+        out = float(self.opts.mu) + (pu * qi).sum(-1)
+        if not self.opts.disable_bias:
+            out = out + np.asarray(p["bu"])[u] + np.asarray(p["bi"])[i]
+        return out.astype(np.float32)
+
+    def model_rows(self) -> Iterator[Tuple]:
+        """(idx, Pu|None, Qi|None, bu, bi) rows, users then items, only
+        touched ids (nonzero factors)."""
+        P = np.asarray(self.params["P"].astype(jnp.float32))
+        Q = np.asarray(self.params["Q"].astype(jnp.float32))
+        bu = np.asarray(self.params["bu"])
+        bi = np.asarray(self.params["bi"])
+        for uid in np.nonzero(np.abs(P).sum(-1) > 0)[0]:
+            yield (int(uid), P[uid].tolist(), None, float(bu[uid]), None)
+        for iid in np.nonzero(np.abs(Q).sum(-1) > 0)[0]:
+            yield (int(iid), None, Q[iid].tolist(), None, float(bi[iid]))
+
+
+class MFAdaGradTrainer(MFTrainer):
+    """SQL: train_mf_adagrad — reference hivemall.mf.MatrixFactorizationAdaGradUDTF."""
+    NAME = "train_mf_adagrad"
+    ADAGRAD = True
+
+
+class BPRMFTrainer(MFTrainer):
+    """SQL: train_bprmf — reference hivemall.mf.BPRMatrixFactorizationUDTF.
+
+    Implicit feedback: rows are (user, pos_item, neg_item); loss is
+    -log sigmoid(x_upos - x_uneg) with x_ui = p_u.q_i + b_i (item bias only).
+    """
+    NAME = "train_bprmf"
+    ADAGRAD = False
+
+    def _make_step(self):
+        o = self.opts
+        lam = float(o["lambda"])
+        eta0 = float(o.eta0)
+
+        @jax.jit
+        def step(params, gg, t, u, i, j, mask):
+            def batch_loss(p):
+                pu = p["P"][u].astype(jnp.float32)
+                qi = p["Q"][i].astype(jnp.float32)
+                qj = p["Q"][j].astype(jnp.float32)
+                x = ((pu * (qi - qj)).sum(-1)
+                     + p["bi"][i] - p["bi"][j])
+                nll = jax.nn.softplus(-x) * mask
+                reg = lam * ((pu * pu).sum() + (qi * qi).sum()
+                             + (qj * qj).sum()
+                             + (p["bi"][i] ** 2).sum()
+                             + (p["bi"][j] ** 2).sum())
+                return nll.sum() + reg
+
+            loss, grads = jax.value_and_grad(batch_loss)(params)
+            new_p = {k: (params[k].astype(jnp.float32)
+                         - eta0 * grads[k].astype(jnp.float32)
+                         ).astype(params[k].dtype) for k in params}
+            return new_p, gg, loss
+
+        return step
+
+    def process(self, user: int, pos_item: int, neg_item: int) -> None:
+        # third slot carries the negative item id (int), not a rating
+        super().process(user, pos_item, float(neg_item))
+
+    def _dispatch(self, chunk) -> None:
+        B = int(self.opts.mini_batch)
+        u = np.zeros(B, np.int32)
+        i = np.zeros(B, np.int32)
+        j = np.zeros(B, np.int32)
+        m = np.zeros(B, np.float32)
+        n = len(chunk)
+        u[:n] = [c[0] for c in chunk]
+        i[:n] = [c[1] for c in chunk]
+        j[:n] = [int(c[2]) for c in chunk]
+        m[:n] = 1.0
+        self.params, self.gg, loss = self._step(
+            self.params, self.gg, float(self._t), u, i, j, m)
+        self._t += 1
+        self.cum_loss += float(loss)
+        self.n_seen += n
+
+    def predict(self, users, items) -> np.ndarray:
+        p = self.params
+        u = np.asarray(users, np.int32)
+        i = np.asarray(items, np.int32)
+        pu = np.asarray(p["P"].astype(jnp.float32))[u]
+        qi = np.asarray(p["Q"].astype(jnp.float32))[i]
+        return ((pu * qi).sum(-1) + np.asarray(p["bi"])[i]).astype(np.float32)
+
+
+# --- predict UDFs (join-side reassembly, SURVEY.md §3.7 row 5) -------------
+
+def mf_predict(pu: Optional[List[float]], qi: Optional[List[float]],
+               bu: Optional[float] = None, bi: Optional[float] = None,
+               mu: float = 0.0) -> float:
+    """SQL: mf_predict(Pu, Qi, Bu, Bi, mu) — reference hivemall.mf.MFPredictUDF.
+    Missing user/item rows fall back to the known parts (cold start)."""
+    out = float(mu)
+    if bu is not None:
+        out += float(bu)
+    if bi is not None:
+        out += float(bi)
+    if pu is not None and qi is not None:
+        out += float(np.dot(np.asarray(pu, np.float64),
+                            np.asarray(qi, np.float64)))
+    return out
+
+
+def bprmf_predict(pu: Optional[List[float]], qi: Optional[List[float]],
+                  bi: Optional[float] = None) -> float:
+    """SQL: bprmf_predict — reference hivemall.mf.BPRMFPredictUDF."""
+    out = 0.0 if bi is None else float(bi)
+    if pu is not None and qi is not None:
+        out += float(np.dot(np.asarray(pu, np.float64),
+                            np.asarray(qi, np.float64)))
+    return out

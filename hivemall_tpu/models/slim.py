@@ -1,0 +1,109 @@
+"""train_slim — sparse linear item-item recommender (SURVEY.md §3.7 row 7).
+
+Reference: hivemall.recommend.SlimUDTF (v0.5-era): learn W[I, I] (diag 0,
+commonly restricted to each item's top-k nearest neighbors) minimizing
+  0.5 ||R[:, i] - R_-i W[:, i]||^2 + 0.5 l2 ||W||^2 + l1 ||W||_1
+by coordinate descent with soft-thresholding.
+
+TPU shape: the per-coordinate residual updates are sequential by nature, but
+all ITEMS are independent — so the rebuild runs CD jointly for every item
+column at once: each sweep updates coordinate j of all columns i via one
+[U, I] matmul-like residual pass (vmapped soft-threshold), keeping the MXU
+busy instead of looping scalar cells.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.options import OptionSpec
+
+__all__ = ["SlimTrainer", "train_slim"]
+
+SLIM_SPEC = (OptionSpec("train_slim")
+             .add("l1", type=float, default=0.001, help="L1 strength")
+             .add("l2", type=float, default=0.0005, help="L2 strength")
+             .add("iters", "iterations", type=int, default=30,
+                  help="CD sweeps")
+             .add("knn", type=int, default=0,
+                  help="restrict W to top-k co-rated neighbors (0 = all)"))
+
+
+def train_slim(R: np.ndarray, options: str = "") -> np.ndarray:
+    """Fit W from a dense user-item matrix R[U, I]; returns W[I, I], diag 0.
+
+    Rating prediction: R_hat = R @ W (column i uses every other item)."""
+    ns = SLIM_SPEC.parse(options)
+    R = jnp.asarray(R, jnp.float32)
+    U, I = R.shape
+    l1, l2 = float(ns.l1), float(ns.l2)
+    col_sq = (R * R).sum(0)                      # [I] Gram diagonal
+
+    if ns.knn:
+        sim = np.asarray(R.T @ R)
+        np.fill_diagonal(sim, -np.inf)
+        k = min(int(ns.knn), I - 1)
+        keep = np.zeros((I, I), np.float32)
+        top = np.argpartition(-sim, k - 1, axis=1)[:, :k]
+        np.put_along_axis(keep, top, 1.0, axis=1)
+        allow = jnp.asarray(keep.T)              # allow[j, i]: j may explain i
+    else:
+        allow = jnp.ones((I, I), jnp.float32)
+    allow = allow * (1.0 - jnp.eye(I))           # never self-explain
+
+    def sweep(W, _):
+        def update_coord(j, W):
+            # residual excluding j's current contribution, for ALL columns i
+            pred = R @ W                          # [U, I]
+            rj = R[:, j]                          # [U]
+            resid = R - pred + jnp.outer(rj, W[j])
+            rho = rj @ resid                      # [I] correlation with resid
+            wj = jnp.sign(rho) * jnp.maximum(
+                jnp.abs(rho) - l1, 0.0) / (col_sq[j] + l2 + 1e-12)
+            wj = wj * allow[j]
+            return W.at[j].set(wj)
+
+        W = jax.lax.fori_loop(0, I, update_coord, W)
+        return W, None
+
+    W0 = jnp.zeros((I, I), jnp.float32)
+    W, _ = jax.lax.scan(sweep, W0, None, length=int(ns.iters))
+    return np.asarray(W)
+
+
+class SlimTrainer:
+    """UDTF-style wrapper: process(user, item, rating) rows, close() emits
+    (item_j, item_i, w_ji) rows for nonzero coefficients."""
+
+    NAME = "train_slim"
+
+    @classmethod
+    def spec(cls) -> OptionSpec:
+        return SLIM_SPEC
+
+    def __init__(self, options: str = ""):
+        self.options = options
+        self._rows = []
+
+    def process(self, user: int, item: int, rating: float) -> None:
+        self._rows.append((int(user), int(item), float(rating)))
+
+    def close(self) -> Iterator[Tuple[int, int, float]]:
+        if not self._rows:
+            return
+        users = sorted({r[0] for r in self._rows})
+        items = sorted({r[1] for r in self._rows})
+        umap = {u: k for k, u in enumerate(users)}
+        imap = {i: k for k, i in enumerate(items)}
+        R = np.zeros((len(users), len(items)), np.float32)
+        for u, i, r in self._rows:
+            R[umap[u], imap[i]] = r
+        W = train_slim(R, self.options)
+        for j in range(len(items)):
+            for i in range(len(items)):
+                if W[j, i] != 0.0:
+                    yield (items[j], items[i], float(W[j, i]))

@@ -1,0 +1,104 @@
+"""MF/BPR/SLIM/samplers: convergence + semantics (SURVEY.md §5 style)."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.models.mf import (BPRMFTrainer, MFAdaGradTrainer, MFTrainer,
+                                    bprmf_predict, mf_predict)
+
+
+def synthetic_ratings(U=50, I=40, K=3, n=3000, seed=0):
+    rng = np.random.default_rng(seed)
+    P = rng.normal(0, 1, (U, K))
+    Q = rng.normal(0, 1, (I, K))
+    users = rng.integers(0, U, n)
+    items = rng.integers(0, I, n)
+    ratings = (P[users] * Q[items]).sum(-1) + rng.normal(0, 0.1, n)
+    return users, items, ratings.astype(np.float32)
+
+
+def test_mf_sgd_fits():
+    users, items, ratings = synthetic_ratings()
+    t = MFTrainer("-factors 3 -eta0 0.05 -lambda 0.001 -iters 30 "
+                  "-users 64 -items 64 -mini_batch 256 -sigma 0.3")
+    t.fit(users, items, ratings)
+    pred = t.predict(users, items)
+    rmse = float(np.sqrt(np.mean((pred - ratings) ** 2)))
+    assert rmse < 0.6, rmse
+
+
+def test_mf_adagrad_fits():
+    users, items, ratings = synthetic_ratings(seed=2)
+    t = MFAdaGradTrainer("-factors 3 -eta0 0.3 -lambda 0.001 -iters 25 "
+                         "-users 64 -items 64 -mini_batch 256 -sigma 0.3")
+    t.fit(users, items, ratings)
+    rmse = float(np.sqrt(np.mean((t.predict(users, items) - ratings) ** 2)))
+    assert rmse < 0.6, rmse
+
+
+def test_mf_udtf_lifecycle_and_rows():
+    t = MFTrainer("-factors 2 -users 8 -items 8 -mini_batch 4 -eta0 0.1")
+    for _ in range(5):
+        t.process(1, 2, 4.0)
+        t.process(0, 3, 1.0)
+    rows = list(t.close())
+    # user rows carry Pu (slot 1), item rows carry Qi (slot 2)
+    assert any(r[1] is not None and r[0] == 1 for r in rows)
+    assert any(r[2] is not None and r[0] == 2 for r in rows)
+
+
+def test_bprmf_ranks_pos_above_neg():
+    rng = np.random.default_rng(1)
+    U, I = 20, 30
+    # users prefer even items
+    t = BPRMFTrainer("-factors 4 -eta0 0.05 -lambda 0.001 -users 32 "
+                     "-items 32 -mini_batch 128 -iters 3 -sigma 0.2")
+    for _ in range(4000):
+        u = int(rng.integers(0, U))
+        pos = int(rng.integers(0, I // 2)) * 2
+        neg = int(rng.integers(0, I // 2)) * 2 + 1
+        t.process(u, pos, neg)
+    list(t.close())
+    users = np.repeat(np.arange(U), I // 2)
+    even = t.predict(users, np.tile(np.arange(0, I, 2), U))
+    odd = t.predict(users, np.tile(np.arange(1, I, 2), U))
+    assert (even > odd).mean() > 0.9
+
+
+def test_predict_udfs_cold_start():
+    assert mf_predict([1.0, 2.0], [3.0, 4.0], 0.5, 0.25, 3.0) == \
+        pytest.approx(3.0 + 0.5 + 0.25 + 11.0)
+    assert mf_predict(None, [1.0], None, 0.5, 3.0) == pytest.approx(3.5)
+    assert bprmf_predict([1.0], [2.0], 0.5) == pytest.approx(2.5)
+    assert bprmf_predict(None, None, None) == 0.0
+
+
+def test_slim_recovers_structure():
+    from hivemall_tpu.models.slim import SlimTrainer
+    rng = np.random.default_rng(3)
+    # item 1 == copy of item 0; item 2 independent
+    U = 40
+    base = rng.uniform(1, 5, U)
+    t = SlimTrainer("-l1 0.01 -l2 0.01 -iters 20")
+    for u in range(U):
+        t.process(u, 0, float(base[u]))
+        t.process(u, 1, float(base[u]))
+        t.process(u, 2, float(rng.uniform(1, 5)))
+    W = {(j, i): w for j, i, w in t.close()}
+    # W[0 -> 1] strong (item 0 explains item 1), both >> any weight into 2
+    assert W.get((0, 1), 0.0) > 0.5
+    assert W.get((0, 1), 0.0) > abs(W.get((0, 2), 0.0))
+    assert (0, 0) not in W     # diag forced to zero
+
+
+def test_samplers():
+    from hivemall_tpu.ftvec.ranking import (bpr_sampling, item_pairs_sampling,
+                                            populate_not_in)
+    trips = list(bpr_sampling(7, [1, 2, 3], 10, 2.0, seed=0))
+    assert len(trips) == 6
+    for u, p, n in trips:
+        assert u == 7 and p in (1, 2, 3) and n not in (1, 2, 3)
+        assert 0 <= n <= 10
+    pairs = list(item_pairs_sampling([4], 6, 3.0, seed=1))
+    assert all(p == 4 and q != 4 for p, q in pairs)
+    assert list(populate_not_in([0, 2], 4)) == [1, 3, 4]

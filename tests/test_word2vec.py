@@ -1,0 +1,57 @@
+"""word2vec: embeddings place co-occurring words together (convergence-smoke,
+SURVEY.md §5 style — structure, not exact numbers)."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.models.word2vec import Word2VecTrainer
+
+
+def synthetic_corpus(n_docs=400, seed=0):
+    """Two topic clusters; words within a cluster co-occur."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "cow"]
+    tech = ["cpu", "gpu", "ram", "disk"]
+    docs = []
+    for _ in range(n_docs):
+        group = animals if rng.random() < 0.5 else tech
+        docs.append([group[rng.integers(len(group))] for _ in range(12)])
+    return docs
+
+
+@pytest.mark.parametrize("mode", ["skipgram", "cbow"])
+def test_clusters_separate(mode):
+    docs = synthetic_corpus()
+    if mode == "cbow":
+        # CBOW emits ~2w-fold fewer training pairs per corpus pass than
+        # SkipGram, so it needs more epochs / a hotter lr to separate
+        opts = ("-dim 16 -window 3 -neg 4 -min_count 2 -alpha 1.0 "
+                "-mini_batch 512 -iters 12 -sample 0 -cbow")
+    else:
+        opts = ("-dim 16 -window 3 -neg 4 -min_count 2 -alpha 0.5 "
+                "-mini_batch 512 -iters 8 -sample 0")
+    t = Word2VecTrainer(opts).train(docs)
+    same = t.similarity("cat", "dog")
+    cross = t.similarity("cat", "gpu")
+    assert same > cross + 0.2, (same, cross)
+
+
+def test_udtf_lifecycle_and_vocab():
+    t = Word2VecTrainer("-dim 8 -min_count 1 -mini_batch 64 -iters 1")
+    for doc in synthetic_corpus(50):
+        t.process(doc)
+    rows = dict(t.close())
+    assert "cat" in rows and len(rows["cat"]) == 8
+
+
+def test_min_count_filters():
+    t = Word2VecTrainer("-dim 4 -min_count 5 -mini_batch 32")
+    docs = [["rare"], ["common"] * 10]
+    t.train(docs)
+    assert "rare" not in t.vocab and "common" in t.vocab
+
+
+def test_empty_vocab_raises():
+    t = Word2VecTrainer("-dim 4 -min_count 100")
+    with pytest.raises(ValueError):
+        t.train([["a", "b"]])
