@@ -74,6 +74,103 @@ for _name, _fn, _ref, _desc in [
     register(_name, "UDAF", f"hivemall_tpu.frame.evaluation:{_fn}",
              description=_desc, reference=_ref)
 
+# --- online classifier family (SURVEY.md §3.3) -----------------------------
+for _n, _cls, _ref, _d in [
+    ("train_perceptron", "PerceptronTrainer", "PerceptronUDTF",
+     "classic mistake-driven perceptron"),
+    ("train_pa", "PassiveAggressiveTrainer", "PassiveAggressiveUDTF",
+     "passive-aggressive PA-0"),
+    ("train_pa1", "PA1Trainer", "PassiveAggressiveUDTF$PA1",
+     "PA-1 (C-capped)"),
+    ("train_pa2", "PA2Trainer", "PassiveAggressiveUDTF$PA2",
+     "PA-2 (soft denominator)"),
+    ("train_cw", "ConfidenceWeightedTrainer", "ConfidenceWeightedUDTF",
+     "confidence-weighted (diagonal Gaussian weights)"),
+    ("train_arow", "AROWTrainer", "AROWClassifierUDTF",
+     "adaptive regularization of weight vectors"),
+    ("train_arowh", "AROWhTrainer", "AROWClassifierUDTF$AROWh",
+     "AROW hinge variant"),
+    ("train_scw", "SCW1Trainer", "SoftConfideceWeightedUDTF$SCW1",
+     "soft confidence-weighted I"),
+    ("train_scw2", "SCW2Trainer", "SoftConfideceWeightedUDTF$SCW2",
+     "soft confidence-weighted II"),
+    ("train_adagrad_rda", "AdaGradRDATrainer", "AdaGradRDAUDTF",
+     "AdaGrad + L1 RDA (sparse)"),
+    ("train_kpa", "KernelizedPATrainer",
+     "KernelExpansionPassiveAggressiveUDTF",
+     "polynomial-kernel-expansion PA"),
+]:
+    _learner(_n, f"hivemall_tpu.models.classifier:{_cls}",
+             f"hivemall.classifier.{_ref}", _d)
+
+# --- multiclass (SURVEY.md §3.4) -------------------------------------------
+for _n, _cls in [
+    ("train_multiclass_perceptron", "MulticlassPerceptronTrainer"),
+    ("train_multiclass_pa", "MulticlassPATrainer"),
+    ("train_multiclass_pa1", "MulticlassPA1Trainer"),
+    ("train_multiclass_pa2", "MulticlassPA2Trainer"),
+    ("train_multiclass_cw", "MulticlassCWTrainer"),
+    ("train_multiclass_arow", "MulticlassAROWTrainer"),
+    ("train_multiclass_scw", "MulticlassSCWTrainer"),
+    ("train_multiclass_scw2", "MulticlassSCW2Trainer"),
+]:
+    _learner(_n, f"hivemall_tpu.models.multiclass:{_cls}",
+             f"hivemall.classifier.multiclass.{_cls.replace('Trainer', 'UDTF')}",
+             "multiclass " + _n.split('_', 2)[2])
+
+# --- regression variants (SURVEY.md §3.5) ----------------------------------
+for _n, _cls, _ref in [
+    ("train_pa1_regr", "PARegressionTrainer",
+     "PassiveAggressiveRegressionUDTF"),
+    ("train_pa1a_regr", "PA1aRegressionTrainer",
+     "PassiveAggressiveRegressionUDTF$PA1a"),
+    ("train_pa2_regr", "PA2RegressionTrainer",
+     "PassiveAggressiveRegressionUDTF$PA2"),
+    ("train_pa2a_regr", "PA2aRegressionTrainer",
+     "PassiveAggressiveRegressionUDTF$PA2a"),
+    ("train_arow_regr", "AROWRegressionTrainer", "AROWRegressionUDTF"),
+    ("train_arowe_regr", "AROWeRegressionTrainer",
+     "AROWRegressionUDTF$AROWe"),
+    ("train_arowe2_regr", "AROWe2RegressionTrainer",
+     "AROWRegressionUDTF$AROWe2"),
+]:
+    _learner(_n, f"hivemall_tpu.models.classifier:{_cls}",
+             f"hivemall.regression.{_ref}", "epsilon-insensitive " + _n)
+
+# --- trees / ensembles (SURVEY.md §3.9) ------------------------------------
+for _n, _cls, _ref, _d in [
+    ("train_randomforest_classifier", "RandomForestClassifier",
+     "hivemall.smile.classification.RandomForestClassifierUDTF",
+     "bootstrap Gini forest via level-wise histogram kernels"),
+    ("train_randomforest_regressor", "RandomForestRegressor",
+     "hivemall.smile.regression.RandomForestRegressionUDTF",
+     "bootstrap variance forest"),
+    ("train_xgboost_classifier", "XGBoostClassifier",
+     "hivemall.xgboost.classification.XGBoostBinaryLogisticUDTF",
+     "histogram GBDT, binary logistic (native-libxgboost parity)"),
+    ("train_xgboost_regr", "XGBoostRegressor",
+     "hivemall.xgboost.regression.XGBoostRegressionUDTF",
+     "histogram GBDT, squared error"),
+    ("train_multiclass_xgboost_classifier", "XGBoostMulticlassClassifier",
+     "hivemall.xgboost.classification.XGBoostMulticlassSoftmaxUDTF",
+     "histogram GBDT, softmax"),
+]:
+    _learner(_n, f"hivemall_tpu.models.trees:{_cls}", _ref, _d)
+register("tree_predict", "UDF", "hivemall_tpu.models.trees:tree_predict",
+         description="evaluate a serialized tree (gather-walk VM)",
+         reference="hivemall.smile.tools.TreePredictUDF")
+register("rf_ensemble", "UDAF", "hivemall_tpu.models.trees:rf_ensemble",
+         description="majority vote over per-tree predictions",
+         reference="hivemall.smile.tools.RandomForestEnsembleUDAF")
+register("guess_attribute_types", "UDF",
+         "hivemall_tpu.models.trees:guess_attribute_types",
+         description="emit Q/C attribute spec",
+         reference="hivemall.smile.tools.GuessAttributesUDF")
+register("xgboost_predict", "UDTF", "hivemall_tpu.models.trees:tree_predict",
+         description="evaluate serialized boosting trees",
+         reference="hivemall.xgboost.tools.XGBoostPredictUDTF",
+         aliases=["xgboost_multiclass_predict"])
+
 # --- factorization machines (SURVEY.md §3.6) -------------------------------
 _learner("train_fm", "hivemall_tpu.models.fm:FMTrainer",
          "hivemall.fm.FactorizationMachineUDTF",

@@ -1,0 +1,308 @@
+"""Multiclass online linear trainers (SURVEY.md §3.4).
+
+Reference: hivemall.classifier.multiclass.{MulticlassPerceptronUDTF,
+MulticlassPassiveAggressiveUDTF (+PA1/PA2), MulticlassConfidenceWeightedUDTF,
+MulticlassAROWClassifierUDTF, MulticlassSoftConfidenceWeightedUDTF (+scw2)}.
+Same row shape as the binary family but the label is a class (int|string) and
+model rows are (label, feature, weight[, covar]).
+
+Update scheme (Crammer's multiclass PA / CW): score every class, find the
+true class and the highest-scoring wrong class; the closed-form step uses the
+margin DIFFERENCE and pushes the true row up / the rival row down. Per-batch
+deltas aggregate by scatter-add as in the binary family (minibatch=1 ==
+reference semantics).
+
+W is a [C_max, N] table; class labels map to rows on first sight, so the jit
+shape stays static while the label set grows dynamically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.sparse import SparseBatch
+from ..utils.options import OptionSpec
+from .classifier import _cw_beta, _online_spec, _phi_of
+
+__all__ = ["MulticlassPerceptronTrainer", "MulticlassPATrainer",
+           "MulticlassPA1Trainer", "MulticlassPA2Trainer",
+           "MulticlassCWTrainer", "MulticlassAROWTrainer",
+           "MulticlassSCWTrainer", "MulticlassSCW2Trainer"]
+
+
+def _mc_spec(name: str) -> OptionSpec:
+    s = _online_spec(name)
+    s.add("classes", "max_classes", type=int, default=64,
+          help="class-table capacity (rows allocated in W)")
+    return s
+
+
+class _MulticlassBase:
+    NAME = "train_multiclass"
+    HAS_COVAR = False
+
+    @classmethod
+    def spec(cls) -> OptionSpec:
+        return _mc_spec(cls.NAME)
+
+    def __init__(self, options: str = ""):
+        self.opts = self.spec().parse(options)
+        self.dims = int(self.opts.dims)
+        self.C = int(self.opts.classes)
+        self.W = jnp.zeros((self.C, self.dims), jnp.float32)
+        self.sigma = jnp.ones((self.C, self.dims), jnp.float32) \
+            if self.HAS_COVAR else None
+        self._labels: Dict[object, int] = {}
+        self._names: Dict[int, str] = {}
+        self._buf: List[Tuple[np.ndarray, np.ndarray, int]] = []
+        self._step = self._make_step()
+        self._t = 0
+
+    # -- label/row handling --------------------------------------------------
+    def _label_id(self, label) -> int:
+        if label not in self._labels:
+            if len(self._labels) >= self.C:
+                raise ValueError(f"more than -classes {self.C} labels seen")
+            self._labels[label] = len(self._labels)
+        return self._labels[label]
+
+    def _parse_row(self, features) -> Tuple[np.ndarray, np.ndarray]:
+        from ..utils.hashing import mhash
+        idx: List[int] = []
+        val: List[float] = []
+        for f in features:
+            if f in (None, ""):
+                continue
+            name, sep, v = str(f).rpartition(":")
+            if not sep:
+                name, v = str(f), "1"
+            try:
+                i = int(name)
+            except ValueError:
+                i = mhash(name, self.dims - 1)
+                self._names.setdefault(i, name)
+            idx.append(i)
+            val.append(float(v))
+        return np.asarray(idx, np.int32), np.asarray(val, np.float32)
+
+    def process(self, features, label) -> None:
+        idx, val = self._parse_row(features)
+        y = self._label_id(label)
+        self._buf.append((idx, val, y))
+        if len(self._buf) >= int(self.opts.mini_batch):
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buf:
+            return
+        chunk = self._buf
+        self._buf = []
+        B = int(self.opts.mini_batch)
+        L = max(1, max(len(r[0]) for r in chunk))
+        Lp = 1
+        while Lp < L:
+            Lp <<= 1
+        idx = np.zeros((B, Lp), np.int32)
+        val = np.zeros((B, Lp), np.float32)
+        y = np.zeros(B, np.int32)
+        mask = np.zeros(B, np.float32)
+        for b, (i, v, yy) in enumerate(chunk):
+            idx[b, :len(i)] = i
+            val[b, :len(v)] = v
+            y[b] = yy
+            mask[b] = 1.0
+        self.W, self.sigma = self._step(self.W, self.sigma, idx, val, y, mask)
+        self._t += 1
+
+    def close(self) -> Iterator[Tuple]:
+        self._flush()
+        yield from self.model_rows()
+
+    # -- the jitted aggregated step -----------------------------------------
+    # subclass hook: (margin_diff, v, C-opts) -> (alpha, beta)
+    def _rates(self):
+        raise NotImplementedError
+
+    def _make_step(self):
+        rates = self._rates()
+        has_covar = self.HAS_COVAR
+
+        @jax.jit
+        def step(W, sigma, idx, val, y, mask):
+            scores = jnp.einsum("cbl,bl->bc",
+                                W[:, idx], val)            # [B, C]
+            B, C = scores.shape
+            true_s = jnp.take_along_axis(scores, y[:, None], 1)[:, 0]
+            penal = scores.at[jnp.arange(B), y].set(-jnp.inf)
+            rival = jnp.argmax(penal, axis=1)               # best wrong class
+            rival_s = jnp.take_along_axis(scores, rival[:, None], 1)[:, 0]
+            m = true_s - rival_s                            # margin difference
+            # diagonal covar: v = sum over both rows' sigma * x^2
+            if has_covar:
+                st = sigma[y, :][jnp.arange(B)[:, None], idx]
+                sr = sigma[rival, :][jnp.arange(B)[:, None], idx]
+                v = ((st + sr) * val * val).sum(-1)
+            else:
+                st = sr = jnp.ones_like(val)
+                v = 2.0 * (val * val).sum(-1)
+            alpha, beta = rates(m, v)
+            alpha = alpha * mask
+            beta = beta * mask
+            # scatter into the true and rival class rows
+            flat_t = y[:, None] * W.shape[1] + idx          # [B, L]
+            flat_r = rival[:, None] * W.shape[1] + idx
+            Wf = W.reshape(-1)
+            Wf = Wf.at[flat_t.ravel()].add(
+                (alpha[:, None] * st * val).ravel())
+            Wf = Wf.at[flat_r.ravel()].add(
+                (-alpha[:, None] * sr * val).ravel())
+            W2 = Wf.reshape(W.shape)
+            if has_covar:
+                Sf = sigma.reshape(-1)
+                Sf = Sf.at[flat_t.ravel()].add(
+                    -(beta[:, None] * (st * val) ** 2).ravel())
+                Sf = Sf.at[flat_r.ravel()].add(
+                    -(beta[:, None] * (sr * val) ** 2).ravel())
+                sigma2 = jnp.maximum(Sf.reshape(sigma.shape), 1e-8)
+            else:
+                sigma2 = sigma
+            return W2, sigma2
+
+        return step
+
+    # -- scoring / emission --------------------------------------------------
+    def classify(self, features) -> object:
+        idx, val = self._parse_row(features)
+        W = np.asarray(self.W)
+        scores = (W[:, idx] * val).sum(-1)
+        inv = {v: k for k, v in self._labels.items()}
+        k = int(np.argmax(scores[:len(self._labels)]))
+        return inv.get(k)
+
+    def model_rows(self) -> Iterator[Tuple]:
+        W = np.asarray(self.W)
+        inv = {v: k for k, v in self._labels.items()}
+        sig = None if self.sigma is None else np.asarray(self.sigma)
+        for c in range(len(self._labels)):
+            nz = np.nonzero(W[c])[0]
+            for i in nz:
+                name = self._names.get(int(i), str(int(i)))
+                if sig is None:
+                    yield (inv[c], name, float(W[c, i]))
+                else:
+                    yield (inv[c], name, float(W[c, i]), float(sig[c, i]))
+
+
+class MulticlassPerceptronTrainer(_MulticlassBase):
+    """SQL: train_multiclass_perceptron."""
+    NAME = "train_multiclass_perceptron"
+
+    def _rates(self):
+        def rates(m, v):
+            return (m <= 0).astype(jnp.float32), jnp.zeros_like(m)
+        return rates
+
+
+class MulticlassPATrainer(_MulticlassBase):
+    """SQL: train_multiclass_pa — tau = hinge(1 - m) / v."""
+    NAME = "train_multiclass_pa"
+
+    def _tau(self, loss, v):
+        return loss / jnp.maximum(v, 1e-12)
+
+    def _rates(self):
+        tau_fn = self._tau
+
+        def rates(m, v):
+            loss = jnp.maximum(0.0, 1.0 - m)
+            return jnp.where(loss > 0, tau_fn(loss, v), 0.0), \
+                jnp.zeros_like(m)
+        return rates
+
+
+class MulticlassPA1Trainer(MulticlassPATrainer):
+    NAME = "train_multiclass_pa1"
+
+    def _tau(self, loss, v):
+        return jnp.minimum(float(self.opts.c),
+                           loss / jnp.maximum(v, 1e-12))
+
+
+class MulticlassPA2Trainer(MulticlassPATrainer):
+    NAME = "train_multiclass_pa2"
+
+    def _tau(self, loss, v):
+        return loss / (v + 1.0 / (2.0 * float(self.opts.c)))
+
+
+class MulticlassCWTrainer(_MulticlassBase):
+    """SQL: train_multiclass_cw."""
+    NAME = "train_multiclass_cw"
+    HAS_COVAR = True
+
+    def _rates(self):
+        phi = _phi_of(self.opts)
+        zeta = 1.0 + phi * phi
+        psi = 1.0 + phi * phi / 2.0
+
+        def rates(m, v):
+            alpha = jnp.maximum(0.0, (-m * psi + jnp.sqrt(
+                m * m * phi ** 4 / 4.0 + v * phi * phi * zeta))
+                / jnp.maximum(v * zeta, 1e-12))
+            return alpha, _cw_beta(alpha, v, phi)
+        return rates
+
+
+class MulticlassAROWTrainer(_MulticlassBase):
+    """SQL: train_multiclass_arow."""
+    NAME = "train_multiclass_arow"
+    HAS_COVAR = True
+
+    def _rates(self):
+        r = float(self.opts.r)
+
+        def rates(m, v):
+            beta = 1.0 / (v + r)
+            alpha = jnp.maximum(0.0, 1.0 - m) * beta
+            upd = (m < 1.0).astype(jnp.float32)
+            return alpha * upd, beta * upd
+        return rates
+
+
+class MulticlassSCWTrainer(MulticlassCWTrainer):
+    """SQL: train_multiclass_scw — SCW-I cap at C."""
+    NAME = "train_multiclass_scw"
+
+    def _rates(self):
+        base = super()._rates()
+        C = float(self.opts.c)
+
+        def rates(m, v):
+            alpha, beta = base(m, v)
+            alpha = jnp.minimum(alpha, C)
+            return alpha, beta
+        return rates
+
+
+class MulticlassSCW2Trainer(_MulticlassBase):
+    """SQL: train_multiclass_scw2 — SCW-II."""
+    NAME = "train_multiclass_scw2"
+    HAS_COVAR = True
+
+    def _rates(self):
+        phi = _phi_of(self.opts)
+        C = float(self.opts.c)
+
+        def rates(m, v):
+            n = v + 1.0 / (2.0 * C)
+            gamma = phi * jnp.sqrt(
+                phi * phi * m * m * v * v + 4.0 * n * v * (n + v * phi * phi))
+            alpha = jnp.maximum(0.0, (-(2.0 * m * n + phi * phi * m * v)
+                                      + gamma)
+                                / (2.0 * (n * n + n * v * phi * phi) + 1e-12))
+            return alpha, _cw_beta(alpha, v, phi)
+        return rates

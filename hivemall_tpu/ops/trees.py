@@ -1,0 +1,311 @@
+"""Histogram-based decision-tree kernels — level-wise growth on TPU.
+
+Reference (SURVEY.md §3.9, §4.5): hivemall.smile vendored DecisionTree /
+RegressionTree (per-node candidate-split scans over sorted values) and the
+xgboost JNI wrapper's native C++ core. The TPU rebuild replaces both with one
+histogram machinery [B: "Pallas histogram kernels"]:
+
+  1. features are quantile-binned once (uint8 codes, LightGBM-style);
+  2. a tree grows LEVEL-WISE with fixed-width frontiers (2^t nodes at depth
+     t): one scatter-add builds the (node, feature, bin, channel) histogram
+     for the whole level, a cumulative-sum scan turns it into left/right
+     split statistics, and an argmax picks each node's best (feature, bin);
+  3. rows route to children with one gather+compare — no per-node recursion,
+     no data-dependent control flow, everything jit-compiled with static
+     shapes per level.
+
+The same skeleton serves Gini classification (channel = class counts),
+variance regression (channels w, wy, wy^2), and XGBoost-style boosting
+(channels g, h) via pluggable gain/leaf functions. Trees vmap over the
+ensemble axis (bootstrap weights differ per tree).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache, partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["quantize_bins", "Tree", "build_tree_classifier",
+           "build_tree_regressor", "build_tree_xgb", "predict_bins",
+           "predict_raw"]
+
+
+def quantize_bins(X: np.ndarray, n_bins: int = 64
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Quantile-bin features: returns (codes uint8 [n,d], edges [d, n_bins-1]).
+    Code b means value <= edges[f, b] (last bin catches the rest)."""
+    X = np.asarray(X, np.float32)
+    n, d = X.shape
+    edges = np.empty((d, n_bins - 1), np.float32)
+    codes = np.empty((n, d), np.uint8)
+    qs = np.linspace(0, 1, n_bins + 1)[1:-1]
+    for f in range(d):
+        e = np.unique(np.quantile(X[:, f], qs))
+        col = np.searchsorted(e, X[:, f], side="left").astype(np.uint8)
+        pad = np.full(n_bins - 1, np.inf, np.float32)
+        pad[:len(e)] = e
+        edges[f] = pad
+        codes[:, f] = col
+    return codes, edges
+
+
+@dataclass
+class Tree:
+    """Complete-binary-layout tree: node i's children are 2i+1 / 2i+2."""
+    feat: np.ndarray        # int32 [Nn], split feature (-1 for leaf)
+    thr: np.ndarray         # uint8 [Nn], split bin (go right if code > thr)
+    value: np.ndarray       # f32 [Nn, C] leaf payload (class counts / value)
+    edges: np.ndarray       # f32 [d, B-1] bin edges for raw-value predict
+
+    @property
+    def depth(self) -> int:
+        # feat is [E, Nn]; Nn = 2^(depth+1) - 1
+        return int(np.log2(self.feat.shape[-1] + 1)) - 1
+
+
+def _gini_gain(left, right, parent, min_leaf):
+    """Weighted Gini impurity decrease. stats channels = class counts."""
+    def wgini(c):
+        n = c.sum(-1)
+        sq = (c * c).sum(-1)
+        return n - sq / jnp.maximum(n, 1e-12)      # n * gini(c)
+    nl = left.sum(-1)
+    nr = right.sum(-1)
+    gain = wgini(parent)[:, None, None] - wgini(left) - wgini(right)
+    ok = (nl >= min_leaf) & (nr >= min_leaf)
+    return jnp.where(ok, gain, -jnp.inf)
+
+
+def _var_gain(left, right, parent, min_leaf):
+    """SSE decrease. stats channels = (w, wy, wy^2)."""
+    def sse(s):
+        w, wy, wy2 = s[..., 0], s[..., 1], s[..., 2]
+        return wy2 - wy * wy / jnp.maximum(w, 1e-12)
+    ok = (left[..., 0] >= min_leaf) & (right[..., 0] >= min_leaf)
+    gain = sse(parent)[:, None, None] - sse(left) - sse(right)
+    return jnp.where(ok, gain, -jnp.inf)
+
+
+def _xgb_gain(lam):
+    def gain(left, right, parent, min_leaf):
+        """stats channels = (g, h, w). score = G^2/(H+lam)."""
+        def score(s):
+            return s[..., 0] ** 2 / (s[..., 1] + lam)
+        ok = (left[..., 2] >= min_leaf) & (right[..., 2] >= min_leaf)
+        g = score(left) + score(right) - score(parent)[:, None, None]
+        return jnp.where(ok, g, -jnp.inf)
+    return gain
+
+
+def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
+                  leaf_fn: Callable, count_fn: Callable, depth: int,
+                  n_bins: int, mtry: int, min_split: float, min_leaf: float,
+                  min_gain: float):
+    """Single-tree level-wise builder; vmap over (w, rng) for an ensemble.
+
+    bins: uint8 [n, d]; aux: per-row stat payload (labels / grads);
+    w: [n] sample weights (bootstrap counts; 0 = out-of-bag).
+    """
+
+    def build(bins, aux, w, rng):
+        n, d = bins.shape
+        Nn = 2 ** (depth + 1) - 1
+        feat = jnp.full(Nn, -1, jnp.int32)
+        thr = jnp.zeros(Nn, jnp.uint8)
+        value = jnp.zeros((Nn, n_channels), jnp.float32)
+        settled = jnp.zeros(Nn, bool)           # node finished (is a leaf)
+        node = jnp.zeros(n, jnp.int32)          # row -> current node id
+        stats = stat_fn(aux)                    # [n, S] per-row channels
+        ws = stats * w[:, None]                 # weighted channels
+
+        for t in range(depth + 1):
+            M = 2 ** t
+            base = M - 1
+            local = node - base
+            active = (local >= 0) & (local < M) & ~settled[jnp.clip(node, 0, Nn - 1)]
+            # ---- histogram: one scatter-add for the whole level ----
+            # flat index: ((local*d + f)*B + bin)
+            loc = jnp.where(active, local, 0)
+            fidx = (loc[:, None] * d + jnp.arange(d)[None, :]) * n_bins \
+                + bins.astype(jnp.int32)                       # [n, d]
+            contrib = jnp.where(active[:, None, None], ws[:, None, :], 0.0)
+            contrib = jnp.broadcast_to(contrib, (n, d, n_channels))
+            hist = jnp.zeros((M * d * n_bins, n_channels), jnp.float32)
+            hist = hist.at[fidx.ravel()].add(
+                contrib.reshape(n * d, n_channels))
+            hist = hist.reshape(M, d, n_bins, n_channels)
+            # ---- split statistics ----
+            parent = hist.sum(2).max(1)  # [M, S] (identical across f; max ok)
+            cum = jnp.cumsum(hist, axis=2)                     # left stats
+            left = cum[:, :, :-1, :]                           # thr bin b
+            right = parent[:, None, None, :] - left
+            gains = gain_fn(left, right, parent, min_leaf)     # [M,d,B-1]
+            if t == depth:
+                best_gain = jnp.full(M, -jnp.inf)
+                bf = jnp.zeros(M, jnp.int32)
+                bb = jnp.zeros(M, jnp.uint8)
+            else:
+                if mtry and mtry < d:
+                    rng, sub = jax.random.split(rng)
+                    # per-node random feature subset (smile's -vars / mtry)
+                    scores = jax.random.uniform(sub, (M, d))
+                    kth = jnp.sort(scores, axis=1)[:, mtry - 1][:, None]
+                    mask = scores <= kth
+                    gains = jnp.where(mask[:, :, None], gains, -jnp.inf)
+                flat_g = gains.reshape(M, -1)
+                arg = jnp.argmax(flat_g, axis=1)
+                best_gain = jnp.take_along_axis(flat_g, arg[:, None],
+                                                axis=1)[:, 0]
+                bf = (arg // (n_bins - 1)).astype(jnp.int32)
+                bb = (arg % (n_bins - 1)).astype(jnp.uint8)
+            cnt = count_fn(parent)
+            # leaf decision per frontier node
+            do_split = (best_gain > min_gain) & (cnt >= min_split)
+            ids = base + jnp.arange(M)
+            feat = feat.at[ids].set(jnp.where(do_split, bf, -1))
+            thr = thr.at[ids].set(jnp.where(do_split, bb, 0))
+            value = value.at[ids].set(leaf_fn(parent))
+            newly_settled = ~do_split & ~settled[ids]
+            settled = settled.at[ids].set(settled[ids] | ~do_split)
+            # ---- route rows ----
+            split_here = active & do_split[loc]
+            fsel = bf[loc]
+            go_right = bins[jnp.arange(n), fsel] > bb[loc]
+            node = jnp.where(split_here,
+                             2 * node + 1 + go_right.astype(jnp.int32),
+                             node)
+        return feat, thr, value
+
+    return build
+
+
+# --- per-task front ends (jitted builders cached per config) ---------------
+
+def _reg_leaf(parent):     # mean in channel 0 slot; keep stats for ensembling
+    mean = parent[..., 1] / jnp.maximum(parent[..., 0], 1e-12)
+    return jnp.stack([mean, parent[..., 0], parent[..., 2]], axis=-1)
+
+
+@lru_cache(maxsize=128)
+def _cached_builder(task: str, n_channels: int, depth: int, n_bins: int,
+                    mtry: int, min_split: float, min_leaf: float,
+                    lam: float, vmapped: bool):
+    if task == "gini":
+        gain, leaf, count = _gini_gain, (lambda p: p), (lambda s: s.sum(-1))
+    elif task == "var":
+        gain, leaf, count = _var_gain, _reg_leaf, (lambda s: s[..., 0])
+    elif task == "xgb":
+        def xleaf(parent):
+            val = -parent[..., 0] / (parent[..., 1] + lam)
+            return jnp.stack([val, parent[..., 1], parent[..., 2]], axis=-1)
+        gain, leaf, count = _xgb_gain(lam), xleaf, (lambda s: s[..., 2])
+    else:
+        raise ValueError(task)
+    build = _make_builder(n_channels, lambda aux: aux, gain, leaf, count,
+                          depth, n_bins, mtry, min_split, min_leaf,
+                          min_gain=1e-7)
+    if vmapped:
+        build = jax.vmap(build, in_axes=(None, None, 0, 0))
+    return jax.jit(build)
+
+
+def build_tree_classifier(bins: np.ndarray, labels: np.ndarray,
+                          weights: np.ndarray, edges: np.ndarray,
+                          n_classes: int, *, depth: int = 8,
+                          n_bins: int = 64, mtry: int = 0,
+                          min_split: float = 2.0, min_leaf: float = 1.0,
+                          seed: int = 42, n_trees: int = 1) -> Tree:
+    """Gini trees; weights [E, n] give per-tree bootstrap counts."""
+    onehot = jax.nn.one_hot(labels, n_classes)
+    build = _cached_builder("gini", n_classes, depth, n_bins, mtry,
+                            float(min_split), float(min_leaf), 0.0, True)
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
+    f, t, v = build(jnp.asarray(bins), onehot, jnp.asarray(weights), keys)
+    return Tree(np.asarray(f), np.asarray(t), np.asarray(v), edges)
+
+
+def build_tree_regressor(bins: np.ndarray, targets: np.ndarray,
+                         weights: np.ndarray, edges: np.ndarray, *,
+                         depth: int = 8, n_bins: int = 64, mtry: int = 0,
+                         min_split: float = 2.0, min_leaf: float = 1.0,
+                         seed: int = 42, n_trees: int = 1) -> Tree:
+    """Variance-split trees; leaf value = weighted mean target."""
+    y = jnp.asarray(targets, jnp.float32)
+    aux = jnp.stack([jnp.ones_like(y), y, y * y], axis=1)
+    build = _cached_builder("var", 3, depth, n_bins, mtry, float(min_split),
+                            float(min_leaf), 0.0, True)
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
+    f, t, v = build(jnp.asarray(bins), aux, jnp.asarray(weights), keys)
+    return Tree(np.asarray(f), np.asarray(t), np.asarray(v), edges)
+
+
+def build_tree_xgb(bins: np.ndarray, grads: np.ndarray, hess: np.ndarray,
+                   edges: np.ndarray, *, depth: int = 6, n_bins: int = 64,
+                   lam: float = 1.0, min_split: float = 2.0,
+                   min_leaf: float = 1.0, colsample: float = 1.0,
+                   seed: int = 42) -> Tree:
+    """One boosting tree on (g, h); leaf value = -G/(H+lam) in channel 0."""
+    g = jnp.asarray(grads, jnp.float32)
+    h = jnp.asarray(hess, jnp.float32)
+    aux = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
+    d = bins.shape[1]
+    mtry = max(1, int(round(colsample * d))) if colsample < 1.0 else 0
+    build = _cached_builder("xgb", 3, depth, n_bins, mtry, float(min_split),
+                            float(min_leaf), float(lam), False)
+    f, t, v = build(jnp.asarray(bins), aux,
+                    jnp.ones(bins.shape[0], jnp.float32),
+                    jax.random.PRNGKey(seed))
+    return Tree(np.asarray(f)[None], np.asarray(t)[None],
+                np.asarray(v)[None], edges)
+
+
+# --- prediction: vectorized gather-walk (the StackMachine VM rebuild) ------
+
+@partial(jax.jit, static_argnums=(4,))
+def _walk(feat, thr, value, bins, depth):
+    n = bins.shape[0]
+    node = jnp.zeros(n, jnp.int32)
+
+    def body(_, node):
+        f = feat[node]
+        is_leaf = f < 0
+        fsel = jnp.maximum(f, 0)
+        go_right = bins[jnp.arange(n), fsel] > thr[node]
+        nxt = 2 * node + 1 + go_right.astype(jnp.int32)
+        return jnp.where(is_leaf, node, nxt)
+
+    node = jax.lax.fori_loop(0, depth, body, node)
+    return value[node]
+
+
+def predict_bins(tree: Tree, bins: np.ndarray) -> np.ndarray:
+    """Predict leaf payload per row for every tree: returns [E, n, C].
+    The reference's per-row StackMachine opcode interpreter (SURVEY.md §3.9
+    row 3) becomes this data-parallel gather walk."""
+    E = tree.feat.shape[0]
+    out = [
+        np.asarray(_walk(jnp.asarray(tree.feat[e]), jnp.asarray(tree.thr[e]),
+                         jnp.asarray(tree.value[e]), jnp.asarray(bins),
+                         tree.depth + 1))
+        for e in range(E)
+    ]
+    return np.stack(out)
+
+
+def bin_raw(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Quantize raw features with a trained tree's edges."""
+    X = np.asarray(X, np.float32)
+    codes = np.empty(X.shape, np.uint8)
+    for f in range(X.shape[1]):
+        e = edges[f][np.isfinite(edges[f])]
+        codes[:, f] = np.searchsorted(e, X[:, f], side="left").astype(np.uint8)
+    return codes
+
+
+def predict_raw(tree: Tree, X: np.ndarray) -> np.ndarray:
+    return predict_bins(tree, bin_raw(X, tree.edges))

@@ -1,0 +1,152 @@
+"""Online classifier family: sequential-oracle equivalence at mini_batch=1
+(SURVEY.md §8: covariance trainers validated against tiny-batch sequential
+oracles) + convergence on separable data."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.frame.evaluation import auc
+from hivemall_tpu.io.libsvm import synthetic_classification
+from hivemall_tpu.models.classifier import (AROWTrainer, AdaGradRDATrainer,
+                                            ConfidenceWeightedTrainer,
+                                            KernelizedPATrainer, PA1Trainer,
+                                            PA2Trainer,
+                                            PARegressionTrainer,
+                                            AROWRegressionTrainer,
+                                            PassiveAggressiveTrainer,
+                                            PerceptronTrainer, SCW1Trainer,
+                                            SCW2Trainer)
+
+ALL_BINARY = [PerceptronTrainer, PassiveAggressiveTrainer, PA1Trainer,
+              PA2Trainer, ConfidenceWeightedTrainer, AROWTrainer,
+              SCW1Trainer, SCW2Trainer, AdaGradRDATrainer]
+
+
+@pytest.mark.parametrize("cls", ALL_BINARY)
+def test_converges_separable(cls):
+    ds, _ = synthetic_classification(600, 40, seed=8)
+    t = cls("-dims 128 -mini_batch 16 -iters 3")
+    t.fit(ds)
+    score = auc(ds.labels, t.decision_function(ds))
+    assert score > 0.85, (cls.NAME, score)
+
+
+def test_pa_sequential_oracle():
+    """mini_batch=1 PA must match the closed-form sequential updates."""
+    t = PassiveAggressiveTrainer("-dims 16 -mini_batch 1")
+    rows = [([1, 2], [1.0, 0.5], 1.0), ([2, 3], [1.0, 1.0], -1.0),
+            ([1, 3], [0.5, 1.0], 1.0)]
+    w_ref = np.zeros(16)
+    for idx, val, y in rows:
+        t.process((np.asarray(idx, np.int32), np.asarray(val, np.float32)), y)
+        m = y * sum(w_ref[i] * v for i, v in zip(idx, val))
+        loss = max(0.0, 1.0 - m)
+        if loss > 0:
+            xx = sum(v * v for v in val)
+            tau = loss / xx
+            for i, v in zip(idx, val):
+                w_ref[i] += tau * y * v
+    w_got = t._finalized_weights()
+    np.testing.assert_allclose(w_got[:16], w_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_arow_sequential_oracle():
+    t = AROWTrainer("-dims 8 -mini_batch 1 -r 0.1")
+    rows = [([1, 2], [1.0, 1.0], 1.0), ([1, 3], [1.0, 0.5], -1.0),
+            ([2, 3], [0.5, 1.0], 1.0)]
+    w_ref = np.zeros(8)
+    s_ref = np.ones(8)
+    for idx, val, y in rows:
+        t.process((np.asarray(idx, np.int32), np.asarray(val, np.float32)), y)
+        m = y * sum(w_ref[i] * v for i, v in zip(idx, val))
+        v_ = sum(s_ref[i] * v * v for i, v in zip(idx, val))
+        if m < 1.0:
+            beta = 1.0 / (v_ + 0.1)
+            alpha = (1.0 - m) * beta
+            for i, v in zip(idx, val):
+                w_ref[i] += alpha * y * s_ref[i] * v
+            for i, v in zip(idx, val):
+                s_ref[i] -= beta * (s_ref[i] * v) ** 2
+    np.testing.assert_allclose(t._finalized_weights()[:8], w_ref,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.sigma)[:8], s_ref,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_covar_rows_emitted():
+    t = AROWTrainer("-dims 64 -mini_batch 4")
+    for _ in range(8):
+        t.process(["1:1.0"], 1)
+        t.process(["2:1.0"], -1)
+    rows = list(t.close())
+    assert all(len(r) == 3 for r in rows)     # (feature, weight, covar)
+    covars = {r[0]: r[2] for r in rows}
+    assert 0 < covars["1"] < 1.0              # confidence grew (covar shrank)
+
+
+def test_kpa_solves_xor():
+    t = KernelizedPATrainer("-dims 4096 -mini_batch 8 -iters 6 -c 1")
+    rng = np.random.default_rng(0)
+    rows = []
+    for _ in range(400):
+        a, b = int(rng.integers(0, 2)), int(rng.integers(0, 2))
+        feats = [f"a:{1.0 if a else -1.0}", f"b:{1.0 if b else -1.0}"]
+        rows.append((feats, 1 if a != b else -1))
+    for f, y in rows:
+        t.process(f, y)
+    # linear features alone cannot separate XOR; kernel crosses can
+    correct = 0
+    from hivemall_tpu.io.sparse import SparseDataset
+    for f, y in rows[:100]:
+        idx, val = t._parse_row(f)
+        w = t._finalized_weights()
+        s = (w[idx] * val).sum()
+        correct += (s > 0) == (y > 0)
+    assert correct > 85, correct
+
+
+def test_pa_regression():
+    rng = np.random.default_rng(1)
+    t = PARegressionTrainer("-dims 8 -mini_batch 1 -epsilon 0.01 -c 10")
+    for _ in range(300):
+        x = rng.uniform(-1, 1)
+        t.process((np.asarray([1], np.int32),
+                   np.asarray([x], np.float32)), 2.5 * x)
+    w = t._finalized_weights()
+    assert abs(w[1] - 2.5) < 0.2, w[1]
+
+
+def test_arow_regression():
+    rng = np.random.default_rng(2)
+    t = AROWRegressionTrainer("-dims 8 -mini_batch 1 -epsilon 0.01 -r 0.5")
+    for _ in range(300):
+        x = rng.uniform(-1, 1)
+        t.process((np.asarray([1], np.int32),
+                   np.asarray([x], np.float32)), -1.5 * x)
+    w = t._finalized_weights()
+    assert abs(w[1] + 1.5) < 0.25, w[1]
+
+
+def test_multiclass_families():
+    from hivemall_tpu.models.multiclass import (MulticlassAROWTrainer,
+                                                MulticlassCWTrainer,
+                                                MulticlassPA1Trainer,
+                                                MulticlassPerceptronTrainer,
+                                                MulticlassSCWTrainer,
+                                                MulticlassSCW2Trainer)
+    rng = np.random.default_rng(4)
+    for cls in (MulticlassPerceptronTrainer, MulticlassPA1Trainer,
+                MulticlassCWTrainer, MulticlassAROWTrainer,
+                MulticlassSCWTrainer, MulticlassSCW2Trainer):
+        t = cls("-dims 64 -classes 8 -mini_batch 4 -iters 1")
+        for _ in range(300):
+            c = int(rng.integers(0, 3))
+            feats = [f"{c + 1}:1.0", f"{(c + 1) * 10}:0.5"]
+            t.process(feats, f"class{c}")
+        acc = 0
+        for c in range(3):
+            acc += t.classify([f"{c + 1}:1.0", f"{(c + 1) * 10}:0.5"]) \
+                == f"class{c}"
+        assert acc == 3, (cls.NAME, acc)
+        rows = list(t.model_rows())
+        assert rows and rows[0][0].startswith("class")
