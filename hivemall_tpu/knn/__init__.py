@@ -1,0 +1,7 @@
+from .distance import (angular_distance, cosine_distance,  # noqa: F401
+                       euclid_distance, hamming_distance, jaccard_distance,
+                       kld, manhattan_distance, minkowski_distance)
+from .similarity import (angular_similarity, cosine_similarity,  # noqa: F401
+                         dimsum_mapper, distance2similarity,
+                         euclid_similarity, jaccard_similarity)
+from .lsh import bbit_minhash, minhash, minhashes  # noqa: F401

@@ -1,0 +1,99 @@
+"""knn.distance — distance UDFs over feature vectors (SURVEY.md §3.13).
+
+Reference: hivemall.knn.distance.{EuclidDistanceUDF,CosineDistanceUDF,
+AngularDistanceUDF,JaccardDistanceUDF,HammingDistanceUDF,
+ManhattanDistanceUDF,MinkowskiDistanceUDF,KLDivergenceUDF}.
+
+Inputs are "name[:value]" feature-string arrays (sparse) or plain numeric
+sequences; kNN search itself stays relational (cross join + each_top_k),
+exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Sequence, Union
+
+__all__ = ["euclid_distance", "cosine_distance", "angular_distance",
+           "jaccard_distance", "hamming_distance", "manhattan_distance",
+           "minkowski_distance", "kld"]
+
+
+def _to_map(features: Sequence) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    if features is None:
+        return out
+    for i, f in enumerate(features):
+        if f is None:
+            continue
+        if isinstance(f, (int, float)) and not isinstance(f, bool):
+            out[str(i)] = float(f)
+            continue
+        name, sep, v = str(f).rpartition(":")
+        if not sep:
+            name, v = str(f), "1"
+        out[name] = float(v)
+    return out
+
+
+def euclid_distance(a: Sequence, b: Sequence) -> float:
+    fa, fb = _to_map(a), _to_map(b)
+    return math.sqrt(sum((fa.get(k, 0.0) - fb.get(k, 0.0)) ** 2
+                         for k in set(fa) | set(fb)))
+
+
+def manhattan_distance(a: Sequence, b: Sequence) -> float:
+    fa, fb = _to_map(a), _to_map(b)
+    return sum(abs(fa.get(k, 0.0) - fb.get(k, 0.0))
+               for k in set(fa) | set(fb))
+
+
+def minkowski_distance(a: Sequence, b: Sequence, p: float = 3.0) -> float:
+    fa, fb = _to_map(a), _to_map(b)
+    return sum(abs(fa.get(k, 0.0) - fb.get(k, 0.0)) ** p
+               for k in set(fa) | set(fb)) ** (1.0 / p)
+
+
+def cosine_distance(a: Sequence, b: Sequence) -> float:
+    return 1.0 - _cosine(a, b)
+
+
+def _cosine(a: Sequence, b: Sequence) -> float:
+    fa, fb = _to_map(a), _to_map(b)
+    dot = sum(v * fb.get(k, 0.0) for k, v in fa.items())
+    na = math.sqrt(sum(v * v for v in fa.values()))
+    nb = math.sqrt(sum(v * v for v in fb.values()))
+    if na == 0.0 or nb == 0.0:
+        return 0.0
+    return dot / (na * nb)
+
+
+def angular_distance(a: Sequence, b: Sequence) -> float:
+    c = max(-1.0, min(1.0, _cosine(a, b)))
+    return math.acos(c) / math.pi
+
+
+def jaccard_distance(a: Sequence, b: Sequence, k: int = 128) -> float:
+    """Jaccard distance over feature-name sets (k kept for b-bit minhash
+    signature compatibility in the reference signature)."""
+    sa = set(_to_map(a))
+    sb = set(_to_map(b))
+    if not sa and not sb:
+        return 0.0
+    return 1.0 - len(sa & sb) / len(sa | sb)
+
+
+def hamming_distance(a: Union[int, Sequence], b: Union[int, Sequence]) -> int:
+    if isinstance(a, int) and isinstance(b, int):
+        return bin(a ^ b).count("1")
+    return sum(1 for x, y in zip(a, b) if x != y) + abs(len(a) - len(b))
+
+
+def kld(mu1: float, sigma1: float, mu2: float, sigma2: float) -> float:
+    """KL divergence between two univariate Gaussians (reference
+    KLDivergenceUDF signature)."""
+    if sigma1 <= 0 or sigma2 <= 0:
+        return 0.0
+    return (0.5 * (math.log(sigma2 / sigma1)
+                   + sigma1 / sigma2
+                   + (mu1 - mu2) ** 2 / sigma2 - 1.0))

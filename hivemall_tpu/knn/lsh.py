@@ -1,0 +1,53 @@
+"""knn.lsh — minhash clustering UDFs (SURVEY.md §3.13).
+
+Reference: hivemall.knn.lsh.{MinHashUDTF,MinHashesUDF,bBitMinHashUDF}.
+Vectorized: all k hash families evaluate over a row's features in one
+numpy broadcast instead of a per-feature loop.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.hashing import murmurhash3_batch
+
+__all__ = ["minhash", "minhashes", "bbit_minhash"]
+
+
+def _feature_hashes(features: Sequence[str], k: int) -> np.ndarray:
+    """[k, n] matrix of per-family hashes (seeded murmur3)."""
+    names = [str(f).rpartition(":")[0] or str(f) for f in features
+             if f not in (None, "")]
+    if not names:
+        return np.zeros((k, 0), np.uint32)
+    return np.stack([murmurhash3_batch(names, seed=s) for s in range(k)])
+
+
+def minhashes(features: Sequence[str], k: int = 5) -> List[int]:
+    """SQL: minhashes(features, k) — the k min-hash values of the row."""
+    h = _feature_hashes(features, k)
+    if h.shape[1] == 0:
+        return [0] * k
+    return [int(v) for v in h.min(axis=1)]
+
+
+def minhash(features: Sequence[str], k: int = 5
+            ) -> Iterator[Tuple[int, Sequence[str]]]:
+    """SQL: minhash(features[, '-n k']) UDTF — emit k (clusterid, features)
+    rows; rows sharing a clusterid are Jaccard-similar candidates."""
+    for v in minhashes(features, k):
+        yield (v, features)
+
+
+def bbit_minhash(features: Sequence[str], k: int = 128, b: int = 1) -> str:
+    """SQL: bbit_minhash(features[, k]) — b-bit minhash signature string."""
+    h = _feature_hashes(features, k)
+    if h.shape[1] == 0:
+        return "0" * k * b
+    mins = h.min(axis=1)
+    bits = []
+    for v in mins:
+        bits.append(format(int(v) & ((1 << b) - 1), f"0{b}b"))
+    return "".join(bits)

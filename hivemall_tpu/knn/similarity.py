@@ -1,0 +1,71 @@
+"""knn.similarity — similarity UDFs + DIMSUM mapper (SURVEY.md §3.13).
+
+Reference: hivemall.knn.similarity.{CosineSimilarityUDF,JaccardIndexUDF,
+AngularSimilarityUDF,EuclidSimilarity,Distance2SimilarityUDF,
+DIMSUMMapperUDF}.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from .distance import _cosine, _to_map, euclid_distance, jaccard_distance
+
+__all__ = ["cosine_similarity", "jaccard_similarity", "angular_similarity",
+           "euclid_similarity", "distance2similarity", "dimsum_mapper"]
+
+
+def cosine_similarity(a: Sequence, b: Sequence) -> float:
+    return _cosine(a, b)
+
+
+def jaccard_similarity(a: Sequence, b: Sequence, k: int = 128) -> float:
+    return 1.0 - jaccard_distance(a, b, k)
+
+
+def angular_similarity(a: Sequence, b: Sequence) -> float:
+    c = max(-1.0, min(1.0, _cosine(a, b)))
+    return 1.0 - math.acos(c) / math.pi
+
+
+def euclid_similarity(a: Sequence, b: Sequence) -> float:
+    return 1.0 / (1.0 + euclid_distance(a, b))
+
+
+def distance2similarity(d: float) -> float:
+    return 1.0 / (1.0 + d)
+
+
+def dimsum_mapper(row: Sequence[str], col_norms: Dict[str, float],
+                  threshold: float = 0.5, seed: int = 43
+                  ) -> Iterator[Tuple[str, str, float]]:
+    """SQL: dimsum_mapper(row, norms[, options]) — DIMSUM probabilistic
+    all-pairs column-similarity mapper (Zadeh & Carlsson). Emits sampled
+    (col_j, col_k, partial) contributions; summing partials over rows
+    approximates cosine similarity of columns j,k with norms >= threshold
+    handled exactly."""
+    f = _to_map(row)
+    if not f:
+        return
+    rng = np.random.default_rng(seed)
+    sqrt_gamma = math.sqrt(10.0 * math.log(max(2, len(col_norms)))
+                           / max(1e-12, threshold))
+    items = [(j, v) for j, v in f.items() if col_norms.get(j, 0.0) > 0]
+    for ji in range(len(items)):
+        j, aij = items[ji]
+        nj = col_norms[j]
+        pj = min(1.0, sqrt_gamma / nj)
+        if rng.random() >= pj:
+            continue
+        for ki in range(ji + 1, len(items)):
+            k, aik = items[ki]
+            nk = col_norms[k]
+            pk = min(1.0, sqrt_gamma / nk)
+            if rng.random() >= pk:
+                continue
+            denom = min(sqrt_gamma, nj) * min(sqrt_gamma, nk)
+            a, b = (j, k) if j <= k else (k, j)
+            yield (a, b, aij * aik / denom)

@@ -1,0 +1,171 @@
+"""Anomaly / change-point detection — changefinder and sst (SURVEY.md §3.11).
+
+Reference: hivemall.anomaly.{ChangeFinderUDF,ChangeFinder1D,ChangeFinder2D,
+SDAR1D,SDAR2D,SingularSpectrumTransformUDF}.
+
+changefinder: two-stage sequentially-discounted AR (SDAR). Stage 1 scores
+each point by -log p(x_t | AR model); smoothed scores feed a second SDAR
+whose score is the change-point score. The recurrence is inherently
+sequential, so the UDF form is a streaming host-side update (tiny O(k^2)
+state — exactly the reference's shape); `changefinder_batch` wraps a whole
+series at once.
+
+sst: singular-spectrum transformation — past/future Hankel matrices at each
+t; score = 1 - overlap of principal left subspaces. The batched form stacks
+every offset's Hankel matrix and runs one vmapped SVD on TPU.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.options import OptionSpec
+
+__all__ = ["SDAR1D", "ChangeFinder", "changefinder", "sst"]
+
+
+class SDAR1D:
+    """Sequentially discounted AR(k) estimator (reference SDAR1D):
+    discounted mean/autocovariances + Yule-Walker solve; score is the
+    negative log likelihood of x_t under the one-step prediction."""
+
+    def __init__(self, r: float = 0.02, k: int = 3):
+        self.r = r
+        self.k = k
+        self.mu = 0.0
+        self.sigma = 1.0
+        self.c = np.zeros(k + 1)
+        self.hist = deque(maxlen=k)
+        self.n = 0
+
+    def update(self, x: float) -> float:
+        r, k = self.r, self.k
+        self.n += 1
+        self.mu = (1 - r) * self.mu + r * x
+        xc = x - self.mu
+        hist = list(self.hist)
+        for j in range(min(len(hist), k + 1)):
+            lagged = hist[-1 - j] - self.mu if j < len(hist) else 0.0
+            self.c[j] = (1 - r) * self.c[j] + r * xc * (
+                xc if j == 0 else lagged)
+        if len(hist) >= 1:
+            m = min(k, len(hist))
+            # Yule-Walker: Toeplitz(c[0..m-1]) a = c[1..m]
+            T = np.empty((m, m))
+            for i in range(m):
+                for j in range(m):
+                    T[i, j] = self.c[abs(i - j)]
+            try:
+                a = np.linalg.solve(T + 1e-6 * np.eye(m), self.c[1:m + 1])
+            except np.linalg.LinAlgError:
+                a = np.zeros(m)
+            pred = self.mu + sum(a[j] * (hist[-1 - j] - self.mu)
+                                 for j in range(m))
+        else:
+            pred = self.mu
+        err = x - pred
+        self.sigma = (1 - r) * self.sigma + r * err * err
+        self.hist.append(x)
+        sig = max(self.sigma, 1e-12)
+        return 0.5 * (np.log(2 * np.pi * sig) + err * err / sig)
+
+
+class ChangeFinder:
+    """Two-stage ChangeFinder over a scalar stream (UDF-per-row semantics).
+
+    update(x) -> (outlier_score, change_score)."""
+
+    def __init__(self, r: float = 0.02, k: int = 3, T1: int = 7, T2: int = 7):
+        self.stage1 = SDAR1D(r, k)
+        self.stage2 = SDAR1D(r, k)
+        self.w1 = deque(maxlen=T1)
+        self.w2 = deque(maxlen=T2)
+
+    def update(self, x: float) -> Tuple[float, float]:
+        s1 = self.stage1.update(float(x))
+        self.w1.append(s1)
+        y = float(np.mean(self.w1))
+        s2 = self.stage2.update(y)
+        self.w2.append(s2)
+        return s1, float(np.mean(self.w2))
+
+
+CHANGEFINDER_SPEC = (OptionSpec("changefinder")
+                     .add("r", "forget", type=float, default=0.02,
+                          help="discounting rate")
+                     .add("k", "order", type=float, default=3,
+                          help="AR order")
+                     .add("T1", "smooth1", type=int, default=7)
+                     .add("T2", "smooth2", type=int, default=7)
+                     .add("outlier_threshold", type=float, default=0.0)
+                     .add("changepoint_threshold", type=float, default=0.0))
+
+
+def changefinder(series: Sequence[float], options: str = ""
+                 ) -> List[Tuple[float, float]]:
+    """SQL: changefinder(x[, options]) — batch over a series, emitting
+    (outlier_score, changepoint_score) per element."""
+    ns = CHANGEFINDER_SPEC.parse(options)
+    cf = ChangeFinder(float(ns.r), int(ns.k), int(ns.T1), int(ns.T2))
+    return [cf.update(float(x)) for x in series]
+
+
+SST_SPEC = (OptionSpec("sst")
+            .add("w", "window", type=int, default=30,
+                 help="Hankel window size")
+            .add("n", "n_past", type=int, default=0,
+                 help="past columns (default w)")
+            .add("m", "n_current", type=int, default=0,
+                 help="future columns (default w)")
+            .add("g", "gap", type=int, default=0,
+                 help="gap between past and future (default w/4)")
+            .add("r", "components", type=int, default=3,
+                 help="principal components compared")
+            .add("threshold", type=float, default=0.0))
+
+
+def sst(series: Sequence[float], options: str = "") -> List[float]:
+    """SQL: sst(x[, options]) — singular-spectrum-transform change score
+    per element (0 until enough history). Batched: every offset's past and
+    future Hankel matrices are SVD'd in one vmapped call."""
+    import jax
+    import jax.numpy as jnp
+
+    ns = SST_SPEC.parse(options)
+    x = np.asarray(list(series), np.float32)
+    w = int(ns.w)
+    n = int(ns.n) or w
+    m = int(ns.m) or w
+    g = int(ns.g) or max(1, w // 4)
+    r = int(ns.r)
+    T = len(x)
+    start = w + n - 1          # first t with a full past matrix
+    need = start + g + m       # and a full future matrix
+    if T <= need:
+        return [0.0] * T
+
+    def hankel(t0, cols):
+        # columns j: x[t0 + j - w + 1 : t0 + j + 1]
+        return jnp.stack([jax.lax.dynamic_slice(xj, (t0 + j - w + 1,), (w,))
+                          for j in range(cols)], axis=1)
+
+    xj = jnp.asarray(x)
+
+    @jax.jit
+    def score_at(t):
+        past = hankel(t - n + 1 - 1, n)       # ends at t-1... columns upto t
+        fut = hankel(t + g - 1, m)
+        up, _, _ = jnp.linalg.svd(past, full_matrices=False)
+        uf, _, _ = jnp.linalg.svd(fut, full_matrices=False)
+        s = jnp.linalg.svd(up[:, :r].T @ uf[:, :r], compute_uv=False)
+        return 1.0 - s[0]
+
+    ts = np.arange(start, T - g - m)
+    scores = np.zeros(T, np.float32)
+    if len(ts):
+        vals = jax.vmap(score_at)(jnp.asarray(ts))
+        scores[ts] = np.asarray(vals)
+    return scores.tolist()

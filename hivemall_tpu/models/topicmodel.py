@@ -1,0 +1,283 @@
+"""Topic models — train_lda / train_plsa (SURVEY.md §3.10).
+
+Reference: hivemall.topicmodel.{LDAUDTF,OnlineLDAModel,LDAPredictUDAF,
+PLSAUDTF,IncrementalPLSAModel,PLSAPredictUDAF}: online variational-Bayes LDA
+(Hoffman et al.) and incremental pLSA, minibatched inside the UDTF with decay
+rho_t = (tau0 + t)^-kappa.
+
+TPU shape: a minibatch of docs becomes padded (word-id, count) arrays; the
+per-doc E-step (gamma/phi fixed-point) runs as a lax.fori_loop vectorized
+over the batch; the M-step is one dense update of lambda [K, V]. Vocabulary
+is hashed into [0, V) like the linear models' feature space.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.hashing import mhash
+from ..utils.options import OptionSpec
+
+__all__ = ["LDATrainer", "PLSATrainer", "lda_predict", "plsa_predict"]
+
+
+def _digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+class LDATrainer:
+    """SQL: train_lda(words[, options]) — online VB LDA."""
+
+    NAME = "train_lda"
+
+    @classmethod
+    def spec(cls) -> OptionSpec:
+        s = OptionSpec(cls.NAME)
+        s.add("topics", "k", type=int, default=10, help="number of topics")
+        s.add("alpha", type=float, default=1 / 2.0, help="doc-topic prior "
+              "(reference default alpha = 1/topics at init; set explicitly)")
+        s.add("eta", type=float, default=1 / 20.0, help="topic-word prior")
+        s.add("tau0", type=float, default=64.0, help="decay offset")
+        s.add("kappa", type=float, default=0.7, help="decay exponent")
+        s.add("iter", "inner_iters", type=int, default=32,
+              help="E-step fixed-point iterations")
+        s.add("delta", type=float, default=1e-3,
+              help="accepted for reference compat (convergence tol)")
+        s.add("vocab", "vocab_size", type=int, default=1 << 16,
+              help="hashed vocabulary size")
+        s.add("mini_batch", type=int, default=128, help="docs per step")
+        s.add("max_doc_len", type=int, default=256,
+              help="distinct words kept per doc")
+        s.add("seed", type=int, default=131, help="init seed")
+        s.add("total_docs", type=int, default=1 << 20,
+              help="corpus-size estimate D for the M-step scale")
+        return s
+
+    def __init__(self, options: str = ""):
+        self.opts = self.spec().parse(options)
+        o = self.opts
+        self.K = int(o.topics)
+        self.V = int(o.vocab)
+        key = jax.random.PRNGKey(int(o.seed))
+        # lambda init ~ Gamma(100, 1/100) as in Hoffman's onlineldavb
+        self.lam = jax.random.gamma(key, 100.0, (self.K, self.V)) / 100.0
+        self._t = 0
+        self._buf: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._vocab_names: Dict[int, str] = {}
+        self._step = self._make_step()
+
+    def _word_ids(self, words: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        counts: Dict[int, float] = {}
+        for w in words:
+            if w in (None, ""):
+                continue
+            name, sep, v = str(w).rpartition(":")
+            if sep and _floatable(v):
+                c = float(v)
+            else:
+                name, c = str(w), 1.0
+            i = mhash(name, self.V) - 1
+            self._vocab_names.setdefault(i, name)
+            counts[i] = counts.get(i, 0.0) + c
+        ids = np.fromiter(counts.keys(), np.int32, len(counts))
+        cts = np.fromiter(counts.values(), np.float32, len(counts))
+        m = int(self.opts.max_doc_len)
+        return ids[:m], cts[:m]
+
+    def _make_step(self):
+        o = self.opts
+        K, V = self.K, self.V
+        alpha = float(o.alpha)
+        eta = float(o.eta)
+        inner = int(o.iter)
+        D = float(o.total_docs)
+
+        @jax.jit
+        def step(lam, t, ids, cts, mask):
+            """ids/cts/mask: [B, L]; returns updated lambda and gamma."""
+            B, L = ids.shape
+            Elogbeta = _digamma(lam) - _digamma(lam.sum(1, keepdims=True))
+            expElogbeta = jnp.exp(Elogbeta)                 # [K, V]
+            eb = expElogbeta[:, ids]                        # [K, B, L]
+            eb = jnp.moveaxis(eb, 0, 1)                     # [B, K, L]
+
+            def estep(_, gamma):
+                Elogth = _digamma(gamma) - _digamma(
+                    gamma.sum(1, keepdims=True))            # [B, K]
+                expElogth = jnp.exp(Elogth)
+                phinorm = jnp.einsum("bk,bkl->bl", expElogth, eb) + 1e-100
+                gamma_new = alpha + expElogth * jnp.einsum(
+                    "bl,bkl->bk", cts * mask / phinorm, eb)
+                return gamma_new
+
+            gamma0 = jnp.ones((B, K))
+            gamma = jax.lax.fori_loop(0, inner, estep, gamma0)
+            Elogth = _digamma(gamma) - _digamma(gamma.sum(1, keepdims=True))
+            expElogth = jnp.exp(Elogth)
+            phinorm = jnp.einsum("bk,bkl->bl", expElogth, eb) + 1e-100
+            # sufficient stats scattered back to the full vocab
+            sstats_rows = expElogth[:, :, None] * (
+                cts * mask / phinorm)[:, None, :]           # [B, K, L]
+            sstats = jnp.zeros((K, V)).at[:, ids.reshape(-1)].add(
+                jnp.moveaxis(sstats_rows, 1, 0).reshape(K, -1))
+            sstats = sstats * expElogbeta
+            rho = jnp.power(float(o.tau0) + t + 1.0, -float(o.kappa))
+            docs_seen = jnp.maximum(mask.max(1).sum(), 1.0)
+            lam_new = (1 - rho) * lam + rho * (
+                eta + D * sstats / docs_seen)
+            return lam_new, gamma
+
+        return step
+
+    # -- lifecycle -----------------------------------------------------------
+    def process(self, words: Sequence[str]) -> None:
+        ids, cts = self._word_ids(words)
+        if len(ids) == 0:
+            return
+        self._buf.append((ids, cts))
+        if len(self._buf) >= int(self.opts.mini_batch):
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buf:
+            return
+        docs = self._buf
+        self._buf = []
+        B = int(self.opts.mini_batch)
+        L = max(len(d[0]) for d in docs)
+        Lp = 1
+        while Lp < L:
+            Lp <<= 1
+        ids = np.zeros((B, Lp), np.int32)
+        cts = np.zeros((B, Lp), np.float32)
+        mask = np.zeros((B, Lp), np.float32)
+        for b, (i, c) in enumerate(docs):
+            ids[b, :len(i)] = i
+            cts[b, :len(c)] = c
+            mask[b, :len(i)] = 1.0
+        self.lam, self._last_gamma = self._step(self.lam, float(self._t),
+                                                ids, cts, mask)
+        self._t += 1
+
+    def close(self, top_n: int = 0) -> Iterator[Tuple[int, str, float]]:
+        """Emit (topic, word, p(word|topic)) rows for seen words."""
+        self._flush()
+        lam = np.asarray(self.lam)
+        probs = lam / lam.sum(1, keepdims=True)
+        seen = sorted(self._vocab_names)
+        for k in range(self.K):
+            order = sorted(seen, key=lambda i: -probs[k, i])
+            if top_n:
+                order = order[:top_n]
+            for i in order:
+                yield (k, self._vocab_names[i], float(probs[k, i]))
+
+    def fit(self, docs: Sequence[Sequence[str]]) -> "LDATrainer":
+        for d in docs:
+            self.process(d)
+        self._flush()
+        return self
+
+    def transform(self, words: Sequence[str]) -> np.ndarray:
+        """Per-doc topic proportions (the lda_predict role)."""
+        ids, cts = self._word_ids(words)
+        B = 1
+        ids_a = ids[None].astype(np.int32)
+        cts_a = cts[None].astype(np.float32)
+        mask = np.ones_like(cts_a)
+        _, gamma = self._step(self.lam, float(self._t), ids_a, cts_a, mask)
+        g = np.asarray(gamma)[0]
+        return g / g.sum()
+
+
+def _floatable(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False
+
+
+class PLSATrainer(LDATrainer):
+    """SQL: train_plsa — incremental pLSA (EM over P(z|d), P(w|z))."""
+
+    NAME = "train_plsa"
+
+    @classmethod
+    def spec(cls) -> OptionSpec:
+        s = super().spec()
+        return s
+
+    def _make_step(self):
+        o = self.opts
+        K, V = self.K, self.V
+        inner = int(o.iter)
+        alpha = float(o.alpha)
+
+        @jax.jit
+        def step(pwz, t, ids, cts, mask):
+            """pwz: P(w|z) [K, V]; returns updated P(w|z) + per-doc P(z|d)."""
+            B, L = ids.shape
+            pw = pwz[:, ids]                       # [K, B, L]
+            pw = jnp.moveaxis(pw, 0, 1)            # [B, K, L]
+
+            def em(_, pzd):
+                # E: P(z|d,w) ~ P(z|d) P(w|z)
+                num = pzd[:, :, None] * pw         # [B, K, L]
+                pzdw = num / (num.sum(1, keepdims=True) + 1e-100)
+                # M (doc side): P(z|d) ~ sum_w n(d,w) P(z|d,w)
+                pzd_new = (pzdw * (cts * mask)[:, None, :]).sum(-1) + alpha
+                return pzd_new / pzd_new.sum(1, keepdims=True)
+
+            pzd = jnp.full((B, K), 1.0 / K)
+            pzd = jax.lax.fori_loop(0, inner, em, pzd)
+            num = pzd[:, :, None] * pw
+            pzdw = num / (num.sum(1, keepdims=True) + 1e-100)
+            stats = (pzdw * (cts * mask)[:, None, :])       # [B, K, L]
+            sstats = jnp.zeros((K, V)).at[:, ids.reshape(-1)].add(
+                jnp.moveaxis(stats, 1, 0).reshape(K, -1))
+            rho = jnp.power(float(o.tau0) + t + 1.0, -float(o.kappa))
+            pwz_new = (1 - rho) * pwz + rho * (
+                (sstats + 1e-3) / (sstats.sum(1, keepdims=True) + 1e-3 * V))
+            return pwz_new, pzd
+
+        return step
+
+    def __init__(self, options: str = ""):
+        super().__init__(options)
+        key = jax.random.PRNGKey(int(self.opts.seed))
+        p = jax.random.uniform(key, (self.K, self.V)) + 0.1
+        self.lam = p / p.sum(1, keepdims=True)    # lam slot holds P(w|z)
+
+
+# --- predict UDAFs (join-side reassembly) ----------------------------------
+
+def lda_predict(words: Sequence[str], model_rows: Sequence[Tuple[int, str, float]],
+                topics: int, alpha: float = 0.5, iters: int = 64
+                ) -> List[Tuple[int, float]]:
+    """SQL: lda_predict — per-doc topic proportions from emitted model rows.
+    model_rows: (topic, word, p(word|topic))."""
+    pword: Dict[str, np.ndarray] = {}
+    for k, w, p in model_rows:
+        pword.setdefault(w, np.zeros(topics))[k] = p
+    gamma = np.full(topics, alpha)
+    doc = [w.rpartition(":")[0] or w for w in words]
+    mats = np.stack([pword.get(w, np.full(topics, 1e-12)) for w in doc]) \
+        if doc else np.zeros((0, topics))
+    for _ in range(iters):
+        theta = gamma / gamma.sum()
+        resp = mats * theta[None, :]
+        resp = resp / np.maximum(resp.sum(1, keepdims=True), 1e-100)
+        gamma = alpha + resp.sum(0)
+    theta = gamma / gamma.sum()
+    return [(k, float(theta[k])) for k in range(topics)]
+
+
+def plsa_predict(words: Sequence[str], model_rows, topics: int,
+                 alpha: float = 0.5, iters: int = 64):
+    """SQL: plsa_predict — same reassembly against P(w|z) rows."""
+    return lda_predict(words, model_rows, topics, alpha, iters)

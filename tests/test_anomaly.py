@@ -1,0 +1,57 @@
+import numpy as np
+
+from hivemall_tpu.models.anomaly import ChangeFinder, changefinder, sst
+
+
+def shifted_series(n1=150, n2=150, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(0.0, 0.2, n1)
+    b = rng.normal(4.0, 0.2, n2)     # mean shift at t = n1
+    return np.concatenate([a, b])
+
+
+def test_changefinder_flags_shift():
+    x = shifted_series()
+    scores = changefinder(x, "-r 0.05 -k 2 -T1 5 -T2 5")
+    cp = np.asarray([s[1] for s in scores])
+    warm = cp[30:]                       # skip burn-in
+    peak = int(np.argmax(warm)) + 30
+    assert 145 <= peak <= 175, peak      # change score peaks near the shift
+    # scores away from the shift are much lower
+    assert cp[100] < cp[peak] * 0.5
+
+
+def test_changefinder_outlier_spike():
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 0.1, 200)
+    x[120] = 5.0
+    scores = changefinder(x, "-r 0.02 -k 2")
+    out = np.asarray([s[0] for s in scores])
+    assert np.argmax(out[20:]) + 20 == 120
+
+
+def test_streaming_matches_batch():
+    x = shifted_series(40, 40)
+    cf = ChangeFinder(0.05, 2, 5, 5)
+    stream = [cf.update(v) for v in x]
+    batch = changefinder(x, "-r 0.05 -k 2 -T1 5 -T2 5")
+    np.testing.assert_allclose(stream, batch, rtol=1e-9)
+
+
+def test_sst_flags_frequency_change():
+    # classic SST scenario: the oscillation frequency changes at t=120
+    # (a mean-only shift inside zero-mean noise has no stable principal
+    # subspace, so frequency change is the discriminative regime here)
+    t = np.arange(240)
+    rng = np.random.default_rng(2)
+    x = np.where(t < 120, np.sin(0.2 * np.pi * t),
+                 np.sin(0.7 * np.pi * t)) + 0.02 * rng.normal(size=240)
+    scores = np.asarray(sst(x, "-w 16 -r 2"))
+    assert scores.shape[0] == 240
+    peak = int(np.argmax(scores))
+    assert 105 <= peak <= 140, peak
+    assert scores[60] < 0.1 and scores[200] < 0.1
+
+
+def test_sst_short_series_zero():
+    assert sst([1.0, 2.0, 3.0], "-w 16") == [0.0, 0.0, 0.0]

@@ -1,0 +1,78 @@
+import numpy as np
+import pytest
+
+from hivemall_tpu.knn import (angular_distance, angular_similarity,
+                              bbit_minhash, cosine_distance,
+                              cosine_similarity, dimsum_mapper,
+                              distance2similarity, euclid_distance,
+                              euclid_similarity, hamming_distance,
+                              jaccard_distance, jaccard_similarity, kld,
+                              manhattan_distance, minhash, minhashes,
+                              minkowski_distance)
+
+
+def test_distances_on_feature_strings():
+    a = ["1:1.0", "2:2.0"]
+    b = ["1:1.0", "3:1.0"]
+    assert euclid_distance(a, b) == pytest.approx(np.sqrt(4 + 1))
+    assert manhattan_distance(a, b) == pytest.approx(3.0)
+    assert minkowski_distance(a, b, 1.0) == pytest.approx(3.0)
+    assert jaccard_distance(a, b) == pytest.approx(1 - 1 / 3)
+    assert cosine_distance(a, a) == pytest.approx(0.0)
+    assert angular_distance(a, a) == pytest.approx(0.0, abs=1e-4)
+
+
+def test_numeric_vectors():
+    assert euclid_distance([0, 0], [3, 4]) == pytest.approx(5.0)
+    assert cosine_similarity([1, 0], [0, 1]) == pytest.approx(0.0)
+    assert cosine_similarity([1, 1], [1, 1]) == pytest.approx(1.0)
+
+
+def test_hamming():
+    assert hamming_distance(0b1010, 0b0011) == 2
+    assert hamming_distance([1, 2, 3], [1, 9, 3]) == 1
+
+
+def test_kld_zero_for_same():
+    assert kld(0.0, 1.0, 0.0, 1.0) == pytest.approx(0.0)
+    assert kld(1.0, 1.0, 0.0, 1.0) > 0
+
+
+def test_similarities():
+    assert euclid_similarity([0], [0]) == 1.0
+    assert distance2similarity(0.0) == 1.0
+    assert jaccard_similarity(["a"], ["a"]) == 1.0
+    assert angular_similarity([1, 0], [1, 0]) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_minhash_similarity_correlates():
+    """Jaccard-similar sets share more minhash buckets than dissimilar."""
+    a = [f"f{i}" for i in range(40)]
+    b = a[:36] + ["x1", "x2", "x3", "x4"]          # ~0.8 similar
+    c = [f"g{i}" for i in range(40)]               # disjoint
+    k = 64
+    ha, hb, hc = minhashes(a, k), minhashes(b, k), minhashes(c, k)
+    share_ab = sum(x == y for x, y in zip(ha, hb)) / k
+    share_ac = sum(x == y for x, y in zip(ha, hc)) / k
+    assert share_ab > 0.5 > share_ac
+    rows = list(minhash(a, 5))
+    assert len(rows) == 5 and rows[0][1] == a
+
+
+def test_bbit_minhash_length():
+    sig = bbit_minhash(["a", "b"], k=16, b=2)
+    assert len(sig) == 32 and set(sig) <= {"0", "1"}
+
+
+def test_dimsum_mapper_partials_sum_to_cosine():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(0, 1, (200, 3)).astype(np.float64)
+    norms = {str(j): float(np.linalg.norm(X[:, j])) for j in range(3)}
+    acc = {}
+    for r in range(200):
+        row = [f"{j}:{X[r, j]}" for j in range(3)]
+        for a, b, p in dimsum_mapper(row, norms, threshold=1e-6, seed=r):
+            acc[(a, b)] = acc.get((a, b), 0.0) + p
+    true = float(X[:, 0] @ X[:, 1] / (norms["0"] * norms["1"]))
+    # with sqrt_gamma >> norms every pair is emitted exactly
+    assert acc[("0", "1")] == pytest.approx(true, rel=1e-6)

@@ -1,0 +1,128 @@
+import numpy as np
+import pytest
+
+from hivemall_tpu.frame import tools as T
+from hivemall_tpu.frame.nlp import tokenize_cn, tokenize_ja
+
+
+def test_array_functions():
+    assert T.array_concat([1, 2], [3]) == [1, 2, 3]
+    assert T.array_avg([[1, 2], [3, 4]]) == [2.0, 3.0]
+    assert T.array_sum([[1, 2], [3, 4]]) == [4.0, 6.0]
+    assert T.array_append([1], 2) == [1, 2]
+    assert T.array_append(None, 1) == [1]
+    assert T.array_union([3, 1], [2, 1]) == [1, 2, 3]
+    assert T.array_intersect([1, 2, 3], [2, 3, 4]) == [2, 3]
+    assert T.array_remove([1, 2, 1], 1) == [2]
+    assert T.array_slice([1, 2, 3, 4], 1, 2) == [2, 3]
+    assert T.array_slice([1, 2, 3, 4], -2) == [3, 4]
+    assert T.array_flatten([[1], [2, 3]]) == [1, 2, 3]
+    assert T.element_at([1, 2], 1) == 2
+    assert T.element_at([1, 2], 5) is None
+    assert T.first_element([7, 8]) == 7
+    assert T.last_element([7, 8]) == 8
+    assert T.sort_and_uniq_array([3, 1, 3]) == [1, 3]
+    assert T.subarray([1, 2, 3], 1, 3) == [2, 3]
+    assert T.subarray_startwith([1, 2, 3], 2) == [2, 3]
+    assert T.subarray_endwith([1, 2, 3], 2) == [1, 2]
+    assert T.to_string_array([1, None]) == ["1", None]
+    assert T.array_to_str([1, 2], "-") == "1-2"
+    assert T.select_k_best([10, 20, 30], [0.1, 0.9, 0.5], 2) == [20, 30]
+    assert T.collect_all(iter([1, 2])) == [1, 2]
+    assert list(T.conditional_emit([True, False, True], "abc")) == ["a", "c"]
+
+
+def test_map_functions():
+    assert T.to_map([1, 2], ["a", "b"]) == {1: "a", 2: "b"}
+    assert list(T.to_ordered_map([2, 1], ["b", "a"])) == [1, 2]
+    assert T.map_get_sum({"a": 1.0, "b": 2.0}, ["a", "b", "z"]) == 3.0
+    assert T.map_tail_n({1: "a", 2: "b", 3: "c"}, 2) == {2: "b", 3: "c"}
+    assert T.map_include_keys({1: "a", 2: "b"}, [1]) == {1: "a"}
+    assert T.map_exclude_keys({1: "a", 2: "b"}, [1]) == {2: "b"}
+    assert T.map_key_values({1: "a"}) == [(1, "a")]
+
+
+def test_list_bits():
+    assert T.to_ordered_list(["b", "a", "c"]) == ["a", "b", "c"]
+    assert T.to_ordered_list([10, 30, 20], [1, 3, 2],
+                             "-k 2 -reverse") == [30, 20]
+    bits = T.to_bits([0, 3, 64])
+    assert T.unbits(bits) == [0, 3, 64]
+    assert T.unbits(T.bits_or(T.to_bits([1]), T.to_bits([2]))) == [1, 2]
+    assert T.unbits(T.bits_collect(iter([5, 1]))) == [1, 5]
+
+
+def test_compress_roundtrip():
+    blob = T.deflate("hello world " * 50, level=6)
+    assert len(blob) < 120
+    assert T.inflate(blob) == "hello world " * 50
+
+
+def test_text_functions():
+    assert T.tokenize("Hello, World!", True) == ["hello", "world"]
+    assert T.is_stopword("the") and not T.is_stopword("tpu")
+    assert T.split_words("a  b\tc") == ["a", "b", "c"]
+    assert T.normalize_unicode("ｱｲｳ") == "アイウ"
+    assert T.singularize("berries") == "berry"
+    assert T.singularize("children") == "child"
+    assert T.singularize("glass") == "glass"
+    data = b"\x00\xffhivemall\x01"
+    assert T.unbase91(T.base91(data)) == data
+    assert T.word_ngrams(["a", "b", "c"], 1, 2) == \
+        ["a", "b", "c", "a b", "b c"]
+
+
+def test_math_matrix():
+    assert T.sigmoid(0.0) == 0.5
+    assert T.sigmoid(100) == pytest.approx(1.0)
+    assert T.sigmoid(-100) == pytest.approx(0.0)
+    assert T.l2_norm([3, 4]) == 5.0
+    out = T.transpose_and_dot([[1, 0], [0, 1]], [[1, 2], [3, 4]])
+    assert out == [[1.0, 2.0], [3.0, 4.0]]
+
+
+def test_mapred_sanity_json_vector():
+    r1, r2 = T.rowid(), T.rowid()
+    assert r1 != r2 and "-" in r1
+    assert isinstance(T.taskid(), int)
+    assert T.jobconf_gets("NOPE_MISSING", "dflt") == "dflt"
+    assert T.assert_(True)
+    with pytest.raises(AssertionError):
+        T.assert_(False, "boom")
+    with pytest.raises(RuntimeError):
+        T.raise_error("x")
+    assert T.from_json(T.to_json({"a": [1, 2]})) == {"a": [1, 2]}
+    assert T.vector_add([1, 2], [3, 4]) == [4.0, 6.0]
+    assert T.vector_dot([1, 2], [3, 4]) == 11.0
+    assert T.vector_dot([1, 2], 2.0) == [2.0, 4.0]
+
+
+def test_sessionize():
+    s = T.sessionize()
+    a = s(100, 30)
+    b = s(120, 30)
+    c = s(200, 30)     # gap 80 > 30 -> new session
+    assert a == b != c
+
+
+def test_sampling_series_topk():
+    out = T.reservoir_sample(range(100), 10, seed=1)
+    assert len(out) == 10 and len(set(out)) == 10
+    assert list(T.generate_series(1, 5, 2)) == [1, 3, 5]
+    assert list(T.generate_series(3, 1, -1)) == [3, 2, 1]
+    groups = ["a", "a", "a", "b", "b"]
+    scores = [0.1, 0.9, 0.5, 0.3, 0.7]
+    vals = ["r1", "r2", "r3", "r4", "r5"]
+    rows = list(T.each_top_k(2, groups, scores, vals))
+    assert rows == [(1, 0.9, "r2"), (2, 0.5, "r3"),
+                    (1, 0.7, "r5"), (2, 0.3, "r4")]
+    bottom = list(T.each_top_k(-1, groups, scores, vals))
+    assert bottom[0] == (1, 0.1, "r1")
+
+
+def test_nlp_tokenizers():
+    ja = tokenize_ja("私はTPUで機械学習を実行します")
+    assert "TPU" in ja and len(ja) >= 5
+    assert tokenize_ja(None) == []
+    cn = tokenize_cn("我爱机器学习ML")
+    assert "我" in cn and "ML" in cn
