@@ -1,0 +1,145 @@
+"""hivemall_tpu CLI — train/predict runners + mixserv packaging.
+
+Reference analogs: the L6/L8 operational surface (SURVEY.md §2, §3.16) —
+define-all DDL listing, bin/run_mixserv.sh, and the HiveQL train/predict
+queries, here as subcommands:
+
+  python -m hivemall_tpu.cli train   --algo train_classifier \
+      --input a9a.libsvm --options '-loss logloss -opt adagrad' \
+      --model model.tsv
+  python -m hivemall_tpu.cli predict --algo train_classifier \
+      --model model.tsv --input a9a.t --output scores.tsv --metric auc
+  python -m hivemall_tpu.cli mixserv --port 11212
+  python -m hivemall_tpu.cli define-all
+  python -m hivemall_tpu.cli help train_ffm
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _cmd_train(args) -> int:
+    from ..catalog import lookup
+    from ..io.libsvm import read_libsvm
+
+    cls = lookup(args.algo).resolve()
+    trainer = cls(args.options or "")
+    ds = read_libsvm(args.input)
+    t0 = time.time()
+    if hasattr(trainer, "fit"):
+        trainer.fit(ds)
+        rows = None
+    else:
+        for i in range(len(ds)):
+            trainer.process(ds.row(i), float(ds.labels[i]))
+        rows = list(trainer.close())
+    dt = time.time() - t0
+    if args.model:
+        if hasattr(trainer, "save_model"):
+            trainer.save_model(args.model)
+        elif rows is not None:
+            with open(args.model, "w") as f:
+                for r in rows:
+                    f.write("\t".join(str(x) for x in r) + "\n")
+    metrics = {"examples": len(ds), "seconds": round(dt, 3),
+               "examples_per_sec": round(len(ds) / max(dt, 1e-9), 1)}
+    if hasattr(trainer, "cumulative_loss"):
+        metrics["cumulative_loss"] = round(trainer.cumulative_loss, 6)
+    print(json.dumps(metrics))
+    return 0
+
+
+def _cmd_predict(args) -> int:
+    from ..catalog import lookup
+    from ..frame.evaluation import auc, logloss, rmse
+    from ..io.libsvm import read_libsvm
+
+    cls = lookup(args.algo).resolve()
+    trainer = cls((args.options or "") + f" -loadmodel {args.model}")
+    ds = read_libsvm(args.input)
+    scores = (trainer.predict_proba(ds) if hasattr(trainer, "predict_proba")
+              else trainer.predict(ds))
+    if args.output:
+        with open(args.output, "w") as f:
+            for i, s in enumerate(scores):
+                f.write(f"{i}\t{float(s):.6g}\n")
+    out = {"rows": len(ds)}
+    if args.metric == "auc":
+        out["auc"] = round(auc(ds.labels, scores), 6)
+    elif args.metric == "logloss":
+        out["logloss"] = round(logloss(ds.labels, scores), 6)
+    elif args.metric == "rmse":
+        out["rmse"] = round(rmse(ds.labels, scores), 6)
+    print(json.dumps(out))
+    return 0
+
+
+def _cmd_mixserv(args) -> int:
+    """The bin/run_mixserv.sh analog: a standalone mix server."""
+    from ..parallel.mix_service import MixServer
+
+    srv = MixServer(args.host, args.port).start()
+    print(json.dumps({"host": srv.host, "port": srv.port}))
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+def _cmd_define_all(args) -> int:
+    from ..catalog import define_all
+    print(define_all())
+    return 0
+
+
+def _cmd_help(args) -> int:
+    from ..catalog import help_for
+    print(help_for(args.function))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="hivemall_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="train a catalog algorithm on LIBSVM")
+    t.add_argument("--algo", required=True)
+    t.add_argument("--input", required=True)
+    t.add_argument("--options", default="")
+    t.add_argument("--model", default=None)
+    t.set_defaults(fn=_cmd_train)
+
+    pr = sub.add_parser("predict", help="score a LIBSVM file with a model")
+    pr.add_argument("--algo", required=True)
+    pr.add_argument("--model", required=True)
+    pr.add_argument("--input", required=True)
+    pr.add_argument("--output", default=None)
+    pr.add_argument("--options", default="")
+    pr.add_argument("--metric", default=None,
+                    choices=[None, "auc", "logloss", "rmse"])
+    pr.set_defaults(fn=_cmd_predict)
+
+    m = sub.add_parser("mixserv", help="run a standalone mix server")
+    m.add_argument("--host", default="0.0.0.0")
+    m.add_argument("--port", type=int, default=11212)
+    m.set_defaults(fn=_cmd_mixserv)
+
+    d = sub.add_parser("define-all", help="print the function manifest")
+    d.set_defaults(fn=_cmd_define_all)
+
+    h = sub.add_parser("help", help="show a function's option grammar")
+    h.add_argument("function")
+    h.set_defaults(fn=_cmd_help)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Frame — the HivemallOps DataFrame binding analog (SURVEY.md §3.18 L7).
+
+Reference: org.apache.spark.sql.hive.HivemallOps exposes every major
+UDF/UDTF as a DataFrame method (``df.train_logregr(add_bias($"features"),
+$"label")``) plus each_top_k as a typed op. Here, a thin columnar table over
+numpy arrays plays that role: every registered ``train_*`` catalog function
+is auto-exposed as a method returning the model as a new Frame, scalar UDFs
+apply via ``map_column``, and ``each_top_k`` keeps its forward-order
+contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..catalog import all_functions, lookup
+from .tools import each_top_k as _each_top_k
+
+__all__ = ["Frame"]
+
+
+class Frame:
+    """Immutable-ish dict-of-columns table with HivemallOps-style methods."""
+
+    def __init__(self, data: Dict[str, Sequence]):
+        n = None
+        self._cols: Dict[str, np.ndarray | list] = {}
+        for k, v in data.items():
+            vv = v if isinstance(v, (list, np.ndarray)) else list(v)
+            if n is None:
+                n = len(vv)
+            elif len(vv) != n:
+                raise ValueError(f"column {k!r}: length {len(vv)} != {n}")
+            self._cols[k] = vv
+        self._n = n or 0
+
+    # -- basic table ops -----------------------------------------------------
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._cols)
+
+    def __getitem__(self, col: str):
+        return self._cols[col]
+
+    def rows(self) -> Iterable[Dict[str, Any]]:
+        cols = self._cols
+        for i in range(self._n):
+            yield {k: v[i] for k, v in cols.items()}
+
+    def select(self, *cols: str) -> "Frame":
+        return Frame({c: self._cols[c] for c in cols})
+
+    def with_column(self, name: str, values: Sequence) -> "Frame":
+        d = dict(self._cols)
+        d[name] = values
+        return Frame(d)
+
+    def map_column(self, src: str, dst: str, fn: Callable) -> "Frame":
+        """Apply a scalar/array UDF (e.g. catalog 'add_bias') row-wise."""
+        return self.with_column(dst, [fn(v) for v in self._cols[src]])
+
+    def filter(self, mask: Sequence[bool]) -> "Frame":
+        idx = [i for i, m in enumerate(mask) if m]
+        return Frame({k: [v[i] for i in idx] for k, v in self._cols.items()})
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame(dict(self._cols))
+
+    # -- HivemallOps surface -------------------------------------------------
+    def _train(self, algo: str, features_col: str, label_col: Optional[str],
+               options: str) -> "Frame":
+        cls = lookup(algo).resolve()
+        trainer = cls(options)
+        feats = self._cols[features_col]
+        if label_col is None:
+            for f in feats:
+                trainer.process(f)
+        else:
+            labels = self._cols[label_col]
+            for f, y in zip(feats, labels):
+                trainer.process(f, y)
+        rows = list(trainer.close())
+        if not rows:
+            return Frame({})
+        width = max(len(r) if isinstance(r, tuple) else 1 for r in rows)
+        names = ["feature", "weight", "covar", "extra"][:width] if width <= 4 \
+            else [f"c{i}" for i in range(width)]
+        cols: Dict[str, list] = {nm: [] for nm in names}
+        for r in rows:
+            tup = r if isinstance(r, tuple) else (r,)
+            for nm, v in zip(names, tup + (None,) * (width - len(tup))):
+                cols[nm].append(v)
+        f = Frame(cols)
+        f.trainer = trainer       # scoring access (predict-side join analog)
+        return f
+
+    def each_top_k(self, k: int, group_col: str, score_col: str,
+                   *value_cols: str) -> "Frame":
+        rows = list(_each_top_k(k, self._cols[group_col],
+                                self._cols[score_col],
+                                *[self._cols[c] for c in value_cols]))
+        out: Dict[str, list] = {"rank": [], "score": []}
+        for vc in value_cols:
+            out[vc] = []
+        for r in rows:
+            out["rank"].append(r[0])
+            out["score"].append(r[1])
+            for vc, v in zip(value_cols, r[2:]):
+                out[vc].append(v)
+        return Frame(out)
+
+    def __getattr__(self, name: str):
+        # auto-expose every catalog trainer as df.train_xxx(features, label)
+        if name.startswith("train_"):
+            try:
+                lookup(name)
+            except KeyError as e:
+                raise AttributeError(name) from e
+
+            def method(features_col: str, label_col: Optional[str] = None,
+                       options: str = "") -> "Frame":
+                return self._train(name, features_col, label_col, options)
+
+            return method
+        raise AttributeError(name)

@@ -1,0 +1,135 @@
+"""ctypes bridge to the C++ native runtime pieces (native/hivemall_native.cpp).
+
+Build-on-first-use: the shared object compiles with g++ into
+``native/_native.so`` the first time it's needed (a few hundred ms), then
+loads via ctypes. Everything here degrades gracefully — any failure (no
+compiler, read-only checkout, HIVEMALL_TPU_NO_NATIVE=1) leaves the pure
+Python/numpy paths in charge with identical semantics; tests pin the
+bit-exact parity between the two.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["get_lib", "mmh3_batch_native", "mhash_batch_native",
+           "parse_libsvm_native"]
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native",
+    "hivemall_native.cpp")
+_SO = os.path.join(os.path.dirname(_SRC), "_native.so")
+
+
+def _build() -> bool:
+    try:
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               _SRC, "-o", _SO]
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        return r.returncode == 0
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("HIVEMALL_TPU_NO_NATIVE") == "1":
+        return None
+    if not os.path.exists(_SO) or (os.path.exists(_SRC) and
+                                   os.path.getmtime(_SO)
+                                   < os.path.getmtime(_SRC)):
+        if not os.path.exists(_SRC) or not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.mmh3_32.restype = ctypes.c_uint32
+    lib.mmh3_32.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32]
+    lib.mmh3_batch.restype = None
+    lib.mhash_batch.restype = None
+    lib.libsvm_parse.restype = ctypes.c_void_p
+    lib.libsvm_parse.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.libsvm_rows.restype = ctypes.c_int64
+    lib.libsvm_rows.argtypes = [ctypes.c_void_p]
+    lib.libsvm_nnz.restype = ctypes.c_int64
+    lib.libsvm_nnz.argtypes = [ctypes.c_void_p]
+    lib.libsvm_fill.restype = None
+    lib.libsvm_free.restype = None
+    lib.libsvm_free.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return _LIB
+
+
+def _pack(keys: Sequence[bytes | str]):
+    enc = [k.encode("utf-8") if isinstance(k, str) else k for k in keys]
+    offsets = np.zeros(len(enc) + 1, np.int64)
+    for i, b in enumerate(enc):
+        offsets[i + 1] = offsets[i] + len(b)
+    return b"".join(enc), offsets
+
+
+def mmh3_batch_native(keys: Sequence[bytes | str],
+                      seed: int = 0) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None or not len(keys):
+        return None
+    buf, offsets = _pack(keys)
+    out = np.empty(len(keys), np.uint32)
+    lib.mmh3_batch(buf, offsets.ctypes.data_as(ctypes.c_void_p),
+                   ctypes.c_int64(len(keys)), ctypes.c_uint32(seed),
+                   out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def mhash_batch_native(keys: Sequence[bytes | str], num_features: int,
+                       seed: int = 0) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None or not len(keys):
+        return None
+    buf, offsets = _pack(keys)
+    out = np.empty(len(keys), np.int64)
+    lib.mhash_batch(buf, offsets.ctypes.data_as(ctypes.c_void_p),
+                    ctypes.c_int64(len(keys)), ctypes.c_uint32(seed),
+                    ctypes.c_int64(num_features),
+                    out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def parse_libsvm_native(path: str, *, zero_based: bool = False):
+    """Parse a LIBSVM file with the C++ parser; None -> caller falls back."""
+    if path.endswith(".gz"):
+        return None
+    lib = get_lib()
+    if lib is None:
+        return None
+    h = lib.libsvm_parse(path.encode(), 1 if zero_based else 0)
+    if not h:
+        return None
+    try:
+        n = lib.libsvm_rows(h)
+        nnz = lib.libsvm_nnz(h)
+        idx = np.empty(nnz, np.int32)
+        val = np.empty(nnz, np.float32)
+        indptr = np.empty(n + 1, np.int64)
+        labels = np.empty(n, np.float32)
+        lib.libsvm_fill(ctypes.c_void_p(h),
+                        idx.ctypes.data_as(ctypes.c_void_p),
+                        indptr.ctypes.data_as(ctypes.c_void_p),
+                        val.ctypes.data_as(ctypes.c_void_p),
+                        labels.ctypes.data_as(ctypes.c_void_p))
+    finally:
+        lib.libsvm_free(ctypes.c_void_p(h))
+    from ..io.sparse import SparseDataset
+    return SparseDataset(idx, indptr, val, labels)

@@ -1,0 +1,148 @@
+// hivemall_tpu native runtime pieces (C++), loaded via ctypes.
+//
+// The reference's host-side hot paths are JVM (Text parsing inside
+// GenericUDTF.process) with one native dependency (libxgboost). In the TPU
+// rebuild the accelerator math is XLA-compiled, so the remaining native-worthy
+// hot path is INGEST: LIBSVM/feature-string parsing and murmur3 feature
+// hashing feed batches to the device and must outrun the TPU, not Python.
+//
+// Exposed C ABI (see hivemall_tpu/utils/native.py):
+//   mmh3_32           - MurmurHash3_x86_32 of one key
+//   mmh3_batch        - hash n packed keys (buf + offsets) -> uint32[n]
+//   mhash_batch       - same, reduced into [1, num_features] (signed-mod +1)
+//   libsvm_parse/rows/nnz/fill/free - two-phase LIBSVM file parser
+//
+// Build: g++ -O3 -march=native -shared -fPIC hivemall_native.cpp -o _native.so
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+extern "C" uint32_t mmh3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const uint32_t c1 = 0xcc9e2d51u, c2 = 0x1b873593u;
+  uint32_t h = seed;
+  const int64_t nblocks = len / 4;
+  const uint8_t* p = data;
+  for (int64_t i = 0; i < nblocks; ++i, p += 4) {
+    uint32_t k;
+    memcpy(&k, p, 4);  // little-endian hosts only (x86/arm64)
+    k *= c1; k = rotl32(k, 15); k *= c2;
+    h ^= k; h = rotl32(h, 13); h = h * 5u + 0xe6546b64u;
+  }
+  uint32_t k = 0;
+  switch (len & 3) {
+    case 3: k ^= (uint32_t)p[2] << 16; [[fallthrough]];
+    case 2: k ^= (uint32_t)p[1] << 8;  [[fallthrough]];
+    case 1: k ^= (uint32_t)p[0];
+            k *= c1; k = rotl32(k, 15); k *= c2; h ^= k;
+  }
+  h ^= (uint32_t)len;
+  h ^= h >> 16; h *= 0x85ebca6bu;
+  h ^= h >> 13; h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+extern "C" void mmh3_batch(const uint8_t* buf, const int64_t* offsets,
+                           int64_t n, uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = mmh3_32(buf + offsets[i], offsets[i + 1] - offsets[i], seed);
+  }
+}
+
+extern "C" void mhash_batch(const uint8_t* buf, const int64_t* offsets,
+                            int64_t n, uint32_t seed, int64_t num_features,
+                            int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t h = mmh3_32(buf + offsets[i], offsets[i + 1] - offsets[i], seed);
+    int64_t s = (int64_t)(int32_t)h;  // signed view, then non-negative mod
+    int64_t r = s % num_features;
+    if (r < 0) r += num_features;
+    out[i] = r + 1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// LIBSVM parser: handle-based two-phase API for ctypes.
+
+struct LibsvmData {
+  std::vector<int32_t> idx;
+  std::vector<float> val;
+  std::vector<int64_t> indptr;
+  std::vector<float> labels;
+};
+
+extern "C" void* libsvm_parse(const char* path, int zero_based) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<char> buf((size_t)size + 1);
+  if (size > 0 && fread(buf.data(), 1, (size_t)size, f) != (size_t)size) {
+    fclose(f);
+    return nullptr;
+  }
+  fclose(f);
+  buf[(size_t)size] = '\0';
+
+  auto* d = new LibsvmData();
+  d->indptr.push_back(0);
+  const int shift = zero_based ? 1 : 0;
+  char* p = buf.data();
+  char* end = buf.data() + size;
+  while (p < end) {
+    while (p < end && (*p == ' ' || *p == '\t')) ++p;
+    if (p >= end) break;
+    if (*p == '\n' || *p == '\r') { ++p; continue; }
+    if (*p == '#') { while (p < end && *p != '\n') ++p; continue; }
+    char* q;
+    float label = strtof(p, &q);
+    if (q == p) { delete d; return nullptr; }
+    p = q;
+    while (p < end && *p != '\n') {
+      while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+      if (p >= end || *p == '\n') break;
+      long i = strtol(p, &q, 10);
+      if (q == p) { delete d; return nullptr; }
+      p = q;
+      float v = 1.0f;
+      if (*p == ':') {
+        ++p;
+        v = strtof(p, &q);
+        if (q == p) { delete d; return nullptr; }
+        p = q;
+      }
+      d->idx.push_back((int32_t)(i + shift));
+      d->val.push_back(v);
+    }
+    d->labels.push_back(label);
+    d->indptr.push_back((int64_t)d->idx.size());
+  }
+  return d;
+}
+
+extern "C" int64_t libsvm_rows(void* h) {
+  return (int64_t)((LibsvmData*)h)->labels.size();
+}
+
+extern "C" int64_t libsvm_nnz(void* h) {
+  return (int64_t)((LibsvmData*)h)->idx.size();
+}
+
+extern "C" void libsvm_fill(void* h, int32_t* idx, int64_t* indptr,
+                            float* val, float* labels) {
+  auto* d = (LibsvmData*)h;
+  memcpy(idx, d->idx.data(), d->idx.size() * sizeof(int32_t));
+  memcpy(val, d->val.data(), d->val.size() * sizeof(float));
+  memcpy(indptr, d->indptr.data(), d->indptr.size() * sizeof(int64_t));
+  memcpy(labels, d->labels.data(), d->labels.size() * sizeof(float));
+}
+
+extern "C" void libsvm_free(void* h) { delete (LibsvmData*)h; }

@@ -1,0 +1,88 @@
+"""Frame (HivemallOps analog) + CLI end-to-end (systemtest analog,
+SURVEY.md §5.4: real workflow through the public operational surface)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.frame.dataframe import Frame
+from hivemall_tpu.ftvec import add_bias
+
+
+def test_frame_basics():
+    f = Frame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    assert len(f) == 3
+    assert f.select("a").columns == ["a"]
+    g = f.with_column("c", [7, 8, 9]).filter([True, False, True])
+    assert g["c"] == [7, 9]
+    assert list(g.rows())[1]["b"] == "z"
+
+
+def test_frame_train_method_and_each_top_k():
+    rng = np.random.default_rng(0)
+    feats, labels = [], []
+    for _ in range(200):
+        y = 1 if rng.random() < 0.5 else -1
+        feats.append([f"{1 if y > 0 else 2}:1.0"])
+        labels.append(y)
+    df = Frame({"features": feats, "label": labels})
+    df = df.map_column("features", "features", add_bias)
+    model = df.train_classifier("features", "label",
+                                "-dims 256 -mini_batch 16 -eta0 0.5")
+    assert "feature" in model.columns
+    w = dict(zip(model["feature"], model["weight"]))
+    assert w["1"] > 0 > w["2"]
+
+    scores = Frame({"g": ["a", "a", "b"], "s": [0.1, 0.9, 0.5],
+                    "item": ["i1", "i2", "i3"]})
+    top = scores.each_top_k(1, "g", "s", "item")
+    assert top["item"] == ["i2", "i3"]
+    assert top["rank"] == [1, 1]
+
+
+def test_frame_unknown_trainer_raises():
+    with pytest.raises(AttributeError):
+        Frame({"x": [1]}).train_nonexistent
+
+
+def _cli(args):
+    import hivemall_tpu.cli.main as m
+    return m.main(args)
+
+
+def test_cli_train_predict_roundtrip(tmp_path, capsys):
+    from hivemall_tpu.io.libsvm import synthetic_classification, write_libsvm
+    ds, _ = synthetic_classification(400, 50, seed=21)
+    train_p = str(tmp_path / "train.libsvm")
+    model_p = str(tmp_path / "model.tsv")
+    out_p = str(tmp_path / "scores.tsv")
+    write_libsvm(ds, train_p)
+
+    rc = _cli(["train", "--algo", "train_classifier", "--input", train_p,
+               "--options",
+               "-dims 256 -loss logloss -opt adagrad -reg no -eta fixed "
+               "-eta0 0.3 -mini_batch 64 -iters 3",
+               "--model", model_p])
+    assert rc == 0
+    train_out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert train_out["examples"] == 400
+
+    rc = _cli(["predict", "--algo", "train_classifier", "--model", model_p,
+               "--input", train_p, "--output", out_p,
+               "--options", "-dims 256", "--metric", "auc"])
+    assert rc == 0
+    pred_out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert pred_out["auc"] > 0.9
+    assert len(open(out_p).readlines()) == 400
+
+
+def test_cli_define_all_and_help(capsys):
+    assert _cli(["define-all"]) == 0
+    ddl = capsys.readouterr().out
+    assert "train_ffm" in ddl and "each_top_k" in ddl
+    assert _cli(["help", "train_ffm"]) == 0
+    h = capsys.readouterr().out
+    assert "-factors" in h and "hivemall.fm" in h
