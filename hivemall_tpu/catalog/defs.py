@@ -180,12 +180,12 @@ register("mhash", "UDF", "hivemall_tpu.utils.hashing:mhash",
 # --- general trainers (SURVEY.md §3.3, §3.5) -------------------------------
 
 
-def _learner(name, cls_path, ref, desc):
+def _learner(name, cls_path, ref, desc, aliases=None):
     from importlib import import_module
     mod, _, attr = cls_path.partition(":")
     cls = getattr(import_module(mod), attr)
     register(name, "UDTF", cls_path, description=desc, reference=ref,
-             options=cls.spec())
+             options=cls.spec(), aliases=aliases)
 
 
 _learner("train_classifier", "hivemall_tpu.models.linear:GeneralClassifier",
@@ -196,7 +196,7 @@ _learner("train_regressor", "hivemall_tpu.models.linear:GeneralRegressor",
          "general regressor: pluggable loss x optimizer x reg")
 _learner("train_logregr", "hivemall_tpu.models.linear:LogressTrainer",
          "hivemall.regression.LogressUDTF",
-         "logistic regression by SGD")
+         "logistic regression by SGD", aliases=["logress"])
 _learner("train_adagrad_regr",
          "hivemall_tpu.models.linear:AdaGradLogisticTrainer",
          "hivemall.regression.AdaGradUDTF",
@@ -403,6 +403,10 @@ for _name, _target, _ref, _desc, _kind in [
      "hivemall.ftvec.trans.IndexedFeatures", "1:v1 2:v2 ...", "UDF"),
     ("onehot_encoding", "trans:onehot_encoding",
      "hivemall.ftvec.trans.OnehotEncodingUDAF", "global one-hot map", "UDAF"),
+    ("quantified_features", "trans:quantified_features",
+     "hivemall.ftvec.trans.QuantifiedFeaturesUDTF",
+     "array<double> rows with categoricals int-coded over the stream",
+     "UDTF"),
     ("ffm_features", "trans:ffm_features",
      "hivemall.ftvec.trans.FFMFeaturesUDF",
      "field:index:value triples for train_ffm", "UDF"),

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import shlex
 import sys
 import time
 
@@ -59,10 +60,19 @@ def _cmd_predict(args) -> int:
     from ..io.libsvm import read_libsvm
 
     cls = lookup(args.algo).resolve()
-    trainer = cls((args.options or "") + f" -loadmodel {args.model}")
+    trainer = cls((args.options or "")
+                  + f" -loadmodel {shlex.quote(args.model)}")
     ds = read_libsvm(args.input)
-    scores = (trainer.predict_proba(ds) if hasattr(trainer, "predict_proba")
-              else trainer.predict(ds))
+    # Classifiers score in probability space (auc/logloss need it);
+    # regressors must emit raw predictions — sigmoid-squashing them would
+    # make rmse/mae against real-valued labels meaningless.
+    classification = getattr(trainer, "CLASSIFICATION", True)
+    if classification and hasattr(trainer, "predict_proba"):
+        scores = trainer.predict_proba(ds)
+    elif hasattr(trainer, "decision_function"):
+        scores = trainer.decision_function(ds)
+    else:
+        scores = trainer.predict(ds)
     if args.output:
         with open(args.output, "w") as f:
             for i, s in enumerate(scores):

@@ -105,14 +105,18 @@ class Frame:
         rows = list(_each_top_k(k, self._cols[group_col],
                                 self._cols[score_col],
                                 *[self._cols[c] for c in value_cols]))
-        out: Dict[str, list] = {"rank": [], "score": []}
+        # output columns: rank, score, then the value columns — uniquified so
+        # a value column literally named "rank"/"score" cannot collide
+        names = ["rank", "score"]
         for vc in value_cols:
-            out[vc] = []
+            nm = vc
+            while nm in names:
+                nm += "_"
+            names.append(nm)
+        out: Dict[str, list] = {nm: [] for nm in names}
         for r in rows:
-            out["rank"].append(r[0])
-            out["score"].append(r[1])
-            for vc, v in zip(value_cols, r[2:]):
-                out[vc].append(v)
+            for nm, v in zip(names, r):
+                out[nm].append(v)
         return Frame(out)
 
     def __getattr__(self, name: str):

@@ -14,7 +14,7 @@ from ..utils.hashing import DEFAULT_NUM_FEATURES, mhash
 
 __all__ = ["binarize_label", "categorical_features", "quantitative_features",
            "vectorize_features", "indexed_features", "onehot_encoding",
-           "ffm_features"]
+           "ffm_features", "quantified_features"]
 
 
 def categorical_features(names: Sequence[str], *values) -> List[str]:
@@ -79,6 +79,34 @@ def onehot_encoding(columns: Sequence[Sequence]) -> Dict:
         out[ci] = {c: offset + i for i, c in enumerate(cats)}
         offset += len(cats)
     return out
+
+
+class quantified_features:
+    """SQL: quantified_features(output_row, col1, col2, ...) — emit
+    array<double> per row with categorical columns replaced by dense int
+    codes (first-seen order over the stream) and numbers passed through.
+
+    Reference: hivemall.ftvec.trans.QuantifiedFeaturesUDTF — the feature-array
+    sibling of conv.quantify (SURVEY.md §3.12 trans row). Stateful:
+
+        q = quantified_features()
+        vecs = [q(row) for row in rows]
+    """
+
+    def __init__(self) -> None:
+        self._maps: List[Dict] = []
+
+    def __call__(self, row: Sequence) -> List[float]:
+        while len(self._maps) < len(row):
+            self._maps.append({})
+        out = []
+        for i, v in enumerate(row):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out.append(float(v))
+            else:
+                m = self._maps[i]
+                out.append(float(m.setdefault(v, len(m))))
+        return out
 
 
 def ffm_features(names: Sequence[str], *values,

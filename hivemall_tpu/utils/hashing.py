@@ -75,13 +75,21 @@ def murmurhash3_x86_32(data: bytes | str, seed: int = 0) -> int:
     return h
 
 
-def murmurhash3_batch(keys: Sequence[bytes | str], seed: int = 0) -> np.ndarray:
+def murmurhash3_batch(keys: Sequence[bytes | str], seed: int = 0,
+                      use_native: bool = True) -> np.ndarray:
     """Hash many keys; returns uint32 array. Vectorized over same-length groups.
 
-    Strategy: bucket keys by byte length, pack each bucket into a (n, L) uint8
-    matrix, and run the whole murmur3 pipeline with numpy uint32 arithmetic —
-    identical rounds for every key of the same length, so fully vectorizable.
+    Dispatches to the C++ kernel (utils.native) when built; the numpy fallback
+    buckets keys by byte length, packs each bucket into a (n, L) uint8 matrix,
+    and runs the whole murmur3 pipeline with uint32 arithmetic — identical
+    rounds for every key of the same length, so fully vectorizable.
+    ``use_native=False`` pins the numpy path (parity tests).
     """
+    if use_native:
+        from .native import mmh3_batch_native
+        native = mmh3_batch_native(keys, seed)
+        if native is not None:
+            return native
     enc: List[bytes] = [k.encode("utf-8") if isinstance(k, str) else k for k in keys]
     out = np.empty(len(enc), dtype=np.uint32)
     if not enc:
@@ -158,8 +166,13 @@ def mhash(word: str | bytes, num_features: int = DEFAULT_NUM_FEATURES,
 
 def mhash_batch(words: Sequence[str | bytes],
                 num_features: int = DEFAULT_NUM_FEATURES,
-                seed: int = 0) -> np.ndarray:
+                seed: int = 0, use_native: bool = True) -> np.ndarray:
     """Vectorized mhash; returns int64 array of ids in [1, num_features]."""
-    h = murmurhash3_batch(words, seed).astype(np.int64)
+    if use_native:
+        from .native import mhash_batch_native
+        native = mhash_batch_native(words, num_features, seed)
+        if native is not None:
+            return native
+    h = murmurhash3_batch(words, seed, use_native=False).astype(np.int64)
     signed = np.where(h >= (1 << 31), h - (1 << 32), h)
     return signed % num_features + 1

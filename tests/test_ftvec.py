@@ -78,6 +78,11 @@ def test_trans():
     assert rows == [("payload", 1), ("payload", 1), ("payload", 0)]
     enc = onehot_encoding([["b", "a"], ["x"]])
     assert enc[0] == {"a": 1, "b": 2} and enc[1] == {"x": 3}
+    from hivemall_tpu.ftvec.trans import quantified_features
+    qf = quantified_features()
+    assert qf(["a", 5]) == [0.0, 5.0]
+    assert qf(["b", 6.5]) == [1.0, 6.5]
+    assert qf(["a", 7]) == [0.0, 7.0]
 
 
 def test_ffm_features():
