@@ -16,3 +16,14 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize (triggered by PALLAS_AXON_POOL_IPS at interpreter
+# startup) registers the tunneled-TPU PJRT plugin and overrides the platform
+# selection to "axon,cpu" via jax.config — which makes the JAX_PLATFORMS env
+# var above a no-op and every backends() call block on the tunnel. Re-pin the
+# config to cpu AFTER that registration (jax is already imported by
+# sitecustomize, so this import is cheap and backends are not yet
+# initialized).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
