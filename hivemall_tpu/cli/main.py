@@ -66,9 +66,15 @@ def _cmd_predict(args) -> int:
     # Classifiers score in probability space (auc/logloss need it);
     # regressors must emit raw predictions — sigmoid-squashing them would
     # make rmse/mae against real-valued labels meaningless.
-    classification = getattr(trainer, "CLASSIFICATION", True)
-    if classification and hasattr(trainer, "predict_proba"):
-        scores = trainer.predict_proba(ds)
+    # Instance-level `classification` wins over the class default: FM/FFM
+    # flip it per the -classification option at construction time.
+    classification = getattr(trainer, "classification",
+                             getattr(trainer, "CLASSIFICATION", True))
+    if classification:
+        # predict() sigmoids in classification mode for trainers without a
+        # dedicated predict_proba (e.g. FM/FFM).
+        scores = (trainer.predict_proba(ds)
+                  if hasattr(trainer, "predict_proba") else trainer.predict(ds))
     elif hasattr(trainer, "decision_function"):
         scores = trainer.decision_function(ds)
     else:

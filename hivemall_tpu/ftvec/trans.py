@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..utils.hashing import DEFAULT_NUM_FEATURES, mhash
+from .conv import quantify
 
 __all__ = ["binarize_label", "categorical_features", "quantitative_features",
            "vectorize_features", "indexed_features", "onehot_encoding",
@@ -81,32 +82,24 @@ def onehot_encoding(columns: Sequence[Sequence]) -> Dict:
     return out
 
 
-class quantified_features:
-    """SQL: quantified_features(output_row, col1, col2, ...) — emit
-    array<double> per row with categorical columns replaced by dense int
-    codes (first-seen order over the stream) and numbers passed through.
+class quantified_features(quantify):
+    """SQL: quantified_features(col1, col2, ...) — emit array<double> per row
+    with categorical columns replaced by dense int codes (first-seen order
+    over the stream) and numbers passed through.
 
     Reference: hivemall.ftvec.trans.QuantifiedFeaturesUDTF — the feature-array
-    sibling of conv.quantify (SURVEY.md §3.12 trans row). Stateful:
+    sibling of conv.quantify (SURVEY.md §3.12 trans row), so it shares
+    quantify's encoder state machine and differs only in emitting doubles.
+    Unlike the reference UDTF there is no leading ``output_row`` boolean: the
+    reference uses it to gate row emission under Hive's streaming contract,
+    which a stateful Python callable doesn't need. Stateful:
 
         q = quantified_features()
         vecs = [q(row) for row in rows]
     """
 
-    def __init__(self) -> None:
-        self._maps: List[Dict] = []
-
     def __call__(self, row: Sequence) -> List[float]:
-        while len(self._maps) < len(row):
-            self._maps.append({})
-        out = []
-        for i, v in enumerate(row):
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                out.append(float(v))
-            else:
-                m = self._maps[i]
-                out.append(float(m.setdefault(v, len(m))))
-        return out
+        return [float(x) for x in super().__call__(row)]
 
 
 def ffm_features(names: Sequence[str], *values,

@@ -79,6 +79,33 @@ def test_cli_train_predict_roundtrip(tmp_path, capsys):
     assert len(open(out_p).readlines()) == 400
 
 
+def test_cli_predict_fm_classification_scores_are_probabilities(tmp_path,
+                                                                capsys):
+    """FM sets -classification per instance (class attr stays False); the
+    predict dispatch must still score in probability space for logloss."""
+    from hivemall_tpu.io.libsvm import synthetic_classification, write_libsvm
+    ds, _ = synthetic_classification(300, 40, seed=5)
+    train_p = str(tmp_path / "train.libsvm")
+    model_p = str(tmp_path / "model.msgpack")
+    out_p = str(tmp_path / "scores.tsv")
+    write_libsvm(ds, train_p)
+
+    opts = "-dims 128 -factors 4 -classification -mini_batch 64 -iters 2"
+    rc = _cli(["train", "--algo", "train_fm", "--input", train_p,
+               "--options", opts, "--model", model_p])
+    assert rc == 0
+    capsys.readouterr()
+
+    rc = _cli(["predict", "--algo", "train_fm", "--model", model_p,
+               "--input", train_p, "--output", out_p,
+               "--options", opts, "--metric", "logloss"])
+    assert rc == 0
+    pred_out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert 0.0 < pred_out["logloss"] < 0.69  # better than chance, in prob space
+    scores = [float(l.split("\t")[1]) for l in open(out_p)]
+    assert all(0.0 <= s <= 1.0 for s in scores)
+
+
 def test_cli_define_all_and_help(capsys):
     assert _cli(["define-all"]) == 0
     ddl = capsys.readouterr().out
