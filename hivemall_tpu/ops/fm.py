@@ -17,6 +17,7 @@ cell updates.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, Dict, Tuple
 
 import jax
@@ -28,43 +29,62 @@ from .optimizers import Optimizer
 __all__ = ["fm_score", "ffm_score", "make_fm_step", "make_ffm_step"]
 
 
+def _fm_slab_phi(w0, wg, Vg, val):
+    """FM score from gathered slabs wg [B,L], Vg [B,L,K]:
+    phi = w0 + sum_i w_i x_i + 1/2 sum_f [(sum_i v_if x_i)^2 - sum v^2 x^2]."""
+    wi = (wg * val).sum(-1)
+    xv = Vg * val[..., None]
+    s = xv.sum(1)
+    s2 = (xv ** 2).sum(1)
+    return w0 + wi + 0.5 * (s * s - s2).sum(-1)
+
+
+def _ffm_slab_phi(w0, wg, Ag, val):
+    """FFM score from gathered slabs wg [B,L], Ag [B,L,L,K] where
+    Ag[b,i,j] = V[idx[b,i], field[b,j]]:
+    phi = w0 + sum_i w_i x_i + sum_{i<j} (A[i,j] . A[j,i]) x_i x_j."""
+    L = val.shape[1]
+    wi = (wg * val).sum(-1)
+    inter = jnp.einsum("bijk,bjik->bij", Ag, Ag)
+    xx = val[:, :, None] * val[:, None, :]
+    iu = jnp.triu(jnp.ones((L, L), jnp.float32), k=1)
+    return w0 + wi + (inter * xx * iu[None]).sum((1, 2))
+
+
 def fm_score(w0, w, V, idx, val):
-    """phi = w0 + sum_i w_i x_i + 1/2 sum_f [(sum_i v_if x_i)^2 - sum v^2 x^2].
+    """Table-level FM score: gather slabs, delegate to _fm_slab_phi.
 
     Reference formula: FMPredictGenericUDAF (SURVEY.md §3.6 row 2)."""
-    wi = (w[idx].astype(jnp.float32) * val).sum(-1)
-    Vg = V[idx].astype(jnp.float32)                      # [B, L, K]
-    s = (Vg * val[..., None]).sum(1)                     # [B, K]
-    s2 = ((Vg * val[..., None]) ** 2).sum(1)             # [B, K]
-    return w0.astype(jnp.float32) + wi + 0.5 * (s * s - s2).sum(-1)
+    return _fm_slab_phi(w0.astype(jnp.float32),
+                        w[idx].astype(jnp.float32),
+                        V[idx].astype(jnp.float32), val)
 
 
 def ffm_score(w0, w, V, idx, val, field):
-    """phi = w0 + sum_i w_i x_i + sum_{i<j} (V[i,f_j] . V[j,f_i]) x_i x_j.
+    """Table-level FFM score: pair-flat gather, delegate to _ffm_slab_phi.
 
     V: [N, F, K]; idx/field: [B, L]. Reference: FFMPredictUDF pairwise
     field-crossed dots (SURVEY.md §3.6 row 4)."""
-    B, L = idx.shape
     N, F, K = V.shape
-    wi = (w[idx].astype(jnp.float32) * val).sum(-1)
     V2 = V.reshape(N * F, K)
     flat = idx[:, :, None] * F + field[:, None, :]       # [B, L(i), L(j)]
-    A = V2[flat].astype(jnp.float32)                     # [B, L, L, K]
-    inter = jnp.einsum("bijk,bjik->bij", A, A)
-    xx = val[:, :, None] * val[:, None, :]               # x_i x_j
-    iu = jnp.triu(jnp.ones((L, L), jnp.float32), k=1)    # i < j
-    return w0.astype(jnp.float32) + wi + (inter * xx * iu[None]).sum((1, 2))
+    return _ffm_slab_phi(w0.astype(jnp.float32),
+                         w[idx].astype(jnp.float32),
+                         V2[flat].astype(jnp.float32), val)
 
 
-def _make_factor_step(score_fn: Callable, loss: Loss, optimizer: Optimizer,
-                      lambdas: Tuple[float, float, float]) -> Callable:
+def _make_factor_step_dense(score_fn: Callable, loss: Loss,
+                            optimizer: Optimizer,
+                            lambdas: Tuple[float, float, float]) -> Callable:
     """Shared FM/FFM jitted step: value_and_grad + per-table optimizer.
     The classification-vs-regression split is carried by ``loss`` (logloss on
     +-1 labels vs squaredloss on targets), as in the reference's
-    -classification flag."""
+    -classification flag. O(table) work per step — used for optimizers whose
+    state decays every step (adam/momentum/adadelta) and so has no exact
+    sparse form."""
     lam0, lam_w, lam_v = lambdas
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, t, idx, val, label, row_mask, *extra):
         def batch_loss(p):
             phi = score_fn(p["w0"], p["w"], p["V"], idx, val, *extra)
@@ -88,9 +108,96 @@ def _make_factor_step(score_fn: Callable, loss: Loss, optimizer: Optimizer,
     return step
 
 
+def _make_factor_step_sparse(kind: str, loss: Loss, optimizer: Optimizer,
+                             lambdas: Tuple[float, float, float]) -> Callable:
+    """Gather/scatter FM/FFM step: O(batch), not O(table), HBM traffic.
+
+    The reference's per-row updates only ever touch features present in the
+    row (SURVEY.md §4.1/§4.4 hot loops); this is the batched TPU equivalent —
+    gather the touched slabs, autodiff at slab level, scatter the optimizer
+    step back through Optimizer.sparse_update. L2 (-lambda*) is likewise
+    applied per-occurrence to touched entries only, masked by row validity,
+    matching the reference's regularize-on-update semantics rather than a
+    whole-table decay. Requires optimizer.sparse_update (SGD/AdaGrad/FTRL/
+    RDA — the families BASELINE.json names)."""
+    lam0, lam_w, lam_v = lambdas
+    assert optimizer.sparse_update is not None
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, t, idx, val, label, row_mask, *extra):
+        w0, w, V = params["w0"], params["w"], params["V"]
+        wg = w[idx].astype(jnp.float32)                       # [B, L]
+        # presence mask: a feature slot participates only if its value is
+        # nonzero AND the row is valid — padding slots and padded rows must
+        # not receive L2 decay (the reference regularizes on update, and it
+        # only updates features present in the row)
+        pm = (val != 0).astype(jnp.float32) * row_mask[:, None]   # [B, L]
+        if kind == "ffm":
+            (field,) = extra
+            N, F, K = V.shape
+            L = idx.shape[1]
+            V2 = V.reshape(N * F, K)
+            # redirect diagonal self-pairs (i==j) to the reserved padding
+            # row 0: they never enter the score (triu mask) and must not
+            # receive optimizer-state/L2 touches
+            eye = jnp.eye(L, dtype=bool)[None]
+            flat = jnp.where(eye, 0,
+                             idx[:, :, None] * F + field[:, None, :])
+            Ag = V2[flat].astype(jnp.float32)                 # [B, L, L, K]
+            phi_fn = _ffm_slab_phi
+            slab = Ag
+        else:
+            Vg = V[idx].astype(jnp.float32)                   # [B, L, K]
+            phi_fn = _fm_slab_phi
+            slab = Vg
+
+        def batch_loss(w0f, wgf, slabf):
+            phi = phi_fn(w0f, wgf, slabf, val)
+            return (loss.loss(phi, label) * row_mask).sum()
+
+        loss_sum, (g0, gw, gs) = jax.value_and_grad(
+            batch_loss, argnums=(0, 1, 2))(
+                w0.astype(jnp.float32), wg, slab)
+
+        # per-occurrence L2 on present entries (reference: -lambda* applied
+        # at update time to the row's features)
+        g0 = g0 + lam0 * w0.astype(jnp.float32)
+        gw = gw + lam_w * wg * pm
+        w0n, s0 = optimizer.update(w0.astype(jnp.float32), g0,
+                                   opt_state["w0"], t)
+        wn, sw = optimizer.sparse_update(
+            w, gw.reshape(-1), opt_state["w"], idx.ravel(), t)
+
+        if kind == "ffm":
+            # pair presence: both sides present, and not a self-pair
+            pp = pm[:, :, None] * pm[:, None, :] * (~eye)     # [B, L, L]
+            gs = gs + lam_v * slab * pp[..., None]
+            # optimizer state is co-shaped with V [N,F,K]; flatten to the
+            # [N*F, K] view the pair-flat indices address
+            sV2 = {k: v.reshape(N * F, K) for k, v in opt_state["V"].items()}
+            Vn2, sV2 = optimizer.sparse_update(
+                V2, gs.reshape(-1, K), sV2, flat.ravel(), t)
+            Vn = Vn2.reshape(N, F, K)
+            sV = {k: v.reshape(N, F, K) for k, v in sV2.items()}
+        else:
+            K = V.shape[-1]
+            gs = gs + lam_v * slab * pm[..., None]
+            Vn, sV = optimizer.sparse_update(
+                V, gs.reshape(-1, K), opt_state["V"], idx.ravel(), t)
+
+        return ({"w0": w0n.astype(w0.dtype), "w": wn, "V": Vn},
+                {"w0": s0, "w": sw, "V": sV}, loss_sum)
+
+    return step
+
+
 def make_fm_step(loss, optimizer, lambdas):
-    return _make_factor_step(fm_score, loss, optimizer, lambdas)
+    if optimizer.sparse_update is not None:
+        return _make_factor_step_sparse("fm", loss, optimizer, lambdas)
+    return _make_factor_step_dense(fm_score, loss, optimizer, lambdas)
 
 
 def make_ffm_step(loss, optimizer, lambdas):
-    return _make_factor_step(ffm_score, loss, optimizer, lambdas)
+    if optimizer.sparse_update is not None:
+        return _make_factor_step_sparse("ffm", loss, optimizer, lambdas)
+    return _make_factor_step_dense(ffm_score, loss, optimizer, lambdas)

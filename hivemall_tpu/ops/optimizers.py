@@ -37,6 +37,17 @@ class Optimizer:
     init: Callable[..., Dict[str, Any]]
     update: Callable[..., Tuple[Any, Dict[str, Any]]]
     finalize: Callable[..., Any] = None  # type: ignore[assignment]
+    # Sparse/embedding-style update over a dense table: touch only the rows a
+    # minibatch gathered, O(batch) instead of O(table) HBM traffic. This is
+    # the TPU analog of the reference's per-cell hash-table updates (it only
+    # ever touched features present in the row). Signature:
+    #   sparse_update(w_table, g_slab, state, flat_idx, t) -> (w_table, state)
+    # with flat_idx [M] row ids into axis 0 of w_table and g_slab [M, ...]
+    # the f32 gradients at those rows. Duplicate ids accumulate by scatter-add
+    # (grad/accumulator sums match whole-batch accumulation; the weight step
+    # then uses the batch-final accumulators). None = no sparse form
+    # (momentum/adam/adadelta decay untouched state; use the dense update).
+    sparse_update: Callable[..., Tuple[Any, Dict[str, Any]]] = None  # type: ignore[assignment]
 
     def __post_init__(self):
         if self.finalize is None:
@@ -77,10 +88,15 @@ def make_optimizer(name: str = "adagrad", *, eta_scheme: str = "fixed",
         return _regularize(g, w, reg, lam, l1_ratio)
 
     if key == "sgd":
+        def sgd_sparse(w, g, s, ix, t):
+            ge = regz(g, w[ix].astype(jnp.float32))
+            return w.at[ix].add((-eta(t) * ge).astype(w.dtype)), s
+
         return Optimizer(
             "sgd",
             init=lambda shape, dtype=jnp.float32: {},
-            update=lambda w, g, s, t: (w - eta(t) * regz(g, w), s))
+            update=lambda w, g, s, t: (w - eta(t) * regz(g, w), s),
+            sparse_update=sgd_sparse)
 
     if key in ("momentum", "nesterov"):
         nesterov = key == "nesterov"
@@ -105,7 +121,14 @@ def make_optimizer(name: str = "adagrad", *, eta_scheme: str = "fixed",
             gg = s["gg"] + ge * ge
             return w - eta(t) * ge / (jnp.sqrt(gg) + EPS), {"gg": gg}
 
-        return Optimizer("adagrad", ag_init, ag_update)
+        def ag_sparse(w, g, s, ix, t):
+            ge = regz(g, w[ix].astype(jnp.float32))
+            gg = s["gg"].at[ix].add(ge * ge)
+            step = -eta(t) * ge / (jnp.sqrt(gg[ix]) + EPS)
+            return w.at[ix].add(step.astype(w.dtype)), {"gg": gg}
+
+        return Optimizer("adagrad", ag_init, ag_update,
+                         sparse_update=ag_sparse)
 
     if key == "adadelta":
         def ad_init(shape, dtype=jnp.float32):
@@ -154,7 +177,17 @@ def make_optimizer(name: str = "adagrad", *, eta_scheme: str = "fixed",
             w_new = -jnp.sign(u) * eta(t) * tt * thresh / (jnp.sqrt(gg) + EPS)
             return w_new, {"u": u, "gg": gg}
 
-        return Optimizer("adagrad_rda", rda_init, rda_update)
+        def rda_sparse(w, g, s, ix, t):
+            u = s["u"].at[ix].add(g)
+            gg = s["gg"].at[ix].add(g * g)
+            ug, gf = u[ix], gg[ix]
+            tt = t + 1.0
+            thresh = jnp.maximum(0.0, jnp.abs(ug) / tt - lam)
+            w_new = -jnp.sign(ug) * eta(t) * tt * thresh / (jnp.sqrt(gf) + EPS)
+            return w.at[ix].set(w_new.astype(w.dtype)), {"u": u, "gg": gg}
+
+        return Optimizer("adagrad_rda", rda_init, rda_update,
+                         sparse_update=rda_sparse)
 
     if key == "ftrl":
         # FTRL-Proximal (McMahan et al.) — the update family BASELINE names
@@ -174,7 +207,22 @@ def make_optimizer(name: str = "adagrad", *, eta_scheme: str = "fixed",
             z = s["z"] + g - sigma * w
             return f_materialize(z, n_new), {"z": z, "n": n_new}
 
-        return Optimizer("ftrl", f_init, f_update)
+        def f_sparse(w, g, s, ix, t):
+            n_old = s["n"][ix]
+            n_new = s["n"].at[ix].add(g * g)
+            # sigma is an ENTRY-level quantity (pre-batch -> batch-final n),
+            # identical across duplicate occurrences of an id. Scatter-ADDing
+            # -sigma*w would subtract it once per duplicate; instead add the
+            # grad sums, then .set the batch-final z (duplicates write
+            # identical values, so the .set is deterministic).
+            sigma = (jnp.sqrt(n_new[ix]) - jnp.sqrt(n_old)) / ftrl_alpha
+            z_g = s["z"].at[ix].add(g)
+            z_final = z_g[ix] - sigma * w[ix].astype(jnp.float32)
+            z = z_g.at[ix].set(z_final)
+            w_new = f_materialize(z[ix], n_new[ix])
+            return w.at[ix].set(w_new.astype(w.dtype)), {"z": z, "n": n_new}
+
+        return Optimizer("ftrl", f_init, f_update, sparse_update=f_sparse)
 
     raise ValueError(f"unknown optimizer {name!r}; one of {sorted(OPTIMIZERS)}")
 

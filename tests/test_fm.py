@@ -135,3 +135,169 @@ def test_fm_save_warm_start(tmp_path):
     a.save_model(p)
     b = FMTrainer(f"-dims 16 -factors 2 -classification -loadmodel {p}")
     np.testing.assert_allclose(a.predict(ds), b.predict(ds), atol=1e-5)
+
+
+# --- sparse (gather/scatter) step vs dense step ----------------------------
+
+def _factor_step_fixture(kind, opt_name, seed=3):
+    import jax.numpy as jnp
+    from hivemall_tpu.ops.fm import (_make_factor_step_dense,
+                                     _make_factor_step_sparse,
+                                     fm_score, ffm_score)
+    from hivemall_tpu.ops.losses import get_loss
+    from hivemall_tpu.ops.optimizers import make_optimizer
+
+    rng = np.random.default_rng(seed)
+    N, F, K, B = 64, 4, 3, 8
+    L = 4  # == F so per-row distinct fields keep (idx,field) pairs unique
+    loss = get_loss("logloss")
+    opt = make_optimizer(opt_name, eta_scheme="fixed", eta0=0.1, reg="no")
+    if kind == "ffm":
+        V = rng.normal(0, 0.1, (N, F, K)).astype(np.float32)
+        score = ffm_score
+    else:
+        V = rng.normal(0, 0.1, (N, K)).astype(np.float32)
+        score = fm_score
+    params = {"w0": jnp.zeros(()), "w": jnp.zeros(N), "V": jnp.asarray(V)}
+    state = {k: opt.init(np.asarray(v).shape) for k, v in params.items()}
+    # duplicate-free indices BATCH-wide (per-occurrence sparse updates only
+    # match one dense accumulated update when no id repeats anywhere in the
+    # batch), and per-row distinct fields so FFM (idx,field) pairs are unique
+    idx = rng.permutation(np.arange(1, N))[:B * L].reshape(B, L).astype(
+        np.int32)
+    val = rng.uniform(0.5, 1.5, (B, L)).astype(np.float32)
+    fld = np.tile(rng.permutation(np.arange(F, dtype=np.int32))[:L], (B, 1))
+    lab = (rng.integers(0, 2, B) * 2 - 1).astype(np.float32)
+    mask = np.ones(B, np.float32)
+    extra = (fld,) if kind == "ffm" else ()
+    dense = _make_factor_step_dense(score, loss, opt, (0.0, 0.0, 0.0))
+    sparse = _make_factor_step_sparse(kind, loss, opt, (0.0, 0.0, 0.0))
+    return params, state, (idx, val, lab, mask), extra, dense, sparse
+
+
+@pytest.mark.parametrize("kind", ["fm", "ffm"])
+@pytest.mark.parametrize("opt_name", ["sgd", "adagrad", "ftrl"])
+def test_sparse_step_matches_dense(kind, opt_name):
+    """With duplicate-free indices and no L2, the O(batch) gather/scatter step
+    must reproduce the O(table) dense step exactly (same math, different
+    memory traffic)."""
+    import jax
+    params, state, (idx, val, lab, mask), extra, dense, sparse = \
+        _factor_step_fixture(kind, opt_name)
+    copy = jax.tree.map(lambda x: x.copy() if hasattr(x, "copy") else x,
+                        (params, state))
+    pd, sd, ld = dense(params, state, 0.0, idx, val, lab, mask, *extra)
+    ps, ss, ls = sparse(copy[0], copy[1], 0.0, idx, val, lab, mask, *extra)
+    np.testing.assert_allclose(float(ld), float(ls), rtol=1e-5)
+    if opt_name == "ftrl":
+        # FTRL weights live implicitly in (z, n): the dense step eagerly
+        # re-materializes the WHOLE table (zeroing untouched random inits),
+        # the sparse step is lazy (untouched cells keep their init until
+        # first touched — the reference's per-cell behavior). Compare only
+        # the entries this batch touched.
+        np.testing.assert_allclose(np.asarray(pd["w0"]), np.asarray(ps["w0"]),
+                                   rtol=1e-4, atol=1e-6)
+        ix = np.asarray(idx).ravel()
+        np.testing.assert_allclose(np.asarray(pd["w"])[ix],
+                                   np.asarray(ps["w"])[ix],
+                                   rtol=1e-4, atol=1e-6)
+        if kind == "ffm":
+            N, F, K = np.asarray(pd["V"]).shape
+            # off-diagonal pairs only: diagonal self-pair cells are
+            # deliberately untouched by the sparse step (they never enter
+            # the score), while dense FTRL eagerly re-materializes them
+            L = np.asarray(idx).shape[1]
+            offdiag = ~np.eye(L, dtype=bool)[None].repeat(len(idx), 0)
+            flat = (np.asarray(idx)[:, :, None] * F +
+                    np.asarray(extra[0])[:, None, :])[offdiag].ravel()
+            np.testing.assert_allclose(
+                np.asarray(pd["V"]).reshape(N * F, K)[flat],
+                np.asarray(ps["V"]).reshape(N * F, K)[flat],
+                rtol=1e-4, atol=1e-6)
+        else:
+            np.testing.assert_allclose(np.asarray(pd["V"])[ix],
+                                       np.asarray(ps["V"])[ix],
+                                       rtol=1e-4, atol=1e-6)
+    else:
+        for k in ("w0", "w", "V"):
+            np.testing.assert_allclose(np.asarray(pd[k]), np.asarray(ps[k]),
+                                       rtol=1e-4, atol=1e-6)
+
+
+def test_sparse_step_duplicate_indices_accumulate():
+    """Duplicate feature ids within a batch must accumulate their gradients
+    (scatter-add), not race (last-write-wins)."""
+    import jax.numpy as jnp
+    from hivemall_tpu.ops.fm import _make_factor_step_sparse
+    from hivemall_tpu.ops.losses import get_loss
+    from hivemall_tpu.ops.optimizers import make_optimizer
+
+    loss = get_loss("squaredloss")
+    opt = make_optimizer("sgd", eta_scheme="fixed", eta0=1.0, reg="no")
+    step = _make_factor_step_sparse("fm", loss, opt, (0.0, 0.0, 0.0))
+    N, K = 8, 2
+    params = {"w0": jnp.zeros(()), "w": jnp.zeros(N),
+              "V": jnp.zeros((N, K))}
+    state = {k: opt.init(np.asarray(v).shape) for k, v in params.items()}
+    # two rows, both touching feature 3 with val 1 → dloss = phi - y = -1 each
+    idx = np.array([[3, 0], [3, 0]], np.int32)
+    val = np.array([[1.0, 0.0], [1.0, 0.0]], np.float32)
+    lab = np.ones(2, np.float32)
+    mask = np.ones(2, np.float32)
+    p2, _, _ = step(params, state, 0.0, idx, val, lab, mask)
+    # squaredloss dloss = (phi - y) = -1 per row; w[3] += eta * 1 * 2 rows
+    np.testing.assert_allclose(float(p2["w"][3]), 2.0, rtol=1e-6)
+
+
+def test_ffm_sparse_convergence_adagrad():
+    """FFM with the sparse AdaGrad path learns field-crossed interactions."""
+    rng = np.random.default_rng(11)
+    n, L, F = 600, 3, 3
+    idx = rng.integers(1, 40, (n, L)).astype(np.int32)
+    val = np.ones((n, L), np.float32)
+    fld = np.tile(np.arange(L, dtype=np.int32), (n, 1))
+    y = np.where((idx[:, 0] % 2) == (idx[:, 1] % 2), 1.0, -1.0
+                 ).astype(np.float32)
+    ds = SparseDataset.from_rows(
+        [(idx[i], val[i]) for i in range(n)], y,
+        fields=[fld[i] for i in range(n)])
+    t = FFMTrainer("-dims 64 -factors 4 -fields 3 -classification "
+                   "-mini_batch 64 -iters 30 -opt adagrad -eta0 0.2 -seed 7")
+    t.fit(ds)
+    assert t.optimizer.sparse_update is not None   # sparse path in use
+    scores = t.predict(ds)
+    assert auc((y > 0).astype(int), scores) > 0.9
+
+
+def test_ffm_sparse_no_diagonal_state_pollution():
+    """Self-pair cells V[idx_i, field_i] never enter the score (i<j mask);
+    the sparse step must not decay them or inflate their AdaGrad state."""
+    import jax.numpy as jnp
+    from hivemall_tpu.ops.fm import _make_factor_step_sparse
+    from hivemall_tpu.ops.losses import get_loss
+    from hivemall_tpu.ops.optimizers import make_optimizer
+
+    loss = get_loss("logloss")
+    opt = make_optimizer("adagrad", eta_scheme="fixed", eta0=0.1, reg="no")
+    step = _make_factor_step_sparse("ffm", loss, opt, (0.01, 0.01, 0.01))
+    N, F, K = 16, 2, 2
+    rng = np.random.default_rng(0)
+    V = jnp.asarray(rng.normal(0, 0.5, (N, F, K)), jnp.float32)
+    params = {"w0": jnp.zeros(()), "w": jnp.zeros(N), "V": V.copy()}
+    state = {k: opt.init(np.asarray(v).shape) for k, v in params.items()}
+    # one row: feature 3 (field 0), feature 7 (field 1); cross pairs touch
+    # (3,1) and (7,0); diagonals (3,0)/(7,1) must stay untouched
+    idx = np.array([[3, 7]], np.int32)
+    val = np.ones((1, 2), np.float32)
+    fld = np.array([[0, 1]], np.int32)
+    lab = np.ones(1, np.float32)
+    mask = np.ones(1, np.float32)
+    V0 = np.asarray(V).copy()
+    p2, s2, _ = step(params, state, 0.0, idx, val, lab, mask, fld)
+    gg = np.asarray(s2["V"]["gg"])
+    np.testing.assert_allclose(np.asarray(p2["V"])[3, 0], V0[3, 0])
+    np.testing.assert_allclose(np.asarray(p2["V"])[7, 1], V0[7, 1])
+    assert gg[3, 0].sum() == 0 and gg[7, 1].sum() == 0
+    # the cross cells DID move
+    assert np.abs(np.asarray(p2["V"])[3, 1] - V0[3, 1]).sum() > 0
+    assert np.abs(np.asarray(p2["V"])[7, 0] - V0[7, 0]).sum() > 0

@@ -80,3 +80,25 @@ def test_l2_shrinks_weights():
 def test_unknown_raises():
     with pytest.raises(ValueError):
         make_optimizer("zzz")
+
+
+def test_ftrl_sparse_duplicate_ids_subtract_sigma_once():
+    """FTRL's -sigma*w term is entry-level (pre-batch -> batch-final n); a
+    feature appearing d times in a batch must not subtract it d times."""
+    import jax.numpy as jnp
+    from hivemall_tpu.ops.optimizers import make_optimizer
+
+    opt = make_optimizer("ftrl", ftrl_alpha=0.5, ftrl_beta=1.0,
+                         ftrl_l1=0.0, ftrl_l2=0.0)
+    w = jnp.array([0.0, 0.5])
+    s = {"z": jnp.array([0.0, 0.1]), "n": jnp.array([0.0, 4.0])}
+    g = np.array([0.3, 0.3], np.float32)        # two grads for id 1
+    ix = np.array([1, 1], np.int32)
+    w2, s2 = opt.sparse_update(w, jnp.asarray(g), s, jnp.asarray(ix), 0.0)
+    n_final = 4.0 + 2 * 0.3 ** 2
+    sigma = (np.sqrt(n_final) - np.sqrt(4.0)) / 0.5
+    z_want = 0.1 + 0.6 - sigma * 0.5            # sigma applied ONCE
+    np.testing.assert_allclose(float(s2["z"][1]), z_want, rtol=1e-6)
+    np.testing.assert_allclose(float(s2["n"][1]), n_final, rtol=1e-6)
+    # untouched id 0 stays put
+    np.testing.assert_allclose(float(s2["z"][0]), 0.0)
