@@ -84,7 +84,8 @@ class LearnerBase:
         self._all_rows: List[Tuple[np.ndarray, np.ndarray]] = []
         self._all_labels: List[float] = []
         self._t = 0                           # global step (batches seen)
-        self._loss_sum = 0.0
+        self._loss_sum = 0.0                  # host float64, exact
+        self._loss_pending = 0.0              # on-device partial, folded in
         self._examples = 0
         self._mixer = None
         if self.opts.get("mix"):
@@ -221,14 +222,25 @@ class LearnerBase:
         nv = batch.n_valid or batch.batch_size
         loss_sum = self._train_batch(batch)
         self._t += 1
-        self._loss_sum += float(loss_sum)
+        # keep the per-step loss on device: float() here would block the host
+        # on every minibatch and stall the dispatch pipeline. The device
+        # partial is f32, so fold it into the exact host float64 sum every
+        # 256 batches before the running magnitude can swamp the increments.
+        self._loss_pending = self._loss_pending + loss_sum
+        if self._t % 256 == 0:
+            self._fold_loss()
         self._examples += nv
         if self._mixer is not None:
             self._mixer.touch(batch.idx[:nv])
             self._mixer.maybe_mix(self)
 
+    def _fold_loss(self) -> None:
+        self._loss_sum += float(self._loss_pending)
+        self._loss_pending = 0.0
+
     @property
     def cumulative_loss(self) -> float:
+        self._fold_loss()
         return self._loss_sum / max(1, self._examples)
 
     # -- model emission (the close()-time forward of (feature, weight)) -----

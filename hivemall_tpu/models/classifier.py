@@ -138,7 +138,7 @@ class _OnlineBase(LearnerBase):
         self.w, self.sigma, loss = self._step(
             self.w, self.sigma, batch.idx, batch.val, batch.label,
             batch.row_mask)
-        return float(loss)
+        return loss
 
     def _finalized_weights(self) -> np.ndarray:
         return np.asarray(self.w.astype(jnp.float32))
@@ -358,7 +358,7 @@ class AdaGradRDATrainer(_OnlineBase):
         self.w, self.u, self.gg, loss = self._step(
             self.w, self.u, self.gg, float(self._t), batch.idx, batch.val,
             batch.label, batch.row_mask)
-        return float(loss)
+        return loss
 
 
 class KernelizedPATrainer(PA1Trainer):

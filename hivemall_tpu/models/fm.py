@@ -113,7 +113,7 @@ class FMTrainer(LearnerBase):
         self.params, self.opt_state, loss_sum = self._step(
             self.params, self.opt_state, float(self._t), batch.idx, batch.val,
             batch.label, batch.row_mask, *self._batch_args(batch))
-        return float(loss_sum)
+        return loss_sum
 
     # -- scoring -------------------------------------------------------------
     def _score_batch(self, batch: SparseBatch) -> np.ndarray:

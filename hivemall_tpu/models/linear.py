@@ -63,7 +63,7 @@ class _LinearLearner(LearnerBase):
         self.w, self.opt_state, loss_sum = self._step(
             self.w, self.opt_state, float(self._t),
             batch.idx, batch.val, batch.label, batch.row_mask)
-        return float(loss_sum)
+        return loss_sum
 
     def _finalized_weights(self) -> np.ndarray:
         w = self.optimizer.finalize(self.w.astype(jnp.float32), self.opt_state)

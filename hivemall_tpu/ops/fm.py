@@ -137,12 +137,17 @@ def _make_factor_step_sparse(kind: str, loss: Loss, optimizer: Optimizer,
             N, F, K = V.shape
             L = idx.shape[1]
             V2 = V.reshape(N * F, K)
-            # redirect diagonal self-pairs (i==j) to the reserved padding
-            # row 0: they never enter the score (triu mask) and must not
-            # receive optimizer-state/L2 touches
+            # redirect inactive pairs to the reserved padding row 0: diagonal
+            # self-pairs (triu-masked out of the score) AND pairs touching a
+            # padding slot or padded row. Their loss gradient is zero, but
+            # FTRL/RDA sparse updates re-materialize w at every scattered id
+            # — routing them to row 0 keeps never-trained real cells at
+            # their lazy init.
             eye = jnp.eye(L, dtype=bool)[None]
-            flat = jnp.where(eye, 0,
-                             idx[:, :, None] * F + field[:, None, :])
+            pb = pm > 0                                       # [B, L] bool
+            active = pb[:, :, None] & pb[:, None, :] & ~eye   # [B, L, L]
+            flat = jnp.where(active,
+                             idx[:, :, None] * F + field[:, None, :], 0)
             Ag = V2[flat].astype(jnp.float32)                 # [B, L, L, K]
             phi_fn = _ffm_slab_phi
             slab = Ag
@@ -170,8 +175,7 @@ def _make_factor_step_sparse(kind: str, loss: Loss, optimizer: Optimizer,
 
         if kind == "ffm":
             # pair presence: both sides present, and not a self-pair
-            pp = pm[:, :, None] * pm[:, None, :] * (~eye)     # [B, L, L]
-            gs = gs + lam_v * slab * pp[..., None]
+            gs = gs + lam_v * slab * active[..., None]
             # optimizer state is co-shaped with V [N,F,K]; flatten to the
             # [N*F, K] view the pair-flat indices address
             sV2 = {k: v.reshape(N * F, K) for k, v in opt_state["V"].items()}

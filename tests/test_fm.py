@@ -301,3 +301,36 @@ def test_ffm_sparse_no_diagonal_state_pollution():
     # the cross cells DID move
     assert np.abs(np.asarray(p2["V"])[3, 1] - V0[3, 1]).sum() > 0
     assert np.abs(np.asarray(p2["V"])[7, 0] - V0[7, 0]).sum() > 0
+
+
+def test_ffm_sparse_padding_pairs_keep_lazy_init_under_ftrl():
+    """Pairs where one side is a padding slot (idx=0/val=0) must not be
+    scattered into real (feature, field-0) cells: FTRL's re-materializing
+    .set would wipe their lazy init to 0 and freeze the interaction."""
+    import jax.numpy as jnp
+    from hivemall_tpu.ops.fm import _make_factor_step_sparse
+    from hivemall_tpu.ops.losses import get_loss
+    from hivemall_tpu.ops.optimizers import make_optimizer
+
+    loss = get_loss("logloss")
+    opt = make_optimizer("ftrl")
+    step = _make_factor_step_sparse("ffm", loss, opt, (0.0, 0.0, 0.0))
+    N, F, K = 16, 3, 2
+    rng = np.random.default_rng(2)
+    V = jnp.asarray(rng.normal(0, 0.5, (N, F, K)), jnp.float32)
+    params = {"w0": jnp.zeros(()), "w": jnp.zeros(N), "V": V.copy()}
+    state = {k: opt.init(np.asarray(v).shape) for k, v in params.items()}
+    # row: feature 5 (field 1), feature 9 (field 2), one padding slot
+    idx = np.array([[5, 9, 0]], np.int32)
+    val = np.array([[1.0, 1.0, 0.0]], np.float32)
+    fld = np.array([[1, 2, 0]], np.int32)
+    V0 = np.asarray(V).copy()
+    p2, _, _ = step(params, state, 0.0, idx, val,
+                    np.ones(1, np.float32), np.ones(1, np.float32), fld)
+    V1 = np.asarray(p2["V"])
+    # pair with the padding slot (field 0) must keep its lazy random init
+    np.testing.assert_allclose(V1[5, 0], V0[5, 0])
+    np.testing.assert_allclose(V1[9, 0], V0[9, 0])
+    # the real cross pair (5,f2) x (9,f1) was touched (FTRL materializes)
+    assert np.abs(V1[5, 2] - V0[5, 2]).sum() > 0
+    assert np.abs(V1[9, 1] - V0[9, 1]).sum() > 0
