@@ -102,8 +102,10 @@ class LearnerBase:
     def _init_state(self) -> None:
         raise NotImplementedError
 
-    def _train_batch(self, batch: SparseBatch) -> float:
-        """Run one jitted step; returns summed loss over valid rows."""
+    def _train_batch(self, batch: SparseBatch):
+        """Run one jitted step; returns the summed loss over valid rows as a
+        device array (kept unconverted so async dispatch can pipeline; the
+        base loop folds it via _fold_loss at cadence)."""
         raise NotImplementedError
 
     def _finalized_weights(self) -> np.ndarray:

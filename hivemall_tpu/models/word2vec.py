@@ -175,7 +175,7 @@ class Word2VecTrainer:
                 self.in_emb, self.out_emb, jnp.asarray(c), jnp.asarray(t),
                 jnp.asarray(negs), jnp.asarray(rm), lr)
             centers, contexts = [], []
-            return float(loss)
+            return loss            # device array; don't block async dispatch
 
         seen = 0
         for ep in range(epochs):

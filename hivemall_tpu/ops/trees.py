@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hivemall_tpu.ops.pallas_hist import level_histogram, use_pallas_default
+
 __all__ = ["quantize_bins", "Tree", "build_tree_classifier",
            "build_tree_regressor", "build_tree_xgb", "predict_bins",
            "predict_raw"]
@@ -105,7 +107,7 @@ def _xgb_gain(lam):
 def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
                   leaf_fn: Callable, count_fn: Callable, depth: int,
                   n_bins: int, mtry: int, min_split: float, min_leaf: float,
-                  min_gain: float):
+                  min_gain: float, use_pallas: bool = False):
     """Single-tree level-wise builder; vmap over (w, rng) for an ensemble.
 
     bins: uint8 [n, d]; aux: per-row stat payload (labels / grads);
@@ -128,17 +130,23 @@ def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
             base = M - 1
             local = node - base
             active = (local >= 0) & (local < M) & ~settled[jnp.clip(node, 0, Nn - 1)]
-            # ---- histogram: one scatter-add for the whole level ----
-            # flat index: ((local*d + f)*B + bin)
+            # ---- histogram: one pass for the whole level ----
             loc = jnp.where(active, local, 0)
-            fidx = (loc[:, None] * d + jnp.arange(d)[None, :]) * n_bins \
-                + bins.astype(jnp.int32)                       # [n, d]
-            contrib = jnp.where(active[:, None, None], ws[:, None, :], 0.0)
-            contrib = jnp.broadcast_to(contrib, (n, d, n_channels))
-            hist = jnp.zeros((M * d * n_bins, n_channels), jnp.float32)
-            hist = hist.at[fidx.ravel()].add(
-                contrib.reshape(n * d, n_channels))
-            hist = hist.reshape(M, d, n_bins, n_channels)
+            if use_pallas:
+                # MXU one-hot-contraction kernel (ops/pallas_hist.py)
+                loc_m = jnp.where(active, local, -1)
+                hist = level_histogram(bins, loc_m, ws, M, n_bins)
+            else:
+                # CPU fallback: flat scatter-add ((local*d + f)*B + bin)
+                fidx = (loc[:, None] * d + jnp.arange(d)[None, :]) * n_bins \
+                    + bins.astype(jnp.int32)                   # [n, d]
+                contrib = jnp.where(active[:, None, None],
+                                    ws[:, None, :], 0.0)
+                contrib = jnp.broadcast_to(contrib, (n, d, n_channels))
+                hist = jnp.zeros((M * d * n_bins, n_channels), jnp.float32)
+                hist = hist.at[fidx.ravel()].add(
+                    contrib.reshape(n * d, n_channels))
+                hist = hist.reshape(M, d, n_bins, n_channels)
             # ---- split statistics ----
             parent = hist.sum(2).max(1)  # [M, S] (identical across f; max ok)
             cum = jnp.cumsum(hist, axis=2)                     # left stats
@@ -194,7 +202,7 @@ def _reg_leaf(parent):     # mean in channel 0 slot; keep stats for ensembling
 @lru_cache(maxsize=128)
 def _cached_builder(task: str, n_channels: int, depth: int, n_bins: int,
                     mtry: int, min_split: float, min_leaf: float,
-                    lam: float, vmapped: bool):
+                    lam: float, vmapped: bool, use_pallas: bool):
     if task == "gini":
         gain, leaf, count = _gini_gain, (lambda p: p), (lambda s: s.sum(-1))
     elif task == "var":
@@ -208,7 +216,7 @@ def _cached_builder(task: str, n_channels: int, depth: int, n_bins: int,
         raise ValueError(task)
     build = _make_builder(n_channels, lambda aux: aux, gain, leaf, count,
                           depth, n_bins, mtry, min_split, min_leaf,
-                          min_gain=1e-7)
+                          min_gain=1e-7, use_pallas=use_pallas)
     if vmapped:
         build = jax.vmap(build, in_axes=(None, None, 0, 0))
     return jax.jit(build)
@@ -223,7 +231,8 @@ def build_tree_classifier(bins: np.ndarray, labels: np.ndarray,
     """Gini trees; weights [E, n] give per-tree bootstrap counts."""
     onehot = jax.nn.one_hot(labels, n_classes)
     build = _cached_builder("gini", n_classes, depth, n_bins, mtry,
-                            float(min_split), float(min_leaf), 0.0, True)
+                            float(min_split), float(min_leaf), 0.0, True,
+                            use_pallas_default())
     keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
     f, t, v = build(jnp.asarray(bins), onehot, jnp.asarray(weights), keys)
     return Tree(np.asarray(f), np.asarray(t), np.asarray(v), edges)
@@ -238,7 +247,7 @@ def build_tree_regressor(bins: np.ndarray, targets: np.ndarray,
     y = jnp.asarray(targets, jnp.float32)
     aux = jnp.stack([jnp.ones_like(y), y, y * y], axis=1)
     build = _cached_builder("var", 3, depth, n_bins, mtry, float(min_split),
-                            float(min_leaf), 0.0, True)
+                            float(min_leaf), 0.0, True, use_pallas_default())
     keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
     f, t, v = build(jnp.asarray(bins), aux, jnp.asarray(weights), keys)
     return Tree(np.asarray(f), np.asarray(t), np.asarray(v), edges)
@@ -256,7 +265,8 @@ def build_tree_xgb(bins: np.ndarray, grads: np.ndarray, hess: np.ndarray,
     d = bins.shape[1]
     mtry = max(1, int(round(colsample * d))) if colsample < 1.0 else 0
     build = _cached_builder("xgb", 3, depth, n_bins, mtry, float(min_split),
-                            float(min_leaf), float(lam), False)
+                            float(min_leaf), float(lam), False,
+                            use_pallas_default())
     f, t, v = build(jnp.asarray(bins), aux,
                     jnp.ones(bins.shape[0], jnp.float32),
                     jax.random.PRNGKey(seed))
