@@ -1,0 +1,81 @@
+"""Checkpoint bundles: params + optimizer state + counters, resumable.
+
+Reference (SURVEY.md §6 "Checkpoint / resume"): in Hivemall every model IS a
+durable table, and warm start is `-loadmodel` over an exported model file —
+but optimizer state (AdaGrad accumulators etc.) is lost across restarts and
+mid-epoch resume does not exist. The rebuild keeps the model-table path
+(LearnerBase.save_model / -loadmodel) for weight-only warm starts and adds
+what the reference lacks: a full bundle of every device array a trainer
+needs to continue exactly where it stopped — weights, optimizer slots,
+covariance tables, the global step (which drives EtaEstimator schedules),
+example counts, and the hashed-id→name map.
+
+Format: one .npz — flattened pytree leaves (bf16 stored as f32, original
+dtype restored from the live trainer's reference tree on load) plus a json
+metadata record. Loading validates trainer name and leaf shapes so a bundle
+can't silently resume onto a mismatched config.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+__all__ = ["save_bundle", "load_bundle"]
+
+_FORMAT = 1
+
+
+def save_bundle(trainer, path: str) -> None:
+    """Write the trainer's full resumable state to ``path`` (.npz)."""
+    trainer._fold_loss()
+    leaves, treedef = jax.tree_util.tree_flatten(trainer._checkpoint_arrays())
+    meta: Dict[str, Any] = {
+        "format": _FORMAT,
+        "trainer": trainer.NAME,
+        "n_leaves": len(leaves),
+        "t": trainer._t,
+        "examples": trainer._examples,
+        "loss_sum": trainer._loss_sum,
+        "names": {str(k): v for k, v in trainer._names.items()},
+    }
+    arrays = {}
+    for i, leaf in enumerate(leaves):
+        a = np.asarray(leaf)
+        if a.dtype.name == "bfloat16":      # npz can't take ml_dtypes leaves
+            a = a.astype(np.float32)
+        arrays[f"leaf_{i}"] = a
+    np.savez(path, __meta__=np.array(json.dumps(meta)), **arrays)
+
+
+def load_bundle(trainer, path: str) -> None:
+    """Restore a bundle into a freshly constructed trainer (same options)."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        if meta.get("trainer") != trainer.NAME:
+            raise ValueError(
+                f"bundle was written by {meta.get('trainer')!r}, "
+                f"cannot resume {trainer.NAME!r}")
+        ref_leaves, treedef = jax.tree_util.tree_flatten(
+            trainer._checkpoint_arrays())
+        if meta["n_leaves"] != len(ref_leaves):
+            raise ValueError(
+                f"bundle has {meta['n_leaves']} state arrays, trainer "
+                f"expects {len(ref_leaves)} — options mismatch?")
+        leaves = []
+        for i, ref in enumerate(ref_leaves):
+            a = z[f"leaf_{i}"]
+            if tuple(a.shape) != tuple(ref.shape):
+                raise ValueError(
+                    f"state array {i}: bundle shape {a.shape} != "
+                    f"trainer shape {tuple(ref.shape)} — options mismatch?")
+            leaves.append(jax.numpy.asarray(a, dtype=ref.dtype))
+    trainer._restore_arrays(jax.tree_util.tree_unflatten(treedef, leaves))
+    trainer._t = int(meta["t"])
+    trainer._examples = int(meta["examples"])
+    trainer._loss_sum = float(meta["loss_sum"])
+    trainer._loss_pending = 0.0
+    trainer._names.update({int(k): v for k, v in meta["names"].items()})

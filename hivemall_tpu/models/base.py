@@ -21,6 +21,7 @@ import numpy as np
 
 from ..io.sparse import SparseBatch, SparseDataset
 from ..utils.hashing import mhash
+from ..utils.metrics import Meter, get_stream
 from ..utils.options import OptionSpec, Parsed
 
 __all__ = ["LearnerBase", "learner_option_spec"]
@@ -87,6 +88,7 @@ class LearnerBase:
         self._loss_sum = 0.0                  # host float64, exact
         self._loss_pending = 0.0              # on-device partial, folded in
         self._examples = 0
+        self._meter = Meter()                 # rolling examples/sec (§6)
         self._mixer = None
         if self.opts.get("mix"):
             from ..parallel.mix_service import MixClient
@@ -139,6 +141,11 @@ class LearnerBase:
                                       [self._all_labels[i] for i in take])
         if self._mixer is not None:
             self._mixer.close_group()
+        stream = get_stream()
+        if stream.enabled:
+            stream.emit("train_done", trainer=self.NAME, step=self._t,
+                        examples=self._examples,
+                        avg_loss=round(self.cumulative_loss, 6))
         yield from self.model_rows()
 
     # -- columnar fast path --------------------------------------------------
@@ -229,9 +236,17 @@ class LearnerBase:
         # partial is f32, so fold it into the exact host float64 sum every
         # 256 batches before the running magnitude can swamp the increments.
         self._loss_pending = self._loss_pending + loss_sum
+        self._examples += nv
+        self._meter.add(nv)
         if self._t % 256 == 0:
             self._fold_loss()
-        self._examples += nv
+            stream = get_stream()
+            if stream.enabled:              # reportProgress analog (§6)
+                stream.emit("train_step", trainer=self.NAME, step=self._t,
+                            examples=self._examples,
+                            examples_per_sec=round(self._meter.rate, 1),
+                            avg_loss=round(self._loss_sum
+                                           / max(1, self._examples), 6))
         if self._mixer is not None:
             self._mixer.touch(batch.idx[:nv])
             self._mixer.maybe_mix(self)
@@ -277,3 +292,29 @@ class LearnerBase:
 
     def _load_weights(self, w: np.ndarray) -> None:
         raise NotImplementedError
+
+    # -- full-state checkpointing (io.checkpoint bundles, SURVEY.md §6) ------
+    def _checkpoint_arrays(self):
+        """Pytree of device arrays forming the resumable training state.
+        The default covers the standard attribute names; trainers with other
+        state override this and `_restore_arrays` as a pair."""
+        tree = {}
+        for attr in ("w", "sigma", "params", "opt_state", "u", "gg"):
+            if getattr(self, attr, None) is not None:
+                tree[attr] = getattr(self, attr)
+        if not tree:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no checkpointable arrays")
+        return tree
+
+    def _restore_arrays(self, tree) -> None:
+        for k, v in tree.items():
+            setattr(self, k, v)
+
+    def save_bundle(self, path: str) -> None:
+        from ..io.checkpoint import save_bundle
+        save_bundle(self, path)
+
+    def load_bundle(self, path: str) -> None:
+        from ..io.checkpoint import load_bundle
+        load_bundle(self, path)

@@ -1,0 +1,121 @@
+"""Observability: per-host jsonl metrics stream + step timing + profiler.
+
+Reference (SURVEY.md §6 "Tracing / profiling"): Hivemall itself has no
+tracing subsystem — trainers report progress through Hadoop's MapredContext
+counters (`reportProgress`), log via log4j, and the MixServer exposes JMX
+metrics. The rebuild's equivalent is this module: a line-per-event jsonl
+stream each host appends to (the Hadoop-counter analog), a rolling
+examples/sec meter (the BASELINE primary metric), and a `jax.profiler`
+trace context for deep dives.
+
+Activation: set ``HIVEMALL_TPU_METRICS=<path>`` (or ``-`` for stderr) and
+every trainer emits records at its loss-fold cadence with zero config; or
+construct a ``MetricsStream`` explicitly and pass it around. When the env
+var is unset the module-level stream is a no-op with one attribute check of
+overhead per emit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import socket
+import sys
+import time
+from typing import Any, Dict, IO, Optional
+
+__all__ = ["MetricsStream", "Meter", "get_stream", "profile_trace"]
+
+
+class Meter:
+    """Rolling examples/sec over a sliding window of (time, count) marks."""
+
+    def __init__(self, window: float = 30.0):
+        self.window = window
+        self._marks: list = []          # (monotonic time, cumulative count)
+        self.total = 0
+
+    def add(self, n: int) -> None:
+        now = time.monotonic()
+        self.total += n
+        self._marks.append((now, self.total))
+        lo = now - self.window
+        while len(self._marks) > 2 and self._marks[0][0] < lo:
+            self._marks.pop(0)
+
+    @property
+    def rate(self) -> float:
+        if len(self._marks) < 2:
+            return 0.0
+        (t0, c0), (t1, c1) = self._marks[0], self._marks[-1]
+        return (c1 - c0) / max(t1 - t0, 1e-9)
+
+
+class MetricsStream:
+    """Append-only jsonl event stream, one file per host process.
+
+    Records carry {ts, host, pid, event, ...fields}. Failure to write is
+    swallowed after disabling the stream — observability must never take
+    training down (the reference's counters are likewise fire-and-forget).
+    """
+
+    def __init__(self, sink: "str | IO[str] | None"):
+        self._fh: Optional[IO[str]] = None
+        self._own = False
+        if sink == "-":
+            self._fh = sys.stderr
+        elif isinstance(sink, str):
+            try:
+                self._fh = open(sink, "a", buffering=1)
+                self._own = True
+            except OSError as e:            # fail soft: bad path must not
+                print(f"hivemall_tpu: metrics sink {sink!r} unusable ({e}); "
+                      "metrics disabled", file=sys.stderr)
+        elif sink is not None:
+            self._fh = sink
+        self._host = socket.gethostname()
+        self._pid = os.getpid()
+
+    @property
+    def enabled(self) -> bool:
+        return self._fh is not None
+
+    def emit(self, event: str, **fields: Any) -> None:
+        if self._fh is None:
+            return
+        rec: Dict[str, Any] = {"ts": round(time.time(), 3),
+                               "host": self._host, "pid": self._pid,
+                               "event": event}
+        rec.update(fields)
+        try:
+            self._fh.write(json.dumps(rec) + "\n")
+        except OSError:
+            self._fh = None               # fail soft, never raise mid-train
+
+    def close(self) -> None:
+        if self._own and self._fh is not None:
+            self._fh.close()
+        self._fh = None
+
+
+_stream: Optional[MetricsStream] = None
+
+
+def get_stream() -> MetricsStream:
+    """The process-wide stream, bound to $HIVEMALL_TPU_METRICS on first use."""
+    global _stream
+    if _stream is None:
+        _stream = MetricsStream(os.environ.get("HIVEMALL_TPU_METRICS"))
+    return _stream
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str] = None):
+    """jax.profiler trace context; no-op when log_dir is falsy."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,122 @@
+"""Checkpoint/resume bundles (SURVEY.md §6): resumed == continuous."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.models.fm import FMTrainer
+from hivemall_tpu.models.linear import GeneralClassifier, GeneralRegressor
+
+
+def _rows(n, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.where(X[:, 0] - 0.3 * X[:, 1] > 0, 1, -1)
+    feats = [[f"f{j}:{X[i, j]:.5f}" for j in range(d)] for i in range(n)]
+    return feats, y
+
+
+OPTS = "-opt adagrad -loss logloss -mini_batch 8 -dims 4096"
+
+
+def test_resume_equals_continuous(tmp_path):
+    feats, y = _rows(96)
+    cont = GeneralClassifier(OPTS)
+    for f, lab in zip(feats, y):
+        cont.process(f, lab)
+    cont_rows = dict(cont.close())
+
+    first = GeneralClassifier(OPTS)
+    for f, lab in zip(feats[:48], y[:48]):
+        first.process(f, lab)
+    first._flush()
+    p = tmp_path / "ck.npz"
+    first.save_bundle(str(p))
+
+    second = GeneralClassifier(OPTS)
+    second.load_bundle(str(p))
+    assert second._t == first._t and second._examples == 48
+    for f, lab in zip(feats[48:], y[48:]):
+        second.process(f, lab)
+    res_rows = dict(second.close())
+
+    assert set(res_rows) == set(cont_rows)
+    for k in cont_rows:
+        np.testing.assert_allclose(res_rows[k], cont_rows[k],
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_bundle_keeps_optimizer_state(tmp_path):
+    """AdaGrad accumulators survive the roundtrip (what -loadmodel loses)."""
+    feats, y = _rows(32)
+    tr = GeneralClassifier(OPTS)
+    for f, lab in zip(feats, y):
+        tr.process(f, lab)
+    tr._flush()
+    p = tmp_path / "ck.npz"
+    tr.save_bundle(str(p))
+    fresh = GeneralClassifier(OPTS)
+    fresh.load_bundle(str(p))
+    ref = tr._checkpoint_arrays()
+    got = fresh._checkpoint_arrays()
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=1e-6)
+
+
+def test_fm_bundle_roundtrip(tmp_path):
+    feats, y = _rows(40)
+    tr = FMTrainer("-factors 4 -mini_batch 8 -dims 2048 -classification")
+    for f, lab in zip(feats, y):
+        tr.process(f, lab)
+    tr._flush()
+    p = tmp_path / "fm.npz"
+    tr.save_bundle(str(p))
+    fresh = FMTrainer("-factors 4 -mini_batch 8 -dims 2048 -classification")
+    fresh.load_bundle(str(p))
+    np.testing.assert_allclose(np.asarray(fresh.params["V"], np.float32),
+                               np.asarray(tr.params["V"], np.float32))
+
+
+def test_rda_resume_keeps_dual_accumulators(tmp_path):
+    """RDA recomputes w from u/gg each step — they must survive the bundle."""
+    from hivemall_tpu.models.classifier import AdaGradRDATrainer
+    feats, y = _rows(96)
+    opts = "-mini_batch 8 -dims 4096"
+    cont = AdaGradRDATrainer(opts)
+    for f, lab in zip(feats, y):
+        cont.process(f, lab)
+    cont_rows = dict(cont.close())
+
+    first = AdaGradRDATrainer(opts)
+    for f, lab in zip(feats[:48], y[:48]):
+        first.process(f, lab)
+    first._flush()
+    p = tmp_path / "rda.npz"
+    first.save_bundle(str(p))
+    second = AdaGradRDATrainer(opts)
+    second.load_bundle(str(p))
+    assert float(np.abs(np.asarray(second.gg)).sum()) > 0
+    for f, lab in zip(feats[48:], y[48:]):
+        second.process(f, lab)
+    res_rows = dict(second.close())
+    assert set(res_rows) == set(cont_rows)
+    for k in cont_rows:
+        np.testing.assert_allclose(res_rows[k], cont_rows[k],
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_bundle_rejects_mismatch(tmp_path):
+    feats, y = _rows(16)
+    tr = GeneralClassifier(OPTS)
+    for f, lab in zip(feats, y):
+        tr.process(f, lab)
+    p = tmp_path / "ck.npz"
+    tr.save_bundle(str(p))
+    with pytest.raises(ValueError, match="cannot resume"):
+        GeneralRegressor(OPTS.replace("logloss", "squaredloss")) \
+            .load_bundle(str(p))
+    with pytest.raises(ValueError, match="mismatch"):
+        GeneralClassifier("-opt adagrad -loss logloss -dims 1024") \
+            .load_bundle(str(p))
