@@ -118,6 +118,12 @@ class MixServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
         self.port = port          # 0 = ephemeral; real port set on start
+        # fault injection (SURVEY.md §6 failure detection): tests set these
+        # to prove fail-soft parity — a dropping/stalling server degrades
+        # training to replica-local SGD, never stops it.
+        self.inject_drop_every = 0   # close the connection every Nth request
+        self.inject_delay_s = 0.0    # stall each reply this long
+        self._requests = 0
         self._sessions: Dict[str, Dict[int, _Partial]] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -135,6 +141,13 @@ class MixServer:
                 if msg.event == EVENT_CLOSEGROUP:
                     self._sessions.pop(msg.group, None)
                     continue
+                self._requests += 1
+                if self.inject_delay_s:
+                    await asyncio.sleep(self.inject_delay_s)
+                if (self.inject_drop_every
+                        and self._requests % self.inject_drop_every == 0):
+                    writer.close()
+                    return
                 sess = self._sessions.setdefault(msg.group, {})
                 out_w = np.empty_like(msg.weights)
                 out_c = np.empty_like(msg.covars)

@@ -36,3 +36,14 @@ def test_lookup_and_help():
     e = lookup("mhash")
     assert e.reference.startswith("hivemall.")
     assert "mhash" in help_for("mhash")
+
+
+def test_functions_manifest_in_sync():
+    """FUNCTIONS.md is generated from the registry and must list every
+    function (regenerate: python -m hivemall_tpu.catalog.manifest)."""
+    import os
+    from hivemall_tpu.catalog.manifest import render_markdown
+    path = os.path.join(os.path.dirname(__file__), "..", "FUNCTIONS.md")
+    assert open(path, encoding="utf-8").read() == render_markdown(), \
+        "FUNCTIONS.md is stale — regenerate with " \
+        "`python -m hivemall_tpu.catalog.manifest > FUNCTIONS.md`"

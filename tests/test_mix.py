@@ -177,3 +177,48 @@ def test_mix_client_fail_soft():
     model = dict(clf.close())
     assert clf._mixer.alive is False
     assert model["1"] > 0 > model["2"]   # learned fine without the server
+
+
+def test_mix_fault_injection_drop():
+    """Server that hangs up mid-session: client disables itself, training
+    finishes, and the model is still sane (SURVEY.md §6 fault injection)."""
+    from hivemall_tpu.models.linear import GeneralClassifier
+    from hivemall_tpu.parallel.mix_service import MixServer
+    srv = MixServer()
+    srv.inject_drop_every = 2            # hang up on every 2nd exchange
+    srv.start()
+    try:
+        clf = GeneralClassifier(
+            f"-dims 32 -mini_batch 4 -eta0 0.5 -reg no -eta fixed "
+            f"-mix 127.0.0.1:{srv.port} -mix_threshold 1")
+        for _ in range(32):
+            clf.process(["1:1.0"], 1)
+            clf.process(["2:1.0"], -1)
+        model = dict(clf.close())
+        assert clf._mixer.alive is False          # detected the drop
+        assert clf._mixer.exchanges >= 1          # at least one worked first
+        assert model["1"] > 0 > model["2"]        # training kept going
+    finally:
+        srv.stop()
+
+
+def test_mix_fault_injection_delay():
+    """Server slower than the client timeout: same fail-soft degradation."""
+    from hivemall_tpu.models.linear import GeneralClassifier
+    from hivemall_tpu.parallel.mix_service import MixServer
+    srv = MixServer()
+    srv.inject_delay_s = 0.5
+    srv.start()
+    try:
+        clf = GeneralClassifier(
+            f"-dims 32 -mini_batch 4 -eta0 0.5 -reg no -eta fixed "
+            f"-mix 127.0.0.1:{srv.port} -mix_threshold 1")
+        clf._mixer.timeout = 0.05                 # client far less patient
+        for _ in range(16):
+            clf.process(["1:1.0"], 1)
+            clf.process(["2:1.0"], -1)
+        model = dict(clf.close())
+        assert clf._mixer.alive is False
+        assert model["1"] > 0 > model["2"]
+    finally:
+        srv.stop()
