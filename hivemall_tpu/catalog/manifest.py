@@ -8,12 +8,11 @@ judgeable function inventory from the live registry.
 
 from __future__ import annotations
 
-from .registry import all_functions, lookup
+from .registry import all_functions
 
 
 def render_markdown() -> str:
-    names = sorted(all_functions())
-    entries = [lookup(n) for n in names]
+    entries = list(all_functions().values())   # already sorted by name
     lines = [
         "# Function manifest (define-all)",
         "",

@@ -29,6 +29,13 @@ def _cmd_train(args) -> int:
 
     cls = lookup(args.algo).resolve()
     trainer = cls(args.options or "")
+    for flag in ("load_bundle", "save_bundle"):   # fail fast, not post-train
+        if getattr(args, flag, None) and not hasattr(trainer, flag):
+            print(f"error: {args.algo} does not support checkpoint bundles "
+                  f"(--{flag.replace('_', '-')})", file=sys.stderr)
+            return 2
+    if getattr(args, "load_bundle", None):
+        trainer.load_bundle(args.load_bundle)
     ds = read_libsvm(args.input)
     t0 = time.time()
     if hasattr(trainer, "fit"):
@@ -39,6 +46,8 @@ def _cmd_train(args) -> int:
             trainer.process(ds.row(i), float(ds.labels[i]))
         rows = list(trainer.close())
     dt = time.time() - t0
+    if getattr(args, "save_bundle", None):
+        trainer.save_bundle(args.save_bundle)
     if args.model:
         if hasattr(trainer, "save_model"):
             trainer.save_model(args.model)
@@ -129,6 +138,10 @@ def main(argv=None) -> int:
     t.add_argument("--input", required=True)
     t.add_argument("--options", default="")
     t.add_argument("--model", default=None)
+    t.add_argument("--load-bundle", default=None,
+                   help="resume from a full-state checkpoint bundle (.npz)")
+    t.add_argument("--save-bundle", default=None,
+                   help="write a full-state checkpoint bundle at the end")
     t.set_defaults(fn=_cmd_train)
 
     pr = sub.add_parser("predict", help="score a LIBSVM file with a model")

@@ -30,17 +30,25 @@ _FORMAT = 1
 
 
 def save_bundle(trainer, path: str) -> None:
-    """Write the trainer's full resumable state to ``path`` (.npz)."""
-    trainer._fold_loss()
+    """Write the trainer's full resumable state to ``path`` (.npz).
+
+    Works for any trainer exposing `_checkpoint_arrays`/`_restore_arrays`;
+    the LearnerBase counters (_examples, _loss_sum, _names) are optional so
+    non-LearnerBase trainers (e.g. MF) bundle too."""
+    if hasattr(trainer, "_fold_loss"):
+        trainer._fold_loss()
     leaves, treedef = jax.tree_util.tree_flatten(trainer._checkpoint_arrays())
     meta: Dict[str, Any] = {
         "format": _FORMAT,
         "trainer": trainer.NAME,
         "n_leaves": len(leaves),
-        "t": trainer._t,
-        "examples": trainer._examples,
-        "loss_sum": trainer._loss_sum,
-        "names": {str(k): v for k, v in trainer._names.items()},
+        "t": getattr(trainer, "_t", 0),
+        "examples": getattr(trainer, "_examples", 0),
+        "loss_sum": getattr(trainer, "_loss_sum", 0.0),
+        "names": {str(k): v for k, v in getattr(trainer, "_names",
+                                                {}).items()},
+        "scalars": (trainer._checkpoint_scalars()
+                    if hasattr(trainer, "_checkpoint_scalars") else {}),
     }
     arrays = {}
     for i, leaf in enumerate(leaves):
@@ -75,7 +83,12 @@ def load_bundle(trainer, path: str) -> None:
             leaves.append(jax.numpy.asarray(a, dtype=ref.dtype))
     trainer._restore_arrays(jax.tree_util.tree_unflatten(treedef, leaves))
     trainer._t = int(meta["t"])
-    trainer._examples = int(meta["examples"])
-    trainer._loss_sum = float(meta["loss_sum"])
-    trainer._loss_pending = 0.0
-    trainer._names.update({int(k): v for k, v in meta["names"].items()})
+    for attr, val in (("_examples", int(meta["examples"])),
+                      ("_loss_sum", float(meta["loss_sum"])),
+                      ("_loss_pending", 0.0)):
+        if hasattr(trainer, attr):
+            setattr(trainer, attr, val)
+    if hasattr(trainer, "_names"):
+        trainer._names.update({int(k): v for k, v in meta["names"].items()})
+    if meta.get("scalars") and hasattr(trainer, "_restore_scalars"):
+        trainer._restore_scalars(meta["scalars"])

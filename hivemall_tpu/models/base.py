@@ -15,6 +15,7 @@ with reshuffling, the NioStatefulSegment analog.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -155,9 +156,19 @@ class LearnerBase:
         bs = int(self.opts.mini_batch)
         labels = self._convert_labels(ds.labels)
         ds = SparseDataset(ds.indices, ds.indptr, ds.values, labels, ds.fields)
+        # elastic recovery (SURVEY.md §6): per-epoch bundle when requested
+        ckdir = os.environ.get("HIVEMALL_TPU_CHECKPOINT_DIR")
         for ep in range(epochs):
             for b in ds.batches(bs, shuffle=shuffle, seed=42 + ep):
                 self._dispatch(b)
+            if ckdir:
+                os.makedirs(ckdir, exist_ok=True)
+                path = os.path.join(ckdir, f"{self.NAME}-ep{ep + 1}.npz")
+                self.save_bundle(path)
+                stream = get_stream()
+                if stream.enabled:
+                    stream.emit("checkpoint", trainer=self.NAME,
+                                epoch=ep + 1, path=path)
         return self
 
     # -- shared plumbing -----------------------------------------------------

@@ -125,6 +125,37 @@ class MFTrainer:
         if len(self._buf) >= int(self.opts.mini_batch):
             self._flush()
 
+    # -- full-state checkpointing (io.checkpoint bundles, SURVEY.md §6) ------
+    # Bundles capture model + optimizer state and counters; the -iters
+    # replay buffer is NOT serialized (matching the reference, where task
+    # retry replays the input split rather than restoring scratch).
+    def _checkpoint_arrays(self):
+        tree = {"params": self.params}
+        if self.gg is not None:
+            tree["gg"] = self.gg
+        return tree
+
+    def _restore_arrays(self, tree) -> None:
+        self.params = tree["params"]
+        if "gg" in tree:
+            self.gg = tree["gg"]
+
+    def _checkpoint_scalars(self):
+        return {"cum_loss": self.cum_loss, "n_seen": self.n_seen}
+
+    def _restore_scalars(self, scalars) -> None:
+        self.cum_loss = float(scalars["cum_loss"])
+        self.n_seen = int(scalars["n_seen"])
+
+    def save_bundle(self, path: str) -> None:
+        from ..io.checkpoint import save_bundle
+        self._flush()                  # buffered rows train before we snapshot
+        save_bundle(self, path)
+
+    def load_bundle(self, path: str) -> None:
+        from ..io.checkpoint import load_bundle
+        load_bundle(self, path)
+
     def _flush(self) -> None:
         if not self._buf:
             return

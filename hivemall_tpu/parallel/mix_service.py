@@ -167,7 +167,10 @@ class MixServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
-            writer.close()
+            try:
+                writer.close()
+            except RuntimeError:
+                pass               # loop already closed during shutdown
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "MixServer":
@@ -192,7 +195,14 @@ class MixServer:
 
     def stop(self) -> None:
         if self._loop:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+            loop = self._loop
+
+            def shutdown():
+                for task in asyncio.all_tasks(loop):
+                    task.cancel()       # unblock handlers stuck in delays
+                loop.stop()
+
+            loop.call_soon_threadsafe(shutdown)
         if self._thread:
             self._thread.join(timeout=5)
 

@@ -120,3 +120,48 @@ def test_bundle_rejects_mismatch(tmp_path):
     with pytest.raises(ValueError, match="mismatch"):
         GeneralClassifier("-opt adagrad -loss logloss -dims 1024") \
             .load_bundle(str(p))
+
+
+def test_mf_resume_equals_continuous(tmp_path):
+    """Non-LearnerBase trainer (MF AdaGrad) bundles via the same protocol."""
+    from hivemall_tpu.models.mf import MFAdaGradTrainer
+    rng = np.random.default_rng(5)
+    opts = "-factors 4 -users 30 -items 20 -mini_batch 8 -seed 2"
+    trips = [(int(rng.integers(30)), int(rng.integers(20)),
+              float(rng.normal())) for _ in range(80)]
+
+    cont = MFAdaGradTrainer(opts)
+    for u, i, r in trips:
+        cont.process(u, i, r)
+    cont._flush()
+    ref = np.asarray(cont.params["P"], np.float32)
+
+    first = MFAdaGradTrainer(opts)
+    for u, i, r in trips[:40]:
+        first.process(u, i, r)
+    first._flush()
+    p = tmp_path / "mf.npz"
+    first.save_bundle(str(p))
+    second = MFAdaGradTrainer(opts)
+    second.load_bundle(str(p))
+    assert second._t == first._t
+    for u, i, r in trips[40:]:
+        second.process(u, i, r)
+    second._flush()
+    np.testing.assert_allclose(np.asarray(second.params["P"], np.float32),
+                               ref, rtol=1e-6, atol=1e-7)
+
+
+def test_per_epoch_auto_checkpoint(tmp_path, monkeypatch):
+    """HIVEMALL_TPU_CHECKPOINT_DIR => one bundle per fit() epoch (§6)."""
+    import os
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    monkeypatch.setenv("HIVEMALL_TPU_CHECKPOINT_DIR", str(tmp_path))
+    ds, _ = synthetic_classification(64, 16, seed=9)
+    tr = GeneralClassifier("-dims 128 -mini_batch 16 -iters 3")
+    tr.fit(ds)
+    files = sorted(os.listdir(tmp_path))
+    assert files == [f"train_classifier-ep{i}.npz" for i in (1, 2, 3)]
+    resumed = GeneralClassifier("-dims 128 -mini_batch 16 -iters 3")
+    resumed.load_bundle(str(tmp_path / files[-1]))
+    assert resumed._t == tr._t

@@ -113,3 +113,25 @@ def test_cli_define_all_and_help(capsys):
     assert _cli(["help", "train_ffm"]) == 0
     h = capsys.readouterr().out
     assert "-factors" in h and "hivemall.fm" in h
+
+
+def test_cli_train_bundle_resume(tmp_path, capsys):
+    from hivemall_tpu.io.libsvm import synthetic_classification, write_libsvm
+    ds, _ = synthetic_classification(200, 30, seed=4)
+    train_p = str(tmp_path / "t.libsvm")
+    bundle_p = str(tmp_path / "ck.npz")
+    model_p = str(tmp_path / "m.tsv")
+    write_libsvm(ds, train_p)
+    opts = "-dims 256 -loss logloss -opt adagrad -mini_batch 64"
+
+    rc = _cli(["train", "--algo", "train_classifier", "--input", train_p,
+               "--options", opts, "--save-bundle", bundle_p])
+    assert rc == 0 and json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])["examples"] == 200
+
+    rc = _cli(["train", "--algo", "train_classifier", "--input", train_p,
+               "--options", opts, "--load-bundle", bundle_p,
+               "--model", model_p])
+    assert rc == 0
+    capsys.readouterr()
+    assert len(open(model_p).readlines()) > 0
