@@ -14,11 +14,44 @@ async host mix service (parallel.mix_service).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh"]
+__all__ = ["make_mesh", "init_distributed"]
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, **kwargs) -> int:
+    """Multi-host (DCN) bootstrap — the NCCL/MPI-init analog.
+
+    Thin wrapper over ``jax.distributed.initialize``: with no arguments the
+    cluster-environment autodetection applies (TPU pods populate everything);
+    explicit args serve manual DCN fleets. After this, ``jax.devices()`` is
+    the GLOBAL device list, so ``make_mesh`` spans hosts and psum-mixing
+    (parallel.mix) rides ICI within a slice and DCN across slices.
+
+    Failure policy: when the call looks multi-host — any explicit argument,
+    or a coordinator address in the environment — init errors RE-RAISE (a
+    real fleet must not silently shrink to one worker). Only a bare local
+    invocation with no cluster hints degrades to local devices.
+    Returns the process index (0 when single-process)."""
+    multi_host_intent = (
+        any(v is not None for v in (coordinator_address, num_processes,
+                                    process_id))
+        or bool(kwargs)
+        or any(k in os.environ for k in ("JAX_COORDINATOR_ADDRESS",
+                                         "COORDINATOR_ADDRESS")))
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id, **kwargs)
+    except (ValueError, RuntimeError):
+        if multi_host_intent:
+            raise
+    return jax.process_index()
 
 
 def make_mesh(dp: int = 1, tp: int = 1, devices=None) -> Mesh:

@@ -200,7 +200,9 @@ class MixServer:
             def shutdown():
                 for task in asyncio.all_tasks(loop):
                     task.cancel()       # unblock handlers stuck in delays
-                loop.stop()
+                # stop in a LATER callback so the cancellations (queued by
+                # task.cancel via call_soon) deliver and finallys run first
+                loop.call_soon(loop.stop)
 
             loop.call_soon_threadsafe(shutdown)
         if self._thread:

@@ -1,0 +1,55 @@
+"""Numerical-safety tooling — the race-detection/sanitizer analog.
+
+Reference (SURVEY.md §6 "Race detection / sanitizers"): Hivemall has no
+sanitizers; thread safety is by construction (SynchronizedModelWrapper
+serializing MixClient write-backs). The rebuild's hazards are numerical,
+not concurrency (JAX is functionally pure; the mix service is a
+single-writer asyncio loop), so the sanitizers here are numeric:
+
+- ``debug_nans()``: context manager flipping ``jax_debug_nans`` so any NaN
+  produced inside jitted kernels raises at the op that made it.
+- ``checked(fn)``: wraps a jittable function with ``checkify`` float
+  checks; returns a function that raises ``JaxRuntimeError`` with the
+  offending check message instead of silently propagating NaN/inf.
+- ``HIVEMALL_TPU_DEBUG_NANS=1`` enables debug-nans process-wide (CI soak).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+from jax.experimental import checkify
+
+__all__ = ["debug_nans", "checked", "maybe_enable_from_env"]
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True):
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def checked(fn):
+    """checkify-wrap ``fn`` (float_checks): call raises on NaN/inf."""
+    cf = checkify.checkify(fn, errors=checkify.float_checks)
+
+    def wrapper(*args, **kwargs):
+        err, out = cf(*args, **kwargs)
+        err.throw()
+        return out
+
+    return wrapper
+
+
+def maybe_enable_from_env() -> bool:
+    """Process-wide debug-nans when HIVEMALL_TPU_DEBUG_NANS is set."""
+    if os.environ.get("HIVEMALL_TPU_DEBUG_NANS"):
+        jax.config.update("jax_debug_nans", True)
+        return True
+    return False

@@ -1,0 +1,42 @@
+"""Numeric sanitizers (SURVEY.md §6 race-detection/sanitizer analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hivemall_tpu.utils.debug import checked, debug_nans
+
+
+def test_checked_clean_function_passes():
+    f = checked(jax.jit(lambda x: jnp.log1p(jnp.exp(-jnp.abs(x)))))
+    out = f(jnp.asarray([0.5, -2.0]))
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_checked_raises_on_nan():
+    f = checked(jax.jit(lambda x: jnp.sqrt(x)))   # sqrt(-1) -> NaN
+    with pytest.raises(Exception, match="nan"):
+        f(jnp.asarray([-1.0]))
+
+
+def test_debug_nans_context_restores_flag():
+    prev = jax.config.jax_debug_nans
+    with debug_nans(True):
+        assert jax.config.jax_debug_nans is True
+    assert jax.config.jax_debug_nans == prev
+
+
+def test_linear_step_is_nan_clean():
+    """A representative trainer kernel stays finite under checkify."""
+    from hivemall_tpu.models.linear import GeneralClassifier
+    tr = GeneralClassifier("-dims 128 -mini_batch 8 -opt adagrad "
+                           "-loss logloss")
+    with debug_nans(True):
+        rng = np.random.default_rng(0)
+        for i in range(24):
+            x = rng.normal(size=3)
+            tr.process([f"f{j}:{x[j]:.4f}" for j in range(3)],
+                       1 if x.sum() > 0 else -1)
+        rows = dict(tr.close())
+    assert all(np.isfinite(v) for v in rows.values())
