@@ -37,9 +37,11 @@ def main():
     cards = rng.integers(10, 1000, F)          # per-field cardinalities
     cols = [f"c{f}" for f in range(F)]
     # a planted low-rank signal: label depends on two field interactions
+    from hivemall_tpu.utils.hashing import murmurhash3_x86_32
     rows_cat = [[f"v{rng.integers(cards[f])}" for f in range(F)]
                 for _ in range(args.rows)]
-    y = np.asarray([1 if (hash(r[0] + r[1]) % 100 < 55) else -1
+    # murmur3, not builtin hash(): labels must be process-independent
+    y = np.asarray([1 if murmurhash3_x86_32(r[0] + r[1]) % 100 < 55 else -1
                     for r in rows_cat])
 
     tr = Trainer(f"-dims 262144 -factors {args.factors} -fields {F} "

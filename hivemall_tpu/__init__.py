@@ -30,6 +30,14 @@ __version__ = "0.1.0"
 
 VERSION = __version__
 
+# opt-in numeric sanitizer (SURVEY.md §6): HIVEMALL_TPU_DEBUG_NANS=1
+import os as _os
+
+if _os.environ.get("HIVEMALL_TPU_DEBUG_NANS"):
+    from .utils.debug import maybe_enable_from_env as _men
+
+    _men()
+
 
 def hivemall_version() -> str:
     """SQL: hivemall_version() — version UDF (reference: hivemall.VersionUDF)."""

@@ -1,0 +1,107 @@
+"""Device prefetcher — overlap host batch prep with TPU compute.
+
+Reference context (SURVEY.md §8 hard parts): "the input path (hashing +
+batching on host) can easily be the bottleneck, not the TPU". The reference
+has no analog (Hadoop feeds rows to the UDTF synchronously); on TPU the
+host→device link is latency the training step should never wait on. A
+worker thread stages upcoming batches with ``jax.device_put`` while the
+current step runs, keeping ``depth`` batches in flight — the same
+double-buffering idea as the Pallas DMA pipeline, at the input-pipeline
+level.
+
+Usage:
+    for batch in DevicePrefetcher(ds.batches(bs), depth=2):
+        step(params, batch)           # batch arrays already on device
+
+LearnerBase.fit uses this automatically on accelerator backends.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, Optional
+
+import jax
+
+from .sparse import SparseBatch
+
+__all__ = ["DevicePrefetcher", "stage_batch"]
+
+_STOP = object()
+
+
+def stage_batch(b: SparseBatch, device=None) -> SparseBatch:
+    """device_put every array of one batch (no-op fields preserved)."""
+    put = (lambda a: jax.device_put(a, device)) if device is not None \
+        else jax.device_put
+    return SparseBatch(put(b.idx), put(b.val), put(b.label),
+                       None if b.field is None else put(b.field),
+                       b.n_valid)
+
+
+class DevicePrefetcher:
+    """Iterate ``src`` with up to ``depth`` device-staged batches in flight.
+
+    The worker thread only calls device_put (thread-safe in JAX) and dies
+    with the iterator; errors in ``src`` re-raise in the consumer thread.
+    """
+
+    def __init__(self, src: Iterable[SparseBatch], depth: int = 2,
+                 device=None):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._err: Optional[BaseException] = None
+        self._device = device
+        self._closed = threading.Event()
+
+        def work():
+            try:
+                for b in src:
+                    staged = stage_batch(b, self._device)
+                    while not self._closed.is_set():
+                        try:
+                            self._q.put(staged, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._closed.is_set():
+                        return          # consumer abandoned the stream
+            except BaseException as e:          # surfaced on next()
+                self._err = e
+            finally:
+                # the sentinel MUST reach the consumer or __next__ blocks
+                # forever; only an explicit close() may abandon delivery
+                while not self._closed.is_set():
+                    try:
+                        self._q.put(_STOP, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        """Release the worker (called on early exit; safe to call twice)."""
+        self._closed.set()
+        while True:                     # drain so a blocked put wakes up
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5)
+
+    def __iter__(self) -> Iterator[SparseBatch]:
+        return self
+
+    def __next__(self) -> SparseBatch:
+        item = self._q.get()
+        if item is _STOP:
+            self._thread.join()
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def __del__(self):
+        self._closed.set()

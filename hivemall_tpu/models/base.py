@@ -151,16 +151,29 @@ class LearnerBase:
 
     # -- columnar fast path --------------------------------------------------
     def fit(self, ds: SparseDataset, *, epochs: Optional[int] = None,
-            shuffle: bool = True) -> "LearnerBase":
+            shuffle: bool = True,
+            prefetch: Optional[bool] = None) -> "LearnerBase":
         epochs = int(self.opts.iters) if epochs is None else epochs
         bs = int(self.opts.mini_batch)
         labels = self._convert_labels(ds.labels)
         ds = SparseDataset(ds.indices, ds.indptr, ds.values, labels, ds.fields)
         # elastic recovery (SURVEY.md §6): per-epoch bundle when requested
         ckdir = os.environ.get("HIVEMALL_TPU_CHECKPOINT_DIR")
+        # overlap host batch prep + h2d with compute on accelerators
+        if prefetch is None:
+            import jax
+            prefetch = jax.default_backend() != "cpu"
         for ep in range(epochs):
-            for b in ds.batches(bs, shuffle=shuffle, seed=42 + ep):
-                self._dispatch(b)
+            it = ds.batches(bs, shuffle=shuffle, seed=42 + ep)
+            if prefetch:
+                from ..io.prefetch import DevicePrefetcher
+                it = DevicePrefetcher(it, depth=2)
+            try:
+                for b in it:
+                    self._dispatch(b)
+            finally:
+                if prefetch:
+                    it.close()       # release the worker on early exit too
             if ckdir:
                 os.makedirs(ckdir, exist_ok=True)
                 path = os.path.join(ckdir, f"{self.NAME}-ep{ep + 1}.npz")

@@ -10,14 +10,23 @@ Design — scatter-add is the natural formulation but lowers poorly on TPU
 (XLA serializes scatter updates). Instead the histogram is recast as a
 matmul so it rides the MXU:
 
-    hist[f, s, m*B + b]  =  sum_r  onehot(idx_r)[m*B + b] * ws[r, s]
+    hist[f, s, m*B + b]  =  sum_r  onehot(idx_{r,f})[m*B + b] * ws[s, r]
 
-where idx_r = node_local(r) * B + bin_code(r, f) is a combined (node, bin)
-one-hot column per row. The kernel tiles rows (VPU builds the one-hot by an
-iota compare) and contracts row-chunks on the MXU with `dot_general`,
-accumulating across the sequential row-chunk grid dimension. Inactive /
-padded rows carry idx < 0 and match no one-hot column, so no separate mask
-multiply is needed.
+where idx_{r,f} = node_local(r) * B + bin_code(r, f) is a combined
+(node, bin) one-hot column per (row, feature). Layout is chosen for
+Mosaic's tiling rules (last two block dims divisible by (8, 128)):
+
+  - idx is transposed to [d_pad8, n_pad] so a (8, ROWS) block holds the
+    feature's row chunk; the kernel selects its feature row with a dynamic
+    SUBLANE index (supported), never a lane index (not supported);
+  - ws is transposed/padded to [8, n_pad] (stat channels ≤ 8 per call);
+  - the one-hot is built TRANSPOSED ([MB_TILE, ROWS], rows on the lane
+    axis, matching idx's layout) via an iota compare on the VPU, then
+    contracted with ws on the MXU, accumulating across the sequential
+    row-chunk grid dimension.
+
+Inactive / padded rows carry idx < 0 and match no one-hot column, so no
+separate mask multiply is needed.
 
 Cost note: work is n * (M*B) * d compares + MACs per level (vs. n * d
 serialized scatter updates). For buffered-RF scale (n ≈ 1e5..1e6 rows,
@@ -26,7 +35,8 @@ of serialized scatter; at much larger n, partition rows by node first and
 histogram per partition (future work, noted in ops/trees.py).
 
 The pure-JAX scatter path in ops/trees.py remains the CPU fallback; tests
-run this kernel in interpreter mode and assert bit-level agreement.
+run this kernel in interpreter mode and assert agreement, and the same
+code compiles via Mosaic on a real chip.
 """
 
 from __future__ import annotations
@@ -40,8 +50,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["level_histogram", "use_pallas_default"]
 
-_ROWS = 256        # row-chunk tile (contraction dim; multiple of 8)
-_MB_TILE = 512     # one-hot column tile (lane dim; multiple of 128)
+_ROWS = 256        # row-chunk tile (lane axis; multiple of 128)
+_MB_TILE = 512     # one-hot column tile (sublane axis of ohT; mult. of 8)
+_SCH = 8           # stat-channel slab (sublane tile) — S ≤ 8 per call
 
 
 def use_pallas_default() -> bool:
@@ -52,13 +63,17 @@ def use_pallas_default() -> bool:
 
 
 def _hist_kernel(idx_ref, ws_ref, out_ref):
-    mb = pl.program_id(1)
-    local = idx_ref[:, 0] - mb * _MB_TILE                 # [_ROWS]
-    cols = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, _MB_TILE), 1)
-    oh = (cols == local[:, None]).astype(jnp.float32)     # [_ROWS, _MB_TILE]
-    acc = jax.lax.dot_general(                            # [S, _MB_TILE]
-        ws_ref[:], oh,
-        dimension_numbers=(((0,), (0,)), ((), ())),
+    f = pl.program_id(0)
+    m = pl.program_id(1)
+    local = idx_ref[f % 8, :] - m * _MB_TILE              # [_ROWS] lane vec
+    cols = jax.lax.broadcasted_iota(jnp.int32, (_MB_TILE, _ROWS), 0)
+    oh_t = (cols == local[None, :]).astype(jnp.float32)   # [_MB_TILE, _ROWS]
+    acc = jax.lax.dot_general(                            # [_SCH, _MB_TILE]
+        ws_ref[:], oh_t,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        # HIGHEST = f32-equivalent MXU passes; split stats must not round
+        # to bf16 (gini/gradient sums feed gain comparisons)
+        precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32)
 
     @pl.when(pl.program_id(2) == 0)
@@ -75,38 +90,47 @@ def level_histogram(bins: jnp.ndarray, loc: jnp.ndarray, ws: jnp.ndarray,
     """Histogram one tree level on TPU.
 
     bins: int [n, d] bin codes; loc: int32 [n] node-local id in [0, n_nodes)
-    or -1 for inactive rows; ws: f32 [n, S] weighted stat channels.
+    or -1 for inactive rows; ws: f32 [n, S] weighted stat channels (S ≤ 8).
     Returns f32 [n_nodes, d, n_bins, S].
     """
     n, d = bins.shape
     S = ws.shape[1]
+    if S > _SCH:                 # e.g. >8-class gini: chunk the channels
+        parts = [level_histogram(bins, loc, ws[:, s:s + _SCH],
+                                 n_nodes, n_bins)
+                 for s in range(0, S, _SCH)]
+        return jnp.concatenate(parts, axis=-1)
     mb = n_nodes * n_bins
     mbp = -(-mb // _MB_TILE) * _MB_TILE
     np_ = -(-n // _ROWS) * _ROWS
+    dp = -(-d // 8) * 8
 
     # combined (node, bin) one-hot column per (row, feature); <0 ⇒ no match
     idx = jnp.where(loc[:, None] >= 0,
                     loc[:, None] * n_bins + bins.astype(jnp.int32),
                     -1)
-    idx = jnp.pad(idx, ((0, np_ - n), (0, 0)), constant_values=-1)
-    wsp = jnp.pad(ws.astype(jnp.float32), ((0, np_ - n), (0, 0)))
+    idx_t = jnp.pad(idx, ((0, np_ - n), (0, dp - d)),
+                    constant_values=-1).T                 # [dp, np_]
+    ws_t = jnp.pad(ws.astype(jnp.float32),
+                   ((0, np_ - n), (0, _SCH - S))).T       # [_SCH, np_]
 
     out = pl.pallas_call(
         _hist_kernel,
         grid=(d, mbp // _MB_TILE, np_ // _ROWS),
         in_specs=[
-            pl.BlockSpec((_ROWS, 1), lambda f, m, r: (r, f),
+            pl.BlockSpec((8, _ROWS), lambda f, m, r: (f // 8, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((_ROWS, S), lambda f, m, r: (r, 0),
+            pl.BlockSpec((_SCH, _ROWS), lambda f, m, r: (0, r),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, S, _MB_TILE), lambda f, m, r: (f, 0, m),
+        out_specs=pl.BlockSpec((1, _SCH, _MB_TILE),
+                               lambda f, m, r: (f, 0, m),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((d, S, mbp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((d, _SCH, mbp), jnp.float32),
         interpret=jax.default_backend() != "tpu",
-    )(idx, wsp)
+    )(idx_t, ws_t)
 
-    # [d, S, mbp] → [n_nodes, d, n_bins, S]
-    return (out[:, :, :mb]
+    # [d, _SCH, mbp] → [n_nodes, d, n_bins, S]
+    return (out[:, :S, :mb]
             .reshape(d, S, n_nodes, n_bins)
             .transpose(2, 0, 3, 1))

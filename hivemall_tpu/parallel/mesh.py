@@ -42,8 +42,11 @@ def init_distributed(coordinator_address=None, num_processes=None,
         any(v is not None for v in (coordinator_address, num_processes,
                                     process_id))
         or bool(kwargs)
-        or any(k in os.environ for k in ("JAX_COORDINATOR_ADDRESS",
-                                         "COORDINATOR_ADDRESS")))
+        or any(k in os.environ for k in (
+            "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID",
+            "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE",
+            "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID")))
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

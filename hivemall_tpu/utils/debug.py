@@ -48,8 +48,10 @@ def checked(fn):
 
 
 def maybe_enable_from_env() -> bool:
-    """Process-wide debug-nans when HIVEMALL_TPU_DEBUG_NANS is set."""
-    if os.environ.get("HIVEMALL_TPU_DEBUG_NANS"):
-        jax.config.update("jax_debug_nans", True)
-        return True
-    return False
+    """Process-wide debug-nans when HIVEMALL_TPU_DEBUG_NANS is truthy.
+    Called from hivemall_tpu.__init__ so the env var alone suffices."""
+    val = os.environ.get("HIVEMALL_TPU_DEBUG_NANS", "").strip().lower()
+    if val in ("", "0", "false", "no", "off"):
+        return False
+    jax.config.update("jax_debug_nans", True)
+    return True

@@ -1,0 +1,61 @@
+"""Property tests (SURVEY.md §5: hypothesis for codecs and parsers)."""
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from hivemall_tpu.frame.tools import base91, deflate, inflate, unbase91
+from hivemall_tpu.utils.hashing import mhash, murmurhash3_x86_32
+from hivemall_tpu.utils.options import OptionSpec
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.binary(max_size=512))
+def test_base91_roundtrip(data):
+    assert unbase91(base91(data)) == data
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.text(max_size=512))
+def test_deflate_inflate_roundtrip(text):
+    assert inflate(deflate(text)) == text
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.text(min_size=0, max_size=64))
+def test_mmh3_is_deterministic_and_bounded(s):
+    a, b = murmurhash3_x86_32(s), murmurhash3_x86_32(s)
+    assert a == b
+    assert 0 <= a < 2 ** 32
+    h = mhash(s, 2 ** 24 - 1)
+    assert 1 <= h <= 2 ** 24 - 1          # reference mhash range [1, 2^24)
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.lists(st.sampled_from(["-eta0 0.5", "-iters 3", "-dense",
+                                 "-loss logloss"]), max_size=4))
+def test_option_parser_accepts_any_known_combo(opts):
+    spec = OptionSpec("t")
+    spec.add("eta0", type=float, default=0.1, help="")
+    spec.add("iters", type=int, default=1, help="")
+    spec.add("loss", default="hingeloss", help="")
+    spec.flag("dense", help="")
+    parsed = spec.parse(" ".join(opts))
+    # last-wins + defaults always produce a complete namespace
+    assert parsed.eta0 is not None and parsed.iters is not None
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.lists(st.tuples(st.integers(1, 1000),
+                          st.floats(-100, 100, allow_nan=False, width=32,
+                                    allow_subnormal=False)),
+                min_size=1, max_size=20))
+def test_feature_string_parse_roundtrip(pairs):
+    """'idx:val' strings parse back to the same (idx, val) arrays."""
+    from hivemall_tpu.models.linear import GeneralClassifier
+    tr = GeneralClassifier("-dims 2048 -int_feature")
+    feats = [f"{i}:{v:.6g}" for i, v in pairs]
+    idx, val = tr._parse_row(feats)
+    assert list(idx) == [i for i, _ in pairs]
+    np.testing.assert_allclose(val, [float(f"{v:.6g}") for _, v in pairs],
+                               rtol=1e-6)
