@@ -95,7 +95,14 @@ class DevicePrefetcher:
         return self
 
     def __next__(self) -> SparseBatch:
-        item = self._q.get()
+        while True:
+            if self._closed.is_set():       # closed stream ends, never hangs
+                raise StopIteration
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                continue
         if item is _STOP:
             self._thread.join()
             if self._err is not None:

@@ -38,15 +38,24 @@ def init_distributed(coordinator_address=None, num_processes=None,
     real fleet must not silently shrink to one worker). Only a bare local
     invocation with no cluster hints degrades to local devices.
     Returns the process index (0 when single-process)."""
+    def _int_env(name):
+        try:
+            return int(os.environ.get(name, "") or 0)
+        except ValueError:
+            return 0
+
+    # presence of a coordinator address, or a scheduler reporting >1 tasks
+    # — NOT mere presence of scheduler/TPU-VM vars, which single-host runs
+    # (salloc shells, every Cloud TPU VM) also carry
     multi_host_intent = (
         any(v is not None for v in (coordinator_address, num_processes,
                                     process_id))
         or bool(kwargs)
         or any(k in os.environ for k in (
             "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
-            "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID",
-            "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE",
-            "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID")))
+            "MEGASCALE_COORDINATOR_ADDRESS"))
+        or _int_env("SLURM_NTASKS") > 1
+        or _int_env("OMPI_COMM_WORLD_SIZE") > 1)
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

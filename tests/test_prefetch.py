@@ -56,3 +56,12 @@ def test_prefetcher_close_releases_worker():
     next(it)                       # take one batch, abandon the rest
     it.close()
     assert not it._thread.is_alive()
+
+
+def test_next_after_close_raises_stopiteration():
+    ds, _ = synthetic_classification(100, 5, seed=7)
+    it = DevicePrefetcher(ds.batches(8, shuffle=False), depth=1)
+    next(it)
+    it.close()
+    with pytest.raises(StopIteration):
+        next(it)
