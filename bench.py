@@ -51,7 +51,9 @@ def bench_ffm(n_steps: int = 60, warmup: int = 8):
         t.params["w"].block_until_ready()
         dt = time.perf_counter() - t0
         best = max(best, B * n_steps / dt)
-    return "train_ffm_examples_per_sec", best
+    # config is part of the metric name so cross-round comparisons don't
+    # silently conflate different bench configurations
+    return "train_ffm_b32k_bf16_examples_per_sec", best
 
 
 def bench_linear(n_steps: int = 100, warmup: int = 10):

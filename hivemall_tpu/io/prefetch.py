@@ -104,6 +104,7 @@ class DevicePrefetcher:
             except queue.Empty:
                 continue
         if item is _STOP:
+            self._closed.set()          # further next() calls end immediately
             self._thread.join()
             if self._err is not None:
                 raise self._err

@@ -21,6 +21,7 @@ argmin-KLD mixing/merging stays available.
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Callable, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -338,7 +339,7 @@ class AdaGradRDATrainer(_OnlineBase):
         lam = float(self.opts["lambda"])
         eta0 = float(self.opts.eta0)
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
         def step(w, u, gg, t, idx, val, label, row_mask):
             m = (w[idx] * val).sum(-1) * label
             active = ((m < 1.0).astype(jnp.float32)) * row_mask

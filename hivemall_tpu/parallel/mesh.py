@@ -55,7 +55,11 @@ def init_distributed(coordinator_address=None, num_processes=None,
             "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
             "MEGASCALE_COORDINATOR_ADDRESS"))
         or _int_env("SLURM_NTASKS") > 1
-        or _int_env("OMPI_COMM_WORLD_SIZE") > 1)
+        or _int_env("OMPI_COMM_WORLD_SIZE") > 1
+        # TPU pod slice: hostnames var lists every worker, single-host
+        # TPU VMs carry it too but with exactly one entry
+        or len([h for h in os.environ.get("TPU_WORKER_HOSTNAMES",
+                                          "").split(",") if h]) > 1)
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
