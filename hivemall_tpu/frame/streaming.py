@@ -1,0 +1,107 @@
+"""Streaming scoring — the HivemallStreamingOps analog.
+
+Reference (SURVEY.md §3.18): the Spark binding ships DStream scoring
+(`HivemallStreamingOps`) so a trained model table scores an unbounded
+stream of rows without a batch job. The rebuild's equivalent: load the
+model table into a dense hashed weight array ONCE, then score arriving
+row chunks with the same jitted gather + segment-sum (+ sigmoid) kernel
+the batch predict path uses (SURVEY.md §4.2) — each chunk is one device
+dispatch. Chunk shapes bucket to powers of two so jit traces a handful
+of shapes, not one per chunk, and feature names hash through the
+vectorized/native mhash_batch (the host ingest hot path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Sequence
+
+import numpy as np
+
+from ..models.linear import _sigmoid
+from ..ops.linear import make_linear_predict
+from ..utils.hashing import mhash, mhash_batch
+
+__all__ = ["StreamingScorer"]
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class StreamingScorer:
+    """Score feature-string rows against a (feature -> weight) model table.
+
+    >>> scorer = StreamingScorer(model_table, dims=2**20, sigmoid=True)
+    >>> for chunk in stream:                 # chunk: list of row feature lists
+    ...     scores = scorer.score(chunk)     # np.ndarray [len(chunk)]
+    """
+
+    def __init__(self, model: Dict[str, float], dims: int = 1 << 24,
+                 *, sigmoid: bool = False):
+        self.dims = dims
+        self.sigmoid = sigmoid
+        w = np.zeros(dims, np.float32)
+        for feat, weight in model.items():
+            try:
+                i = int(feat)
+            except ValueError:
+                i = mhash(feat, dims - 1)
+            if 0 <= i < dims:
+                w[i] = float(weight)
+        import jax.numpy as jnp
+        self._w = jnp.asarray(w)
+        self._predict = make_linear_predict()
+
+    def score(self, rows: Sequence[Sequence[str]]) -> np.ndarray:
+        """Score one chunk of rows (list of "name:val" feature lists)."""
+        n_rows = len(rows)
+        if not n_rows:
+            return np.zeros(0, np.float32)
+        names: List[str] = []
+        vals: List[float] = []
+        row_len: List[int] = []
+        for r in rows:
+            n = 0
+            for f in r:
+                if f is None or f == "":
+                    continue
+                name, sep, v = str(f).rpartition(":")
+                if not sep:
+                    name, v = str(f), "1.0"
+                names.append(name)
+                vals.append(float(v))
+                n += 1
+            row_len.append(n)
+        ids = np.zeros(len(names), np.int64)
+        str_pos: List[int] = []
+        str_names: List[str] = []
+        for i, nm in enumerate(names):
+            try:
+                ids[i] = int(nm)
+            except ValueError:
+                str_pos.append(i)
+                str_names.append(nm)
+        if str_pos:
+            ids[np.asarray(str_pos)] = mhash_batch(str_names, self.dims - 1)
+        # pow2 buckets: jit traces a handful of (B, L) shapes per stream
+        B = _pow2(n_rows)
+        L = _pow2(max(row_len) if row_len else 1) or 1
+        idx = np.zeros((B, L), np.int32)
+        val = np.zeros((B, L), np.float32)
+        off = 0
+        varr = np.asarray(vals, np.float32)
+        for b, n in enumerate(row_len):
+            idx[b, :n] = ids[off:off + n]
+            val[b, :n] = varr[off:off + n]
+            off += n
+        out = np.asarray(self._predict(self._w, idx, val))[:n_rows]
+        return _sigmoid(out) if self.sigmoid else out
+
+    def score_stream(self, chunks: Iterable[Sequence[Sequence[str]]]
+                     ) -> Iterator[np.ndarray]:
+        """Generator form: yields one score array per incoming chunk."""
+        for chunk in chunks:
+            yield self.score(chunk)

@@ -45,6 +45,7 @@ class SparseBatch:
 
 def parse_feature_strings(features: Sequence[str],
                           *, int_feature: bool = False,
+                          num_features: Optional[int] = None,
                           ) -> Tuple[np.ndarray, np.ndarray]:
     """Parse one row of ``"idx:val"`` / ``"idx"`` feature strings.
 
@@ -68,7 +69,8 @@ def parse_feature_strings(features: Sequence[str],
                 raise ValueError(
                     f"-int_feature is set but feature name {name!r} is not an "
                     f"integer index")
-            i = mhash(name)
+            i = mhash(name) if num_features is None \
+                else mhash(name, num_features)
         idx.append(i)
         val.append(float(v))
     return np.asarray(idx, np.int32), np.asarray(val, np.float32)

@@ -88,6 +88,30 @@ class LDATrainer:
         m = int(self.opts.max_doc_len)
         return ids[:m], cts[:m]
 
+    # -- full-state checkpointing (io.checkpoint bundles, SURVEY.md §6) ------
+    def _checkpoint_arrays(self):
+        return {"lam": self.lam}
+
+    def _restore_arrays(self, tree) -> None:
+        self.lam = tree["lam"]
+
+    def _checkpoint_scalars(self):
+        return {"vocab_names": {str(k): v
+                                for k, v in self._vocab_names.items()}}
+
+    def _restore_scalars(self, scalars) -> None:
+        self._vocab_names.update(
+            {int(k): v for k, v in scalars.get("vocab_names", {}).items()})
+
+    def save_bundle(self, path: str) -> None:
+        from ..io.checkpoint import save_bundle
+        self._flush()
+        save_bundle(self, path)
+
+    def load_bundle(self, path: str) -> None:
+        from ..io.checkpoint import load_bundle
+        load_bundle(self, path)
+
     def _make_step(self):
         o = self.opts
         K, V = self.K, self.V

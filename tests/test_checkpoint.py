@@ -165,3 +165,25 @@ def test_per_epoch_auto_checkpoint(tmp_path, monkeypatch):
     resumed = GeneralClassifier("-dims 128 -mini_batch 16 -iters 3")
     resumed.load_bundle(str(tmp_path / files[-1]))
     assert resumed._t == tr._t
+
+
+def test_lda_bundle_resume(tmp_path):
+    """Topic-model bundles: lambda matrix + hashed vocab names survive."""
+    from hivemall_tpu.models.topicmodel import LDATrainer
+    docs_a = [["apple", "banana", "fruit"] * 4 for _ in range(10)]
+    docs_b = [["stock", "market", "trade"] * 4 for _ in range(10)]
+    opts = "-topics 2 -vocab 1024 -mini_batch 4"
+    tr = LDATrainer(opts)
+    for d in docs_a + docs_b:
+        tr.process(d)
+    tr._flush()
+    p = tmp_path / "lda.npz"
+    tr.save_bundle(str(p))
+    fresh = LDATrainer(opts)
+    fresh.load_bundle(str(p))
+    np.testing.assert_allclose(np.asarray(fresh.lam), np.asarray(tr.lam))
+    assert fresh._vocab_names == tr._vocab_names
+    assert fresh._t == tr._t
+    # restored model assigns the same topics
+    np.testing.assert_allclose(fresh.transform(["apple", "banana"]),
+                               tr.transform(["apple", "banana"]), rtol=1e-6)

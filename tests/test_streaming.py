@@ -1,0 +1,46 @@
+"""StreamingScorer — the HivemallStreamingOps analog (SURVEY.md §3.18)."""
+
+import numpy as np
+
+from hivemall_tpu.frame.streaming import StreamingScorer
+from hivemall_tpu.models.linear import GeneralClassifier
+
+
+def _trained():
+    rng = np.random.default_rng(2)
+    tr = GeneralClassifier("-dims 4096 -loss logloss -opt adagrad -reg no "
+                           "-eta fixed -eta0 0.5 -mini_batch 16")
+    rows = []
+    for _ in range(200):
+        x = rng.normal(size=3)
+        feats = [f"f{j}:{x[j]:.4f}" for j in range(3)]
+        tr.process(feats, 1 if x[0] > 0 else -1)
+        rows.append((feats, 1 if x[0] > 0 else -1))
+    return dict(tr.close()), rows
+
+
+def test_stream_scores_match_direction():
+    model, rows = _trained()
+    scorer = StreamingScorer(model, dims=4096, sigmoid=True)
+    feats = [r[0] for r in rows]
+    labels = np.asarray([r[1] for r in rows])
+    scores = scorer.score(feats)
+    acc = ((scores > 0.5) == (labels > 0)).mean()
+    assert acc > 0.9, acc
+    assert np.all((scores >= 0) & (scores <= 1))
+
+
+def test_stream_chunked_equals_batch():
+    model, rows = _trained()
+    scorer = StreamingScorer(model, dims=4096)
+    feats = [r[0] for r in rows]
+    whole = scorer.score(feats)
+    chunked = np.concatenate(
+        list(scorer.score_stream([feats[i:i + 32]
+                                  for i in range(0, len(feats), 32)])))
+    np.testing.assert_allclose(whole, chunked, rtol=1e-6, atol=1e-6)
+
+
+def test_empty_chunk():
+    model, _ = _trained()
+    assert StreamingScorer(model, dims=4096).score([]).shape == (0,)
