@@ -30,11 +30,11 @@ def _cmd_train(args) -> int:
     cls = lookup(args.algo).resolve()
     trainer = cls(args.options or "")
     for flag in ("load_bundle", "save_bundle"):   # fail fast, not post-train
-        if getattr(args, flag, None) and not hasattr(trainer, flag):
+        if getattr(args, flag) and not hasattr(trainer, flag):
             print(f"error: {args.algo} does not support checkpoint bundles "
                   f"(--{flag.replace('_', '-')})", file=sys.stderr)
             return 2
-    if getattr(args, "load_bundle", None):
+    if args.load_bundle:
         trainer.load_bundle(args.load_bundle)
     ds = read_libsvm(args.input)
     t0 = time.time()
@@ -46,7 +46,7 @@ def _cmd_train(args) -> int:
             trainer.process(ds.row(i), float(ds.labels[i]))
         rows = list(trainer.close())
     dt = time.time() - t0
-    if getattr(args, "save_bundle", None):
+    if args.save_bundle:
         trainer.save_bundle(args.save_bundle)
     if args.model:
         if hasattr(trainer, "save_model"):

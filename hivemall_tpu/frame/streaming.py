@@ -17,18 +17,12 @@ from typing import Dict, Iterable, Iterator, List, Sequence
 
 import numpy as np
 
+from ..io.sparse import pow2_len, split_feature
 from ..models.linear import _sigmoid
 from ..ops.linear import make_linear_predict
 from ..utils.hashing import mhash, mhash_batch
 
 __all__ = ["StreamingScorer"]
-
-
-def _pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p <<= 1
-    return p
 
 
 class StreamingScorer:
@@ -68,9 +62,7 @@ class StreamingScorer:
             for f in r:
                 if f is None or f == "":
                     continue
-                name, sep, v = str(f).rpartition(":")
-                if not sep:
-                    name, v = str(f), "1.0"
+                name, v = split_feature(f)
                 names.append(name)
                 vals.append(float(v))
                 n += 1
@@ -87,8 +79,8 @@ class StreamingScorer:
         if str_pos:
             ids[np.asarray(str_pos)] = mhash_batch(str_names, self.dims - 1)
         # pow2 buckets: jit traces a handful of (B, L) shapes per stream
-        B = _pow2(n_rows)
-        L = _pow2(max(row_len) if row_len else 1) or 1
+        B = pow2_len(n_rows)
+        L = pow2_len(max(row_len) if row_len else 1)
         idx = np.zeros((B, L), np.int32)
         val = np.zeros((B, L), np.float32)
         off = 0

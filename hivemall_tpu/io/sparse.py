@@ -19,7 +19,28 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["SparseBatch", "SparseDataset", "pad_examples", "parse_feature_strings"]
+__all__ = ["SparseBatch", "SparseDataset", "pad_examples",
+           "parse_feature_strings", "split_feature", "pow2_len"]
+
+
+def pow2_len(n: int) -> int:
+    """Smallest power of two >= max(n, 1) — the shared shape bucket."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def split_feature(f) -> Tuple[str, str]:
+    """Split one feature string into (name, value-string).
+
+    Reference semantics (hivemall.model.FeatureValue.parse): a bare
+    ``"name"`` means value 1.0; ``"name:val"`` splits on the LAST ':' so
+    names containing ':' still parse."""
+    name, sep, v = str(f).rpartition(":")
+    if not sep:
+        return str(f), "1.0"
+    return name, v
 
 
 @dataclass
@@ -59,9 +80,7 @@ def parse_feature_strings(features: Sequence[str],
     for f in features:
         if f is None or f == "":
             continue
-        name, sep, v = str(f).rpartition(":")
-        if not sep:
-            name, v = str(f), "1.0"
+        name, v = split_feature(f)
         try:
             i = int(name)
         except ValueError:

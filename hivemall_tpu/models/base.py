@@ -20,7 +20,7 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tupl
 
 import numpy as np
 
-from ..io.sparse import SparseBatch, SparseDataset
+from ..io.sparse import SparseBatch, SparseDataset, pow2_len, split_feature
 from ..utils.hashing import mhash
 from ..utils.metrics import Meter, get_stream
 from ..utils.options import OptionSpec, Parsed
@@ -194,9 +194,7 @@ class LearnerBase:
         for f in features:
             if f is None or f == "":
                 continue
-            name, sep, v = str(f).rpartition(":")
-            if not sep:
-                name, v = str(f), "1"
+            name, v = split_feature(f)
             try:
                 i = int(name)
             except ValueError:
@@ -219,12 +217,9 @@ class LearnerBase:
             return np.where(labels > 0, 1.0, -1.0).astype(np.float32)
         return labels.astype(np.float32)
 
-    @staticmethod
-    def _pow2_len(n: int) -> int:
-        L = 1
-        while L < n:
-            L <<= 1
-        return L
+    # shared shape bucket (io.sparse.pow2_len); kept as a method alias for
+    # subclasses that call self._pow2_len
+    _pow2_len = staticmethod(pow2_len)
 
     def _flush(self) -> None:
         if not self._buf_rows:

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..io.sparse import SparseBatch
+from ..io.sparse import SparseBatch, pow2_len, split_feature
 from ..utils.options import OptionSpec
 from .classifier import _cw_beta, _online_spec, _phi_of
 
@@ -77,9 +77,7 @@ class _MulticlassBase:
         for f in features:
             if f in (None, ""):
                 continue
-            name, sep, v = str(f).rpartition(":")
-            if not sep:
-                name, v = str(f), "1"
+            name, v = split_feature(f)
             try:
                 i = int(name)
             except ValueError:
@@ -102,10 +100,7 @@ class _MulticlassBase:
         chunk = self._buf
         self._buf = []
         B = int(self.opts.mini_batch)
-        L = max(1, max(len(r[0]) for r in chunk))
-        Lp = 1
-        while Lp < L:
-            Lp <<= 1
+        Lp = pow2_len(max(1, max(len(r[0]) for r in chunk)))
         idx = np.zeros((B, Lp), np.int32)
         val = np.zeros((B, Lp), np.float32)
         y = np.zeros(B, np.int32)

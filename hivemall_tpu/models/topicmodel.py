@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..io.sparse import pow2_len
 from ..utils.hashing import mhash
 from ..utils.options import OptionSpec
 
@@ -172,10 +173,7 @@ class LDATrainer:
         docs = self._buf
         self._buf = []
         B = int(self.opts.mini_batch)
-        L = max(len(d[0]) for d in docs)
-        Lp = 1
-        while Lp < L:
-            Lp <<= 1
+        Lp = pow2_len(max(len(d[0]) for d in docs))
         ids = np.zeros((B, Lp), np.int32)
         cts = np.zeros((B, Lp), np.float32)
         mask = np.zeros((B, Lp), np.float32)

@@ -29,10 +29,11 @@ Inactive / padded rows carry idx < 0 and match no one-hot column, so no
 separate mask multiply is needed.
 
 Cost note: work is n * (M*B) * d compares + MACs per level (vs. n * d
-serialized scatter updates). For buffered-RF scale (n ≈ 1e5..1e6 rows,
-depth ≤ 8 ⇒ M*B ≤ 16384) this is milliseconds on the VPU/MXU and far ahead
-of serialized scatter; at much larger n, partition rows by node first and
-histogram per partition (future work, noted in ops/trees.py).
+serialized scatter updates). Measured on v5e (d=28, depth 8, B=64):
+~1s/tree at n=1e5, ~5.8s/tree at n=1e6 steady-state — compute-bound on
+the deep-level one-hot compares. At much larger n the next step is to
+sort rows by node per level and histogram per node window (M drops out
+of the compare count); not yet implemented.
 
 The pure-JAX scatter path in ops/trees.py remains the CPU fallback; tests
 run this kernel in interpreter mode and assert agreement, and the same

@@ -23,6 +23,7 @@ import os
 import socket
 import sys
 import time
+from collections import deque
 from typing import Any, Dict, IO, Optional
 
 __all__ = ["MetricsStream", "Meter", "get_stream", "profile_trace"]
@@ -33,7 +34,7 @@ class Meter:
 
     def __init__(self, window: float = 30.0):
         self.window = window
-        self._marks: list = []          # (monotonic time, cumulative count)
+        self._marks: deque = deque()    # (monotonic time, cumulative count)
         self.total = 0
 
     def add(self, n: int) -> None:
@@ -42,7 +43,7 @@ class Meter:
         self._marks.append((now, self.total))
         lo = now - self.window
         while len(self._marks) > 2 and self._marks[0][0] < lo:
-            self._marks.pop(0)
+            self._marks.popleft()
 
     @property
     def rate(self) -> float:
