@@ -62,6 +62,44 @@ class _MulticlassBase:
         self._step = self._make_step()
         self._t = 0
 
+    # -- full-state checkpointing (io.checkpoint bundles, SURVEY.md §6) ------
+    def _checkpoint_arrays(self):
+        tree = {"W": self.W}
+        if self.sigma is not None:
+            tree["sigma"] = self.sigma
+        return tree
+
+    def _restore_arrays(self, tree) -> None:
+        self.W = tree["W"]
+        if "sigma" in tree:
+            self.sigma = tree["sigma"]
+
+    def _checkpoint_scalars(self):
+        # class labels are json keys; keep their original type tag so int
+        # labels don't come back as strings
+        return {"labels": [[type(k).__name__, str(k), v]
+                           for k, v in self._labels.items()]}
+
+    def _restore_scalars(self, scalars) -> None:
+        for tname, key, row in scalars.get("labels", []):
+            if tname == "bool":            # bool first: bool < int in Python
+                self._labels[key == "True"] = int(row)
+            elif "int" in tname:
+                self._labels[int(key)] = int(row)
+            elif "float" in tname:
+                self._labels[float(key)] = int(row)
+            else:
+                self._labels[key] = int(row)
+
+    def save_bundle(self, path: str) -> None:
+        from ..io.checkpoint import save_bundle
+        self._flush()
+        save_bundle(self, path)
+
+    def load_bundle(self, path: str) -> None:
+        from ..io.checkpoint import load_bundle
+        load_bundle(self, path)
+
     # -- label/row handling --------------------------------------------------
     def _label_id(self, label) -> int:
         if label not in self._labels:

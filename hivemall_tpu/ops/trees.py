@@ -30,7 +30,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from hivemall_tpu.ops.pallas_hist import level_histogram, use_pallas_default
+from hivemall_tpu.ops.pallas_hist import (level_histogram,
+                                          level_histogram_sorted,
+                                          use_pallas_default)
 
 __all__ = ["quantize_bins", "Tree", "build_tree_classifier",
            "build_tree_regressor", "build_tree_xgb", "predict_bins",
@@ -133,9 +135,14 @@ def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
             # ---- histogram: one pass for the whole level ----
             loc = jnp.where(active, local, 0)
             if use_pallas:
-                # MXU one-hot-contraction kernel (ops/pallas_hist.py)
+                # MXU one-hot-contraction kernels (ops/pallas_hist.py):
+                # flat for shallow levels, sorted-window once the frontier
+                # outgrows one 512-column tile (measured 15x at M=256)
                 loc_m = jnp.where(active, local, -1)
-                hist = level_histogram(bins, loc_m, ws, M, n_bins)
+                if M * n_bins > 512:
+                    hist = level_histogram_sorted(bins, loc_m, ws, M, n_bins)
+                else:
+                    hist = level_histogram(bins, loc_m, ws, M, n_bins)
             else:
                 # CPU fallback: flat scatter-add ((local*d + f)*B + bin)
                 fidx = (loc[:, None] * d + jnp.arange(d)[None, :]) * n_bins \

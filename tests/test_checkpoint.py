@@ -187,3 +187,38 @@ def test_lda_bundle_resume(tmp_path):
     # restored model assigns the same topics
     np.testing.assert_allclose(fresh.transform(["apple", "banana"]),
                                tr.transform(["apple", "banana"]), rtol=1e-6)
+
+
+def test_multiclass_bundle_resume(tmp_path):
+    """Multiclass bundles keep the class-row map with label types intact."""
+    from hivemall_tpu.models.multiclass import MulticlassPerceptronTrainer
+    rng = np.random.default_rng(8)
+    opts = "-classes 3 -dims 1024 -mini_batch 8"
+    tr = MulticlassPerceptronTrainer(opts)
+    for _ in range(60):
+        x = rng.normal(size=3)
+        cls = int(np.argmax(x))
+        tr.process([f"f{j}:{x[j]:.4f}" for j in range(3)], cls)
+    tr._flush()
+    p = tmp_path / "mc.npz"
+    tr.save_bundle(str(p))
+    fresh = MulticlassPerceptronTrainer(opts)
+    fresh.load_bundle(str(p))
+    assert fresh._labels == tr._labels
+    assert all(isinstance(k, int) for k in fresh._labels)
+    np.testing.assert_allclose(np.asarray(fresh.W), np.asarray(tr.W))
+
+
+def test_multiclass_bundle_bool_labels(tmp_path):
+    from hivemall_tpu.models.multiclass import MulticlassPerceptronTrainer
+    opts = "-classes 2 -dims 256 -mini_batch 4"
+    tr = MulticlassPerceptronTrainer(opts)
+    for i in range(8):
+        tr.process([f"f{i % 3}:1.0"], bool(i % 2))
+    tr._flush()
+    p = tmp_path / "b.npz"
+    tr.save_bundle(str(p))
+    fresh = MulticlassPerceptronTrainer(opts)
+    fresh.load_bundle(str(p))
+    assert fresh._labels == tr._labels
+    assert all(isinstance(k, bool) for k in fresh._labels)

@@ -64,3 +64,71 @@ def test_pallas_builder_matches_scatter_builder():
     for a, b in zip(*outs):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("n,d,M,B,S", [(70, 5, 4, 16, 3),
+                                       (500, 3, 64, 64, 2),
+                                       (1000, 7, 256, 64, 3)])
+def test_sorted_histogram_matches_flat(n, d, M, B, S):
+    from hivemall_tpu.ops.pallas_hist import level_histogram_sorted
+    rng = np.random.default_rng(11)
+    bins = rng.integers(0, B, (n, d)).astype(np.uint8)
+    loc = rng.integers(-1, M, n).astype(np.int32)
+    ws = rng.normal(size=(n, S)).astype(np.float32)
+    a = np.asarray(level_histogram(jnp.asarray(bins), jnp.asarray(loc),
+                                   jnp.asarray(ws), M, B))
+    b = np.asarray(level_histogram_sorted(jnp.asarray(bins),
+                                          jnp.asarray(loc),
+                                          jnp.asarray(ws), M, B))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_sorted_histogram_skewed_nodes():
+    """All rows on one node: every other window is spill-free and masked."""
+    from hivemall_tpu.ops.pallas_hist import level_histogram_sorted
+    rng = np.random.default_rng(12)
+    n, d, M, B = 400, 3, 128, 64
+    bins = rng.integers(0, B, (n, d)).astype(np.uint8)
+    loc = np.full(n, 77, np.int32)           # single hot node
+    ws = np.ones((n, 1), np.float32)
+    out = np.asarray(level_histogram_sorted(jnp.asarray(bins),
+                                            jnp.asarray(loc),
+                                            jnp.asarray(ws), M, B))
+    assert out.sum() == n * d
+    assert np.all(out[np.arange(M) != 77] == 0)
+
+
+def test_sorted_histogram_trailing_inactive_chunks():
+    """>= one full chunk of inactive rows at the end must not clobber
+    window 0 (regression: all-inactive chunks forward-fill their home
+    window instead of defaulting to 0)."""
+    from hivemall_tpu.ops.pallas_hist import level_histogram_sorted
+    rng = np.random.default_rng(13)
+    n, d, M, B = 1000, 3, 128, 64
+    bins = rng.integers(0, B, (n, d)).astype(np.uint8)
+    loc = rng.integers(0, M, n).astype(np.int32)
+    loc[n // 2:] = -1                    # half the rows inactive (sorted last)
+    ws = rng.normal(size=(n, 2)).astype(np.float32)
+    a = np.asarray(level_histogram(jnp.asarray(bins), jnp.asarray(loc),
+                                   jnp.asarray(ws), M, B))
+    b = np.asarray(level_histogram_sorted(jnp.asarray(bins),
+                                          jnp.asarray(loc),
+                                          jnp.asarray(ws), M, B))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_sorted_histogram_many_channels_and_odd_bins():
+    from hivemall_tpu.ops.pallas_hist import level_histogram_sorted
+    rng = np.random.default_rng(14)
+    n, d, M = 300, 3, 32
+    # S > 8: channel slabs share one sort; B=100 falls back to flat kernel
+    for B, S in ((32, 11), (100, 2)):
+        bins = rng.integers(0, B, (n, d)).astype(np.uint8)
+        loc = rng.integers(-1, M, n).astype(np.int32)
+        ws = rng.normal(size=(n, S)).astype(np.float32)
+        a = np.asarray(level_histogram(jnp.asarray(bins), jnp.asarray(loc),
+                                       jnp.asarray(ws), M, B))
+        b = np.asarray(level_histogram_sorted(jnp.asarray(bins),
+                                              jnp.asarray(loc),
+                                              jnp.asarray(ws), M, B))
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
