@@ -29,7 +29,8 @@ from ..utils.options import OptionSpec
 
 __all__ = ["RandomForestClassifier", "RandomForestRegressor",
            "GradientBoosting", "XGBoostClassifier", "XGBoostRegressor",
-           "XGBoostMulticlassClassifier", "tree_predict", "rf_ensemble",
+           "XGBoostMulticlassClassifier", "tree_predict", "tree_model_meta",
+           "rf_ensemble",
            "guess_attribute_types", "serialize_tree", "deserialize_tree"]
 
 
@@ -247,7 +248,8 @@ class GradientBoosting:
         self._y.append(float(label))
 
     def close(self) -> Iterator[Tuple[int, str]]:
-        self.fit(np.asarray(self._X, np.float32), np.asarray(self._y))
+        if self._X:                  # refit only from buffered rows; a prior
+            self.fit(np.asarray(self._X, np.float32), np.asarray(self._y))
         for r, tree in enumerate(self.trees):
             yield (r, serialize_tree(tree, 0,
                                      {"eta": np.float32(self.eta),
@@ -369,6 +371,22 @@ class XGBoostMulticlassClassifier(GradientBoosting):
     def predict(self, X: np.ndarray) -> np.ndarray:
         return self.classes_[self.predict_proba(X).argmax(-1)]
 
+    def close(self) -> Iterator[Tuple[int, str]]:
+        """Emit one row per (round, class) tree — the base close() expects a
+        flat tree list and cannot serialize the per-class nesting."""
+        if self._X:                  # direct fit() then close() serializes
+            self.fit(np.asarray(self._X, np.float32), np.asarray(self._y))
+        mid = 0
+        for round_trees in self.trees:
+            for c, tree in enumerate(round_trees):
+                yield (mid, serialize_tree(
+                    tree, 0,
+                    {"eta": np.float32(self.eta),
+                     "cls": np.int32(self.classes_[c]),
+                     "objective": np.frombuffer(
+                         self.objective.encode(), np.uint8)}))
+                mid += 1
+
 
 # --- SQL-side predict / ensemble / attr helpers ----------------------------
 
@@ -380,12 +398,30 @@ def tree_predict(model_blob: str, features: Sequence[float],
     out = predict_bins(tree, bin_raw(np.asarray([features], np.float32),
                                      tree.edges))[0, 0]
     if "eta" in extra:               # boosting tree: raw leaf value
+        if "cls" in extra:           # multiclass softmax: (class, leaf) so
+            # the SQL pattern GROUP BY rowid, cls / sum(leaf) / argmax works
+            return int(extra["cls"]), float(out[0])
         return float(out[0])
     if classification:
         cls = extra.get("classes")
         k = int(np.argmax(out))
         return int(cls[k]) if cls is not None else k
     return float(out[0])
+
+
+def tree_model_meta(model_blob: str) -> Dict:
+    """Scalar metadata of a serialized tree blob (eta, base, cls, objective)
+    — what a scorer needs to assemble per-tree leaves into a prediction."""
+    _, extra = deserialize_tree(model_blob)
+    meta: Dict = {}
+    for k in ("eta", "base", "cls"):
+        if k in extra:
+            meta[k] = extra[k].item() if hasattr(extra[k], "item") \
+                else extra[k]
+    if "objective" in extra:
+        meta["objective"] = bytes(np.asarray(extra["objective"])
+                                  .tobytes()).decode()
+    return meta
 
 
 def rf_ensemble(predictions: Sequence) -> Tuple[object, float, List[float]]:

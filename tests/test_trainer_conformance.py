@@ -1,0 +1,78 @@
+"""Catalog conformance: every train_* SQL name resolves, instantiates,
+round-trips a smoke input through process()/close(), and emits rows
+(SURVEY.md §5 "catalog conformance test ... round-trips a smoke input")."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.catalog.registry import all_functions, lookup
+
+RNG = np.random.default_rng(0)
+
+SPARSE = [([f"f{j}:{v:.3f}" for j, v in enumerate(RNG.normal(size=3))],
+           1 if i % 2 else -1) for i in range(24)]
+DENSE = [(list(RNG.normal(size=4)), i % 2) for i in range(40)]
+FFM = [([f"{f}:{f * 7 + i % 5 + 1}:1.0" for f in range(3)], 1 if i % 2 else -1)
+       for i in range(24)]
+TRIPLES = [(int(RNG.integers(6)), int(RNG.integers(5)),
+            float(RNG.normal() + 3)) for _ in range(30)]
+DOCS = [(["alpha", "beta", "gamma", "delta"] * 3,) for _ in range(12)]
+
+# name -> (constructor options, rows). Rows are *args tuples for process().
+SMOKE = {}
+for name in ["train_classifier", "train_perceptron", "train_pa", "train_pa1",
+             "train_pa2", "train_cw", "train_arow", "train_arowh",
+             "train_scw", "train_scw2", "train_adagrad_rda", "train_kpa"]:
+    SMOKE[name] = ("-mini_batch 8 -dims 1024", [(f, y) for f, y in SPARSE])
+for name in ["train_regressor", "train_logregr", "train_adagrad_regr",
+             "train_adadelta_regr", "train_pa1_regr", "train_pa1a_regr",
+             "train_pa2_regr", "train_pa2a_regr", "train_arow_regr",
+             "train_arowe_regr", "train_arowe2_regr"]:
+    SMOKE[name] = ("-mini_batch 8 -dims 1024",
+                   [(f, float(max(0, y))) for f, y in SPARSE])
+for name in ["train_multiclass_perceptron", "train_multiclass_pa",
+             "train_multiclass_pa1", "train_multiclass_pa2",
+             "train_multiclass_cw", "train_multiclass_arow",
+             "train_multiclass_scw", "train_multiclass_scw2"]:
+    SMOKE[name] = ("-classes 3 -mini_batch 8 -dims 1024",
+                   [(f, i % 3) for i, (f, _) in enumerate(SPARSE)])
+SMOKE["train_fm"] = ("-factors 4 -mini_batch 8 -dims 1024 -classification",
+                     [(f, y) for f, y in SPARSE])
+SMOKE["train_ffm"] = ("-factors 4 -fields 4 -mini_batch 8 -dims 1024 "
+                      "-classification", FFM)
+SMOKE["train_mf_sgd"] = ("-factors 4 -users 8 -items 8 -mini_batch 8 -mu 3.0",
+                         TRIPLES)
+SMOKE["train_mf_adagrad"] = SMOKE["train_mf_sgd"]
+SMOKE["train_bprmf"] = ("-factors 4 -users 8 -items 8 -mini_batch 8",
+                        [(u, i, (i + 1) % 5) for u, i, _ in TRIPLES])
+SMOKE["train_slim"] = ("-l1 0.01 -iters 5",
+                       [(u, i % 6, r) for u, i, r in TRIPLES])
+SMOKE["train_word2vec"] = ("-dim 8 -window 2 -neg 2 -min_count 1 "
+                           "-mini_batch 64 -iters 1 -sample 0", DOCS)
+SMOKE["train_lda"] = ("-topics 2 -vocab 256 -mini_batch 4", DOCS)
+SMOKE["train_plsa"] = ("-topics 2 -vocab 256 -mini_batch 4", DOCS)
+for name in ["train_randomforest_classifier", "train_xgboost_classifier",
+             "train_multiclass_xgboost_classifier"]:
+    SMOKE[name] = ("-trees 2 -depth 3" if "randomforest" in name
+                   else "-num_round 2 -max_depth 3", DENSE)
+SMOKE["train_randomforest_regressor"] = (
+    "-trees 2 -depth 3", [(f, float(y)) for f, y in DENSE])
+SMOKE["train_xgboost_regr"] = (
+    "-num_round 2 -max_depth 3", [(f, float(y)) for f, y in DENSE])
+
+
+def test_every_trainer_is_smoke_covered():
+    trainers = [n for n in all_functions() if n.startswith("train_")]
+    missing = [n for n in trainers if n not in SMOKE]
+    assert not missing, f"no smoke spec for: {missing}"
+
+
+@pytest.mark.parametrize("name", sorted(SMOKE))
+def test_trainer_smoke(name):
+    opts, rows = SMOKE[name]
+    cls = lookup(name).resolve()
+    tr = cls(opts)
+    for args in rows:
+        tr.process(*args)
+    out = list(tr.close())
+    assert out, f"{name} emitted no model rows"

@@ -114,3 +114,38 @@ def test_rf_tree_predict_and_ensemble():
 
 def test_guess_attribute_types():
     assert guess_attribute_types(1.5, "tokyo", 3) == "Q,C,Q"
+
+
+def test_multiclass_gbt_blob_prediction_assembles():
+    """Multiclass blobs carry (cls, leaf): the SQL group-by-class pattern
+    reconstructs the trainer's own prediction."""
+    import numpy as np
+    from hivemall_tpu.models.trees import (XGBoostMulticlassClassifier,
+                                           tree_model_meta, tree_predict)
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(150, 4)).astype(np.float32)
+    y = np.argmax(X[:, :3], axis=1)
+    gb = XGBoostMulticlassClassifier("-num_round 4 -max_depth 3")
+    for i in range(len(X)):
+        gb.process(list(X[i]), int(y[i]))
+    rows = list(gb.close())
+    assert len(rows) == 4 * 3
+    eta = tree_model_meta(rows[0][1])["eta"]
+    direct = gb.predict(X[:20])
+    for i in range(20):
+        margins = {}
+        for _, blob in rows:
+            cls, leaf = tree_predict(blob, list(X[i]))
+            margins[cls] = margins.get(cls, 0.0) + eta * leaf
+        assert max(margins, key=margins.get) == direct[i]
+
+
+def test_gbt_fit_then_close_serializes(tmp_path):
+    import numpy as np
+    from hivemall_tpu.models.trees import XGBoostClassifier
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(80, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    gb = XGBoostClassifier("-num_round 3 -max_depth 3").fit(X, y)
+    rows = list(gb.close())          # no process() buffer: must not refit
+    assert len(rows) == 3
