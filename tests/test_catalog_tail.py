@@ -1,0 +1,88 @@
+"""Smoke coverage for the last catalog functions no other test names
+(fm/ffm/plsa predict assemblers, hashing tail, snr/fmeasure, mapred ctx)."""
+
+import numpy as np
+
+from hivemall_tpu.catalog.registry import lookup
+
+
+def test_fm_predict_matches_formula():
+    fm_predict = lookup("fm_predict").resolve()
+    rng = np.random.default_rng(0)
+    N, K, L = 16, 3, 4
+    w0 = 0.3
+    w = rng.normal(size=N).astype(np.float32)
+    V = rng.normal(size=(N, K)).astype(np.float32)
+    idx = rng.integers(1, N, (2, L)).astype(np.int32)
+    val = rng.uniform(0.5, 1.5, (2, L)).astype(np.float32)
+    got = np.asarray(fm_predict(w0, w, V, idx, val))
+    for b in range(2):
+        lin = w0 + sum(w[idx[b, l]] * val[b, l] for l in range(L))
+        inter = 0.0
+        for i in range(L):
+            for j in range(i + 1, L):
+                inter += float(V[idx[b, i]] @ V[idx[b, j]]) \
+                    * val[b, i] * val[b, j]
+        np.testing.assert_allclose(got[b], lin + inter, rtol=1e-4)
+
+
+def test_ffm_predict_runs():
+    ffm_predict = lookup("ffm_predict").resolve()
+    rng = np.random.default_rng(1)
+    N, F, K, L = 16, 3, 2, 3
+    w0 = 0.0
+    w = rng.normal(size=N).astype(np.float32)
+    V = rng.normal(size=(N, F, K)).astype(np.float32)
+    idx = rng.integers(1, N, (2, L)).astype(np.int32)
+    val = np.ones((2, L), np.float32)
+    fld = np.tile(np.arange(L, dtype=np.int32) % F, (2, 1))
+    out = np.asarray(ffm_predict(w0, w, V, idx, val, fld))
+    assert out.shape == (2,) and np.all(np.isfinite(out))
+
+
+def test_plsa_predict_proportions():
+    plsa_predict = lookup("plsa_predict").resolve()
+    PLSA = lookup("train_plsa").resolve()
+    tr = PLSA("-topics 2 -vocab 256 -mini_batch 4")
+    for _ in range(10):
+        tr.process(["sun", "moon", "star"] * 3)
+        tr.process(["cash", "bank", "loan"] * 3)
+    rows = list(tr.close())
+    pairs = plsa_predict(["sun", "moon"], rows, topics=2)
+    assert sorted(k for k, _ in pairs) == [0, 1]     # (topic, proportion)
+    np.testing.assert_allclose(sum(p for _, p in pairs), 1.0, rtol=1e-5)
+
+
+def test_hashing_tail():
+    sha1 = lookup("sha1").resolve()
+    ahv = lookup("array_hash_values").resolve()
+    phv = lookup("prefixed_hash_values").resolve()
+    h = sha1("hello")
+    assert h == sha1("hello") and 1 <= h <= 2 ** 24
+    vals = ahv(["a", "b"])
+    assert len(vals) == 2 and all(isinstance(v, int) for v in vals)
+    pv = phv(["a", "b"], "city")
+    assert len(pv) == 2 and all(isinstance(s, str) for s in pv)
+
+
+def test_snr_and_fmeasure():
+    snr = lookup("snr").resolve()
+    fmeasure = lookup("fmeasure").resolve()
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(60, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    X[:, 0] += y * 3                       # feature 0 separates the classes
+    s = np.asarray(snr(X, y))
+    assert s.shape == (3,) and s[0] > s[1] and s[0] > s[2]
+    f1 = fmeasure(np.asarray([1, 1, 0, 0]), np.asarray([1, 0, 0, 0]))
+    assert 0 < f1 < 1
+
+
+def test_mapred_context_tail(tmp_path):
+    assert isinstance(lookup("rownum").resolve()(), int)
+    assert isinstance(lookup("jobid").resolve()(), str)
+    p = tmp_path / "cache.tsv"
+    p.write_text("k1\tv1\n")
+    dg = lookup("distcache_gets").resolve()
+    assert dg(str(p), "k1") == "v1"
+    assert dg(str(p), "nope", "dflt") == "dflt"
