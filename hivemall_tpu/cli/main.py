@@ -29,10 +29,15 @@ def _cmd_train(args) -> int:
 
     cls = lookup(args.algo).resolve()
     trainer = cls(args.options or "")
-    for flag in ("load_bundle", "save_bundle"):   # fail fast, not post-train
-        if getattr(args, flag) and not hasattr(trainer, flag):
+    if args.load_bundle or args.save_bundle:      # fail fast, not post-train
+        # every LearnerBase inherits load_bundle/save_bundle, so hasattr is
+        # vacuous — probe the actual capability (checkpointable state)
+        try:
+            trainer._checkpoint_arrays()
+        except (NotImplementedError, AttributeError):
+            flag = "load-bundle" if args.load_bundle else "save-bundle"
             print(f"error: {args.algo} does not support checkpoint bundles "
-                  f"(--{flag.replace('_', '-')})", file=sys.stderr)
+                  f"(--{flag})", file=sys.stderr)
             return 2
     if args.load_bundle:
         trainer.load_bundle(args.load_bundle)

@@ -44,7 +44,10 @@ class StreamingScorer:
             except ValueError:
                 i = mhash(feat, dims - 1)
             if 0 <= i < dims:
-                w[i] = float(weight)
+                # accumulate on hash collision: feature-hashing semantics are
+                # additive sharing, not last-writer-wins (collisions happen
+                # when the scorer's dims is below the training dims)
+                w[i] += float(weight)
         import jax.numpy as jnp
         self._w = jnp.asarray(w)
         self._predict = make_linear_predict()

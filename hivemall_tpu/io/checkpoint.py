@@ -63,6 +63,10 @@ def load_bundle(trainer, path: str) -> None:
     """Restore a bundle into a freshly constructed trainer (same options)."""
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
+        if meta.get("format") != _FORMAT:
+            raise ValueError(
+                f"bundle format {meta.get('format')!r} != supported "
+                f"{_FORMAT} — bundle written by an incompatible version")
         if meta.get("trainer") != trainer.NAME:
             raise ValueError(
                 f"bundle was written by {meta.get('trainer')!r}, "

@@ -88,8 +88,11 @@ def parse_feature_strings(features: Sequence[str],
                 raise ValueError(
                     f"-int_feature is set but feature name {name!r} is not an "
                     f"integer index")
+            # num_features means the weight-array SIZE (ids < num_features),
+            # matching every other call site in the repo that passes dims;
+            # mhash's range is [1, n] inclusive, hence the -1
             i = mhash(name) if num_features is None \
-                else mhash(name, num_features)
+                else mhash(name, num_features - 1)
         idx.append(i)
         val.append(float(v))
     return np.asarray(idx, np.int32), np.asarray(val, np.float32)

@@ -292,6 +292,7 @@ class LearnerBase:
     def _warm_start(self, path: str) -> None:
         """-loadmodel: read a previously saved model table (feature\tweight)."""
         w = np.asarray(self._finalized_weights()).copy()
+        seen = set()
         with open(path) as f:
             for line in f:
                 feat, _, weight = line.rstrip("\n").partition("\t")
@@ -301,7 +302,14 @@ class LearnerBase:
                     i = mhash(feat, self.dims - 1)
                     self._names.setdefault(i, feat)
                 if 0 <= i < len(w):
-                    w[i] = float(weight)
+                    # first touch replaces the warm base; later touches of the
+                    # same slot accumulate — feature-hashing collisions share
+                    # additively, matching StreamingScorer's loader
+                    if i in seen:
+                        w[i] += float(weight)
+                    else:
+                        w[i] = float(weight)
+                        seen.add(i)
         self._load_weights(w)
 
     def save_model(self, path: str) -> None:

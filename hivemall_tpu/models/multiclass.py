@@ -82,7 +82,8 @@ class _MulticlassBase:
 
     def _restore_scalars(self, scalars) -> None:
         for tname, key, row in scalars.get("labels", []):
-            if tname == "bool":            # bool first: bool < int in Python
+            if tname.startswith("bool"):   # bool first: bool < int in Python
+                # (startswith: numpy scalars stringify as 'bool_')
                 self._labels[key == "True"] = int(row)
             elif "int" in tname:
                 self._labels[int(key)] = int(row)
