@@ -96,3 +96,5 @@ def load_bundle(trainer, path: str) -> None:
         trainer._names.update({int(k): v for k, v in meta["names"].items()})
     if meta.get("scalars") and hasattr(trainer, "_restore_scalars"):
         trainer._restore_scalars(meta["scalars"])
+    if getattr(trainer, "mesh", None) is not None:
+        trainer._reshard_state()      # bundles load replicated; re-shard

@@ -56,6 +56,9 @@ def learner_option_spec(name: str, *, classification: bool,
            help="keep float32 weights (default); unset-able via -halffloat")
     s.flag("halffloat", help="store weights as bfloat16 (HalfFloat analog)")
     s.flag("int_feature", help="features are integer indices, no hashing")
+    s.add("mesh", default=None,
+          help="device mesh spec ('dp=2,tp=4' or 'auto'): run the train "
+               "step GSPMD-sharded — batch over dp, weight tables over tp")
     s.add("mix", default=None, help="mix cohort spec (parallel.mix)")
     s.add("mix_threshold", type=int, default=16,
           help="local updates between mix exchanges")
@@ -97,9 +100,13 @@ class LearnerBase:
                 self.opts.mix,
                 group=self.opts.mix_session or self.NAME,
                 threshold=int(self.opts.mix_threshold))
+        self._fit_ds = None                   # columnar dataset ref (fit)
+        self.mesh = None                      # jax Mesh when -mesh is set
         self._init_state()
         if self.opts.loadmodel:
             self._warm_start(self.opts.loadmodel)
+        if self.opts.get("mesh"):
+            self._apply_mesh(self.opts.mesh)
 
     # -- subclass surface ----------------------------------------------------
     def _init_state(self) -> None:
@@ -157,12 +164,16 @@ class LearnerBase:
         bs = int(self.opts.mini_batch)
         labels = self._convert_labels(ds.labels)
         ds = SparseDataset(ds.indices, ds.indptr, ds.values, labels, ds.fields)
+        if self._wants_fit_ds():
+            self._fit_ds = ds             # emission-time metadata (FFM pairs)
         # elastic recovery (SURVEY.md §6): per-epoch bundle when requested
         ckdir = os.environ.get("HIVEMALL_TPU_CHECKPOINT_DIR")
         # overlap host batch prep + h2d with compute on accelerators
+        # (the prefetcher places on the default device; under -mesh the
+        # dispatch path does its own sharded placement instead)
         if prefetch is None:
             import jax
-            prefetch = jax.default_backend() != "cpu"
+            prefetch = jax.default_backend() != "cpu" and self.mesh is None
         for ep in range(epochs):
             it = ds.batches(bs, shuffle=shuffle, seed=42 + ep)
             if prefetch:
@@ -183,6 +194,70 @@ class LearnerBase:
                     stream.emit("checkpoint", trainer=self.NAME,
                                 epoch=ep + 1, path=path)
         return self
+
+    def _wants_fit_ds(self) -> bool:
+        """Whether fit() should keep a reference to the training dataset for
+        emission-time metadata. Default no — pinning a Criteo-scale dataset
+        on the trainer for its whole lifetime is not free."""
+        return False
+
+    # -- mesh sharding (SURVEY.md §3.17 / §8 M3) -----------------------------
+    def _apply_mesh(self, spec: str) -> None:
+        """Shard this trainer's state over a (dp, tp) device mesh.
+
+        The PRODUCT multi-chip path (not a demo kernel): the same jitted
+        sparse step the single-chip trainer runs is compiled under GSPMD —
+        batch arrays sharded over 'dp' (XLA inserts the gradient psum that
+        replaces MixServer averaging), every dims-sized state axis sharded
+        over 'tp' (feature-dim sharding, the context-parallel analog), the
+        rest replicated. fit()/process() are unchanged."""
+        import jax
+        import jax.numpy as jnp
+        from ..parallel.mesh import make_mesh, parse_mesh_spec
+        dp, tp = parse_mesh_spec(spec)
+        if int(self.opts.mini_batch) % dp:
+            raise ValueError(
+                f"-mini_batch {self.opts.mini_batch} must be divisible by "
+                f"the dp axis ({dp})")
+        self.mesh = make_mesh(dp=dp, tp=tp)
+        self._reshard_state()
+
+    def _state_sharding(self, leaf):
+        """NamedSharding for one state leaf: first dims-sized axis -> 'tp',
+        everything else replicated (w0, counters, small tables)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shape = getattr(leaf, "shape", ())
+        for ax, s in enumerate(shape):
+            if s == self.dims:
+                return NamedSharding(
+                    self.mesh,
+                    P(*["tp" if a == ax else None for a in range(len(shape))]))
+        return NamedSharding(self.mesh, P())
+
+    def _reshard_state(self) -> None:
+        """device_put every checkpointable array with its mesh sharding."""
+        import jax
+        import jax.numpy as jnp
+        tree = self._checkpoint_arrays()
+        tree = jax.tree_util.tree_map(
+            lambda l: jax.device_put(jnp.asarray(l), self._state_sharding(l)),
+            tree)
+        self._restore_arrays(tree)
+
+    def _shard_batch(self, batch: SparseBatch) -> SparseBatch:
+        """Place one padded batch on the mesh: rows sharded over 'dp'."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def put(a, spec):
+            return jax.device_put(jnp.asarray(a), NamedSharding(self.mesh,
+                                                                spec))
+        return SparseBatch(
+            put(batch.idx, P("dp", None)), put(batch.val, P("dp", None)),
+            put(batch.label, P("dp")),
+            None if batch.field is None else put(batch.field, P("dp", None)),
+            n_valid=batch.n_valid)
 
     # -- shared plumbing -----------------------------------------------------
     def _parse_row(self, features) -> Tuple[np.ndarray, np.ndarray]:
@@ -248,6 +323,8 @@ class LearnerBase:
 
     def _dispatch(self, batch: SparseBatch) -> None:
         nv = batch.n_valid or batch.batch_size
+        if self.mesh is not None:
+            batch = self._shard_batch(batch)
         loss_sum = self._train_batch(batch)
         self._t += 1
         # keep the per-step loss on device: float() here would block the host

@@ -158,6 +158,12 @@ class FMTrainer(LearnerBase):
     def _warm_start(self, path: str) -> None:
         z = np.load(path if path.endswith(".npz") else path + ".npz")
         for k in self.params:
+            if tuple(z[k].shape) != tuple(self.params[k].shape):
+                raise ValueError(
+                    f"-loadmodel {path}: saved {k!r} has shape "
+                    f"{tuple(z[k].shape)}, trainer expects "
+                    f"{tuple(self.params[k].shape)} — options mismatch "
+                    f"(-dims/-factors/-fields/-ffm_table)?")
             self.params[k] = jnp.asarray(z[k], self.params[k].dtype)
 
     def _finalized_weights(self) -> np.ndarray:
@@ -170,8 +176,17 @@ class FMTrainer(LearnerBase):
 class FFMTrainer(FMTrainer):
     """SQL: train_ffm — reference hivemall.fm.FieldAwareFactorizationMachineUDTF.
 
-    Features are "field:index:value" triples (ftvec.trans.ffm_features);
-    latent table V[N, F, K] holds one k-vector per (feature, field)."""
+    Features are "field:index:value" triples (ftvec.trans.ffm_features).
+    Two latent-table layouts (-ffm_table):
+
+      joint (default) — one flat V[M, K] table addressed by a joint
+        (feature, field) hash (ops.fm.ffm_joint_slot), M = -dims. The TPU
+        analog of the reference's packed-long keys: Criteo-scale
+        ``-dims 2^24 -fields 64 -halffloat`` is 128 MB of weights + 256 MB
+        f32 AdaGrad state, single-chip friendly; shards over 'tp'.
+      dense — V[N, F, K] field cube, exact (feature, field) cells, for
+        small field counts.
+    """
 
     NAME = "train_ffm"
 
@@ -180,6 +195,10 @@ class FFMTrainer(FMTrainer):
         s = _factor_spec(cls.NAME, default_factors=4, default_opt="adagrad")
         s.add("fields", "num_fields", type=int, default=64,
               help="field-space size F")
+        s.add("ffm_table", default="auto",
+              help="latent-table layout: joint (hashed flat [M,K], "
+                   "Criteo-scale) | dense ([N,F,K] field cube) | auto "
+                   "(joint when -dims is a power of two, else dense)")
         s.flag("no_w0", help="drop the global bias term")
         s.flag("no_wi", help="drop the linear terms (libffm-style)")
         return s
@@ -194,18 +213,32 @@ class FFMTrainer(FMTrainer):
             power_t=o.power_t, reg="no")
         self.k = int(o.factors)
         self.F = int(o.fields)
+        self.layout = str(o.ffm_table)
+        if self.layout not in ("joint", "dense", "auto"):
+            raise ValueError(f"-ffm_table must be joint|dense|auto, "
+                             f"got {self.layout!r}")
+        pow2 = (self.dims & (self.dims - 1)) == 0
+        if self.layout == "auto":
+            self.layout = "joint" if pow2 else "dense"
+        if self.layout == "joint" and not pow2:
+            raise ValueError("-ffm_table joint needs a power-of-two -dims "
+                             f"(got {self.dims})")
         dtype = jnp.bfloat16 if o.halffloat else jnp.float32
         key = jax.random.PRNGKey(int(o.seed))
+        v_shape = ((self.dims, self.k) if self.layout == "joint"
+                   else (self.dims, self.F, self.k))
         self.params = {
             "w0": jnp.zeros((), dtype),
             "w": jnp.zeros(self.dims, dtype),
-            "V": (jax.random.normal(key, (self.dims, self.F, self.k)) *
+            "V": (jax.random.normal(key, v_shape) *
                   float(o.sigma)).astype(dtype),
         }
         self.opt_state = {k: self.optimizer.init(v.shape)
                           for k, v in self.params.items()}
         self._step = make_ffm_step(self.loss, self.optimizer,
                                    (o.lambda0, o.lambda_w, o.lambda_v))
+        self._pairs: set = set()       # (feature_id, field) seen, stream path
+        self._fit_ds = None            # dataset ref, columnar path
 
     def _batch_args(self, batch: SparseBatch) -> tuple:
         if batch.field is None:
@@ -264,6 +297,8 @@ class FFMTrainer(FMTrainer):
             val[b, :len(v)] = v
             fld[b, :len(f)] = f
             lab[b] = labels[b]
+            if self.layout == "joint":     # joint emission needs seen pairs
+                self._pairs.update(zip(i.tolist(), f.tolist()))
         nv = len(rows)
         self._dispatch(SparseBatch(idx, val, lab, fld,
                                    n_valid=nv if nv < B else None))
@@ -273,19 +308,63 @@ class FFMTrainer(FMTrainer):
         return np.asarray(ffm_score(p["w0"], p["w"], p["V"],
                                     batch.idx, batch.val, batch.field))
 
+    def _wants_fit_ds(self) -> bool:
+        return self.layout == "joint"     # emission needs observed pairs
+
+    def _observed_pairs(self):
+        """Unique (feature_id, field) pairs seen in training as two sorted
+        arrays (ii, ff), merged from the streaming path's tracked set and
+        the columnar dataset — all vectorized (no per-pair Python)."""
+        keys = []
+        if self._pairs:
+            arr = np.fromiter((i * self.F + f for i, f in self._pairs),
+                              np.int64, len(self._pairs))
+            keys.append(arr)
+        ds = self._fit_ds
+        if ds is not None and ds.fields is not None:
+            keys.append(ds.indices.astype(np.int64) * self.F
+                        + ds.fields.astype(np.int64))
+        if not keys:
+            return None
+        uniq = np.unique(np.concatenate(keys))
+        ii, ff = np.divmod(uniq, self.F)
+        return ii.astype(np.int32), ff.astype(np.int32)
+
     def model_rows(self):
-        """(feature, field, Wi, Vi[k]) rows — the FFMPredictionModel surface."""
+        """(feature, field, Wi, Vi[k]) rows — the FFMPredictionModel surface.
+
+        Joint layout: rows are enumerated from the observed (feature, field)
+        pairs and each Vi is read from its joint-hashed slot; colliding pairs
+        intentionally report the same shared vector (hashing-trick
+        semantics). If no pairs were observed (e.g. a bundle-restored trainer
+        that never saw data), falls back to slot-keyed "vslot:<id>" rows."""
         w = np.asarray(self.params["w"].astype(jnp.float32))
         V = np.asarray(self.params["V"].astype(jnp.float32))
         yield ("0", -1, float(np.asarray(self.params["w0"])), None)
-        touched = np.nonzero(np.abs(V).sum((1, 2)) > 0)[0]
-        for i in touched:
+        if self.layout == "dense":
+            touched = np.nonzero(np.abs(V).sum((1, 2)) > 0)[0]
+            for i in touched:
+                if i == 0:
+                    continue
+                name = self._names.get(int(i), str(int(i)))
+                for f in range(self.F):
+                    if np.abs(V[i, f]).sum() > 0:
+                        yield (name, f, float(w[i]), V[i, f].tolist())
+            return
+        pairs = self._observed_pairs()
+        if pairs is None:
+            for s in np.nonzero(np.abs(V).sum(-1) > 0)[0]:
+                yield (f"vslot:{int(s)}", -1, 0.0, V[int(s)].tolist())
+            return
+        from ..ops.fm import ffm_joint_slot
+        ii, ff = pairs
+        slots = np.asarray(ffm_joint_slot(jnp.asarray(ii), jnp.asarray(ff),
+                                          self.dims))
+        for i, f, s in zip(ii.tolist(), ff.tolist(), slots.tolist()):
             if i == 0:
                 continue
-            name = self._names.get(int(i), str(int(i)))
-            for f in range(self.F):
-                if np.abs(V[i, f]).sum() > 0:
-                    yield (name, f, float(w[i]), V[i, f].tolist())
+            name = self._names.get(i, str(i))
+            yield (name, f, float(w[i]), V[s].tolist())
 
 
 # --- standalone predict kernels (the UDAF/UDF reassembly path) -------------

@@ -26,7 +26,33 @@ import jax.numpy as jnp
 from .losses import Loss
 from .optimizers import Optimizer
 
-__all__ = ["fm_score", "ffm_score", "make_fm_step", "make_ffm_step"]
+__all__ = ["fm_score", "ffm_score", "make_fm_step", "make_ffm_step",
+           "ffm_joint_slot"]
+
+# odd 32-bit mixing constants (golden-ratio / murmur finalizer family)
+_J1, _J2, _J3 = 0x9E3779B1, 0x85EBCA6B, 0xC2B2AE35
+
+
+def ffm_joint_slot(idx, field, M: int):
+    """Joint (feature, field) hash into one flat [M, K] latent table.
+
+    The TPU analog of the reference's packed-long (feature,field) keys in
+    FFMStringFeatureMapModel (SURVEY.md §3.6): instead of a dense [N, F, K]
+    cube (8.6 GB at -dims 2^24 -fields 64 bf16, which cannot fit one chip's
+    HBM with f32 optimizer state), both key halves mix into a single slot id
+    in [0, M). Collisions share a latent vector — the same hashing-trick
+    semantics feature_hashing already applies to the linear weights.
+
+    M must be a power of two (the & (M-1) fold). Slot 0 doubles as the
+    padding row; a real pair landing there shares it, which is benign: the
+    padding contributions carry zero gradient.
+    """
+    h = (idx.astype(jnp.uint32) * jnp.uint32(_J1)
+         + field.astype(jnp.uint32) * jnp.uint32(_J2))
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(_J3)
+    h = h ^ (h >> 13)
+    return (h & jnp.uint32(M - 1)).astype(jnp.int32)
 
 
 def _fm_slab_phi(w0, wg, Vg, val):
@@ -63,11 +89,19 @@ def fm_score(w0, w, V, idx, val):
 def ffm_score(w0, w, V, idx, val, field):
     """Table-level FFM score: pair-flat gather, delegate to _ffm_slab_phi.
 
-    V: [N, F, K]; idx/field: [B, L]. Reference: FFMPredictUDF pairwise
-    field-crossed dots (SURVEY.md §3.6 row 4)."""
-    N, F, K = V.shape
-    V2 = V.reshape(N * F, K)
-    flat = idx[:, :, None] * F + field[:, None, :]       # [B, L(i), L(j)]
+    Two layouts, told apart by V.ndim (reference: FFMPredictUDF pairwise
+    field-crossed dots, SURVEY.md §3.6 row 4):
+      V [N, F, K]  — dense field cube, flat index = idx*F + field
+      V [M, K]     — joint-hashed table, flat index = ffm_joint_slot
+    """
+    if V.ndim == 2:
+        M, K = V.shape
+        V2 = V
+        flat = ffm_joint_slot(idx[:, :, None], field[:, None, :], M)
+    else:
+        N, F, K = V.shape
+        V2 = V.reshape(N * F, K)
+        flat = idx[:, :, None] * F + field[:, None, :]   # [B, L(i), L(j)]
     return _ffm_slab_phi(w0.astype(jnp.float32),
                          w[idx].astype(jnp.float32),
                          V2[flat].astype(jnp.float32), val)
@@ -134,9 +168,15 @@ def _make_factor_step_sparse(kind: str, loss: Loss, optimizer: Optimizer,
         pm = (val != 0).astype(jnp.float32) * row_mask[:, None]   # [B, L]
         if kind == "ffm":
             (field,) = extra
-            N, F, K = V.shape
             L = idx.shape[1]
-            V2 = V.reshape(N * F, K)
+            if V.ndim == 2:                # joint-hashed flat [M, K] table
+                M, K = V.shape
+                V2 = V
+                raw = ffm_joint_slot(idx[:, :, None], field[:, None, :], M)
+            else:                          # dense [N, F, K] field cube
+                N, F, K = V.shape
+                V2 = V.reshape(N * F, K)
+                raw = idx[:, :, None] * F + field[:, None, :]
             # redirect inactive pairs to the reserved padding row 0: diagonal
             # self-pairs (triu-masked out of the score) AND pairs touching a
             # padding slot or padded row. Their loss gradient is zero, but
@@ -146,8 +186,7 @@ def _make_factor_step_sparse(kind: str, loss: Loss, optimizer: Optimizer,
             eye = jnp.eye(L, dtype=bool)[None]
             pb = pm > 0                                       # [B, L] bool
             active = pb[:, :, None] & pb[:, None, :] & ~eye   # [B, L, L]
-            flat = jnp.where(active,
-                             idx[:, :, None] * F + field[:, None, :], 0)
+            flat = jnp.where(active, raw, 0)
             Ag = V2[flat].astype(jnp.float32)                 # [B, L, L, K]
             phi_fn = _ffm_slab_phi
             slab = Ag
@@ -176,13 +215,18 @@ def _make_factor_step_sparse(kind: str, loss: Loss, optimizer: Optimizer,
         if kind == "ffm":
             # pair presence: both sides present, and not a self-pair
             gs = gs + lam_v * slab * active[..., None]
-            # optimizer state is co-shaped with V [N,F,K]; flatten to the
-            # [N*F, K] view the pair-flat indices address
-            sV2 = {k: v.reshape(N * F, K) for k, v in opt_state["V"].items()}
-            Vn2, sV2 = optimizer.sparse_update(
-                V2, gs.reshape(-1, K), sV2, flat.ravel(), t)
-            Vn = Vn2.reshape(N, F, K)
-            sV = {k: v.reshape(N, F, K) for k, v in sV2.items()}
+            if V.ndim == 2:                # joint table updates in place
+                Vn, sV = optimizer.sparse_update(
+                    V2, gs.reshape(-1, K), opt_state["V"], flat.ravel(), t)
+            else:
+                # optimizer state is co-shaped with V [N,F,K]; flatten to
+                # the [N*F, K] view the pair-flat indices address
+                sV2 = {k: v.reshape(N * F, K)
+                       for k, v in opt_state["V"].items()}
+                Vn2, sV2 = optimizer.sparse_update(
+                    V2, gs.reshape(-1, K), sV2, flat.ravel(), t)
+                Vn = Vn2.reshape(N, F, K)
+                sV = {k: v.reshape(N, F, K) for k, v in sV2.items()}
         else:
             K = V.shape[-1]
             gs = gs + lam_v * slab * pm[..., None]

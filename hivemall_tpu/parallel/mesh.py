@@ -20,7 +20,35 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "init_distributed"]
+__all__ = ["make_mesh", "init_distributed", "parse_mesh_spec"]
+
+
+def parse_mesh_spec(spec: str, n_devices: int = None) -> tuple:
+    """Parse a trainer ``-mesh`` option into (dp, tp).
+
+    Grammar: ``auto`` (dp = all visible devices, tp = 1) or a comma list of
+    ``dp=<n>`` / ``tp=<n>`` assignments, e.g. ``dp=2,tp=4``. Unassigned axes
+    default to 1."""
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    s = str(spec).strip().lower()
+    if s == "auto":
+        return n_devices, 1
+    dp = tp = 1
+    for part in s.split(","):
+        k, sep, v = part.partition("=")
+        k = k.strip()
+        if not sep or k not in ("dp", "tp"):
+            raise ValueError(
+                f"bad -mesh spec {spec!r}: expected 'auto' or "
+                f"'dp=<n>,tp=<n>' assignments, got {part!r}")
+        if k == "dp":
+            dp = int(v)
+        else:
+            tp = int(v)
+    if dp < 1 or tp < 1:
+        raise ValueError(f"-mesh axes must be >= 1, got dp={dp} tp={tp}")
+    return dp, tp
 
 
 def init_distributed(coordinator_address=None, num_processes=None,

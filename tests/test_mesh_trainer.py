@@ -1,0 +1,114 @@
+"""Sharded training as a product feature (SURVEY.md §3.17 TP row, §8 M3).
+
+The trainers' OWN sparse steps run GSPMD-partitioned via the ``-mesh`` option
+— batch over dp, dims-sized state axes over tp — and must match the
+single-device model to float tolerance on identical batch streams. This is
+the multi-chip path the driver's dryrun exercises; here it runs on the
+8-virtual-device CPU mesh (conftest).
+"""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.io.sparse import SparseDataset
+from hivemall_tpu.models.fm import FFMTrainer
+from hivemall_tpu.models.linear import GeneralClassifier
+from hivemall_tpu.parallel.mesh import parse_mesh_spec
+
+
+def _ffm_ds(n=384, L=6, F=8, seed=0):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(1, 200, (n, L)).astype(np.int32)
+    fld = np.tile(np.arange(L, dtype=np.int32) % F, (n, 1))
+    val = np.ones((n, L), np.float32)
+    w_true = rng.normal(0, 1, 201)
+    y = np.sign(w_true[idx].sum(1) + rng.normal(0, 0.1, n)).astype(np.float32)
+    indptr = np.arange(0, n * L + 1, L)
+    return SparseDataset(idx.ravel(), indptr, val.ravel(), y, fld.ravel())
+
+
+def _linear_ds(n=512, L=8, seed=1):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(1, 300, (n, L)).astype(np.int32)
+    val = rng.uniform(0.5, 1.5, (n, L)).astype(np.float32)
+    w_true = rng.normal(0, 1, 301)
+    y = np.sign((w_true[idx] * val).sum(1)).astype(np.float32)
+    indptr = np.arange(0, n * L + 1, L)
+    return SparseDataset(idx.ravel(), indptr, val.ravel(), y)
+
+
+def test_parse_mesh_spec():
+    assert parse_mesh_spec("dp=2,tp=4") == (2, 4)
+    assert parse_mesh_spec("dp=8") == (8, 1)
+    assert parse_mesh_spec("tp=8") == (1, 8)
+    assert parse_mesh_spec("auto", n_devices=8) == (8, 1)
+    with pytest.raises(ValueError):
+        parse_mesh_spec("pp=2")
+    with pytest.raises(ValueError):
+        parse_mesh_spec("dp=0")
+
+
+def test_mesh_requires_divisible_batch():
+    with pytest.raises(ValueError, match="divisible"):
+        FFMTrainer("-dims 1024 -fields 8 -mini_batch 100 -mesh dp=8")
+
+
+def test_ffm_joint_mesh_matches_single_device():
+    ds = _ffm_ds()
+    opts = "-dims 4096 -factors 4 -fields 8 -mini_batch 128 -opt adagrad " \
+           "-classification"
+    single = FFMTrainer(opts).fit(ds, epochs=2)
+    sharded = FFMTrainer(opts + " -mesh dp=2,tp=4").fit(ds, epochs=2)
+    assert sharded.params["V"].shape == (4096, 4)
+    np.testing.assert_allclose(np.asarray(single.params["w"]),
+                               np.asarray(sharded.params["w"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(single.params["V"]),
+                               np.asarray(sharded.params["V"]), atol=1e-4)
+
+
+def test_ffm_ftrl_mesh_matches_single_device():
+    ds = _ffm_ds(seed=3)
+    opts = "-dims 4096 -factors 4 -fields 8 -mini_batch 128 -opt ftrl " \
+           "-classification"
+    single = FFMTrainer(opts).fit(ds, epochs=1)
+    sharded = FFMTrainer(opts + " -mesh dp=4,tp=2").fit(ds, epochs=1)
+    np.testing.assert_allclose(np.asarray(single.params["V"]),
+                               np.asarray(sharded.params["V"]), atol=1e-4)
+
+
+def test_linear_mesh_matches_single_device():
+    ds = _linear_ds()
+    opts = "-dims 2048 -loss logloss -opt adagrad -reg no -mini_batch 128"
+    single = GeneralClassifier(opts).fit(ds, epochs=2)
+    sharded = GeneralClassifier(opts + " -mesh dp=2,tp=4").fit(ds, epochs=2)
+    np.testing.assert_allclose(single._finalized_weights(),
+                               sharded._finalized_weights(), atol=1e-4)
+    # scoring works off the sharded state
+    p1 = single.predict_proba(ds)
+    p2 = sharded.predict_proba(ds)
+    np.testing.assert_allclose(p1, p2, atol=1e-4)
+
+
+def test_sharded_bundle_roundtrip(tmp_path):
+    ds = _ffm_ds(seed=5)
+    opts = "-dims 4096 -factors 4 -fields 8 -mini_batch 128 -opt adagrad " \
+           "-classification -mesh dp=2,tp=4"
+    t = FFMTrainer(opts).fit(ds, epochs=1)
+    path = str(tmp_path / "ffm_mesh.npz")
+    t.save_bundle(path)
+    t2 = FFMTrainer(opts)
+    t2.load_bundle(path)
+    np.testing.assert_allclose(np.asarray(t.params["V"]),
+                               np.asarray(t2.params["V"]), atol=0)
+    # restored state is re-sharded onto the mesh and trainable
+    t2.fit(ds, epochs=1)
+    assert np.isfinite(t2.cumulative_loss)
+
+
+def test_mesh_dp_only_auto():
+    ds = _linear_ds(seed=7)
+    opts = "-dims 2048 -loss logloss -opt sgd -reg no -mini_batch 128"
+    single = GeneralClassifier(opts).fit(ds, epochs=1)
+    sharded = GeneralClassifier(opts + " -mesh auto").fit(ds, epochs=1)
+    np.testing.assert_allclose(single._finalized_weights(),
+                               sharded._finalized_weights(), atol=1e-4)
