@@ -36,8 +36,11 @@ def main():
         test = train
 
     Trainer = lookup("train_classifier").resolve()
+    # batch scales with the corpus so small fragments still take enough
+    # optimizer steps for the 1-epoch logloss to be meaningful
+    bs = min(1024, max(64, len(train) // 16))
     clf = Trainer("-loss logloss -opt adagrad -reg no -eta fixed -eta0 0.3 "
-                  "-dims 262144 -mini_batch 1024 -iters 1")
+                  f"-dims 262144 -mini_batch {bs} -iters 1")
     t0 = time.time()
     clf.fit(train)
     dt = time.time() - t0

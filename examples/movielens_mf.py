@@ -2,7 +2,10 @@
 """BASELINE config #3: MF-SGD + BPR on MovieLens-like ratings.
 
 Usage: python examples/movielens_mf.py [--users U] [--items I] [--rows N]
-Synthetic low-rank ratings exercise train_mf_sgd (rmse) and
+                                       [--data ratings.tsv]
+--data reads (user \t item \t rating) rows, e.g. a MovieLens ratings dump
+or tests/resources/movielens.frag.tsv; without it synthetic low-rank
+ratings stand in. Both exercise train_mf_sgd (rmse) and
 bpr_sampling → train_bprmf (implicit ranking) end-to-end
 (SURVEY.md §3.7).
 """
@@ -22,19 +25,29 @@ def main():
     ap.add_argument("--users", type=int, default=200)
     ap.add_argument("--items", type=int, default=100)
     ap.add_argument("--rows", type=int, default=8000)
+    ap.add_argument("--data", default=None,
+                    help="(user\\titem\\trating) tsv, e.g. "
+                         "tests/resources/movielens.frag.tsv")
     args = ap.parse_args()
 
     from hivemall_tpu.catalog.registry import lookup
     from hivemall_tpu.frame.evaluation import rmse
 
     rng = np.random.default_rng(7)
-    U, I = args.users, args.items
-    P = rng.normal(size=(U, 4)) * 0.5
-    Q = rng.normal(size=(I, 4)) * 0.5
-    users = rng.integers(0, U, args.rows)
-    items = rng.integers(0, I, args.rows)
-    ratings = 3.0 + (P[users] * Q[items]).sum(-1) \
-        + rng.normal(scale=0.1, size=args.rows)
+    if args.data:
+        m = np.loadtxt(args.data)
+        users = m[:, 0].astype(np.int64)
+        items = m[:, 1].astype(np.int64)
+        ratings = m[:, 2].astype(np.float64)
+        U, I = int(users.max()) + 1, int(items.max()) + 1
+    else:
+        U, I = args.users, args.items
+        P = rng.normal(size=(U, 4)) * 0.5
+        Q = rng.normal(size=(I, 4)) * 0.5
+        users = rng.integers(0, U, args.rows)
+        items = rng.integers(0, I, args.rows)
+        ratings = 3.0 + (P[users] * Q[items]).sum(-1) \
+            + rng.normal(scale=0.1, size=args.rows)
 
     MF = lookup("train_mf_sgd").resolve()
     mf = MF(f"-factors 8 -users {U} -items {I} -eta0 0.01 -iters 5 "

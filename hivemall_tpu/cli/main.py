@@ -23,9 +23,35 @@ import sys
 import time
 
 
+def _load_input(args, trainer):
+    """Route --input by format: LIBSVM file (default), .csv, .parquet file,
+    or a DIRECTORY of parquet shards (returns a ParquetStream for
+    out-of-core training). FFM trainers get field-aware parsing."""
+    import os
+    from ..io.libsvm import read_libsvm
+
+    path = args.input
+    ffm = getattr(trainer, "F", None) is not None and \
+        trainer.NAME == "train_ffm"
+    kw = dict(feature_col=args.feature_col, label_col=args.label_col,
+              dims=getattr(trainer, "dims", None))
+    if ffm:
+        kw.update(ffm=True, num_fields=trainer.F)
+    if os.path.isdir(path):
+        from ..io.arrow import ParquetStream
+        return ParquetStream(path, **kw), True
+    if path.endswith((".parquet", ".pq")):
+        from ..io.arrow import read_parquet
+        return read_parquet(path, **kw), False
+    if path.endswith(".csv"):
+        from ..io.arrow import read_csv
+        return read_csv(path, label_col=args.label_col,
+                        dims=getattr(trainer, "dims", None)), False
+    return read_libsvm(path), False
+
+
 def _cmd_train(args) -> int:
     from ..catalog import lookup
-    from ..io.libsvm import read_libsvm
 
     cls = lookup(args.algo).resolve()
     trainer = cls(args.options or "")
@@ -41,9 +67,21 @@ def _cmd_train(args) -> int:
             return 2
     if args.load_bundle:
         trainer.load_bundle(args.load_bundle)
-    ds = read_libsvm(args.input)
+    ds, streaming = _load_input(args, trainer)
+    n_examples = len(ds)
     t0 = time.time()
-    if hasattr(trainer, "fit"):
+    if streaming:
+        if not hasattr(trainer, "fit_stream"):
+            print(f"error: {args.algo} cannot train from a shard directory "
+                  f"(no streaming path); pass a single file instead",
+                  file=sys.stderr)
+            return 2
+        epochs = int(getattr(trainer.opts, "iters", 1))
+        bs = int(getattr(trainer.opts, "mini_batch", 256))
+        trainer.fit_stream(ds.batches(bs, epochs=epochs))
+        n_examples *= max(1, epochs)   # the stream runs every epoch itself
+        rows = None
+    elif hasattr(trainer, "fit"):
         trainer.fit(ds)
         rows = None
     else:
@@ -60,8 +98,11 @@ def _cmd_train(args) -> int:
             with open(args.model, "w") as f:
                 for r in rows:
                     f.write("\t".join(str(x) for x in r) + "\n")
-    metrics = {"examples": len(ds), "seconds": round(dt, 3),
-               "examples_per_sec": round(len(ds) / max(dt, 1e-9), 1)}
+    # prefer the trainer's own processed-examples counter (covers -iters
+    # epochs on every path); fall back to the input-size estimate
+    n_examples = int(getattr(trainer, "_examples", 0)) or n_examples
+    metrics = {"examples": n_examples, "seconds": round(dt, 3),
+               "examples_per_sec": round(n_examples / max(dt, 1e-9), 1)}
     if hasattr(trainer, "cumulative_loss"):
         metrics["cumulative_loss"] = round(trainer.cumulative_loss, 6)
     print(json.dumps(metrics))
@@ -138,9 +179,16 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="hivemall_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    t = sub.add_parser("train", help="train a catalog algorithm on LIBSVM")
+    t = sub.add_parser(
+        "train",
+        help="train a catalog algorithm on LIBSVM/CSV/Parquet input "
+             "(a directory of .parquet shards streams out-of-core)")
     t.add_argument("--algo", required=True)
     t.add_argument("--input", required=True)
+    t.add_argument("--feature-col", default="features",
+                   help="feature column for parquet/arrow input")
+    t.add_argument("--label-col", default="label",
+                   help="label column for parquet/csv/arrow input")
     t.add_argument("--options", default="")
     t.add_argument("--model", default=None)
     t.add_argument("--load-bundle", default=None,

@@ -94,15 +94,20 @@ class LearnerBase:
         self._examples = 0
         self._meter = Meter()                 # rolling examples/sec (§6)
         self._mixer = None
-        if self.opts.get("mix"):
-            from ..parallel.mix_service import MixClient
-            self._mixer = MixClient(
-                self.opts.mix,
-                group=self.opts.mix_session or self.NAME,
-                threshold=int(self.opts.mix_threshold))
         self._fit_ds = None                   # columnar dataset ref (fit)
         self.mesh = None                      # jax Mesh when -mesh is set
         self._init_state()
+        if self.opts.get("mix"):
+            # covariance trainers (CW/AROW/SCW) mix by argmin-KLD —
+            # precision-weighted Gaussian posterior merge (SURVEY.md §3.16)
+            from ..parallel.mix_service import (EVENT_ARGMIN_KLD,
+                                                EVENT_AVERAGE, MixClient)
+            has_covar = getattr(self, "sigma", None) is not None
+            self._mixer = MixClient(
+                self.opts.mix,
+                group=self.opts.mix_session or self.NAME,
+                threshold=int(self.opts.mix_threshold),
+                event=EVENT_ARGMIN_KLD if has_covar else EVENT_AVERAGE)
         if self.opts.loadmodel:
             self._warm_start(self.opts.loadmodel)
         if self.opts.get("mesh"):
@@ -259,6 +264,25 @@ class LearnerBase:
             None if batch.field is None else put(batch.field, P("dp", None)),
             n_valid=batch.n_valid)
 
+    def fit_stream(self, batches: Iterable[SparseBatch], *,
+                   convert_labels: bool = True) -> "LearnerBase":
+        """Out-of-core training over a stream of padded batches (e.g.
+        io.arrow.ParquetStream.batches): each batch dispatches one jitted
+        step; nothing is buffered, so resident memory is one shard.
+        Epoch count is owned by the stream (ParquetStream re-reads shards
+        per epoch — the NioStatefulSegment analog at corpus scale)."""
+        for b in batches:
+            if convert_labels:
+                b = SparseBatch(b.idx, b.val, self._convert_labels(b.label),
+                                b.field, n_valid=b.n_valid)
+            self._note_batch(b)
+            self._dispatch(b)
+        return self
+
+    def _note_batch(self, batch: SparseBatch) -> None:
+        """Hook for emission-time metadata on the streaming path (FFM joint
+        layout tracks observed (feature, field) pairs here)."""
+
     # -- shared plumbing -----------------------------------------------------
     def _parse_row(self, features) -> Tuple[np.ndarray, np.ndarray]:
         if (isinstance(features, tuple) and len(features) == 2
@@ -396,6 +420,56 @@ class LearnerBase:
 
     def _load_weights(self, w: np.ndarray) -> None:
         raise NotImplementedError
+
+    # -- sparse weight access (mix delta exchange, O(touched) not O(dims)) ---
+    def _weight_table(self):
+        """The [dims] device weight array, or None when the trainer's state
+        is not a flat table (then sparse access falls back to O(dims))."""
+        w = getattr(self, "w", None)
+        if w is not None:
+            return w
+        p = getattr(self, "params", None)
+        if isinstance(p, dict) and "w" in p:
+            return p["w"]
+        return None
+
+    def _store_weight_table(self, t) -> None:
+        if getattr(self, "w", None) is not None:
+            self.w = t
+        else:
+            self.params["w"] = t
+
+    def _get_weights_at(self, keys: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+        t = self._weight_table()
+        if t is None:
+            return np.asarray(self._finalized_weights())[keys]
+        return np.asarray(t[jnp.asarray(keys)], np.float32)
+
+    def _set_weights_at(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        import jax.numpy as jnp
+        t = self._weight_table()
+        if t is None:
+            w = np.array(self._finalized_weights())
+            w[keys] = vals
+            self._load_weights(w)
+            return
+        self._store_weight_table(
+            t.at[jnp.asarray(keys)].set(jnp.asarray(vals, t.dtype)))
+
+    def _get_covar_at(self, keys: np.ndarray):
+        import jax.numpy as jnp
+        sig = getattr(self, "sigma", None)
+        if sig is None:
+            return None
+        return np.asarray(sig[jnp.asarray(keys)], np.float32)
+
+    def _set_covar_at(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        import jax.numpy as jnp
+        sig = getattr(self, "sigma", None)
+        if sig is not None:
+            self.sigma = sig.at[jnp.asarray(keys)].set(
+                jnp.asarray(vals, sig.dtype))
 
     # -- full-state checkpointing (io.checkpoint bundles, SURVEY.md §6) ------
     def _checkpoint_arrays(self):

@@ -311,6 +311,20 @@ class FFMTrainer(FMTrainer):
     def _wants_fit_ds(self) -> bool:
         return self.layout == "joint"     # emission needs observed pairs
 
+    def _note_batch(self, batch) -> None:
+        """Streaming path (fit_stream): record observed (feature, field)
+        pairs so joint-layout model emission keeps names/fields."""
+        if self.layout != "joint" or batch.field is None:
+            return
+        idx = np.asarray(batch.idx)
+        fld = np.asarray(batch.field)
+        val = np.asarray(batch.val)
+        live = val != 0
+        packed = np.unique(idx[live].astype(np.int64) * self.F
+                           + fld[live].astype(np.int64))
+        ii, ff = np.divmod(packed, self.F)
+        self._pairs.update(zip(ii.tolist(), ff.tolist()))
+
     def _observed_pairs(self):
         """Unique (feature_id, field) pairs seen in training as two sorted
         arrays (ii, ff), merged from the streaming path's tracked set and

@@ -41,9 +41,11 @@ EVENT_AVERAGE = 1
 EVENT_ARGMIN_KLD = 2
 EVENT_CLOSEGROUP = 3
 
-_REC = struct.Struct("<qffi")
 _HDR = struct.Struct("<BH")
 _LEN = struct.Struct("<I")
+# one wire record — numpy structured dtype so whole messages encode/decode
+# as single tobytes/frombuffer calls (no per-record Python)
+_REC_DT = np.dtype([("k", "<i8"), ("w", "<f4"), ("c", "<f4"), ("d", "<i4")])
 
 
 @dataclass
@@ -58,13 +60,14 @@ class MixMessage:
     def encode(self) -> bytes:
         g = self.group.encode("utf-8")
         n = len(self.keys)
-        body = bytearray(_HDR.pack(self.event, len(g)))
-        body += g
-        body += struct.pack("<I", n)
-        for i in range(n):
-            body += _REC.pack(int(self.keys[i]), float(self.weights[i]),
-                              float(self.covars[i]), int(self.deltas[i]))
-        return _LEN.pack(len(body)) + bytes(body)
+        recs = np.empty(n, _REC_DT)
+        recs["k"] = self.keys
+        recs["w"] = self.weights
+        recs["c"] = self.covars
+        recs["d"] = self.deltas
+        body = (_HDR.pack(self.event, len(g)) + g + struct.pack("<I", n)
+                + recs.tobytes())
+        return _LEN.pack(len(body)) + body
 
     @classmethod
     def decode(cls, body: bytes) -> "MixMessage":
@@ -74,40 +77,65 @@ class MixMessage:
         off += glen
         (n,) = struct.unpack_from("<I", body, off)
         off += 4
-        keys = np.empty(n, np.int64)
-        weights = np.empty(n, np.float32)
-        covars = np.empty(n, np.float32)
-        deltas = np.empty(n, np.int32)
-        for i in range(n):
-            k, w, c, d = _REC.unpack_from(body, off)
-            off += _REC.size
-            keys[i], weights[i], covars[i], deltas[i] = k, w, c, d
-        return cls(event, group, keys, weights, covars, deltas)
+        recs = np.frombuffer(body, _REC_DT, count=n, offset=off)
+        return cls(event, group, recs["k"].astype(np.int64),
+                   recs["w"].astype(np.float32),
+                   recs["c"].astype(np.float32),
+                   recs["d"].astype(np.int32))
 
 
-@dataclass
-class _Partial:
-    """Per-(group, feature) running aggregate (reference: PartialResult /
-    PartialAverage / PartialArgminKLD)."""
-    sum_w_du: float = 0.0       # sum of weight * delta_updates
-    total_du: int = 0
-    sum_prec: float = 0.0       # argmin-KLD: sum of 1/covar
-    sum_w_prec: float = 0.0     # argmin-KLD: sum of w/covar
+class _GroupStore:
+    """Per-group partial aggregates in flat growable arrays (reference:
+    SessionObject holding PartialResult per feature) — the fold over one
+    incoming message is numpy-vectorized; only the key->row indexing
+    remains a dict lookup per NEW key."""
 
-    def fold_avg(self, w: float, du: int) -> None:
-        self.sum_w_du += w * max(1, du)
-        self.total_du += max(1, du)
+    def __init__(self, cap: int = 1024):
+        self.index: Dict[int, int] = {}
+        self._grow(cap)
 
-    def fold_kld(self, w: float, covar: float) -> None:
-        prec = 1.0 / max(1e-12, covar)
-        self.sum_prec += prec
-        self.sum_w_prec += w * prec
+    def _grow(self, cap: int) -> None:
+        def g(a, dt=np.float64):
+            out = np.zeros(cap, dt)
+            if a is not None:
+                out[:len(a)] = a
+            return out
+        old = getattr(self, "sum_w_du", None)
+        self.sum_w_du = g(old)
+        self.total_du = g(getattr(self, "total_du", None), np.int64)
+        self.sum_prec = g(getattr(self, "sum_prec", None))
+        self.sum_w_prec = g(getattr(self, "sum_w_prec", None))
 
-    def mixed_avg(self) -> float:
-        return self.sum_w_du / max(1, self.total_du)
+    def rows_for(self, keys: np.ndarray) -> np.ndarray:
+        idx = self.index
+        rows = np.empty(len(keys), np.int64)
+        for i, k in enumerate(keys.tolist()):      # dict path for new keys
+            r = idx.get(k)
+            if r is None:
+                r = len(idx)
+                idx[k] = r
+            rows[i] = r
+        if len(idx) > len(self.sum_w_du):
+            self._grow(max(len(idx), 2 * len(self.sum_w_du)))
+        return rows
 
-    def mixed_kld(self) -> Tuple[float, float]:
-        return self.sum_w_prec / self.sum_prec, 1.0 / self.sum_prec
+    def fold_avg(self, rows: np.ndarray, w: np.ndarray, du: np.ndarray
+                 ) -> np.ndarray:
+        duf = np.maximum(1, du.astype(np.int64))
+        # np.add.at: duplicate keys within one message accumulate correctly
+        np.add.at(self.sum_w_du, rows, w.astype(np.float64) * duf)
+        np.add.at(self.total_du, rows, duf)
+        return (self.sum_w_du[rows]
+                / np.maximum(1, self.total_du[rows])).astype(np.float32)
+
+    def fold_kld(self, rows: np.ndarray, w: np.ndarray, c: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        prec = 1.0 / np.maximum(1e-12, c.astype(np.float64))
+        np.add.at(self.sum_prec, rows, prec)
+        np.add.at(self.sum_w_prec, rows, w.astype(np.float64) * prec)
+        sp = self.sum_prec[rows]
+        return ((self.sum_w_prec[rows] / sp).astype(np.float32),
+                (1.0 / sp).astype(np.float32))
 
 
 class MixServer:
@@ -124,7 +152,7 @@ class MixServer:
         self.inject_drop_every = 0   # close the connection every Nth request
         self.inject_delay_s = 0.0    # stall each reply this long
         self._requests = 0
-        self._sessions: Dict[str, Dict[int, _Partial]] = {}
+        self._sessions: Dict[str, _GroupStore] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -148,18 +176,14 @@ class MixServer:
                         and self._requests % self.inject_drop_every == 0):
                     writer.close()
                     return
-                sess = self._sessions.setdefault(msg.group, {})
-                out_w = np.empty_like(msg.weights)
-                out_c = np.empty_like(msg.covars)
-                for i, k in enumerate(msg.keys):
-                    p = sess.setdefault(int(k), _Partial())
-                    if msg.event == EVENT_ARGMIN_KLD:
-                        p.fold_kld(float(msg.weights[i]), float(msg.covars[i]))
-                        out_w[i], out_c[i] = p.mixed_kld()
-                    else:
-                        p.fold_avg(float(msg.weights[i]), int(msg.deltas[i]))
-                        out_w[i] = p.mixed_avg()
-                        out_c[i] = 0.0
+                sess = self._sessions.setdefault(msg.group, _GroupStore())
+                rows = sess.rows_for(msg.keys)
+                if msg.event == EVENT_ARGMIN_KLD:
+                    out_w, out_c = sess.fold_kld(rows, msg.weights,
+                                                 msg.covars)
+                else:
+                    out_w = sess.fold_avg(rows, msg.weights, msg.deltas)
+                    out_c = np.zeros_like(out_w)
                 reply = MixMessage(msg.event, msg.group, msg.keys, out_w,
                                    out_c, msg.deltas)
                 writer.write(reply.encode())
@@ -245,7 +269,13 @@ class MixClient:
         self._touched.update(int(k) for k in np.unique(keys) if k != 0)
 
     def maybe_mix(self, trainer) -> None:
-        """Called by LearnerBase after each dispatched batch."""
+        """Called by LearnerBase after each dispatched batch.
+
+        Exchange cost is O(touched keys), never O(dims): the touched
+        weights (and covariances, for argmin-KLD trainers) are gathered on
+        device and only they cross the wire and fold back — the reference's
+        delta-exchange semantics, where MixClient ships accumulated deltas
+        per clocked feature, not the model."""
         if not self.alive:
             return
         self._batches += 1
@@ -254,19 +284,22 @@ class MixClient:
         try:
             keys = np.fromiter(self._touched, np.int64)
             self._touched.clear()
-            w = np.array(trainer._finalized_weights())  # writable copy
-            covar = getattr(trainer, "covar_table", lambda: None)()
+            w_at = trainer._get_weights_at(keys)
+            covar = trainer._get_covar_at(keys) \
+                if hasattr(trainer, "_get_covar_at") else None
             msg = MixMessage(
                 self.event, self.group, keys,
-                w[keys].astype(np.float32),
-                (np.asarray(covar)[keys].astype(np.float32)
-                 if covar is not None else np.ones(len(keys), np.float32)),
+                np.asarray(w_at, np.float32),
+                (np.asarray(covar, np.float32) if covar is not None
+                 else np.ones(len(keys), np.float32)),
                 np.full(len(keys), self.threshold, np.int32))
             self._connect()
             self._sock.sendall(msg.encode())
             reply = self._read_reply()
-            w[reply.keys] = reply.weights
-            trainer._load_weights(w)
+            trainer._set_weights_at(reply.keys, reply.weights)
+            if (self.event == EVENT_ARGMIN_KLD and covar is not None
+                    and hasattr(trainer, "_set_covar_at")):
+                trainer._set_covar_at(reply.keys, reply.covars)
             self.exchanges += 1
         except OSError:
             self.alive = False     # fail-soft: keep training unmixed

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Deterministic dataset-shaped fixtures for golden convergence tests.
+
+The reference commits LIBSVM snippets of a9a/news20/MovieLens as test
+resources (SURVEY.md §5.2). This environment has no network access and no
+copy of those datasets, so the committed fragments are SYNTHETIC but
+dataset-SHAPED: schema, dimensionality, sparsity, label balance and
+achievable quality are matched to the public datasets' documented
+statistics, and generation is seed-pinned so the files are reproducible
+from this script (python make_fragments.py regenerates byte-identical
+outputs).
+
+Shapes:
+  a9a.frag       — 123 binary features (a9a's one-hot Adult encoding),
+                   ~14 active per row, ~24% positive, logistic ground
+                   truth with noise calibrated so 1-epoch AdaGrad logloss
+                   lands near a9a's documented ~0.33 ballpark.
+  news20b.frag   — news20.binary-shaped: 2^20 hashed dims, ~150 active
+                   text-like features per row, balanced labels.
+  movielens.frag — (user, item, rating) integer ratings 1..5 from a
+                   low-rank + bias model, ML-100k-like margins.
+"""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def make_a9a(n_train=2000, n_test=1000, seed=101):
+    rng = np.random.default_rng(seed)
+    d = 123
+    # block structure like one-hot groups: 15 categorical groups
+    groups = np.array_split(np.arange(1, d + 1), 15)
+    w = rng.normal(0, 1.0, d + 1)
+    w[0] = 0.0
+    rows = []
+    labels = []
+    for _ in range(n_train + n_test):
+        feats = [int(rng.choice(g)) for g in groups if rng.random() < 0.93]
+        margin = w[feats].sum() - 1.05    # shift for ~24% positive rate
+        p = 1.0 / (1.0 + np.exp(-1.1 * margin))
+        labels.append(1 if rng.random() < p else -1)
+        rows.append(sorted(feats))
+    return rows, labels, n_train
+
+
+def write_libsvm(path, rows, labels):
+    with open(path, "w") as f:
+        for r, y in zip(rows, labels):
+            f.write(f"{y} " + " ".join(f"{i}:1" for i in r) + "\n")
+
+
+def make_news20b(n_train=600, n_test=300, seed=202):
+    rng = np.random.default_rng(seed)
+    dims = 1 << 20
+    # zipf-weighted vocabulary: frequent terms shared, rare terms classy
+    vocab = 50_000
+    topic_a = rng.integers(1, dims, vocab)
+    topic_b = rng.integers(1, dims, vocab)
+    rows, labels = [], []
+    for _ in range(n_train + n_test):
+        y = 1 if rng.random() < 0.5 else -1
+        src = topic_a if y > 0 else topic_b
+        n_tok = int(rng.integers(80, 220))
+        ranks = np.minimum((rng.zipf(1.35, n_tok) - 1), vocab - 1)
+        common = rng.random(n_tok) < 0.35       # shared background terms
+        ids = np.where(common, topic_a[ranks], src[ranks])
+        uniq, cnt = np.unique(ids, return_counts=True)
+        # tf-idf-ish weights, l2-normalized like news20.binary
+        v = np.log1p(cnt.astype(np.float64))
+        v /= np.linalg.norm(v) + 1e-12
+        rows.append(list(zip(uniq.tolist(), np.round(v, 6).tolist())))
+        labels.append(y)
+    return rows, labels, n_train
+
+
+def write_libsvm_valued(path, rows, labels):
+    with open(path, "w") as f:
+        for r, y in zip(rows, labels):
+            f.write(f"{y} " + " ".join(f"{i}:{v:g}" for i, v in r) + "\n")
+
+
+def make_movielens(n=8000, users=400, items=300, k=6, seed=303):
+    rng = np.random.default_rng(seed)
+    P = rng.normal(0, 0.45, (users, k))
+    Q = rng.normal(0, 0.45, (items, k))
+    bu = rng.normal(0, 0.35, users)
+    bi = rng.normal(0, 0.35, items)
+    mu = 3.6                                    # ML-ish global mean
+    u = rng.integers(0, users, n)
+    i = rng.integers(0, items, n)
+    r = mu + bu[u] + bi[i] + (P[u] * Q[i]).sum(1) + rng.normal(0, 0.4, n)
+    r = np.clip(np.round(r), 1, 5).astype(int)
+    return u, i, r
+
+
+def main():
+    rows, labels, nt = make_a9a()
+    write_libsvm(os.path.join(HERE, "a9a.frag.train.libsvm"),
+                 rows[:nt], labels[:nt])
+    write_libsvm(os.path.join(HERE, "a9a.frag.test.libsvm"),
+                 rows[nt:], labels[nt:])
+
+    rows, labels, nt = make_news20b()
+    write_libsvm_valued(os.path.join(HERE, "news20b.frag.train.libsvm"),
+                        rows[:nt], labels[:nt])
+    write_libsvm_valued(os.path.join(HERE, "news20b.frag.test.libsvm"),
+                        rows[nt:], labels[nt:])
+
+    u, i, r = make_movielens()
+    with open(os.path.join(HERE, "movielens.frag.tsv"), "w") as f:
+        for a, b, c in zip(u, i, r):
+            f.write(f"{a}\t{b}\t{c}\n")
+    print("fragments written to", HERE)
+
+
+if __name__ == "__main__":
+    main()

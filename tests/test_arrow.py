@@ -1,0 +1,127 @@
+"""Arrow/Parquet/CSV ingest + out-of-core streaming epochs (SURVEY.md §1,
+§8 M0; VERDICT r1 missing #1)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("pyarrow")
+
+from hivemall_tpu.io.arrow import (ParquetStream, read_csv, read_parquet,
+                                   table_to_dataset, write_parquet_shards)
+from hivemall_tpu.io.libsvm import synthetic_classification
+from hivemall_tpu.io.sparse import SparseDataset
+from hivemall_tpu.utils.hashing import mhash
+
+
+def _ds(n=1000, seed=0):
+    ds, _ = synthetic_classification(n, 500, density=0.02, seed=seed)
+    return ds
+
+
+def test_parquet_roundtrip(tmp_path):
+    ds = _ds()
+    paths = write_parquet_shards(ds, str(tmp_path / "shards"),
+                                 rows_per_shard=300)
+    assert len(paths) == 4
+    back = read_parquet(str(tmp_path / "shards"))
+    np.testing.assert_array_equal(ds.indices, back.indices)
+    np.testing.assert_array_equal(ds.indptr, back.indptr)
+    np.testing.assert_allclose(ds.values, back.values)
+    np.testing.assert_allclose(ds.labels, back.labels)
+
+
+def test_parquet_roundtrip_with_fields(tmp_path):
+    n, L = 200, 5
+    rng = np.random.default_rng(0)
+    ds = SparseDataset(
+        rng.integers(1, 100, n * L).astype(np.int32),
+        np.arange(0, n * L + 1, L), np.ones(n * L, np.float32),
+        rng.normal(0, 1, n).astype(np.float32),
+        rng.integers(0, 8, n * L).astype(np.int32))
+    write_parquet_shards(ds, str(tmp_path / "s"), rows_per_shard=64)
+    back = read_parquet(str(tmp_path / "s"))
+    np.testing.assert_array_equal(ds.fields, back.fields)
+
+
+def test_string_features_table():
+    import pyarrow as pa
+    table = pa.table({
+        "features": [["1:0.5", "7", "height:1.7"], ["2:2.0"]],
+        "label": [1.0, -1.0],
+    })
+    ds = table_to_dataset(table, dims=1 << 16)
+    assert len(ds) == 2
+    i0, v0 = ds.row(0)
+    assert list(i0[:2]) == [1, 7]
+    assert i0[2] == mhash("height", (1 << 16) - 1)
+    np.testing.assert_allclose(v0, [0.5, 1.0, 1.7])
+
+
+def test_ffm_string_features_table():
+    import pyarrow as pa
+    table = pa.table({
+        "features": [["2:11:0.5", "3:12"], ["0:1:1.0"]],
+        "label": [1.0, -1.0],
+    })
+    ds = table_to_dataset(table, dims=1 << 16, ffm=True, num_fields=8)
+    i0, v0 = ds.row(0)
+    assert list(i0) == [11, 12]
+    np.testing.assert_allclose(v0, [0.5, 1.0])
+    assert list(ds.fields[:2]) == [2, 3]
+
+
+def test_csv_reader(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("label,age,income\n1,30,5.5\n-1,40,0\n")
+    ds = read_csv(str(p), dims=1 << 16)
+    assert len(ds) == 2
+    i0, v0 = ds.row(0)
+    assert len(i0) == 2
+    np.testing.assert_allclose(sorted(v0), [5.5, 30.0])
+    i1, v1 = ds.row(1)       # zero income dropped (sparse semantics)
+    assert len(i1) == 1 and v1[0] == 40.0
+
+
+def test_stream_covers_every_row_once_per_epoch(tmp_path):
+    ds = _ds(997)            # prime size: exercises the carry-over path
+    write_parquet_shards(ds, str(tmp_path / "s"), rows_per_shard=250)
+    stream = ParquetStream(str(tmp_path / "s"))
+    assert len(stream) == 997
+    seen = 0.0
+    n_rows = 0
+    for b in stream.batches(64, epochs=2, shuffle=True, seed=7):
+        nv = b.n_valid or b.batch_size
+        n_rows += nv
+        seen += b.label[:nv].sum()
+    assert n_rows == 2 * 997
+    assert abs(seen - 2 * ds.labels.sum()) < 1e-3
+
+
+def test_fit_stream_matches_in_ram_quality(tmp_path):
+    from hivemall_tpu.models.linear import GeneralClassifier
+    ds = _ds(2000, seed=3)
+    write_parquet_shards(ds, str(tmp_path / "s"), rows_per_shard=512)
+    opts = "-dims 1024 -loss logloss -opt adagrad -reg no -mini_batch 128"
+    ram = GeneralClassifier(opts).fit(ds, epochs=2)
+    stream = ParquetStream(str(tmp_path / "s"))
+    oo = GeneralClassifier(opts).fit_stream(stream.batches(128, epochs=2))
+    # same corpus, different order: equal quality, not equal bits
+    assert abs(ram.cumulative_loss - oo.cumulative_loss) < 0.1
+    from hivemall_tpu.frame.evaluation import auc
+    assert auc(ds.labels, oo.predict_proba(ds)) > 0.9
+
+
+def test_cli_trains_from_parquet_dir(tmp_path, capsys):
+    from hivemall_tpu.cli.main import main
+    ds = _ds(600, seed=5)
+    write_parquet_shards(ds, str(tmp_path / "s"), rows_per_shard=200)
+    model = str(tmp_path / "m.tsv")
+    rc = main(["train", "--algo", "train_classifier",
+               "--input", str(tmp_path / "s"),
+               "--options", "-dims 1024 -mini_batch 64 -loss logloss "
+                            "-opt adagrad -reg no",
+               "--model", model])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"examples": 600' in out
+    assert sum(1 for _ in open(model)) > 10

@@ -1,0 +1,71 @@
+"""Batched-mode convergence evidence for the covariance family (CW/AROW/SCW)
+on the a9a-shaped fragment (VERDICT r1 weak #4 / SURVEY.md §8 "online-learner
+semantics under batching").
+
+Measured on the committed fragment (tests/resources), 1 epoch, test AUC:
+
+  trainer   mb=1 (oracle)  mb=16   mb=64   mb=256
+  AROW      0.936          0.934   0.893   0.800
+  CW        0.931          0.929   0.661   0.745
+  SCW1      0.938          0.938   0.903   0.748
+
+and mb=64 with 4 epochs recovers to 0.92-0.93 while mb=256 does not (CW
+diverges). Hence the documented guidance: -mini_batch 1 is exact reference
+semantics (the default), <=16 matches the sequential oracle within noise,
+64 needs extra epochs, beyond that the closed-form per-batch update departs
+from the online semantics. These tests pin the <=16 equivalence and the
+large-batch degradation so the trade-off stays measured, not assumed.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.frame.evaluation import auc
+from hivemall_tpu.io.libsvm import read_libsvm
+from hivemall_tpu.models.classifier import (AROWTrainer,
+                                            ConfidenceWeightedTrainer,
+                                            SCW1Trainer)
+
+RES = os.path.join(os.path.dirname(__file__), "resources")
+
+
+@pytest.fixture(scope="module")
+def a9a():
+    return (read_libsvm(os.path.join(RES, "a9a.frag.train.libsvm")),
+            read_libsvm(os.path.join(RES, "a9a.frag.test.libsvm")))
+
+
+@pytest.mark.parametrize("cls", [AROWTrainer, ConfidenceWeightedTrainer,
+                                 SCW1Trainer])
+def test_minibatch16_matches_sequential_oracle(cls, a9a):
+    tr, te = a9a
+    oracle = cls("-dims 256 -mini_batch 1")
+    oracle.fit(tr, epochs=1)
+    a1 = auc(te.labels, oracle.decision_function(te))
+    batched = cls("-dims 256 -mini_batch 16")
+    batched.fit(tr, epochs=1)
+    a16 = auc(te.labels, batched.decision_function(te))
+    assert a1 > 0.90                     # the oracle itself converges
+    assert abs(a1 - a16) < 0.01, (a1, a16)
+
+
+def test_minibatch64_recovers_with_epochs(a9a):
+    tr, te = a9a
+    t = AROWTrainer("-dims 256 -mini_batch 64")
+    t.fit(tr, epochs=4)
+    assert auc(te.labels, t.decision_function(te)) > 0.90
+
+
+def test_large_batch_degradation_is_real(a9a):
+    """Document-by-test: the 1-epoch mb=256 model is measurably worse than
+    the oracle — the reason the default stays -mini_batch 1."""
+    tr, te = a9a
+    oracle = AROWTrainer("-dims 256 -mini_batch 1")
+    oracle.fit(tr, epochs=1)
+    big = AROWTrainer("-dims 256 -mini_batch 256")
+    big.fit(tr, epochs=1)
+    a1 = auc(te.labels, oracle.decision_function(te))
+    a256 = auc(te.labels, big.decision_function(te))
+    assert a1 - a256 > 0.05, (a1, a256)

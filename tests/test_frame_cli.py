@@ -68,7 +68,8 @@ def test_cli_train_predict_roundtrip(tmp_path, capsys):
                "--model", model_p])
     assert rc == 0
     train_out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert train_out["examples"] == 400
+    # examples counts PROCESSED rows: 400 input rows x -iters 3 epochs
+    assert train_out["examples"] == 1200
 
     rc = _cli(["predict", "--algo", "train_classifier", "--model", model_p,
                "--input", train_p, "--output", out_p,
