@@ -21,16 +21,25 @@ import traceback
 
 
 def _sync(trainer):
-    """Force-complete every queued device computation for a trainer: block
-    on the whole param tree AND fetch the scalar loss (the loss fetch pulls
-    the full dependency chain through the dispatch queue)."""
+    """Force-complete every queued device computation for a trainer.
+
+    IMPORTANT: block_until_ready does NOT synchronize through the remote
+    device tunnel used here — only fetching VALUES to the host does
+    (measured: a 13M-row scatter 'completed' in 0.05ms under
+    block_until_ready, 1.2s under a value fetch). So every state leaf the
+    trainer maintains (from its own _checkpoint_arrays inventory) is summed
+    and fetched, plus the loss chain."""
     import jax
-    for attr in ("params", "w", "opt_state", "gg", "in_emb"):
-        v = getattr(trainer, attr, None)
-        if v is not None:
-            jax.tree_util.tree_map(
-                lambda l: l.block_until_ready()
-                if hasattr(l, "block_until_ready") else l, v)
+    import numpy as np
+    try:
+        tree = trainer._checkpoint_arrays()
+    except (NotImplementedError, AttributeError):
+        tree = {a: getattr(trainer, a) for a in
+                ("params", "w", "opt_state", "gg", "in_emb")
+                if getattr(trainer, a, None) is not None}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "sum"):
+            float(np.asarray(leaf.sum(), np.float64))
     if hasattr(trainer, "cumulative_loss"):
         float(trainer.cumulative_loss)
 

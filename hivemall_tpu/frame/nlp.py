@@ -3,14 +3,15 @@
 Reference: hivemall/nlp KuromojiUDF (Japanese morphological analysis via
 Lucene Kuromoji) and SmartcnUDF (Chinese). Those analyzers are JVM-only;
 this rebuild ships host-side (CPU) tokenizers with the same signatures and
-option surface, using script-boundary + dictionary-free heuristics:
+option surface:
 
-- tokenize_ja: splits on script transitions (kanji / hiragana / katakana /
-  latin / digits), then splits hiragana runs off as particles. This matches
-  Kuromoji's output on the common benchmark phrases well enough for feature
-  extraction but is NOT a morphological analyzer — documented delta; the
-  hook (`set_ja_tokenizer`) accepts a drop-in callable (e.g. a SentencePiece
-  or sudachi binding) when one is available.
+- tokenize_ja: a real dictionary-based lattice segmenter
+  (frame.ja_segmenter — vendored high-frequency lexicon + unknown-word
+  model + Viterbi min-cost path, the same mechanism Kuromoji runs at
+  IPADIC scale). Correctly separates particles inside all-hiragana text,
+  which script heuristics cannot. The hook (`set_ja_tokenizer`) still
+  accepts a drop-in callable (e.g. a SentencePiece or sudachi binding)
+  for full IPADIC-grade analysis.
 - tokenize_cn: greedy per-codepoint segmentation for Han runs (unigram),
   whitespace for the rest — the standard fallback when no dictionary exists.
 """
@@ -20,6 +21,8 @@ from __future__ import annotations
 import re
 import unicodedata
 from typing import Callable, List, Optional, Sequence
+
+from .ja_segmenter import _script  # single script-classification table
 
 __all__ = ["tokenize_ja", "tokenize_cn", "set_ja_tokenizer"]
 
@@ -32,23 +35,6 @@ def set_ja_tokenizer(fn: Optional[Callable[[str], List[str]]]) -> None:
     _JA_OVERRIDE = fn
 
 
-def _script(ch: str) -> str:
-    o = ord(ch)
-    if 0x3040 <= o <= 0x309F:
-        return "hira"
-    if 0x30A0 <= o <= 0x30FF or o == 0x30FC:
-        return "kata"
-    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF:
-        return "han"
-    if ch.isdigit():
-        return "num"
-    if ch.isalpha():
-        return "latin"
-    if ch.isspace():
-        return "space"
-    return "punct"
-
-
 def tokenize_ja(text: str, mode: str = "normal",
                 stopwords: Optional[Sequence[str]] = None,
                 stoptags: Optional[Sequence[str]] = None) -> List[str]:
@@ -58,23 +44,8 @@ def tokenize_ja(text: str, mode: str = "normal",
     if _JA_OVERRIDE is not None:
         toks = _JA_OVERRIDE(text)
     else:
-        toks = []
-        cur = ""
-        cur_s = ""
-        for ch in text:
-            s = _script(ch)
-            if s in ("space", "punct"):
-                if cur:
-                    toks.append(cur)
-                cur, cur_s = "", ""
-                continue
-            if cur and s != cur_s:
-                toks.append(cur)
-                cur = ""
-            cur += ch
-            cur_s = s
-        if cur:
-            toks.append(cur)
+        from .ja_segmenter import segment
+        toks = segment(text)
     stop = set(stopwords or [])
     return [t for t in toks if t not in stop]
 

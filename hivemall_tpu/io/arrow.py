@@ -100,8 +100,6 @@ def table_to_dataset(table, *, feature_col: str = "features",
 
     if "indices" in names:              # pre-parsed CSR fast path
         col = table.column("indices").combine_chunks()
-        if isinstance(col, pa.ChunkedArray):
-            col = col.combine_chunks()
         indices = col.flatten().to_numpy().astype(np.int32)
         indptr = col.offsets.to_numpy().astype(np.int64)
         if "values" in names:
@@ -116,8 +114,6 @@ def table_to_dataset(table, *, feature_col: str = "features",
         return SparseDataset(indices, indptr, values, labels, fields)
 
     col = table.column(feature_col).combine_chunks()
-    if isinstance(col, pa.ChunkedArray):
-        col = col.combine_chunks()
     indptr = col.offsets.to_numpy().astype(np.int64)
     flat = col.flatten().to_numpy(zero_copy_only=False)
     if len(flat) and not isinstance(flat[0], str):
@@ -159,11 +155,29 @@ def read_csv(path: str, *, feature_cols: Optional[Sequence[str]] = None,
     becomes a quantitative feature "col:value" (hashed name); explicit
     feature_cols restricts the set. The ftvec.trans quantitative_features
     analog at ingest level."""
+    import pyarrow as pa
     from pyarrow import csv as pacsv
     from ..utils.hashing import mhash_batch
     table = pacsv.read_csv(path)
-    cols = list(feature_cols) if feature_cols is not None else \
-        [c for c in table.column_names if c != label_col]
+
+    def numeric(c):
+        return pa.types.is_integer(table.schema.field(c).type) or \
+            pa.types.is_floating(table.schema.field(c).type)
+    if feature_cols is not None:
+        cols = list(feature_cols)
+        bad = [c for c in cols if not numeric(c)]
+        if bad:
+            raise ValueError(
+                f"non-numeric feature columns {bad}; encode them first "
+                f"(e.g. ftvec categorical_features) or drop them")
+    else:
+        # id/name/text columns are common — only numeric columns become
+        # quantitative features by default
+        cols = [c for c in table.column_names
+                if c != label_col and numeric(c)]
+        if not cols:
+            raise ValueError(
+                f"no numeric feature columns in {path}; pass feature_cols")
     labels = table.column(label_col).to_numpy(
         zero_copy_only=False).astype(np.float32)
     n = len(labels)

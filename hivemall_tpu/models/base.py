@@ -270,13 +270,33 @@ class LearnerBase:
         io.arrow.ParquetStream.batches): each batch dispatches one jitted
         step; nothing is buffered, so resident memory is one shard.
         Epoch count is owned by the stream (ParquetStream re-reads shards
-        per epoch — the NioStatefulSegment analog at corpus scale)."""
-        for b in batches:
-            if convert_labels:
-                b = SparseBatch(b.idx, b.val, self._convert_labels(b.label),
-                                b.field, n_valid=b.n_valid)
-            self._note_batch(b)
-            self._dispatch(b)
+        per epoch — the NioStatefulSegment analog at corpus scale). On
+        accelerators the shard read/parse overlaps device compute via the
+        same DevicePrefetcher fit() uses."""
+        import jax
+
+        def host_side() -> Iterator[SparseBatch]:
+            # label conversion + pair tracking stay on HOST arrays, before
+            # the prefetcher stages anything onto the device
+            for b in batches:
+                if convert_labels:
+                    b = SparseBatch(b.idx, b.val,
+                                    self._convert_labels(b.label),
+                                    b.field, n_valid=b.n_valid)
+                self._note_batch(b)
+                yield b
+
+        it: Iterable[SparseBatch] = host_side()
+        prefetch = jax.default_backend() != "cpu" and self.mesh is None
+        if prefetch:
+            from ..io.prefetch import DevicePrefetcher
+            it = DevicePrefetcher(it, depth=2)
+        try:
+            for b in it:
+                self._dispatch(b)
+        finally:
+            if prefetch:
+                it.close()
         return self
 
     def _note_batch(self, batch: SparseBatch) -> None:
