@@ -281,6 +281,9 @@ def bench_trees() -> dict:
     rng = np.random.default_rng(0)
     X = rng.normal(0, 1, (n, d)).astype(np.float32)
     y = (X[:, :4].sum(1) + 0.5 * rng.normal(0, 1, n) > 0).astype(np.int32)
+    # warm the XLA cache with identical shapes: one-off compilation (~40s
+    # for the level-wise builders) is not the per-forest training cost
+    RandomForestClassifier("-trees 16 -depth 8 -seed 7").fit(X, y)
     t0 = time.perf_counter()
     rf = RandomForestClassifier("-trees 16 -depth 8 -seed 31")
     rf.fit(X, y)

@@ -161,8 +161,9 @@ def read_csv(path: str, *, feature_cols: Optional[Sequence[str]] = None,
     table = pacsv.read_csv(path)
 
     def numeric(c):
-        return pa.types.is_integer(table.schema.field(c).type) or \
-            pa.types.is_floating(table.schema.field(c).type)
+        t = table.schema.field(c).type
+        return (pa.types.is_integer(t) or pa.types.is_floating(t)
+                or pa.types.is_boolean(t))     # bool casts cleanly to 0/1
     if feature_cols is not None:
         cols = list(feature_cols)
         bad = [c for c in cols if not numeric(c)]

@@ -96,6 +96,7 @@ class LearnerBase:
         self._mixer = None
         self._fit_ds = None                   # columnar dataset ref (fit)
         self.mesh = None                      # jax Mesh when -mesh is set
+        self._tp_sizes = {self.dims}          # axis sizes sharded over 'tp'
         self._init_state()
         if self.opts.get("mix"):
             # covariance trainers (CW/AROW/SCW) mix by argmin-KLD —
@@ -228,12 +229,13 @@ class LearnerBase:
         self._reshard_state()
 
     def _state_sharding(self, leaf):
-        """NamedSharding for one state leaf: first dims-sized axis -> 'tp',
+        """NamedSharding for one state leaf: the first axis whose size is a
+        registered table size (_tp_sizes: dims, FFM's Mr, ...) -> 'tp',
         everything else replicated (w0, counters, small tables)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
         shape = getattr(leaf, "shape", ())
         for ax, s in enumerate(shape):
-            if s == self.dims:
+            if s in self._tp_sizes:
                 return NamedSharding(
                     self.mesh,
                     P(*["tp" if a == ax else None for a in range(len(shape))]))

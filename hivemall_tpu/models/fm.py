@@ -21,7 +21,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.sparse import SparseBatch, SparseDataset
-from ..ops.fm import ffm_score, fm_score, make_ffm_step, make_fm_step
+from ..ops.fm import (ffm_row_hash, ffm_score, fm_score,
+                      make_ffm_score_fused, make_ffm_step, make_ffm_step_fused,
+                      make_fm_step)
 from ..ops.losses import get_loss
 from ..ops.optimizers import make_optimizer
 from ..utils.hashing import mhash
@@ -179,11 +181,16 @@ class FFMTrainer(FMTrainer):
     Features are "field:index:value" triples (ftvec.trans.ffm_features).
     Two latent-table layouts (-ffm_table):
 
-      joint (default) — one flat V[M, K] table addressed by a joint
-        (feature, field) hash (ops.fm.ffm_joint_slot), M = -dims. The TPU
-        analog of the reference's packed-long keys: Criteo-scale
-        ``-dims 2^24 -fields 64 -halffloat`` is 128 MB of weights + 256 MB
-        f32 AdaGrad state, single-chip friendly; shards over 'tp'.
+      joint (default) — the fused feature-row layout: one table
+        T[Mr, F*K + 8] where row ffm_row_hash(feature) holds ALL F of that
+        feature's per-field latent vectors plus its linear weight, and
+        Mr * F_pow2 = -dims total (feature, field) capacity. The TPU analog
+        of the reference's packed-long keys, laid out so one train step
+        costs exactly one row-gather + one row-scatter (TPU scatter cost is
+        per-row, not per-byte — see ops.fm.make_ffm_step_fused; measured
+        95x over a flat per-pair table). Criteo-scale ``-dims 2^24
+        -fields 64 -halffloat`` is ~140 MB of weights + ~280 MB f32
+        AdaGrad state, single-chip friendly; shards over 'tp'.
       dense — V[N, F, K] field cube, exact (feature, field) cells, for
         small field counts.
     """
@@ -225,18 +232,36 @@ class FFMTrainer(FMTrainer):
                              f"(got {self.dims})")
         dtype = jnp.bfloat16 if o.halffloat else jnp.float32
         key = jax.random.PRNGKey(int(o.seed))
-        v_shape = ((self.dims, self.k) if self.layout == "joint"
-                   else (self.dims, self.F, self.k))
-        self.params = {
-            "w0": jnp.zeros((), dtype),
-            "w": jnp.zeros(self.dims, dtype),
-            "V": (jax.random.normal(key, v_shape) *
-                  float(o.sigma)).astype(dtype),
-        }
-        self.opt_state = {k: self.optimizer.init(v.shape)
-                          for k, v in self.params.items()}
-        self._step = make_ffm_step(self.loss, self.optimizer,
-                                   (o.lambda0, o.lambda_w, o.lambda_v))
+        if self.layout == "joint":
+            f_pow2 = 1
+            while f_pow2 < self.F:
+                f_pow2 <<= 1
+            self.Mr = max(1 << 10, self.dims // f_pow2)
+            FK = self.F * self.k
+            self.W = FK + 8            # [V(F*K) | w | pad] fused row
+            Tinit = jnp.concatenate([
+                jax.random.normal(key, (self.Mr, FK)) * float(o.sigma),
+                jnp.zeros((self.Mr, self.W - FK)),
+            ], axis=1).astype(dtype)
+            self.params = {"w0": jnp.zeros((), dtype), "T": Tinit}
+            self.opt_state = {"w0": self.optimizer.init(()),
+                              "T": self.optimizer.init((self.Mr, self.W))}
+            self._step = make_ffm_step_fused(
+                self.loss, self.optimizer,
+                (o.lambda0, o.lambda_w, o.lambda_v), self.F, self.k)
+            self._fused_score = make_ffm_score_fused(self.F, self.k)
+            self._tp_sizes.add(self.Mr)     # mesh: shard T rows over tp
+        else:
+            self.params = {
+                "w0": jnp.zeros((), dtype),
+                "w": jnp.zeros(self.dims, dtype),
+                "V": (jax.random.normal(key, (self.dims, self.F, self.k)) *
+                      float(o.sigma)).astype(dtype),
+            }
+            self.opt_state = {k: self.optimizer.init(v.shape)
+                              for k, v in self.params.items()}
+            self._step = make_ffm_step(self.loss, self.optimizer,
+                                       (o.lambda0, o.lambda_w, o.lambda_v))
         self._pairs: set = set()       # (feature_id, field) seen, stream path
         self._fit_ds = None            # dataset ref, columnar path
 
@@ -305,6 +330,10 @@ class FFMTrainer(FMTrainer):
 
     def _score_batch(self, batch: SparseBatch) -> np.ndarray:
         p = self.params
+        if self.layout == "joint":
+            return np.asarray(self._fused_score(
+                p["w0"], p["T"], jnp.asarray(batch.idx),
+                jnp.asarray(batch.val), jnp.asarray(batch.field)))
         return np.asarray(ffm_score(p["w0"], p["w"], p["V"],
                                     batch.idx, batch.val, batch.field))
 
@@ -344,18 +373,24 @@ class FFMTrainer(FMTrainer):
         ii, ff = np.divmod(uniq, self.F)
         return ii.astype(np.int32), ff.astype(np.int32)
 
+    def _rows_for(self, keys: np.ndarray) -> np.ndarray:
+        """Host-side fused-table row ids for feature ids (joint layout)."""
+        return np.asarray(ffm_row_hash(jnp.asarray(keys, jnp.int32),
+                                       self.Mr))
+
     def model_rows(self):
         """(feature, field, Wi, Vi[k]) rows — the FFMPredictionModel surface.
 
         Joint layout: rows are enumerated from the observed (feature, field)
-        pairs and each Vi is read from its joint-hashed slot; colliding pairs
-        intentionally report the same shared vector (hashing-trick
-        semantics). If no pairs were observed (e.g. a bundle-restored trainer
-        that never saw data), falls back to slot-keyed "vslot:<id>" rows."""
-        w = np.asarray(self.params["w"].astype(jnp.float32))
-        V = np.asarray(self.params["V"].astype(jnp.float32))
+        pairs; each feature's weight and per-field vectors are read from its
+        hashed fused row. Colliding features intentionally report the same
+        shared state (hashing-trick semantics). If no pairs were observed
+        (e.g. a bundle-restored trainer that never saw data), falls back to
+        row-keyed "vrow:<id>:<field>" rows."""
         yield ("0", -1, float(np.asarray(self.params["w0"])), None)
         if self.layout == "dense":
+            w = np.asarray(self.params["w"].astype(jnp.float32))
+            V = np.asarray(self.params["V"].astype(jnp.float32))
             touched = np.nonzero(np.abs(V).sum((1, 2)) > 0)[0]
             for i in touched:
                 if i == 0:
@@ -365,20 +400,60 @@ class FFMTrainer(FMTrainer):
                     if np.abs(V[i, f]).sum() > 0:
                         yield (name, f, float(w[i]), V[i, f].tolist())
             return
+        FK = self.F * self.k
+        T = np.asarray(self.params["T"].astype(jnp.float32))
         pairs = self._observed_pairs()
         if pairs is None:
-            for s in np.nonzero(np.abs(V).sum(-1) > 0)[0]:
-                yield (f"vslot:{int(s)}", -1, 0.0, V[int(s)].tolist())
+            live = np.nonzero(np.abs(T[:, :FK]).sum(-1) > 0)[0]
+            for r in live:
+                for f in range(self.F):
+                    vec = T[r, f * self.k:(f + 1) * self.k]
+                    if np.abs(vec).sum() > 0:
+                        yield (f"vrow:{int(r)}", f, float(T[r, FK]),
+                               vec.tolist())
             return
-        from ..ops.fm import ffm_joint_slot
         ii, ff = pairs
-        slots = np.asarray(ffm_joint_slot(jnp.asarray(ii), jnp.asarray(ff),
-                                          self.dims))
-        for i, f, s in zip(ii.tolist(), ff.tolist(), slots.tolist()):
+        rr = self._rows_for(ii)
+        for i, f, r in zip(ii.tolist(), ff.tolist(), rr.tolist()):
             if i == 0:
                 continue
             name = self._names.get(i, str(i))
-            yield (name, f, float(w[i]), V[s].tolist())
+            yield (name, f, float(T[r, FK]),
+                   T[r, f * self.k:(f + 1) * self.k].tolist())
+
+    # -- sparse weight access for the mix client (joint layout) -------------
+    def _weight_table(self):
+        if self.layout == "joint":
+            return None                # w lives inside T; use overrides
+        return super()._weight_table()
+
+    def _get_weights_at(self, keys: np.ndarray) -> np.ndarray:
+        if self.layout != "joint":
+            return super()._get_weights_at(keys)
+        FK = self.F * self.k
+        rr = jnp.asarray(self._rows_for(np.asarray(keys)))
+        return np.asarray(self.params["T"][rr, FK], np.float32)
+
+    def _set_weights_at(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        if self.layout != "joint":
+            return super()._set_weights_at(keys, vals)
+        FK = self.F * self.k
+        rr = jnp.asarray(self._rows_for(np.asarray(keys)))
+        T = self.params["T"]
+        self.params["T"] = T.at[rr, FK].set(jnp.asarray(vals, T.dtype))
+
+    def _finalized_weights(self) -> np.ndarray:
+        if self.layout != "joint":
+            return super()._finalized_weights()
+        FK = self.F * self.k
+        return np.asarray(self.params["T"][:, FK].astype(jnp.float32))
+
+    def _load_weights(self, w: np.ndarray) -> None:
+        if self.layout != "joint":
+            return super()._load_weights(w)
+        FK = self.F * self.k
+        T = self.params["T"]
+        self.params["T"] = T.at[:, FK].set(jnp.asarray(w, T.dtype))
 
 
 # --- standalone predict kernels (the UDAF/UDF reassembly path) -------------

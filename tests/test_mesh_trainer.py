@@ -59,11 +59,9 @@ def test_ffm_joint_mesh_matches_single_device():
            "-classification"
     single = FFMTrainer(opts).fit(ds, epochs=2)
     sharded = FFMTrainer(opts + " -mesh dp=2,tp=4").fit(ds, epochs=2)
-    assert sharded.params["V"].shape == (4096, 4)
-    np.testing.assert_allclose(np.asarray(single.params["w"]),
-                               np.asarray(sharded.params["w"]), atol=1e-4)
-    np.testing.assert_allclose(np.asarray(single.params["V"]),
-                               np.asarray(sharded.params["V"]), atol=1e-4)
+    assert sharded.params["T"].shape == (sharded.Mr, sharded.W)
+    np.testing.assert_allclose(np.asarray(single.params["T"]),
+                               np.asarray(sharded.params["T"]), atol=1e-4)
 
 
 def test_ffm_ftrl_mesh_matches_single_device():
@@ -72,8 +70,8 @@ def test_ffm_ftrl_mesh_matches_single_device():
            "-classification"
     single = FFMTrainer(opts).fit(ds, epochs=1)
     sharded = FFMTrainer(opts + " -mesh dp=4,tp=2").fit(ds, epochs=1)
-    np.testing.assert_allclose(np.asarray(single.params["V"]),
-                               np.asarray(sharded.params["V"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(single.params["T"]),
+                               np.asarray(sharded.params["T"]), atol=1e-4)
 
 
 def test_linear_mesh_matches_single_device():
@@ -98,8 +96,8 @@ def test_sharded_bundle_roundtrip(tmp_path):
     t.save_bundle(path)
     t2 = FFMTrainer(opts)
     t2.load_bundle(path)
-    np.testing.assert_allclose(np.asarray(t.params["V"]),
-                               np.asarray(t2.params["V"]), atol=0)
+    np.testing.assert_allclose(np.asarray(t.params["T"]),
+                               np.asarray(t2.params["T"]), atol=0)
     # restored state is re-sharded onto the mesh and trainable
     t2.fit(ds, epochs=1)
     assert np.isfinite(t2.cumulative_loss)
