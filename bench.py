@@ -206,17 +206,18 @@ def bench_linear(n_steps: int = 60, warmup: int = 8) -> dict:
     for _ in range(warmup):
         clf._train_batch(batch)
     _sync(clf)
-    t0 = time.perf_counter()
-    loss = None
-    for _ in range(n_steps):
-        loss = clf._train_batch(batch)
-    clf.w.block_until_ready()
-    jax.tree_util.tree_map(lambda l: l.block_until_ready(), clf.opt_state)
-    float(loss)
-    dt = time.perf_counter() - t0
+    best_dt = float("inf")
+    for _ in range(3):              # best-of-3, like the FFM bench
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n_steps):
+            loss = clf._train_batch(batch)
+        _sync(clf)
+        float(loss)
+        best_dt = min(best_dt, time.perf_counter() - t0)
     return {"metric": "train_classifier_examples_per_sec",
-            "value": round(B * n_steps / dt, 1), "unit": "examples/sec",
-            "step_ms": round(dt / n_steps * 1e3, 3)}
+            "value": round(B * n_steps / best_dt, 1), "unit": "examples/sec",
+            "step_ms": round(best_dt / n_steps * 1e3, 3)}
 
 
 def bench_mf(n_steps: int = 60, warmup: int = 8) -> dict:

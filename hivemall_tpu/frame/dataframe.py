@@ -72,6 +72,37 @@ class Frame:
         import pandas as pd
         return pd.DataFrame(dict(self._cols))
 
+    # -- Arrow interchange (the columnar runtime boundary, SURVEY.md §1) -----
+    @classmethod
+    def from_arrow(cls, table) -> "Frame":
+        """pyarrow Table -> Frame (list columns stay python lists)."""
+        cols = {}
+        for name in table.column_names:
+            col = table.column(name)
+            try:
+                cols[name] = col.to_numpy(zero_copy_only=False)
+            except Exception:
+                cols[name] = col.to_pylist()
+        return cls(cols)
+
+    @classmethod
+    def from_parquet(cls, path: str) -> "Frame":
+        import pyarrow.parquet as pq
+        return cls.from_arrow(pq.read_table(path))
+
+    @classmethod
+    def from_csv(cls, path: str) -> "Frame":
+        from pyarrow import csv as pacsv
+        return cls.from_arrow(pacsv.read_csv(path))
+
+    def to_arrow(self):
+        import pyarrow as pa
+        return pa.table({k: list(v) for k, v in self._cols.items()})
+
+    def to_parquet(self, path: str) -> None:
+        import pyarrow.parquet as pq
+        pq.write_table(self.to_arrow(), path)
+
     # -- HivemallOps surface -------------------------------------------------
     def _train(self, algo: str, features_col: str, label_col: Optional[str],
                options: str) -> "Frame":

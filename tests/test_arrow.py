@@ -125,3 +125,29 @@ def test_cli_trains_from_parquet_dir(tmp_path, capsys):
     out = capsys.readouterr().out
     assert '"examples": 600' in out
     assert sum(1 for _ in open(model)) > 10
+
+
+def test_frame_arrow_interchange(tmp_path):
+    from hivemall_tpu.frame.dataframe import Frame
+    f = Frame({"features": [["1:1.0", "2:0.5"], ["3:2.0"]],
+               "label": [1.0, -1.0]})
+    p = str(tmp_path / "f.parquet")
+    f.to_parquet(p)
+    back = Frame.from_parquet(p)
+    assert len(back) == 2
+    assert list(back["label"]) == [1.0, -1.0]
+    assert list(back["features"][0]) == ["1:1.0", "2:0.5"]
+    # trains straight off the round-tripped frame (HivemallOps-style)
+    model = back.train_classifier("features", "label",
+                                  "-dims 64 -mini_batch 2 -loss logloss "
+                                  "-opt adagrad -reg no")
+    assert len(model) > 0
+
+
+def test_frame_from_csv(tmp_path):
+    from hivemall_tpu.frame.dataframe import Frame
+    p = tmp_path / "t.csv"
+    p.write_text("a,b\n1,x\n2,y\n")
+    f = Frame.from_csv(str(p))
+    assert list(f["a"]) == [1, 2]
+    assert list(f["b"]) == ["x", "y"]
