@@ -108,34 +108,38 @@ def bench_ffm_kernel(n_steps: int = 30, warmup: int = 5) -> dict:
     }
 
 
-def bench_ffm_e2e(n_rows: int = 131072) -> dict:
-    """End-to-end FFM: host feature STRINGS -> parse -> hash -> pad/batch ->
-    h2d -> sparse train step. This is the input-path-included number SURVEY
-    §8 warns about ('the input path can easily be the bottleneck')."""
+def _criteo_synth(n_rows: int, seed: int):
+    """Shared Criteo-shaped synthetic corpus + warmed flagship trainer for
+    the end-to-end benches (one recipe so their numbers stay comparable)."""
     import numpy as np
     from hivemall_tpu.io.sparse import SparseDataset
     from hivemall_tpu.models.fm import FFMTrainer
 
     B, L, F, K = 16384, 39, 39, 4
     dims = 1 << 22
-    rng = np.random.default_rng(1)
-    # Criteo-shaped synthetic: 39 fields, hashed categorical per field
-    raw_idx = rng.integers(1, dims, (n_rows, L)).astype(np.int32)
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(1, dims, (n_rows, L)).astype(np.int32)
     fld = np.tile(np.arange(L, dtype=np.int32), (n_rows, 1))
     lab = (rng.integers(0, 2, n_rows) * 2 - 1).astype(np.float32)
-
     indptr = np.arange(0, n_rows * L + 1, L, dtype=np.int64)
-    ds = SparseDataset(raw_idx.ravel(), indptr,
+    ds = SparseDataset(idx.ravel(), indptr,
                        np.ones(n_rows * L, np.float32), lab, fld.ravel())
     t = FFMTrainer(f"-dims {dims} -factors {K} -fields {F} -mini_batch {B} "
                    f"-opt adagrad -classification -halffloat")
-    # warm up the jitted step OUTSIDE the timed region (compile time is not
-    # the input path this bench characterizes); the timed fit still pays
-    # host batch prep + h2d + step for the whole corpus
+    # warm the jitted step OUTSIDE the timed region (compile time is not
+    # the input path these benches characterize)
     for wb in ds.batches(B, shuffle=False):
         t._dispatch(wb)
         break
     _sync(t)
+    return ds, t, B, L
+
+
+def bench_ffm_e2e(n_rows: int = 131072) -> dict:
+    """End-to-end FFM: host CSR -> pad/batch -> h2d -> fused train step.
+    This is the input-path-included number SURVEY §8 warns about ('the
+    input path can easily be the bottleneck')."""
+    ds, t, B, L = _criteo_synth(n_rows, seed=1)
     t0 = time.perf_counter()
     t.fit(ds, epochs=1)
     _sync(t)
@@ -146,6 +150,32 @@ def bench_ffm_e2e(n_rows: int = 131072) -> dict:
         "unit": "examples/sec",
         "seconds": round(dt, 3),
         "loss": round(t.cumulative_loss, 6),
+    }
+
+
+def bench_ffm_parquet_stream(n_rows: int = 131072) -> dict:
+    """Out-of-core production path: Parquet shards on disk -> ParquetStream
+    (per-epoch shard re-read, prefetch overlap) -> fused FFM train step.
+    Same corpus recipe as bench_ffm_e2e so the numbers are comparable."""
+    import shutil
+    import tempfile
+    from hivemall_tpu.io.arrow import ParquetStream, write_parquet_shards
+
+    ds, t, B, L = _criteo_synth(n_rows, seed=3)
+    tmp = tempfile.mkdtemp(prefix="bench_ffm_pq_")
+    try:
+        write_parquet_shards(ds, tmp, rows_per_shard=32768)
+        stream = ParquetStream(tmp)
+        t0 = time.perf_counter()
+        t.fit_stream(stream.batches(B, epochs=1, max_len=L))
+        _sync(t)
+        dt = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "metric": "train_ffm_parquet_stream_examples_per_sec",
+        "value": round(n_rows / dt, 1), "unit": "examples/sec",
+        "seconds": round(dt, 3),
     }
 
 
@@ -301,7 +331,8 @@ def main():
 
     configs = []
     primary = None
-    for fn in (bench_linear, bench_ffm_kernel, bench_ffm_e2e, bench_ingest,
+    for fn in (bench_linear, bench_ffm_kernel, bench_ffm_e2e,
+               bench_ffm_parquet_stream, bench_ingest,
                bench_mf, bench_word2vec, bench_trees):
         try:
             rec = fn()

@@ -108,3 +108,33 @@ def define_all() -> str:
         for a in e.aliases:
             lines.append(f"CREATE FUNCTION {a} AS '{e.target}';  -- alias of {e.name}")
     return "\n".join(lines)
+
+
+def define_all_spark() -> str:
+    """The define-all.spark analog (SURVEY.md §3.18): sqlContext.sql
+    registration lines for a Spark session bridging to this catalog.
+    Rendered from the same registry, so the three surfaces cannot drift."""
+    lines = ["-- Spark registration (define-all.spark analog); pair with a",
+             "-- py4j/UDF bridge exposing hivemall_tpu callables"]
+    for e in all_functions().values():
+        for n in [e.name] + list(e.aliases):
+            lines.append(
+                f'sqlContext.sql("CREATE TEMPORARY FUNCTION {n} '
+                f"AS 'hivemall_tpu:{e.target}'\")")
+    return "\n".join(lines)
+
+
+def define_udfs_td() -> str:
+    """The define-udfs.td.hql analog: the curated Treasure-Data-style subset
+    (trainers, predictors, ftvec, evaluation — no low-level tools)."""
+    keep_prefix = ("train_", "fm_", "ffm_", "mf_", "bprmf_", "tree_",
+                   "xgboost_", "lda_", "plsa_", "feature_", "rescale",
+                   "zscore", "l1_normalize", "l2_normalize", "add_bias",
+                   "extract_", "amplify", "rand_amplify", "each_top_k",
+                   "auc", "logloss", "rmse", "mae", "mse", "f1score",
+                   "fmeasure", "sigmoid", "changefinder", "sst")
+    lines = []
+    for e in all_functions().values():
+        if e.name.startswith(keep_prefix) or e.name in keep_prefix:
+            lines.append(f"CREATE FUNCTION {e.name} AS '{e.target}';")
+    return "\n".join(lines)

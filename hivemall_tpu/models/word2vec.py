@@ -53,7 +53,10 @@ class Word2VecTrainer:
                    "word2vec.c's per-pair 0.025 for equivalent pacing")
         s.add("sample", type=float, default=1e-4,
               help="frequent-word subsampling threshold (0 = off)")
-        s.add("mini_batch", type=int, default=2048, help="pairs per step")
+        s.add("mini_batch", type=int, default=2048,
+              help="pairs per step. NOTE: the loss is a batch MEAN, so "
+                   "total per-epoch movement scales with alpha/mini_batch "
+                   "— raise alpha when raising this")
         s.add("seed", type=int, default=11, help="rng seed")
         s.flag("cbow", help="CBOW instead of SkipGram")
         return s
@@ -92,14 +95,66 @@ class Word2VecTrainer:
                          np.maximum(1, np.round(p * size).astype(np.int64))
                          ).astype(np.int32)
 
-    def _make_step(self, cbow: bool):
+    def _make_step(self, cbow: bool, vocab_size: int, dim: int):
         neg = int(self.opts.neg)
+        # Two update variants, chosen by table size (measured on v5e):
+        #   dense  — autodiff over the whole (in, out) tables; the SGD
+        #            update is two fused elementwise passes. Fastest while
+        #            V*D stays a few MB (text8-class vocabularies).
+        #   sparse — slab-level autodiff + scatter-add of touched rows
+        #            only (the ops.fm.make_ffm_step_fused principle). At
+        #            enwiki scale (V ~ 1M) the dense variant would move
+        #            100s of MB of table per step for a few thousand
+        #            touched rows.
+        if vocab_size * dim <= (1 << 23):
+            return self._make_step_dense(cbow)
 
         @jax.jit
         def step(in_emb, out_emb, center, context, negs, row_mask, lr):
             # SkipGram: v_in = in[center]; target = context
             # CBOW: v_in = mean(in[context window]) handled by caller passing
             #       the window in `center` as [B, 2w] with -1 padding
+            if cbow:
+                cmask = (center >= 0).astype(jnp.float32)
+                cids = jnp.maximum(center, 0)
+                vin_slab = in_emb[cids]                      # [B, 2w, D]
+            else:
+                vin_slab = in_emb[center]                    # [B, D]
+            pos_slab = out_emb[context]                      # [B, D]
+            neg_slab = out_emb[negs]                         # [B, neg, D]
+
+            def batch_loss(vin, op, on):
+                if cbow:
+                    v = (vin * cmask[..., None]).sum(1) / jnp.maximum(
+                        cmask.sum(1, keepdims=True), 1.0)
+                else:
+                    v = vin
+                pos = (v * op).sum(-1)
+                negd = jnp.einsum("bd,bnd->bn", v, on)
+                per_pair = (jax.nn.softplus(-pos)
+                            + jax.nn.softplus(negd).sum(-1)) * row_mask
+                # mean over valid pairs: per-word effective step stays O(lr)
+                # even when one word recurs many times in a batch (the
+                # batched analog of word2vec.c's sequential per-pair steps)
+                return per_pair.sum() / jnp.maximum(row_mask.sum(), 1.0)
+
+            loss, (gv, gp, gn) = jax.value_and_grad(
+                batch_loss, argnums=(0, 1, 2))(vin_slab, pos_slab, neg_slab)
+            D = in_emb.shape[1]
+            if cbow:
+                ie = in_emb.at[cids.reshape(-1)].add(
+                    (-lr * gv).reshape(-1, D))
+            else:
+                ie = in_emb.at[center].add(-lr * gv)
+            oe = out_emb.at[context].add(-lr * gp)
+            oe = oe.at[negs.reshape(-1)].add((-lr * gn).reshape(-1, D))
+            return ie, oe, loss
+
+        return step
+
+    def _make_step_dense(self, cbow: bool):
+        @jax.jit
+        def step(in_emb, out_emb, center, context, negs, row_mask, lr):
             def batch_loss(tables):
                 ie, oe = tables
                 if cbow:
@@ -107,11 +162,9 @@ class Word2VecTrainer:
                     v = (ie[jnp.maximum(center, 0)] *
                          mask[..., None]).sum(1) / jnp.maximum(
                              mask.sum(1, keepdims=True), 1.0)
-                    tgt = context
                 else:
                     v = ie[center]
-                    tgt = context
-                pos = (v * oe[tgt]).sum(-1)
+                pos = (v * oe[context]).sum(-1)
                 negd = jnp.einsum("bd,bnd->bn", v, oe[negs])
                 per_pair = (jax.nn.softplus(-pos)
                             + jax.nn.softplus(negd).sum(-1)) * row_mask
@@ -194,7 +247,7 @@ class Word2VecTrainer:
             keep_p = np.ones(V)
 
         cbow = bool(o.cbow)
-        step = self._make_step(cbow)
+        step = self._make_step(cbow, V, D)
         win = int(o.window)
         B = int(o.mini_batch)
         neg = int(o.neg)

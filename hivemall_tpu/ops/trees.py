@@ -283,7 +283,6 @@ def build_tree_xgb(bins: np.ndarray, grads: np.ndarray, hess: np.ndarray,
 
 # --- prediction: vectorized gather-walk (the StackMachine VM rebuild) ------
 
-@partial(jax.jit, static_argnums=(4,))
 def _walk(feat, thr, value, bins, depth):
     n = bins.shape[0]
     node = jnp.zeros(n, jnp.int32)
@@ -300,18 +299,21 @@ def _walk(feat, thr, value, bins, depth):
     return value[node]
 
 
+@partial(jax.jit, static_argnums=(4,))
+def _walk_ensemble(feat, thr, value, bins, depth):
+    """vmapped gather-walk: all E trees in ONE device dispatch."""
+    return jax.vmap(_walk, in_axes=(0, 0, 0, None, None)
+                    )(feat, thr, value, bins, depth)
+
+
 def predict_bins(tree: Tree, bins: np.ndarray) -> np.ndarray:
     """Predict leaf payload per row for every tree: returns [E, n, C].
     The reference's per-row StackMachine opcode interpreter (SURVEY.md §3.9
-    row 3) becomes this data-parallel gather walk."""
-    E = tree.feat.shape[0]
-    out = [
-        np.asarray(_walk(jnp.asarray(tree.feat[e]), jnp.asarray(tree.thr[e]),
-                         jnp.asarray(tree.value[e]), jnp.asarray(bins),
-                         tree.depth + 1))
-        for e in range(E)
-    ]
-    return np.stack(out)
+    row 3) becomes this data-parallel gather walk, vmapped over the
+    ensemble (one device call for the whole forest, not one per tree)."""
+    return np.asarray(_walk_ensemble(
+        jnp.asarray(tree.feat), jnp.asarray(tree.thr),
+        jnp.asarray(tree.value), jnp.asarray(bins), tree.depth + 1))
 
 
 def bin_raw(X: np.ndarray, edges: np.ndarray) -> np.ndarray:

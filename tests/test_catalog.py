@@ -47,3 +47,16 @@ def test_functions_manifest_in_sync():
     assert open(path, encoding="utf-8").read() == render_markdown(), \
         "FUNCTIONS.md is stale — regenerate with " \
         "`python -m hivemall_tpu.catalog.manifest > FUNCTIONS.md`"
+
+
+def test_define_all_spark_and_td():
+    from hivemall_tpu.catalog.registry import define_all_spark, define_udfs_td
+    spark = define_all_spark()
+    assert "CREATE TEMPORARY FUNCTION train_classifier" in spark
+    assert "cosine_sim" in spark          # aliases registered too
+    td = define_udfs_td()
+    assert "CREATE FUNCTION train_ffm" in td
+    assert "CREATE FUNCTION auc" in td
+    # curated subset: low-level tools stay out
+    assert "map_tail_n" not in td
+    assert len(td.splitlines()) < len(spark.splitlines())

@@ -1,0 +1,73 @@
+"""Multi-process (DCN-path) smoke: jax.distributed bootstrap via
+parallel.mesh.init_distributed + a cross-process pmean collective.
+
+The reference's NCCL/MPI analog (SURVEY.md §6 'distributed communication
+backend'): two REAL processes form a cluster over the coordination service
+(gloo on CPU), build a global 2-device mesh (one device per process) and
+run a shard_map pmean — the same substrate a multi-host TPU fleet uses
+over DCN. Mirrors the reference's in-process-localhost-MixServer trick at
+the collectives layer (SURVEY.md §5.3).
+"""
+
+import socket
+import subprocess
+import sys
+import textwrap
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)       # one device per process
+    sys.path.insert(0, %(repo)r)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from hivemall_tpu.parallel.mesh import init_distributed
+    port, rank = sys.argv[1], int(sys.argv[2])
+    init_distributed(coordinator_address="127.0.0.1:" + port,
+                     num_processes=2, process_id=rank)
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    devs = jax.devices()
+    assert len(devs) == 2, devs             # global device view
+    assert jax.process_count() == 2
+    mesh = Mesh(devs, ("dp",))
+    f = jax.jit(shard_map(lambda a: jax.lax.pmean(a, "dp"), mesh=mesh,
+                          in_specs=P("dp"), out_specs=P("dp")))
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")),
+        np.ones(4, np.float32) * (rank + 1), (8,))
+    out = f(garr)
+    local = np.asarray(out.addressable_shards[0].data)
+    assert np.allclose(local, 1.5), local   # mean of ranks 1 and 2
+    print("rank", rank, "ok", flush=True)
+""")
+
+
+def test_two_process_dcn_pmean(tmp_path):
+    import os
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    script = tmp_path / "worker.py"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script.write_text(WORKER % {"repo": repo})
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(port), str(r)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:          # never orphan a hung rank
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, err[-2000:]
+        assert "ok" in out

@@ -100,3 +100,25 @@ def test_pair_generation_is_fast():
     c, x = Word2VecTrainer._skipgram_pairs(d, 5, rng)
     rate = len(x) / (time.perf_counter() - t0)
     assert rate > 2e6, f"pair gen too slow: {rate/1e6:.1f}M pairs/sec"
+
+
+def test_sparse_step_selected_for_large_vocab_updates_touched_only():
+    """Vocab above the dense threshold uses slab-level scatter updates:
+    untouched embedding rows must be bit-identical after a step."""
+    import jax.numpy as jnp
+    import numpy as np
+    from hivemall_tpu.models.word2vec import Word2VecTrainer
+    t = Word2VecTrainer("-dim 16 -neg 2 -mini_batch 4")
+    step = t._make_step(False, vocab_size=1 << 20, dim=16)  # sparse branch
+    V = 64
+    ie = jnp.ones((V, 16))
+    oe = jnp.ones((V, 16)) * 0.5
+    center = jnp.asarray([1, 2, 3, 1])
+    ctx = jnp.asarray([4, 5, 6, 7])
+    negs = jnp.asarray([[8, 9], [10, 11], [12, 13], [14, 15]])
+    ie2, oe2, loss = step(ie, oe, center, ctx, negs, jnp.ones(4), 0.1)
+    assert float(loss) > 0
+    assert not np.allclose(np.asarray(ie2[1]), np.asarray(ie[1]))
+    np.testing.assert_array_equal(np.asarray(ie2[20]), np.asarray(ie[20]))
+    np.testing.assert_array_equal(np.asarray(oe2[30]), np.asarray(oe[30]))
+    assert not np.allclose(np.asarray(oe2[4]), np.asarray(oe[4]))
