@@ -196,17 +196,23 @@ class SparseDataset:
                 nv = len(take)
                 if nv < batch_size and drop_remainder:
                     break
+                # vectorized padding: flat CSR positions of every kept slot
+                # in one fancy index (no per-row Python — the host batch
+                # assembly is on the e2e critical path, SURVEY.md §8)
+                m = np.minimum(lens[take], L)                 # [nv]
+                pos = np.arange(L, dtype=np.int64)[None, :]   # [1, L]
+                keep = pos < m[:, None]                       # [nv, L]
+                flat = np.where(keep, self.indptr[take][:, None] + pos, 0)
                 idx = np.zeros((batch_size, L), np.int32)
                 val = np.zeros((batch_size, L), np.float32)
-                fld = np.zeros((batch_size, L), np.int32) \
-                    if self.fields is not None else None
-                for b, r in enumerate(take):
-                    st = self.indptr[r]
-                    m = min(int(lens[r]), L)
-                    idx[b, :m] = self.indices[st: st + m]
-                    val[b, :m] = self.values[st: st + m]
-                    if fld is not None:
-                        fld[b, :m] = self.fields[st: st + m]
+                if len(self.indices):        # all-empty-rows dataset guard
+                    idx[:nv] = np.where(keep, self.indices[flat], 0)
+                    val[:nv] = np.where(keep, self.values[flat], 0.0)
+                fld = None
+                if self.fields is not None:
+                    fld = np.zeros((batch_size, L), np.int32)
+                    if len(self.fields):
+                        fld[:nv] = np.where(keep, self.fields[flat], 0)
                 lab = np.zeros(batch_size, np.float32)
                 lab[:nv] = self.labels[take]
                 yield SparseBatch(idx, val, lab, fld,

@@ -174,6 +174,25 @@ class LearnerBase:
             self._fit_ds = ds             # emission-time metadata (FFM pairs)
         # elastic recovery (SURVEY.md §6): per-epoch bundle when requested
         ckdir = os.environ.get("HIVEMALL_TPU_CHECKPOINT_DIR")
+        # tracing/profiling (SURVEY.md §6): HIVEMALL_TPU_PROFILE=<dir>
+        # captures a jax.profiler trace of the FIRST fit() in the process —
+        # open with tensorboard/xprof; complements the jsonl metrics stream
+        prof_dir = os.environ.get("HIVEMALL_TPU_PROFILE")
+        tracing = bool(prof_dir) and not getattr(LearnerBase, "_profiled",
+                                                 False)
+        if tracing:
+            import jax
+            LearnerBase._profiled = True
+            jax.profiler.start_trace(prof_dir)
+        try:
+            self._fit_epochs(ds, epochs, bs, shuffle, prefetch, ckdir)
+        finally:
+            if tracing:
+                import jax
+                jax.profiler.stop_trace()
+        return self
+
+    def _fit_epochs(self, ds, epochs, bs, shuffle, prefetch, ckdir) -> None:
         # overlap host batch prep + h2d with compute on accelerators
         # (the prefetcher places on the default device; under -mesh the
         # dispatch path does its own sharded placement instead)
@@ -199,7 +218,6 @@ class LearnerBase:
                 if stream.enabled:
                     stream.emit("checkpoint", trainer=self.NAME,
                                 epoch=ep + 1, path=path)
-        return self
 
     def _wants_fit_ds(self) -> bool:
         """Whether fit() should keep a reference to the training dataset for

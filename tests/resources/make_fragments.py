@@ -19,6 +19,9 @@ Shapes:
                    text-like features per row, balanced labels.
   movielens.frag — (user, item, rating) integer ratings 1..5 from a
                    low-rank + bias model, ML-100k-like margins.
+  criteo_ffm.frag — field:index:value categorical rows whose labels are
+                   dominated by rank-3 field-pair interactions; FFM must
+                   beat a linear model on it by a wide AUC margin.
 """
 
 import os
@@ -82,6 +85,33 @@ def write_libsvm_valued(path, rows, labels):
             f.write(f"{y} " + " ".join(f"{i}:{v:g}" for i, v in r) + "\n")
 
 
+def make_criteo_ffm(n=6000, fields=6, vocab_per_field=12, seed=404):
+    """Criteo-shaped FFM fragment: one categorical per field, labels from
+    field-PAIR interactions (plus weak unary effects) so factorized
+    interaction models separate from linear ones on it."""
+    rng = np.random.default_rng(seed)
+    F = fields
+    # labels driven DOMINANTLY by field-pair interactions (weak unary), so
+    # factorized interaction models separate from linear ones
+    unary = rng.normal(0, 0.15, (F, vocab_per_field))
+    k = 3
+    emb = rng.normal(0, 0.9, (F, vocab_per_field, k))
+    rows = []
+    labels = []
+    for _ in range(n):
+        vals = rng.integers(0, vocab_per_field, F)
+        s = unary[np.arange(F), vals].sum()
+        for a in range(F):
+            for b in range(a + 1, F):
+                s += emb[a, vals[a]] @ emb[b, vals[b]] / np.sqrt(F)
+        p = 1.0 / (1.0 + np.exp(-0.8 * s))
+        labels.append(1 if rng.random() < p else -1)
+        # feature string "field:index:1" with a global per-(field,value) id
+        rows.append([f"{f}:{1 + f * vocab_per_field + int(v)}:1"
+                     for f, v in enumerate(vals)])
+    return rows, labels
+
+
 def make_movielens(n=8000, users=400, items=300, k=6, seed=303):
     rng = np.random.default_rng(seed)
     P = rng.normal(0, 0.45, (users, k))
@@ -113,6 +143,11 @@ def main():
     with open(os.path.join(HERE, "movielens.frag.tsv"), "w") as f:
         for a, b, c in zip(u, i, r):
             f.write(f"{a}\t{b}\t{c}\n")
+
+    rows, labels = make_criteo_ffm()
+    with open(os.path.join(HERE, "criteo_ffm.frag.tsv"), "w") as f:
+        for feats, y in zip(rows, labels):
+            f.write(f"{y}\t" + " ".join(feats) + "\n")
     print("fragments written to", HERE)
 
 

@@ -103,3 +103,47 @@ def test_a9a_loss_decreases_across_epochs(a9a):
         c.fit(tr, epochs=ep)
         losses.append(logloss(te.labels, c.predict_proba(te)))
     assert losses[1] < losses[0]
+
+
+def test_criteo_ffm_fragment_beats_linear():
+    """The FFM fragment's labels are dominated by field-pair interactions:
+    train_ffm (both layouts) must clearly beat train_classifier on AUC —
+    the capability the model family exists for."""
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.models.fm import FFMTrainer
+    from hivemall_tpu.models.linear import GeneralClassifier
+
+    rows, labels = [], []
+    for line in open(os.path.join(RES, "criteo_ffm.frag.tsv")):
+        y, _, feats = line.rstrip().partition("\t")
+        labels.append(float(y))
+        rows.append(feats.split())
+    split = int(len(rows) * 0.8)
+
+    probe = FFMTrainer("-dims 4096 -fields 6")
+    parsed = [probe._parse_row(r) for r in rows]
+    tr = SparseDataset.from_rows([(i, v) for i, v, f in parsed[:split]],
+                                 labels[:split],
+                                 [f for i, v, f in parsed[:split]])
+    te = SparseDataset.from_rows([(i, v) for i, v, f in parsed[split:]],
+                                 labels[split:],
+                                 [f for i, v, f in parsed[split:]])
+    y_te = np.asarray(labels[split:])
+
+    aucs = {}
+    for layout in ("joint", "dense"):
+        f = FFMTrainer("-dims 4096 -factors 4 -fields 6 -mini_batch 64 "
+                       "-classification -opt adagrad -eta0 0.2 -iters 20 "
+                       f"-lambda_v 0 -lambda_w 0 -sigma 0.05 "
+                       f"-ffm_table {layout}")
+        f.fit(tr)
+        aucs[layout] = auc(y_te, f.predict(te))
+
+    lin = GeneralClassifier("-dims 4096 -loss logloss -opt adagrad -reg no "
+                            "-mini_batch 64 -iters 20")
+    lin.fit(tr)
+    lin_auc = auc(y_te, lin.predict_proba(te))
+
+    assert aucs["joint"] > 0.70, aucs
+    assert aucs["dense"] > 0.70, aucs
+    assert min(aucs.values()) > lin_auc + 0.08, (aucs, lin_auc)
