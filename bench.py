@@ -250,6 +250,40 @@ def bench_linear(n_steps: int = 60, warmup: int = 8) -> dict:
             "step_ms": round(best_dt / n_steps * 1e3, 3)}
 
 
+def bench_fm(n_steps: int = 40, warmup: int = 6) -> dict:
+    """train_fm (non-field) sparse-path throughput."""
+    import numpy as np
+    import jax.numpy as jnp
+    from hivemall_tpu.io.sparse import SparseBatch
+    from hivemall_tpu.models.fm import FMTrainer
+
+    B, L, K = 32768, 32, 8
+    dims = 1 << 24
+    t = FMTrainer(f"-dims {dims} -factors {K} -mini_batch {B} "
+                  f"-opt adagrad -classification -halffloat")
+    rng = np.random.default_rng(0)
+    batch = SparseBatch(
+        jnp.asarray(rng.integers(1, dims, (B, L)).astype(np.int32)),
+        jnp.asarray(np.ones((B, L), np.float32)),
+        jnp.asarray((rng.integers(0, 2, B) * 2 - 1).astype(np.float32)))
+    for _ in range(warmup):
+        t._train_batch(batch)
+    _sync(t)
+    best_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n_steps):
+            loss = t._train_batch(batch)
+        _sync(t)
+        float(loss)
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    return {"metric": "train_fm_examples_per_sec",
+            "value": round(B * n_steps / best_dt, 1),
+            "unit": "examples/sec",
+            "step_ms": round(best_dt / n_steps * 1e3, 3)}
+
+
 def bench_mf(n_steps: int = 60, warmup: int = 8) -> dict:
     """BASELINE config #3 shape: train_mf_adagrad on MovieLens-like ids."""
     import numpy as np
@@ -332,7 +366,7 @@ def main():
     configs = []
     primary = None
     for fn in (bench_linear, bench_ffm_kernel, bench_ffm_e2e,
-               bench_ffm_parquet_stream, bench_ingest,
+               bench_ffm_parquet_stream, bench_ingest, bench_fm,
                bench_mf, bench_word2vec, bench_trees):
         try:
             rec = fn()

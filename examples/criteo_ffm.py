@@ -24,13 +24,46 @@ def main():
     ap.add_argument("--rows", type=int, default=20000)
     ap.add_argument("--fields", type=int, default=13)
     ap.add_argument("--factors", type=int, default=4)
+    ap.add_argument("--data", default=None,
+                    help="tsv of 'label\\tfield:idx:val ...' rows, e.g. "
+                         "tests/resources/criteo_ffm.frag.tsv")
     args = ap.parse_args()
 
     from hivemall_tpu.catalog.registry import lookup
-    from hivemall_tpu.frame.evaluation import logloss
+    from hivemall_tpu.frame.evaluation import auc, logloss
 
     ffm_features = lookup("ffm_features").resolve()
     Trainer = lookup("train_ffm").resolve()
+
+    if args.data:
+        rows, labels = [], []
+        for line in open(args.data):
+            yv, _, feats = line.rstrip("\n").partition("\t")
+            labels.append(float(yv))
+            rows.append(feats.split())
+        F = 1 + max(int(f.split(":")[0]) for r in rows for f in r)
+        tr = Trainer(f"-dims 16384 -factors {args.factors} -fields {F} "
+                     f"-opt adagrad -eta0 0.2 -lambda_v 0 -lambda_w 0 "
+                     f"-sigma 0.05 -classification -mini_batch 64 -iters 10")
+        t0 = time.time()
+        for r, lab in zip(rows, labels):
+            tr.process(r, lab)
+        list(tr.close())
+        dt = time.time() - t0
+        from hivemall_tpu.io.sparse import SparseDataset
+        parsed = [tr._parse_row(r) for r in rows]
+        ds = SparseDataset.from_rows([(i, v) for i, v, f in parsed], labels,
+                                     [f for i, v, f in parsed])
+        p = tr.predict(ds)
+        print(json.dumps({
+            "config": "criteo_ffm",
+            "cumulative_logloss": round(tr.cumulative_loss, 5),
+            "train_auc": round(auc(np.asarray(labels), p), 5),
+            "wall_examples_per_sec": round(
+                len(rows) * 10 / max(dt, 1e-9), 1),
+            "synthetic": False,
+        }))
+        return 0
 
     rng = np.random.default_rng(3)
     F = args.fields

@@ -35,3 +35,10 @@ def test_movielens_example_on_fragment():
     rec = _run(["examples/movielens_mf.py",
                 "--data", os.path.join(RES, "movielens.frag.tsv")])
     assert rec["mf_rmse"] < 0.85
+
+
+def test_criteo_ffm_example_on_fragment():
+    rec = _run(["examples/criteo_ffm.py",
+                "--data", os.path.join(RES, "criteo_ffm.frag.tsv")])
+    assert rec["train_auc"] > 0.72
+    assert rec["cumulative_logloss"] < 0.75

@@ -110,3 +110,23 @@ def test_mesh_dp_only_auto():
     sharded = GeneralClassifier(opts + " -mesh auto").fit(ds, epochs=1)
     np.testing.assert_allclose(single._finalized_weights(),
                                sharded._finalized_weights(), atol=1e-4)
+
+
+def test_mesh_with_parquet_stream(tmp_path):
+    """Out-of-core streaming composes with GSPMD sharding: the same
+    ParquetStream batches train a -mesh FFM trainer and match the
+    single-device in-RAM result."""
+    pytest.importorskip("pyarrow")
+    from hivemall_tpu.io.arrow import ParquetStream, write_parquet_shards
+
+    ds = _ffm_ds(seed=11)
+    write_parquet_shards(ds, str(tmp_path / "s"), rows_per_shard=100)
+    opts = "-dims 4096 -factors 4 -fields 8 -mini_batch 64 -opt adagrad " \
+           "-classification"
+    ram = FFMTrainer(opts).fit(ds, epochs=1, shuffle=False)
+    stream = ParquetStream(str(tmp_path / "s"))
+    sharded = FFMTrainer(opts + " -mesh dp=2,tp=4")
+    sharded.fit_stream(stream.batches(64, epochs=1, shuffle=False))
+    # same rows, same shard order when unshuffled with one pass
+    np.testing.assert_allclose(np.asarray(ram.params["T"]),
+                               np.asarray(sharded.params["T"]), atol=1e-3)
