@@ -47,6 +47,9 @@ def _load_input(args, trainer):
         from ..io.arrow import read_csv
         return read_csv(path, label_col=args.label_col,
                         dims=getattr(trainer, "dims", None)), False
+    if ffm:
+        return read_libsvm(path, ffm=True, num_fields=trainer.F,
+                           dims=getattr(trainer, "dims", None)), False
     return read_libsvm(path), False
 
 

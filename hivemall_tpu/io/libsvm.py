@@ -25,23 +25,35 @@ def _open(path: str, mode: str = "rt"):
 
 
 def read_libsvm(path: str, *, zero_based: bool = False,
-                binary_labels: bool = True) -> SparseDataset:
+                binary_labels: bool = True, ffm: bool = False,
+                num_fields: int = 64,
+                dims: Optional[int] = None) -> SparseDataset:
     """Read a LIBSVM file into a SparseDataset.
+
+    With ``ffm=True``, tokens are libffm-style ``field:index:value``
+    triples (the ftvec.trans.ffm_features output format — reference
+    FieldAwareFactorizationMachineUDTF input, SURVEY.md §3.6); the returned
+    dataset carries per-feature field ids. Non-integer field names hash
+    into [0, num_fields) and non-integer feature names into [1, dims-1]
+    (or murmur3 default range without ``dims``) — the same normalization
+    FFMTrainer._parse_row applies on the streaming path.
 
     Labels: by default +1/-1 style labels are kept as floats (trainers decide
     their own label convention); indices are shifted +1 if ``zero_based`` so
     id 0 stays the padding/bias slot.
     """
-    try:
-        from ..utils.native import parse_libsvm_native
-        parsed = parse_libsvm_native(path, zero_based=zero_based)
-        if parsed is not None:
-            return parsed
-    except ImportError:
-        pass
+    if not ffm:
+        try:
+            from ..utils.native import parse_libsvm_native
+            parsed = parse_libsvm_native(path, zero_based=zero_based)
+            if parsed is not None:
+                return parsed
+        except ImportError:
+            pass
     labels = []
     indices = []
     values = []
+    fields = [] if ffm else None
     indptr = [0]
     shift = 1 if zero_based else 0
     with _open(path) as f:
@@ -52,13 +64,33 @@ def read_libsvm(path: str, *, zero_based: bool = False,
             parts = line.split()
             labels.append(float(parts[0]))
             for tok in parts[1:]:
-                i, _, v = tok.partition(":")
-                indices.append(int(i) + shift)
+                if ffm:
+                    fs, _, rest = tok.partition(":")
+                    i, _, v = rest.partition(":")
+                    if not i:
+                        raise ValueError(
+                            f"FFM token needs field:index[:value]: {tok!r}")
+                    try:
+                        fi = int(fs)
+                    except ValueError:
+                        from ..utils.hashing import mhash
+                        fi = mhash(fs, num_fields) - 1
+                    fields.append(fi % num_fields)
+                    try:
+                        ii = int(i) + shift
+                    except ValueError:
+                        from ..utils.hashing import mhash
+                        ii = mhash(i) if dims is None else mhash(i, dims - 1)
+                    indices.append(ii)
+                else:
+                    i, _, v = tok.partition(":")
+                    indices.append(int(i) + shift)
                 values.append(float(v) if v else 1.0)
             indptr.append(len(indices))
     return SparseDataset(
         np.asarray(indices, np.int32), np.asarray(indptr, np.int64),
-        np.asarray(values, np.float32), np.asarray(labels, np.float32))
+        np.asarray(values, np.float32), np.asarray(labels, np.float32),
+        None if fields is None else np.asarray(fields, np.int32))
 
 
 def write_libsvm(ds: SparseDataset, path: str) -> None:

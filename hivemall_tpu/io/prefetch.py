@@ -37,7 +37,7 @@ def stage_batch(b: SparseBatch, device=None) -> SparseBatch:
         else jax.device_put
     return SparseBatch(put(b.idx), put(b.val), put(b.label),
                        None if b.field is None else put(b.field),
-                       b.n_valid)
+                       b.n_valid, fieldmajor=b.fieldmajor)
 
 
 class DevicePrefetcher:

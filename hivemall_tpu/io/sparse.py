@@ -19,8 +19,9 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["SparseBatch", "SparseDataset", "pad_examples",
-           "parse_feature_strings", "split_feature", "pow2_len"]
+__all__ = ["SparseBatch", "SparseDataset", "canonicalize_fieldmajor",
+           "pad_examples", "parse_feature_strings", "split_feature",
+           "pow2_len"]
 
 
 def pow2_len(n: int) -> int:
@@ -45,13 +46,19 @@ def split_feature(f) -> Tuple[str, str]:
 
 @dataclass
 class SparseBatch:
-    """One padded minibatch. ``field`` is present only for FFM-style features."""
+    """One padded minibatch. ``field`` is present only for FFM-style features.
+
+    ``fieldmajor=True`` marks the canonical FFM layout built by
+    :func:`canonicalize_fieldmajor`: slot s holds a feature of field
+    ``s % F`` (so no ``field`` array is needed; the jitted step derives the
+    pattern statically)."""
 
     idx: np.ndarray                  # int32 [B, L], 0 = padding
     val: np.ndarray                  # float32 [B, L]
     label: np.ndarray                # float32 [B]
     field: Optional[np.ndarray] = None  # int32 [B, L], FFM only
     n_valid: Optional[int] = None    # rows < n_valid are real; rest are padding
+    fieldmajor: bool = False         # canonical slot->field layout (FFM)
 
     @property
     def batch_size(self) -> int:
@@ -62,6 +69,56 @@ class SparseBatch:
         b = self.batch_size
         n = b if self.n_valid is None else self.n_valid
         return (np.arange(b) < n).astype(np.float32)
+
+
+def canonicalize_fieldmajor(idx: np.ndarray, val: np.ndarray,
+                            fld: np.ndarray, F: int, *,
+                            max_m: int = 4):
+    """Reorder each row's features into FIELD-MAJOR slots.
+
+    Output slot ``s = rank * F + field`` holds the rank-th feature of that
+    field in the row (FFM is order-invariant, so reordering within a row is
+    free). The jitted FFM step then derives every slot's field statically
+    (``s % F``) — ops.fm._fused_phi_fieldmajor computes the pair
+    interaction with no gather/scatter/matmul at all. Criteo-shaped rows
+    (exactly one feature per field) canonicalize with m = 1, i.e. to a
+    [B, F] batch.
+
+    Fully vectorized (one argsort + cumulative ops — this runs on the e2e
+    input path). Returns ``(idx2, val2, m)`` with arrays [B, m*F] and m a
+    power of two, or ``None`` if some row has more than ``max_m`` features
+    in one field (caller falls back to the general pair path).
+
+    Field ids fold modulo F — the same normalization FFMTrainer._parse_row
+    and every FFM kernel apply, so out-of-range ids keep their features
+    instead of silently vanishing."""
+    B, L = idx.shape
+    live = val != 0
+    fld = fld % F
+    fkey = np.where(live, fld, F)                   # dead slots sort last
+    order = np.argsort(fkey, axis=1, kind="stable")
+    sf = np.take_along_axis(fkey, order, 1)
+    pos = np.arange(L, dtype=np.int64)[None, :]
+    # occurrence rank within each row's run of equal fields
+    first = np.where((sf != np.roll(sf, 1, axis=1)) | (pos == 0), pos, 0)
+    first = np.maximum.accumulate(first, 1)
+    rank = pos - first
+    alive = sf < F
+    if not alive.any():
+        return (np.zeros((B, F), np.int32), np.zeros((B, F), np.float32), 1)
+    m_needed = int(rank[alive].max()) + 1
+    if m_needed > max_m:
+        return None
+    m = pow2_len(m_needed)
+    si = np.take_along_axis(idx, order, 1)
+    sv = np.take_along_axis(val, order, 1)
+    out_idx = np.zeros((B, m * F), np.int32)
+    out_val = np.zeros((B, m * F), np.float32)
+    slot = rank * F + sf                            # block-major: field s % F
+    rowi = np.broadcast_to(np.arange(B)[:, None], (B, L))
+    out_idx[rowi[alive], slot[alive]] = si[alive]
+    out_val[rowi[alive], slot[alive]] = sv[alive]
+    return out_idx, out_val, int(m)
 
 
 def parse_feature_strings(features: Sequence[str],

@@ -200,7 +200,8 @@ class LearnerBase:
             import jax
             prefetch = jax.default_backend() != "cpu" and self.mesh is None
         for ep in range(epochs):
-            it = ds.batches(bs, shuffle=shuffle, seed=42 + ep)
+            it = map(self._preprocess_batch,
+                     ds.batches(bs, shuffle=shuffle, seed=42 + ep))
             if prefetch:
                 from ..io.prefetch import DevicePrefetcher
                 it = DevicePrefetcher(it, depth=2)
@@ -224,6 +225,12 @@ class LearnerBase:
         emission-time metadata. Default no — pinning a Criteo-scale dataset
         on the trainer for its whole lifetime is not free."""
         return False
+
+    def _preprocess_batch(self, batch: SparseBatch) -> SparseBatch:
+        """Host-side per-batch hook, applied BEFORE device staging (so the
+        prefetcher overlaps it with compute). Default identity; FFM's joint
+        layout canonicalizes batches into field-major slots here."""
+        return batch
 
     # -- mesh sharding (SURVEY.md §3.17 / §8 M3) -----------------------------
     def _apply_mesh(self, spec: str) -> None:
@@ -282,7 +289,7 @@ class LearnerBase:
             put(batch.idx, P("dp", None)), put(batch.val, P("dp", None)),
             put(batch.label, P("dp")),
             None if batch.field is None else put(batch.field, P("dp", None)),
-            n_valid=batch.n_valid)
+            n_valid=batch.n_valid, fieldmajor=batch.fieldmajor)
 
     def fit_stream(self, batches: Iterable[SparseBatch], *,
                    convert_labels: bool = True) -> "LearnerBase":
@@ -302,9 +309,10 @@ class LearnerBase:
                 if convert_labels:
                     b = SparseBatch(b.idx, b.val,
                                     self._convert_labels(b.label),
-                                    b.field, n_valid=b.n_valid)
+                                    b.field, n_valid=b.n_valid,
+                                    fieldmajor=b.fieldmajor)
                 self._note_batch(b)
-                yield b
+                yield self._preprocess_batch(b)
 
         it: Iterable[SparseBatch] = host_side()
         prefetch = jax.default_backend() != "cpu" and self.mesh is None
@@ -382,8 +390,8 @@ class LearnerBase:
             val[b, :len(v)] = v
             lab[b] = labels[b]
         nv = len(rows)
-        self._dispatch(SparseBatch(idx, val, lab,
-                                   n_valid=nv if nv < B else None))
+        self._dispatch(self._preprocess_batch(
+            SparseBatch(idx, val, lab, n_valid=nv if nv < B else None)))
 
     def _dispatch(self, batch: SparseBatch) -> None:
         nv = batch.n_valid or batch.batch_size

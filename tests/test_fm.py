@@ -334,3 +334,139 @@ def test_ffm_sparse_padding_pairs_keep_lazy_init_under_ftrl():
     # the real cross pair (5,f2) x (9,f1) was touched (FTRL materializes)
     assert np.abs(V1[5, 2] - V0[5, 2]).sum() > 0
     assert np.abs(V1[9, 1] - V0[9, 1]).sum() > 0
+
+
+# --- field-major canonical layout (ops.fm._fused_phi_fieldmajor) -----------
+
+def test_canonicalize_fieldmajor_invariants():
+    from hivemall_tpu.io.sparse import canonicalize_fieldmajor
+    rng = np.random.default_rng(7)
+    F = 5
+    for _ in range(10):
+        B, L = 4, 11
+        idx = rng.integers(1, 999, (B, L)).astype(np.int32)
+        val = rng.uniform(0.1, 1, (B, L)).astype(np.float32)
+        fld = rng.integers(0, F, (B, L)).astype(np.int32)
+        dead = rng.uniform(size=(B, L)) < 0.4
+        val[dead] = 0
+        idx[dead] = 0
+        res = canonicalize_fieldmajor(idx, val, fld, F, max_m=8)
+        assert res is not None
+        idx2, val2, m = res
+        assert idx2.shape == (B, m * F) and (m & (m - 1)) == 0
+        for b in range(B):
+            orig = sorted((int(i), float(v), int(f)) for i, v, f in
+                          zip(idx[b], val[b], fld[b]) if v != 0)
+            got = sorted((int(idx2[b, s]), float(val2[b, s]), s % F)
+                         for s in range(m * F) if val2[b, s] != 0)
+            assert orig == got          # same (feature, value, field) multiset
+
+
+def test_canonicalize_fieldmajor_overflow_returns_none():
+    from hivemall_tpu.io.sparse import canonicalize_fieldmajor
+    idx = np.ones((2, 6), np.int32)
+    val = np.ones((2, 6), np.float32)
+    fld = np.zeros((2, 6), np.int32)       # six features all in field 0
+    assert canonicalize_fieldmajor(idx, val, fld, 5, max_m=4) is None
+    out = canonicalize_fieldmajor(idx, val, fld, 5, max_m=8)
+    assert out is not None and out[2] == 8  # pow2 bucket of m_needed=6
+
+
+def test_fieldmajor_phi_matches_pairs_phi():
+    import jax.numpy as jnp
+    from hivemall_tpu.io.sparse import canonicalize_fieldmajor
+    from hivemall_tpu.ops.fm import (_fused_phi, _fused_phi_fieldmajor,
+                                     ffm_row_hash)
+    rng = np.random.default_rng(3)
+    F, K, Mr = 5, 3, 1 << 8
+    W = F * K + 2
+    T = rng.normal(0, 1, (Mr, W)).astype(np.float32)
+    for _ in range(5):
+        B, L = 6, 9
+        idx = rng.integers(1, 1000, (B, L)).astype(np.int32)
+        val = rng.uniform(0.1, 1, (B, L)).astype(np.float32)
+        fld = rng.integers(0, F, (B, L)).astype(np.int32)
+        dead = rng.uniform(size=(B, L)) < 0.3
+        val[dead] = 0
+        idx[dead] = 0
+        idx2, val2, m = canonicalize_fieldmajor(idx, val, fld, F, max_m=8)
+        r1 = np.asarray(ffm_row_hash(jnp.asarray(idx), Mr))
+        r2 = np.asarray(ffm_row_hash(jnp.asarray(idx2), Mr))
+        p1 = np.asarray(_fused_phi(0.3, jnp.asarray(T[r1]), jnp.asarray(val),
+                                   jnp.asarray(fld), F, K))
+        p2 = np.asarray(_fused_phi_fieldmajor(
+            0.3, jnp.asarray(T[r2]), jnp.asarray(val2), F, K))
+        # same math, different summation order: f32-noise tolerance
+        np.testing.assert_allclose(p1, p2, rtol=2e-3, atol=2e-2)
+
+
+def test_ffm_fieldmajor_trains_like_pairs():
+    """End-to-end: the canonical-batch step and the general pair step are the
+    same optimization — same data, same seed => near-identical tables."""
+    rows, fields, labels = _xor_dataset(600)
+    ds = SparseDataset.from_rows(rows, labels, fields=fields)
+    opts = ("-dims 64 -factors 4 -fields 4 -classification -opt adagrad "
+            "-eta fixed -eta0 0.1 -mini_batch 64 -iters 4 -sigma 0.3")
+    tp = FFMTrainer(opts + " -ffm_interaction pairs")
+    tf = FFMTrainer(opts + " -ffm_interaction fieldmajor")
+    tp.fit(ds)
+    tf.fit(ds)
+    assert tf._step_fm is not None and tp._step_fm is None
+    Tp = np.asarray(tp.params["T"], np.float32)
+    Tf = np.asarray(tf.params["T"], np.float32)
+    np.testing.assert_allclose(Tp, Tf, rtol=5e-2, atol=5e-3)
+    assert auc(np.asarray(labels), tf.predict(ds)) > 0.95
+
+
+def test_ffm_auto_interaction_skips_sparse_rows():
+    """auto mode must fall back to the pair kernel when rows are sparse
+    relative to the field space (canonical width would inflate > 2x)."""
+    rng = np.random.default_rng(5)
+    rows, fields, labels = [], [], []
+    for _ in range(64):
+        idx = rng.integers(1, 200, 3).astype(np.int32)
+        rows.append((idx, np.ones(3, np.float32)))
+        fields.append(rng.integers(0, 64, 3).astype(np.int32))
+        labels.append(1.0 if rng.uniform() > 0.5 else -1.0)
+    ds = SparseDataset.from_rows(rows, labels, fields=fields)
+    t = FFMTrainer("-dims 256 -factors 2 -fields 64 -classification "
+                   "-opt adagrad -mini_batch 32")
+    b = next(ds.batches(32))
+    out = t._preprocess_batch(t._convert_batch(b) if hasattr(
+        t, "_convert_batch") else b)
+    assert not out.fieldmajor            # 64 fields >> 3-feature rows
+    t.fit(ds)                            # trains through the pair path
+    assert np.isfinite(t.cumulative_loss)
+
+
+def test_out_of_range_fields_fold_consistently():
+    """Field ids >= F fold mod F in BOTH interaction kernels (parse-path
+    normalization) — the fieldmajor and pairs paths must agree on the same
+    data (review r2: fieldmajor silently dropped such features)."""
+    import jax.numpy as jnp
+    from hivemall_tpu.io.sparse import canonicalize_fieldmajor
+    from hivemall_tpu.ops.fm import (_fused_phi, _fused_phi_fieldmajor,
+                                     ffm_row_hash)
+    F, K, Mr = 4, 3, 1 << 8
+    W = F * K + 2
+    rng = np.random.default_rng(11)
+    T = rng.normal(0, 1, (Mr, W)).astype(np.float32)
+    idx = np.asarray([[3, 8, 12, 5]], np.int32)
+    val = np.ones((1, 4), np.float32)
+    fld = np.asarray([[0, 1, 2, 5]], np.int32)       # 5 >= F
+    idx2, val2, m = canonicalize_fieldmajor(idx, val, fld, F)
+    assert (val2 != 0).sum() == 4                    # nothing dropped
+    r1 = np.asarray(ffm_row_hash(jnp.asarray(idx), Mr))
+    r2 = np.asarray(ffm_row_hash(jnp.asarray(idx2), Mr))
+    p1 = np.asarray(_fused_phi(0.0, jnp.asarray(T[r1]), jnp.asarray(val),
+                               jnp.asarray(fld), F, K))
+    p2 = np.asarray(_fused_phi_fieldmajor(
+        0.0, jnp.asarray(T[r2]), jnp.asarray(val2), F, K))
+    np.testing.assert_allclose(p1, p2, rtol=1e-4, atol=1e-4)
+
+
+def test_ffm_interaction_option_validated_any_layout():
+    with pytest.raises(ValueError):
+        FFMTrainer("-dims 1000 -fields 4 -ffm_interaction fieldmajro")
+    with pytest.raises(ValueError):                 # dense layout, forced fm
+        FFMTrainer("-dims 1000 -fields 4 -ffm_interaction fieldmajor")

@@ -85,3 +85,22 @@ def test_synthetic_separable():
     ds, w = synthetic_classification(200, 30, seed=3)
     assert len(ds) == 200
     assert set(np.unique(ds.labels)) <= {-1.0, 1.0}
+
+
+def test_read_libsvm_ffm_triples(tmp_path):
+    """libffm-style field:index:value ingest (ffm_features output format)."""
+    from hivemall_tpu.io.libsvm import read_libsvm
+    p = tmp_path / "ffm.libsvm"
+    p.write_text("1 0:3:1 1:7:0.5\n-1 cat:5:2 1:9\n")
+    ds = read_libsvm(str(p), ffm=True, num_fields=4)
+    assert ds.fields is not None
+    assert list(ds.indices) == [3, 7, 5, 9]
+    assert list(ds.values) == [1.0, 0.5, 2.0, 1.0]
+    assert list(ds.fields[:2]) == [0, 1]
+    assert 0 <= int(ds.fields[2]) < 4        # hashed string field name
+    assert int(ds.fields[3]) == 1
+    import pytest
+    bad = tmp_path / "bad.libsvm"
+    bad.write_text("1 justindex\n")
+    with pytest.raises(ValueError):
+        read_libsvm(str(bad), ffm=True, num_fields=4)
