@@ -21,9 +21,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.sparse import SparseBatch, SparseDataset, canonicalize_fieldmajor
-from ..ops.fm import (ffm_row_hash, ffm_score, fm_score,
+from ..ops.fm import (ffm_row_hash, ffm_score, fm_pack_geometry, fm_score,
                       make_ffm_score_fused, make_ffm_step, make_ffm_step_fused,
-                      make_fm_step)
+                      make_fm_score_fused, make_fm_step, make_fm_step_fused)
 from ..ops.losses import get_loss
 from ..ops.optimizers import make_optimizer
 from ..utils.hashing import mhash
@@ -48,6 +48,11 @@ def _factor_spec(name: str, default_factors: int, default_opt: str
     s.add("min_target", type=float, default=None, help="clip regression target")
     s.add("max_target", type=float, default=None, help="clip regression target")
     s.add("seed", type=int, default=42, help="init seed")
+    s.add("fm_table", default="auto",
+          help="train_fm table layout: fused (one [N, K+pad] row per "
+               "feature holding V and w — half the gather/scatter index "
+               "ops, see docs/PERFORMANCE.md) | split (separate w/V) | "
+               "auto (fused when the optimizer has a sparse form)")
     for o in s.options:
         if o.name == "opt":
             o.default = default_opt
@@ -77,16 +82,52 @@ class FMTrainer(LearnerBase):
         self.k = int(o.factors)
         dtype = jnp.bfloat16 if o.halffloat else jnp.float32
         key = jax.random.PRNGKey(int(o.seed))
-        self.params = {
-            "w0": jnp.zeros((), dtype),
-            "w": jnp.zeros(self.dims, dtype),
-            "V": (jax.random.normal(key, (self.dims, self.k)) *
-                  float(o.sigma)).astype(dtype),
-        }
-        self.opt_state = {k: self.optimizer.init(v.shape)
-                          for k, v in self.params.items()}
-        self._step = make_fm_step(self.loss, self.optimizer,
-                                  (o.lambda0, o.lambda_w, o.lambda_v))
+        self.fm_layout = str(getattr(o, "fm_table", "auto"))
+        if self.fm_layout not in ("fused", "split", "auto"):
+            raise ValueError(f"-fm_table must be fused|split|auto, "
+                             f"got {self.fm_layout!r}")
+        # fused needs zero-grad sparse updates to be exact no-ops on the
+        # sibling features packed into the same 128-lane row; FTRL/RDA
+        # re-materialize every scattered element (they'd wipe siblings'
+        # lazy init), so only the elementwise .add families qualify
+        fusable = self.optimizer.name in ("sgd", "adagrad")
+        if self.fm_layout == "auto":
+            self.fm_layout = "fused" if fusable else "split"
+        if self.fm_layout == "fused" and not fusable:
+            raise ValueError(f"-fm_table fused needs -opt sgd|adagrad "
+                             f"(-opt {self.optimizer.name} re-materializes "
+                             f"packed sibling rows); use -fm_table split")
+        if self.fm_layout == "fused":
+            # packed fused rows: [V(K) | w | pad] x P features per 128-lane
+            # physical row — one gather + one sparse update per step
+            # instead of two tables' worth of narrow-row chains
+            self.W, self.P = fm_pack_geometry(self.k)
+            self.Np = -(-self.dims // self.P)
+            Tinit = jnp.concatenate([
+                jax.random.normal(key, (self.Np * self.P, self.k)) *
+                float(o.sigma),
+                jnp.zeros((self.Np * self.P, self.W - self.k)),
+            ], axis=1).astype(dtype).reshape(self.Np, self.P * self.W)
+            self.params = {"w0": jnp.zeros((), dtype), "T": Tinit}
+            self.opt_state = {
+                "w0": self.optimizer.init(()),
+                "T": self.optimizer.init((self.Np, self.P * self.W))}
+            self._step = make_fm_step_fused(
+                self.loss, self.optimizer,
+                (o.lambda0, o.lambda_w, o.lambda_v), self.k)
+            self._fused_score = make_fm_score_fused(self.k)
+            self._tp_sizes.add(self.Np)    # mesh: shard packed rows over tp
+        else:
+            self.params = {
+                "w0": jnp.zeros((), dtype),
+                "w": jnp.zeros(self.dims, dtype),
+                "V": (jax.random.normal(key, (self.dims, self.k)) *
+                      float(o.sigma)).astype(dtype),
+            }
+            self.opt_state = {k: self.optimizer.init(v.shape)
+                              for k, v in self.params.items()}
+            self._step = make_fm_step(self.loss, self.optimizer,
+                                      (o.lambda0, o.lambda_w, o.lambda_v))
 
     def _convert_label(self, label: float) -> float:
         if self.classification:
@@ -120,6 +161,10 @@ class FMTrainer(LearnerBase):
     # -- scoring -------------------------------------------------------------
     def _score_batch(self, batch: SparseBatch) -> np.ndarray:
         p = self.params
+        if getattr(self, "fm_layout", "split") == "fused":
+            return np.asarray(self._fused_score(
+                p["w0"], p["T"], jnp.asarray(batch.idx),
+                jnp.asarray(batch.val)))
         return np.asarray(fm_score(p["w0"], p["w"], p["V"],
                                    batch.idx, batch.val))
 
@@ -137,10 +182,23 @@ class FMTrainer(LearnerBase):
             return 1.0 / (1.0 + np.exp(-phi))
         return phi
 
+    def _fused_rows(self):
+        """Per-feature [>=dims, Wf] view of the packed fused table (device).
+        Row i = feature i's [V(K) | w | pad] block — the [Np, P*Wf]
+        physical layout unpacks with one reshape."""
+        return self.params["T"].reshape(self.Np * self.P, self.W)
+
+    def _wv_tables(self):
+        """(w [N], V [N, K]) float32 views for emission, either layout."""
+        if getattr(self, "fm_layout", "split") == "fused":
+            R = np.asarray(self._fused_rows().astype(jnp.float32))
+            return R[:self.dims, self.k], R[:self.dims, :self.k]
+        return (np.asarray(self.params["w"].astype(jnp.float32)),
+                np.asarray(self.params["V"].astype(jnp.float32)))
+
     # -- model emission: (feature, Wi, Vi[]) rows ---------------------------
     def model_rows(self):
-        w = np.asarray(self.params["w"].astype(jnp.float32))
-        V = np.asarray(self.params["V"].astype(jnp.float32))
+        w, V = self._wv_tables()
         touched = np.nonzero((np.abs(V).sum(-1) > 0) | (w != 0))[0]
         yield ("0", float(np.asarray(self.params["w0"])), None)
         for i in touched:
@@ -165,13 +223,41 @@ class FMTrainer(LearnerBase):
                     f"-loadmodel {path}: saved {k!r} has shape "
                     f"{tuple(z[k].shape)}, trainer expects "
                     f"{tuple(self.params[k].shape)} — options mismatch "
-                    f"(-dims/-factors/-fields/-ffm_table)?")
+                    f"(-dims/-factors/-fields/-fm_table/-ffm_table)?")
             self.params[k] = jnp.asarray(z[k], self.params[k].dtype)
 
+    # -- sparse weight access for the mix client (fused layout: w is col k) --
+    def _weight_table(self):
+        if getattr(self, "fm_layout", "split") == "fused":
+            return None                # w lives inside T; use overrides
+        return super()._weight_table()
+
+    def _get_weights_at(self, keys: np.ndarray) -> np.ndarray:
+        if getattr(self, "fm_layout", "split") != "fused":
+            return super()._get_weights_at(keys)
+        rr = jnp.asarray(np.asarray(keys))
+        return np.asarray(self._fused_rows()[rr, self.k], np.float32)
+
+    def _set_weights_at(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        if getattr(self, "fm_layout", "split") != "fused":
+            return super()._set_weights_at(keys, vals)
+        R = self._fused_rows()
+        rr = jnp.asarray(np.asarray(keys))
+        R = R.at[rr, self.k].set(jnp.asarray(vals, R.dtype))
+        self.params["T"] = R.reshape(self.Np, self.P * self.W)
+
     def _finalized_weights(self) -> np.ndarray:
+        if getattr(self, "fm_layout", "split") == "fused":
+            return np.asarray(
+                self._fused_rows()[:self.dims, self.k].astype(jnp.float32))
         return np.asarray(self.params["w"].astype(jnp.float32))
 
     def _load_weights(self, w: np.ndarray) -> None:
+        if getattr(self, "fm_layout", "split") == "fused":
+            R = self._fused_rows()
+            R = R.at[:self.dims, self.k].set(jnp.asarray(w, R.dtype))
+            self.params["T"] = R.reshape(self.Np, self.P * self.W)
+            return
         self.params["w"] = jnp.asarray(w, self.params["w"].dtype)
 
 

@@ -28,7 +28,8 @@ from .optimizers import Optimizer
 
 __all__ = ["fm_score", "ffm_score", "make_fm_step", "make_ffm_step",
            "ffm_joint_slot", "ffm_row_hash", "make_ffm_step_fused",
-           "make_ffm_score_fused"]
+           "make_ffm_score_fused", "make_fm_step_fused",
+           "make_fm_score_fused", "fm_pack_geometry"]
 
 # odd 32-bit mixing constants (golden-ratio / murmur finalizer family)
 _J1, _J2, _J3 = 0x9E3779B1, 0x85EBCA6B, 0xC2B2AE35
@@ -397,6 +398,104 @@ def make_ffm_step_fused(loss: Loss, optimizer: Optimizer,
         def step(params, opt_state, t, idx, val, label, row_mask, field):
             return body(params, opt_state, t, idx, val, label, row_mask,
                         field)
+    return step
+
+
+def fm_pack_geometry(K: int) -> Tuple[int, int]:
+    """(Wf, P) for the packed fused FM table: Wf = per-feature row width
+    (V's K columns + the linear weight, padded to an 8-multiple), P =
+    features packed per physical table row, chosen as the power of two
+    that makes P*Wf >= 128 — TPU gather/scatter of rows NARROWER than the
+    128-lane vreg degrades to element granularity (measured: scatter-add
+    of 1M rows into [16M, 16] = 137 ms vs [2M, 128] = 36 ms)."""
+    Wf = -(-(K + 1) // 8) * 8
+    P = 1
+    while P * Wf < 128:
+        P <<= 1
+    return Wf, P
+
+
+def _fm_unpack(slab128, sub, Wf: int, P: int):
+    """Select each slot's [Wf] block out of its packed [P*Wf] row (VPU
+    select over the small static P axis, not a gather)."""
+    B, L = sub.shape
+    blocks = slab128.reshape(B, L, P, Wf)
+    return jnp.take_along_axis(blocks, sub[..., None, None],
+                               axis=2)[:, :, 0, :]
+
+
+def make_fm_score_fused(K: int):
+    """Jitted FM scorer over the packed fused table T [ceil(N/P), P*Wf]:
+    feature i lives in row i // P, column block (i % P) * Wf; inside the
+    block, columns [:K] are the latent vector and column K the linear
+    weight."""
+    Wf, P = fm_pack_geometry(K)
+
+    @jax.jit
+    def score(w0, T, idx, val):
+        slab = _fm_unpack(T[idx // P], idx % P, Wf, P).astype(jnp.float32)
+        return _fm_slab_phi(w0.astype(jnp.float32), slab[..., K],
+                            slab[..., :K], val)
+    return score
+
+
+def make_fm_step_fused(loss: Loss, optimizer: Optimizer,
+                       lambdas: Tuple[float, float, float],
+                       K: int) -> Callable:
+    """train_fm step over the packed fused table — w and V share rows, and
+    P features share one 128-lane-wide physical row.
+
+    Rationale (same cost model as the FFM fused layout): on TPU the sparse
+    step is bound by gather/scatter INDEX-ops, and rows narrower than the
+    128-lane vreg pay ~4-5x per index (see fm_pack_geometry). The split
+    w/V layout spends 8 narrow-row chains per slot; this layout does ONE
+    gather + one 3-op sparse-optimizer chain on 128-lane rows. The
+    gradient of a slot expands to its [P*Wf] row via a one-hot mask —
+    sibling features in the row receive exact zeros, so the optimizer's
+    elementwise sparse update leaves them untouched (requires reg='no' on
+    the optimizer, which factor trainers always use: -lambda* L2 is
+    applied per-occurrence at slab level here instead). Duplicate-id
+    accumulation inside the batch is handled by the scatter-add in
+    sparse_update exactly as before."""
+    lam0, lam_w, lam_v = lambdas
+    assert optimizer.sparse_update is not None
+    Wf, P = fm_pack_geometry(K)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, t, idx, val, label, row_mask):
+        T, w0 = params["T"], params["w0"]
+        rows, sub = idx // P, idx % P
+        slab128 = T[rows]                            # ONE 128-lane gather
+        slab = _fm_unpack(slab128, sub, Wf, P)
+
+        def batch_loss(w0f, slabf):
+            s32 = slabf.astype(jnp.float32)
+            phi = _fm_slab_phi(w0f, s32[..., K], s32[..., :K], val)
+            return (loss.loss(phi, label) * row_mask).sum()
+
+        loss_sum, (g0, gslab) = jax.value_and_grad(
+            batch_loss, argnums=(0, 1))(w0.astype(jnp.float32), slab)
+        gslab = gslab.astype(jnp.float32)
+
+        # per-occurrence L2 on present entries (reference -lambda* semantics)
+        pm = (val != 0).astype(jnp.float32) * row_mask[:, None]
+        lam_col = jnp.concatenate([
+            jnp.full((K,), lam_v, jnp.float32),
+            jnp.full((Wf - K,), lam_w, jnp.float32)])
+        gslab = gslab + lam_col * slab.astype(jnp.float32) * pm[..., None]
+        g0 = g0 + lam0 * w0.astype(jnp.float32)
+
+        # expand each slot's [Wf] grad into its packed row: one-hot over P
+        oh = jax.nn.one_hot(sub, P, dtype=jnp.float32)       # [B, L, P]
+        g128 = (oh[..., None] * gslab[..., None, :]).reshape(
+            *idx.shape, P * Wf)
+        Tn, sT = optimizer.sparse_update(
+            T, g128.reshape(-1, P * Wf), opt_state["T"], rows.ravel(), t)
+        w0n, s0 = optimizer.update(w0.astype(jnp.float32), g0,
+                                   opt_state["w0"], t)
+        return ({"T": Tn, "w0": w0n.astype(w0.dtype)},
+                {"T": sT, "w0": s0}, loss_sum)
+
     return step
 
 

@@ -75,8 +75,8 @@ def test_fm_bundle_roundtrip(tmp_path):
     tr.save_bundle(str(p))
     fresh = FMTrainer("-factors 4 -mini_batch 8 -dims 2048 -classification")
     fresh.load_bundle(str(p))
-    np.testing.assert_allclose(np.asarray(fresh.params["V"], np.float32),
-                               np.asarray(tr.params["V"], np.float32))
+    np.testing.assert_allclose(np.asarray(fresh.params["T"], np.float32),
+                               np.asarray(tr.params["T"], np.float32))
 
 
 def test_rda_resume_keeps_dual_accumulators(tmp_path):

@@ -470,3 +470,31 @@ def test_ffm_interaction_option_validated_any_layout():
         FFMTrainer("-dims 1000 -fields 4 -ffm_interaction fieldmajro")
     with pytest.raises(ValueError):                 # dense layout, forced fm
         FFMTrainer("-dims 1000 -fields 4 -ffm_interaction fieldmajor")
+
+
+def test_fm_fused_layout_matches_split():
+    """-fm_table fused (one [N,K+pad] row: V|w) is the same optimization as
+    the split w/V layout — same data, same seed => matching tables."""
+    rows, _, labels = _xor_dataset(600)
+    ds = SparseDataset.from_rows(rows, labels)
+    opts = ("-dims 64 -factors 4 -classification -opt adagrad -eta fixed "
+            "-eta0 0.1 -mini_batch 64 -iters 4 -sigma 0.3")
+    tf = FMTrainer(opts + " -fm_table fused")
+    tsp = FMTrainer(opts + " -fm_table split")
+    tf.fit(ds)
+    tsp.fit(ds)
+    assert tf.fm_layout == "fused" and tsp.fm_layout == "split"
+    wf, Vf = tf._wv_tables()
+    ws, Vs = tsp._wv_tables()
+    np.testing.assert_allclose(Vf, Vs, rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(wf, ws, rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(tf.predict(ds), tsp.predict(ds),
+                               rtol=2e-2, atol=2e-3)
+    assert auc(np.asarray(labels), tf.predict(ds)) > 0.95
+
+
+def test_fm_fused_rejects_dense_only_optimizer():
+    with pytest.raises(ValueError):
+        FMTrainer("-dims 64 -opt adam -fm_table fused")
+    t = FMTrainer("-dims 64 -opt adam")          # auto falls back to split
+    assert t.fm_layout == "split"
