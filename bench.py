@@ -75,7 +75,8 @@ def bench_ffm_kernel(n_steps: int = 30, warmup: int = 5) -> dict:
     # field-major layout (host work, overlapped by the prefetcher in fit();
     # the kernel bench does it once outside the timed loop)
     hb = t._preprocess_batch(SparseBatch(idx, val, lab, fld))
-    batch = SparseBatch(jnp.asarray(hb.idx), jnp.asarray(hb.val),
+    batch = SparseBatch(jnp.asarray(hb.idx),
+                        None if hb.val is None else jnp.asarray(hb.val),
                         jnp.asarray(hb.label), None,
                         fieldmajor=hb.fieldmajor)
     assert batch.fieldmajor
@@ -144,9 +145,11 @@ def _criteo_synth(n_rows: int, seed: int):
     t = FFMTrainer(f"-dims {dims} -factors {K} -fields {F} -mini_batch {B} "
                    f"-opt adagrad -classification -halffloat")
     # warm the jitted step OUTSIDE the timed region (compile time is not
-    # the input path these benches characterize)
+    # the input path these benches characterize) — through the SAME
+    # preprocess path fit() takes, so the canonical/unit-val variant that
+    # actually runs is the one compiled
     for wb in ds.batches(B, shuffle=False):
-        t._dispatch(wb)
+        t._dispatch(t._preprocess_batch(wb))
         break
     _sync(t)
     return ds, t, B, L

@@ -51,10 +51,16 @@ class SparseBatch:
     ``fieldmajor=True`` marks the canonical FFM layout built by
     :func:`canonicalize_fieldmajor`: slot s holds a feature of field
     ``s % F`` (so no ``field`` array is needed; the jitted step derives the
-    pattern statically)."""
+    pattern statically).
+
+    ``val=None`` is UNIT-VALUE ELISION: every present feature has value
+    1.0, i.e. val == (idx != 0) exactly (categorical/CTR data — the Criteo
+    case). Consumers that support it rebuild val from idx inside the
+    jitted step; the h2d transfer of the val array (a third of batch
+    bytes) is skipped entirely."""
 
     idx: np.ndarray                  # int32 [B, L], 0 = padding
-    val: np.ndarray                  # float32 [B, L]
+    val: Optional[np.ndarray]        # float32 [B, L]; None = unit values
     label: np.ndarray                # float32 [B]
     field: Optional[np.ndarray] = None  # int32 [B, L], FFM only
     n_valid: Optional[int] = None    # rows < n_valid are real; rest are padding

@@ -277,7 +277,9 @@ class LearnerBase:
         self._restore_arrays(tree)
 
     def _shard_batch(self, batch: SparseBatch) -> SparseBatch:
-        """Place one padded batch on the mesh: rows sharded over 'dp'."""
+        """Place one padded batch on the mesh: rows sharded over 'dp'.
+        val=None (unit-value elision) skips that transfer; the jitted
+        unit-val step rebuilds val from idx under the same sharding."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -286,7 +288,8 @@ class LearnerBase:
             return jax.device_put(jnp.asarray(a), NamedSharding(self.mesh,
                                                                 spec))
         return SparseBatch(
-            put(batch.idx, P("dp", None)), put(batch.val, P("dp", None)),
+            put(batch.idx, P("dp", None)),
+            None if batch.val is None else put(batch.val, P("dp", None)),
             put(batch.label, P("dp")),
             None if batch.field is None else put(batch.field, P("dp", None)),
             n_valid=batch.n_valid, fieldmajor=batch.fieldmajor)

@@ -350,6 +350,11 @@ class FFMTrainer(FMTrainer):
                     self.loss, self.optimizer,
                     (o.lambda0, o.lambda_w, o.lambda_v), self.F, self.k,
                     fieldmajor=True)
+            self._step_fm_unit = None if self.interaction == "pairs" else \
+                make_ffm_step_fused(
+                    self.loss, self.optimizer,
+                    (o.lambda0, o.lambda_w, o.lambda_v), self.F, self.k,
+                    fieldmajor=True, unit_val=True)
             self._fused_score = make_ffm_score_fused(self.F, self.k)
             self._tp_sizes.add(self.Mr)     # mesh: shard T rows over tp
         else:
@@ -368,6 +373,7 @@ class FFMTrainer(FMTrainer):
             self._step = make_ffm_step(self.loss, self.optimizer,
                                        (o.lambda0, o.lambda_w, o.lambda_v))
             self._step_fm = None
+            self._step_fm_unit = None
             self.interaction = "pairs"
         self._pairs: set = set()       # (feature_id, field) seen, stream path
         self._fit_ds = None            # dataset ref, columnar path
@@ -403,14 +409,23 @@ class FFMTrainer(FMTrainer):
                     "features in one field; use -ffm_interaction auto")
             return batch
         idx2, val2, _ = res
+        if np.array_equal(val2, (idx2 != 0).astype(np.float32)):
+            # unit-value elision: skip the val array entirely (a third of
+            # the h2d bytes; the step rebuilds it from idx on device)
+            val2 = None
         return SparseBatch(idx2, val2, batch.label, None,
                            n_valid=batch.n_valid, fieldmajor=True)
 
     def _train_batch(self, batch: SparseBatch) -> float:
         if batch.fieldmajor and self._step_fm is not None:
-            self.params, self.opt_state, loss_sum = self._step_fm(
-                self.params, self.opt_state, float(self._t), batch.idx,
-                batch.val, batch.label, batch.row_mask)
+            if batch.val is None:
+                self.params, self.opt_state, loss_sum = self._step_fm_unit(
+                    self.params, self.opt_state, float(self._t), batch.idx,
+                    batch.label, batch.row_mask)
+            else:
+                self.params, self.opt_state, loss_sum = self._step_fm(
+                    self.params, self.opt_state, float(self._t), batch.idx,
+                    batch.val, batch.label, batch.row_mask)
             return loss_sum
         return super()._train_batch(batch)
 
