@@ -343,8 +343,13 @@ def bench_word2vec() -> dict:
     # zipf-ish token stream so the unigram table/subsampling do real work
     toks = (rng.zipf(1.3, n_tokens) % vocab).astype(np.int32)
     words = [f"w{t}" for t in toks]
-    t = Word2VecTrainer("-dim 100 -window 5 -neg 5 -min_count 5 "
-                        "-mini_batch 16384 -sample 1e-4")
+    opts = ("-dim 100 -window 5 -neg 5 -min_count 5 "
+            "-mini_batch 16384 -sample 1e-4")
+    # warm the XLA compile cache with the same step shapes (B/neg/dim)
+    # outside the timed region — one-off compilation is not the
+    # steady-state throughput this bench characterizes
+    Word2VecTrainer(opts).train([words[:60_000]])
+    t = Word2VecTrainer(opts)
     t0 = time.perf_counter()
     t.train([words])
     import jax

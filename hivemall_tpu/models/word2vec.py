@@ -22,6 +22,7 @@ not input-bound.
 from __future__ import annotations
 
 from collections import Counter
+from functools import partial
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -106,14 +107,23 @@ class Word2VecTrainer:
         #            enwiki scale (V ~ 1M) the dense variant would move
         #            100s of MB of table per step for a few thousand
         #            touched rows.
+        # Both variants draw NEGATIVES ON DEVICE from the staged unigram^.75
+        # table (word2vec.c's table sampling, jax PRNG keyed by the step
+        # counter) and rebuild the pair mask from the valid-count scalar:
+        # per-step h2d drops from 4 arrays (~520 KB at B=16k) to the two
+        # id arrays — the dispatch link is the e2e bottleneck here.
         if vocab_size * dim <= (1 << 23):
             return self._make_step_dense(cbow)
 
-        @jax.jit
-        def step(in_emb, out_emb, center, context, negs, row_mask, lr):
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(in_emb, out_emb, ntab, center, context, nvalid, t, lr):
             # SkipGram: v_in = in[center]; target = context
             # CBOW: v_in = mean(in[context window]) handled by caller passing
             #       the window in `center` as [B, 2w] with -1 padding
+            B = context.shape[0]
+            key = jax.random.fold_in(jax.random.PRNGKey(77), t)
+            negs = ntab[jax.random.randint(key, (B, neg), 0, ntab.shape[0])]
+            row_mask = (jnp.arange(B) < nvalid).astype(jnp.float32)
             if cbow:
                 cmask = (center >= 0).astype(jnp.float32)
                 cids = jnp.maximum(center, 0)
@@ -153,8 +163,15 @@ class Word2VecTrainer:
         return step
 
     def _make_step_dense(self, cbow: bool):
-        @jax.jit
-        def step(in_emb, out_emb, center, context, negs, row_mask, lr):
+        neg = int(self.opts.neg)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(in_emb, out_emb, ntab, center, context, nvalid, t, lr):
+            B = context.shape[0]
+            key = jax.random.fold_in(jax.random.PRNGKey(77), t)
+            negs = ntab[jax.random.randint(key, (B, neg), 0, ntab.shape[0])]
+            row_mask = (jnp.arange(B) < nvalid).astype(jnp.float32)
+
             def batch_loss(tables):
                 ie, oe = tables
                 if cbow:
@@ -234,7 +251,7 @@ class Word2VecTrainer:
         key = jax.random.PRNGKey(int(o.seed))
         self.in_emb = (jax.random.uniform(key, (V, D)) - 0.5) / D
         self.out_emb = jnp.zeros((V, D))
-        table = self._neg_table(freqs)
+        table = jnp.asarray(self._neg_table(freqs))   # staged on device once
         ids_docs =[np.asarray([self.vocab[w] for w in d if w in self.vocab],
                                np.int32) for d in docs]
         total = sum(len(d) for d in ids_docs)
@@ -259,8 +276,13 @@ class Word2VecTrainer:
         pend_x: List[np.ndarray] = []
         pending = 0
 
+        nstep = 0
+
         def dispatch(c: np.ndarray, x: np.ndarray, progress: float) -> None:
-            """One fixed-shape [B] (or [B, 2w]) step; short batches pad."""
+            """One fixed-shape [B] (or [B, 2w]) step; short batches pad.
+            Only the two id arrays cross host->device; negatives and the
+            pair mask are built on device (see _make_step)."""
+            nonlocal nstep
             nb = len(x)
             if nb == 0:
                 return
@@ -270,13 +292,11 @@ class Word2VecTrainer:
                     [c, np.full((pad,) + c.shape[1:],
                                 -1 if cbow else 0, np.int32)])
                 x = np.concatenate([x, np.zeros(pad, np.int32)])
-            rm = np.zeros(B, np.float32)
-            rm[:nb] = 1.0
-            negs = table[rng.integers(0, len(table), (B, neg))]
             lr = max(alpha * (1.0 - progress), alpha * 1e-4)
+            nstep += 1
             self.in_emb, self.out_emb, _ = step(
-                self.in_emb, self.out_emb, jnp.asarray(c), jnp.asarray(x),
-                jnp.asarray(negs), jnp.asarray(rm), lr)
+                self.in_emb, self.out_emb, table, jnp.asarray(c),
+                jnp.asarray(x), nb, nstep, lr)
 
         def drain(progress: float, final: bool = False) -> None:
             nonlocal pend_c, pend_x, pending

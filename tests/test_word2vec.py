@@ -115,8 +115,10 @@ def test_sparse_step_selected_for_large_vocab_updates_touched_only():
     oe = jnp.ones((V, 16)) * 0.5
     center = jnp.asarray([1, 2, 3, 1])
     ctx = jnp.asarray([4, 5, 6, 7])
-    negs = jnp.asarray([[8, 9], [10, 11], [12, 13], [14, 15]])
-    ie2, oe2, loss = step(ie, oe, center, ctx, negs, jnp.ones(4), 0.1)
+    ntab = jnp.asarray([8, 9, 10, 11, 12, 13, 14, 15])  # negatives pool
+    ie0, oe0 = np.asarray(ie), np.asarray(oe)   # donation invalidates ie/oe
+    ie2, oe2, loss = step(ie, oe, ntab, center, ctx, 4, 1, 0.1)
+    ie, oe = ie0, oe0
     assert float(loss) > 0
     assert not np.allclose(np.asarray(ie2[1]), np.asarray(ie[1]))
     np.testing.assert_array_equal(np.asarray(ie2[20]), np.asarray(ie[20]))
