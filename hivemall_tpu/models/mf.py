@@ -78,7 +78,11 @@ class MFTrainer:
         self._t = 0
         self._buf: List[Tuple[int, int, float]] = []
         self._all: List[Tuple[int, int, float]] = []
-        self.cum_loss = 0.0
+        # device-side loss accumulation: fetching the loss value every step
+        # would put one host round-trip on each dispatch (the step itself is
+        # async); fold into the host float sparingly instead
+        self._loss_pending = jnp.zeros(())
+        self._loss_host = 0.0
         self.n_seen = 0
 
     def _make_step(self):
@@ -144,7 +148,8 @@ class MFTrainer:
         return {"cum_loss": self.cum_loss, "n_seen": self.n_seen}
 
     def _restore_scalars(self, scalars) -> None:
-        self.cum_loss = float(scalars["cum_loss"])
+        self._loss_host = float(scalars["cum_loss"])
+        self._loss_pending = jnp.zeros(())
         self.n_seen = int(scalars["n_seen"])
 
     def save_bundle(self, path: str) -> None:
@@ -179,8 +184,19 @@ class MFTrainer:
         self.params, self.gg, loss = self._step(
             self.params, self.gg, float(self._t), u, i, r, m)
         self._t += 1
-        self.cum_loss += float(loss)
+        self._loss_pending = self._loss_pending + loss
+        if self._t % 256 == 0:
+            self._fold_loss()
         self.n_seen += n
+
+    def _fold_loss(self) -> None:
+        self._loss_host += float(self._loss_pending)
+        self._loss_pending = jnp.zeros(())
+
+    @property
+    def cum_loss(self) -> float:
+        self._fold_loss()
+        return self._loss_host
 
     def close(self) -> Iterator[Tuple]:
         self._flush()
@@ -295,7 +311,9 @@ class BPRMFTrainer(MFTrainer):
         self.params, self.gg, loss = self._step(
             self.params, self.gg, float(self._t), u, i, j, m)
         self._t += 1
-        self.cum_loss += float(loss)
+        self._loss_pending = self._loss_pending + loss
+        if self._t % 256 == 0:
+            self._fold_loss()
         self.n_seen += n
 
     def predict(self, users, items) -> np.ndarray:
