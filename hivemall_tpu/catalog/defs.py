@@ -430,7 +430,8 @@ def _mf(name, cls_path, ref, desc):
     mod, _, attr = cls_path.partition(":")
     cls = getattr(import_module(mod), attr)
     register(name, "UDTF", cls_path, description=desc, reference=ref,
-             options=cls.spec())
+             options=cls.spec(),
+             aliases=["train_mf"] if name == "train_mf_sgd" else None)
 
 
 _mf("train_mf_sgd", "hivemall_tpu.models.mf:MFTrainer",

@@ -124,6 +124,20 @@ def define_all_spark() -> str:
     return "\n".join(lines)
 
 
+def define_all_pig() -> str:
+    """The Pig define-script analog (SURVEY.md §3.18 row 2: resources/
+    define scripts registering UDFs for Pig). Rendered from the same
+    registry as the Hive/Spark/TD surfaces, so the dialects cannot
+    drift."""
+    lines = ["-- Pig registration (define-all.pig analog); pair with a",
+             "-- jython/streaming bridge exposing hivemall_tpu callables",
+             "REGISTER 'hivemall_tpu_bridge.py' USING jython AS hivemall;"]
+    for e in all_functions().values():
+        for n in [e.name] + list(e.aliases):
+            lines.append(f"DEFINE {n} hivemall.{n}();  -- {e.target}")
+    return "\n".join(lines)
+
+
 def define_udfs_td() -> str:
     """The define-udfs.td.hql analog: the curated Treasure-Data-style subset
     (trainers, predictors, ftvec, evaluation — no low-level tools)."""

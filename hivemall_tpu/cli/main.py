@@ -167,8 +167,13 @@ def _cmd_mixserv(args) -> int:
 
 
 def _cmd_define_all(args) -> int:
-    from ..catalog import define_all
-    print(define_all())
+    from ..catalog import registry
+    dialect = getattr(args, "dialect", "hive")
+    fn = {"hive": registry.define_all,
+          "spark": registry.define_all_spark,
+          "pig": registry.define_all_pig,
+          "td": registry.define_udfs_td}[dialect]
+    print(fn())
     return 0
 
 
@@ -216,6 +221,10 @@ def main(argv=None) -> int:
     m.set_defaults(fn=_cmd_mixserv)
 
     d = sub.add_parser("define-all", help="print the function manifest")
+    d.add_argument("--dialect", default="hive",
+                   choices=("hive", "spark", "pig", "td"),
+                   help="registration dialect (define-all.hive/.spark/"
+                        ".pig / define-udfs.td.hql analogs)")
     d.set_defaults(fn=_cmd_define_all)
 
     h = sub.add_parser("help", help="show a function's option grammar")

