@@ -91,13 +91,19 @@ def canonicalize_fieldmajor(idx: np.ndarray, val: np.ndarray,
     [B, F] batch.
 
     Fully vectorized (one argsort + cumulative ops — this runs on the e2e
-    input path). Returns ``(idx2, val2, m)`` with arrays [B, m*F] and m a
-    power of two, or ``None`` if some row has more than ``max_m`` features
-    in one field (caller falls back to the general pair path).
+    input path; the C++ twin in native/hivemall_native.cpp takes over when
+    built, ~10x, rows OpenMP-parallel). Returns ``(idx2, val2, m)`` with
+    arrays [B, m*F] and m a power of two, or ``None`` if some row has more
+    than ``max_m`` features in one field (caller falls back to the general
+    pair path).
 
     Field ids fold modulo F — the same normalization FFMTrainer._parse_row
     and every FFM kernel apply, so out-of-range ids keep their features
     instead of silently vanishing."""
+    from ..utils.native import canonicalize_fieldmajor_native
+    native = canonicalize_fieldmajor_native(idx, val, fld, F, max_m)
+    if native is not NotImplemented:
+        return native
     B, L = idx.shape
     live = val != 0
     fld = fld % F

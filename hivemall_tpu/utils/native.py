@@ -18,7 +18,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 __all__ = ["get_lib", "mmh3_batch_native", "mhash_batch_native",
-           "parse_libsvm_native"]
+           "parse_libsvm_native", "canonicalize_fieldmajor_native"]
 
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -31,6 +31,12 @@ _SO = os.path.join(os.path.dirname(_SRC), "_native.so")
 
 def _build() -> bool:
     try:
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-fopenmp",
+               _SRC, "-o", _SO]
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode == 0:
+            return True
+        # toolchains without libgomp: rebuild single-threaded
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                _SRC, "-o", _SO]
         r = subprocess.run(cmd, capture_output=True, timeout=120)
@@ -68,6 +74,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.libsvm_fill.restype = None
     lib.libsvm_free.restype = None
     lib.libsvm_free.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "canon_measure"):     # present after rebuild
+        lib.canon_measure.restype = ctypes.c_int
+        lib.canon_fill.restype = None
     _LIB = lib
     return _LIB
 
@@ -133,3 +142,40 @@ def parse_libsvm_native(path: str, *, zero_based: bool = False):
         lib.libsvm_free(ctypes.c_void_p(h))
     from ..io.sparse import SparseDataset
     return SparseDataset(idx, indptr, val, labels)
+
+
+def canonicalize_fieldmajor_native(idx: np.ndarray, val: np.ndarray,
+                                   fld: np.ndarray, F: int, max_m: int):
+    """C++ field-major canonicalization (io.sparse semantic twin).
+
+    Returns (idx2, val2, m) like io.sparse.canonicalize_fieldmajor,
+    ``None`` if a row overflows max_m, or ``NotImplemented`` when the
+    native lib is unavailable (caller falls back to numpy)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "canon_measure"):
+        return NotImplemented
+    idx = np.ascontiguousarray(idx, np.int32)
+    val = np.ascontiguousarray(val, np.float32)
+    fld = np.ascontiguousarray(fld, np.int32)
+    B, L = idx.shape
+    m_needed = lib.canon_measure(
+        val.ctypes.data_as(ctypes.c_void_p),
+        fld.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(B), ctypes.c_int64(L),
+        ctypes.c_int(F), ctypes.c_int(max_m))
+    if m_needed < 0:
+        return None
+    m = 1
+    while m < m_needed:
+        m <<= 1
+    out_idx = np.zeros((B, m * F), np.int32)
+    out_val = np.zeros((B, m * F), np.float32)
+    lib.canon_fill(
+        idx.ctypes.data_as(ctypes.c_void_p),
+        val.ctypes.data_as(ctypes.c_void_p),
+        fld.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(B), ctypes.c_int64(L),
+        ctypes.c_int(F), ctypes.c_int(m),
+        out_idx.ctypes.data_as(ctypes.c_void_p),
+        out_val.ctypes.data_as(ctypes.c_void_p))
+    return out_idx, out_val, int(m)

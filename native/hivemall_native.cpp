@@ -146,3 +146,83 @@ extern "C" void libsvm_fill(void* h, int32_t* idx, int64_t* indptr,
 }
 
 extern "C" void libsvm_free(void* h) { delete (LibsvmData*)h; }
+
+// ---- field-major FFM batch canonicalization (io.sparse analog) ------------
+// Reorders each row's features into slots where slot s carries field s % F
+// (rank r occurrence at slot r*F + f). The numpy implementation in
+// io/sparse.py is the semantic definition; this is the multi-host input-
+// pipeline version (one pass per row, rows parallel). Field ids fold with
+// floored modulo to match Python's % semantics.
+
+static inline int floormod(int x, int F) {
+  int r = x % F;
+  return r < 0 ? r + F : r;
+}
+
+// First sweep: the per-row max same-field multiplicity (the m the packed
+// layout needs). Returns -1 if it exceeds max_m (caller falls back).
+extern "C" int canon_measure(const float* val, const int32_t* fld,
+                             int64_t B, int64_t L, int F, int max_m) {
+  int m_needed = 1;
+#ifdef _OPENMP
+#pragma omp parallel
+#endif
+  {
+    std::vector<int> cnt((size_t)F, 0);
+    std::vector<int> stamp((size_t)F, -1);
+    int local_max = 0;
+#ifdef _OPENMP
+#pragma omp for nowait
+#endif
+    for (int64_t b = 0; b < B; b++) {
+      const float* v = val + b * L;
+      const int32_t* f = fld + b * L;
+      for (int64_t j = 0; j < L; j++) {
+        if (v[j] == 0.0f) continue;
+        int ff = floormod(f[j], F);
+        if (stamp[ff] != (int)b) { stamp[ff] = (int)b; cnt[ff] = 0; }
+        cnt[ff]++;
+        if (cnt[ff] > local_max) local_max = cnt[ff];
+      }
+    }
+#ifdef _OPENMP
+#pragma omp critical
+#endif
+    { if (local_max > m_needed) m_needed = local_max; }
+  }
+  return m_needed > max_m ? -1 : m_needed;
+}
+
+// Second sweep: scatter features into the [B, m*F] field-major arrays
+// (caller pre-zeroed). Earlier positions keep lower ranks, matching the
+// stable argsort in the numpy version.
+extern "C" void canon_fill(const int32_t* idx, const float* val,
+                           const int32_t* fld, int64_t B, int64_t L,
+                           int F, int m, int32_t* out_idx, float* out_val) {
+  const int64_t W = (int64_t)m * F;
+#ifdef _OPENMP
+#pragma omp parallel
+#endif
+  {
+    std::vector<int> cnt((size_t)F, 0);
+    std::vector<int> stamp((size_t)F, -1);
+#ifdef _OPENMP
+#pragma omp for nowait
+#endif
+    for (int64_t b = 0; b < B; b++) {
+      const int32_t* ii = idx + b * L;
+      const float* v = val + b * L;
+      const int32_t* f = fld + b * L;
+      int32_t* oi = out_idx + b * W;
+      float* ov = out_val + b * W;
+      for (int64_t j = 0; j < L; j++) {
+        if (v[j] == 0.0f) continue;
+        int ff = floormod(f[j], F);
+        if (stamp[ff] != (int)b) { stamp[ff] = (int)b; cnt[ff] = 0; }
+        int r = cnt[ff]++;
+        oi[(int64_t)r * F + ff] = ii[j];
+        ov[(int64_t)r * F + ff] = v[j];
+      }
+    }
+  }
+}

@@ -92,3 +92,44 @@ def test_native_parser_speed(lib, tmp_path):
         native._TRIED = False
     np.testing.assert_array_equal(a.indices, b.indices)
     assert t_native < t_py, (t_native, t_py)
+
+
+def test_canonicalize_native_matches_numpy():
+    """The C++ canonicalizer is a semantic twin of the numpy path."""
+    import numpy as np
+    from hivemall_tpu.utils.native import canonicalize_fieldmajor_native
+    res0 = canonicalize_fieldmajor_native(
+        np.zeros((1, 1), np.int32), np.zeros((1, 1), np.float32),
+        np.zeros((1, 1), np.int32), 2, 4)
+    if res0 is NotImplemented:
+        import pytest
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(9)
+    F = 5
+    for _ in range(10):
+        B, L = 6, 11
+        idx = rng.integers(1, 999, (B, L)).astype(np.int32)
+        val = rng.uniform(0.1, 1, (B, L)).astype(np.float32)
+        fld = rng.integers(-3, 2 * F, (B, L)).astype(np.int32)  # incl. oor
+        dead = rng.uniform(size=(B, L)) < 0.4
+        val[dead] = 0
+        # numpy reference (bypass the native fast path)
+        import hivemall_tpu.io.sparse as sp
+        import hivemall_tpu.utils.native as nat
+        native = canonicalize_fieldmajor_native(idx, val, fld, F, 8)
+        saved = nat.canonicalize_fieldmajor_native
+        try:
+            nat.canonicalize_fieldmajor_native = \
+                lambda *a, **k: NotImplemented
+            ref = sp.canonicalize_fieldmajor(idx, val, fld, F, max_m=8)
+        finally:
+            nat.canonicalize_fieldmajor_native = saved
+        assert native is not None and ref is not None
+        np.testing.assert_array_equal(native[0], ref[0])
+        np.testing.assert_array_equal(native[1], ref[1])
+        assert native[2] == ref[2]
+    # overflow parity
+    idx = np.ones((2, 6), np.int32)
+    val = np.ones((2, 6), np.float32)
+    fld = np.zeros((2, 6), np.int32)
+    assert canonicalize_fieldmajor_native(idx, val, fld, F, 4) is None
