@@ -226,10 +226,24 @@ class LearnerBase:
         on the trainer for its whole lifetime is not free."""
         return False
 
+    # Trainers whose jitted step accepts val=None (rebuilding it from idx
+    # on device) set this True: unit-valued categorical batches then skip
+    # the val h2d transfer entirely (a third of batch bytes — the link is
+    # the measured e2e bottleneck; see io.sparse.SparseBatch).
+    UNIT_VAL_ELISION = False
+
     def _preprocess_batch(self, batch: SparseBatch) -> SparseBatch:
         """Host-side per-batch hook, applied BEFORE device staging (so the
-        prefetcher overlaps it with compute). Default identity; FFM's joint
-        layout canonicalizes batches into field-major slots here."""
+        prefetcher overlaps it with compute). Default: unit-value elision
+        when the trainer's step supports it; FFM's joint layout overrides
+        to canonicalize into field-major slots."""
+        if (self.UNIT_VAL_ELISION and isinstance(batch.val, np.ndarray)
+                and isinstance(batch.idx, np.ndarray)
+                and np.array_equal(batch.val,
+                                   (batch.idx != 0).astype(np.float32))):
+            return SparseBatch(batch.idx, None, batch.label, batch.field,
+                               n_valid=batch.n_valid,
+                               fieldmajor=batch.fieldmajor)
         return batch
 
     # -- mesh sharding (SURVEY.md §3.17 / §8 M3) -----------------------------

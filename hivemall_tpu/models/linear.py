@@ -31,6 +31,7 @@ __all__ = ["GeneralClassifier", "GeneralRegressor", "LogressTrainer",
 
 
 class _LinearLearner(LearnerBase):
+    UNIT_VAL_ELISION = True      # ops.linear.make_linear_step takes val=None
     """Shared machinery for dense-table linear trainers."""
 
     FIXED_LOSS: Optional[str] = None       # set by historical subclasses

@@ -24,7 +24,7 @@ import numpy as np
 
 from ..ops.trees import (Tree, bin_raw, build_tree_classifier,
                          build_tree_regressor, build_tree_xgb, predict_bins,
-                         quantize_bins)
+                         predict_bins_device, quantize_bins)
 from ..utils.options import OptionSpec
 
 __all__ = ["RandomForestClassifier", "RandomForestRegressor",
@@ -257,15 +257,20 @@ class GradientBoosting:
                                       "objective": np.frombuffer(
                                           self.objective.encode(), np.uint8)}))
 
-    def _grad_hess(self, y: np.ndarray, margin: np.ndarray):
+    def _grad_hess(self, y, margin):
+        # jnp math: the boosting state (margin, g, h) stays ON DEVICE for
+        # the whole round loop — a numpy margin forced two host round-trips
+        # per round, which dominated wall time on a high-latency link
+        import jax.numpy as jnp
         if self.objective == "binary:logistic":
-            p = 1.0 / (1.0 + np.exp(-margin))
+            p = 1.0 / (1.0 + jnp.exp(-margin))
             return p - y, p * (1 - p)
         if self.objective == "reg:squarederror":
-            return margin - y, np.ones_like(y)
+            return margin - y, jnp.ones_like(y)
         raise ValueError(f"unknown objective {self.objective!r}")
 
     def fit(self, X: np.ndarray, y: np.ndarray) -> "GradientBoosting":
+        import jax.numpy as jnp
         o = self.opts
         X = np.asarray(X, np.float32)
         y = np.asarray(y, np.float32)
@@ -275,22 +280,24 @@ class GradientBoosting:
         self.eta = float(o.eta)
         bins, edges = quantize_bins(X, int(o.bins))
         rng = np.random.default_rng(int(o.seed))
-        margin = np.full(n, self.base_score, np.float32)
+        bins_d = jnp.asarray(bins)
+        y_d = jnp.asarray(y)
+        margin = jnp.full(n, self.base_score, jnp.float32)
         self.trees = []
         for r in range(int(o.num_round)):
-            g, h = self._grad_hess(y, margin)
+            g, h = self._grad_hess(y_d, margin)
             if float(o.subsample) < 1.0:
-                keep = rng.random(n) < float(o.subsample)
-                g = np.where(keep, g, 0.0)
-                h = np.where(keep, h, 0.0)
+                keep = jnp.asarray(rng.random(n) < float(o.subsample))
+                g = jnp.where(keep, g, 0.0)
+                h = jnp.where(keep, h, 0.0)
             tree = build_tree_xgb(
-                bins, g, h, edges, depth=int(o.max_depth),
+                bins_d, g, h, edges, depth=int(o.max_depth),
                 n_bins=int(o.bins), lam=float(o["lambda"]),
                 min_split=2.0, min_leaf=float(o.min_child_weight),
                 colsample=float(o.colsample_bytree),
                 seed=int(o.seed) + r)
             self.trees.append(tree)
-            margin = margin + self.eta * predict_bins(tree, bins)[0, :, 0]
+            margin = margin + self.eta * predict_bins_device(tree, bins_d)[0, :, 0]
         return self
 
     def decision_function(self, X: np.ndarray) -> np.ndarray:
@@ -337,23 +344,30 @@ class XGBoostMulticlassClassifier(GradientBoosting):
         n, d = X.shape
         self.eta = float(o.eta)
         bins, edges = quantize_bins(X, int(o.bins))
-        margin = np.zeros((n, C), np.float32)
+        import jax.numpy as jnp
+        bins_d = jnp.asarray(bins)
+        yoh = jnp.asarray((yc[:, None] == np.arange(C)[None, :])
+                          .astype(np.float32))
+        # margin stays on device across rounds (same rationale as the
+        # binary fit: host margins cost two relay round-trips per tree)
+        margin = jnp.zeros((n, C), jnp.float32)
         self.trees = []          # list of per-round lists
         for r in range(int(o.num_round)):
-            e = np.exp(margin - margin.max(1, keepdims=True))
+            e = jnp.exp(margin - margin.max(1, keepdims=True))
             p = e / e.sum(1, keepdims=True)
             round_trees = []
             for c in range(C):
-                g = p[:, c] - (yc == c)
-                h = np.maximum(p[:, c] * (1 - p[:, c]), 1e-6)
+                g = p[:, c] - yoh[:, c]
+                h = jnp.maximum(p[:, c] * (1 - p[:, c]), 1e-6)
                 tree = build_tree_xgb(
-                    bins, g, h, edges, depth=int(o.max_depth),
+                    bins_d, g, h, edges, depth=int(o.max_depth),
                     n_bins=int(o.bins), lam=float(o["lambda"]),
                     min_leaf=float(o.min_child_weight),
                     colsample=float(o.colsample_bytree),
                     seed=int(o.seed) + r * C + c)
                 round_trees.append(tree)
-                margin[:, c] += self.eta * predict_bins(tree, bins)[0, :, 0]
+                margin = margin.at[:, c].add(
+                    self.eta * predict_bins_device(tree, bins_d)[0, :, 0])
             self.trees.append(round_trees)
         return self
 

@@ -43,6 +43,12 @@ def make_linear_step(loss: Loss, optimizer: Optimizer) -> Callable:
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(w, opt_state, t, idx, val, label, row_mask):
         wf = w.astype(jnp.float32)
+        if val is None:
+            # unit-value elision (io.sparse.SparseBatch): categorical rows
+            # never transfer the val array; rebuild it from idx on device.
+            # None is static under jit, so this is a separate compiled
+            # variant, not a runtime branch.
+            val = (idx != 0).astype(jnp.float32)
         margin = linear_margin(wf, idx, val)
         d = loss.dloss(margin, label) * row_mask            # [B]
         g = jnp.zeros_like(wf).at[idx.ravel()].add(

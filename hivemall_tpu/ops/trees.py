@@ -36,6 +36,7 @@ from hivemall_tpu.ops.pallas_hist import (level_histogram,
 
 __all__ = ["quantize_bins", "Tree", "build_tree_classifier",
            "build_tree_regressor", "build_tree_xgb", "predict_bins",
+           "predict_bins_device",
            "predict_raw"]
 
 
@@ -306,14 +307,20 @@ def _walk_ensemble(feat, thr, value, bins, depth):
                     )(feat, thr, value, bins, depth)
 
 
+def predict_bins_device(tree: Tree, bins) -> jnp.ndarray:
+    """Device-resident predict (no host sync) — the boosting round loop
+    uses this so the margin chain never leaves the chip."""
+    return _walk_ensemble(
+        jnp.asarray(tree.feat), jnp.asarray(tree.thr),
+        jnp.asarray(tree.value), jnp.asarray(bins), tree.depth + 1)
+
+
 def predict_bins(tree: Tree, bins: np.ndarray) -> np.ndarray:
     """Predict leaf payload per row for every tree: returns [E, n, C].
     The reference's per-row StackMachine opcode interpreter (SURVEY.md §3.9
     row 3) becomes this data-parallel gather walk, vmapped over the
     ensemble (one device call for the whole forest, not one per tree)."""
-    return np.asarray(_walk_ensemble(
-        jnp.asarray(tree.feat), jnp.asarray(tree.thr),
-        jnp.asarray(tree.value), jnp.asarray(bins), tree.depth + 1))
+    return np.asarray(predict_bins_device(tree, bins))
 
 
 def bin_raw(X: np.ndarray, edges: np.ndarray) -> np.ndarray:

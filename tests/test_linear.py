@@ -105,3 +105,28 @@ def test_halffloat_bf16():
     import jax.numpy as jnp
     assert clf.w.dtype == jnp.bfloat16
     assert auc(ds.labels, clf.decision_function(ds)) > 0.8
+
+
+def test_unit_val_elision_trains_identically():
+    """Categorical (all-unit) batches drop the val array; the step rebuilds
+    it on device — same model as the explicit-val path."""
+    import numpy as np
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.models.linear import GeneralClassifier
+    rng = np.random.default_rng(0)
+    rows = [(rng.choice(np.arange(1, 64), 5, replace=False).astype(np.int32),
+             np.ones(5, np.float32)) for _ in range(200)]
+    labels = [1.0 if r[0][0] % 2 else -1.0 for r in rows]
+    ds = SparseDataset.from_rows(rows, labels)
+    opts = "-dims 64 -loss logloss -opt adagrad -mini_batch 32 -iters 3"
+    t1 = GeneralClassifier(opts)
+    t1.fit(ds)
+    b = next(ds.batches(32))
+    pb = t1._preprocess_batch(b)
+    assert pb.val is None                      # elision engaged
+    t2 = GeneralClassifier(opts)
+    t2.UNIT_VAL_ELISION = False                # force explicit val path
+    t2.fit(ds)
+    np.testing.assert_allclose(np.asarray(t1.w, np.float32),
+                               np.asarray(t2.w, np.float32),
+                               rtol=1e-5, atol=1e-6)
