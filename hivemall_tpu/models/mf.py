@@ -43,6 +43,9 @@ def _mf_spec(name: str) -> OptionSpec:
     s.add("seed", type=int, default=31, help="init seed")
     s.flag("disable_bias", help="drop user/item bias terms")
     s.flag("halffloat", help="bf16 factor tables")
+    s.add("mesh", default=None,
+          help="shard training over a device mesh, e.g. 'dp=2,tp=4' "
+               "(batch over dp, P/Q/bias tables over tp) or 'auto'")
     return s
 
 
@@ -74,6 +77,9 @@ class MFTrainer:
         }
         self.gg = ({k: jnp.zeros(v.shape, jnp.float32)
                     for k, v in self.params.items()} if self.ADAGRAD else None)
+        self.mesh = None
+        if o.mesh:
+            self._apply_mesh(str(o.mesh))
         self._step = self._make_step()
         self._t = 0
         self._buf: List[Tuple[int, int, float]] = []
@@ -84,6 +90,35 @@ class MFTrainer:
         self._loss_pending = jnp.zeros(())
         self._loss_host = 0.0
         self.n_seen = 0
+
+    # -- mesh sharding (SURVEY.md §3.17): batch over dp, tables over tp ------
+    def _apply_mesh(self, spec: str) -> None:
+        """GSPMD-shard the MF state: P/Q factor tables and biases split
+        their id axis over 'tp' (feature-dim sharding), minibatches split
+        rows over 'dp' (XLA inserts the gradient psum). The same jitted
+        step runs unchanged — mirrors LearnerBase._apply_mesh."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import make_mesh, parse_mesh_spec
+        dp, tp = parse_mesh_spec(spec)
+        if int(self.opts.mini_batch) % dp:
+            raise ValueError(
+                f"-mini_batch {self.opts.mini_batch} must be divisible by "
+                f"the dp axis ({dp})")
+        self.mesh = make_mesh(dp=dp, tp=tp)
+
+        def shard(v):
+            spec_ = P(*(["tp"] + [None] * (v.ndim - 1)))
+            return jax.device_put(v, NamedSharding(self.mesh, spec_))
+        self.params = {k: shard(v) for k, v in self.params.items()}
+        if self.gg is not None:
+            self.gg = {k: shard(v) for k, v in self.gg.items()}
+
+    def _shard_inputs(self, arrays):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return tuple(jax.device_put(a, NamedSharding(self.mesh, P("dp")))
+                     for a in arrays)
 
     def _make_step(self):
         o = self.opts
@@ -181,6 +216,8 @@ class MFTrainer:
         i[:n] = [c[1] for c in chunk]
         r[:n] = [c[2] for c in chunk]
         m[:n] = 1.0
+        if self.mesh is not None:
+            u, i, r, m = self._shard_inputs((u, i, r, m))
         self.params, self.gg, loss = self._step(
             self.params, self.gg, float(self._t), u, i, r, m)
         self._t += 1
@@ -308,6 +345,8 @@ class BPRMFTrainer(MFTrainer):
         i[:n] = [c[1] for c in chunk]
         j[:n] = [int(c[2]) for c in chunk]
         m[:n] = 1.0
+        if self.mesh is not None:
+            u, i, j, m = self._shard_inputs((u, i, j, m))
         self.params, self.gg, loss = self._step(
             self.params, self.gg, float(self._t), u, i, j, m)
         self._t += 1

@@ -130,3 +130,30 @@ def test_mesh_with_parquet_stream(tmp_path):
     # same rows, same shard order when unshuffled with one pass
     np.testing.assert_allclose(np.asarray(ram.params["T"]),
                                np.asarray(sharded.params["T"]), atol=1e-3)
+
+
+def test_mf_mesh_matches_single_device():
+    """-mesh on the MF family: dp-sharded batches + tp-sharded P/Q tables
+    train to the same model as the unsharded trainer."""
+    import numpy as np
+    from hivemall_tpu.models.mf import MFAdaGradTrainer
+    rng = np.random.default_rng(3)
+    n, U, I = 512, 64, 32
+    u = rng.integers(0, U, n).astype(np.int32)
+    i = rng.integers(0, I, n).astype(np.int32)
+    r = (3.0 + 0.5 * rng.normal(0, 1, n)).astype(np.float32)
+    opts = (f"-factors 8 -users {U} -items {I} -mini_batch 128 "
+            f"-eta0 0.05 -iters 2")
+    t0 = MFAdaGradTrainer(opts)
+    t0.fit(u, i, r, shuffle=False)
+    t1 = MFAdaGradTrainer(opts + " -mesh dp=2,tp=4")
+    assert t1.mesh is not None
+    t1.fit(u, i, r, shuffle=False)
+    P1 = np.asarray(t1.params["P"], np.float32)
+    shard = t1.params["P"].sharding.shard_shape(t1.params["P"].shape)
+    assert shard[0] == U // 4        # tp=4 row sharding
+    np.testing.assert_allclose(np.asarray(t0.params["P"], np.float32), P1,
+                               rtol=1e-4, atol=1e-5)
+    preds0 = t0.predict(u[:32], i[:32])
+    preds1 = t1.predict(u[:32], i[:32])
+    np.testing.assert_allclose(preds0, preds1, rtol=1e-4, atol=1e-5)
