@@ -60,6 +60,9 @@ class Word2VecTrainer:
                    "— raise alpha when raising this")
         s.add("seed", type=int, default=11, help="rng seed")
         s.flag("cbow", help="CBOW instead of SkipGram")
+        s.add("mesh", default=None,
+              help="shard training over a device mesh, e.g. 'dp=2,tp=4' "
+                   "(pair batches over dp, embedding tables over tp)")
         return s
 
     def __init__(self, options: str = ""):
@@ -69,6 +72,15 @@ class Word2VecTrainer:
         self.inv_vocab: List[str] = []
         self.in_emb: Optional[jnp.ndarray] = None
         self.out_emb: Optional[jnp.ndarray] = None
+        self.mesh = None
+        if self.opts.mesh:
+            from ..parallel.mesh import make_mesh, parse_mesh_spec
+            dp, tp = parse_mesh_spec(str(self.opts.mesh))
+            if int(self.opts.mini_batch) % dp:
+                raise ValueError(
+                    f"-mini_batch {self.opts.mini_batch} must be divisible "
+                    f"by the dp axis ({dp})")
+            self.mesh = make_mesh(dp=dp, tp=tp)
 
     # -- UDTF lifecycle ------------------------------------------------------
     def process(self, words: Sequence[str]) -> None:
@@ -249,9 +261,20 @@ class Word2VecTrainer:
             raise ValueError("empty vocabulary (check -min_count)")
         rng = np.random.default_rng(int(o.seed))
         key = jax.random.PRNGKey(int(o.seed))
-        self.in_emb = (jax.random.uniform(key, (V, D)) - 0.5) / D
-        self.out_emb = jnp.zeros((V, D))
+        Vp = V
+        if self.mesh is not None:     # pad vocab rows to the tp axis size
+            tp = self.mesh.shape["tp"]
+            Vp = -(-V // tp) * tp     # extra rows are never gathered
+        self.in_emb = (jax.random.uniform(key, (Vp, D)) - 0.5) / D
+        self.out_emb = jnp.zeros((Vp, D))
         table = jnp.asarray(self._neg_table(freqs))   # staged on device once
+        if self.mesh is not None:
+            # vocab rows over tp, negative table replicated, batches over dp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sh = NamedSharding(self.mesh, P("tp", None))
+            self.in_emb = jax.device_put(self.in_emb, sh)
+            self.out_emb = jax.device_put(self.out_emb, sh)
+            table = jax.device_put(table, NamedSharding(self.mesh, P()))
         ids_docs =[np.asarray([self.vocab[w] for w in d if w in self.vocab],
                                np.int32) for d in docs]
         total = sum(len(d) for d in ids_docs)
@@ -294,9 +317,14 @@ class Word2VecTrainer:
                 x = np.concatenate([x, np.zeros(pad, np.int32)])
             lr = max(alpha * (1.0 - progress), alpha * 1e-4)
             nstep += 1
+            cd, xd = jnp.asarray(c), jnp.asarray(x)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                cd = jax.device_put(cd, NamedSharding(
+                    self.mesh, P("dp", *([None] * (cd.ndim - 1)))))
+                xd = jax.device_put(xd, NamedSharding(self.mesh, P("dp")))
             self.in_emb, self.out_emb, _ = step(
-                self.in_emb, self.out_emb, table, jnp.asarray(c),
-                jnp.asarray(x), nb, nstep, lr)
+                self.in_emb, self.out_emb, table, cd, xd, nb, nstep, lr)
 
         def drain(progress: float, final: bool = False) -> None:
             nonlocal pend_c, pend_x, pending

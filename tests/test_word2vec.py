@@ -124,3 +124,21 @@ def test_sparse_step_selected_for_large_vocab_updates_touched_only():
     np.testing.assert_array_equal(np.asarray(ie2[20]), np.asarray(ie[20]))
     np.testing.assert_array_equal(np.asarray(oe2[30]), np.asarray(oe[30]))
     assert not np.allclose(np.asarray(oe2[4]), np.asarray(oe[4]))
+
+
+def test_word2vec_mesh_trains():
+    """-mesh shards pair batches over dp and embedding tables over tp."""
+    import numpy as np
+    from hivemall_tpu.models.word2vec import Word2VecTrainer
+    rng = np.random.default_rng(0)
+    words = [f"w{t}" for t in rng.integers(0, 50, 20000)]
+    t = Word2VecTrainer("-dim 16 -window 3 -neg 2 -min_count 1 "
+                        "-mini_batch 512 -mesh dp=2,tp=4")
+    assert t.mesh is not None
+    t.train([words])
+    emb = t.in_emb
+    assert emb.sharding.shard_shape(emb.shape)[0] == emb.shape[0] // 4
+    assert np.isfinite(np.asarray(emb)).all()
+    # similar-context words should still embed meaningfully
+    v = t.vectors()
+    assert len(v) == 50
