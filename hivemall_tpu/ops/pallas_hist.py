@@ -73,7 +73,8 @@ def _hist_kernel(idx_ref, ws_ref, out_ref):
         ws_ref[:], oh_t,
         dimension_numbers=(((1,), (1,)), ((), ())),
         # HIGHEST = f32-equivalent MXU passes; split stats must not round
-        # to bf16 (gini/gradient sums feed gain comparisons)
+        # to bf16 (gini/gradient sums feed gain comparisons). Mosaic
+        # supports only DEFAULT|HIGHEST here (HIGH raises NotImplemented).
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32)
 
