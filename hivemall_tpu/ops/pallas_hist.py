@@ -63,7 +63,7 @@ def use_pallas_default() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _hist_kernel(idx_ref, ws_ref, out_ref):
+def _hist_kernel(idx_ref, ws_ref, out_ref, *, precision):
     f = pl.program_id(0)
     m = pl.program_id(1)
     local = idx_ref[f % 8, :] - m * _MB_TILE              # [_ROWS] lane vec
@@ -72,10 +72,14 @@ def _hist_kernel(idx_ref, ws_ref, out_ref):
     acc = jax.lax.dot_general(                            # [_SCH, _MB_TILE]
         ws_ref[:], oh_t,
         dimension_numbers=(((1,), (1,)), ((), ())),
-        # HIGHEST = f32-equivalent MXU passes; split stats must not round
-        # to bf16 (gini/gradient sums feed gain comparisons). Mosaic
-        # supports only DEFAULT|HIGHEST here (HIGH raises NotImplemented).
-        precision=jax.lax.Precision.HIGHEST,
+        # HIGHEST = f32-equivalent MXU passes (the default: gini/gradient
+        # sums feed gain comparisons and must not round to bf16). Callers
+        # whose stat channels are SMALL INTEGERS (classification: class
+        # indicator x bootstrap count) pass DEFAULT — single-pass bf16
+        # products of exact-in-bf16 operands with f32 accumulation are
+        # still exact, at ~6x fewer MXU passes. Mosaic supports only
+        # DEFAULT|HIGHEST (HIGH raises NotImplemented).
+        precision=precision,
         preferred_element_type=jnp.float32)
 
     @pl.when(pl.program_id(2) == 0)
@@ -88,7 +92,8 @@ def _hist_kernel(idx_ref, ws_ref, out_ref):
 
 
 def level_histogram(bins: jnp.ndarray, loc: jnp.ndarray, ws: jnp.ndarray,
-                    n_nodes: int, n_bins: int) -> jnp.ndarray:
+                    n_nodes: int, n_bins: int,
+                    fast: bool = False) -> jnp.ndarray:
     """Histogram one tree level on TPU.
 
     bins: int [n, d] bin codes; loc: int32 [n] node-local id in [0, n_nodes)
@@ -99,7 +104,7 @@ def level_histogram(bins: jnp.ndarray, loc: jnp.ndarray, ws: jnp.ndarray,
     S = ws.shape[1]
     if S > _SCH:                 # e.g. >8-class gini: chunk the channels
         parts = [level_histogram(bins, loc, ws[:, s:s + _SCH],
-                                 n_nodes, n_bins)
+                                 n_nodes, n_bins, fast=fast)
                  for s in range(0, S, _SCH)]
         return jnp.concatenate(parts, axis=-1)
     mb = n_nodes * n_bins
@@ -116,8 +121,11 @@ def level_histogram(bins: jnp.ndarray, loc: jnp.ndarray, ws: jnp.ndarray,
     ws_t = jnp.pad(ws.astype(jnp.float32),
                    ((0, np_ - n), (0, _SCH - S))).T       # [_SCH, np_]
 
+    from functools import partial as _partial
+    prec = (jax.lax.Precision.DEFAULT if fast
+            else jax.lax.Precision.HIGHEST)
     out = pl.pallas_call(
-        _hist_kernel,
+        _partial(_hist_kernel, precision=prec),
         grid=(d, mbp // _MB_TILE, np_ // _ROWS),
         in_specs=[
             pl.BlockSpec((8, _ROWS), lambda f, m, r: (f // 8, r),
@@ -154,7 +162,7 @@ def level_histogram(bins: jnp.ndarray, loc: jnp.ndarray, ws: jnp.ndarray,
 _CHUNK = 256                   # sorted rows per grid step (= _ROWS)
 
 
-def _windowed_kernel(wseq_ref, idx_ref, ws_ref, out_ref):
+def _windowed_kernel(wseq_ref, idx_ref, ws_ref, out_ref, *, precision):
     f = pl.program_id(0)
     c = pl.program_id(1)
     base = wseq_ref[c] * _MB_TILE
@@ -164,7 +172,7 @@ def _windowed_kernel(wseq_ref, idx_ref, ws_ref, out_ref):
     acc = jax.lax.dot_general(
         ws_ref[:], oh_t,
         dimension_numbers=(((1,), (1,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
+        precision=precision,
         preferred_element_type=jnp.float32)               # [_SCH, _MB_TILE]
 
     first = jnp.logical_or(c == 0, wseq_ref[c] != wseq_ref[jnp.maximum(c - 1, 0)])
@@ -179,8 +187,8 @@ def _windowed_kernel(wseq_ref, idx_ref, ws_ref, out_ref):
 
 
 def level_histogram_sorted(bins: jnp.ndarray, loc: jnp.ndarray,
-                           ws: jnp.ndarray, n_nodes: int, n_bins: int
-                           ) -> jnp.ndarray:
+                           ws: jnp.ndarray, n_nodes: int, n_bins: int,
+                           fast: bool = False) -> jnp.ndarray:
     """Sorted-window histogram: same contract as level_histogram, cost
     n * 512 * d instead of n * (M*B) * d at deep levels. Window alignment
     needs n_bins to divide 512; other bin counts fall back to the flat
@@ -188,7 +196,7 @@ def level_histogram_sorted(bins: jnp.ndarray, loc: jnp.ndarray,
     n, d = bins.shape
     S = ws.shape[1]
     if _MB_TILE % n_bins:
-        return level_histogram(bins, loc, ws, n_nodes, n_bins)
+        return level_histogram(bins, loc, ws, n_nodes, n_bins, fast=fast)
     W = _MB_TILE // n_bins               # nodes per window
     nw = -(-n_nodes // W)
 
@@ -253,8 +261,11 @@ def level_histogram_sorted(bins: jnp.ndarray, loc: jnp.ndarray,
         slab = ws_s[:, s0:s0 + _SCH]
         Sk = slab.shape[1]
         ws_t = jnp.pad(slab, ((0, np_ - n), (0, _SCH - Sk))).T
+        from functools import partial as _partial
+        prec = (jax.lax.Precision.DEFAULT if fast
+                else jax.lax.Precision.HIGHEST)
         out = pl.pallas_call(
-            _windowed_kernel,
+            _partial(_windowed_kernel, precision=prec),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((d, _SCH, nw * _MB_TILE),
                                            jnp.float32),
@@ -267,5 +278,5 @@ def level_histogram_sorted(bins: jnp.ndarray, loc: jnp.ndarray,
                 .transpose(2, 0, 3, 1))                   # [M, d, B, Sk]
         parts.append(main + level_histogram(sp_bins, sp_loc,
                                             sp_ws[:, s0:s0 + _SCH],
-                                            n_nodes, n_bins))
+                                            n_nodes, n_bins, fast=fast))
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=-1)

@@ -110,7 +110,8 @@ def _xgb_gain(lam):
 def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
                   leaf_fn: Callable, count_fn: Callable, depth: int,
                   n_bins: int, mtry: int, min_split: float, min_leaf: float,
-                  min_gain: float, use_pallas: bool = False):
+                  min_gain: float, use_pallas: bool = False,
+                  hist_fast: bool = False):
     """Single-tree level-wise builder; vmap over (w, rng) for an ensemble.
 
     bins: uint8 [n, d]; aux: per-row stat payload (labels / grads);
@@ -141,9 +142,11 @@ def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
                 # outgrows one 512-column tile (measured 15x at M=256)
                 loc_m = jnp.where(active, local, -1)
                 if M * n_bins > 512:
-                    hist = level_histogram_sorted(bins, loc_m, ws, M, n_bins)
+                    hist = level_histogram_sorted(bins, loc_m, ws, M, n_bins,
+                                                  fast=hist_fast)
                 else:
-                    hist = level_histogram(bins, loc_m, ws, M, n_bins)
+                    hist = level_histogram(bins, loc_m, ws, M, n_bins,
+                                           fast=hist_fast)
             else:
                 # CPU fallback: flat scatter-add ((local*d + f)*B + bin)
                 fidx = (loc[:, None] * d + jnp.arange(d)[None, :]) * n_bins \
@@ -222,9 +225,14 @@ def _cached_builder(task: str, n_channels: int, depth: int, n_bins: int,
         gain, leaf, count = _xgb_gain(lam), xleaf, (lambda s: s[..., 2])
     else:
         raise ValueError(task)
+    # classification stat channels are class-indicator x bootstrap-count —
+    # small integers, exact in bf16 — so the histogram matmul can run
+    # single-pass (fast) without rounding anything; var/xgb channels carry
+    # arbitrary floats and keep the f32-equivalent passes
     build = _make_builder(n_channels, lambda aux: aux, gain, leaf, count,
                           depth, n_bins, mtry, min_split, min_leaf,
-                          min_gain=1e-7, use_pallas=use_pallas)
+                          min_gain=1e-7, use_pallas=use_pallas,
+                          hist_fast=(task == "gini"))
     if vmapped:
         build = jax.vmap(build, in_axes=(None, None, 0, 0))
     return jax.jit(build)
