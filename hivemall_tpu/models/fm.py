@@ -117,6 +117,7 @@ class FMTrainer(LearnerBase):
                 (o.lambda0, o.lambda_w, o.lambda_v), self.k)
             self._fused_score = make_fm_score_fused(self.k)
             self._tp_sizes.add(self.Np)    # mesh: shard packed rows over tp
+            self.UNIT_VAL_ELISION = True   # fused step accepts val=None
         else:
             self.params = {
                 "w0": jnp.zeros((), dtype),

@@ -474,6 +474,11 @@ def make_fm_step_fused(loss: Loss, optimizer: Optimizer,
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, t, idx, val, label, row_mask):
+        if val is None:
+            # unit-value elision (io.sparse.SparseBatch): categorical
+            # batches never transfer val; rebuild it from idx on device
+            # (None is static under jit — a separate compiled variant)
+            val = (idx != 0).astype(jnp.float32)
         T, w0 = params["T"], params["w0"]
         rows, sub = idx // P, idx % P
         slab128 = T[rows]                            # ONE 128-lane gather

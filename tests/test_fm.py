@@ -498,3 +498,22 @@ def test_fm_fused_rejects_dense_only_optimizer():
         FMTrainer("-dims 64 -opt adam -fm_table fused")
     t = FMTrainer("-dims 64 -opt adam")          # auto falls back to split
     assert t.fm_layout == "split"
+
+
+def test_fm_fused_unit_val_elision():
+    """Categorical FM batches drop the val array; the fused step rebuilds
+    it from idx on device — same model as the explicit-val path."""
+    rows, _, labels = _xor_dataset(400)
+    ds = SparseDataset.from_rows(rows, labels)
+    opts = ("-dims 64 -factors 4 -classification -opt adagrad -eta fixed "
+            "-eta0 0.1 -mini_batch 64 -iters 3 -sigma 0.3")
+    t1 = FMTrainer(opts)
+    b = t1._preprocess_batch(next(ds.batches(64)))
+    assert b.val is None                   # elision engaged (all-unit vals)
+    t1.fit(ds)
+    t2 = FMTrainer(opts)
+    t2.UNIT_VAL_ELISION = False
+    t2.fit(ds)
+    np.testing.assert_allclose(np.asarray(t1.params["T"], np.float32),
+                               np.asarray(t2.params["T"], np.float32),
+                               rtol=1e-5, atol=1e-6)
