@@ -59,3 +59,27 @@ def test_feature_string_parse_roundtrip(pairs):
     assert list(idx) == [i for i, _ in pairs]
     np.testing.assert_allclose(val, [float(f"{v:.6g}") for _, v in pairs],
                                rtol=1e-6)
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.integers(2, 7), st.integers(1, 4), st.integers(2, 12),
+       st.integers(0, 2**31 - 1))
+def test_canonicalize_fieldmajor_preserves_multiset(F, B, L, seed):
+    """Field-major canonicalization (numpy or C++ twin) keeps every live
+    (feature, value, field mod F) triple and assigns slot s field s % F."""
+    from hivemall_tpu.io.sparse import canonicalize_fieldmajor
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(1, 500, (B, L)).astype(np.int32)
+    val = rng.uniform(0.1, 1, (B, L)).astype(np.float32)
+    fld = rng.integers(-2, 2 * F, (B, L)).astype(np.int32)
+    val[rng.uniform(size=(B, L)) < 0.3] = 0
+    res = canonicalize_fieldmajor(idx, val, fld, F, max_m=L)
+    assert res is not None
+    idx2, val2, m = res
+    assert idx2.shape == (B, m * F) and (m & (m - 1)) == 0
+    for b in range(B):
+        orig = sorted((int(i), float(v), int(f) % F) for i, v, f in
+                      zip(idx[b], val[b], fld[b]) if v != 0)
+        got = sorted((int(idx2[b, s]), float(val2[b, s]), s % F)
+                     for s in range(m * F) if val2[b, s] != 0)
+        assert orig == got
