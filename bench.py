@@ -354,10 +354,11 @@ def bench_word2vec() -> dict:
     words = [f"w{t}" for t in toks]
     opts = ("-dim 100 -window 5 -neg 5 -min_count 5 "
             "-mini_batch 16384 -sample 1e-4")
-    # warm the XLA compile cache with the same step shapes (B/neg/dim)
+    # warm the XLA compile cache with IDENTICAL shapes (same corpus => same
+    # vocab => same table shapes; the compilation cache is cross-instance)
     # outside the timed region — one-off compilation is not the
     # steady-state throughput this bench characterizes
-    Word2VecTrainer(opts).train([words[:60_000]])
+    Word2VecTrainer(opts).train([words])
     t = Word2VecTrainer(opts)
     t0 = time.perf_counter()
     t.train([words])
@@ -513,15 +514,37 @@ def _supervised():
         configs.append(rec)
         any_ok = any_ok or rec.get("unit") != "failed"
     if any_ok:
-        e2 = dict(env)
-        e2["HIVEMALL_TPU_BENCH_EMIT"] = json.dumps(configs)
-        out = subprocess.run([sys.executable, __file__], env=e2,
-                             capture_output=True, text=True, timeout=300)
-        lines = [l for l in out.stdout.strip().splitlines()
-                 if l.startswith("{")]
-        if lines:
-            print(lines[-1])
-            return
+        try:
+            e2 = dict(env)
+            e2["HIVEMALL_TPU_BENCH_EMIT"] = json.dumps(configs)
+            out = subprocess.run([sys.executable, __file__], env=e2,
+                                 capture_output=True, text=True, timeout=300)
+            lines = [l for l in out.stdout.strip().splitlines()
+                     if l.startswith("{")]
+            if lines:
+                print(lines[-1])
+                return
+        except subprocess.TimeoutExpired:
+            pass
+        # emit child failed/hung (accelerator re-attach) — NEVER discard the
+        # collected TPU measurements: emit locally without touching jax
+        per_chip_baseline = 10_000_000 / 16
+        primary = next((c for c in configs
+                        if c["metric"].startswith("train_ffm_b32k")
+                        and c.get("unit") != "failed"),
+                       next((c for c in configs
+                             if c.get("unit") == "examples/sec"),
+                            {"metric": "bench_failed", "value": 0.0,
+                             "unit": "examples/sec"}))
+        print(json.dumps({
+            "metric": primary["metric"], "value": primary["value"],
+            "unit": primary.get("unit", "examples/sec"),
+            "vs_baseline": round(primary["value"] / per_chip_baseline, 4),
+            "detail": {"chip": {"platform": "unknown (emit child failed)",
+                                "kind": "?", "n_devices": 1},
+                       "configs": configs},
+        }))
+        return
 
     # nothing ran on the accelerator — whole-suite CPU fallback
     causes = ["tpu: no per-config child produced a result"]

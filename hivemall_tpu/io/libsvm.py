@@ -56,6 +56,8 @@ def read_libsvm(path: str, *, zero_based: bool = False,
     fields = [] if ffm else None
     indptr = [0]
     shift = 1 if zero_based else 0
+    if ffm:
+        from ..utils.hashing import mhash   # hoisted out of the token loop
     with _open(path) as f:
         for line in f:
             line = line.strip()
@@ -73,13 +75,11 @@ def read_libsvm(path: str, *, zero_based: bool = False,
                     try:
                         fi = int(fs)
                     except ValueError:
-                        from ..utils.hashing import mhash
                         fi = mhash(fs, num_fields) - 1
                     fields.append(fi % num_fields)
                     try:
                         ii = int(i) + shift
                     except ValueError:
-                        from ..utils.hashing import mhash
                         ii = mhash(i) if dims is None else mhash(i, dims - 1)
                     indices.append(ii)
                 else:

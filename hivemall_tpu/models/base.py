@@ -97,6 +97,7 @@ class LearnerBase:
         self._fit_ds = None                   # columnar dataset ref (fit)
         self.mesh = None                      # jax Mesh when -mesh is set
         self._tp_sizes = {self.dims}          # axis sizes sharded over 'tp'
+        self._elision_off = False             # set on first non-unit batch
         self._init_state()
         if self.opts.get("mix"):
             # covariance trainers (CW/AROW/SCW) mix by argmin-KLD —
@@ -236,14 +237,22 @@ class LearnerBase:
         """Host-side per-batch hook, applied BEFORE device staging (so the
         prefetcher overlaps it with compute). Default: unit-value elision
         when the trainer's step supports it; FFM's joint layout overrides
-        to canonicalize into field-major slots."""
-        if (self.UNIT_VAL_ELISION and isinstance(batch.val, np.ndarray)
-                and isinstance(batch.idx, np.ndarray)
-                and np.array_equal(batch.val,
-                                   (batch.idx != 0).astype(np.float32))):
-            return SparseBatch(batch.idx, None, batch.label, batch.field,
-                               n_valid=batch.n_valid,
-                               fieldmajor=batch.fieldmajor)
+        to canonicalize into field-major slots.
+
+        The first non-unit batch disables the scan for the trainer's
+        lifetime (real-valued datasets stay non-unit; a unit batch arriving
+        later merely misses the optimization, which is always correct) —
+        the O(B*L) check must not tax every epoch of data that can never
+        elide."""
+        if (self.UNIT_VAL_ELISION and not self._elision_off
+                and isinstance(batch.val, np.ndarray)
+                and isinstance(batch.idx, np.ndarray)):
+            if np.array_equal(batch.val,
+                              (batch.idx != 0).astype(np.float32)):
+                return SparseBatch(batch.idx, None, batch.label, batch.field,
+                                   n_valid=batch.n_valid,
+                                   fieldmajor=batch.fieldmajor)
+            self._elision_off = True
         return batch
 
     # -- mesh sharding (SURVEY.md §3.17 / §8 M3) -----------------------------

@@ -218,6 +218,13 @@ class FMTrainer(LearnerBase):
 
     def _warm_start(self, path: str) -> None:
         z = np.load(path if path.endswith(".npz") else path + ".npz")
+        missing = [k for k in self.params if k not in z.files]
+        if missing:
+            raise ValueError(
+                f"-loadmodel {path}: saved model has keys "
+                f"{sorted(z.files)} but this trainer expects "
+                f"{sorted(self.params)} — table-layout mismatch "
+                f"(-fm_table/-ffm_table changed since the save?)")
         for k in self.params:
             if tuple(z[k].shape) != tuple(self.params[k].shape):
                 raise ValueError(

@@ -127,13 +127,15 @@ class Word2VecTrainer:
         if vocab_size * dim <= (1 << 23):
             return self._make_step_dense(cbow)
 
+        seed = int(self.opts.seed)
+
         @partial(jax.jit, donate_argnums=(0, 1))
         def step(in_emb, out_emb, ntab, center, context, nvalid, t, lr):
             # SkipGram: v_in = in[center]; target = context
             # CBOW: v_in = mean(in[context window]) handled by caller passing
             #       the window in `center` as [B, 2w] with -1 padding
             B = context.shape[0]
-            key = jax.random.fold_in(jax.random.PRNGKey(77), t)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
             negs = ntab[jax.random.randint(key, (B, neg), 0, ntab.shape[0])]
             row_mask = (jnp.arange(B) < nvalid).astype(jnp.float32)
             if cbow:
@@ -177,10 +179,12 @@ class Word2VecTrainer:
     def _make_step_dense(self, cbow: bool):
         neg = int(self.opts.neg)
 
+        seed = int(self.opts.seed)
+
         @partial(jax.jit, donate_argnums=(0, 1))
         def step(in_emb, out_emb, ntab, center, context, nvalid, t, lr):
             B = context.shape[0]
-            key = jax.random.fold_in(jax.random.PRNGKey(77), t)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
             negs = ntab[jax.random.randint(key, (B, neg), 0, ntab.shape[0])]
             row_mask = (jnp.arange(B) < nvalid).astype(jnp.float32)
 

@@ -517,3 +517,13 @@ def test_fm_fused_unit_val_elision():
     np.testing.assert_allclose(np.asarray(t1.params["T"], np.float32),
                                np.asarray(t2.params["T"], np.float32),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_fm_warm_start_layout_mismatch_is_friendly(tmp_path):
+    """Loading a split-layout save into a fused-layout trainer (or vice
+    versa) must raise the diagnostic ValueError, not a raw npz KeyError."""
+    t = FMTrainer("-dims 64 -factors 4 -fm_table split -opt adagrad")
+    p = str(tmp_path / "m.npz")
+    t.save_model(p)
+    with pytest.raises(ValueError, match="fm_table"):
+        FMTrainer(f"-dims 64 -factors 4 -opt adagrad -loadmodel {p}")
