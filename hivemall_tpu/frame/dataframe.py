@@ -18,7 +18,7 @@ import numpy as np
 from ..catalog import all_functions, lookup
 from .tools import each_top_k as _each_top_k
 
-__all__ = ["Frame"]
+__all__ = ["Frame", "GroupedFrame"]
 
 
 class Frame:
@@ -150,6 +150,12 @@ class Frame:
                 out[nm].append(v)
         return Frame(out)
 
+    def group_by(self, key_col: str) -> "GroupedFrame":
+        """HivemallGroupedDataset analog (SURVEY.md §3.18): per-group UDAF
+        aggregation, e.g. the post-hoc model-averaging query
+        ``model.group_by('feature').agg(weight=('weight', 'voted_avg'))``."""
+        return GroupedFrame(self, key_col)
+
     def __getattr__(self, name: str):
         # auto-expose every catalog trainer as df.train_xxx(features, label)
         if name.startswith("train_"):
@@ -164,3 +170,60 @@ class Frame:
 
             return method
         raise AttributeError(name)
+
+
+class GroupedFrame:
+    """Per-group aggregation over a Frame — the HivemallGroupedDataset
+    analog (reference: org.apache.spark.sql.hive.HivemallGroupedDataset,
+    SURVEY.md §3.18). Aggregators may be callables or catalog/registry
+    names: the model-averaging UDAFs ('avg', 'voted_avg',
+    'weight_voted_avg'), collection UDAFs ('collect_all', 'to_map'), or
+    any numpy reduction name ('sum', 'max', 'min', 'mean')."""
+
+    def __init__(self, frame: "Frame", key_col: str):
+        self._frame = frame
+        self._key = key_col
+
+    @staticmethod
+    def _resolve(fn):
+        if callable(fn):
+            return fn
+        name = str(fn)
+        if name in ("avg", "mean"):
+            return lambda v: float(np.mean(np.asarray(v, np.float64)))
+        if name == "voted_avg":
+            from ..parallel.averaging import voted_avg
+            return voted_avg
+        if name == "weight_voted_avg":
+            from ..parallel.averaging import weight_voted_avg
+            return weight_voted_avg
+        if name == "collect_all":
+            return list
+        if name in ("sum", "max", "min"):
+            red = getattr(np, name)
+            return lambda v: float(red(np.asarray(v, np.float64)))
+        if name == "count":
+            return len
+        raise ValueError(f"unknown aggregator {fn!r}; pass a callable or "
+                         f"one of avg|voted_avg|weight_voted_avg|"
+                         f"collect_all|sum|max|min|count")
+
+    def agg(self, **outs) -> "Frame":
+        """outs: out_col=(src_col, aggregator). Group order is first-seen
+        (the reference's GROUP BY is unordered; first-seen is deterministic
+        here)."""
+        keys = self._frame[self._key]
+        groups: Dict = {}
+        order: List = []
+        for r, k in enumerate(keys):
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(r)
+        cols: Dict[str, list] = {self._key: list(order)}
+        for out_col, (src, fn) in outs.items():
+            f = self._resolve(fn)
+            src_vals = self._frame[src]
+            cols[out_col] = [f([src_vals[r] for r in groups[k]])
+                             for k in order]
+        return Frame(cols)

@@ -136,3 +136,25 @@ def test_cli_train_bundle_resume(tmp_path, capsys):
     assert rc == 0
     capsys.readouterr()
     assert len(open(model_p).readlines()) > 0
+
+
+def test_frame_group_by_model_averaging():
+    """HivemallGroupedDataset analog: the post-hoc model-averaging query
+    GROUP BY feature + voted_avg(weight) (SURVEY.md §3.17 row 3)."""
+    from hivemall_tpu.frame.dataframe import Frame
+    # two replicas' model rows for the same features
+    f = Frame({"feature": ["a", "b", "a", "b", "c"],
+               "weight": [1.0, -2.0, 3.0, -4.0, 5.0]})
+    out = f.group_by("feature").agg(weight=("weight", "voted_avg"),
+                                    n=("weight", "count"))
+    assert out["feature"] == ["a", "b", "c"]
+    assert out["weight"] == [2.0, -3.0, 5.0]    # same-sign majority mean
+    assert out["n"] == [2, 2, 1]
+    # callables and numpy reductions work too
+    out2 = f.group_by("feature").agg(mx=("weight", "max"),
+                                     all=("weight", "collect_all"))
+    assert out2["mx"] == [3.0, -2.0, 5.0]
+    assert out2["all"][0] == [1.0, 3.0]
+    import pytest
+    with pytest.raises(ValueError):
+        f.group_by("feature").agg(x=("weight", "nope"))
