@@ -120,7 +120,13 @@ def _cmd_predict(args) -> int:
     cls = lookup(args.algo).resolve()
     trainer = cls((args.options or "")
                   + f" -loadmodel {shlex.quote(args.model)}")
-    ds = read_libsvm(args.input)
+    if getattr(trainer, "F", None) is not None and \
+            trainer.NAME == "train_ffm":
+        # field:index:value triples; scoring needs the field ids
+        ds = read_libsvm(args.input, ffm=True, num_fields=trainer.F,
+                         dims=getattr(trainer, "dims", None))
+    else:
+        ds = read_libsvm(args.input)
     # Classifiers score in probability space (auc/logloss need it);
     # regressors must emit raw predictions — sigmoid-squashing them would
     # make rmse/mae against real-valued labels meaningless.

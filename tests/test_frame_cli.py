@@ -158,3 +158,26 @@ def test_frame_group_by_model_averaging():
     import pytest
     with pytest.raises(ValueError):
         f.group_by("feature").agg(x=("weight", "nope"))
+
+
+def test_cli_ffm_train_predict_roundtrip(tmp_path, capsys):
+    """FFM LIBSVM triples (field:index:value) work through BOTH CLI paths:
+    train ingests fields, predict reloads and scores with them."""
+    data_p = str(tmp_path / "ffm.libsvm")
+    model_p = str(tmp_path / "ffm_model")
+    with open(data_p, "w") as f:
+        f.write("1 0:3:1 1:7:1\n-1 0:3:1 1:9:1\n"
+                "1 0:5:1 1:9:1\n-1 0:5:1 1:7:1\n" * 8)
+    opts = ("-dims 64 -factors 2 -fields 4 -classification -mini_batch 8 "
+            "-iters 10 -eta0 0.3 -sigma 0.3")
+    rc = _cli(["train", "--algo", "train_ffm", "--input", data_p,
+               "--options", opts, "--model", model_p])
+    assert rc == 0
+    capsys.readouterr()
+    rc = _cli(["predict", "--algo", "train_ffm", "--model", model_p,
+               "--input", data_p,
+               "--options", "-dims 64 -factors 2 -fields 4 -classification",
+               "--metric", "auc"])
+    assert rc == 0
+    pred_out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert pred_out["auc"] > 0.95
