@@ -280,3 +280,33 @@ def test_mix_exchange_is_touched_keys_only():
         assert after == before == 7.5
     finally:
         srv.stop()
+
+
+def test_fm_fused_layout_mixes_linear_weights():
+    """The packed fused FM table stores w inside T (column K of each
+    feature's block); the mix client's sparse weight access must read and
+    fold mixed weights through the packed-layout overrides."""
+    import numpy as np
+    from hivemall_tpu.models.fm import FMTrainer
+    from hivemall_tpu.parallel.mix_service import MixServer
+
+    srv = MixServer().start()
+    try:
+        opts = (f"-dims 64 -factors 4 -classification -opt adagrad "
+                f"-eta fixed -eta0 0.5 -mini_batch 8 "
+                f"-mix 127.0.0.1:{srv.port} -mix_session fmf "
+                f"-mix_threshold 2")
+        a = FMTrainer(opts)
+        b = FMTrainer(opts)
+        assert a.fm_layout == "fused"
+        for i in range(64):
+            a.process(["1:1.0"], 1)
+            b.process(["1:1.0"], -1 if i % 4 == 0 else 1)
+        ma = {r[0]: r[1] for r in a.model_rows()}
+        mb = {r[0]: r[1] for r in b.model_rows()}
+        assert a._mixer.exchanges > 0 and b._mixer.exchanges > 0
+        # mixed replicas' linear weight for the shared feature is pulled
+        # toward a common value
+        assert abs(ma["1"] - mb["1"]) < 0.35, (ma["1"], mb["1"])
+    finally:
+        srv.stop()
