@@ -22,7 +22,8 @@ import numpy as np
 
 from ..io.sparse import SparseBatch, SparseDataset, canonicalize_fieldmajor
 from ..ops.fm import (ffm_row_hash, ffm_score, fm_pack_geometry, fm_score,
-                      make_ffm_score_fused, make_ffm_step, make_ffm_step_fused,
+                      make_ffm_score_fieldmajor, make_ffm_score_fused,
+                      make_ffm_step, make_ffm_step_fused,
                       make_fm_score_fused, make_fm_step, make_fm_step_fused)
 from ..ops.losses import get_loss
 from ..ops.optimizers import make_optimizer
@@ -364,6 +365,7 @@ class FFMTrainer(FMTrainer):
                     (o.lambda0, o.lambda_w, o.lambda_v), self.F, self.k,
                     fieldmajor=True, unit_val=True)
             self._fused_score = make_ffm_score_fused(self.F, self.k)
+            self._fused_score_fm = make_ffm_score_fieldmajor(self.F, self.k)
             self._tp_sizes.add(self.Mr)     # mesh: shard T rows over tp
         else:
             self.params = {
@@ -497,6 +499,12 @@ class FFMTrainer(FMTrainer):
     def _score_batch(self, batch: SparseBatch) -> np.ndarray:
         p = self.params
         if self.layout == "joint":
+            if not batch.fieldmajor and self._step_fm is not None:
+                batch = self._preprocess_batch(batch)   # scoring fast path
+            if batch.fieldmajor:
+                return np.asarray(self._fused_score_fm(
+                    p["w0"], p["T"], jnp.asarray(batch.idx),
+                    None if batch.val is None else jnp.asarray(batch.val)))
             return np.asarray(self._fused_score(
                 p["w0"], p["T"], jnp.asarray(batch.idx),
                 jnp.asarray(batch.val), jnp.asarray(batch.field)))

@@ -321,6 +321,20 @@ def make_ffm_score_fused(F: int, K: int):
     return score
 
 
+def make_ffm_score_fieldmajor(F: int, K: int):
+    """Jitted scorer over canonical field-major batches (slot s ↔ field
+    s % F) — same no-L^2 kernel the fieldmajor train step uses. val=None
+    is unit-value elision (rebuilt from idx on device)."""
+    @jax.jit
+    def score(w0, T, idx, val):
+        if val is None:
+            val = (idx != 0).astype(jnp.float32)
+        rows = ffm_row_hash(idx, T.shape[0])
+        return _fused_phi_fieldmajor(w0.astype(jnp.float32), T[rows],
+                                     val, F, K)
+    return score
+
+
 def make_ffm_step_fused(loss: Loss, optimizer: Optimizer,
                         lambdas: Tuple[float, float, float],
                         F: int, K: int,

@@ -527,3 +527,20 @@ def test_fm_warm_start_layout_mismatch_is_friendly(tmp_path):
     t.save_model(p)
     with pytest.raises(ValueError, match="fm_table"):
         FMTrainer(f"-dims 64 -factors 4 -opt adagrad -loadmodel {p}")
+
+
+def test_ffm_scoring_fieldmajor_matches_pairs_scorer():
+    """decision_function routes canonical batches through the field-major
+    scorer; predictions must match the general pairs scorer exactly."""
+    rows, fields, labels = _xor_dataset(300)
+    ds = SparseDataset.from_rows(rows, labels, fields=fields)
+    t = FFMTrainer("-dims 64 -factors 4 -fields 4 -classification "
+                   "-opt adagrad -mini_batch 64 -iters 3 -sigma 0.3")
+    t.fit(ds)
+    fast = t.predict(ds)
+    t2 = FFMTrainer("-dims 64 -factors 4 -fields 4 -classification "
+                    "-opt adagrad -mini_batch 64 -iters 3 -sigma 0.3 "
+                    "-ffm_interaction pairs")
+    t2.fit(ds)
+    slow = t2.predict(ds)
+    np.testing.assert_allclose(fast, slow, rtol=2e-2, atol=2e-3)
