@@ -23,19 +23,32 @@ import sys
 import time
 
 
+def _is_ffm(trainer) -> bool:
+    return getattr(trainer, "F", None) is not None and \
+        trainer.NAME == "train_ffm"
+
+
+def _read_libsvm_for(trainer, path):
+    """LIBSVM read with the trainer's parsing needs (FFM triples carry
+    field ids; hashed names bound by the trainer's -dims). Shared by the
+    train and predict commands so their ingest cannot diverge."""
+    from ..io.libsvm import read_libsvm
+    if _is_ffm(trainer):
+        return read_libsvm(path, ffm=True, num_fields=trainer.F,
+                           dims=getattr(trainer, "dims", None))
+    return read_libsvm(path)
+
+
 def _load_input(args, trainer):
     """Route --input by format: LIBSVM file (default), .csv, .parquet file,
     or a DIRECTORY of parquet shards (returns a ParquetStream for
     out-of-core training). FFM trainers get field-aware parsing."""
     import os
-    from ..io.libsvm import read_libsvm
 
     path = args.input
-    ffm = getattr(trainer, "F", None) is not None and \
-        trainer.NAME == "train_ffm"
     kw = dict(feature_col=args.feature_col, label_col=args.label_col,
               dims=getattr(trainer, "dims", None))
-    if ffm:
+    if _is_ffm(trainer):
         kw.update(ffm=True, num_fields=trainer.F)
     if os.path.isdir(path):
         from ..io.arrow import ParquetStream
@@ -47,10 +60,7 @@ def _load_input(args, trainer):
         from ..io.arrow import read_csv
         return read_csv(path, label_col=args.label_col,
                         dims=getattr(trainer, "dims", None)), False
-    if ffm:
-        return read_libsvm(path, ffm=True, num_fields=trainer.F,
-                           dims=getattr(trainer, "dims", None)), False
-    return read_libsvm(path), False
+    return _read_libsvm_for(trainer, path), False
 
 
 def _cmd_train(args) -> int:
@@ -115,18 +125,11 @@ def _cmd_train(args) -> int:
 def _cmd_predict(args) -> int:
     from ..catalog import lookup
     from ..frame.evaluation import auc, logloss, rmse
-    from ..io.libsvm import read_libsvm
 
     cls = lookup(args.algo).resolve()
     trainer = cls((args.options or "")
                   + f" -loadmodel {shlex.quote(args.model)}")
-    if getattr(trainer, "F", None) is not None and \
-            trainer.NAME == "train_ffm":
-        # field:index:value triples; scoring needs the field ids
-        ds = read_libsvm(args.input, ffm=True, num_fields=trainer.F,
-                         dims=getattr(trainer, "dims", None))
-    else:
-        ds = read_libsvm(args.input)
+    ds = _read_libsvm_for(trainer, args.input)
     # Classifiers score in probability space (auc/logloss need it);
     # regressors must emit raw predictions — sigmoid-squashing them would
     # make rmse/mae against real-valued labels meaningless.

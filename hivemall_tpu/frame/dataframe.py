@@ -175,10 +175,9 @@ class Frame:
 class GroupedFrame:
     """Per-group aggregation over a Frame — the HivemallGroupedDataset
     analog (reference: org.apache.spark.sql.hive.HivemallGroupedDataset,
-    SURVEY.md §3.18). Aggregators may be callables or catalog/registry
-    names: the model-averaging UDAFs ('avg', 'voted_avg',
-    'weight_voted_avg'), collection UDAFs ('collect_all', 'to_map'), or
-    any numpy reduction name ('sum', 'max', 'min', 'mean')."""
+    SURVEY.md §3.18). Aggregators may be callables or names: the
+    model-averaging UDAFs ('avg'/'mean', 'voted_avg', 'weight_voted_avg'),
+    'collect_all', 'count', or a numpy reduction ('sum', 'max', 'min')."""
 
     def __init__(self, frame: "Frame", key_col: str):
         self._frame = frame
@@ -205,7 +204,7 @@ class GroupedFrame:
         if name == "count":
             return len
         raise ValueError(f"unknown aggregator {fn!r}; pass a callable or "
-                         f"one of avg|voted_avg|weight_voted_avg|"
+                         f"one of avg|mean|voted_avg|weight_voted_avg|"
                          f"collect_all|sum|max|min|count")
 
     def agg(self, **outs) -> "Frame":
@@ -222,6 +221,9 @@ class GroupedFrame:
             groups[k].append(r)
         cols: Dict[str, list] = {self._key: list(order)}
         for out_col, (src, fn) in outs.items():
+            if out_col == self._key:
+                raise ValueError(
+                    f"output column {out_col!r} collides with the group key")
             f = self._resolve(fn)
             src_vals = self._frame[src]
             cols[out_col] = [f([src_vals[r] for r in groups[k]])

@@ -500,7 +500,13 @@ class FFMTrainer(FMTrainer):
         p = self.params
         if self.layout == "joint":
             if not batch.fieldmajor and self._step_fm is not None:
-                batch = self._preprocess_batch(batch)   # scoring fast path
+                # scoring fast path; unlike training, a row canonicalization
+                # cannot handle (forced mode raises) just keeps the general
+                # pairs scorer — prediction must accept any row
+                try:
+                    batch = self._preprocess_batch(batch)
+                except ValueError:
+                    pass
             if batch.fieldmajor:
                 return np.asarray(self._fused_score_fm(
                     p["w0"], p["T"], jnp.asarray(batch.idx),

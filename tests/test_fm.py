@@ -544,3 +544,20 @@ def test_ffm_scoring_fieldmajor_matches_pairs_scorer():
     t2.fit(ds)
     slow = t2.predict(ds)
     np.testing.assert_allclose(fast, slow, rtol=2e-2, atol=2e-3)
+
+
+def test_ffm_forced_fieldmajor_scoring_falls_back_on_overflow():
+    """Forced -ffm_interaction fieldmajor: a scoring row with too many
+    same-field features must score through the pairs kernel, not raise."""
+    rows, fields, labels = _xor_dataset(100)
+    ds = SparseDataset.from_rows(rows, labels, fields=fields)
+    t = FFMTrainer("-dims 64 -factors 4 -fields 4 -classification "
+                   "-opt adagrad -mini_batch 32 -iters 2 "
+                   "-ffm_interaction fieldmajor")
+    t.fit(ds)
+    # 6 features all in field 0: canonicalization overflows max_m=4
+    odd = SparseDataset.from_rows(
+        [(np.arange(1, 7, dtype=np.int32), np.ones(6, np.float32))],
+        [1.0], fields=[np.zeros(6, np.int32)])
+    out = t.predict(odd)
+    assert np.isfinite(out).all()

@@ -181,3 +181,11 @@ def test_cli_ffm_train_predict_roundtrip(tmp_path, capsys):
     assert rc == 0
     pred_out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert pred_out["auc"] > 0.95
+
+
+def test_group_by_key_collision_raises():
+    from hivemall_tpu.frame.dataframe import Frame
+    import pytest
+    f = Frame({"k": ["a", "b"], "v": [1.0, 2.0]})
+    with pytest.raises(ValueError, match="collides"):
+        f.group_by("k").agg(k=("v", "sum"))
