@@ -27,7 +27,12 @@ def main():
     ap.add_argument("--data", default=None,
                     help="tsv of 'label\\tfield:idx:val ...' rows, e.g. "
                          "tests/resources/criteo_ffm.frag.tsv")
+    ap.add_argument("--mesh", default=None,
+                    help="GSPMD-shard the trainer, e.g. 'dp=2,tp=4' "
+                         "(CPU demo: JAX_PLATFORMS=cpu XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8)")
     args = ap.parse_args()
+    mesh_opt = f" -mesh {args.mesh}" if args.mesh else ""
 
     from hivemall_tpu.catalog.registry import lookup
     from hivemall_tpu.frame.evaluation import auc, logloss
@@ -78,7 +83,7 @@ def main():
                     for r in rows_cat])
 
     tr = Trainer(f"-dims 262144 -factors {args.factors} -fields {F} "
-                 f"-opt adagrad -classification -mini_batch 1024")
+                 f"-opt adagrad -classification -mini_batch 1024" + mesh_opt)
     t0 = time.time()
     for r, lab in zip(rows_cat, y):
         tr.process(ffm_features(cols, *r), int(lab))
