@@ -62,7 +62,13 @@ def _online_spec(name: str) -> OptionSpec:
     s.add("dims", "feature_dimensions", type=int, default=1 << 24,
           help="model table size")
     s.add("mini_batch", "mini_batch_size", type=int, default=1,
-          help="rows per aggregated step (1 = exact reference semantics)")
+          help="rows per step (1 = exact reference semantics)")
+    s.add("batch_mode", default="aggregate",
+          help="how a >1-row minibatch updates the model: aggregate "
+               "(one closed-form step over the batch — fast, documented "
+               "semantic delta) | sequential (lax.scan row-by-row inside "
+               "ONE device dispatch — bit-equivalent to -mini_batch 1 "
+               "reference semantics at minibatch dispatch rate)")
     s.add("iters", "iterations", type=int, default=1, help="epochs")
     s.flag("int_feature", help="features are integer indices")
     s.add("mix", default=None, help="mix cohort spec")
@@ -100,7 +106,12 @@ class _OnlineBase(LearnerBase):
         self.w = jnp.zeros(self.dims, dtype)
         self.sigma = jnp.ones(self.dims, jnp.float32) if self.HAS_COVAR \
             else None
-        self._step = self._make_step()
+        mode = str(getattr(self.opts, "batch_mode", "aggregate"))
+        if mode not in ("aggregate", "sequential"):
+            raise ValueError(f"-batch_mode must be aggregate|sequential, "
+                             f"got {mode!r}")
+        self._step = (self._make_step_sequential() if mode == "sequential"
+                      else self._make_step())
 
     # subclass: (margin_y, v, xx, y, params) -> (alpha_like, beta_like)
     #   margin_y = y * (w.x); v = sigma-weighted or plain ||x||^2
@@ -137,6 +148,52 @@ class _OnlineBase(LearnerBase):
             # cumulative hinge-ish loss for -cv reporting
             loss_sum = (jnp.maximum(0.0, 1.0 - m) * row_mask).sum()
             return w2, sigma2, loss_sum
+
+        return step
+
+    def _make_step_sequential(self):
+        """Reference-exact row-by-row updates at minibatch dispatch rate:
+        a lax.scan over the batch inside ONE jitted call. Each scan step
+        is the -mini_batch 1 update (gather the row's weights/variances,
+        closed-form rates, scatter the deltas), so the result is
+        bit-equivalent (f32) to dispatching rows one at a time — without
+        paying one host->device round trip per row. This is the
+        SURVEY §8 'online-learner semantics under batching' hard part
+        solved exactly rather than approximated."""
+        rates = self._rates()
+        has_covar = self.HAS_COVAR
+
+        @jax.jit
+        def step(w, sigma, idx, val, label, row_mask):
+            wf = w.astype(jnp.float32)
+            sig0 = sigma if has_covar else jnp.zeros((1,), jnp.float32)
+
+            def body(carry, row):
+                cw, cs = carry
+                ridx, rval, y, msk = row
+                wg = cw[ridx]
+                m = (wg * rval).sum() * y
+                if has_covar:
+                    sg = cs[ridx]
+                    v = (sg * rval * rval).sum()
+                else:
+                    sg = jnp.ones_like(rval)
+                    v = (rval * rval).sum()
+                alpha, beta = rates(m, v)
+                alpha = alpha * msk
+                beta = beta * msk
+                cw = cw.at[ridx].add(alpha * y * sg * rval)
+                if has_covar:
+                    new_sig = jnp.maximum(sg - beta * (sg * rval) ** 2,
+                                          1e-8)
+                    # .at[].max-free write: only the row's entries change
+                    cs = cs.at[ridx].set(jnp.where(msk > 0, new_sig, sg))
+                return (cw, cs), jnp.maximum(0.0, 1.0 - m) * msk
+
+            (wf, sig), losses = jax.lax.scan(
+                body, (wf, sig0), (idx, val, label, row_mask))
+            return (wf.astype(w.dtype),
+                    sig if has_covar else sigma, losses.sum())
 
         return step
 

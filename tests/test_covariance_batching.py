@@ -69,3 +69,38 @@ def test_large_batch_degradation_is_real(a9a):
     a1 = auc(te.labels, oracle.decision_function(te))
     a256 = auc(te.labels, big.decision_function(te))
     assert a1 - a256 > 0.05, (a1, a256)
+
+
+@pytest.mark.parametrize("cls_name", ["ConfidenceWeightedTrainer",
+                                      "AROWTrainer", "SCW1Trainer"])
+def test_sequential_batch_mode_is_bit_equivalent_to_row_dispatch(cls_name):
+    """-batch_mode sequential: a lax.scan minibatch must reproduce the
+    -mini_batch 1 dispatch loop exactly (same per-row update order)."""
+    import hivemall_tpu.models.classifier as C
+    from hivemall_tpu.io.sparse import SparseDataset
+    cls = getattr(C, cls_name)
+    rng = np.random.default_rng(5)
+    rows = [(rng.choice(np.arange(1, 64), 4, replace=False).astype(np.int32),
+             rng.uniform(0.5, 1.5, 4).astype(np.float32))
+            for _ in range(96)]
+    labels = [1.0 if r[0].sum() % 2 else -1.0 for r in rows]
+    ds = SparseDataset.from_rows(rows, labels)
+
+    seq = cls("-dims 64 -mini_batch 32 -batch_mode sequential")
+    seq.fit(ds, shuffle=False)
+    ref = cls("-dims 64 -mini_batch 1")
+    ref.fit(ds, shuffle=False)
+
+    np.testing.assert_allclose(np.asarray(seq.w, np.float32),
+                               np.asarray(ref.w, np.float32),
+                               rtol=1e-5, atol=1e-6)
+    if seq.sigma is not None:
+        np.testing.assert_allclose(np.asarray(seq.sigma),
+                                   np.asarray(ref.sigma),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_batch_mode_validates():
+    from hivemall_tpu.models.classifier import AROWTrainer
+    with pytest.raises(ValueError):
+        AROWTrainer("-dims 64 -batch_mode nope")
