@@ -59,7 +59,12 @@ class _MulticlassBase:
         self._labels: Dict[object, int] = {}
         self._names: Dict[int, str] = {}
         self._buf: List[Tuple[np.ndarray, np.ndarray, int]] = []
-        self._step = self._make_step()
+        mode = str(getattr(self.opts, "batch_mode", "aggregate"))
+        if mode not in ("aggregate", "sequential"):
+            raise ValueError(f"-batch_mode must be aggregate|sequential, "
+                             f"got {mode!r}")
+        self._step = (self._make_step_sequential() if mode == "sequential"
+                      else self._make_step())
         self._t = 0
 
     # -- full-state checkpointing (io.checkpoint bundles, SURVEY.md §6) ------
@@ -205,6 +210,54 @@ class _MulticlassBase:
             else:
                 sigma2 = sigma
             return W2, sigma2
+
+        return step
+
+    def _make_step_sequential(self):
+        """Reference-exact row-by-row multiclass updates in ONE dispatch
+        (lax.scan) — the models/classifier.py sequential mode for the
+        per-class table family: each row scores all classes against the
+        PREVIOUS row's updated W/sigma, exactly like the reference's
+        streaming UDTF."""
+        rates = self._rates()
+        has_covar = self.HAS_COVAR
+
+        @jax.jit
+        def step(W, sigma, idx, val, y, mask):
+            sig0 = sigma if has_covar else jnp.zeros((1, 1), jnp.float32)
+
+            def body(carry, row):
+                cW, cS = carry
+                ridx, rval, ry, msk = row
+                scores = (cW[:, ridx] * rval).sum(-1)        # [C]
+                true_s = scores[ry]
+                penal = scores.at[ry].set(-jnp.inf)
+                rival = jnp.argmax(penal)
+                m = true_s - scores[rival]
+                if has_covar:
+                    st = cS[ry, ridx]
+                    sr = cS[rival, ridx]
+                    v = ((st + sr) * rval * rval).sum()
+                else:
+                    st = sr = jnp.ones_like(rval)
+                    v = 2.0 * (rval * rval).sum()
+                alpha, beta = rates(m, v)
+                alpha = alpha * msk
+                beta = beta * msk
+                cW = cW.at[ry, ridx].add(alpha * st * rval)
+                cW = cW.at[rival, ridx].add(-alpha * sr * rval)
+                if has_covar:
+                    st_new = jnp.maximum(st - beta * (st * rval) ** 2, 1e-8)
+                    sr_new = jnp.maximum(sr - beta * (sr * rval) ** 2, 1e-8)
+                    cS = cS.at[ry, ridx].set(
+                        jnp.where(msk > 0, st_new, st))
+                    cS = cS.at[rival, ridx].set(
+                        jnp.where(msk > 0, sr_new, sr))
+                return (cW, cS), None
+
+            (W2, sig), _ = jax.lax.scan(body, (W, sig0),
+                                        (idx, val, y, mask))
+            return W2, (sig if has_covar else sigma)
 
         return step
 

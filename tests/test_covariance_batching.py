@@ -104,3 +104,28 @@ def test_sequential_batch_mode_validates():
     from hivemall_tpu.models.classifier import AROWTrainer
     with pytest.raises(ValueError):
         AROWTrainer("-dims 64 -batch_mode nope")
+
+
+@pytest.mark.parametrize("cls_name", ["MulticlassCWTrainer",
+                                      "MulticlassAROWTrainer"])
+def test_multiclass_sequential_matches_row_dispatch(cls_name):
+    import hivemall_tpu.models.multiclass as M
+    cls = getattr(M, cls_name)
+    rng = np.random.default_rng(7)
+    feats = [[f"{i}:1.0" for i in
+              rng.choice(np.arange(1, 64), 4, replace=False)]
+             for _ in range(60)]
+    labels = [int(rng.integers(0, 3)) for _ in range(60)]
+
+    seq = cls("-dims 64 -classes 4 -mini_batch 20 -batch_mode sequential")
+    ref = cls("-dims 64 -classes 4 -mini_batch 1")
+    for t in (seq, ref):
+        for f, y in zip(feats, labels):
+            t.process(f, y)
+        list(t.close())
+    np.testing.assert_allclose(np.asarray(seq.W), np.asarray(ref.W),
+                               rtol=1e-5, atol=1e-6)
+    if seq.sigma is not None:
+        np.testing.assert_allclose(np.asarray(seq.sigma),
+                                   np.asarray(ref.sigma),
+                                   rtol=1e-5, atol=1e-6)
