@@ -489,11 +489,7 @@ def _supervised():
     t_start = _time.monotonic()
     configs = []
     any_ok = False
-    for name in _BENCHES:
-        if _time.monotonic() - t_start > 1300:
-            configs.append({"metric": name, "value": 0.0, "unit": "failed",
-                            "error": "skipped: bench time budget exhausted"})
-            continue
+    def run_one(name):
         e1 = dict(env)
         e1["HIVEMALL_TPU_BENCH_ONE"] = name
         try:
@@ -503,14 +499,26 @@ def _supervised():
             lines = [l for l in out.stdout.strip().splitlines()
                      if l.startswith("{")]
             if out.returncode == 0 and lines:
-                rec = json.loads(lines[-1])
-            else:
-                rec = {"metric": name, "value": 0.0, "unit": "failed",
-                       "error": f"rc={out.returncode} "
-                                f"stderr tail: {out.stderr[-800:]}"}
+                return json.loads(lines[-1])
+            return {"metric": name, "value": 0.0, "unit": "failed",
+                    "error": f"rc={out.returncode} "
+                             f"stderr tail: {out.stderr[-800:]}"}
         except subprocess.TimeoutExpired:
-            rec = {"metric": name, "value": 0.0, "unit": "failed",
-                   "error": "timed out after 300s"}
+            return {"metric": name, "value": 0.0, "unit": "failed",
+                    "error": "timed out after 300s"}
+
+    for name in _BENCHES:
+        if _time.monotonic() - t_start > 1300:
+            configs.append({"metric": name, "value": 0.0, "unit": "failed",
+                            "error": "skipped: bench time budget exhausted"})
+            continue
+        rec = run_one(name)
+        if rec.get("unit") == "failed" and \
+                _time.monotonic() - t_start < 1200:
+            # one retry: the relay's compile service drops connections
+            # transiently ("response body closed"), which is not a
+            # property of the config being measured
+            rec = run_one(name)
         configs.append(rec)
         any_ok = any_ok or rec.get("unit") != "failed"
     if any_ok:
