@@ -189,3 +189,22 @@ def test_group_by_key_collision_raises():
     f = Frame({"k": ["a", "b"], "v": [1.0, 2.0]})
     with pytest.raises(ValueError, match="collides"):
         f.group_by("k").agg(k=("v", "sum"))
+
+
+def test_cli_train_from_parquet_shard_dir(tmp_path, capsys):
+    """Out-of-core CLI path: --input <dir of parquet shards> streams
+    through fit_stream (the NioStatefulSegment analog at corpus scale)."""
+    import numpy as np
+    from hivemall_tpu.io.arrow import write_parquet_shards
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    ds, _ = synthetic_classification(300, 40, seed=5)
+    shard_dir = str(tmp_path / "shards")
+    write_parquet_shards(ds, shard_dir, rows_per_shard=100)
+    rc = _cli(["train", "--algo", "train_classifier", "--input", shard_dir,
+               "--options",
+               "-dims 256 -loss logloss -opt adagrad -reg no -eta fixed "
+               "-eta0 0.3 -mini_batch 64 -iters 2"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["examples"] == 600          # 300 rows x 2 epochs
+    assert np.isfinite(out["cumulative_loss"])
