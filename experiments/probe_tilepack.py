@@ -1,0 +1,165 @@
+"""Probe 2: (a) XLA loop-of-F small gathers/scatters (does the small-table
+fast path survive as separate ops?), (b) Pallas RMW loop on a tile-packed
+G3 [MRF/4, 8, 128] f32 with dynamic LEADING-dim indexing, which Mosaic
+should allow (the last-two-dims tiling stays whole).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, L, W = 32768, 40, 256   # W padded to two 128-lane groups
+F = L
+MRF = 8192
+N = B * L
+
+rng = np.random.default_rng(0)
+
+
+def sync(x):
+    return float(np.asarray(jnp.asarray(x).astype(jnp.float32).sum(), np.float64))
+
+
+def timeit(fn, iters=10, repeats=3):
+    out = fn()
+    sync(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        sync(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def report(name, secs, nrows=N):
+    print(f"{name:48s} {secs*1e3:9.3f} ms  {nrows/secs/1e6:8.1f} Mrows/s  "
+          f"{secs/nrows*1e9:6.2f} ns/row", flush=True)
+
+
+def probe_xla_loops():
+    rows_np = rng.integers(0, MRF, (L, B)).astype(np.int32)
+    rows2d = jnp.asarray(rows_np)
+    g32 = jnp.asarray(rng.standard_normal((L, B, W)).astype(np.float32))
+    Ts = jnp.asarray(rng.standard_normal((L, MRF, W)), jnp.bfloat16)
+
+    @jax.jit
+    def scat_loop(rows, g32):
+        outs = []
+        for i in range(L):
+            outs.append(jnp.zeros((MRF, W), jnp.float32).at[rows[i]].add(
+                g32[i]))
+        return jnp.stack([o.sum() for o in outs]).sum()
+
+    report("xla 40x separate scatters 2^13",
+           timeit(lambda: scat_loop(rows2d, g32), iters=5))
+
+    @jax.jit
+    def gath_loop(Ts, rows):
+        acc = jnp.zeros((), jnp.float32)
+        for i in range(L):
+            acc += Ts[i][rows[i]].astype(jnp.float32).sum()
+        return acc
+
+    report("xla 40x separate gathers 2^13",
+           timeit(lambda: gath_loop(Ts, rows2d), iters=5))
+
+    # gather rate vs (Mr, W): find the fast-path boundary
+    for mr_e in (12, 13, 14, 16):
+        for w in (128, 168, 256):
+            T1 = jnp.asarray(rng.standard_normal((1 << mr_e, w)), jnp.bfloat16)
+            rf = jnp.asarray(rng.integers(0, 1 << mr_e, N).astype(np.int32))
+            g1 = jax.jit(lambda T, r: T[r].astype(jnp.float32).sum())
+            report(f"xla gather Mr=2^{mr_e} W={w}",
+                   timeit(lambda: g1(T1, rf), iters=5))
+
+
+def make_tilepack_rmw(chunk: int, unroll: int = 4):
+    """G3 [MRF//4, 8, 128] f32 accumulation with per-slot dynamic
+    leading-dim RMW. g comes tile-packed [chunk//4, 8, 128] f32 (4
+    consecutive slots per tile). Each slot's (2,128) sub-row is rotated to
+    its target sublane pair and masked-added into G3[r>>2].
+
+    Grid (L, B//chunk). This probe DOES NOT produce the true scatter (the
+    rotate/mask arithmetic is exercised, correctness checked separately).
+    """
+    nc = B // chunk
+
+    def kernel(rows_ref, g_ref, sub_iota_ref, G_ref):
+        c = pl.program_id(1)
+
+        @pl.when(jnp.logical_and(c == 0, pl.program_id(0) == 0))
+        def _():
+            G_ref[...] = jnp.zeros_like(G_ref)
+
+        sub = sub_iota_ref[...]          # [8,128] sublane-pair index 0..3
+
+        def body(i, _):
+            for u in range(unroll):
+                jt = i * unroll + u      # tile index within chunk
+                jj = c * chunk // 4 + jt
+                gtile = g_ref[jt]                     # [8,128] 4 slots
+                for s in range(4):                    # the 4 packed slots
+                    k = jj * 4 + s
+                    r = rows_ref[0, k >> 7, k & 127]
+                    rt = r >> 2
+                    p = r & 3
+                    rolled = pltpu.roll(gtile, (p - s) * 2, 0)
+                    add = jnp.where(sub == p, rolled, 0.0)
+                    G_ref[rt] += add
+            return 0
+
+        jax.lax.fori_loop(0, chunk // 4 // unroll, body, 0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(L, nc),
+        in_specs=[
+            pl.BlockSpec((1, B // 128, 128), lambda g, c: (g, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((chunk // 4, 8, 128),
+                         lambda g, c: (g * nc + c, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 128), lambda g, c: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((MRF // 4, 8, 128), lambda g, c: (0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((MRF // 4, 8, 128), jnp.float32),
+    )
+
+
+def probe_tilepack():
+    rows_np = rng.integers(0, MRF, (L, B)).astype(np.int32)
+    rows = jnp.asarray(rows_np.reshape(L, B // 128, 128))
+    g = jnp.asarray(rng.standard_normal((L * B // 4, 8, 128)).astype(np.float32))
+    sub = jnp.asarray(np.repeat(np.arange(4), 2)[:, None]
+                      * np.ones((1, 128), np.int32), jnp.int32)
+
+    for chunk, unroll in ((2048, 2), (2048, 4), (4096, 4)):
+        try:
+            fn = jax.jit(make_tilepack_rmw(chunk, unroll))
+            secs = timeit(lambda: fn(rows, g, sub), iters=5)
+            report(f"pallas tilepack-rmw chunk={chunk} u={unroll}", secs)
+        except Exception as e:
+            print(f"tilepack {chunk}/{unroll}: FAIL {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    print(jax.devices(), flush=True)
+    which = sys.argv[1:] or ["xla", "tile"]
+    if "xla" in which:
+        probe_xla_loops()
+    if "tile" in which:
+        probe_tilepack()

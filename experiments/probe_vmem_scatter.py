@@ -1,0 +1,152 @@
+"""Probe: Pallas per-slot RMW accumulation into a VMEM-resident G tile.
+
+S3 of the planned field-partitioned FFM step: for each field partition g,
+accumulate gslab_g [B, W] into G_g [Mr_f, W] (VMEM scratch), sequential
+fori_loop RMW — no DMA per row, no XLA scatter. Question: cycles/slot?
+
+Also: XLA scatter as a python loop of F independent small scatters
+(non-vmapped), to see if the small-table fast path survives.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, L, W = 32768, 40, 256
+F = 40
+MRF = 8192          # per-field partition rows (pow2 >= 262144/40)
+N = B * L
+
+rng = np.random.default_rng(0)
+
+
+def sync(x):
+    return float(np.asarray(jnp.asarray(x).astype(jnp.float32).sum(), np.float64))
+
+
+def timeit(fn, iters=10, repeats=3):
+    out = fn()
+    sync(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        sync(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def report(name, secs, nrows=N):
+    print(f"{name:46s} {secs*1e3:9.3f} ms  {nrows/secs/1e6:8.1f} Mrows/s  "
+          f"{secs/nrows*1e9:6.2f} ns/row", flush=True)
+
+
+def make_pallas_scatter(chunk: int, unroll: int = 1, w: int = W):
+    """gslab [L, B, w] bf16 + rows [L, B//128, 128] -> G [L, MRF, w] f32.
+
+    Grid (L, B//chunk); G block revisited across chunk steps (accumulate in
+    VMEM), written out once per field.
+    """
+    nc = B // chunk
+
+    def kernel(rows_ref, g_ref, G_ref):
+        c = pl.program_id(1)
+
+        @pl.when(c == 0)
+        def _():
+            G_ref[...] = jnp.zeros_like(G_ref)
+
+        def body(i, _):
+            for u in range(unroll):
+                j = i * unroll + u
+                jj = c * chunk + j
+                r = rows_ref[0, jj >> 7, jj & 127]
+                G_ref[r, :] += g_ref[j, :].astype(jnp.float32)
+            return 0
+
+        jax.lax.fori_loop(0, chunk // unroll, body, 0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(L, nc),
+        in_specs=[
+            pl.BlockSpec((1, B // 128, 128), lambda g, c: (g, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((chunk, w), lambda g, c: (g * nc + c, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((MRF, w), lambda g, c: (g, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((L * MRF, w), jnp.float32),
+    )
+
+
+def main():
+    rows_np = rng.integers(0, MRF, (L, B)).astype(np.int32)
+    rows = jnp.asarray(rows_np.reshape(L, B // 128, 128))
+    g16 = jnp.asarray(rng.standard_normal((L * B, W)).astype(np.float32),
+                      jnp.bfloat16)
+
+    for chunk, unroll in ((8192, 1), (8192, 4)):
+        try:
+            fn = jax.jit(make_pallas_scatter(chunk, unroll))
+            secs = timeit(lambda: fn(rows, g16), iters=5)
+            report(f"pallas vmem-scatter chunk={chunk} u={unroll}", secs)
+        except Exception as e:
+            print(f"pallas chunk={chunk} u={unroll}: FAIL "
+                  f"{type(e).__name__}: {str(e)[:300]}", flush=True)
+
+    # correctness check on small case
+    fn = jax.jit(make_pallas_scatter(8192, 1))
+    out = fn(rows, g16).reshape(L, MRF, W)
+    ref = jax.jit(lambda r, g: jax.vmap(
+        lambda rr, gg: jnp.zeros((MRF, W), jnp.float32).at[rr].add(
+            gg.astype(jnp.float32)))(r, g))(jnp.asarray(rows_np),
+                                            g16.reshape(L, B, W))
+    err = float(jnp.abs(out - ref).max())
+    print(f"correctness max|diff| = {err:.3e}", flush=True)
+
+    # XLA: python loop of 40 small scatters into separate arrays
+    g32 = jnp.asarray(rng.standard_normal((L, B, W)).astype(np.float32))
+    Gs = [jnp.zeros((MRF, W), jnp.float32) for _ in range(L)]
+
+    @jax.jit
+    def scat_loop(rows, g32):
+        outs = []
+        for i in range(L):
+            outs.append(jnp.zeros((MRF, W), jnp.float32).at[rows[i]].add(
+                g32[i]))
+        return outs
+
+    rows2d = jnp.asarray(rows_np)
+    report("xla 40x separate scatters 2^13",
+           timeit(lambda: scat_loop(rows2d, g32), iters=5))
+
+    # XLA: gather loop from 40 small tables
+    Ts = jnp.asarray(rng.standard_normal((L, MRF, W)), jnp.bfloat16)
+
+    @jax.jit
+    def gath_loop(Ts, rows):
+        acc = jnp.zeros((), jnp.float32)
+        for i in range(L):
+            acc += Ts[i][rows[i]].astype(jnp.float32).sum()
+        return acc
+
+    report("xla 40x separate gathers 2^13",
+           timeit(lambda: gath_loop(Ts, rows2d), iters=5))
+
+
+if __name__ == "__main__":
+    print(jax.devices(), flush=True)
+    main()
