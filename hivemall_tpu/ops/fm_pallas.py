@@ -1,0 +1,342 @@
+"""Pallas fused scatter/optimizer step for train_ffm — the "parts" layout.
+
+Reference behavior: hivemall.fm.FieldAwareFactorizationMachineUDTF's per-row
+AdaGrad updates of (feature, field) latent cells (SURVEY.md §3.6). This
+module is the round-3 answer to the flagship gap: the XLA scatter-add costs
+~24-26 ns per table row (measured, experiments/probe_idx.py) and the dense
+optimizer pass another ~7 ms — together over half the step. Here the whole
+gradient-accumulate + AdaGrad-apply side runs in one Pallas kernel against a
+VMEM-resident per-field gradient tile, so the batch gradient NEVER
+materializes in HBM and the optimizer pass rides the same kernel.
+
+Layout ("parts" = field-partitioned fused feature rows):
+  - logical table: F partitions x MRF rows, row (g, h) = the fused record
+    [V[g,h][0..F-1][0..K-1] | w | pad] of one hashed feature whose OWN field
+    is g: Wp = 128*ceil((F*K+8)/128) columns. Capacity F*MRF >= Mr matches
+    the joint layout's -dims semantics (collisions only within a field).
+  - physical storage: T2 [F*MRF*HP, 128] (HP = Wp/128 half-rows), i.e. each
+    logical row r is HP consecutive 128-lane rows starting at HP*r. ONE
+    gather index per slot fetches the (HP, 128) window via the free
+    [N*HP, 128] -> [N, HP, 128] reshape; the same trick makes the gradient
+    slab reshape into the kernel's (16, 128) bf16 tiles for free.
+  - AdaGrad state S2 f32, co-shaped with T2.
+
+Step (shapes for the flagship: B=32768, L=F=40, K=4, MRF=8192, Wp=256):
+  1. XLA: rows[l, b] = l//? -- slot l has field l % F; flat row id =
+     (l % F) * MRF + (murmur-mix(idx) & (MRF-1)).
+  2. XLA: slab = T3[rows]  ([L, B, HP, 128], ONE index op per slot), fwd
+     phi + loss + grad wrt slab via autodiff (same math as
+     ops.fm._fused_phi_fieldmajor, axes [L, B]), per-occurrence L2 folded
+     into the slab gradient exactly like make_ffm_step_fused.
+  3. Pallas (grid (F, m*nc + n_opt)): accumulate the packed bf16 gradient
+     tiles into G [MRF*HP/8, 8, 128] f32 VMEM scratch by per-slot
+     roll+add RMW (measured ~17 ns/row vs XLA scatter's 24-26), then in the
+     same kernel's tail steps apply AdaGrad to the partition's T2/S2 blocks
+     (in-place via input_output_aliases).
+
+Semantics deltas vs make_ffm_step_fused (documented, tested):
+  - hashing: per-field hash h_g(idx) instead of one joint feature hash, so
+    a feature id appearing under two different fields occupies two rows
+    (the reference's packed-long (feature, field) keys are also distinct
+    per field; capacity is F*MRF*f_pow2-ish >= -dims).
+  - AdaGrad accumulators see the square of the summed minibatch gradient,
+    same as the joint fused step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .losses import Loss
+
+__all__ = ["parts_geometry", "parts_row_hash", "make_parts_step",
+           "make_parts_score", "parts_supported"]
+
+_J1, _J3 = 0x9E3779B1, 0xC2B2AE35
+_EPS = 1e-6
+
+
+def parts_geometry(dims: int, F: int, K: int) -> Tuple[int, int, int]:
+    """(MRF, Wp, HP): per-field partition rows, padded row width, and
+    half-rows per logical row. MRF is the power of two making F*MRF the
+    smallest field-partitioned table with at least the joint layout's
+    Mr = dims / next_pow2(F) rows (same -dims capacity semantics)."""
+    f_pow2 = 1
+    while f_pow2 < F:
+        f_pow2 <<= 1
+    mr_joint = max(1 << 10, dims // f_pow2)
+    mrf = 1 << 10
+    while F * mrf < mr_joint:
+        mrf <<= 1
+    wp = 128 * (-(-(F * K + 8) // 128))
+    return mrf, wp, wp // 128
+
+
+def parts_row_hash(idx, field, MRF: int):
+    """Flat physical row id in [0, F*MRF): field partition + murmur-mix of
+    the feature id folded to the partition (ops.fm.ffm_row_hash's mix).
+    Row 0 of each partition doubles as that partition's padding row."""
+    h = idx.astype(jnp.uint32) * jnp.uint32(_J1)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(_J3)
+    h = h ^ (h >> 13)
+    return (field.astype(jnp.int32) * MRF
+            + (h & jnp.uint32(MRF - 1)).astype(jnp.int32))
+
+
+def _phi_parts(w0f, slab, val, F: int, K: int):
+    """Field-major FFM score over [L, B, Wp] slabs (slot-major axes — the
+    [B, L] version is ops.fm._fused_phi_fieldmajor; same math, same no-L^2
+    factorization). The interaction runs in the slab's own dtype with f32
+    accumulation — the same -halffloat policy the joint path applies to
+    its pair mixing (bf16 halves the C-tensor traffic, measured +17%
+    there); the linear part is always f32."""
+    L, B = val.shape
+    m = L // F
+    FK = F * K
+    Vg = slab[..., :FK].reshape(m, F, B, F, K)       # [m, g, B, f, k]
+    wg = slab[..., FK].astype(jnp.float32)           # [L, B]
+    U = Vg * val.reshape(m, F, B, 1, 1).astype(Vg.dtype)
+    C = U if m == 1 else U.astype(jnp.float32).sum(0, keepdims=True)
+    C = C.reshape(F, B, F, K)                        # [g, B, f, k]
+    full = jnp.einsum("gbfk,fbgk->b", C, C,
+                      preferred_element_type=jnp.float32)
+    own = jnp.einsum("mgbgk->mbgk", U.reshape(m, F, B, F, K)).astype(
+        jnp.float32)
+    diag = (own * own).sum((0, 2, 3))
+    return w0f + (wg * val).sum(0) + 0.5 * (full - diag)
+
+
+def _roll_pad8(piece, shift):
+    """piece [2, 128] f32 -> [8, 128] with the pair placed at sublane-pair
+    `shift` (dynamic); other sublanes zero."""
+    padded = jnp.concatenate([piece, jnp.zeros((6, 128), piece.dtype)], 0)
+    return pltpu.roll(padded, shift * 2, 0)
+
+
+def _make_scatter_opt_kernel(B: int, L: int, F: int, MRF: int, HP: int,
+                             chunk: int, r_opt: int,
+                             interpret: bool = False):
+    """pallas_call: accumulate packed gradient tiles into a VMEM G and
+    apply AdaGrad to the field partition's T2/S2 blocks in the tail steps.
+
+    Only HP == 2 is wired (Wp = 256: flagship K=4, F<=62); other widths
+    fall back to the XLA step.
+    """
+    assert HP == 2
+    m = L // F
+    nc = B // chunk
+    n_acc = m * nc
+    gt_rows = MRF * HP // 8          # f32 (8,128) G tiles per partition
+    n_opt = MRF * HP // r_opt
+    grid = (F, n_acc + n_opt)
+
+    def kernel(rows_ref, eta_ref, g_ref, t_ref, s_ref, tout_ref, sout_ref,
+               G_ref):
+        c = pl.program_id(1)
+
+        @pl.when(c == 0)
+        def _():
+            G_ref[...] = jnp.zeros_like(G_ref)
+
+        @pl.when(c < n_acc)
+        def _acc():
+            cc = c % nc
+            base = (c // nc) * B          # slot-row offset (m > 1)
+
+            def body(i, _):
+                # one packed bf16 tile = 8 slots' (2,128) gradient rows
+                gtile = g_ref[0, i].astype(jnp.float32)       # [16, 128]
+                for u in range(8):
+                    j = base + cc * chunk + i * 8 + u
+                    r = rows_ref[0, j >> 7, j & 127]          # local row
+                    piece = gtile[2 * u:2 * u + 2, :]
+                    G_ref[r >> 2] += _roll_pad8(piece, r & 3)
+                return 0
+
+            jax.lax.fori_loop(0, chunk // 8, body, 0)
+
+        @pl.when(c >= n_acc)
+        def _opt():
+            j = c - n_acc
+            Gt = G_ref[pl.ds(j * (r_opt // 8), r_opt // 8)]
+            G2 = Gt.reshape(r_opt, 128)
+            gg = s_ref[...] + G2 * G2
+            w = t_ref[...].astype(jnp.float32)
+            wn = w - eta_ref[0, 0] * G2 / (jnp.sqrt(gg) + _EPS)
+            sout_ref[...] = gg
+            tout_ref[...] = wn.astype(tout_ref.dtype)
+
+    def rows_spec():
+        return pl.BlockSpec((1, (m * B) // 128, 128),
+                            lambda g, c: (g, 0, 0),
+                            memory_space=pltpu.SMEM)
+
+    def g_spec():
+        # packed grad [F, m*B*HP/16, 16, 128] bf16; block = one chunk of
+        # one slot-row (m index folded into the chunk sequence)
+        return pl.BlockSpec(
+            (1, chunk * HP // 16, 16, 128),
+            lambda g, c: (g, jnp.minimum(c, n_acc - 1), 0, 0),
+            memory_space=pltpu.VMEM)
+
+    def t_spec():
+        # T2 [F*MRF*HP, 128] -> per-partition opt blocks of r_opt rows;
+        # during accumulate steps park on the partition's block 0 (fetched
+        # once; contents unused there)
+        def imap(g, c):
+            j = jnp.maximum(c - n_acc, 0)
+            return (g * n_opt + j, 0)
+        return imap
+
+    eta_spec = pl.BlockSpec((1, 1), lambda g, c: (0, 0),
+                            memory_space=pltpu.SMEM)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            rows_spec(),
+            eta_spec,
+            g_spec(),
+            pl.BlockSpec((r_opt, 128), t_spec(), memory_space=pltpu.VMEM),
+            pl.BlockSpec((r_opt, 128), t_spec(), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((r_opt, 128), t_spec(), memory_space=pltpu.VMEM),
+            pl.BlockSpec((r_opt, 128), t_spec(), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((F * MRF * HP, 128), jnp.bfloat16),
+            jax.ShapeDtypeStruct((F * MRF * HP, 128), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((gt_rows, 8, 128), jnp.float32)],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret,
+    )
+
+
+def parts_supported(F: int, K: int, opt_name: str, dtype) -> bool:
+    """The pallas step handles the flagship envelope; everything else uses
+    the XLA joint step."""
+    wp = 128 * (-(-(F * K + 8) // 128))
+    return (wp == 256 and opt_name == "adagrad"
+            and dtype == jnp.bfloat16
+            and jax.default_backend() in ("tpu", "cpu"))
+
+
+def make_parts_step(loss: Loss, eta_fn: Callable, lambdas, F: int, K: int,
+                    MRF: int, unit_val: bool = False,
+                    interpret: bool = False) -> Callable:
+    """Jitted train step over the parts layout.
+
+    params: {"w0": f32 scalar-ish, "T2": [F*MRF*HP, 128] bf16}
+    opt_state: {"w0": {"gg"}, "T2": {"gg": S2 [F*MRF*HP, 128] f32}}
+    batch: canonical field-major idx [B, L] (slot s -> field s % F), val
+    [B, L] (or elided), label [B], row_mask [B].
+    """
+    lam0, lam_w, lam_v = lambdas
+    wp = 128 * (-(-(F * K + 8) // 128))
+    hp = wp // 128
+    assert hp == 2, "parts step requires Wp == 256 (use parts_supported)"
+    FK = F * K
+
+    def step_impl(params, opt_state, t, idx, val, label, row_mask):
+        T2, w0 = params["T2"], params["w0"]
+        S2 = opt_state["T2"]["gg"]
+        B, L = idx.shape
+        m = L // F
+        chunk = min(2048, B)
+        assert B % chunk == 0 and chunk % 8 == 0, \
+            "parts step needs the batch padded to a multiple of 8"
+        r_opt = min(1024, MRF * hp)
+        kern = _make_scatter_opt_kernel(B, L, F, MRF, hp, chunk, r_opt,
+                                        interpret=interpret)
+
+        if val is None:
+            val = (idx != 0).astype(jnp.float32)
+        # slot-major orientation
+        idxT = idx.T                                    # [L, B]
+        valT = val.T
+        fieldT = (jnp.arange(L, dtype=jnp.int32) % F)[:, None]
+        rows = parts_row_hash(idxT, fieldT, MRF)        # [L, B] flat ids
+        T3 = T2.reshape(F * MRF, hp, 128)
+        slab = T3[rows]                                 # [L, B, hp, 128]
+
+        def batch_loss(w0f, slabf):
+            phi = _phi_parts(w0f, slabf.reshape(L, B, wp), valT, F, K)
+            return (loss.loss(phi, label) * row_mask).sum()
+
+        loss_sum, (g0, gslab) = jax.value_and_grad(
+            batch_loss, argnums=(0, 1))(w0.astype(jnp.float32), slab)
+        gslab = gslab.astype(jnp.float32).reshape(L, B, wp)
+
+        # per-occurrence L2 on present entries, at slab level (identical
+        # semantics to make_ffm_step_fused)
+        if lam_w or lam_v:
+            pm = (valT != 0).astype(jnp.float32) * row_mask[None, :]
+            lam_col = jnp.concatenate([
+                jnp.full((FK,), lam_v, jnp.float32),
+                jnp.full((wp - FK,), lam_w, jnp.float32)])
+            gslab = gslab + lam_col * slab.astype(jnp.float32).reshape(
+                L, B, wp) * pm[..., None]
+        g0 = g0 + lam0 * w0.astype(jnp.float32)
+
+        # pack for the kernel: [L, B, hp, 128] -> [F, m*B*hp/16, 16, 128]
+        gpack = gslab.reshape(L, B, hp, 128).astype(jnp.bfloat16)
+        gpack = gpack.reshape(m, F, B * hp // 16, 16, 128)
+        gpack = gpack.transpose(1, 0, 2, 3, 4).reshape(
+            F, m * B * hp // 16, 16, 128)
+        # local (within-partition) row ids for the kernel, [F, m*B] packed
+        local = (rows - fieldT * MRF).reshape(m, F, B)
+        local = local.transpose(1, 0, 2).reshape(F, (m * B) // 128, 128)
+
+        eta_t = jnp.asarray(eta_fn(t), jnp.float32).reshape(1, 1)
+        T2n, S2n = kern(local, eta_t, gpack, T2, S2)
+
+        # w0: plain AdaGrad scalar step
+        gg0 = opt_state["w0"]["gg"] + g0 * g0
+        w0n = (w0.astype(jnp.float32)
+               - eta_fn(t) * g0 / (jnp.sqrt(gg0) + _EPS)).astype(w0.dtype)
+
+        return ({"T2": T2n, "w0": w0n},
+                {"T2": {"gg": S2n}, "w0": {"gg": gg0}}, loss_sum)
+
+    if unit_val:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, t, idx, label, row_mask):
+            return step_impl(params, opt_state, t, idx, None, label,
+                             row_mask)
+    else:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, t, idx, val, label, row_mask):
+            return step_impl(params, opt_state, t, idx, val, label,
+                             row_mask)
+    return step
+
+
+def make_parts_score(F: int, K: int, MRF: int):
+    """Jitted scorer over the parts layout for canonical field-major
+    batches (slot s -> field s % F)."""
+    wp = 128 * (-(-(F * K + 8) // 128))
+    hp = wp // 128
+
+    @jax.jit
+    def score(w0, T2, idx, val):
+        if val is None:
+            val = (idx != 0).astype(jnp.float32)
+        B, L = idx.shape
+        idxT, valT = idx.T, val.T
+        fieldT = (jnp.arange(L, dtype=jnp.int32) % F)[:, None]
+        rows = parts_row_hash(idxT, fieldT, MRF)
+        T3 = T2.reshape(F * MRF, hp, 128)
+        slab = T3[rows].astype(jnp.float32).reshape(L, B, wp)
+        return _phi_parts(w0.astype(jnp.float32), slab, valT, F, K)
+
+    return score
