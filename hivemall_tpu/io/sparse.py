@@ -72,9 +72,18 @@ class SparseBatch:
 
     @property
     def row_mask(self) -> np.ndarray:
-        b = self.batch_size
-        n = b if self.n_valid is None else self.n_valid
-        return (np.arange(b) < n).astype(np.float32)
+        """Valid-row mask, cached: building it fresh per access made every
+        jitted-step call re-transfer 4*B bytes h2d (measured ~5 ms/step for
+        B=32k through the ~25 MB/s relay when the same batch is stepped
+        repeatedly). The cache also lets jax reuse the device buffer."""
+        m = self.__dict__.get("_row_mask")
+        if m is None:
+            import jax.numpy as jnp
+            b = self.batch_size
+            n = b if self.n_valid is None else self.n_valid
+            m = jnp.asarray((np.arange(b) < n).astype(np.float32))
+            object.__setattr__(self, "_row_mask", m)
+        return m
 
 
 def canonicalize_fieldmajor(idx: np.ndarray, val: np.ndarray,

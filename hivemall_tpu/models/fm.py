@@ -299,8 +299,11 @@ class FFMTrainer(FMTrainer):
               help="field-space size F")
         s.add("ffm_table", default="auto",
               help="latent-table layout: joint (hashed flat [M,K], "
-                   "Criteo-scale) | dense ([N,F,K] field cube) | auto "
-                   "(joint when -dims is a power of two, else dense)")
+                   "Criteo-scale) | parts (field-partitioned fused rows "
+                   "with the Pallas VMEM scatter+AdaGrad kernel — fastest "
+                   "on TPU for adagrad/-halffloat/fieldmajor configs) | "
+                   "dense ([N,F,K] field cube) | auto (joint when -dims "
+                   "is a power of two, else dense)")
         s.add("ffm_interaction", default="auto",
               help="pair-interaction kernel for the joint layout: "
                    "fieldmajor (canonical field-major batches, no L^2 "
@@ -322,8 +325,8 @@ class FFMTrainer(FMTrainer):
         self.k = int(o.factors)
         self.F = int(o.fields)
         self.layout = str(o.ffm_table)
-        if self.layout not in ("joint", "dense", "auto"):
-            raise ValueError(f"-ffm_table must be joint|dense|auto, "
+        if self.layout not in ("joint", "dense", "auto", "parts"):
+            raise ValueError(f"-ffm_table must be joint|parts|dense|auto, "
                              f"got {self.layout!r}")
         self.interaction = str(getattr(o, "ffm_interaction", "auto"))
         if self.interaction not in ("auto", "pairs", "fieldmajor"):
@@ -332,11 +335,55 @@ class FFMTrainer(FMTrainer):
         pow2 = (self.dims & (self.dims - 1)) == 0
         if self.layout == "auto":
             self.layout = "joint" if pow2 else "dense"
-        if self.layout == "joint" and not pow2:
-            raise ValueError("-ffm_table joint needs a power-of-two -dims "
-                             f"(got {self.dims})")
+        if self.layout in ("joint", "parts") and not pow2:
+            raise ValueError(f"-ffm_table {self.layout} needs a "
+                             f"power-of-two -dims (got {self.dims})")
         dtype = jnp.bfloat16 if o.halffloat else jnp.float32
         key = jax.random.PRNGKey(int(o.seed))
+        if self.layout == "parts":
+            from ..ops.fm_pallas import (parts_geometry, make_parts_step,
+                                         make_parts_score, parts_supported)
+            from ..ops.schedules import make_eta
+            if not parts_supported(self.F, self.k, self.optimizer.name,
+                                   dtype):
+                raise ValueError(
+                    "-ffm_table parts requires -opt adagrad, -halffloat, "
+                    f"and F*K+8 <= 248 (got opt={self.optimizer.name}, "
+                    f"dtype={dtype.__name__}, F={self.F}, K={self.k}); "
+                    "use -ffm_table joint")
+            self.MRF, self.Wp, self.HP = parts_geometry(self.dims, self.F,
+                                                        self.k)
+            FK = self.F * self.k
+            Tl = jnp.concatenate([
+                jax.random.normal(key, (self.F * self.MRF, FK))
+                * float(o.sigma),
+                jnp.zeros((self.F * self.MRF, self.Wp - FK)),
+            ], axis=1)
+            self.params = {
+                "w0": jnp.zeros((), jnp.float32),
+                "T2": Tl.reshape(self.F * self.MRF * self.HP,
+                                 128).astype(dtype)}
+            self.opt_state = {
+                "w0": self.optimizer.init(()),
+                "T2": {"gg": jnp.zeros((self.F * self.MRF * self.HP, 128),
+                                       jnp.float32)}}
+            eta_fn = make_eta(o.eta, o.eta0, o.total_steps, o.power_t)
+            interp = jax.default_backend() != "tpu"
+            lamt = (o.lambda0, o.lambda_w, o.lambda_v)
+            self._step = None
+            self._step_fm = make_parts_step(
+                self.loss, eta_fn, lamt, self.F, self.k, self.MRF,
+                interpret=interp)
+            self._step_fm_unit = make_parts_step(
+                self.loss, eta_fn, lamt, self.F, self.k, self.MRF,
+                unit_val=True, interpret=interp)
+            self._fused_score = None
+            self._fused_score_fm = make_parts_score(self.F, self.k,
+                                                    self.MRF)
+            self.interaction = "fieldmajor"   # parts is fieldmajor-only
+            self._pairs = set()
+            self._fit_ds = None
+            return
         if self.layout == "joint":
             f_pow2 = 1
             while f_pow2 < self.F:
@@ -388,6 +435,13 @@ class FFMTrainer(FMTrainer):
         self._pairs: set = set()       # (feature_id, field) seen, stream path
         self._fit_ds = None            # dataset ref, columnar path
 
+    def _apply_mesh(self, spec: str) -> None:
+        if getattr(self, "layout", None) == "parts":
+            raise ValueError("-mesh is not supported with -ffm_table parts "
+                             "(the Pallas kernel is single-chip); use "
+                             "-ffm_table joint for GSPMD sharding")
+        super()._apply_mesh(spec)
+
     def _batch_args(self, batch: SparseBatch) -> tuple:
         if batch.field is None:
             raise ValueError("train_ffm needs field ids; use "
@@ -395,6 +449,31 @@ class FFMTrainer(FMTrainer):
         return (batch.field,)
 
     def _preprocess_batch(self, batch: SparseBatch) -> SparseBatch:
+        batch = self._canonicalize_batch(batch)
+        if self.layout == "parts" and batch.fieldmajor:
+            batch = self._pad_parts_rows(batch)
+        return batch
+
+    def _pad_parts_rows(self, batch: SparseBatch) -> SparseBatch:
+        """Pad the batch's row count to the Pallas kernel's grid multiple
+        (128 rows — the SMEM row-id packing — up to 2048, then 2048-row
+        chunks); padded rows carry idx 0 and are masked out of the loss by
+        n_valid."""
+        B = batch.batch_size
+        mult = 128 if B <= 2048 else 2048
+        target = -(-B // mult) * mult
+        if target == B:
+            return batch
+        pad = target - B
+        idx = np.pad(np.asarray(batch.idx), ((0, pad), (0, 0)))
+        val = None if batch.val is None else np.pad(
+            np.asarray(batch.val), ((0, pad), (0, 0)))
+        lab = np.pad(np.asarray(batch.label), (0, pad))
+        nv = batch.n_valid if batch.n_valid is not None else B
+        return SparseBatch(idx, val, lab, None, n_valid=nv,
+                           fieldmajor=True)
+
+    def _canonicalize_batch(self, batch: SparseBatch) -> SparseBatch:
         """Canonicalize one host batch into field-major slots (slot s holds
         a feature of field s % F) so the jitted step can run the static
         field-grouped interaction — no L^2 intermediate, no per-slot field
@@ -498,6 +577,14 @@ class FFMTrainer(FMTrainer):
 
     def _score_batch(self, batch: SparseBatch) -> np.ndarray:
         p = self.params
+        if self.layout == "parts":
+            B0 = batch.batch_size
+            if not batch.fieldmajor:
+                batch = self._preprocess_batch(batch)   # forced; may raise
+            out = np.asarray(self._fused_score_fm(
+                p["w0"], p["T2"], jnp.asarray(batch.idx),
+                None if batch.val is None else jnp.asarray(batch.val)))
+            return out[:B0]            # drop kernel-grid padding rows
         if self.layout == "joint":
             if not batch.fieldmajor and self._step_fm is not None:
                 # scoring fast path; unlike training, a row canonicalization
@@ -518,12 +605,13 @@ class FFMTrainer(FMTrainer):
                                     batch.idx, batch.val, batch.field))
 
     def _wants_fit_ds(self) -> bool:
-        return self.layout == "joint"     # emission needs observed pairs
+        # emission needs observed pairs
+        return self.layout in ("joint", "parts")
 
     def _note_batch(self, batch) -> None:
         """Streaming path (fit_stream): record observed (feature, field)
         pairs so joint-layout model emission keeps names/fields."""
-        if self.layout != "joint" or batch.field is None:
+        if self.layout not in ("joint", "parts") or batch.field is None:
             return
         idx = np.asarray(batch.idx)
         fld = np.asarray(batch.field)
@@ -553,8 +641,15 @@ class FFMTrainer(FMTrainer):
         ii, ff = np.divmod(uniq, self.F)
         return ii.astype(np.int32), ff.astype(np.int32)
 
-    def _rows_for(self, keys: np.ndarray) -> np.ndarray:
-        """Host-side fused-table row ids for feature ids (joint layout)."""
+    def _rows_for(self, keys: np.ndarray, fields: np.ndarray = None
+                  ) -> np.ndarray:
+        """Host-side fused-table row ids for feature ids (joint layout) or
+        (feature, own-field) pairs (parts layout)."""
+        if self.layout == "parts":
+            from ..ops.fm_pallas import parts_row_hash
+            return np.asarray(parts_row_hash(
+                jnp.asarray(keys, jnp.int32),
+                jnp.asarray(fields, jnp.int32), self.MRF))
         return np.asarray(ffm_row_hash(jnp.asarray(keys, jnp.int32),
                                        self.Mr))
 
@@ -581,7 +676,11 @@ class FFMTrainer(FMTrainer):
                         yield (name, f, float(w[i]), V[i, f].tolist())
             return
         FK = self.F * self.k
-        T = np.asarray(self.params["T"].astype(jnp.float32))
+        if self.layout == "parts":
+            T = np.asarray(self.params["T2"].astype(jnp.float32)).reshape(
+                self.F * self.MRF, self.Wp)
+        else:
+            T = np.asarray(self.params["T"].astype(jnp.float32))
         pairs = self._observed_pairs()
         if pairs is None:
             live = np.nonzero(np.abs(T[:, :FK]).sum(-1) > 0)[0]
@@ -593,7 +692,7 @@ class FFMTrainer(FMTrainer):
                                vec.tolist())
             return
         ii, ff = pairs
-        rr = self._rows_for(ii)
+        rr = self._rows_for(ii, ff)
         for i, f, r in zip(ii.tolist(), ff.tolist(), rr.tolist()):
             if i == 0:
                 continue
@@ -603,11 +702,14 @@ class FFMTrainer(FMTrainer):
 
     # -- sparse weight access for the mix client (joint layout) -------------
     def _weight_table(self):
-        if self.layout == "joint":
+        if self.layout in ("joint", "parts"):
             return None                # w lives inside T; use overrides
         return super()._weight_table()
 
     def _get_weights_at(self, keys: np.ndarray) -> np.ndarray:
+        if self.layout == "parts":
+            raise ValueError("MIX weight exchange is not supported with "
+                             "-ffm_table parts; use -ffm_table joint")
         if self.layout != "joint":
             return super()._get_weights_at(keys)
         FK = self.F * self.k
@@ -615,6 +717,9 @@ class FFMTrainer(FMTrainer):
         return np.asarray(self.params["T"][rr, FK], np.float32)
 
     def _set_weights_at(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        if self.layout == "parts":
+            raise ValueError("MIX weight exchange is not supported with "
+                             "-ffm_table parts; use -ffm_table joint")
         if self.layout != "joint":
             return super()._set_weights_at(keys, vals)
         FK = self.F * self.k
@@ -623,12 +728,23 @@ class FFMTrainer(FMTrainer):
         self.params["T"] = T.at[rr, FK].set(jnp.asarray(vals, T.dtype))
 
     def _finalized_weights(self) -> np.ndarray:
+        if self.layout == "parts":
+            FK = self.F * self.k
+            Tl = self.params["T2"].reshape(self.F * self.MRF, self.Wp)
+            return np.asarray(Tl[:, FK].astype(jnp.float32))
         if self.layout != "joint":
             return super()._finalized_weights()
         FK = self.F * self.k
         return np.asarray(self.params["T"][:, FK].astype(jnp.float32))
 
     def _load_weights(self, w: np.ndarray) -> None:
+        if self.layout == "parts":
+            FK = self.F * self.k
+            T2 = self.params["T2"]
+            Tl = T2.reshape(self.F * self.MRF, self.Wp)
+            Tl = Tl.at[:, FK].set(jnp.asarray(w, T2.dtype))
+            self.params["T2"] = Tl.reshape(T2.shape)
+            return
         if self.layout != "joint":
             return super()._load_weights(w)
         FK = self.F * self.k

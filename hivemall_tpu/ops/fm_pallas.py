@@ -121,24 +121,35 @@ def _roll_pad8(piece, shift):
 
 
 def _make_scatter_opt_kernel(B: int, L: int, F: int, MRF: int, HP: int,
-                             chunk: int, r_opt: int,
+                             chunk: int, r_opt: int, FK: int,
+                             lam_w: float = 0.0, lam_v: float = 0.0,
                              interpret: bool = False):
     """pallas_call: accumulate packed gradient tiles into a VMEM G and
     apply AdaGrad to the field partition's T2/S2 blocks in the tail steps.
 
-    Only HP == 2 is wired (Wp = 256: flagship K=4, F<=62); other widths
-    fall back to the XLA step.
+    Per-occurrence L2 rides a COUNT LANE: the XLA side writes the slot's
+    presence (pm) into pad column FK+2 of each gradient row, so the same
+    accumulate pass yields count(r) = number of live occurrences of row r,
+    and the opt phase applies lam * T[r] * count(r) — exactly the summed
+    slab-level lam * slab * pm of the joint step (every occurrence's slab
+    IS T[r]). Pad lanes are masked out of the weight update.
+
+    Only HP == 2 with FK >= 128 is wired (Wp = 256, count lane in the odd
+    half-row); other widths fall back to the XLA step.
     """
-    assert HP == 2
+    assert HP == 2 and 128 <= FK <= 248
     m = L // F
     nc = B // chunk
     n_acc = m * nc
     gt_rows = MRF * HP // 8          # f32 (8,128) G tiles per partition
     n_opt = MRF * HP // r_opt
     grid = (F, n_acc + n_opt)
+    cnt_lane = FK + 2 - 128          # pad column FK+2, odd half-row
+    w_lane = FK - 128                # linear-weight column, odd half-row
 
-    def kernel(rows_ref, eta_ref, g_ref, t_ref, s_ref, tout_ref, sout_ref,
-               G_ref):
+
+    def kernel(rows_ref, eta_ref, lam_ref, live_ref, g_ref, t_ref, s_ref,
+               tout_ref, sout_ref, G_ref):
         c = pl.program_id(1)
 
         @pl.when(c == 0)
@@ -167,9 +178,31 @@ def _make_scatter_opt_kernel(B: int, L: int, F: int, MRF: int, HP: int,
             j = c - n_acc
             Gt = G_ref[pl.ds(j * (r_opt // 8), r_opt // 8)]
             G2 = Gt.reshape(r_opt, 128)
-            gg = s_ref[...] + G2 * G2
             w = t_ref[...].astype(jnp.float32)
-            wn = w - eta_ref[0, 0] * G2 / (jnp.sqrt(gg) + _EPS)
+            if lam_w or lam_v:
+                # occurrence counts ride pad lane cnt_lane of ODD rows.
+                # Mosaic has no two-axis broadcast, so: mask everything
+                # but that lane, lane-broadcast by a ones matmul (MXU),
+                # then spread odd->even sublanes with a roll.
+                row_i = jax.lax.broadcasted_iota(jnp.int32,
+                                                 (r_opt, 128), 0)
+                lane_i = jax.lax.broadcasted_iota(jnp.int32,
+                                                  (r_opt, 128), 1)
+                sel = ((lane_i == cnt_lane)
+                       & ((row_i & 1) == 1)).astype(jnp.float32)
+                ones_m = (jax.lax.broadcasted_iota(
+                    jnp.int32, (128, 128), 0) >= 0).astype(jnp.float32)
+                bcast = jax.lax.dot_general(
+                    G2 * sel, ones_m, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                cnt = bcast + pltpu.roll(bcast, r_opt - 1, 0)
+                lam_t = jnp.tile(lam_ref[...], (r_opt // 8, 1))
+                live_t = jnp.tile(live_ref[...], (r_opt // 8, 1))
+                Geff = (G2 + lam_t * w * cnt) * live_t
+            else:
+                Geff = G2
+            gg = s_ref[...] + Geff * Geff
+            wn = w - eta_ref[0, 0] * Geff / (jnp.sqrt(gg) + _EPS)
             sout_ref[...] = gg
             tout_ref[...] = wn.astype(tout_ref.dtype)
 
@@ -197,6 +230,8 @@ def _make_scatter_opt_kernel(B: int, L: int, F: int, MRF: int, HP: int,
 
     eta_spec = pl.BlockSpec((1, 1), lambda g, c: (0, 0),
                             memory_space=pltpu.SMEM)
+    pat_spec = pl.BlockSpec((8, 128), lambda g, c: (0, 0),
+                            memory_space=pltpu.VMEM)
 
     return pl.pallas_call(
         kernel,
@@ -204,6 +239,8 @@ def _make_scatter_opt_kernel(B: int, L: int, F: int, MRF: int, HP: int,
         in_specs=[
             rows_spec(),
             eta_spec,
+            pat_spec,
+            pat_spec,
             g_spec(),
             pl.BlockSpec((r_opt, 128), t_spec(), memory_space=pltpu.VMEM),
             pl.BlockSpec((r_opt, 128), t_spec(), memory_space=pltpu.VMEM),
@@ -217,7 +254,7 @@ def _make_scatter_opt_kernel(B: int, L: int, F: int, MRF: int, HP: int,
             jax.ShapeDtypeStruct((F * MRF * HP, 128), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((gt_rows, 8, 128), jnp.float32)],
-        input_output_aliases={3: 0, 4: 1},
+        input_output_aliases={5: 0, 6: 1},
         interpret=interpret,
     )
 
@@ -226,7 +263,7 @@ def parts_supported(F: int, K: int, opt_name: str, dtype) -> bool:
     """The pallas step handles the flagship envelope; everything else uses
     the XLA joint step."""
     wp = 128 * (-(-(F * K + 8) // 128))
-    return (wp == 256 and opt_name == "adagrad"
+    return (wp == 256 and 128 <= F * K <= 248 and opt_name == "adagrad"
             and dtype == jnp.bfloat16
             and jax.default_backend() in ("tpu", "cpu"))
 
@@ -253,10 +290,12 @@ def make_parts_step(loss: Loss, eta_fn: Callable, lambdas, F: int, K: int,
         B, L = idx.shape
         m = L // F
         chunk = min(2048, B)
-        assert B % chunk == 0 and chunk % 8 == 0, \
-            "parts step needs the batch padded to a multiple of 8"
+        assert B % chunk == 0 and (m * B) % 128 == 0, \
+            "parts step needs the batch padded to a multiple of 128 " \
+            "(<=2048) or 2048 (see FFMTrainer._pad_parts_rows)"
         r_opt = min(1024, MRF * hp)
         kern = _make_scatter_opt_kernel(B, L, F, MRF, hp, chunk, r_opt,
+                                        FK, lam_w, lam_v,
                                         interpret=interpret)
 
         if val is None:
@@ -266,26 +305,41 @@ def make_parts_step(loss: Loss, eta_fn: Callable, lambdas, F: int, K: int,
         valT = val.T
         fieldT = (jnp.arange(L, dtype=jnp.int32) % F)[:, None]
         rows = parts_row_hash(idxT, fieldT, MRF)        # [L, B] flat ids
-        T3 = T2.reshape(F * MRF, hp, 128)
-        slab = T3[rows]                                 # [L, B, hp, 128]
+        if m == 1:
+            # one gather PER FIELD PARTITION: XLA's row-gather runs
+            # ~10.7 ns/row from an 8k-row partition vs ~17 ns from the
+            # full table (measured, /tmp gather A/B + probe_size.py) —
+            # the slot order IS the field order, so the stack is slab
+            T4 = T2.reshape(F, MRF, hp, 128)
+            local_rows = rows - fieldT * MRF
+            slab = jnp.stack([T4[g][local_rows[g]] for g in range(F)])
+        else:
+            T3 = T2.reshape(F * MRF, hp, 128)
+            slab = T3[rows]                             # [L, B, hp, 128]
 
         def batch_loss(w0f, slabf):
-            phi = _phi_parts(w0f, slabf.reshape(L, B, wp), valT, F, K)
-            return (loss.loss(phi, label) * row_mask).sum()
+            s = slabf.reshape(L, B, wp)
+            phi = _phi_parts(w0f, s, valT, F, K)
+            data = (loss.loss(phi, label) * row_mask).sum()
+            if lam_w or lam_v:
+                # per-occurrence L2 rides the kernel's count lane (pad
+                # column FK+2): each slot's gradient must carry pm there
+                # so the scatter pass accumulates count(r) and the opt
+                # phase applies lam * T[r] * count(r) — identical to the
+                # joint step's slab-level lam * slab * pm. Emitting the
+                # lane THROUGH autodiff (gradient of sum(slab_cnt * pm)
+                # is exactly pm) fuses it into the existing backward pass;
+                # the loss value is unchanged because pad columns of T are
+                # zero forever (live-masked in the kernel's update).
+                pm = ((valT != 0).astype(jnp.float32)
+                      * row_mask[None, :])
+                data = data + jnp.sum(
+                    s[..., FK + 2].astype(jnp.float32) * pm)
+            return data
 
         loss_sum, (g0, gslab) = jax.value_and_grad(
             batch_loss, argnums=(0, 1))(w0.astype(jnp.float32), slab)
-        gslab = gslab.astype(jnp.float32).reshape(L, B, wp)
-
-        # per-occurrence L2 on present entries, at slab level (identical
-        # semantics to make_ffm_step_fused)
-        if lam_w or lam_v:
-            pm = (valT != 0).astype(jnp.float32) * row_mask[None, :]
-            lam_col = jnp.concatenate([
-                jnp.full((FK,), lam_v, jnp.float32),
-                jnp.full((wp - FK,), lam_w, jnp.float32)])
-            gslab = gslab + lam_col * slab.astype(jnp.float32).reshape(
-                L, B, wp) * pm[..., None]
+        gslab = gslab.astype(jnp.bfloat16).reshape(L, B, wp)
         g0 = g0 + lam0 * w0.astype(jnp.float32)
 
         # pack for the kernel: [L, B, hp, 128] -> [F, m*B*hp/16, 16, 128]
@@ -298,7 +352,16 @@ def make_parts_step(loss: Loss, eta_fn: Callable, lambdas, F: int, K: int,
         local = local.transpose(1, 0, 2).reshape(F, (m * B) // 128, 128)
 
         eta_t = jnp.asarray(eta_fn(t), jnp.float32).reshape(1, 1)
-        T2n, S2n = kern(local, eta_t, gpack, T2, S2)
+        w_lane = FK - 128
+        lane = jnp.arange(128)
+        lam_row = jnp.where(lane < w_lane, lam_v,
+                            jnp.where(lane == w_lane, lam_w, 0.0))
+        lam8 = jnp.tile(jnp.stack([jnp.full((128,), lam_v, jnp.float32),
+                                   lam_row.astype(jnp.float32)]), (4, 1))
+        live8 = jnp.tile(jnp.stack([
+            jnp.ones((128,), jnp.float32),
+            (lane <= w_lane).astype(jnp.float32)]), (4, 1))
+        T2n, S2n = kern(local, eta_t, lam8, live8, gpack, T2, S2)
 
         # w0: plain AdaGrad scalar step
         gg0 = opt_state["w0"]["gg"] + g0 * g0
