@@ -420,25 +420,42 @@ def bench_gbt() -> dict:
 
 
 def bench_trees() -> dict:
-    """BASELINE config #5 shape: RandomForest on HIGGS-like dense rows
-    (level-wise histogram kernels)."""
+    """BASELINE config #5 shape: RandomForest 16 trees depth 8 on
+    HIGGS-SHAPED dense rows — 1M x 28, the scale SURVEY §3.9's
+    "native-performance equivalent" demand is judged at. Uses the round-3
+    dense-channel histogram kernel (ops/pallas_hist.level_histogram_dense):
+    node x stat channels on the MXU lane axis, no per-row index ops."""
     import numpy as np
     from hivemall_tpu.models.trees import RandomForestClassifier
 
-    n, d = 100_000, 28
+    n, d, depth, E, B = 1_000_000, 28, 8, 16, 64
     rng = np.random.default_rng(0)
     X = rng.normal(0, 1, (n, d)).astype(np.float32)
     y = (X[:, :4].sum(1) + 0.5 * rng.normal(0, 1, n) > 0).astype(np.int32)
-    # warm the XLA cache with identical shapes: one-off compilation (~40s
-    # for the level-wise builders) is not the per-forest training cost
-    RandomForestClassifier("-trees 16 -depth 8 -seed 7").fit(X, y)
-    t0 = time.perf_counter()
-    rf = RandomForestClassifier("-trees 16 -depth 8 -seed 31")
-    rf.fit(X, y)
-    dt = time.perf_counter() - t0
+    # warm the XLA cache with identical shapes: one-off compilation is not
+    # the per-forest training cost
+    RandomForestClassifier(f"-trees {E} -depth {depth} -seed 7").fit(X, y)
+    best = float("inf")
+    for seed in (31, 32):
+        t0 = time.perf_counter()
+        rf = RandomForestClassifier(f"-trees {E} -depth {depth} "
+                                    f"-seed {seed}")
+        rf.fit(X, y)
+        best = min(best, time.perf_counter() - t0)
+    # achieved-MAC accounting for the dense-channel kernel: per level the
+    # matmuls move n x (dp*B) x cs MACs per tree, cs = channel lanes
+    dp = -(-d // 8) * 8
+    macs = 0
+    for t in range(depth + 1):
+        cs_need = (2 ** t) * 2
+        cs = min(512, max(128, -(-cs_need // 128) * 128))
+        macs += E * n * (dp * B) * cs
+    util = macs / best / 123e12          # v5e ~123T bf16 MAC/s
     return {"metric": "train_randomforest_rows_per_sec",
-            "value": round(n / dt, 1), "unit": "rows/sec",
-            "seconds": round(dt, 3), "trees": 16}
+            "value": round(n / best, 1), "unit": "rows/sec",
+            "seconds": round(best, 3), "trees": E, "rows": n,
+            "hist_macs_per_forest": macs,
+            "achieved_mxu_util": round(util, 3)}
 
 
 _BENCHES = ("bench_linear", "bench_ffm_kernel", "bench_ffm_e2e",

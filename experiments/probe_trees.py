@@ -1,0 +1,97 @@
+"""Where does RF-16xd8 time go? Per-level histogram cost (flat vs sorted,
+vmapped over 16 trees), the routing/argsort extras, and the full build."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hivemall_tpu.ops.pallas_hist import level_histogram, level_histogram_sorted
+
+n, d, B, E = 100_000, 28, 64, 16
+rng = np.random.default_rng(0)
+bins = jnp.asarray(rng.integers(0, B, (n, d)).astype(np.uint8))
+w = jnp.asarray(rng.poisson(1.0, (E, n)).astype(np.float32))
+ws1 = jnp.asarray(rng.random((n, 2)).astype(np.float32))
+
+
+def sync(x):
+    return float(np.asarray(jnp.asarray(x).astype(jnp.float32).sum(), np.float64))
+
+
+def timeit(fn, iters=3, repeats=2):
+    sync(fn())
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        sync(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def report(name, secs):
+    print(f"{name:46s} {secs*1e3:9.2f} ms", flush=True)
+
+
+def main():
+    for M in (1, 8):
+        loc = jnp.asarray(rng.integers(0, M, n).astype(np.int32))
+        f = jax.jit(jax.vmap(
+            lambda wv: level_histogram(bins, loc, ws1 * wv[:, None], M, B),
+        ))
+        report(f"flat hist M={M} vmapped x16", timeit(lambda: f(w)))
+
+    for M in (32, 256):
+        loc = jnp.asarray(rng.integers(0, M, n).astype(np.int32))
+        f = jax.jit(jax.vmap(
+            lambda wv: level_histogram_sorted(bins, loc, ws1 * wv[:, None],
+                                              M, B)))
+        report(f"sorted hist M={M} vmapped x16", timeit(lambda: f(w)))
+
+    # the non-hist per-level machinery: gains/route on [M,d,B,S]
+    M = 256
+    loc = jnp.asarray(rng.integers(0, M, n).astype(np.int32))
+
+    @jax.jit
+    @jax.vmap
+    def extras(wv):
+        hist = jnp.zeros((M, d, B, 2), jnp.float32) + wv[0]
+        parent = hist.sum(2).max(1)
+        cum = jnp.cumsum(hist, axis=2)
+        left = cum[:, :, :-1, :]
+        right = parent[:, None, None, :] - left
+        gains = (left[..., 0] * right[..., 0])
+        arg = jnp.argmax(gains.reshape(M, -1), axis=1)
+        return arg.sum()
+    report("gains+argmax M=256 x16", timeit(lambda: extras(w)))
+
+    # full builds
+    from hivemall_tpu.ops.trees import build_tree_classifier
+    labels = rng.integers(0, 2, n).astype(np.int32)
+    wnp = np.asarray(w)
+    edges = np.zeros((d, B - 1), np.float32)
+    t0 = time.perf_counter()
+    tree = build_tree_classifier(np.asarray(bins), labels, wnp, edges,
+                                 2, depth=8, n_bins=B, n_trees=E)
+    print(f"full RF-16 d8 build (compile+run): "
+          f"{time.perf_counter()-t0:.1f}s", flush=True)
+    for _ in range(2):
+        t0 = time.perf_counter()
+        tree = build_tree_classifier(np.asarray(bins), labels, wnp, edges,
+                                     2, depth=8, n_bins=B, n_trees=E)
+        dt = time.perf_counter() - t0
+        print(f"full RF-16 d8 build (warm): {dt:.2f}s -> "
+              f"{n/dt/1e3:.1f}k rows/s", flush=True)
+
+
+if __name__ == "__main__":
+    print(jax.devices(), flush=True)
+    main()

@@ -52,7 +52,10 @@ __all__ = ["level_histogram", "level_histogram_sorted",
            "use_pallas_default"]
 
 _ROWS = 256        # row-chunk tile (lane axis; multiple of 128)
-_MB_TILE = 512     # one-hot column tile (sublane axis of ohT; mult. of 8)
+_MB_TILE = 512     # flat-kernel max column tile (sublane axis of ohT)
+_TW = 128          # sorted-kernel window tile: 4x fewer one-hot compares
+                   # than 512 (the kernels are VPU-compare bound, not MXU
+                   # bound — measured round 3, experiments/probe_trees.py)
 _SCH = 8           # stat-channel slab (sublane tile) — S ≤ 8 per call
 
 
@@ -63,32 +66,40 @@ def use_pallas_default() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _hist_kernel(idx_ref, ws_ref, out_ref, *, precision):
-    f = pl.program_id(0)
-    m = pl.program_id(1)
-    local = idx_ref[f % 8, :] - m * _MB_TILE              # [_ROWS] lane vec
-    cols = jax.lax.broadcasted_iota(jnp.int32, (_MB_TILE, _ROWS), 0)
-    oh_t = (cols == local[None, :]).astype(jnp.float32)   # [_MB_TILE, _ROWS]
-    acc = jax.lax.dot_general(                            # [_SCH, _MB_TILE]
-        ws_ref[:], oh_t,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        # HIGHEST = f32-equivalent MXU passes (the default: gini/gradient
-        # sums feed gain comparisons and must not round to bf16). Callers
-        # whose stat channels are SMALL INTEGERS (classification: class
-        # indicator x bootstrap count) pass DEFAULT — single-pass bf16
-        # products of exact-in-bf16 operands with f32 accumulation are
-        # still exact, at ~6x fewer MXU passes. Mosaic supports only
-        # DEFAULT|HIGHEST (HIGH raises NotImplemented).
-        precision=precision,
-        preferred_element_type=jnp.float32)
+def _hist_kernel(idx_ref, ws_ref, out_ref, *, precision, tile, d):
+    # The FEATURE loop lives INSIDE the kernel: one grid step histograms
+    # every feature's row chunk against one column tile, so Mosaic's
+    # per-grid-step overhead (measured dominant in round 3 — the per-step
+    # compute is only ~1 us) amortizes over d features.
+    m = pl.program_id(0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (tile, _ROWS), 0)
+    first = pl.program_id(1) == 0
+    for f in range(d):
+        local = idx_ref[f, :] - m * tile                  # [_ROWS] lane vec
+        oh_t = (cols == local[None, :]).astype(jnp.float32)
+        acc = jax.lax.dot_general(                        # [_SCH, tile]
+            ws_ref[:], oh_t,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            # (tile is the column-tile width: shallow levels size it to the
+            # actual mb so a 64-column level-0 histogram does not pay for
+            # 512 one-hot compare columns — an 8x waste measured in r2)
+            # HIGHEST = f32-equivalent MXU passes (the default: gini /
+            # gradient sums feed gain comparisons and must not round to
+            # bf16). Callers whose stat channels are SMALL INTEGERS
+            # (classification: class indicator x bootstrap count) pass
+            # DEFAULT — single-pass bf16 products of exact-in-bf16
+            # operands with f32 accumulation are still exact, at ~6x
+            # fewer MXU passes. Mosaic supports only DEFAULT|HIGHEST.
+            precision=precision,
+            preferred_element_type=jnp.float32)
 
-    @pl.when(pl.program_id(2) == 0)
-    def _init():
-        out_ref[0, :, :] = acc
+        @pl.when(first)
+        def _init():
+            out_ref[f, :, :] = acc
 
-    @pl.when(pl.program_id(2) != 0)
-    def _accum():
-        out_ref[0, :, :] += acc
+        @pl.when(jnp.logical_not(first))
+        def _accum():
+            out_ref[f, :, :] += acc
 
 
 def level_histogram(bins: jnp.ndarray, loc: jnp.ndarray, ws: jnp.ndarray,
@@ -108,7 +119,10 @@ def level_histogram(bins: jnp.ndarray, loc: jnp.ndarray, ws: jnp.ndarray,
                  for s in range(0, S, _SCH)]
         return jnp.concatenate(parts, axis=-1)
     mb = n_nodes * n_bins
-    mbp = -(-mb // _MB_TILE) * _MB_TILE
+    # adaptive column tile: smallest 128-multiple covering mb, capped at
+    # _MB_TILE (the out-block's last dim must be a 128-multiple)
+    tile = min(_MB_TILE, -(-mb // 128) * 128)
+    mbp = -(-mb // tile) * tile
     np_ = -(-n // _ROWS) * _ROWS
     dp = -(-d // 8) * 8
 
@@ -125,16 +139,16 @@ def level_histogram(bins: jnp.ndarray, loc: jnp.ndarray, ws: jnp.ndarray,
     prec = (jax.lax.Precision.DEFAULT if fast
             else jax.lax.Precision.HIGHEST)
     out = pl.pallas_call(
-        _partial(_hist_kernel, precision=prec),
-        grid=(d, mbp // _MB_TILE, np_ // _ROWS),
+        _partial(_hist_kernel, precision=prec, tile=tile, d=d),
+        grid=(mbp // tile, np_ // _ROWS),
         in_specs=[
-            pl.BlockSpec((8, _ROWS), lambda f, m, r: (f // 8, r),
+            pl.BlockSpec((dp, _ROWS), lambda m, r: (0, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((_SCH, _ROWS), lambda f, m, r: (0, r),
+            pl.BlockSpec((_SCH, _ROWS), lambda m, r: (0, r),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, _SCH, _MB_TILE),
-                               lambda f, m, r: (f, 0, m),
+        out_specs=pl.BlockSpec((d, _SCH, tile),
+                               lambda m, r: (0, 0, m),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((d, _SCH, mbp), jnp.float32),
         interpret=jax.default_backend() != "tpu",
@@ -162,28 +176,44 @@ def level_histogram(bins: jnp.ndarray, loc: jnp.ndarray, ws: jnp.ndarray,
 _CHUNK = 256                   # sorted rows per grid step (= _ROWS)
 
 
-def _windowed_kernel(wseq_ref, idx_ref, ws_ref, out_ref, *, precision):
-    f = pl.program_id(0)
-    c = pl.program_id(1)
-    base = wseq_ref[c] * _MB_TILE
-    local = idx_ref[f % 8, :] - base                      # [_CHUNK]
-    cols = jax.lax.broadcasted_iota(jnp.int32, (_MB_TILE, _CHUNK), 0)
-    oh_t = (cols == local[None, :]).astype(jnp.float32)
-    acc = jax.lax.dot_general(
-        ws_ref[:], oh_t,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        precision=precision,
-        preferred_element_type=jnp.float32)               # [_SCH, _MB_TILE]
+def _windowed_kernel(wseq_ref, idx_ref, ws_ref, out_ref, *, precision, d):
+    c = pl.program_id(0)
+    base = wseq_ref[c] * _TW
+    cols = jax.lax.broadcasted_iota(jnp.int32, (_TW, _CHUNK), 0)
+    first = jnp.logical_or(
+        c == 0, wseq_ref[c] != wseq_ref[jnp.maximum(c - 1, 0)])
+    for f in range(d):
+        local = idx_ref[f, :] - base                      # [_CHUNK]
+        oh_t = (cols == local[None, :]).astype(jnp.float32)
+        acc = jax.lax.dot_general(
+            ws_ref[:], oh_t,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            precision=precision,
+            preferred_element_type=jnp.float32)           # [_SCH, _TW]
 
-    first = jnp.logical_or(c == 0, wseq_ref[c] != wseq_ref[jnp.maximum(c - 1, 0)])
+        @pl.when(first)
+        def _init():
+            out_ref[f, :, :] = acc
 
-    @pl.when(first)
-    def _init():
-        out_ref[0, :, :] = acc
+        @pl.when(jnp.logical_not(first))
+        def _accum():
+            out_ref[f, :, :] += acc
 
-    @pl.when(jnp.logical_not(first))
-    def _accum():
-        out_ref[0, :, :] += acc
+
+def _hist_scatter(bins, loc, ws, n_nodes: int, n_bins: int):
+    """Plain scatter-add histogram for SMALL row sets (the sorted kernel's
+    spill replay): [M, d, B, S] with inactive rows (loc < 0) dropped."""
+    n, d = bins.shape
+    S = ws.shape[1]
+    active = loc >= 0
+    l0 = jnp.where(active, loc, 0)
+    fidx = (l0[:, None] * d + jnp.arange(d)[None, :]) * n_bins \
+        + bins.astype(jnp.int32)
+    contrib = jnp.where(active[:, None, None], ws[:, None, :], 0.0)
+    contrib = jnp.broadcast_to(contrib, (n, d, S))
+    hist = jnp.zeros((n_nodes * d * n_bins, S), jnp.float32)
+    hist = hist.at[fidx.ravel()].add(contrib.reshape(n * d, S))
+    return hist.reshape(n_nodes, d, n_bins, S)
 
 
 def level_histogram_sorted(bins: jnp.ndarray, loc: jnp.ndarray,
@@ -195,9 +225,9 @@ def level_histogram_sorted(bins: jnp.ndarray, loc: jnp.ndarray,
     kernel (still correct, just M-dependent)."""
     n, d = bins.shape
     S = ws.shape[1]
-    if _MB_TILE % n_bins:
+    if _TW % n_bins:
         return level_histogram(bins, loc, ws, n_nodes, n_bins, fast=fast)
-    W = _MB_TILE // n_bins               # nodes per window
+    W = _TW // n_bins                    # nodes per window
     nw = -(-n_nodes // W)
 
     # ---- shared prep, computed once for all channel slabs ----
@@ -243,15 +273,15 @@ def level_histogram_sorted(bins: jnp.ndarray, loc: jnp.ndarray,
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(d, n_chunks),
+        grid=(n_chunks,),
         in_specs=[
-            pl.BlockSpec((8, _CHUNK), lambda f, c, wseq: (f // 8, c),
+            pl.BlockSpec((dp, _CHUNK), lambda c, wseq: (0, c),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((_SCH, _CHUNK), lambda f, c, wseq: (0, c),
+            pl.BlockSpec((_SCH, _CHUNK), lambda c, wseq: (0, c),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, _SCH, _MB_TILE),
-                               lambda f, c, wseq: (f, 0, wseq[c]),
+        out_specs=pl.BlockSpec((d, _SCH, _TW),
+                               lambda c, wseq: (0, 0, wseq[c]),
                                memory_space=pltpu.VMEM),
     )
 
@@ -265,18 +295,133 @@ def level_histogram_sorted(bins: jnp.ndarray, loc: jnp.ndarray,
         prec = (jax.lax.Precision.DEFAULT if fast
                 else jax.lax.Precision.HIGHEST)
         out = pl.pallas_call(
-            _partial(_windowed_kernel, precision=prec),
+            _partial(_windowed_kernel, precision=prec, d=d),
             grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((d, _SCH, nw * _MB_TILE),
+            out_shape=jax.ShapeDtypeStruct((d, _SCH, nw * _TW),
                                            jnp.float32),
             interpret=jax.default_backend() != "tpu",
         )(wseq, idx_t, ws_t)
-        out = jnp.where(jnp.repeat(visited, _MB_TILE)[None, None, :],
+        out = jnp.where(jnp.repeat(visited, _TW)[None, None, :],
                         out, 0.0)
         main = (out[:, :Sk]
                 .reshape(d, Sk, nw * W, n_bins)[:, :, :n_nodes]
                 .transpose(2, 0, 3, 1))                   # [M, d, B, Sk]
-        parts.append(main + level_histogram(sp_bins, sp_loc,
-                                            sp_ws[:, s0:s0 + _SCH],
-                                            n_nodes, n_bins, fast=fast))
+        # spill rows (boundary-straddling chunks) replay through a plain
+        # scatter-add: at R <= nw*_CHUNK rows the index-op cost (~26 ns x
+        # R*d) beats re-running the flat compare kernel at full M*B width
+        parts.append(main + _hist_scatter(sp_bins, sp_loc,
+                                          sp_ws[:, s0:s0 + _SCH],
+                                          n_nodes, n_bins))
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=-1)
+
+
+# --------------------------------------------------------------------------
+# Dense-channel kernel (round 3): node x stat channels on the matmul's LANE
+# axis, (feature, bin) one-hots on the sublane axis — no sorting, no spill,
+# no per-row ops at all.
+#
+#     out[(f, b), (n_, s)] = sum_r (bins[r,f] == b) * (loc[r] == n_) * ws[r,s]
+#
+# Per row-chunk the kernel builds W2T[(n_, s), r] = (node_of_col == loc_r)
+# * ws_{s, r} (everything lane-oriented, VPU) and contracts it with each
+# feature's bin one-hot on the MXU: [B, CHUNK] x [CS, CHUNK]^T -> [B, CS]
+# accumulated into a VMEM-resident [d*B, CS] output. Cost is
+# n * (d*B) * max(128, M*S) MACs with BOTH matmul axes full — the round-2
+# kernels idled 94% of the MXU on an 8-wide stat axis AND paid per-tree
+# argsort + gather + spill-replay per level (~3-4 per-row ops x 26 ns x n,
+# the real bound at 1M rows). Node counts beyond 512/S channel lanes are
+# processed in channel GROUPS (an extra grid dimension); total MACs stay
+# n * d*B * M*S.
+# --------------------------------------------------------------------------
+
+_DCHUNK = 1024     # rows per grid step (lane axis): big chunks
+                   # amortize the per-(step, feature) VMEM
+                   # accumulate of the out tile
+_DCS = 512         # channel lanes per group (VMEM: d*B x 512 f32 <= ~4MB)
+
+
+def _dense_kernel(bins_ref, loc_ref, ws_ref, out_ref, *, precision,
+                  d, n_bins, S, cs):
+    g = pl.program_id(0)              # channel (node) group
+    first = pl.program_id(1) == 0
+    loc = loc_ref[0, :]                                   # [CHUNK] lanes
+    col = jax.lax.broadcasted_iota(jnp.int32, (cs, _DCHUNK), 0)
+    node_col = col // S + g * (cs // S)
+    s_col = col % S
+    w2t = jnp.zeros((cs, _DCHUNK), jnp.float32)
+    for s in range(S):
+        w2t = jnp.where(s_col == s, ws_ref[s, :][None, :], w2t)
+    w2t = jnp.where(node_col == loc[None, :], w2t, 0.0)   # [cs, CHUNK]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n_bins, _DCHUNK), 0)
+    for f in range(d):
+        oh = (rows == bins_ref[f, :][None, :]).astype(jnp.float32)
+        acc = jax.lax.dot_general(
+            oh, w2t, dimension_numbers=(((1,), (1,)), ((), ())),
+            precision=precision,
+            preferred_element_type=jnp.float32)           # [B, cs]
+
+        @pl.when(first)
+        def _init():
+            out_ref[0, f * n_bins:(f + 1) * n_bins, :] = acc
+
+        @pl.when(jnp.logical_not(first))
+        def _accum():
+            out_ref[0, f * n_bins:(f + 1) * n_bins, :] += acc
+
+
+def level_histogram_dense(bins_t: jnp.ndarray, loc: jnp.ndarray,
+                          ws: jnp.ndarray, n_nodes: int, n_bins: int,
+                          fast: bool = False) -> jnp.ndarray:
+    """Dense-channel level histogram.
+
+    bins_t: uint8/int32 [dp, np_] PRE-transposed (+row-padded) bin codes —
+    build it once per tree build, it never changes across levels/trees.
+    loc: int32 [n] node-local ids (-1 = inactive); ws: f32 [n, S].
+    Returns f32 [n_nodes, d_pad_rows_of_bins_t? -> caller slices] — same
+    contract as level_histogram: [n_nodes, d, n_bins, S] with d inferred
+    from bins_t's first dim (callers pass dp == padded d and slice).
+    """
+    dp, np_ = bins_t.shape
+    n = loc.shape[0]
+    S = ws.shape[1]
+    import math as _math
+    cs_need = n_nodes * S
+    cs0 = (S * 128) // _math.gcd(S, 128)   # lanes per valid channel unit
+    cs = min(max(_DCS // cs0, 1) * cs0,
+             -(-cs_need // cs0) * cs0)
+    n_groups = -(-cs_need // cs)
+    nodes_per_group = cs // S
+
+    locp = jnp.pad(jnp.where(loc >= 0, loc, -1), (0, np_ - n),
+                   constant_values=-1).reshape(1, np_)
+    wsp = jnp.pad(ws.astype(jnp.float32),
+                  ((0, np_ - n), (0, 0))).T               # [S, np_]
+
+    from functools import partial as _partial
+    prec = (jax.lax.Precision.DEFAULT if fast
+            else jax.lax.Precision.HIGHEST)
+    out = pl.pallas_call(
+        _partial(_dense_kernel, precision=prec, d=dp, n_bins=n_bins,
+                 S=S, cs=cs),
+        grid=(n_groups, np_ // _DCHUNK),
+        in_specs=[
+            pl.BlockSpec((dp, _DCHUNK), lambda g, r: (0, r),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _DCHUNK), lambda g, r: (0, r),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((S, _DCHUNK), lambda g, r: (0, r),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, dp * n_bins, cs),
+                               lambda g, r: (g, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_groups, dp * n_bins, cs),
+                                       jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(bins_t.astype(jnp.int32), locp, wsp)
+
+    # [n_groups, dp*B, cs] -> [n_nodes, dp, B, S]
+    out = out.reshape(n_groups, dp, n_bins, nodes_per_group, S)
+    out = out.transpose(0, 3, 1, 2, 4).reshape(
+        n_groups * nodes_per_group, dp, n_bins, S)
+    return out[:n_nodes]

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hivemall_tpu.ops.pallas_hist import (level_histogram,
+                                          level_histogram_dense,
                                           level_histogram_sorted,
                                           use_pallas_default)
 
@@ -49,8 +50,15 @@ def quantize_bins(X: np.ndarray, n_bins: int = 64
     edges = np.empty((d, n_bins - 1), np.float32)
     codes = np.empty((n, d), np.uint8)
     qs = np.linspace(0, 1, n_bins + 1)[1:-1]
+    # quantile sketch on a sample (xgboost-style approx): exact quantiles
+    # over 1M+ rows cost ~2 s host-side for no accuracy benefit at 64 bins
+    if n > 262144:
+        sample = X[np.random.default_rng(0).choice(n, 262144,
+                                                   replace=False)]
+    else:
+        sample = X
     for f in range(d):
-        e = np.unique(np.quantile(X[:, f], qs))
+        e = np.unique(np.quantile(sample[:, f], qs))
         col = np.searchsorted(e, X[:, f], side="left").astype(np.uint8)
         pad = np.full(n_bins - 1, np.inf, np.float32)
         pad[:len(e)] = e
@@ -121,6 +129,14 @@ def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
     def build(bins, aux, w, rng):
         n, d = bins.shape
         Nn = 2 ** (depth + 1) - 1
+        if use_pallas:
+            # dense-channel kernel input: transposed, padded bin codes —
+            # invariant across levels (and across vmapped trees)
+            np_ = -(-n // 1024) * 1024
+            dp = -(-d // 8) * 8
+            bins_t = jnp.pad(bins.astype(jnp.int32),
+                             ((0, np_ - n), (0, dp - d)),
+                             constant_values=-1).T
         feat = jnp.full(Nn, -1, jnp.int32)
         thr = jnp.zeros(Nn, jnp.uint8)
         value = jnp.zeros((Nn, n_channels), jnp.float32)
@@ -133,20 +149,22 @@ def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
             M = 2 ** t
             base = M - 1
             local = node - base
-            active = (local >= 0) & (local < M) & ~settled[jnp.clip(node, 0, Nn - 1)]
+            # rows at settled nodes never route deeper, so their node id
+            # stays behind the frontier and local < 0 already excludes
+            # them — no per-row settled[] gather needed (per-row gathers
+            # at ~26 ns each were the build's dominant cost, round 3)
+            active = (local >= 0) & (local < M)
             # ---- histogram: one pass for the whole level ----
             loc = jnp.where(active, local, 0)
             if use_pallas:
-                # MXU one-hot-contraction kernels (ops/pallas_hist.py):
-                # flat for shallow levels, sorted-window once the frontier
-                # outgrows one 512-column tile (measured 15x at M=256)
+                # dense-channel MXU kernel (ops/pallas_hist.py): node x
+                # stat channels ride the matmul lane axis — no sorting,
+                # no spill, no per-row index ops (round 3; the round-2
+                # flat/sorted kernels remain for tests/fallback)
                 loc_m = jnp.where(active, local, -1)
-                if M * n_bins > 512:
-                    hist = level_histogram_sorted(bins, loc_m, ws, M, n_bins,
-                                                  fast=hist_fast)
-                else:
-                    hist = level_histogram(bins, loc_m, ws, M, n_bins,
-                                           fast=hist_fast)
+                hist = level_histogram_dense(bins_t, loc_m, ws, M,
+                                             n_bins,
+                                             fast=hist_fast)[:, :d]
             else:
                 # CPU fallback: flat scatter-add ((local*d + f)*B + bin)
                 fidx = (loc[:, None] * d + jnp.arange(d)[None, :]) * n_bins \
@@ -192,9 +210,27 @@ def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
             newly_settled = ~do_split & ~settled[ids]
             settled = settled.at[ids].set(settled[ids] | ~do_split)
             # ---- route rows ----
-            split_here = active & do_split[loc]
-            fsel = bf[loc]
-            go_right = bins[jnp.arange(n), fsel] > bb[loc]
+            # per-row (do_split, bf, bb) lookups as ONE-HOT MATVECS, not
+            # gathers: a [n]-indexed gather costs ~26 ns/row regardless of
+            # table size (16 trees x 9 levels x n of them dominated the 1M
+            # build), while onehot(loc) @ vals is n*M exact-in-bf16 MACs on
+            # the MXU. All three values are small integers (< 2^8), exact
+            # under single-pass bf16; accumulation is f32.
+            vals = jnp.stack([do_split.astype(jnp.float32),
+                              bf.astype(jnp.float32),
+                              bb.astype(jnp.float32)], 1)   # [M, 3]
+            ohn = (loc[:, None]
+                   == jnp.arange(M, dtype=jnp.int32)[None, :])
+            out3 = jax.lax.dot_general(
+                ohn.astype(jnp.bfloat16), vals.astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [n, 3]
+            split_here = active & (out3[:, 0] > 0.5)
+            fsel = out3[:, 1].astype(jnp.int32)
+            bsel = out3[:, 2]
+            ohf = fsel[:, None] == jnp.arange(d, dtype=jnp.int32)[None, :]
+            bval = jnp.where(ohf, bins, jnp.uint8(0)).max(1)
+            go_right = bval.astype(jnp.float32) > bsel
             node = jnp.where(split_here,
                              2 * node + 1 + go_right.astype(jnp.int32),
                              node)
@@ -315,12 +351,75 @@ def _walk_ensemble(feat, thr, value, bins, depth):
                     )(feat, thr, value, bins, depth)
 
 
+def _sweep_one(feat, thr, value, bins, depth):
+    """Gather-free predict for one tree: per-level 0/1 membership sweep.
+
+    The gather walk pays 3 per-row index ops per level (~26 ns each on
+    v5e — 10 s for 1M rows x 16 trees x depth 8). Here membership mass
+    flows down level by level with pure elementwise ops on [n, 2^t]
+    slabs: P[r, nd] (the node's predicate) comes from ONE exact-in-bf16
+    one-hot matmul, leaves emit value through a tiny [2^t, C] matmul, and
+    nothing indexes per row.
+    """
+    n, d = bins.shape
+    Nn = feat.shape[0]
+    C = value.shape[1]
+    ohf = jax.nn.one_hot(jnp.maximum(feat, 0), d, dtype=jnp.bfloat16)
+    proj = jax.lax.dot_general(
+        bins.astype(jnp.bfloat16), ohf,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [n, Nn] bin values
+    P = (proj > thr[None, :].astype(jnp.float32)).astype(jnp.float32)
+    is_leaf = (feat < 0).astype(jnp.float32)
+    out = jnp.zeros((n, C), jnp.float32)
+    match = jnp.ones((n, 1), jnp.float32)
+    # `depth` here is the LEVEL COUNT (callers pass tree.depth + 1, the
+    # same convention as _walk's routing-step count)
+    for t in range(depth):
+        base, M = 2 ** t - 1, 2 ** t
+        leaf_t = is_leaf[base:base + M]
+        # depth-t frontier: emit settled leaves (the deepest level is all
+        # leaves by construction: feat stays -1 there)
+        lv = value[base:base + M] * leaf_t[:, None]
+        out = out + jax.lax.dot_general(
+            match, lv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        if t == depth - 1:
+            break
+        keep = match * (1.0 - leaf_t)[None, :]
+        pt = P[:, base:base + M]
+        left, right = keep * (1.0 - pt), keep * pt
+        match = jnp.stack([left, right], 2).reshape(n, 2 * M)
+    return out
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _sweep_ensemble(feat, thr, value, bins, depth):
+    return jax.vmap(_sweep_one, in_axes=(0, 0, 0, None, None)
+                    )(feat, thr, value, bins, depth)
+
+
 def predict_bins_device(tree: Tree, bins) -> jnp.ndarray:
     """Device-resident predict (no host sync) — the boosting round loop
-    uses this so the margin chain never leaves the chip."""
-    return _walk_ensemble(
-        jnp.asarray(tree.feat), jnp.asarray(tree.thr),
-        jnp.asarray(tree.value), jnp.asarray(bins), tree.depth + 1)
+    uses this so the margin chain never leaves the chip. Uses the
+    gather-free level sweep up to depth 9 (cost grows with 2^depth slabs),
+    row-chunked so the [E, chunk, Nn] predicate slab stays ~1 GB; deeper
+    trees fall back to the gather walk."""
+    depth = tree.depth + 1
+    f = jnp.asarray(tree.feat)
+    t = jnp.asarray(tree.thr)
+    v = jnp.asarray(tree.value)
+    bins = jnp.asarray(bins)
+    if depth > 9:
+        return _walk_ensemble(f, t, v, bins, depth)
+    n = bins.shape[0]
+    chunk = 32768
+    if n <= chunk:
+        return _sweep_ensemble(f, t, v, bins, depth)
+    outs = [_sweep_ensemble(f, t, v, bins[s:s + chunk], depth)
+            for s in range(0, n, chunk)]
+    return jnp.concatenate(outs, axis=1)
 
 
 def predict_bins(tree: Tree, bins: np.ndarray) -> np.ndarray:
