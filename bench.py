@@ -458,9 +458,45 @@ def bench_trees() -> dict:
             "achieved_mxu_util": round(util, 3)}
 
 
+def bench_seq_exact() -> dict:
+    """-batch_mode sequential (reference-EXACT row-by-row semantics) on
+    AROW: round-3 slab scan (128-row slabs, in-register cross-row
+    propagation) vs round 2's 1.8k rows/s full-table scan."""
+    import numpy as np
+    import jax.numpy as jnp
+    from hivemall_tpu.models.classifier import AROWTrainer
+    from hivemall_tpu.io.sparse import SparseBatch
+
+    n, L, dims, B = 102400, 16, 1 << 20, 4096
+    rng = np.random.default_rng(0)
+    idx = rng.integers(1, dims, (n, L)).astype(np.int32)
+    val = rng.uniform(0.5, 1.5, (n, L)).astype(np.float32)
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    t = AROWTrainer(f"-dims {dims} -mini_batch {B} -batch_mode sequential")
+
+    def run():
+        for s0 in range(0, n, B):
+            t._train_batch(SparseBatch(idx[s0:s0 + B], val[s0:s0 + B],
+                                       lab[s0:s0 + B], None))
+        float(np.asarray(t.w.astype(jnp.float32).sum()))
+
+    run()
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return {"metric": "train_arow_sequential_exact_rows_per_sec",
+            "value": round(n / best, 1), "unit": "rows/sec",
+            "seconds": round(best, 3),
+            "note": "bit-equivalent to -mini_batch 1 row dispatch "
+                    "(tests/test_covariance_batching.py)"}
+
+
 _BENCHES = ("bench_linear", "bench_ffm_kernel", "bench_ffm_e2e",
             "bench_ffm_parquet_stream", "bench_ingest", "bench_fm",
-            "bench_mf", "bench_word2vec", "bench_trees", "bench_gbt")
+            "bench_mf", "bench_word2vec", "bench_trees", "bench_gbt",
+            "bench_seq_exact")
 
 
 def _emit(configs) -> None:
