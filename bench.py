@@ -493,10 +493,60 @@ def bench_seq_exact() -> dict:
                     "(tests/test_covariance_batching.py)"}
 
 
+def bench_mix() -> dict:
+    """MixServer localhost throughput: 4 concurrent clients streaming
+    delta-exchange messages (SURVEY §3.16 production-scale criterion:
+    >= 100k key-updates/s across 4 client trainers)."""
+    import numpy as np
+    import threading
+    from hivemall_tpu.parallel.mix_service import (MixServer, MixMessage,
+                                                   EVENT_AVERAGE)
+    import socket
+    import struct
+
+    srv = MixServer().start()
+    n_clients, n_msgs, n_keys = 4, 60, 4096
+    rng = np.random.default_rng(0)
+    keysets = [rng.integers(0, 1 << 22, (n_msgs, n_keys)).astype(np.int64)
+               for _ in range(n_clients)]
+    done = []
+
+    def client(ci):
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=30)
+        f = s.makefile("rwb")
+        for m in range(n_msgs):
+            msg = MixMessage(EVENT_AVERAGE, f"g{ci}", keysets[ci][m],
+                             rng.standard_normal(n_keys).astype(np.float32),
+                             np.ones(n_keys, np.float32),
+                             np.ones(n_keys, np.int32))
+            f.write(msg.encode())
+            f.flush()
+            ln = struct.unpack("<I", f.read(4))[0]
+            f.read(ln)
+        s.close()
+        done.append(ci)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    counters = srv.counters()
+    srv.stop()
+    total = n_clients * n_msgs * n_keys
+    return {"metric": "mix_server_key_updates_per_sec",
+            "value": round(total / dt, 1), "unit": "key-updates/sec",
+            "seconds": round(dt, 3), "clients": n_clients,
+            "server_counters": counters}
+
+
 _BENCHES = ("bench_linear", "bench_ffm_kernel", "bench_ffm_e2e",
             "bench_ffm_parquet_stream", "bench_ingest", "bench_fm",
             "bench_mf", "bench_word2vec", "bench_trees", "bench_gbt",
-            "bench_seq_exact")
+            "bench_seq_exact", "bench_mix")
 
 
 def _emit(configs) -> None:

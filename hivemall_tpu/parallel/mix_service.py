@@ -35,11 +35,13 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 __all__ = ["MixServer", "MixClient", "MixMessage", "EVENT_AVERAGE",
-           "EVENT_ARGMIN_KLD", "EVENT_CLOSEGROUP"]
+           "EVENT_ARGMIN_KLD", "EVENT_CLOSEGROUP", "EVENT_STATS"]
 
 EVENT_AVERAGE = 1
 EVENT_ARGMIN_KLD = 2
 EVENT_CLOSEGROUP = 3
+EVENT_STATS = 4          # JMX-analog counters probe (reference: MixServer
+                         # exposes metrics over JMX; here a wire event)
 
 _HDR = struct.Struct("<BH")
 _LEN = struct.Struct("<I")
@@ -84,14 +86,90 @@ class MixMessage:
                    recs["d"].astype(np.int32))
 
 
+_EMPTY = np.int64(-(1 << 62))      # open-addressing empty sentinel
+
+
+class _NpIndex:
+    """Vectorized int64 key -> dense row index: numpy open-addressing hash
+    table with batched linear probing. Replaces the per-key Python dict
+    walk (round 2's `rows_for` loop — ~1 us/key, the server's throughput
+    ceiling); a whole message's keys now resolve in a handful of numpy
+    passes. Single-writer (the asyncio loop thread), so batch claiming of
+    empty slots needs no locking — colliding same-round claims are
+    re-checked and losers keep probing."""
+
+    def __init__(self, cap_bits: int = 12):
+        self._bits = cap_bits
+        self._keys = np.full(1 << cap_bits, _EMPTY, np.int64)
+        self._rows = np.zeros(1 << cap_bits, np.int64)
+        self.n = 0
+
+    @staticmethod
+    def _mix(k: np.ndarray) -> np.ndarray:
+        h = k.astype(np.uint64)
+        h ^= h >> np.uint64(33)
+        h *= np.uint64(0xFF51AFD7ED558CCD)
+        h ^= h >> np.uint64(33)
+        return h
+
+    def lookup_or_insert(self, keys: np.ndarray) -> np.ndarray:
+        """rows [n] for int64 keys [n]; new keys get rows n0, n0+1, ...
+        in first-appearance order."""
+        uk, inv = np.unique(keys.astype(np.int64), return_inverse=True)
+        if self.n + len(uk) > (len(self._keys) * 7) // 10:
+            self._rehash(max(self._bits + 1,
+                             int(np.ceil(np.log2((self.n + len(uk))
+                                                 * 2 + 1)))))
+        mask = np.uint64(len(self._keys) - 1)
+        slot = (self._mix(uk) & mask).astype(np.int64)
+        out = np.full(len(uk), -1, np.int64)
+        pend = np.arange(len(uk))
+        while len(pend):
+            cur = self._keys[slot[pend]]
+            hit = cur == uk[pend]
+            out[pend[hit]] = self._rows[slot[pend[hit]]]
+            free = cur == _EMPTY
+            if free.any():
+                cand = pend[free]
+                self._keys[slot[cand]] = uk[cand]      # batch claim
+                won = self._keys[slot[cand]] == uk[cand]
+                winners = cand[won]
+                rows_new = self.n + np.arange(len(winners))
+                self._rows[slot[winners]] = rows_new
+                out[winners] = rows_new
+                self.n += len(winners)
+            pend = pend[out[pend] < 0]
+            slot[pend] = (slot[pend] + 1) & np.int64(mask)
+        return out[inv]
+
+    def _rehash(self, bits: int) -> None:
+        live = self._keys != _EMPTY
+        old_k, old_r = self._keys[live], self._rows[live]
+        self._bits = bits
+        self._keys = np.full(1 << bits, _EMPTY, np.int64)
+        self._rows = np.zeros(1 << bits, np.int64)
+        mask = np.uint64(len(self._keys) - 1)
+        slot = (self._mix(old_k) & mask).astype(np.int64)
+        pend = np.arange(len(old_k))
+        while len(pend):
+            cur = self._keys[slot[pend]]
+            free = cur == _EMPTY
+            cand = pend[free]
+            self._keys[slot[cand]] = old_k[cand]
+            won = self._keys[slot[cand]] == old_k[cand]
+            winners = cand[won]
+            self._rows[slot[winners]] = old_r[winners]
+            pend = pend[self._keys[slot[pend]] != old_k[pend]]
+            slot[pend] = (slot[pend] + 1) & np.int64(mask)
+
+
 class _GroupStore:
     """Per-group partial aggregates in flat growable arrays (reference:
-    SessionObject holding PartialResult per feature) — the fold over one
-    incoming message is numpy-vectorized; only the key->row indexing
-    remains a dict lookup per NEW key."""
+    SessionObject holding PartialResult per feature) — folds AND key->row
+    indexing are fully numpy-vectorized (no per-key Python)."""
 
     def __init__(self, cap: int = 1024):
-        self.index: Dict[int, int] = {}
+        self.index = _NpIndex()
         self._grow(cap)
 
     def _grow(self, cap: int) -> None:
@@ -107,16 +185,9 @@ class _GroupStore:
         self.sum_w_prec = g(getattr(self, "sum_w_prec", None))
 
     def rows_for(self, keys: np.ndarray) -> np.ndarray:
-        idx = self.index
-        rows = np.empty(len(keys), np.int64)
-        for i, k in enumerate(keys.tolist()):      # dict path for new keys
-            r = idx.get(k)
-            if r is None:
-                r = len(idx)
-                idx[k] = r
-            rows[i] = r
-        if len(idx) > len(self.sum_w_du):
-            self._grow(max(len(idx), 2 * len(self.sum_w_du)))
+        rows = self.index.lookup_or_insert(keys)
+        if self.index.n > len(self.sum_w_du):
+            self._grow(max(self.index.n, 2 * len(self.sum_w_du)))
         return rows
 
     def fold_avg(self, rows: np.ndarray, w: np.ndarray, du: np.ndarray
@@ -151,7 +222,14 @@ class MixServer:
         # training to replica-local SGD, never stops it.
         self.inject_drop_every = 0   # close the connection every Nth request
         self.inject_delay_s = 0.0    # stall each reply this long
+        # throttle (reference: MixServer's per-connection throttling): cap
+        # on key-updates/sec across all connections; 0 = unlimited
+        self.throttle_keys_per_s = 0
         self._requests = 0
+        self._keys_folded = 0
+        self._bytes_in = 0
+        self._bytes_out = 0
+        self._t0 = None
         self._sessions: Dict[str, _GroupStore] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -165,9 +243,24 @@ class MixServer:
             while True:
                 hdr = await reader.readexactly(_LEN.size)
                 (ln,) = _LEN.unpack(hdr)
-                msg = MixMessage.decode(await reader.readexactly(ln))
+                body = await reader.readexactly(ln)
+                msg = MixMessage.decode(body)
+                self._bytes_in += ln + _LEN.size
                 if msg.event == EVENT_CLOSEGROUP:
                     self._sessions.pop(msg.group, None)
+                    continue
+                if msg.event == EVENT_STATS:
+                    import json as _json
+                    payload = _json.dumps(self.counters())
+                    reply = MixMessage(EVENT_STATS, payload,
+                                       np.zeros(0, np.int64),
+                                       np.zeros(0, np.float32),
+                                       np.zeros(0, np.float32),
+                                       np.zeros(0, np.int32))
+                    buf = reply.encode()
+                    self._bytes_out += len(buf)
+                    writer.write(buf)
+                    await writer.drain()
                     continue
                 self._requests += 1
                 if self.inject_delay_s:
@@ -184,9 +277,20 @@ class MixServer:
                 else:
                     out_w = sess.fold_avg(rows, msg.weights, msg.deltas)
                     out_c = np.zeros_like(out_w)
+                self._keys_folded += len(msg.keys)
+                if self.throttle_keys_per_s:
+                    import time as _time
+                    if self._t0 is None:
+                        self._t0 = _time.monotonic()
+                    ahead = (self._keys_folded / self.throttle_keys_per_s
+                             - (_time.monotonic() - self._t0))
+                    if ahead > 0:
+                        await asyncio.sleep(ahead)
                 reply = MixMessage(msg.event, msg.group, msg.keys, out_w,
                                    out_c, msg.deltas)
-                writer.write(reply.encode())
+                buf = reply.encode()
+                self._bytes_out += len(buf)
+                writer.write(buf)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
@@ -195,6 +299,19 @@ class MixServer:
                 writer.close()
             except RuntimeError:
                 pass               # loop already closed during shutdown
+
+    def counters(self) -> Dict[str, float]:
+        """JMX-analog metrics surface (also served over the wire via
+        EVENT_STATS): request/key/byte counters plus live session sizes."""
+        return {
+            "requests": self._requests,
+            "keys_folded": self._keys_folded,
+            "bytes_in": self._bytes_in,
+            "bytes_out": self._bytes_out,
+            "groups": len(self._sessions),
+            "keys_tracked": int(sum(g.index.n
+                                    for g in self._sessions.values())),
+        }
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "MixServer":

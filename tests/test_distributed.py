@@ -71,3 +71,67 @@ def test_two_process_dcn_pmean(tmp_path):
     for rc, out, err in outs:
         assert rc == 0, err[-2000:]
         assert "ok" in out
+
+
+def test_mix_server_stats_and_throttle():
+    """EVENT_STATS counters probe (the JMX-metrics analog) and the
+    key-updates/s throttle (reference MixServer throttling)."""
+    import socket
+    import struct
+    import time as _time
+    import json
+    import numpy as np
+    from hivemall_tpu.parallel.mix_service import (MixServer, MixMessage,
+                                                   EVENT_AVERAGE,
+                                                   EVENT_STATS)
+
+    srv = MixServer().start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        f = s.makefile("rwb")
+
+        def send(msg):
+            f.write(msg.encode())
+            f.flush()
+            ln = struct.unpack("<I", f.read(4))[0]
+            return MixMessage.decode(f.read(ln))
+
+        keys = np.arange(100, dtype=np.int64)
+        send(MixMessage(EVENT_AVERAGE, "g", keys,
+                        np.ones(100, np.float32), np.ones(100, np.float32),
+                        np.ones(100, np.int32)))
+        z = np.zeros(0)
+        rep = send(MixMessage(EVENT_STATS, "", z.astype(np.int64),
+                              z.astype(np.float32), z.astype(np.float32),
+                              z.astype(np.int32)))
+        stats = json.loads(rep.group)
+        assert stats["requests"] == 1 and stats["keys_folded"] == 100
+        assert stats["keys_tracked"] == 100 and stats["groups"] == 1
+
+        # throttle: 1000 keys/s cap makes a 500-key burst take >= ~0.3s
+        srv.throttle_keys_per_s = 1000
+        t0 = _time.monotonic()
+        for _ in range(4):
+            send(MixMessage(EVENT_AVERAGE, "g", keys,
+                            np.ones(100, np.float32),
+                            np.ones(100, np.float32),
+                            np.ones(100, np.int32)))
+        assert _time.monotonic() - t0 > 0.25
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_np_index_vectorized_growth_and_duplicates():
+    import numpy as np
+    from hivemall_tpu.parallel.mix_service import _NpIndex
+    ix = _NpIndex(cap_bits=3)
+    rng = np.random.default_rng(3)
+    seen = {}
+    for _ in range(30):
+        ks = rng.integers(-500, 500, rng.integers(1, 200))
+        rows = ix.lookup_or_insert(ks)
+        assert (rows == ix.lookup_or_insert(ks)).all()   # stable
+        for k, r in zip(ks.tolist(), rows.tolist()):
+            assert seen.setdefault(k, r) == r
+    assert ix.n == len(seen)
