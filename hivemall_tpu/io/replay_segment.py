@@ -1,0 +1,110 @@
+"""Disk-backed multi-epoch replay for the process() lifecycle.
+
+Reference: hivemall's NioStatefulSegment (SURVEY.md §3.20): UDTF trainers
+buffer every processed row and, when ``-iters > 1``, replay the stream for
+further epochs; beyond a memory budget the buffer spills to local disk
+segments and epochs stream them back.
+
+TPU-side analog: rows accumulate in RAM as (idx, val) arrays; once the
+running byte budget (``HIVEMALL_TPU_REPLAY_BUDGET_MB``, default 512) is
+exceeded, the buffered block compacts into a CSR .npz segment file in a
+temp directory. Epoch replay shuffles segment order and row order within
+each segment (loading one segment at a time, so resident memory stays one
+segment regardless of corpus size); when nothing spilled, the caller keeps
+the exact in-RAM global-permutation behavior of earlier rounds.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+__all__ = ["RowSegmentStore"]
+
+
+def _default_budget() -> int:
+    mb = float(os.environ.get("HIVEMALL_TPU_REPLAY_BUDGET_MB", "512"))
+    return int(mb * (1 << 20))
+
+
+class RowSegmentStore:
+    """Append-only store of (idx, val, label) rows with disk spill."""
+
+    def __init__(self, budget_bytes: int | None = None):
+        self.budget = _default_budget() if budget_bytes is None \
+            else int(budget_bytes)
+        self.ram_rows: List[Tuple[np.ndarray, np.ndarray]] = []
+        self.ram_labels: List[float] = []
+        self._ram_bytes = 0
+        self._segments: List[str] = []
+        self._tmpdir: str | None = None
+        self.n_rows = 0
+
+    @property
+    def spilled(self) -> bool:
+        return bool(self._segments)
+
+    def append(self, rows, labels) -> None:
+        for (i, v) in rows:
+            self._ram_bytes += i.nbytes + v.nbytes + 64
+        self.ram_rows.extend(rows)
+        self.ram_labels.extend(labels)
+        self.n_rows += len(rows)
+        if self._ram_bytes > self.budget:
+            self._spill()
+
+    def _spill(self) -> None:
+        if not self.ram_rows:
+            return
+        if self._tmpdir is None:
+            self._tmpdir = tempfile.mkdtemp(prefix="hivemall_tpu_replay_")
+        lens = np.fromiter((len(r[0]) for r in self.ram_rows), np.int64,
+                           len(self.ram_rows))
+        indptr = np.zeros(len(lens) + 1, np.int64)
+        np.cumsum(lens, out=indptr[1:])
+        idx = np.concatenate([r[0] for r in self.ram_rows]).astype(np.int32)
+        val = np.concatenate([r[1] for r in self.ram_rows]).astype(
+            np.float32)
+        lab = np.asarray(self.ram_labels, np.float32)
+        path = os.path.join(self._tmpdir,
+                            f"seg{len(self._segments):05d}.npz")
+        np.savez(path, idx=idx, val=val, indptr=indptr, lab=lab)
+        self._segments.append(path)
+        self.ram_rows, self.ram_labels, self._ram_bytes = [], [], 0
+
+    def _load(self, path: str):
+        z = np.load(path)
+        idx, val, indptr, lab = z["idx"], z["val"], z["indptr"], z["lab"]
+        rows = [(idx[indptr[i]:indptr[i + 1]], val[indptr[i]:indptr[i + 1]])
+                for i in range(len(lab))]
+        return rows, lab.tolist()
+
+    def epoch_rows(self, rng) -> Iterator[Tuple[list, list]]:
+        """One epoch: yields (rows, labels) blocks, one per segment (plus
+        the RAM tail), segment order and within-segment row order
+        shuffled. Resident memory = one segment."""
+        units: List[int | str] = list(self._segments)
+        if self.ram_rows:
+            units.append("ram")
+        order = rng.permutation(len(units))
+        for u in order:
+            unit = units[int(u)]
+            if unit == "ram":
+                rows, labels = self.ram_rows, self.ram_labels
+            else:
+                rows, labels = self._load(unit)
+            perm = rng.permutation(len(rows))
+            yield ([rows[i] for i in perm], [labels[i] for i in perm])
+
+    def cleanup(self) -> None:
+        if self._tmpdir is not None:
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
+            self._tmpdir = None
+        self._segments = []
+        self.ram_rows, self.ram_labels = [], []
+        self._ram_bytes = 0
+        self.n_rows = 0

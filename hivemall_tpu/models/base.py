@@ -86,8 +86,10 @@ class LearnerBase:
         self._names: Dict[int, str] = {}      # hashed id -> original name
         self._buf_rows: List[Tuple[np.ndarray, np.ndarray]] = []
         self._buf_labels: List[float] = []
-        self._all_rows: List[Tuple[np.ndarray, np.ndarray]] = []
-        self._all_labels: List[float] = []
+        # -iters replay buffer: RAM up to a byte budget, then disk
+        # segments (the NioStatefulSegment analog — io/replay_segment.py)
+        from ..io.replay_segment import RowSegmentStore
+        self._replay = RowSegmentStore()
         self._t = 0                           # global step (batches seen)
         self._loss_sum = 0.0                  # host float64, exact
         self._loss_pending = 0.0              # on-device partial, folded in
@@ -144,16 +146,29 @@ class LearnerBase:
         """Flush, run extra epochs (-iters), emit model rows."""
         self._flush()
         iters = int(self.opts.iters)
-        if iters > 1 and self._all_rows:
-            # epoch replay over the recorded stream (NioStatefulSegment analog)
+        if iters > 1 and self._replay.n_rows:
+            # epoch replay over the recorded stream (NioStatefulSegment
+            # analog): exact global shuffle while everything fits the RAM
+            # budget; past it, rows live in disk segments and epochs
+            # stream them back one segment at a time (segment order and
+            # within-segment rows shuffled)
             rng = np.random.default_rng(42)
             bs = int(self.opts.mini_batch)
             for ep in range(1, iters):
-                order = rng.permutation(len(self._all_rows))
-                for s in range(0, len(order), bs):
-                    take = order[s:s + bs]
-                    self._flush_chunk([self._all_rows[i] for i in take],
-                                      [self._all_labels[i] for i in take])
+                if not self._replay.spilled:
+                    rows_all = self._replay.ram_rows
+                    labels_all = self._replay.ram_labels
+                    order = rng.permutation(len(rows_all))
+                    for s in range(0, len(order), bs):
+                        take = order[s:s + bs]
+                        self._flush_chunk([rows_all[i] for i in take],
+                                          [labels_all[i] for i in take])
+                else:
+                    for rows, labels in self._replay.epoch_rows(rng):
+                        for s in range(0, len(rows), bs):
+                            self._flush_chunk(rows[s:s + bs],
+                                              labels[s:s + bs])
+        self._replay.cleanup()
         if self._mixer is not None:
             self._mixer.close_group()
         stream = get_stream()
@@ -400,8 +415,7 @@ class LearnerBase:
         rows, labels = self._buf_rows, self._buf_labels
         self._buf_rows, self._buf_labels = [], []
         if int(self.opts.iters) > 1:
-            self._all_rows.extend(rows)
-            self._all_labels.extend(labels)
+            self._replay.append(rows, labels)
         self._flush_chunk(rows, labels)
 
     def _flush_chunk(self, rows, labels) -> None:

@@ -1,0 +1,64 @@
+"""Disk-backed -iters replay (the NioStatefulSegment analog,
+SURVEY.md §3.20): a process()-fed trainer must run -iters 3 over more
+rows than the RAM budget allows, spilling segments to disk and cleaning
+them up."""
+import glob
+import os
+import tempfile
+
+import numpy as np
+
+from hivemall_tpu.io.replay_segment import RowSegmentStore
+from hivemall_tpu.models.classifier import PerceptronTrainer
+
+
+def test_store_spills_and_replays():
+    store = RowSegmentStore(budget_bytes=4096)   # tiny: forces spilling
+    rng = np.random.default_rng(0)
+    ref = []
+    for _ in range(40):
+        rows = [(rng.integers(1, 100, 5).astype(np.int32),
+                 np.ones(5, np.float32)) for _ in range(8)]
+        labels = [float(rng.integers(0, 2)) for _ in range(8)]
+        store.append(rows, labels)
+        ref += [(tuple(r[0].tolist()), y) for r, y in zip(rows, labels)]
+    assert store.spilled and store.n_rows == 320
+    got = []
+    for rows, labels in store.epoch_rows(np.random.default_rng(1)):
+        got += [(tuple(r[0].tolist()), y) for r, y in zip(rows, labels)]
+    assert sorted(got) == sorted(ref)            # every row exactly once
+    tmp = store._tmpdir
+    assert tmp and glob.glob(os.path.join(tmp, "seg*.npz"))
+    store.cleanup()
+    assert not os.path.exists(tmp)
+
+
+def test_process_iters3_beyond_ram_budget(monkeypatch):
+    monkeypatch.setenv("HIVEMALL_TPU_REPLAY_BUDGET_MB", "0.01")  # ~10 KB
+    rng = np.random.default_rng(2)
+    t = PerceptronTrainer("-dims 512 -mini_batch 32 -iters 3")
+    n = 600
+    for _ in range(n):
+        feats = [f"{i}:1.0" for i in rng.choice(np.arange(1, 512), 6,
+                                                replace=False)]
+        y = 1.0 if int(feats[0].split(":")[0]) % 2 else -1.0
+        t.process(feats, y)
+    assert t._replay.spilled                      # budget forced disk use
+    rows = list(t.close())
+    assert len(rows) > 1
+    assert t._examples == n * 3                   # all 3 epochs ran
+    assert t._replay._tmpdir is None              # cleaned up
+
+
+def test_no_spill_keeps_exact_in_ram_replay():
+    rng = np.random.default_rng(3)
+    a = PerceptronTrainer("-dims 256 -mini_batch 16 -iters 2")
+    b = PerceptronTrainer("-dims 256 -mini_batch 16 -iters 2")
+    data = [([f"{i}:1.0" for i in rng.choice(np.arange(1, 256), 4,
+                                             replace=False)],
+             float(rng.integers(0, 2)) * 2 - 1) for _ in range(100)]
+    for t in (a, b):
+        for f, y in data:
+            t.process(f, y)
+        list(t.close())
+    np.testing.assert_array_equal(np.asarray(a.w), np.asarray(b.w))
