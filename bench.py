@@ -378,8 +378,8 @@ def bench_word2vec() -> dict:
     # zipf-ish token stream so the unigram table/subsampling do real work
     toks = (rng.zipf(1.3, n_tokens) % vocab).astype(np.int32)
     words = [f"w{t}" for t in toks]
-    opts = ("-dim 100 -window 5 -neg 5 -min_count 5 "
-            "-mini_batch 16384 -sample 1e-4")
+    opts = ("-dim 100 -window 5 -neg 16 -neg_sharing batch -min_count 5 "
+            "-mini_batch 32768 -sample 1e-4")
     # warm the XLA compile cache with IDENTICAL shapes (same corpus => same
     # vocab => same table shapes; the compilation cache is cross-instance)
     # outside the timed region — one-off compilation is not the

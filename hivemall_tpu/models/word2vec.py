@@ -48,16 +48,31 @@ class Word2VecTrainer:
               help="negative samples per pair")
         s.add("iters", "iterations", type=int, default=1, help="epochs")
         s.add("min_count", type=int, default=5, help="vocab frequency floor")
-        s.add("alpha", "lr", type=float, default=0.25,
-              help="initial learning rate, linearly decayed. NOTE: applies "
-                   "to the batch-MEAN pair loss, so it sits ~10x above "
-                   "word2vec.c's per-pair 0.025 for equivalent pacing")
+        s.add("alpha", "lr", type=float, default=0.025,
+              help="initial learning rate, linearly decayed. With the "
+                   "default -pacing pair this is word2vec.c's per-pair "
+                   "step size (0.025 means 0.025)")
+        s.add("pacing", default="pair",
+              help="pair (default): per-pair-SUM loss — each pair moves "
+                   "its rows by O(alpha), word2vec.c-compatible option "
+                   "values | mean: round-2 batch-MEAN loss (alpha must "
+                   "scale with mini_batch; kept for compatibility)")
         s.add("sample", type=float, default=1e-4,
               help="frequent-word subsampling threshold (0 = off)")
-        s.add("mini_batch", type=int, default=2048,
-              help="pairs per step. NOTE: the loss is a batch MEAN, so "
-                   "total per-epoch movement scales with alpha/mini_batch "
-                   "— raise alpha when raising this")
+        s.add("neg_sharing", default="pair",
+              help="pair (default): word2vec.c per-pair negative draws | "
+                   "batch: ONE negative set shared by the whole minibatch "
+                   "(candidate-sampling style). Sharing turns the "
+                   "negative path into a [B,D]x[D,neg] MXU matmul and a "
+                   "neg-row scatter instead of B*neg gather/scatter rows "
+                   "— ~3x step throughput; raise -neg (e.g. 16-64) to "
+                   "compensate the shared draw")
+        s.add("mini_batch", type=int, default=16384,
+              help="pairs per step. Under -pacing pair each pair "
+                   "contributes its own O(alpha) step regardless of batch "
+                   "size (hogwild-style minibatch of word2vec.c's "
+                   "sequential updates), so bigger batches only reduce "
+                   "dispatch overhead")
         s.add("seed", type=int, default=11, help="rng seed")
         s.flag("cbow", help="CBOW instead of SkipGram")
         s.add("mesh", default=None,
@@ -92,13 +107,32 @@ class Word2VecTrainer:
 
     # -- training ------------------------------------------------------------
     def _build_vocab(self, docs: Sequence[Sequence[str]]) -> np.ndarray:
-        counts = Counter(w for d in docs for w in d)
-        kept = [(w, c) for w, c in counts.most_common()
-                if c >= int(self.opts.min_count)]
-        self.vocab = {w: i for i, (w, _) in enumerate(kept)}
-        self.inv_vocab = [w for w, _ in kept]
-        freqs = np.asarray([c for _, c in kept], np.float64)
-        return freqs
+        # vectorized: ONE np.unique pass over the corpus replaces the
+        # Counter + two per-token dict walks (~1.2 s of the text8-scale
+        # bench was host string work); per-doc id arrays are cached for
+        # train() via the same inverse
+        parts = [np.asarray(d, dtype=np.str_) for d in docs if len(d)]
+        flat = np.concatenate(parts) if parts else np.asarray([], np.str_)
+        uniq, inverse, counts = np.unique(
+            flat, return_inverse=True, return_counts=True)
+        keep = counts >= int(self.opts.min_count)
+        order = np.argsort(-counts[keep], kind="stable")
+        kept_words = uniq[keep][order]
+        kept_counts = counts[keep][order]
+        remap = np.full(len(uniq), -1, np.int64)
+        remap[np.nonzero(keep)[0][order]] = np.arange(order.size)
+        ids_flat = remap[inverse]
+        self.vocab = {w: i for i, w in enumerate(kept_words.tolist())}
+        self.inv_vocab = kept_words.tolist()
+        # cache per-doc id arrays (dropping out-of-vocab tokens)
+        self._ids_docs_cache = []
+        off = 0
+        for d in docs:
+            ids = ids_flat[off:off + len(d)]
+            off += len(d)
+            self._ids_docs_cache.append(
+                ids[ids >= 0].astype(np.int32))
+        return np.asarray(kept_counts, np.float64)
 
     def _neg_table(self, freqs: np.ndarray, size: int = 1 << 20) -> np.ndarray:
         """Unigram^0.75 sampling table (word2vec.c style)."""
@@ -110,6 +144,9 @@ class Word2VecTrainer:
 
     def _make_step(self, cbow: bool, vocab_size: int, dim: int):
         neg = int(self.opts.neg)
+        pair_pacing = str(getattr(self.opts, "pacing", "pair")) == "pair"
+        share_neg = str(getattr(self.opts, "neg_sharing",
+                                "pair")) == "batch"
         # Two update variants, chosen by table size (measured on v5e):
         #   dense  — autodiff over the whole (in, out) tables; the SGD
         #            update is two fused elementwise passes. Fastest while
@@ -124,7 +161,12 @@ class Word2VecTrainer:
         # counter) and rebuild the pair mask from the valid-count scalar:
         # per-step h2d drops from 4 arrays (~520 KB at B=16k) to the two
         # id arrays — the dispatch link is the e2e bottleneck here.
-        if vocab_size * dim <= (1 << 23):
+        if vocab_size * dim <= (1 << 23) and not share_neg:
+            # NOTE: with -neg_sharing batch the sparse slab step wins at
+            # every vocab size (measured 5 ms vs 20 ms at V=16k, B=32k —
+            # the dense autodiff materializes several [V,D] passes while
+            # shared negatives already removed the sparse path's per-pair
+            # neg rows)
             return self._make_step_dense(cbow)
 
         seed = int(self.opts.seed)
@@ -134,9 +176,14 @@ class Word2VecTrainer:
             # SkipGram: v_in = in[center]; target = context
             # CBOW: v_in = mean(in[context window]) handled by caller passing
             #       the window in `center` as [B, 2w] with -1 padding
+            # ids may arrive uint16 (halved h2d bytes — the relay link is
+            # the e2e bottleneck); widen on device
+            center = center.astype(jnp.int32)
+            context = context.astype(jnp.int32)
             B = context.shape[0]
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
-            negs = ntab[jax.random.randint(key, (B, neg), 0, ntab.shape[0])]
+            nshape = (neg,) if share_neg else (B, neg)
+            negs = ntab[jax.random.randint(key, nshape, 0, ntab.shape[0])]
             row_mask = (jnp.arange(B) < nvalid).astype(jnp.float32)
             if cbow:
                 cmask = (center >= 0).astype(jnp.float32)
@@ -145,7 +192,7 @@ class Word2VecTrainer:
             else:
                 vin_slab = in_emb[center]                    # [B, D]
             pos_slab = out_emb[context]                      # [B, D]
-            neg_slab = out_emb[negs]                         # [B, neg, D]
+            neg_slab = out_emb[negs]            # [neg, D] or [B, neg, D]
 
             def batch_loss(vin, op, on):
                 if cbow:
@@ -154,12 +201,18 @@ class Word2VecTrainer:
                 else:
                     v = vin
                 pos = (v * op).sum(-1)
-                negd = jnp.einsum("bd,bnd->bn", v, on)
+                if share_neg:
+                    negd = jnp.einsum("bd,nd->bn", v, on)    # MXU
+                else:
+                    negd = jnp.einsum("bd,bnd->bn", v, on)
                 per_pair = (jax.nn.softplus(-pos)
                             + jax.nn.softplus(negd).sum(-1)) * row_mask
-                # mean over valid pairs: per-word effective step stays O(lr)
-                # even when one word recurs many times in a batch (the
-                # batched analog of word2vec.c's sequential per-pair steps)
+                if pair_pacing:
+                    # per-pair SUM: every pair moves its rows by O(lr) —
+                    # word2vec.c's pacing, batched hogwild-style
+                    return per_pair.sum()
+                # batch MEAN (round-2 semantics): effective per-pair step
+                # is lr / n_valid
                 return per_pair.sum() / jnp.maximum(row_mask.sum(), 1.0)
 
             loss, (gv, gp, gn) = jax.value_and_grad(
@@ -178,14 +231,20 @@ class Word2VecTrainer:
 
     def _make_step_dense(self, cbow: bool):
         neg = int(self.opts.neg)
+        pair_pacing = str(getattr(self.opts, "pacing", "pair")) == "pair"
+        share_neg = str(getattr(self.opts, "neg_sharing",
+                                "pair")) == "batch"
 
         seed = int(self.opts.seed)
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def step(in_emb, out_emb, ntab, center, context, nvalid, t, lr):
+            center = center.astype(jnp.int32)
+            context = context.astype(jnp.int32)
             B = context.shape[0]
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
-            negs = ntab[jax.random.randint(key, (B, neg), 0, ntab.shape[0])]
+            nshape = (neg,) if share_neg else (B, neg)
+            negs = ntab[jax.random.randint(key, nshape, 0, ntab.shape[0])]
             row_mask = (jnp.arange(B) < nvalid).astype(jnp.float32)
 
             def batch_loss(tables):
@@ -198,12 +257,15 @@ class Word2VecTrainer:
                 else:
                     v = ie[center]
                 pos = (v * oe[context]).sum(-1)
-                negd = jnp.einsum("bd,bnd->bn", v, oe[negs])
+                if share_neg:
+                    negd = jnp.einsum("bd,nd->bn", v, oe[negs])
+                else:
+                    negd = jnp.einsum("bd,bnd->bn", v, oe[negs])
                 per_pair = (jax.nn.softplus(-pos)
                             + jax.nn.softplus(negd).sum(-1)) * row_mask
-                # mean over valid pairs: per-word effective step stays O(lr)
-                # even when one word recurs many times in a batch (the
-                # batched analog of word2vec.c's sequential per-pair steps)
+                if pair_pacing:
+                    # per-pair SUM — word2vec.c pacing (see _make_step)
+                    return per_pair.sum()
                 return per_pair.sum() / jnp.maximum(row_mask.sum(), 1.0)
 
             loss, grads = jax.value_and_grad(batch_loss)((in_emb, out_emb))
@@ -279,7 +341,8 @@ class Word2VecTrainer:
             self.in_emb = jax.device_put(self.in_emb, sh)
             self.out_emb = jax.device_put(self.out_emb, sh)
             table = jax.device_put(table, NamedSharding(self.mesh, P()))
-        ids_docs =[np.asarray([self.vocab[w] for w in d if w in self.vocab],
+        ids_docs = getattr(self, "_ids_docs_cache", None) or \
+            [np.asarray([self.vocab[w] for w in d if w in self.vocab],
                                np.int32) for d in docs]
         total = sum(len(d) for d in ids_docs)
         # frequent-word subsampling probabilities (word2vec.c formula)
@@ -305,43 +368,64 @@ class Word2VecTrainer:
 
         nstep = 0
 
-        def dispatch(c: np.ndarray, x: np.ndarray, progress: float) -> None:
-            """One fixed-shape [B] (or [B, 2w]) step; short batches pad.
-            Only the two id arrays cross host->device; negatives and the
-            pair mask are built on device (see _make_step)."""
+        wire_dt = np.uint16 if (not cbow and V < 65536) else np.int32
+        K = 8               # steps shipped per h2d block (latency ~5 ms
+                            # per transfer through the relay dominates; one
+                            # [K*B] block transfer feeds K pipelined steps)
+
+        def dispatch_block(c: np.ndarray, x: np.ndarray, progress: float
+                           ) -> None:
+            """Ship up to K steps' pair ids in ONE h2d each, then step on
+            device-resident slices; short tails pad to B and mask."""
             nonlocal nstep
-            nb = len(x)
-            if nb == 0:
+            n = len(x)
+            if n == 0:
                 return
-            if nb < B:
-                pad = B - nb
+            nfull = -(-n // B) * B
+            if nfull != n:
+                padn = nfull - n
                 c = np.concatenate(
-                    [c, np.full((pad,) + c.shape[1:],
-                                -1 if cbow else 0, np.int32)])
-                x = np.concatenate([x, np.zeros(pad, np.int32)])
+                    [c, np.full((padn,) + c.shape[1:],
+                                -1 if cbow else 0, c.dtype)])
+                x = np.concatenate([x, np.zeros(padn, x.dtype)])
             lr = max(alpha * (1.0 - progress), alpha * 1e-4)
-            nstep += 1
-            cd, xd = jnp.asarray(c), jnp.asarray(x)
+            cd_all = jnp.asarray(c.astype(wire_dt, copy=False))
+            xd_all = jnp.asarray(x.astype(wire_dt, copy=False))
             if self.mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
-                cd = jax.device_put(cd, NamedSharding(
-                    self.mesh, P("dp", *([None] * (cd.ndim - 1)))))
-                xd = jax.device_put(xd, NamedSharding(self.mesh, P("dp")))
-            self.in_emb, self.out_emb, _ = step(
-                self.in_emb, self.out_emb, table, cd, xd, nb, nstep, lr)
+                cd_all = jax.device_put(cd_all, NamedSharding(
+                    self.mesh, P(None, *([None] * (cd_all.ndim - 1)))))
+                xd_all = jax.device_put(xd_all,
+                                        NamedSharding(self.mesh, P(None)))
+            for s0 in range(0, nfull, B):
+                nb = min(B, n - s0)
+                if nb <= 0:
+                    break
+                nstep += 1
+                cd = cd_all[s0:s0 + B]
+                xd = xd_all[s0:s0 + B]
+                if self.mesh is not None:
+                    from jax.sharding import NamedSharding, \
+                        PartitionSpec as P
+                    cd = jax.device_put(cd, NamedSharding(
+                        self.mesh, P("dp", *([None] * (cd.ndim - 1)))))
+                    xd = jax.device_put(xd,
+                                        NamedSharding(self.mesh, P("dp")))
+                self.in_emb, self.out_emb, _ = step(
+                    self.in_emb, self.out_emb, table, cd, xd, nb, nstep,
+                    lr)
 
         def drain(progress: float, final: bool = False) -> None:
             nonlocal pend_c, pend_x, pending
-            if pending >= B or (final and pending):
+            if pending >= K * B or (final and pending):
                 c = np.concatenate(pend_c)
                 x = np.concatenate(pend_x)
                 nfull = (len(x) // B) * B
-                for s in range(0, nfull, B):
-                    dispatch(c[s:s + B], x[s:s + B], progress)
-                if final and nfull < len(x):
-                    dispatch(c[nfull:], x[nfull:], progress)
+                if final:
+                    dispatch_block(c, x, progress)
                     pend_c, pend_x, pending = [], [], 0
                 else:
+                    dispatch_block(c[:nfull], x[:nfull], progress)
                     pend_c = [c[nfull:]]
                     pend_x = [x[nfull:]]
                     pending = len(x) - nfull
@@ -356,11 +440,17 @@ class Word2VecTrainer:
                 else:
                     c, x = self._skipgram_pairs(d, win, rng)
                 if len(x):
-                    # shuffle within the doc chunk: the per-delta grouping
-                    # above would otherwise feed same-offset runs
-                    perm = rng.permutation(len(x))
-                    pend_c.append(c[perm])
-                    pend_x.append(x[perm])
+                    if str(o.pacing) == "mean":
+                        # mean pacing needs in-chunk shuffling: the
+                        # per-delta grouping feeds same-offset runs that
+                        # skew the batch mean. Pair pacing processes pairs
+                        # in corpus order — word2vec.c's own order — and
+                        # skips the ~1s host permutation+gather per 10M+
+                        # pair chunk.
+                        perm = rng.permutation(len(x))
+                        c, x = c[perm], x[perm]
+                    pend_c.append(c)
+                    pend_x.append(x)
                     pending += len(x)
                 tokens_done += len(d)
                 drain(tokens_done / max(1, total * epochs))

@@ -26,10 +26,10 @@ def test_clusters_separate(mode):
         # CBOW emits ~2w-fold fewer training pairs per corpus pass than
         # SkipGram, so it needs more epochs / a hotter lr to separate
         opts = ("-dim 16 -window 3 -neg 4 -min_count 2 -alpha 1.0 "
-                "-mini_batch 512 -iters 12 -sample 0 -cbow")
+                "-mini_batch 512 -iters 12 -sample 0 -cbow -pacing mean")
     else:
         opts = ("-dim 16 -window 3 -neg 4 -min_count 2 -alpha 0.5 "
-                "-mini_batch 512 -iters 8 -sample 0")
+                "-mini_batch 512 -iters 8 -sample 0 -pacing mean")
     t = Word2VecTrainer(opts).train(docs)
     same = t.similarity("cat", "dog")
     cross = t.similarity("cat", "gpu")
@@ -142,3 +142,25 @@ def test_word2vec_mesh_trains():
     # similar-context words should still embed meaningfully
     v = t.vectors()
     assert len(v) == 50
+
+
+def test_pair_pacing_converges_at_word2vec_c_alpha():
+    """-pacing pair (the default): word2vec.c option values work as-is —
+    alpha 0.025/pair separates the synthetic clusters without the x10
+    round-2 footgun scaling."""
+    rng = np.random.default_rng(0)
+    A = [f"a{i}" for i in range(6)]
+    B = [f"b{i}" for i in range(6)]
+    docs = []
+    for _ in range(300):
+        docs.append(list(rng.permutation(A)))
+        docs.append(list(rng.permutation(B)))
+    t = Word2VecTrainer("-dim 16 -window 3 -neg 4 -min_count 2 "
+                        "-alpha 0.025 -mini_batch 512 -iters 10 -sample 0")
+    assert str(t.opts.pacing) == "pair"
+    t.train(docs)
+    within = np.mean([t.similarity("a0", "a1"), t.similarity("a2", "a3"),
+                      t.similarity("b0", "b1")])
+    across = np.mean([t.similarity("a0", "b0"), t.similarity("a1", "b3"),
+                      t.similarity("a4", "b2")])
+    assert within > across + 0.2, (within, across)
