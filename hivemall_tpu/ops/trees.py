@@ -247,6 +247,33 @@ def _reg_leaf(parent):     # mean in channel 0 slot; keep stats for ensembling
 
 
 @lru_cache(maxsize=128)
+def make_forest_builder_sharded(build, mesh):
+    """Ensemble parallelism (SURVEY.md §3.17 row 4): per-device bootstrap
+    tree builds over a dp mesh. Trees are embarrassingly parallel — the
+    tree axis (weights, rng keys) shards over 'dp', bins replicate, and
+    shard_map runs each device's sub-forest with the Pallas histogram
+    kernel on local shapes (pallas_call cannot be GSPMD-partitioned, so
+    the explicit shard_map IS the supported multi-chip path). The vote
+    gather happens on the host over the [E]-sharded outputs."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map as _sm
+
+        def smap(f, **kw):
+            return _sm(f, **kw)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        def smap(f, **kw):
+            return _sm(f, **kw)
+    return jax.jit(_sm(
+        build, mesh=mesh,
+        in_specs=(P(), P(), P("dp"), P("dp")),
+        out_specs=(P("dp"), P("dp"), P("dp")),
+        check_vma=False))
+
+
 def _cached_builder(task: str, n_channels: int, depth: int, n_bins: int,
                     mtry: int, min_split: float, min_leaf: float,
                     lam: float, vmapped: bool, use_pallas: bool):
@@ -279,13 +306,22 @@ def build_tree_classifier(bins: np.ndarray, labels: np.ndarray,
                           n_classes: int, *, depth: int = 8,
                           n_bins: int = 64, mtry: int = 0,
                           min_split: float = 2.0, min_leaf: float = 1.0,
-                          seed: int = 42, n_trees: int = 1) -> Tree:
-    """Gini trees; weights [E, n] give per-tree bootstrap counts."""
+                          seed: int = 42, n_trees: int = 1,
+                          mesh=None) -> Tree:
+    """Gini trees; weights [E, n] give per-tree bootstrap counts. With
+    ``mesh`` (a dp-axis jax Mesh), trees shard over devices."""
     onehot = jax.nn.one_hot(labels, n_classes)
     build = _cached_builder("gini", n_classes, depth, n_bins, mtry,
                             float(min_split), float(min_leaf), 0.0, True,
                             use_pallas_default())
     keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
+    if mesh is not None:
+        dp = mesh.shape["dp"]
+        if n_trees % dp:
+            raise ValueError(f"-trees {n_trees} must divide by dp={dp}")
+        build = make_forest_builder_sharded(build.__wrapped__
+                                            if hasattr(build, "__wrapped__")
+                                            else build, mesh)
     f, t, v = build(jnp.asarray(bins), onehot, jnp.asarray(weights), keys)
     return Tree(np.asarray(f), np.asarray(t), np.asarray(v), edges)
 

@@ -27,7 +27,8 @@ from jax import shard_map
 from ..ops.losses import Loss
 from ..ops.optimizers import Optimizer
 
-__all__ = ["mix_average", "argmin_kld_mix", "make_replica_train_step"]
+__all__ = ["mix_average", "argmin_kld_mix", "make_replica_train_step",
+           "make_covariance_replica_step"]
 
 
 def mix_average(w: jnp.ndarray, axis: str = "dp") -> jnp.ndarray:
@@ -83,4 +84,49 @@ def make_replica_train_step(mesh: Mesh, loss: Loss, optimizer: Optimizer,
         in_specs=(P("dp", None), pspec_state, P(), P("dp", None),
                   P("dp", None), P("dp")),
         out_specs=(P("dp", None), pspec_state, P()),
+        check_vma=False))
+
+
+def make_covariance_replica_step(mesh: Mesh, rates: Callable,
+                                 mix_every: int = 16) -> Callable:
+    """Covariance-family (CW/AROW/SCW) replicas under a dp mesh with
+    argmin-KLD mixing — the MixServer 'argminKLD' event as an ICI
+    collective (reference: PartialArgminKLD folded by the server; SURVEY
+    §3.16/§3.17). Each device trains a local (w, sigma) on its batch shard
+    with the closed-form aggregate update (models.classifier._make_step
+    math); every ``mix_every`` steps the replicas merge by precision
+    weighting.
+
+    w, sigma: [dp, N]; rates(margin_y, v) -> (alpha, beta) is the
+    trainer's closed-form rate fn (e.g. AROWTrainer()._rates()).
+    """
+
+    def local_step(w, sigma, t, idx, val, label):
+        w, sigma = w[0], sigma[0]
+        wg = w[idx]
+        m = (wg * val).sum(-1) * label
+        sg = sigma[idx]
+        v = (sg * val * val).sum(-1)
+        alpha, beta = rates(m, v)
+        dw = jnp.zeros_like(w).at[idx.ravel()].add(
+            ((alpha * label)[:, None] * sg * val).ravel())
+        ds = jnp.zeros_like(sigma).at[idx.ravel()].add(
+            (beta[:, None] * (sg * val) ** 2).ravel())
+        w2 = w + dw
+        sig2 = jnp.maximum(sigma - ds, 1e-8)
+        do_mix = (t + 1.0) % mix_every == 0.0
+
+        def mix(args):
+            return argmin_kld_mix(args[0], args[1], "dp")
+
+        w2, sig2 = lax.cond(do_mix, mix, lambda a: a, (w2, sig2))
+        loss_sum = lax.psum(
+            jnp.maximum(0.0, 1.0 - m).sum(), "dp")
+        return w2[None], sig2[None], loss_sum
+
+    return jax.jit(shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("dp", None), P("dp", None), P(), P("dp", None),
+                  P("dp", None), P("dp")),
+        out_specs=(P("dp", None), P("dp", None), P()),
         check_vma=False))
