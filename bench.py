@@ -543,10 +543,80 @@ def bench_mix() -> dict:
             "server_counters": counters}
 
 
+def bench_lda() -> dict:
+    """Online VB LDA (SURVEY §3.10) on a synthetic 2-topic corpus."""
+    import numpy as np
+    from hivemall_tpu.models.topicmodel import LDATrainer
+
+    rng = np.random.default_rng(0)
+    A = [f"a{i}" for i in range(40)]
+    Bw = [f"b{i}" for i in range(40)]
+    docs = []
+    n_docs = 3000
+    for _ in range(n_docs):
+        g = A if rng.random() < 0.5 else Bw
+        docs.append([g[rng.integers(40)] for _ in range(30)])
+    LDATrainer("-topics 2 -mini_batch 256").fit(docs[:256])   # warm
+    t0 = time.perf_counter()
+    LDATrainer("-topics 2 -mini_batch 256").fit(docs)
+    dt = time.perf_counter() - t0
+    return {"metric": "train_lda_docs_per_sec",
+            "value": round(n_docs / dt, 1), "unit": "docs/sec",
+            "seconds": round(dt, 3)}
+
+
+def bench_changefinder() -> dict:
+    """ChangeFinder SDAR two-stage over a scalar stream (SURVEY §3.11)."""
+    import numpy as np
+    from hivemall_tpu.models.anomaly import changefinder
+
+    rng = np.random.default_rng(0)
+    n = 50_000
+    x = np.concatenate([rng.normal(0, 1, n // 2),
+                        rng.normal(4, 1, n // 2)])
+    changefinder(x[:1000])                                    # warm
+    t0 = time.perf_counter()
+    out = changefinder(x)
+    dt = time.perf_counter() - t0
+    assert len(out) == n
+    return {"metric": "changefinder_points_per_sec",
+            "value": round(n / dt, 1), "unit": "points/sec",
+            "seconds": round(dt, 3)}
+
+
+def bench_topk_knn() -> dict:
+    """each_top_k + cosine kNN micro-config (SURVEY §3.13/§3.15): per-group
+    top-k over a scored stream plus a brute-force cosine row."""
+    import numpy as np
+    from hivemall_tpu.frame.tools import each_top_k
+    from hivemall_tpu.knn.similarity import cosine_similarity
+
+    rng = np.random.default_rng(0)
+    n, groups = 500_000, 2000
+    g = np.repeat(np.arange(groups), n // groups)
+    s = rng.random(n)
+    v = np.arange(n)
+    t0 = time.perf_counter()
+    out = list(each_top_k(5, g, s, v))
+    dt = time.perf_counter() - t0
+    assert len(out) == groups * 5
+    q = rng.normal(0, 1, 128)
+    C = rng.normal(0, 1, (1000, 128))
+    t1 = time.perf_counter()
+    sims = [cosine_similarity(q, c) for c in C]
+    dt_knn = time.perf_counter() - t1
+    assert len(sims) == 1000
+    return {"metric": "each_top_k_rows_per_sec",
+            "value": round(n / dt, 1), "unit": "rows/sec",
+            "seconds": round(dt, 3),
+            "knn_cosine_1000x128_seconds": round(dt_knn, 4)}
+
+
 _BENCHES = ("bench_linear", "bench_ffm_kernel", "bench_ffm_e2e",
             "bench_ffm_parquet_stream", "bench_ingest", "bench_fm",
             "bench_mf", "bench_word2vec", "bench_trees", "bench_gbt",
-            "bench_seq_exact", "bench_mix")
+            "bench_seq_exact", "bench_mix", "bench_lda",
+            "bench_changefinder", "bench_topk_knn")
 
 
 def _emit(configs) -> None:
