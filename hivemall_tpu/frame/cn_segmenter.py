@@ -69,6 +69,7 @@ _CONTENT = (
     "漂亮 美丽 可爱 聪明 认真 努力 热情 友好 安静 干净 整齐 方便 舒服 "
     "重要 主要 基本 简单 复杂 容易 困难 新鲜 便宜 昂贵 快速 缓慢 "
     "一个 两个 三个 第一 第二 许多 很多 不少 大量 全部 部分 半天 "
+    "首都 回答 每天 每年 这样 那样 怎样 晴天 阴天 "
     "人民 政府 法律 权利 机会 能力 水平 标准 质量 价格 市场 产品 服务 "
     "信息 数据 网络 互联网 软件 系统 程序 手段 目标 计划 项目 任务").split()
 

@@ -62,7 +62,30 @@ _CONTENT = (
     "大きい 小さい 高い 安い 新しい 古い 良い いい 悪い 早い 遅い "
     "多い 少ない 長い 短い 強い 弱い 白い 黒い 赤い 青い "
     "好き 嫌い 静か 元気 有名 大切 大丈夫 "
-    "一 二 三 四 五 六 七 八 九 十 百 千 万 円 歳 個 回 匹 冊 台").split()
+    "一 二 三 四 五 六 七 八 九 十 百 千 万 円 歳 個 回 匹 冊 台 "
+    "天気 季節 春 夏 秋 冬 花 桜 森 林 田 畑 島 橋 庭 公園 "
+    "病院 銀行 空港 図書館 大学 高校 中学 小学校 教室 事務所 工場 "
+    "医者 看護師 警察 運転手 社長 部長 課長 店員 選手 歌手 作家 記者 "
+    "電気 机 椅子 窓 扉 服 靴 帽子 眼鏡 鞄 傘 "
+    "牛乳 卵 魚 米 塩 砂糖 醤油 味噌 弁当 寿司 "
+    "問題 質問 答え 意味 理由 結果 方法 仕事 勉強 宿題 試験 授業 "
+    "旅行 買い物 散歩 運動 練習 試合 約束 予定 計画 経験 "
+    "気持ち 心 体 頭 顔 目 耳 口 鼻 足 背 声 "
+    "お金 値段 切符 地図 荷物 お土産 "
+    "始まり 終わり 始め 終わっ 始まっ 終わる 始まる "
+    "かけ かける かけた 登り 登る 登っ あり ませ "
+    "休み 休む 休ん 遊び 遊ぶ 遊ん 泳ぎ 泳ぐ 泳い "
+    "教え 教える 習い 習う 習っ 覚え 覚える 忘れ 忘れる "
+    "開け 開ける 閉め 閉める 置き 置く 置い 取り 取る 取っ "
+    "渡し 渡す 渡っ 送り 送る 送っ 届き 届く 届い "
+    "会い 会う 会っ 立ち 立つ 立っ 座り 座る 座っ "
+    "寝 寝る 起き 起きる 死ぬ 生まれ 生まれる "
+    "楽しい 嬉しい 悲しい 寒い 暑い 暖かい 涼しい 難しい 易しい "
+    "忙しい 美しい 可愛い 広い 狭い 重い 軽い 近い 遠い 甘い 辛い "
+    "便利 簡単 複雑 特別 普通 自由 安全 危険 必要 "
+    "とても すこし 少し たくさん いつも 時々 もう まだ すぐ ゆっくり "
+    "今度 今回 最初 最後 "
+    "みんな 全部 半分 毎日 毎朝 毎晩 毎週 毎年").split()
 
 LEXICON: Dict[str, int] = {}
 for _w in _PARTICLES:
@@ -80,10 +103,23 @@ for _w in _CONTENT:
 
 _MAX_WORD = max(len(w) for w in LEXICON)
 _PARTICLE_SET = frozenset(_PARTICLES)
-# unigram lattices over-segment runs of particles (もももも...); a light
-# particle-after-particle transition penalty plays the connection-cost role
-# of a full morphological analyzer at two-state scale
-_PP_PENALTY = 150
+_AUX_SET = frozenset(_FUNC)
+# Connection-cost classes (round 3): the reference Kuromoji consults a
+# full left/right-id connection matrix; here words fall into four classes
+# — particle, aux/function, content, unknown — with a small transition
+# table. particle->particle keeps the round-2 penalty (unigram lattices
+# over-segment もももも... runs); content->particle and content->aux get a
+# DISCOUNT (the dominant Japanese clause shape), unk->unk is penalized so
+# known decompositions win inside mixed runs.
+_CLS_PART, _CLS_AUX, _CLS_CONTENT, _CLS_UNK = 0, 1, 2, 3
+_N_CLS = 4
+_CONN = [
+    #  to: part aux  cont unk      from:
+    [150,   40,   0,  60],       # particle
+    [40,     0,   0,  60],       # aux
+    [-60,  -40,   0,   0],       # content
+    [40,    60,   0, 120],       # unknown
+]
 
 
 def _script(ch: str) -> str:
@@ -114,31 +150,36 @@ _UNK = {
 }
 
 
-def _segment_chunk(text: str) -> List[str]:
-    """Viterbi min-cost segmentation of one script-continuous chunk.
+def _word_class(w: str) -> int:
+    if w in _PARTICLE_SET:
+        return _CLS_PART
+    if w in _AUX_SET:
+        return _CLS_AUX
+    return _CLS_CONTENT
 
-    Two lattice states per position — previous word was / was not a
-    particle — so the particle-particle connection penalty applies."""
+
+def _segment_chunk(text: str) -> List[str]:
+    """Viterbi min-cost segmentation of one script-continuous chunk with
+    connection-cost classes (state = class of the previous word)."""
     n = len(text)
     INF = 1 << 60
-    # best[pos][state]: state 1 = last emitted word was a particle
-    best = [[INF, INF] for _ in range(n + 1)]
+    best = [[INF] * _N_CLS for _ in range(n + 1)]
     back: List[List[Tuple[int, int, int]]] = \
-        [[(0, 0, 0), (0, 0, 0)] for _ in range(n + 1)]
-    best[0][0] = 0
+        [[(0, 0, 0)] * _N_CLS for _ in range(n + 1)]
+    best[0][_CLS_CONTENT] = 0          # sentence start: neutral class
     scripts = [_script(c) for c in text]
 
-    def relax(i: int, ln: int, cost: int, is_particle: bool) -> None:
-        st = 1 if is_particle else 0
-        for prev_st in (0, 1):
-            base = best[i][prev_st]
+    def relax(i: int, ln: int, cost: int, cls: int) -> None:
+        row_base = best[i]
+        tgt = best[i + ln]
+        for prev in range(_N_CLS):
+            base = row_base[prev]
             if base >= INF:
                 continue
-            c = base + cost + (_PP_PENALTY if (prev_st and is_particle)
-                               else 0)
-            if c < best[i + ln][st]:
-                best[i + ln][st] = c
-                back[i + ln][st] = (i, ln, prev_st)
+            c = base + cost + _CONN[prev][cls]
+            if c < tgt[cls]:
+                tgt[cls] = c
+                back[i + ln][cls] = (i, ln, prev)
 
     for i in range(n):
         if min(best[i]) >= INF:
@@ -148,7 +189,7 @@ def _segment_chunk(text: str) -> List[str]:
             w = text[i:i + ln]
             c = LEXICON.get(w)
             if c is not None:
-                relax(i, ln, c, w in _PARTICLE_SET)
+                relax(i, ln, c, _word_class(w))
         # unknown words: same-script runs from i
         s = scripts[i]
         base, per, mx = _UNK[s]
@@ -156,11 +197,11 @@ def _segment_chunk(text: str) -> List[str]:
         while i + run < n and run < mx and scripts[i + run] == s:
             run += 1
         for ln in range(1, run + 1):
-            relax(i, ln, base + per * (ln - 1), False)
+            relax(i, ln, base + per * (ln - 1), _CLS_UNK)
 
     out: List[str] = []
     pos = n
-    st = 0 if best[n][0] <= best[n][1] else 1
+    st = min(range(_N_CLS), key=lambda k: best[n][k])
     while pos > 0:
         i, ln, prev_st = back[pos][st]
         out.append(text[i:pos])
