@@ -49,8 +49,10 @@ class RowSegmentStore:
         return bool(self._segments)
 
     def append(self, rows, labels) -> None:
-        for (i, v) in rows:
-            self._ram_bytes += i.nbytes + v.nbytes + 64
+        # a row is an arity-k tuple of parallel arrays (linear trainers:
+        # (idx, val); FFM: (idx, val, field); ...)
+        for r in rows:
+            self._ram_bytes += sum(np.asarray(a).nbytes for a in r) + 64
         self.ram_rows.extend(rows)
         self.ram_labels.extend(labels)
         self.n_rows += len(rows)
@@ -66,20 +68,25 @@ class RowSegmentStore:
                            len(self.ram_rows))
         indptr = np.zeros(len(lens) + 1, np.int64)
         np.cumsum(lens, out=indptr[1:])
-        idx = np.concatenate([r[0] for r in self.ram_rows]).astype(np.int32)
-        val = np.concatenate([r[1] for r in self.ram_rows]).astype(
-            np.float32)
-        lab = np.asarray(self.ram_labels, np.float32)
+        arity = len(self.ram_rows[0])
+        payload = {"indptr": indptr,
+                   "lab": np.asarray(self.ram_labels, np.float32)}
+        for k in range(arity):
+            payload[f"a{k}"] = np.concatenate(
+                [np.asarray(r[k]) for r in self.ram_rows])
         path = os.path.join(self._tmpdir,
                             f"seg{len(self._segments):05d}.npz")
-        np.savez(path, idx=idx, val=val, indptr=indptr, lab=lab)
+        np.savez(path, **payload)
         self._segments.append(path)
         self.ram_rows, self.ram_labels, self._ram_bytes = [], [], 0
 
     def _load(self, path: str):
         z = np.load(path)
-        idx, val, indptr, lab = z["idx"], z["val"], z["indptr"], z["lab"]
-        rows = [(idx[indptr[i]:indptr[i + 1]], val[indptr[i]:indptr[i + 1]])
+        indptr, lab = z["indptr"], z["lab"]
+        comps = [z[k] for k in sorted(
+            (f for f in z.files if f.startswith("a")),
+            key=lambda f: int(f[1:]))]
+        rows = [tuple(c[indptr[i]:indptr[i + 1]] for c in comps)
                 for i in range(len(lab))]
         return rows, lab.tolist()
 
