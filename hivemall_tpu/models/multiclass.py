@@ -214,49 +214,85 @@ class _MulticlassBase:
         return step
 
     def _make_step_sequential(self):
-        """Reference-exact row-by-row multiclass updates in ONE dispatch
-        (lax.scan) — the models/classifier.py sequential mode for the
-        per-class table family: each row scores all classes against the
-        PREVIOUS row's updated W/sigma, exactly like the reference's
-        streaming UDTF."""
+        """Reference-exact row-by-row multiclass updates at slab rate.
+
+        The round-3 slab scan (models/classifier.py): gather G=64 rows'
+        per-class entries once ([C, G, L]), run the exact per-row loop on
+        the in-register slab — rival selection and margins read the
+        PREVIOUS rows' updates through an idx-match propagation mask, so
+        each row sees exactly the values true row-by-row dispatch would —
+        and scatter the final values back once per slab. Round 2's scan
+        carried the whole [C, dims] tables through every row."""
         rates = self._rates()
         has_covar = self.HAS_COVAR
+        G = 64
 
         @jax.jit
         def step(W, sigma, idx, val, y, mask):
+            B, L = idx.shape
+            pad = (-B) % G
+            if pad:
+                idx = jnp.pad(idx, ((0, pad), (0, 0)))
+                val = jnp.pad(val, ((0, pad), (0, 0)))
+                y = jnp.pad(y, (0, pad))
+                mask = jnp.pad(mask, (0, pad))
+            nS = (B + pad) // G
             sig0 = sigma if has_covar else jnp.zeros((1, 1), jnp.float32)
 
-            def body(carry, row):
+            def slab(carry, rows):
                 cW, cS = carry
-                ridx, rval, ry, msk = row
-                scores = (cW[:, ridx] * rval).sum(-1)        # [C]
-                true_s = scores[ry]
-                penal = scores.at[ry].set(-jnp.inf)
-                rival = jnp.argmax(penal)
-                m = true_s - scores[rival]
+                sidx, sval, sy, smsk = rows          # [G, L], ..., [G]
+                Ws = cW[:, sidx]                     # [C, G, L]
+                Ss = cS[:, sidx] if has_covar else jnp.ones_like(Ws)
+
+                def body(j, st_):
+                    Ws, Ss = st_
+                    rval, ry, msk = sval[j], sy[j], smsk[j]
+                    scores = (Ws[:, j] * rval).sum(-1)       # [C]
+                    true_s = scores[ry]
+                    penal = scores.at[ry].set(-jnp.inf)
+                    rival = jnp.argmax(penal)
+                    m = true_s - scores[rival]
+                    if has_covar:
+                        st = Ss[ry, j]
+                        sr = Ss[rival, j]
+                        v = ((st + sr) * rval * rval).sum()
+                    else:
+                        st = sr = jnp.ones_like(rval)
+                        v = 2.0 * (rval * rval).sum()
+                    alpha, beta = rates(m, v)
+                    alpha = alpha * msk
+                    beta = beta * msk
+                    match = sidx[:, :, None] == sidx[j][None, None, :]
+                    dwt = (jnp.where(match, (alpha * st * rval)[None, None],
+                                     0.0)).sum(-1)           # [G, L]
+                    dwr = (jnp.where(match, (alpha * sr * rval)[None, None],
+                                     0.0)).sum(-1)
+                    Ws = Ws.at[ry].add(dwt)
+                    Ws = Ws.at[rival].add(-dwr)
+                    if has_covar:
+                        stn = jnp.maximum(st - beta * (st * rval) ** 2,
+                                          1e-8)
+                        srn = jnp.maximum(sr - beta * (sr * rval) ** 2,
+                                          1e-8)
+                        dst = jnp.where(msk > 0, stn - st, 0.0)
+                        dsr = jnp.where(msk > 0, srn - sr, 0.0)
+                        Ss = Ss.at[ry].add(
+                            jnp.where(match, dst[None, None], 0.0).sum(-1))
+                        Ss = Ss.at[rival].add(
+                            jnp.where(match, dsr[None, None], 0.0).sum(-1))
+                    return Ws, Ss
+
+                Ws, Ss = jax.lax.fori_loop(0, G, body, (Ws, Ss))
+                cW = cW.at[:, sidx].set(Ws)
                 if has_covar:
-                    st = cS[ry, ridx]
-                    sr = cS[rival, ridx]
-                    v = ((st + sr) * rval * rval).sum()
-                else:
-                    st = sr = jnp.ones_like(rval)
-                    v = 2.0 * (rval * rval).sum()
-                alpha, beta = rates(m, v)
-                alpha = alpha * msk
-                beta = beta * msk
-                cW = cW.at[ry, ridx].add(alpha * st * rval)
-                cW = cW.at[rival, ridx].add(-alpha * sr * rval)
-                if has_covar:
-                    st_new = jnp.maximum(st - beta * (st * rval) ** 2, 1e-8)
-                    sr_new = jnp.maximum(sr - beta * (sr * rval) ** 2, 1e-8)
-                    cS = cS.at[ry, ridx].set(
-                        jnp.where(msk > 0, st_new, st))
-                    cS = cS.at[rival, ridx].set(
-                        jnp.where(msk > 0, sr_new, sr))
+                    cS = cS.at[:, sidx].set(Ss)
                 return (cW, cS), None
 
-            (W2, sig), _ = jax.lax.scan(body, (W, sig0),
-                                        (idx, val, y, mask))
+            (W2, sig), _ = jax.lax.scan(
+                slab, (W, sig0),
+                (idx.reshape(nS, G, L), val.reshape(nS, G, L),
+                 y.reshape(nS, G), mask.reshape(nS, G)))
             return W2, (sig if has_covar else sigma)
 
         return step
