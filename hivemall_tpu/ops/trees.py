@@ -246,7 +246,6 @@ def _reg_leaf(parent):     # mean in channel 0 slot; keep stats for ensembling
     return jnp.stack([mean, parent[..., 0], parent[..., 2]], axis=-1)
 
 
-@lru_cache(maxsize=128)
 def make_forest_builder_sharded(build, mesh):
     """Ensemble parallelism (SURVEY.md §3.17 row 4): per-device bootstrap
     tree builds over a dp mesh. Trees are embarrassingly parallel — the
@@ -274,6 +273,7 @@ def make_forest_builder_sharded(build, mesh):
         check_vma=False))
 
 
+@lru_cache(maxsize=128)
 def _cached_builder(task: str, n_channels: int, depth: int, n_bins: int,
                     mtry: int, min_split: float, min_leaf: float,
                     lam: float, vmapped: bool, use_pallas: bool):
