@@ -71,11 +71,14 @@ class SparseBatch:
         return int(self.idx.shape[0])
 
     @property
-    def row_mask(self) -> np.ndarray:
-        """Valid-row mask, cached: building it fresh per access made every
-        jitted-step call re-transfer 4*B bytes h2d (measured ~5 ms/step for
-        B=32k through the ~25 MB/s relay when the same batch is stepped
-        repeatedly). The cache also lets jax reuse the device buffer."""
+    def row_mask(self) -> "jnp.ndarray":
+        """Valid-row mask as a cached jax DEVICE array (not host numpy —
+        callers that need host-side in-place numpy must np.asarray a copy).
+        Building it fresh per access made every jitted-step call
+        re-transfer 4*B bytes h2d (measured ~5 ms/step for B=32k through
+        the ~25 MB/s relay when the same batch is stepped repeatedly). The
+        cache also lets jax reuse the device buffer; the value is frozen at
+        first access, which is correct because SparseBatch is write-once."""
         m = self.__dict__.get("_row_mask")
         if m is None:
             import jax.numpy as jnp

@@ -214,20 +214,30 @@ def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
             # gathers: a [n]-indexed gather costs ~26 ns/row regardless of
             # table size (16 trees x 9 levels x n of them dominated the 1M
             # build), while onehot(loc) @ vals is n*M exact-in-bf16 MACs on
-            # the MXU. All three values are small integers (< 2^8), exact
-            # under single-pass bf16; accumulation is f32.
-            vals = jnp.stack([do_split.astype(jnp.float32),
-                              bf.astype(jnp.float32),
-                              bb.astype(jnp.float32)], 1)   # [M, 3]
-            ohn = (loc[:, None]
-                   == jnp.arange(M, dtype=jnp.int32)[None, :])
-            out3 = jax.lax.dot_general(
-                ohn.astype(jnp.bfloat16), vals.astype(jnp.bfloat16),
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)          # [n, 3]
-            split_here = active & (out3[:, 0] > 0.5)
-            fsel = out3[:, 1].astype(jnp.int32)
-            bsel = out3[:, 2]
+            # the MXU. bf16 represents integers exactly only up to 256, so
+            # the matvec decode is used only when every carried value fits
+            # (feature ids < d <= 256, bin ids < n_bins <= 256); wider
+            # configs take the exact gather path.
+            if d <= 256 and n_bins <= 256:
+                vals = jnp.stack([do_split.astype(jnp.float32),
+                                  bf.astype(jnp.float32),
+                                  bb.astype(jnp.float32)], 1)   # [M, 3]
+                ohn = (loc[:, None]
+                       == jnp.arange(M, dtype=jnp.int32)[None, :])
+                out3 = jax.lax.dot_general(
+                    ohn.astype(jnp.bfloat16), vals.astype(jnp.bfloat16),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)          # [n, 3]
+                split_here = active & (out3[:, 0] > 0.5)
+                fsel = out3[:, 1].astype(jnp.int32)
+                bsel = out3[:, 2]
+            else:
+                sel = jnp.stack([do_split.astype(jnp.float32),
+                                 bf.astype(jnp.float32),
+                                 bb.astype(jnp.float32)], 1)[loc]  # [n, 3]
+                split_here = active & (sel[:, 0] > 0.5)
+                fsel = sel[:, 1].astype(jnp.int32)
+                bsel = sel[:, 2]
             ohf = fsel[:, None] == jnp.arange(d, dtype=jnp.int32)[None, :]
             bval = jnp.where(ohf, bins, jnp.uint8(0)).max(1)
             go_right = bval.astype(jnp.float32) > bsel
@@ -258,19 +268,15 @@ def make_forest_builder_sharded(build, mesh):
     from jax.sharding import PartitionSpec as P
     try:
         from jax import shard_map as _sm
-
-        def smap(f, **kw):
-            return _sm(f, **kw)
+        nocheck = {"check_vma": False}
     except ImportError:
         from jax.experimental.shard_map import shard_map as _sm
-
-        def smap(f, **kw):
-            return _sm(f, **kw)
+        nocheck = {"check_rep": False}   # older API spells the flag check_rep
     return jax.jit(_sm(
         build, mesh=mesh,
         in_specs=(P(), P(), P("dp"), P("dp")),
         out_specs=(P("dp"), P("dp"), P("dp")),
-        check_vma=False))
+        **nocheck))
 
 
 @lru_cache(maxsize=128)

@@ -113,8 +113,10 @@ class _NpIndex:
         return h
 
     def lookup_or_insert(self, keys: np.ndarray) -> np.ndarray:
-        """rows [n] for int64 keys [n]; new keys get rows n0, n0+1, ...
-        in first-appearance order."""
+        """rows [n] for int64 keys [n]; new keys get fresh rows n0, n0+1,
+        ... assigned in SORTED-key order within the batch (np.unique sorts;
+        any stable key->row map is valid for the aggregates, so order is
+        an implementation detail, not a contract)."""
         uk, inv = np.unique(keys.astype(np.int64), return_inverse=True)
         if self.n + len(uk) > (len(self._keys) * 7) // 10:
             self._rehash(max(self._bits + 1,

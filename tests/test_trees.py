@@ -30,6 +30,22 @@ def test_rf_classifier_fits_xor():
     assert acc > 0.95, acc
 
 
+def test_rf_wide_feature_space_routes_exactly():
+    """d > 256 features: the routing decode must take the exact gather
+    path (bf16 one-hot matvec rounds integer feature ids above 256 —
+    ADVICE r3). Signal lives in a high feature index so a rounded id
+    would mis-split and tank accuracy."""
+    rng = np.random.default_rng(7)
+    n, d = 400, 300
+    X = rng.uniform(-1, 1, (n, d)).astype(np.float32)
+    y = ((X[:, 290] > 0) ^ (X[:, 299] > 0.2)).astype(int)
+    rf = RandomForestClassifier(f"-trees 8 -depth 6 -bins 16 -vars {d} "
+                                "-seed 11")
+    rf.fit(X, y)
+    acc = (rf.predict(X) == y).mean()
+    assert acc > 0.9, acc
+
+
 def test_rf_oob_and_rows():
     X, y = two_moons_ish(300)
     rf = RandomForestClassifier("-trees 5 -depth 5 -bins 32")
