@@ -266,12 +266,16 @@ def make_forest_builder_sharded(build, mesh):
     gather happens on the host over the [E]-sharded outputs."""
     import jax
     from jax.sharding import PartitionSpec as P
+    import inspect
     try:
         from jax import shard_map as _sm
-        nocheck = {"check_vma": False}
     except ImportError:
         from jax.experimental.shard_map import shard_map as _sm
-        nocheck = {"check_rep": False}   # older API spells the flag check_rep
+    # the flag was spelled check_rep before check_vma, in BOTH import
+    # locations across jax versions — key on the actual signature
+    flag = ("check_vma" if "check_vma" in inspect.signature(_sm).parameters
+            else "check_rep")
+    nocheck = {flag: False}
     return jax.jit(_sm(
         build, mesh=mesh,
         in_specs=(P(), P(), P("dp"), P("dp")),
