@@ -57,8 +57,17 @@ def quantize_bins(X: np.ndarray, n_bins: int = 64
                                                    replace=False)]
     else:
         sample = X
+    # one axis-0 sort + order-stat indexing replaces d np.quantile calls
+    # (measured 0.14 s -> 0.03 s at 100k x 28; with searchsorted this made
+    # quantize_bins ~45% of the whole fused-GBT fit wall, round 4). Edges
+    # are lower order statistics, not interpolated — an equally valid
+    # quantile sketch (xgboost-style approx), stored in `edges` so predict
+    # bins identically.
+    S = np.sort(sample, axis=0)
+    order = (qs * (len(S) - 1)).astype(int)
+    E = S[order, :]                          # [n_bins-1, d]
     for f in range(d):
-        e = np.unique(np.quantile(sample[:, f], qs))
+        e = np.unique(E[:, f])
         col = np.searchsorted(e, X[:, f], side="left").astype(np.uint8)
         pad = np.full(n_bins - 1, np.inf, np.float32)
         pad[:len(e)] = e
@@ -115,15 +124,35 @@ def _xgb_gain(lam):
     return gain
 
 
+def _xgb_task(lam):
+    """(gain, leaf, count) closures for the xgb builder task — shared by
+    the per-tree builder cache and the fused boosting loop so the
+    -G/(H+lam) leaf policy lives in exactly one place."""
+    def xleaf(parent):
+        val = -parent[..., 0] / (parent[..., 1] + lam)
+        return jnp.stack([val, parent[..., 1], parent[..., 2]], axis=-1)
+    return _xgb_gain(lam), xleaf, (lambda s: s[..., 2])
+
+
+def colsample_mtry(colsample: float, d: int) -> int:
+    """XGBoost -colsample_bytree fraction -> the builder's mtry count
+    (0 = all features)."""
+    return max(1, int(round(colsample * d))) if colsample < 1.0 else 0
+
+
 def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
                   leaf_fn: Callable, count_fn: Callable, depth: int,
                   n_bins: int, mtry: int, min_split: float, min_leaf: float,
                   min_gain: float, use_pallas: bool = False,
-                  hist_fast: bool = False):
+                  hist_fast: bool = False, return_nodes: bool = False):
     """Single-tree level-wise builder; vmap over (w, rng) for an ensemble.
 
     bins: uint8 [n, d]; aux: per-row stat payload (labels / grads);
     w: [n] sample weights (bootstrap counts; 0 = out-of-bag).
+
+    ``return_nodes=True`` also returns each row's final node id — the
+    boosting loop reads the new tree's leaf value per row straight from it
+    (value[node]), so no separate predict pass re-routes the rows.
     """
 
     def build(bins, aux, w, rng):
@@ -244,6 +273,8 @@ def _make_builder(n_channels: int, stat_fn: Callable, gain_fn: Callable,
             node = jnp.where(split_here,
                              2 * node + 1 + go_right.astype(jnp.int32),
                              node)
+        if return_nodes:
+            return feat, thr, value, node
         return feat, thr, value
 
     return build
@@ -292,10 +323,7 @@ def _cached_builder(task: str, n_channels: int, depth: int, n_bins: int,
     elif task == "var":
         gain, leaf, count = _var_gain, _reg_leaf, (lambda s: s[..., 0])
     elif task == "xgb":
-        def xleaf(parent):
-            val = -parent[..., 0] / (parent[..., 1] + lam)
-            return jnp.stack([val, parent[..., 1], parent[..., 2]], axis=-1)
-        gain, leaf, count = _xgb_gain(lam), xleaf, (lambda s: s[..., 2])
+        gain, leaf, count = _xgb_task(lam)
     else:
         raise ValueError(task)
     # classification stat channels are class-indicator x bootstrap-count —
@@ -361,7 +389,7 @@ def build_tree_xgb(bins: np.ndarray, grads: np.ndarray, hess: np.ndarray,
     h = jnp.asarray(hess, jnp.float32)
     aux = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
     d = bins.shape[1]
-    mtry = max(1, int(round(colsample * d))) if colsample < 1.0 else 0
+    mtry = colsample_mtry(colsample, d)
     build = _cached_builder("xgb", 3, depth, n_bins, mtry, float(min_split),
                             float(min_leaf), float(lam), False,
                             use_pallas_default())
@@ -370,6 +398,93 @@ def build_tree_xgb(bins: np.ndarray, grads: np.ndarray, hess: np.ndarray,
                     jax.random.PRNGKey(seed))
     return Tree(np.asarray(f)[None], np.asarray(t)[None],
                 np.asarray(v)[None], edges)
+
+
+@lru_cache(maxsize=64)
+def boost_loop_xgb(objective: str, n_rounds: int, depth: int, n_bins: int,
+                   mtry: int, min_child_weight: float, lam: float,
+                   eta: float, subsample: float, use_pallas: bool,
+                   n_class: int = 0):
+    """The WHOLE boosting run as one jitted lax.scan over rounds.
+
+    Round 3 measured GBT at ~26k rows/s while RF built trees 10x bigger at
+    117k rows/s: the boosting chain was round-SERIAL, paying per-dispatch
+    tunnel overhead (~100 ms host-synced) several times per round. Here a
+    round is one scan iteration — grad/hess from the carried margin, the
+    level-wise build, and the margin update from the builder's own row
+    node ids (value[node, 0]; no separate predict re-walk) — so R rounds
+    cost ONE dispatch. Matches the reference XGBoostUDTF training loop
+    semantics (SURVEY.md §3.9) with jax.random round keys for subsample.
+
+    With ``n_class > 0`` (multi:softmax) each round vmaps the builder over
+    the per-class (g, h) stacks, carrying a [n, C] margin — the one-vs-rest
+    round structure XGBoost uses for softmax.
+    """
+    gain, leaf, count = _xgb_task(lam)
+    build = _make_builder(3, lambda aux: aux, gain, leaf, count,
+                          depth, n_bins, mtry,
+                          2.0, min_child_weight, 1e-7,
+                          use_pallas=use_pallas, return_nodes=True)
+
+    def grad_hess(y, margin):
+        if objective == "binary:logistic":
+            p = 1.0 / (1.0 + jnp.exp(-margin))
+            return p - y, p * (1 - p)
+        if objective == "reg:squarederror":
+            return margin - y, jnp.ones_like(margin)
+        if objective == "multi:softmax":
+            e = jnp.exp(margin - margin.max(1, keepdims=True))
+            p = e / e.sum(1, keepdims=True)
+            onehot = jax.nn.one_hot(y.astype(jnp.int32), n_class)
+            return p - onehot, jnp.maximum(p * (1 - p), 1e-6)
+        raise ValueError(f"unknown objective {objective!r}")
+
+    def subsampled(g, h, key):
+        if subsample >= 1.0:
+            return g, h
+        keep = jax.random.bernoulli(key, subsample, (g.shape[0],))
+        km = keep.astype(jnp.float32)
+        km = km if g.ndim == 1 else km[:, None]
+        return g * km, h * km
+
+    def loop(bins, y, base_score, key):
+        n = bins.shape[0]
+        ones = jnp.ones(n, jnp.float32)
+
+        def round_fn(margin, key_r):
+            g, h = grad_hess(y, margin)
+            g, h = subsampled(g, h, jax.random.fold_in(key_r, 1))
+            if n_class:
+                aux = jnp.stack([g, h, jnp.ones_like(g)], -1)   # [n, C, 3]
+                aux = jnp.swapaxes(aux, 0, 1)                   # [C, n, 3]
+                keys = jax.random.split(key_r, n_class)
+                f, t, v, node = jax.vmap(
+                    build, in_axes=(None, 0, None, 0))(bins, aux, ones,
+                                                       keys)
+                # [C, n] leaf values -> margin [n, C]
+                leaf = jnp.take_along_axis(v[..., 0], node,
+                                           axis=1)              # [C, n]
+                margin = margin + eta * leaf.T
+            else:
+                aux = jnp.stack([g, h, jnp.ones_like(g)], 1)
+                f, t, v, node = build(bins, aux, ones, key_r)
+                margin = margin + eta * v[node, 0]
+            return margin, (f, t, v)
+
+        keys = jax.random.split(key, n_rounds)
+        m0 = (jnp.full((n, n_class), base_score, jnp.float32) if n_class
+              else jnp.full(n, base_score, jnp.float32))
+        margin, (fs, ts, vs) = jax.lax.scan(round_fn, m0, keys)
+        # ONE packed f32 tensor [..., Nn, 5] = (value[3], feat, thr): every
+        # d2h fetch through the relay pays ~200 ms latency regardless of
+        # size, so three small fetches cost more than the whole build —
+        # feat (small ints) and thr (uint8) are exact in f32
+        packed = jnp.concatenate(
+            [vs, fs.astype(jnp.float32)[..., None],
+             ts.astype(jnp.float32)[..., None]], axis=-1)
+        return packed, margin
+
+    return jax.jit(loop)
 
 
 # --- prediction: vectorized gather-walk (the StackMachine VM rebuild) ------
