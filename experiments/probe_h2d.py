@@ -1,0 +1,65 @@
+"""h2d relay characterization: bandwidth + latency vs transfer size, and
+whether device_put transfers overlap jitted compute (the round-4 e2e
+question: is the 25 MB/s + 200 ms/transfer model right, and does the
+prefetcher actually hide transfers under compute?)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+
+def sync(x):
+    return float(np.asarray(jnp.asarray(x).sum()))
+
+@jax.jit
+def probe_sum(a):
+    return a.astype(jnp.uint32).sum()
+
+# warm
+a = np.ones(1 << 16, np.uint8)
+sync(probe_sum(jax.device_put(a)))
+
+print("== h2d bandwidth vs size (uint8, single device_put) ==")
+for mb in (0.25, 1, 4, 16, 64):
+    n = int(mb * (1 << 20))
+    a = np.random.default_rng(0).integers(0, 255, n, dtype=np.uint8)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        d = jax.device_put(a)
+        sync(probe_sum(d))          # forces the transfer to complete
+        ts.append(time.perf_counter() - t0)
+    best = min(ts)
+    print(f"{mb:6.2f} MB: best {best*1e3:8.1f} ms  -> {mb/best:7.1f} MB/s")
+
+print("== overlap: device_put on a thread while a long matmul runs ==")
+import threading
+M = jnp.asarray(np.random.default_rng(0).normal(size=(8192, 8192)).astype(np.float32))
+@jax.jit
+def burn(M, k):
+    def body(_, x):
+        return jnp.tanh(x @ M)
+    return jax.lax.fori_loop(0, k, body, M).sum()
+# calibrate burn to ~1s
+sync(burn(M, 2))
+t0 = time.perf_counter(); sync(burn(M, 20)); t_burn = time.perf_counter() - t0
+print(f"burn(20) alone: {t_burn:.2f}s")
+payload = np.random.default_rng(0).integers(0, 255, 8 << 20, dtype=np.uint8)
+t0 = time.perf_counter()
+d = jax.device_put(payload); sync(probe_sum(d))
+t_put = time.perf_counter() - t0
+print(f"8MB put alone: {t_put:.2f}s")
+res = {}
+def putter():
+    t0 = time.perf_counter()
+    d = jax.device_put(payload)
+    res["staged"] = d
+    res["put_done"] = time.perf_counter() - t0
+t0 = time.perf_counter()
+th = threading.Thread(target=putter); th.start()
+sync(burn(M, 20))
+t_both_burn = time.perf_counter() - t0
+th.join()
+sync(probe_sum(res["staged"]))
+t_total = time.perf_counter() - t0
+ov = (t_burn + t_put - t_total) / min(t_burn, t_put)
+print(f"concurrent: burn finished {t_both_burn:.2f}s, total {t_total:.2f}s, "
+      f"overlap fraction ~{ov:.2f}")

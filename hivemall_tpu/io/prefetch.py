@@ -24,21 +24,24 @@ from typing import Iterable, Iterator, Optional
 
 import jax
 
-from .sparse import SparseBatch
+from .sparse import PackedBatch, SparseBatch
 
 __all__ = ["DevicePrefetcher", "stage_batch"]
 
 _STOP = object()
 
 
-def stage_batch(b: SparseBatch, device=None) -> SparseBatch:
+def stage_batch(b, device=None):
     """device_put every array of one batch. ``val=None`` (unit-value
     elision, see SparseBatch) and ``field=None`` are preserved — skipping
     the val transfer is the point: the host->device link is the e2e
     bottleneck (measured ~25 MB/s through the relay here), and the jitted
-    unit-val step variants rebuild val from idx on device for free."""
+    unit-val step variants rebuild val from idx on device for free.
+    A PackedBatch stages its single uint8 buffer — ONE transfer."""
     put = (lambda a: jax.device_put(a, device)) if device is not None \
         else jax.device_put
+    if isinstance(b, PackedBatch):
+        return PackedBatch(put(b.buf), b.B, b.L, b.n_valid)
     return SparseBatch(put(b.idx),
                        None if b.val is None else put(b.val),
                        put(b.label),

@@ -89,6 +89,45 @@ class SparseBatch:
         return m
 
 
+@dataclass
+class PackedBatch:
+    """One canonical unit-value field-major batch packed into a SINGLE
+    uint8 buffer for ONE host->device transfer.
+
+    The e2e flagship wall is the h2d link, which charges per TRANSFER
+    (latency) and per BYTE (bandwidth): a SparseBatch moves 2-3 arrays
+    (idx int32 + label f32 + row mask) = 2-3 latency hits and 4 bytes per
+    index lane. Here idx packs to 3 little-endian bytes per lane (exact
+    for dims <= 2^24 — every table size the trainers accept), the f32
+    labels ride as raw bytes in the same buffer, and the row mask is
+    rebuilt on device from the n_valid scalar. The jitted step unpacks
+    with shifts/bitcasts (free against the link). Layout:
+    ``buf[:B*L*3]`` = idx lanes, ``buf[B*L*3:]`` = label bytes."""
+
+    buf: np.ndarray                  # uint8 [B*L*3 + B*4]
+    B: int
+    L: int
+    n_valid: Optional[int] = None
+    fieldmajor: bool = True
+
+    @property
+    def batch_size(self) -> int:
+        return self.B
+
+
+def pack_unit_fieldmajor(batch: SparseBatch) -> PackedBatch:
+    """Pack a canonical unit-value field-major SparseBatch (host arrays)
+    into a PackedBatch. Caller guarantees val is None (unit-value elision)
+    and idx < 2^24."""
+    idx = np.ascontiguousarray(np.asarray(batch.idx, np.int32))
+    B, L = idx.shape
+    lanes = idx.view(np.uint8).reshape(B, L, 4)[:, :, :3]   # little-endian
+    lab = np.ascontiguousarray(np.asarray(batch.label, np.float32))
+    buf = np.concatenate([np.ascontiguousarray(lanes).reshape(-1),
+                          lab.view(np.uint8)])
+    return PackedBatch(buf, B, L, n_valid=batch.n_valid)
+
+
 def canonicalize_fieldmajor(idx: np.ndarray, val: np.ndarray,
                             fld: np.ndarray, F: int, *,
                             max_m: int = 4):

@@ -216,7 +216,7 @@ class LearnerBase:
             import jax
             prefetch = jax.default_backend() != "cpu" and self.mesh is None
         for ep in range(epochs):
-            it = map(self._preprocess_batch,
+            it = map(self._preprocess_train_batch,
                      ds.batches(bs, shuffle=shuffle, seed=42 + ep))
             if prefetch:
                 from ..io.prefetch import DevicePrefetcher
@@ -269,6 +269,14 @@ class LearnerBase:
                                    fieldmajor=batch.fieldmajor)
             self._elision_off = True
         return batch
+
+    def _preprocess_train_batch(self, batch: SparseBatch):
+        """TRAINING-ONLY per-batch hook (fit / fit_stream / process-flush).
+        Defaults to _preprocess_batch; subclasses whose training dispatch
+        accepts a representation scoring can't consume (e.g. FFM's packed
+        uint8 transfer buffers) override THIS, keeping _preprocess_batch —
+        which the scoring paths share — representation-stable."""
+        return self._preprocess_batch(batch)
 
     # -- mesh sharding (SURVEY.md §3.17 / §8 M3) -----------------------------
     def _apply_mesh(self, spec: str) -> None:
@@ -353,7 +361,7 @@ class LearnerBase:
                                     b.field, n_valid=b.n_valid,
                                     fieldmajor=b.fieldmajor)
                 self._note_batch(b)
-                yield self._preprocess_batch(b)
+                yield self._preprocess_train_batch(b)
 
         it: Iterable[SparseBatch] = host_side()
         prefetch = jax.default_backend() != "cpu" and self.mesh is None
@@ -430,7 +438,7 @@ class LearnerBase:
             val[b, :len(v)] = v
             lab[b] = labels[b]
         nv = len(rows)
-        self._dispatch(self._preprocess_batch(
+        self._dispatch(self._preprocess_train_batch(
             SparseBatch(idx, val, lab, n_valid=nv if nv < B else None)))
 
     def _dispatch(self, batch: SparseBatch) -> None:

@@ -561,3 +561,59 @@ def test_ffm_forced_fieldmajor_scoring_falls_back_on_overflow():
         [1.0], fields=[np.zeros(6, np.int32)])
     out = t.predict(odd)
     assert np.isfinite(out).all()
+
+
+def test_ffm_pack_input_bit_exact():
+    """-pack_input on (3-byte idx lanes + f32 label bytes in ONE uint8
+    buffer, unpacked on device) must be bit-identical to the unpacked
+    path — same params after an epoch, joint layout."""
+    import numpy as np
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.models.fm import FFMTrainer
+
+    B, L, F, K, dims, n = 256, 8, 8, 4, 1 << 20, 1024
+    rng = np.random.default_rng(1)
+    idx = rng.integers(1, dims, (n, L)).astype(np.int32)
+    fld = np.tile(np.arange(L, dtype=np.int32), (n, 1))
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    indptr = np.arange(0, n * L + 1, L, dtype=np.int64)
+    ds = SparseDataset(idx.ravel(), indptr, np.ones(n * L, np.float32),
+                       lab, fld.ravel())
+    cfg = (f"-dims {dims} -factors {K} -fields {F} -mini_batch {B} "
+           f"-opt adagrad -classification -halffloat -seed 5")
+    a = FFMTrainer(cfg + " -pack_input off")
+    a.fit(ds, epochs=1, shuffle=False, prefetch=False)
+    b = FFMTrainer(cfg + " -pack_input on")
+    b.fit(ds, epochs=1, shuffle=False, prefetch=False)
+    for k2 in a.params:
+        pa = np.asarray(a.params[k2], np.float32)
+        pb = np.asarray(b.params[k2], np.float32)
+        np.testing.assert_array_equal(pa, pb, err_msg=k2)
+    assert a.cumulative_loss == b.cumulative_loss
+
+
+def test_ffm_pack_input_partial_batch_mask():
+    """A short tail batch (n_valid < B) must keep its padded rows out of
+    the loss on the packed path, matching the unpacked path exactly."""
+    import numpy as np
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.models.fm import FFMTrainer
+
+    B, L, F, K, dims, n = 256, 8, 8, 4, 1 << 20, 300   # 300 = 256 + 44
+    rng = np.random.default_rng(3)
+    idx = rng.integers(1, dims, (n, L)).astype(np.int32)
+    fld = np.tile(np.arange(L, dtype=np.int32), (n, 1))
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    indptr = np.arange(0, n * L + 1, L, dtype=np.int64)
+    ds = SparseDataset(idx.ravel(), indptr, np.ones(n * L, np.float32),
+                       lab, fld.ravel())
+    cfg = (f"-dims {dims} -factors {K} -fields {F} -mini_batch {B} "
+           f"-opt adagrad -classification -halffloat -seed 5")
+    a = FFMTrainer(cfg + " -pack_input off")
+    a.fit(ds, epochs=1, shuffle=False, prefetch=False)
+    b = FFMTrainer(cfg + " -pack_input on")
+    b.fit(ds, epochs=1, shuffle=False, prefetch=False)
+    for k2 in a.params:
+        np.testing.assert_array_equal(np.asarray(a.params[k2], np.float32),
+                                      np.asarray(b.params[k2], np.float32),
+                                      err_msg=k2)
