@@ -443,10 +443,45 @@ class FFMTrainer(FMTrainer):
 
     def _apply_mesh(self, spec: str) -> None:
         if getattr(self, "layout", None) == "parts":
-            raise ValueError("-mesh is not supported with -ffm_table parts "
-                             "(the Pallas kernel is single-chip); use "
-                             "-ffm_table joint for GSPMD sharding")
+            self._apply_mesh_parts(spec)
+            return
         super()._apply_mesh(spec)
+
+    def _apply_mesh_parts(self, spec: str) -> None:
+        """Shard the parts layout over a (dp, tp) mesh: field partitions
+        over 'tp' (the shard boundary is a partition boundary, so slab
+        gathers stay rank-local), batch over 'dp' with a G psum before the
+        optimizer tail (ops.fm_pallas.make_parts_step_sharded). The fused
+        single-chip kernel remains the mesh=None path."""
+        import jax
+        from ..ops.fm_pallas import make_parts_step_sharded
+        from ..ops.schedules import make_eta
+        from ..parallel.mesh import make_mesh, parse_mesh_spec
+        o = self.opts
+        dp, tp = parse_mesh_spec(spec)
+        if self.F % tp:
+            raise ValueError(f"-ffm_table parts: -fields {self.F} must be "
+                             f"divisible by the tp axis ({tp})")
+        B = int(o.mini_batch)
+        Bd = B // dp
+        if B % (dp * 128) or (Bd > 2048 and Bd % 2048):
+            raise ValueError(f"-ffm_table parts: -mini_batch "
+                             f"{o.mini_batch} must be a multiple of "
+                             f"128*dp ({128 * dp}) and, when the per-rank "
+                             f"batch exceeds 2048, of 2048*dp — each dp "
+                             "rank feeds the kernel whole chunk tiles")
+        self.mesh = make_mesh(dp=dp, tp=tp)
+        eta_fn = make_eta(o.eta, o.eta0, o.total_steps, o.power_t)
+        lamt = (o.lambda0, o.lambda_w, o.lambda_v)
+        interp = jax.default_backend() != "tpu"
+        self._step_fm = make_parts_step_sharded(
+            self.loss, eta_fn, lamt, self.F, self.k, self.MRF, self.mesh,
+            interpret=interp)
+        self._step_fm_unit = make_parts_step_sharded(
+            self.loss, eta_fn, lamt, self.F, self.k, self.MRF, self.mesh,
+            unit_val=True, interpret=interp)
+        self._tp_sizes.add(self.F * self.MRF * self.HP)
+        self._reshard_state()
 
     def _batch_args(self, batch: SparseBatch) -> tuple:
         if batch.field is None:
@@ -516,9 +551,14 @@ class FFMTrainer(FMTrainer):
         """Pad the batch's row count to the Pallas kernel's grid multiple
         (128 rows — the SMEM row-id packing — up to 2048, then 2048-row
         chunks); padded rows carry idx 0 and are masked out of the loss by
-        n_valid."""
+        n_valid. Under -mesh each dp rank must receive whole 128-row
+        tiles, so the multiple scales by dp."""
         B = batch.batch_size
-        mult = 128 if B <= 2048 else 2048
+        dp = self.mesh.shape["dp"] if self.mesh is not None else 1
+        # per-rank rows must be a multiple of 128 and, above 2048, of 2048
+        # (the kernel's chunk grid floors otherwise) — so the GLOBAL
+        # multiple scales by dp on both branches
+        mult = 128 * dp if B <= 2048 * dp else 2048 * dp
         target = -(-B // mult) * mult
         if target == B:
             return batch

@@ -56,7 +56,7 @@ from jax.experimental.pallas import tpu as pltpu
 from .losses import Loss
 
 __all__ = ["parts_geometry", "parts_row_hash", "make_parts_step",
-           "make_parts_score", "parts_supported"]
+           "make_parts_step_sharded", "make_parts_score", "parts_supported"]
 
 _J1, _J3 = 0x9E3779B1, 0xC2B2AE35
 _EPS = 1e-6
@@ -259,6 +259,63 @@ def _make_scatter_opt_kernel(B: int, L: int, F: int, MRF: int, HP: int,
     )
 
 
+def _make_scatter_accum_kernel(Bd: int, Ll: int, Fl: int, MRF: int, HP: int,
+                               chunk: int, interpret: bool = False):
+    """Accumulate-only twin of _make_scatter_opt_kernel for the SHARDED
+    parts step: the same per-slot roll+add VMEM RMW (~17 ns/slot), but G
+    is emitted to HBM once per local field partition instead of feeding a
+    fused optimizer tail — the sharded step must psum G over 'dp' before
+    any optimizer math (per-replica AdaGrad on partial gradients is NOT
+    minibatch AdaGrad), so the tail runs as a dense XLA update on each
+    rank's table shard. Extra HBM traffic vs the fused kernel: one G
+    write + one read (~2 table passes, ~0.4 ms at flagship shapes against
+    819 GB/s) — the scatter itself still never materializes per-slot."""
+    assert HP == 2
+    m = Ll // Fl
+    nc = Bd // chunk
+    n_acc = m * nc
+    gt_rows = MRF * HP // 8
+    grid = (Fl, n_acc)
+
+    def kernel(rows_ref, g_ref, G_ref):
+        c = pl.program_id(1)
+
+        @pl.when(c == 0)
+        def _():
+            G_ref[...] = jnp.zeros_like(G_ref)
+
+        cc = c % nc
+        base = (c // nc) * Bd
+
+        def body(i, _):
+            gtile = g_ref[0, i].astype(jnp.float32)       # [16, 128]
+            for u in range(8):
+                j = base + cc * chunk + i * 8 + u
+                r = rows_ref[0, j >> 7, j & 127]
+                piece = gtile[2 * u:2 * u + 2, :]
+                G_ref[0, r >> 2] += _roll_pad8(piece, r & 3)
+            return 0
+
+        jax.lax.fori_loop(0, chunk // 8, body, 0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, (m * Bd) // 128, 128), lambda g, c: (g, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, chunk * HP // 16, 16, 128),
+                         lambda g, c: (g, c, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, gt_rows, 8, 128),
+                               lambda g, c: (g, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Fl, gt_rows, 8, 128), jnp.float32),
+        interpret=interpret,
+    )
+
+
 def parts_supported(F: int, K: int, opt_name: str, dtype) -> bool:
     """The pallas step handles the flagship envelope; everything else uses
     the XLA joint step."""
@@ -382,6 +439,196 @@ def make_parts_step(loss: Loss, eta_fn: Callable, lambdas, F: int, K: int,
             return step_impl(params, opt_state, t, idx, val, label,
                              row_mask)
     return step
+
+
+def _phi_parts_sharded(w0f, slab, val_l, F: int, Fl: int,
+                       K: int, m: int, ti):
+    """Per-tp-rank partial of _phi_parts over the rank's Fl local field
+    partitions, completed by one all_to_all + psum over 'tp'.
+
+    The cross-field sum full = Σ_{g,f,k} C[g,b,f,k]·C[f,b,g,k] factors by
+    which rank owns f: each rank holds C_local[fl, b, g, k] for its own
+    fields fl and ALL g, and needs C[g, b, f(fl), k] for all g — exactly
+    the field-axis transpose an all_to_all over 'tp' delivers (the
+    sequence-parallel a2a pattern, with fields in the sequence role).
+    Every (g, f) term is produced on exactly one rank, so psum('tp')
+    completes phi; autodiff through the collectives gives each rank its
+    local slab cotangent with no extra communication."""
+    Ll, Bd = val_l.shape
+    FK = F * K
+    Vg = slab[..., :FK].reshape(m, Fl, Bd, F, K)
+    wg = slab[..., FK].astype(jnp.float32)
+    U = Vg * val_l.reshape(m, Fl, Bd, 1, 1).astype(Vg.dtype)
+    C = U if m == 1 else U.astype(jnp.float32).sum(0, keepdims=True)
+    C = C.reshape(Fl, Bd, F, K)
+    Cx = jax.lax.all_to_all(C, "tp", split_axis=2, concat_axis=0,
+                            tiled=True)              # [F, Bd, Fl, K]
+    partial_full = jnp.einsum("gbfk,fbgk->b", Cx, C,
+                              preferred_element_type=jnp.float32)
+    gidx = (ti * Fl + jnp.arange(Fl, dtype=jnp.int32))
+    own = jnp.take_along_axis(
+        U.reshape(m, Fl, Bd, F, K),
+        gidx[None, :, None, None, None], axis=3)[..., 0, :].astype(
+            jnp.float32)                             # [m, Fl, Bd, K]
+    diag = (own * own).sum((0, 1, 3))
+    lin = (wg * val_l).sum(0)
+    return w0f + jax.lax.psum(lin + 0.5 * (partial_full - diag), "tp")
+
+
+def make_parts_step_sharded(loss: Loss, eta_fn: Callable, lambdas, F: int,
+                            K: int, MRF: int, mesh, unit_val: bool = False,
+                            interpret: bool = False) -> Callable:
+    """Multi-chip parts step: fields shard over 'tp', batch over 'dp'
+    (VERDICT r3 next #2; SURVEY §4.4 rebuild note — table sharded TP-like,
+    psum partial dots).
+
+    Decomposition per device (shard_map; pallas_call cannot be GSPMD-cut):
+      - T2/S2 shard by FIELD PARTITION over 'tp' (rows are partition-major,
+        so the shard boundary is a partition boundary and every slab gather
+        stays inside the rank's own shard — zero gather communication).
+      - idx/val/label/row_mask shard over 'dp'; each rank slices its own
+        tp field columns locally ([Bd, m, F] -> [Bd, m, Fl]).
+      - interaction: one bf16 all_to_all of the C tensor + psum over 'tp'
+        (_phi_parts_sharded).
+      - scatter: the accumulate-only Pallas kernel per rank; G then psums
+        over 'dp' (minibatch-AdaGrad semantics) and the optimizer tail is
+        a dense XLA update on the local shard — same count-lane L2 and
+        live masks as the fused single-chip kernel, which stays the
+        mesh=None path (its rate is the flagship headline).
+    """
+    from jax.sharding import PartitionSpec as P
+    import inspect
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+    flag = ("check_vma" if "check_vma" in inspect.signature(_sm).parameters
+            else "check_rep")
+    dp, tp = mesh.shape["dp"], mesh.shape["tp"]
+    assert F % tp == 0, (F, tp)
+    Fl = F // tp
+    lam0, lam_w, lam_v = lambdas
+    wp = 128 * (-(-(F * K + 8) // 128))
+    hp = wp // 128
+    assert hp == 2, "sharded parts step requires Wp == 256"
+    FK = F * K
+    cnt_lane = FK + 2 - 128
+    w_lane = FK - 128
+
+    def local_step(params, opt_state, t, idx, val, label, row_mask):
+        T2, w0 = params["T2"], params["w0"]
+        S2 = opt_state["T2"]["gg"]
+        Bd, L = idx.shape
+        m = L // F
+        Ll = m * Fl
+        chunk = min(2048, Bd)
+        if Bd % chunk or (m * Bd) % 128:
+            raise ValueError(
+                f"sharded parts step: per-rank batch {Bd} must be a "
+                f"multiple of 128 and, above 2048, of 2048 (see "
+                "FFMTrainer._pad_parts_rows / _apply_mesh_parts)")
+        ti = jax.lax.axis_index("tp")
+        if val is None:
+            val = (idx != 0).astype(jnp.float32)
+        idx3 = idx.reshape(Bd, m, F)
+        val3 = val.reshape(Bd, m, F)
+        idx_l = jax.lax.dynamic_slice_in_dim(idx3, ti * Fl, Fl, 2)
+        val_l = jax.lax.dynamic_slice_in_dim(val3, ti * Fl, Fl, 2)
+        idxT = idx_l.transpose(1, 2, 0).reshape(Ll, Bd)   # slot = r*Fl + gl
+        valT = val_l.transpose(1, 2, 0).reshape(Ll, Bd)
+        glT = (jnp.arange(Ll, dtype=jnp.int32) % Fl)[:, None]
+        # the hash FOLD depends only on idx, so local row placement is
+        # identical to the single-chip table's placement in this partition
+        rows = parts_row_hash(idxT, glT, MRF)             # [Ll, Bd] local
+        if m == 1:
+            T4 = T2.reshape(Fl, MRF, hp, 128)
+            local_rows = rows - glT * MRF
+            slab = jnp.stack([T4[g][local_rows[g]] for g in range(Fl)])
+        else:
+            T3g = T2.reshape(Fl * MRF, hp, 128)
+            slab = T3g[rows]                              # [Ll, Bd, hp, 128]
+
+        def batch_loss(w0f, slabf):
+            s = slabf.reshape(Ll, Bd, wp)
+            phi = _phi_parts_sharded(w0f, s, valT, F, Fl, K, m, ti)
+            data = (loss.loss(phi, label) * row_mask).sum()
+            # tp rank 0 OWNS each row's data loss: shard_map transposes
+            # psum to psum, so an unmasked (replicated) loss would hand
+            # every rank a tp-x slab cotangent through _phi_parts_sharded's
+            # psum — this mask makes the summed cotangent exactly 1x on
+            # every rank (and g0/loss_sum recover the total via a
+            # ('dp','tp') psum below). The count-lane L2 term sits OUTSIDE
+            # the mask: it is rank-local slab state, already 1x.
+            data = data * jnp.where(ti == 0, 1.0, 0.0)
+            if lam_w or lam_v:
+                pm = ((valT != 0).astype(jnp.float32) * row_mask[None, :])
+                data = data + jnp.sum(
+                    s[..., FK + 2].astype(jnp.float32) * pm)
+            return data
+
+        loss_sum, (g0, gslab) = jax.value_and_grad(
+            batch_loss, argnums=(0, 1))(w0.astype(jnp.float32), slab)
+        gslab = gslab.astype(jnp.bfloat16).reshape(Ll, Bd, wp)
+        g0 = jax.lax.psum(g0, ("dp", "tp")) + lam0 * w0.astype(jnp.float32)
+        loss_sum = jax.lax.psum(loss_sum, ("dp", "tp"))
+
+        gpack = gslab.reshape(Ll, Bd, hp, 128)
+        gpack = gpack.reshape(m, Fl, Bd * hp // 16, 16, 128)
+        gpack = gpack.transpose(1, 0, 2, 3, 4).reshape(
+            Fl, m * Bd * hp // 16, 16, 128)
+        local = (rows - glT * MRF).reshape(m, Fl, Bd)
+        local = local.transpose(1, 0, 2).reshape(Fl, (m * Bd) // 128, 128)
+        kern = _make_scatter_accum_kernel(Bd, Ll, Fl, MRF, hp, chunk,
+                                          interpret=interpret)
+        G = kern(local, gpack)                            # [Fl, ·, 8, 128]
+        G = jax.lax.psum(G, "dp")
+
+        # dense XLA optimizer tail on the local shard — same math as the
+        # fused kernel's _opt phase (count-lane L2, live masks)
+        G3 = G.reshape(Fl * MRF, hp, 128)
+        T3 = T2.astype(jnp.float32).reshape(Fl * MRF, hp, 128)
+        S3 = S2.reshape(Fl * MRF, hp, 128)
+        lane = jnp.arange(128)
+        if lam_w or lam_v:
+            cnt = G3[:, 1, cnt_lane]                      # [rows]
+            lam_hp = jnp.stack([
+                jnp.full((128,), lam_v, jnp.float32),
+                jnp.where(lane < w_lane, lam_v,
+                          jnp.where(lane == w_lane, lam_w, 0.0))])
+            live_hp = jnp.stack([jnp.ones((128,), jnp.float32),
+                                 (lane <= w_lane).astype(jnp.float32)])
+            Geff = (G3 + lam_hp[None] * T3 * cnt[:, None, None]) \
+                * live_hp[None]
+        else:
+            Geff = G3
+        gg = S3 + Geff * Geff
+        eta_t = jnp.asarray(eta_fn(t), jnp.float32)
+        T3n = T3 - eta_t * Geff / (jnp.sqrt(gg) + _EPS)
+        T2n = T3n.reshape(Fl * MRF * hp, 128).astype(T2.dtype)
+        S2n = gg.reshape(Fl * MRF * hp, 128)
+
+        gg0 = opt_state["w0"]["gg"] + g0 * g0
+        w0n = (w0.astype(jnp.float32)
+               - eta_fn(t) * g0 / (jnp.sqrt(gg0) + _EPS)).astype(w0.dtype)
+        return ({"T2": T2n, "w0": w0n},
+                {"T2": {"gg": S2n}, "w0": {"gg": gg0}}, loss_sum)
+
+    pT = P("tp", None)
+    param_spec = {"T2": pT, "w0": P()}
+    opt_spec = {"T2": {"gg": pT}, "w0": {"gg": P()}}
+    if unit_val:
+        def fn(params, opt_state, t, idx, label, row_mask):
+            return local_step(params, opt_state, t, idx, None, label,
+                              row_mask)
+        in_specs = (param_spec, opt_spec, P(), P("dp", None), P("dp"),
+                    P("dp"))
+    else:
+        fn = local_step
+        in_specs = (param_spec, opt_spec, P(), P("dp", None),
+                    P("dp", None), P("dp"), P("dp"))
+    smapped = _sm(fn, mesh=mesh, in_specs=in_specs,
+                  out_specs=(param_spec, opt_spec, P()), **{flag: False})
+    return jax.jit(smapped, donate_argnums=(0, 1))
 
 
 def make_parts_score(F: int, K: int, MRF: int):

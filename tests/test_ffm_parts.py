@@ -176,7 +176,9 @@ def test_guards():
     t = FFMTrainer(f"-dims {DIMS} -factors {K} -fields {F} -mini_batch 64 "
                    "-opt adagrad -classification -halffloat "
                    "-ffm_table parts")
-    with pytest.raises(ValueError, match="mesh"):
+    # round 4: parts DOES mesh now (make_parts_step_sharded) — but field
+    # and batch divisibility are validated (F=31 here; tp=4 cannot divide)
+    with pytest.raises(ValueError, match="divisible by the tp axis"):
         t._apply_mesh("dp=2,tp=4")
     with pytest.raises(ValueError, match="MIX"):
         t._get_weights_at(np.array([1, 2], np.int64))
