@@ -74,6 +74,20 @@ class Word2VecTrainer:
                    "sequential updates), so bigger batches only reduce "
                    "dispatch overhead")
         s.add("seed", type=int, default=11, help="rng seed")
+        s.add("pair_gen", default="auto",
+              help="where SkipGram (center, context) pairs are generated: "
+                   "host (vectorized numpy, pairs cross h2d — 4 bytes per "
+                   "pair) | device (token stream crosses h2d ONCE — ~2 "
+                   "bytes per token, pairs come from shifted views on "
+                   "device; needs -neg_sharing batch, SkipGram, no -mesh "
+                   "— rejected otherwise) | auto (device on accelerators "
+                   "when those hold, else host)")
+        s.add("window_policy", default="sample",
+              help="device pair-gen window policy: sample (word2vec.c "
+                   "dynamic windows — each position draws w in [1,win], "
+                   "pairs beyond w masked) | weighted (every pair trains, "
+                   "weighted (win-delta+1)/win — the EXPECTATION of "
+                   "sample's draw; zero masked slots, lower variance)")
         s.flag("cbow", help="CBOW instead of SkipGram")
         s.add("mesh", default=None,
               help="shard training over a device mesh, e.g. 'dp=2,tp=4' "
@@ -134,13 +148,21 @@ class Word2VecTrainer:
                 ids[ids >= 0].astype(np.int32))
         return np.asarray(kept_counts, np.float64)
 
-    def _neg_table(self, freqs: np.ndarray, size: int = 1 << 20) -> np.ndarray:
-        """Unigram^0.75 sampling table (word2vec.c style)."""
+    def _neg_table(self, freqs: np.ndarray, size: int = 0) -> np.ndarray:
+        """Unigram^0.75 sampling table (word2vec.c style). Sized ~16 slots
+        per word (capped [2^16, 2^20]) and stored uint16 when the vocab
+        fits — the table crosses h2d once per trainer and a fixed 2^20
+        int32 table cost ~4 MB (~0.3 s of every e2e run on the relay) for
+        no sampling-fidelity gain at text8-scale vocabularies."""
+        V = len(freqs)
+        if not size:
+            size = max(1 << 16, min(1 << 20, 16 * V))
         p = freqs ** 0.75
         p /= p.sum()
+        dt = np.uint16 if V < 65536 else np.int32
         return np.repeat(np.arange(len(freqs)),
                          np.maximum(1, np.round(p * size).astype(np.int64))
-                         ).astype(np.int32)
+                         ).astype(dt)
 
     def _make_step(self, cbow: bool, vocab_size: int, dim: int):
         neg = int(self.opts.neg)
@@ -183,7 +205,9 @@ class Word2VecTrainer:
             B = context.shape[0]
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
             nshape = (neg,) if share_neg else (B, neg)
-            negs = ntab[jax.random.randint(key, nshape, 0, ntab.shape[0])]
+            negs = ntab[jax.random.randint(key, nshape, 0,
+                                           ntab.shape[0])].astype(
+                jnp.int32)
             row_mask = (jnp.arange(B) < nvalid).astype(jnp.float32)
             if cbow:
                 cmask = (center >= 0).astype(jnp.float32)
@@ -244,7 +268,9 @@ class Word2VecTrainer:
             B = context.shape[0]
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
             nshape = (neg,) if share_neg else (B, neg)
-            negs = ntab[jax.random.randint(key, nshape, 0, ntab.shape[0])]
+            negs = ntab[jax.random.randint(key, nshape, 0,
+                                           ntab.shape[0])].astype(
+                jnp.int32)
             row_mask = (jnp.arange(B) < nvalid).astype(jnp.float32)
 
             def batch_loss(tables):
@@ -272,6 +298,107 @@ class Word2VecTrainer:
             return (in_emb - lr * grads[0], out_emb - lr * grads[1], loss)
 
         return step
+
+    def _make_pairgen(self, Nc: int, win: int, sep_id: int, policy: str,
+                      seed: int, wire_dt):
+        # module-level lru_cache: a fresh jitted closure per TRAINER would
+        # re-trace/compile on every instance (measured: recompilation cost
+        # dominated the device-windowing e2e run — each bench repeat paid
+        # seconds of compile for identical configs)
+        return _pairgen_cached(Nc, win, sep_id, policy, seed,
+                               np.dtype(wire_dt).name)
+
+    def _make_chunk_trainer(self, W2: int, Bc: int, n_steps: int):
+        return _chunk_trainer_cached(
+            W2, Bc, n_steps, int(self.opts.neg),
+            str(getattr(self.opts, "pacing", "pair")) == "pair",
+            int(self.opts.seed))
+
+    def _train_device_windowing(self, ids_docs, keep_p,
+                                table) -> None:
+        """SkipGram training with on-device pair windowing (-pair_gen
+        device): the token stream crosses h2d once per epoch (~2
+        bytes/token vs ~4 bytes/PAIR x ~5 pairs/token on the host path);
+        per-chunk, one jitted pair-gen builds the center-major [.., 2*win]
+        grid and the grid step consumes row-block device slices."""
+        o = self.opts
+        rng = np.random.default_rng(int(o.seed))
+        win = int(o.window)
+        W2 = 2 * win
+        B = int(o.mini_batch)
+        Bc = max(128, B // W2)          # centers per step (~B pair slots)
+        alpha = float(o.alpha)
+        epochs = int(o.iters)
+        V = len(self.vocab)
+        sep = V                         # out-of-vocab sentinel id
+        wire_dt = np.uint16 if V < 65535 else np.int32
+        policy = str(o.window_policy)
+        if policy not in ("sample", "weighted"):
+            raise ValueError(f"-window_policy must be sample|weighted, got "
+                             f"{policy!r}")
+        gen = None                      # built once the stream size is known
+        runner = None
+        nstep = 0
+        for ep in range(epochs):
+            parts = []
+            for d in ids_docs:
+                if float(o.sample) > 0 and len(d):
+                    d = d[rng.random(len(d)) < keep_p[d]]
+                if len(d):
+                    parts.append(d)
+                    parts.append(np.full(win, sep, np.int32))
+            if not parts:
+                continue
+            stream = np.concatenate(parts).astype(wire_dt)
+            n = len(stream)
+            if gen is None:
+                # chunk tokens: power-of-two sized to the corpus, capped at
+                # 512k (pair grid ~5.2M slots) — ONE compile per corpus
+                # scale instead of a fixed grid that buries small corpora
+                # in masked slots
+                CH = min(1 << 19, 1 << max(10, (n - 1).bit_length()))
+                Nc = CH + 2 * win
+                gen = self._make_pairgen(Nc, win, sep, policy,
+                                         int(o.seed), wire_dt)
+            epd = jnp.uint32(ep)
+            for s0 in range(0, n, CH):
+                # win-token halo each side; SEP-pad the stream edges
+                lo, hi = s0 - win, s0 + CH + win
+                chunk = np.full(Nc, sep, wire_dt)
+                src_lo, src_hi = max(0, lo), min(n, hi)
+                chunk[src_lo - lo:src_hi - lo] = stream[src_lo:src_hi]
+                c_all, x_all, m_all, _ = gen(jnp.asarray(chunk),
+                                             jnp.int32(s0), epd)
+                R = c_all.shape[0]               # grid rows (= Nc centers)
+                ck_tokens = min(CH, n - s0)
+                n_steps = -(-R // Bc)
+                if runner is None:
+                    runner = self._make_chunk_trainer(W2, Bc, n_steps)
+                pad = n_steps * Bc - R
+                if pad:
+                    c_all = jnp.pad(c_all, (0, pad))
+                    x_all = jnp.pad(x_all, ((0, pad), (0, 0)))
+                    m_all = jnp.pad(m_all, ((0, pad), (0, 0)))
+
+                # word2vec.c decays alpha continuously per word; progress
+                # is PER-EPOCH NORMALIZED ((ep + within-epoch)/epochs) so
+                # subsampling's per-epoch stream-length jitter can't push
+                # it past 1.0 (which would clamp the tail at lr_min) or
+                # leave it short of the floor; within a chunk it
+                # interpolates per STEP so a single-chunk corpus still
+                # sweeps alpha -> ~0
+                def lr_at(si: float) -> float:
+                    prog = (ep + (s0 + ck_tokens * (si / n_steps)) / n) \
+                        / epochs
+                    return alpha * (1.0 - prog)
+
+                lr0 = lr_at(0.0)
+                dlr = (lr0 - lr_at(float(n_steps))) / max(1, n_steps)
+                self.in_emb, self.out_emb = runner(
+                    self.in_emb, self.out_emb, table, c_all, x_all, m_all,
+                    jnp.int32(nstep), jnp.float32(lr0), jnp.float32(dlr),
+                    jnp.float32(alpha * 1e-4))
+                nstep += n_steps
 
     @staticmethod
     def _skipgram_pairs(d: np.ndarray, win: int, rng) -> Tuple[np.ndarray,
@@ -360,6 +487,26 @@ class Word2VecTrainer:
         neg = int(o.neg)
         alpha = float(o.alpha)
         epochs = int(o.iters)
+
+        pg = str(o.pair_gen)
+        if pg not in ("auto", "host", "device"):
+            raise ValueError(f"-pair_gen must be auto|host|device, got "
+                             f"{pg!r}")
+        share_neg = str(getattr(o, "neg_sharing", "pair")) == "batch"
+        dev_ok = not cbow and self.mesh is None and share_neg
+        if pg == "device" and not dev_ok:
+            # never SILENTLY train with different semantics than asked: the
+            # grid path needs batch-shared negatives (the per-center
+            # negative term is the savings), SkipGram, and no mesh
+            raise ValueError(
+                "-pair_gen device requires -neg_sharing batch, SkipGram "
+                "(no -cbow), and no -mesh; use -pair_gen auto to fall "
+                "back automatically")
+        if dev_ok and (pg == "device"
+                       or (pg == "auto"
+                           and jax.default_backend() != "cpu")):
+            self._train_device_windowing(ids_docs, keep_p, table)
+            return self
 
         # pending vectorized pair chunks awaiting dispatch
         pend_c: List[np.ndarray] = []
@@ -471,3 +618,124 @@ class Word2VecTrainer:
         va, vb = self.vectors()[a], self.vectors()[b]
         return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb)
                                 + 1e-12))
+
+
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=64)
+def _pairgen_cached(Nc: int, win: int, sep_id: int, policy: str, seed: int,
+                    wire_name: str):
+    """Jitted device-side SkipGram pair generator over a token chunk
+    (cached per static config so trainer instances share one compile).
+
+    The round-3 e2e wall was the h2d link moving PAIRS (~4 bytes/pair
+    x ~5 pairs/token); here the TOKEN STREAM crosses once (~2
+    bytes/token) and pairs come from 2*win shifted views (jnp.roll —
+    no per-element index ops, the round-3 trap). Slot (i, j) of the
+    [Nc, 2*win] grid is (T[i], T[i +/- delta]); validity/weight rides
+    a per-slot mask consumed by the grid step (invalid slots train with
+    weight 0 — masking beats device compaction, whose argsort/scatter
+    would cost ~26 ns per pair, more than the step).
+
+    policy='sample': word2vec.c dynamic windows — w[i] drawn in [1, win]
+    by an integer hash of the global position (stateless, so chunks and
+    epochs stay reproducible), pairs with delta > w[i] masked.
+    policy='weighted': every pair trains with weight (win - delta + 1)/win
+    — exactly the expectation of sample's draw, zero masked slots, lower
+    gradient variance (documented delta). Chunks arrive with a win-token
+    halo on both sides; centers in the halo are masked (their pairs belong
+    to neighbour chunks)."""
+    wire_dt = np.dtype(wire_name)
+
+    @jax.jit
+    def gen(T, offset, ep):
+        Tw = T.astype(jnp.int32)
+        i = jnp.arange(Nc, dtype=jnp.int32)
+        if policy == "sample":
+            h = (i + offset).astype(jnp.uint32)
+            h = h * jnp.uint32(0x9E3779B1) + jnp.uint32(seed)
+            h = h ^ (h >> 15)
+            h = (h + ep.astype(jnp.uint32)) * jnp.uint32(0xC2B2AE35)
+            h = h ^ (h >> 13)
+            w = (1 + h % jnp.uint32(win)).astype(jnp.int32)
+        ms, xs = [], []
+        is_sep = Tw == sep_id
+        center_ok = (~is_sep) & (i >= win) & (i < Nc - win)
+        for delta in range(1, win + 1):
+            for sgn in (1, -1):
+                ctx = jnp.roll(Tw, -sgn * delta)
+                ok = center_ok & (ctx != sep_id)
+                if policy == "sample":
+                    wt = (ok & (w >= delta)).astype(jnp.float32)
+                else:
+                    wt = ok.astype(jnp.float32) * ((win - delta + 1) / win)
+                xs.append(ctx)
+                ms.append(wt)
+        x = jnp.stack(xs, 1).astype(wire_dt)      # [Nc, 2*win]
+        m = jnp.stack(ms, 1)                      # [Nc, 2*win]
+        return Tw.astype(wire_dt), x, m, m.sum()
+
+    return gen
+
+
+@_lru_cache(maxsize=64)
+def _chunk_trainer_cached(W2: int, Bc: int, n_steps: int, neg: int,
+                          pair_pacing: bool, seed: int):
+    """The WHOLE chunk's step loop as one jitted lax.fori_loop (cached per
+    static config — a fresh closure per trainer re-compiled every run).
+
+    A per-step python loop cost ~2 ms of relay dispatch per slice/step
+    (measured: it capped the device pair-gen path below the host path);
+    here a chunk is ONE dispatch. Each iteration consumes a [Bc] center
+    block of the center-major grid via dynamic_slice, draws that step's
+    shared negatives from the staged table, and applies the grid-step
+    update: the flat pair step pays (gather + scatter) on BOTH endpoints
+    of every slot (~4 index ops/pair at ~26 ns, the measured per-row
+    floor); the grid gathers/scatters each center ONCE per W2 slots and
+    computes the shared-negative term — which depends only on the center
+    vector — per CENTER, weighted by the row's total pair weight (equal
+    to summing it per pair). Index ops per slot drop from ~4 to
+    ~2 + 2/W2. lr decays linearly across the chunk (word2vec.c per-word
+    decay)."""
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(in_emb, out_emb, ntab, c_all, x_all, m_all, t0, lr0, dlr,
+            lr_min):
+        D = in_emb.shape[1]
+
+        def body(si, carry):
+            ie, oe = carry
+            r0 = si * Bc
+            centers = jax.lax.dynamic_slice(
+                c_all, (r0,), (Bc,)).astype(jnp.int32)
+            ctx = jax.lax.dynamic_slice(
+                x_all, (r0, 0), (Bc, W2)).astype(jnp.int32)
+            wts = jax.lax.dynamic_slice(m_all, (r0, 0), (Bc, W2))
+            lr = jnp.maximum(lr0 - dlr * si.astype(jnp.float32), lr_min)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), t0 + si)
+            negs = ntab[jax.random.randint(
+                key, (neg,), 0, ntab.shape[0])].astype(jnp.int32)
+            vin = ie[centers]                        # [Bc, D]
+            pos_slab = oe[ctx.reshape(-1)].reshape(Bc, W2, D)
+            neg_slab = oe[negs]                      # [neg, D]
+            wrow = wts.sum(1)
+
+            def batch_loss(v, po, on):
+                posd = jnp.einsum("bd,bwd->bw", v, po)
+                negd = jnp.einsum("bd,nd->bn", v, on)
+                data = (jax.nn.softplus(-posd) * wts).sum() \
+                    + (jax.nn.softplus(negd).sum(-1) * wrow).sum()
+                if pair_pacing:
+                    return data
+                return data / jnp.maximum(wrow.sum(), 1.0)
+
+            _, (gv, gp, gn) = jax.value_and_grad(
+                batch_loss, argnums=(0, 1, 2))(vin, pos_slab, neg_slab)
+            ie = ie.at[centers].add(-lr * gv)
+            oe = oe.at[ctx.reshape(-1)].add((-lr * gp).reshape(-1, D))
+            oe = oe.at[negs].add(-lr * gn)
+            return (ie, oe)
+
+        return jax.lax.fori_loop(0, n_steps, body, (in_emb, out_emb))
+
+    return run

@@ -164,3 +164,55 @@ def test_pair_pacing_converges_at_word2vec_c_alpha():
     across = np.mean([t.similarity("a0", "b0"), t.similarity("a1", "b3"),
                       t.similarity("a4", "b2")])
     assert within > across + 0.2, (within, across)
+
+
+def test_device_pairgen_matches_numpy_reference():
+    """The jitted device pair grid (shifted rolls + masks) must agree with
+    a direct numpy enumeration: slot (i, j) of the [Nc, 2*win] grid is
+    (T[i], T[i + sgn*delta]), masked for SEP endpoints, halo centers, and
+    (sample policy) delta > w[i]; weighted policy carries
+    (win-delta+1)/win."""
+    import jax.numpy as jnp
+    win, sep = 2, 9
+    t = Word2VecTrainer(f"-dim 4 -window {win} -min_count 1")
+    Nc = 16
+    T = np.array([sep, sep, 1, 2, 3, sep, sep, 4, 5, 6, 7, 8, sep, sep,
+                  sep, sep], np.int32)
+    gen = t._make_pairgen(Nc, win, sep, "weighted", 7, np.int32)
+    c, x, m, s = gen(jnp.asarray(T), jnp.int32(0), jnp.uint32(0))
+    c, x, m = np.asarray(c), np.asarray(x), np.asarray(m)
+    assert x.shape == (Nc, 2 * win) and m.shape == (Nc, 2 * win)
+    np.testing.assert_array_equal(c, T)       # grid centers ARE the chunk
+    slots = [(d, sg) for d in range(1, win + 1) for sg in (1, -1)]
+    for i in range(Nc):
+        for j, (delta, sgn) in enumerate(slots):
+            jpos = i + sgn * delta
+            ok = (win <= i < Nc - win and T[i] != sep
+                  and 0 <= jpos < Nc and T[jpos] != sep)
+            want = (win - delta + 1) / win if ok else 0.0
+            assert abs(m[i, j] - want) < 1e-6, (i, j, m[i, j], want)
+            if ok:
+                assert x[i, j] == T[jpos], (i, j)
+    # sample policy: masks are a subset of weighted's support, w in [1,win]
+    gen2 = t._make_pairgen(Nc, win, sep, "sample", 7, np.int32)
+    _, _, m2, _ = gen2(jnp.asarray(T), jnp.int32(0), jnp.uint32(0))
+    m2 = np.asarray(m2)
+    assert set(np.unique(m2)).issubset({0.0, 1.0})
+    assert ((m2 > 0) <= (m > 0)).all()
+    # delta=1 slots valid for any drawn w: where weighted is valid, sample
+    # keeps every delta=1 slot
+    d1 = np.zeros_like(m, bool)
+    d1[:, :2] = True
+    assert (m2[(m > 0) & d1] == 1.0).all()
+
+
+@pytest.mark.parametrize("policy", ["sample", "weighted"])
+def test_clusters_separate_device_pairgen(policy):
+    docs = synthetic_corpus()
+    t = Word2VecTrainer(
+        "-dim 16 -window 3 -neg 4 -neg_sharing batch -min_count 2 "
+        "-alpha 0.5 -mini_batch 512 -iters 8 -sample 0 -pacing mean "
+        f"-pair_gen device -window_policy {policy}").train(docs)
+    same = t.similarity("cat", "dog")
+    cross = t.similarity("cat", "gpu")
+    assert same > cross + 0.2, (same, cross)
