@@ -163,10 +163,18 @@ def _cmd_predict(args) -> int:
 
 def _cmd_mixserv(args) -> int:
     """The bin/run_mixserv.sh analog: a standalone mix server."""
-    from ..parallel.mix_service import MixServer
+    from ..parallel.mix_service import MixServer, make_server_ssl_context
 
-    srv = MixServer(args.host, args.port).start()
-    print(json.dumps({"host": srv.host, "port": srv.port}))
+    ctx = None
+    if bool(args.ssl_cert) != bool(args.ssl_key):
+        print("--ssl-cert and --ssl-key must be given together",
+              file=sys.stderr)
+        return 2
+    if args.ssl_cert:
+        ctx = make_server_ssl_context(args.ssl_cert, args.ssl_key)
+    srv = MixServer(args.host, args.port, ssl_context=ctx).start()
+    print(json.dumps({"host": srv.host, "port": srv.port,
+                      "ssl": bool(ctx)}))
     try:
         while True:
             time.sleep(3600)
@@ -225,6 +233,9 @@ def main(argv=None) -> int:
     pr.set_defaults(fn=_cmd_predict)
 
     m = sub.add_parser("mixserv", help="run a standalone mix server")
+    m.add_argument("--ssl-cert", default=None,
+                   help="TLS certificate file (enables -ssl transport)")
+    m.add_argument("--ssl-key", default=None, help="TLS private key file")
     m.add_argument("--host", default="0.0.0.0")
     m.add_argument("--port", type=int, default=11212)
     m.set_defaults(fn=_cmd_mixserv)

@@ -63,6 +63,12 @@ def learner_option_spec(name: str, *, classification: bool,
     s.add("mix_threshold", type=int, default=16,
           help="local updates between mix exchanges")
     s.add("mix_session", default=None, help="mix session/group id")
+    s.flag("ssl", help="TLS-wrap the MIX connection (reference LearnerBase "
+                       "-ssl); pair with -ssl_cafile to verify the server")
+    s.add("ssl_cafile", default=None,
+          help="CA / self-signed server certificate to verify against "
+               "(omit for encrypted-but-unauthenticated, matching the "
+               "reference's in-cluster -ssl)")
     s.add("loadmodel", default=None, help="warm-start from a saved model table")
     s.flag("cv", help="track cumulative loss for convergence check")
     return s
@@ -107,11 +113,16 @@ class LearnerBase:
             from ..parallel.mix_service import (EVENT_ARGMIN_KLD,
                                                 EVENT_AVERAGE, MixClient)
             has_covar = getattr(self, "sigma", None) is not None
+            sslctx = None
+            if self.opts.get("ssl"):
+                from ..parallel.mix_service import make_client_ssl_context
+                sslctx = make_client_ssl_context(self.opts.ssl_cafile)
             self._mixer = MixClient(
                 self.opts.mix,
                 group=self.opts.mix_session or self.NAME,
                 threshold=int(self.opts.mix_threshold),
-                event=EVENT_ARGMIN_KLD if has_covar else EVENT_AVERAGE)
+                event=EVENT_ARGMIN_KLD if has_covar else EVENT_AVERAGE,
+                ssl_context=sslctx)
         if self.opts.loadmodel:
             self._warm_start(self.opts.loadmodel)
         if self.opts.get("mesh"):

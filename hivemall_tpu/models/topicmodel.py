@@ -13,6 +13,7 @@ is hashed into [0, V) like the linear models' feature space.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -115,48 +116,13 @@ class LDATrainer:
 
     def _make_step(self):
         o = self.opts
-        K, V = self.K, self.V
-        alpha = float(o.alpha)
-        eta = float(o.eta)
-        inner = int(o.iter)
-        D = float(o.total_docs)
-
-        @jax.jit
-        def step(lam, t, ids, cts, mask):
-            """ids/cts/mask: [B, L]; returns updated lambda and gamma."""
-            B, L = ids.shape
-            Elogbeta = _digamma(lam) - _digamma(lam.sum(1, keepdims=True))
-            expElogbeta = jnp.exp(Elogbeta)                 # [K, V]
-            eb = expElogbeta[:, ids]                        # [K, B, L]
-            eb = jnp.moveaxis(eb, 0, 1)                     # [B, K, L]
-
-            def estep(_, gamma):
-                Elogth = _digamma(gamma) - _digamma(
-                    gamma.sum(1, keepdims=True))            # [B, K]
-                expElogth = jnp.exp(Elogth)
-                phinorm = jnp.einsum("bk,bkl->bl", expElogth, eb) + 1e-100
-                gamma_new = alpha + expElogth * jnp.einsum(
-                    "bl,bkl->bk", cts * mask / phinorm, eb)
-                return gamma_new
-
-            gamma0 = jnp.ones((B, K))
-            gamma = jax.lax.fori_loop(0, inner, estep, gamma0)
-            Elogth = _digamma(gamma) - _digamma(gamma.sum(1, keepdims=True))
-            expElogth = jnp.exp(Elogth)
-            phinorm = jnp.einsum("bk,bkl->bl", expElogth, eb) + 1e-100
-            # sufficient stats scattered back to the full vocab
-            sstats_rows = expElogth[:, :, None] * (
-                cts * mask / phinorm)[:, None, :]           # [B, K, L]
-            sstats = jnp.zeros((K, V)).at[:, ids.reshape(-1)].add(
-                jnp.moveaxis(sstats_rows, 1, 0).reshape(K, -1))
-            sstats = sstats * expElogbeta
-            rho = jnp.power(float(o.tau0) + t + 1.0, -float(o.kappa))
-            docs_seen = jnp.maximum(mask.max(1).sum(), 1.0)
-            lam_new = (1 - rho) * lam + rho * (
-                eta + D * sstats / docs_seen)
-            return lam_new, gamma
-
-        return step
+        # module-level cache: a fresh jitted closure per trainer instance
+        # re-COMPILES for identical configs (measured: 1.5 s of the 2.3 s
+        # LDA bench was XLA compile of the second instance's step)
+        return _lda_step_cached(self.K, self.V, float(o.alpha),
+                                float(o.eta), int(o.iter),
+                                float(o.total_docs), float(o.tau0),
+                                float(o.kappa))
 
     # -- lifecycle -----------------------------------------------------------
     def process(self, words: Sequence[str]) -> None:
@@ -236,38 +202,9 @@ class PLSATrainer(LDATrainer):
 
     def _make_step(self):
         o = self.opts
-        K, V = self.K, self.V
-        inner = int(o.iter)
-        alpha = float(o.alpha)
-
-        @jax.jit
-        def step(pwz, t, ids, cts, mask):
-            """pwz: P(w|z) [K, V]; returns updated P(w|z) + per-doc P(z|d)."""
-            B, L = ids.shape
-            pw = pwz[:, ids]                       # [K, B, L]
-            pw = jnp.moveaxis(pw, 0, 1)            # [B, K, L]
-
-            def em(_, pzd):
-                # E: P(z|d,w) ~ P(z|d) P(w|z)
-                num = pzd[:, :, None] * pw         # [B, K, L]
-                pzdw = num / (num.sum(1, keepdims=True) + 1e-100)
-                # M (doc side): P(z|d) ~ sum_w n(d,w) P(z|d,w)
-                pzd_new = (pzdw * (cts * mask)[:, None, :]).sum(-1) + alpha
-                return pzd_new / pzd_new.sum(1, keepdims=True)
-
-            pzd = jnp.full((B, K), 1.0 / K)
-            pzd = jax.lax.fori_loop(0, inner, em, pzd)
-            num = pzd[:, :, None] * pw
-            pzdw = num / (num.sum(1, keepdims=True) + 1e-100)
-            stats = (pzdw * (cts * mask)[:, None, :])       # [B, K, L]
-            sstats = jnp.zeros((K, V)).at[:, ids.reshape(-1)].add(
-                jnp.moveaxis(stats, 1, 0).reshape(K, -1))
-            rho = jnp.power(float(o.tau0) + t + 1.0, -float(o.kappa))
-            pwz_new = (1 - rho) * pwz + rho * (
-                (sstats + 1e-3) / (sstats.sum(1, keepdims=True) + 1e-3 * V))
-            return pwz_new, pzd
-
-        return step
+        return _plsa_step_cached(self.K, self.V, float(o.alpha),
+                                 int(o.iter), float(o.tau0),
+                                 float(o.kappa))
 
     def __init__(self, options: str = ""):
         super().__init__(options)
@@ -303,3 +240,81 @@ def plsa_predict(words: Sequence[str], model_rows, topics: int,
                  alpha: float = 0.5, iters: int = 64):
     """SQL: plsa_predict — same reassembly against P(w|z) rows."""
     return lda_predict(words, model_rows, topics, alpha, iters)
+
+
+@lru_cache(maxsize=32)
+def _lda_step_cached(K: int, V: int, alpha: float, eta: float, inner: int,
+                     D: float, tau0: float, kappa: float):
+    """One online-VB LDA step (Hoffman's onlineldavb), jitted and cached
+    per static config so trainer instances share a single compile."""
+    @jax.jit
+    def step(lam, t, ids, cts, mask):
+        """ids/cts/mask: [B, L]; returns updated lambda and gamma."""
+        B, L = ids.shape
+        Elogbeta = _digamma(lam) - _digamma(lam.sum(1, keepdims=True))
+        expElogbeta = jnp.exp(Elogbeta)                 # [K, V]
+        eb = expElogbeta[:, ids]                        # [K, B, L]
+        eb = jnp.moveaxis(eb, 0, 1)                     # [B, K, L]
+
+        def estep(_, gamma):
+            Elogth = _digamma(gamma) - _digamma(
+                gamma.sum(1, keepdims=True))            # [B, K]
+            expElogth = jnp.exp(Elogth)
+            phinorm = jnp.einsum("bk,bkl->bl", expElogth, eb) + 1e-100
+            gamma_new = alpha + expElogth * jnp.einsum(
+                "bl,bkl->bk", cts * mask / phinorm, eb)
+            return gamma_new
+
+        gamma0 = jnp.ones((B, K))
+        gamma = jax.lax.fori_loop(0, inner, estep, gamma0)
+        Elogth = _digamma(gamma) - _digamma(gamma.sum(1, keepdims=True))
+        expElogth = jnp.exp(Elogth)
+        phinorm = jnp.einsum("bk,bkl->bl", expElogth, eb) + 1e-100
+        # sufficient stats scattered back to the full vocab
+        sstats_rows = expElogth[:, :, None] * (
+            cts * mask / phinorm)[:, None, :]           # [B, K, L]
+        sstats = jnp.zeros((K, V)).at[:, ids.reshape(-1)].add(
+            jnp.moveaxis(sstats_rows, 1, 0).reshape(K, -1))
+        sstats = sstats * expElogbeta
+        rho = jnp.power(tau0 + t + 1.0, -kappa)
+        docs_seen = jnp.maximum(mask.max(1).sum(), 1.0)
+        lam_new = (1 - rho) * lam + rho * (
+            eta + D * sstats / docs_seen)
+        return lam_new, gamma
+
+    return step
+
+
+@lru_cache(maxsize=32)
+def _plsa_step_cached(K: int, V: int, alpha: float, inner: int,
+                      tau0: float, kappa: float):
+    """One incremental-pLSA EM step, jitted and cached per static config
+    (same per-instance recompile rationale as _lda_step_cached)."""
+    @jax.jit
+    def step(pwz, t, ids, cts, mask):
+        """pwz: P(w|z) [K, V]; returns updated P(w|z) + per-doc P(z|d)."""
+        B, L = ids.shape
+        pw = pwz[:, ids]                       # [K, B, L]
+        pw = jnp.moveaxis(pw, 0, 1)            # [B, K, L]
+
+        def em(_, pzd):
+            # E: P(z|d,w) ~ P(z|d) P(w|z)
+            num = pzd[:, :, None] * pw         # [B, K, L]
+            pzdw = num / (num.sum(1, keepdims=True) + 1e-100)
+            # M (doc side): P(z|d) ~ sum_w n(d,w) P(z|d,w)
+            pzd_new = (pzdw * (cts * mask)[:, None, :]).sum(-1) + alpha
+            return pzd_new / pzd_new.sum(1, keepdims=True)
+
+        pzd = jnp.full((B, K), 1.0 / K)
+        pzd = jax.lax.fori_loop(0, inner, em, pzd)
+        num = pzd[:, :, None] * pw
+        pzdw = num / (num.sum(1, keepdims=True) + 1e-100)
+        stats = (pzdw * (cts * mask)[:, None, :])       # [B, K, L]
+        sstats = jnp.zeros((K, V)).at[:, ids.reshape(-1)].add(
+            jnp.moveaxis(stats, 1, 0).reshape(K, -1))
+        rho = jnp.power(tau0 + t + 1.0, -kappa)
+        pwz_new = (1 - rho) * pwz + rho * (
+            (sstats + 1e-3) / (sstats.sum(1, keepdims=True) + 1e-3 * V))
+        return pwz_new, pzd
+
+    return step

@@ -22,7 +22,7 @@ not input-bound.
 from __future__ import annotations
 
 from collections import Counter
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -481,13 +481,6 @@ class Word2VecTrainer:
             keep_p = np.ones(V)
 
         cbow = bool(o.cbow)
-        step = self._make_step(cbow, V, D)
-        win = int(o.window)
-        B = int(o.mini_batch)
-        neg = int(o.neg)
-        alpha = float(o.alpha)
-        epochs = int(o.iters)
-
         pg = str(o.pair_gen)
         if pg not in ("auto", "host", "device"):
             raise ValueError(f"-pair_gen must be auto|host|device, got "
@@ -507,6 +500,13 @@ class Word2VecTrainer:
                            and jax.default_backend() != "cpu")):
             self._train_device_windowing(ids_docs, keep_p, table)
             return self
+
+        step = self._make_step(cbow, V, D)
+        win = int(o.window)
+        B = int(o.mini_batch)
+        neg = int(o.neg)
+        alpha = float(o.alpha)
+        epochs = int(o.iters)
 
         # pending vectorized pair chunks awaiting dispatch
         pend_c: List[np.ndarray] = []
@@ -620,10 +620,7 @@ class Word2VecTrainer:
                                 + 1e-12))
 
 
-from functools import lru_cache as _lru_cache
-
-
-@_lru_cache(maxsize=64)
+@lru_cache(maxsize=64)
 def _pairgen_cached(Nc: int, win: int, sep_id: int, policy: str, seed: int,
                     wire_name: str):
     """Jitted device-side SkipGram pair generator over a token chunk
@@ -679,7 +676,7 @@ def _pairgen_cached(Nc: int, win: int, sep_id: int, policy: str, seed: int,
     return gen
 
 
-@_lru_cache(maxsize=64)
+@lru_cache(maxsize=64)
 def _chunk_trainer_cached(W2: int, Bc: int, n_steps: int, neg: int,
                           pair_pacing: bool, seed: int):
     """The WHOLE chunk's step loop as one jitted lax.fori_loop (cached per

@@ -216,9 +216,13 @@ class MixServer:
     running the event loop, so tests exercise the real TCP path on localhost
     exactly like the reference's in-JVM MixServer tests (SURVEY.md §5.3)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
         self.host = host
         self.port = port          # 0 = ephemeral; real port set on start
+        # TLS transport (the reference LearnerBase's -ssl MIX option,
+        # SURVEY.md §3.1): pass make_server_ssl_context(cert, key)
+        self.ssl_context = ssl_context
         # fault injection (SURVEY.md §6 failure detection): tests set these
         # to prove fail-soft parity — a dropping/stalling server degrades
         # training to replica-local SGD, never stops it.
@@ -323,7 +327,8 @@ class MixServer:
 
             async def boot():
                 self._server = await asyncio.start_server(
-                    self._handle, self.host, self.port)
+                    self._handle, self.host, self.port,
+                    ssl=self.ssl_context)
                 self.port = self._server.sockets[0].getsockname()[1]
                 self._started.set()
 
@@ -365,13 +370,15 @@ class MixClient:
     """
 
     def __init__(self, hosts: str, group: str, threshold: int = 16,
-                 event: int = EVENT_AVERAGE, timeout: float = 2.0):
+                 event: int = EVENT_AVERAGE, timeout: float = 2.0,
+                 ssl_context=None):
         host, _, port = hosts.partition(":")
         self.addr = (host or "127.0.0.1", int(port or 11212))
         self.group = group
         self.threshold = max(1, threshold)
         self.event = event
         self.timeout = timeout
+        self.ssl_context = ssl_context    # -ssl: TLS-wrapped exchanges
         self.alive = True
         self.exchanges = 0
         self._sock: Optional[socket.socket] = None
@@ -382,6 +389,9 @@ class MixClient:
         if self._sock is None:
             s = socket.create_connection(self.addr, timeout=self.timeout)
             s.settimeout(self.timeout)
+            if self.ssl_context is not None:
+                s = self.ssl_context.wrap_socket(
+                    s, server_hostname=self.addr[0])
             self._sock = s
 
     def touch(self, keys: np.ndarray) -> None:
@@ -449,3 +459,32 @@ class MixClient:
             except OSError:
                 pass
             self._sock = None
+
+
+# -- TLS transport (-ssl, SURVEY.md §3.1 LearnerBase MIX options) -----------
+
+def make_server_ssl_context(certfile: str, keyfile: str):
+    """TLS context for MixServer (the reference's -ssl transport): the
+    server presents certfile/keyfile; clients connect with
+    make_client_ssl_context."""
+    import ssl
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile)
+    return ctx
+
+
+def make_client_ssl_context(cafile: Optional[str] = None):
+    """TLS context for MixClient. With ``cafile`` the server certificate
+    is verified against it (self-signed deployments point this at the
+    server cert); without, the channel is encrypted but the peer is NOT
+    authenticated — the reference's -ssl is likewise transport encryption
+    inside a trusted cluster."""
+    import ssl
+    if cafile:
+        ctx = ssl.create_default_context(cafile=cafile)
+        ctx.check_hostname = False      # cluster peers connect by IP
+        return ctx
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    return ctx
