@@ -1,0 +1,241 @@
+"""Generated Japanese lexicon — inflection-paradigm expansion (round 4).
+
+Reference (SURVEY.md §3.19): Kuromoji consults IPADIC (~400k entries).
+Round 3 shipped the lattice/Viterbi MECHANISM with a few hundred
+hand-tuned entries; this module grows the vendored lexicon mechanically:
+verb and adjective PARADIGMS expand each seed stem into its real surface
+forms (the way IPADIC itself is generated from conjugation tables), so a
+few hundred seeds become thousands of entries with no per-form curation.
+
+Paradigms (school-grammar complete for the segmenter's needs — the forms
+that appear as LATTICE PIECES, with auxiliaries like ます/た/ない/ば as
+separate lexicon words):
+
+  godan  (五段):   書く -> 書く 書き 書い 書か 書け 書こ
+                   (ku-onbin 書い; su-row keeps し as renyou, no onbin;
+                    u/tsu/ru-row onbin 買っ; nu/bu/mu-row onbin 読ん)
+  ichidan(一段):   食べる -> 食べる 食べ
+  suru verbal nouns: 勉強 -> 勉強 (+ する/し/した composed from the する
+                   paradigm already in the base lexicon)
+  i-adjectives:    高い -> 高い 高く 高かっ 高けれ
+  na-adjectives / nouns / adverbs: the surface itself
+
+The expansion is intentionally conservative: every emitted string is a
+real inflected form by the paradigm tables; nothing is synthesized
+outside them. For full IPADIC coverage use
+frame.ja_segmenter.load_ipadic_csv (the dictionary drop-in loader).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+__all__ = ["generated_entries", "expand_godan", "expand_ichidan",
+           "expand_i_adjective"]
+
+# godan conjugation rows: dict-ending -> (renyou, onbin, mizen, katei/e,
+# volitional-o). The onbin stem is the piece before て/た.
+_GODAN_ROWS = {
+    "う": ("い", "っ", "わ", "え", "お"),
+    "く": ("き", "い", "か", "け", "こ"),
+    "ぐ": ("ぎ", "い", "が", "げ", "ご"),
+    "す": ("し", "し", "さ", "せ", "そ"),
+    "つ": ("ち", "っ", "た", "て", "と"),
+    "ぬ": ("に", "ん", "な", "ね", "の"),
+    "ぶ": ("び", "ん", "ば", "べ", "ぼ"),
+    "む": ("み", "ん", "ま", "め", "も"),
+    "る": ("り", "っ", "ら", "れ", "ろ"),
+}
+
+
+def expand_godan(dict_form: str) -> List[str]:
+    stem, end = dict_form[:-1], dict_form[-1]
+    ren, onbin, mizen, e, o = _GODAN_ROWS[end]
+    return [dict_form, stem + ren, stem + onbin, stem + mizen,
+            stem + e, stem + o]
+
+
+def expand_ichidan(dict_form: str) -> List[str]:
+    return [dict_form, dict_form[:-1]]           # 食べる, 食べ
+
+
+def expand_i_adjective(dict_form: str) -> List[str]:
+    stem = dict_form[:-1]
+    return [dict_form, stem + "く", stem + "かっ", stem + "けれ"]
+
+
+# --- seed stems (dictionary forms; all standard JLPT N5-N3 vocabulary) ----
+
+_GODAN = """
+会う 合う 買う 使う 思う 言う 歌う 習う 払う 笑う 洗う 手伝う 向かう
+通う 違う 間に合う 拾う 吸う 誘う 迷う 疑う 追う 救う 願う 戦う 扱う
+行く 書く 聞く 歩く 働く 着く 泣く 咲く 開く 置く 履く 引く 弾く 驚く
+招く 続く 乾く 動く 届く 頂く 抱く 磨く 叩く 除く 輝く 頷く
+泳ぐ 脱ぐ 急ぐ 騒ぐ 稼ぐ 防ぐ 繋ぐ 注ぐ
+話す 出す 貸す 消す 押す 探す 返す 渡す 直す 落とす 起こす 回す 移す
+残す 示す 許す 離す 試す 写す 指す 刺す 倒す 壊す 流す 増やす 減らす
+冷やす 乾かす 驚かす 動かす 泣かす 降ろす 通す 表す 現す 隠す 足す
+待つ 立つ 持つ 勝つ 打つ 育つ 役立つ 目立つ 保つ
+死ぬ
+遊ぶ 呼ぶ 飛ぶ 選ぶ 運ぶ 並ぶ 学ぶ 喜ぶ 転ぶ 結ぶ 叫ぶ 浮かぶ
+読む 飲む 休む 住む 頼む 進む 盗む 包む 踏む 悩む 畳む 噛む 積む
+楽しむ 苦しむ 親しむ 望む 挟む 済む 沈む 生む 盗む
+帰る 入る 走る 作る 取る 乗る 送る 座る 知る 売る 切る 降る 終わる
+始まる 分かる 止まる 曲がる 渡る 登る 触る 怒る 困る 謝る 頑張る
+集まる 決まる 変わる 戻る 回る 残る 眠る 守る 祈る 踊る 誇る 縛る
+破る 配る 断る 測る 計る 量る 刈る 彫る 掘る 釣る 吊る 張る 貼る
+鳴る 成る 光る 通る 移る 写る 映る 治る 直る 当たる 上がる 下がる
+広がる 繋がる 助かる 見つかる 受かる 預かる 儲かる 捕まる 温まる
+強まる 弱まる 高まる 深まる 早まる 静まる 泊まる 固まる 埋まる
+加わる 伝わる 教わる 終わる 関わる 代わる 換わる 刺さる 挟まる
+"""
+
+_ICHIDAN = """
+食べる 見る 寝る 起きる 着る 出る 入れる 開ける 閉める 教える 覚える
+忘れる 借りる 浴びる 疲れる 生まれる 降りる 足りる 信じる 感じる
+考える 答える 数える 比べる 調べる 並べる 届ける 続ける 見つける
+つける 付ける 受ける 避ける 助ける 預ける 分ける 欠ける 掛ける
+投げる 逃げる 曲げる 上げる 下げる 挙げる 揚げる 捨てる 育てる
+建てる 立てる 決める 止める 集める 温める 始める 眺める 褒める
+攻める 責める 締める 占める 進める 勧める 薦める 確かめる 慰める
+伝える 変える 替える 換える 加える 迎える 控える 支える 抑える
+捕まえる 間違える 植える 増える 見える 聞こえる 消える 冷える
+燃える 絶える 耐える 生える 映える 覚める 冷める 褪める
+倒れる 壊れる 汚れる 濡れる 折れる 切れる 割れる 破れる 倒れる
+売れる 取れる 外れる 離れる 流れる 溢れる 現れる 表れる 隠れる
+触れる 晴れる 枯れる 暮れる 遅れる 優れる 慣れる 揺れる 別れる
+"""
+
+_SURU_NOUNS = """
+勉強 運動 散歩 旅行 買い物 料理 洗濯 掃除 電話 質問 説明 紹介 案内
+練習 連絡 相談 予約 約束 準備 用意 注意 心配 安心 成功 失敗 発表
+研究 調査 確認 報告 計算 計画 工事 運転 出発 到着 帰国 入学 卒業
+就職 結婚 離婚 生活 仕事 残業 出張 会議 参加 出席 欠席 遅刻 訪問
+見学 観光 撮影 録音 記録 記入 登録 申請 契約 販売 生産 製造 輸出
+輸入 貿易 競争 協力 努力 我慢 感謝 謝罪 反対 賛成 賛同 議論 討論
+翻訳 通訳 意味 理解 誤解 想像 期待 希望 絶望 後悔 反省
+感動 興奮 緊張 集中 徹夜 昼寝 外出 帰宅 入院 退院 手術 検査 診察
+予防 治療 回復 増加 減少 変化 発展 進歩 成長 拡大 縮小 移動 停止
+開始 終了 継続 中止 延期 変更 修正 訂正 削除 追加 選択 決定 判断
+比較 区別 分類 整理 管理 経営 営業 宣伝 広告 募集 応募 採用 解雇
+"""
+
+_I_ADJ = """
+高い 安い 大きい 小さい 新しい 古い 良い 悪い 早い 速い 遅い 多い
+少ない 長い 短い 強い 弱い 白い 黒い 赤い 青い 明るい 暗い 暑い
+寒い 熱い 冷たい 暖かい 温かい 涼しい 楽しい 嬉しい 悲しい 寂しい
+難しい 易しい 優しい 厳しい 忙しい 美しい 可愛い 広い 狭い 重い
+軽い 近い 遠い 甘い 辛い 苦い 酸っぱい 美味しい 不味い 若い 固い
+硬い 柔らかい 太い 細い 厚い 薄い 深い 浅い 丸い 鋭い 鈍い 汚い
+綺麗 眩しい 煩い 煩わしい 恥ずかしい 懐かしい 恋しい 羨ましい
+怖い 危ない 痛い 痒い 眠い だるい 苦しい 切ない 悔しい 正しい
+詳しい 等しい 親しい 珍しい 激しい 貧しい 涼しい 大人しい 凄い
+偉い 賢い 緩い きつい 丸い 四角い 青白い 真っ白い 細かい 荒い
+粗い 淡い 濃い 渋い 鈍い 温い 生ぬるい ぬるい しつこい くどい
+"""
+
+_NA_ADJ_ADV_NOUN = """
+静か 元気 有名 大切 大丈夫 便利 簡単 複雑 特別 普通 自由 安全 危険
+必要 丁寧 親切 真面目 素直 正直 素敵 立派 豊か 確か 盛ん 新鮮 適当
+十分 充分 不便 不安 幸せ 不幸 豪華 地味 派手 暇 楽 変 無理 無駄
+可能 不可能 重要 大事 主要 最高 最低 最悪 完全 完璧 得意 苦手 上手
+下手 好き 嫌い 同じ 様々 色々 立派 綺麗 きれい
+とても すこし 少し たくさん いつも 時々 もう まだ すぐ ゆっくり
+きっと ちょっと やはり やっぱり たぶん 多分 もちろん 勿論 絶対
+非常 かなり 結構 随分 大変 本当 実 特 別 急 偶然 突然 次第 早速
+天気 季節 春 夏 秋 冬 花 桜 森 林 田 畑 島 橋 庭 公園 景色 自然
+地震 台風 津波 洪水 火事 事故 事件 戦争 平和 環境 汚染 資源
+政治 経済 社会 文化 歴史 科学 技術 芸術 文学 音楽 美術 体育 数学
+国語 英語 理科 社会科 地理 物理 化学 生物 哲学 心理 法律 医学
+政府 国会 選挙 大臣 総理 知事 市長 議員 役所 役人 警察 消防 軍隊
+銀行 会社 企業 工場 商店 市場 店舗 支店 本社 本店 受付 窓口 倉庫
+病院 医院 歯科 内科 外科 小児科 薬局 薬 注射 熱 風邪 咳 怪我 傷
+頭痛 腹痛 虫歯 骨折 血 涙 汗 息 命 健康 病気 症状 体温 体重 身長
+駅前 駅員 改札 切符 定期券 時刻表 路線 新幹線 特急 急行 各駅 終電
+始発 乗車 下車 乗り換え 運賃 片道 往復 座席 指定席 自由席 窓側
+通路側 荷物 鞄 財布 鍵 傘 眼鏡 時計 指輪 手袋 帽子 靴下 上着
+背広 制服 着物 浴衣 下着 袖 襟 ポケット ボタン
+祖父 祖母 叔父 叔母 伯父 伯母 従兄弟 甥 姪 孫 夫 妻 主人 家内
+両親 親戚 親子 兄弟 姉妹 夫婦 恋人 彼氏 彼女 友人 知人 仲間 同僚
+先輩 後輩 上司 部下 社員 店長 客 お客様 隣人 大家 住人
+朝食 昼食 夕食 夕飯 晩ご飯 朝ご飯 昼ご飯 間食 夜食 食事 食欲
+豆腐 納豆 味噌汁 漬物 海苔 刺身 天ぷら うどん そば ラーメン カレー
+丼 餅 饅頭 煎餅 飴 菓子 和菓子 洋菓子 氷 湯 茶 紅茶 緑茶 抹茶
+珈琲 牛肉 豚肉 鶏肉 挽肉 玉子 豆 芋 大根 人参 玉葱 葱 胡瓜 茄子
+南瓜 白菜 キャベツ トマト 苺 葡萄 梨 柿 栗 桃 梅 檸檬 西瓜 蜜柑
+林檎 バナナ 砂糖 胡椒 酢 油 バター チーズ パン ケーキ
+春休み 夏休み 冬休み 休日 祝日 平日 週末 月曜日 火曜日 水曜日
+木曜日 金曜日 土曜日 日曜日 今週 先週 来週 再来週 今月 先月 来月
+今年 去年 来年 再来年 一昨日 明後日 毎回 毎度 今晩 今夜 夕方 深夜
+正午 午前 午後 未来 過去 現在 最近 昔 将来 当時 現代 時代
+一つ 二つ 三つ 四つ 五つ 六つ 七つ 八つ 九つ 十 二十 三十 四十
+五十 六十 七十 八十 九十 半 倍 数 番号 番 号 位 等 割 割合 率
+全体 部分 一部 大部分 多く 少数 複数 単数 合計 平均 約 およそ
+"""
+
+
+_DIGITS = "一 二 三 四 五 六 七 八 九".split()
+
+
+def _kanji_numerals() -> List[str]:
+    """Compound kanji numerals 1-999 by the standard composition rules
+    (二十三, 四百五, ...) — each a real written surface form; IPADIC
+    carries these as 名詞,数 entries. Plus the irregular person/day
+    counters that are single dictionary words (一人, 二十日, ...)."""
+    def tens(n: int) -> str:
+        t, o = divmod(n, 10)
+        s = ""
+        if t:
+            s += ("" if t == 1 else _DIGITS[t - 1]) + "十"
+        if o:
+            s += _DIGITS[o - 1]
+        return s
+
+    out = []
+    for n in range(1, 1000):
+        h, r = divmod(n, 100)
+        s = ""
+        if h:
+            s += ("" if h == 1 else _DIGITS[h - 1]) + "百"
+        s += tens(r)
+        if not s:
+            s = _DIGITS[n - 1]
+        out.append(s)
+    out += "一人 二人 一日 二日 三日 四日 五日 六日 七日 八日 九日 十日 二十日".split()
+    return out
+
+
+def _entries() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+
+    def add(w: str, cost: int) -> None:
+        w = w.strip()
+        if w and not w.isascii():       # guard against stray ascii tokens
+            out.setdefault(w, cost)
+
+    for v in _GODAN.split():
+        for i, form in enumerate(expand_godan(v)):
+            # dict form slightly dearer than renyou (ます-stem) so 行きます
+            # lattices as 行き/ます rather than eating the next chunk
+            add(form, 700 - 60 * min(len(form), 4) + (20 if i == 0 else 0))
+    for v in _ICHIDAN.split():
+        for form in expand_ichidan(v):
+            add(form, 700 - 60 * min(len(form), 4))
+    for n in _SURU_NOUNS.split():
+        add(n, 700 - 60 * min(len(n), 4))
+    for a in _I_ADJ.split():
+        for form in expand_i_adjective(a):
+            add(form, 700 - 60 * min(len(form), 4))
+    for w in _NA_ADJ_ADV_NOUN.split():
+        add(w, 700 - 60 * min(len(w), 4))
+    for w in _kanji_numerals():
+        add(w, 700 - 60 * min(len(w), 4))
+    return out
+
+
+def generated_entries() -> Dict[str, int]:
+    """word -> unigram cost for every paradigm-expanded entry."""
+    return dict(_GENERATED)
+
+
+_GENERATED = _entries()

@@ -20,9 +20,9 @@ frame.nlp.set_ja_tokenizer — the option surface stays identical.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, Iterable, List, Tuple
 
-__all__ = ["segment", "LEXICON"]
+__all__ = ["segment", "LEXICON", "install_entries", "load_ipadic_csv"]
 
 # --- vendored lexicon: word -> unigram cost (lower = preferred) -------------
 # Costs are hand-tuned on the test vectors with three bands:
@@ -101,9 +101,80 @@ for _w in _CONTENT:
     # longer known content words are cheaper per char so 名前 beats 名+前
     LEXICON.setdefault(_w, 700 - 60 * min(len(_w), 4))
 
+# round 4: paradigm-expanded entries (frame.ja_lexicon — verbs/adjectives
+# mechanically conjugated from seed stems, IPADIC-style); hand-tuned costs
+# above take precedence on overlap
+from .ja_lexicon import generated_entries as _gen_entries   # noqa: E402
+for _w, _c in _gen_entries().items():
+    LEXICON.setdefault(_w, _c)
+
 _MAX_WORD = max(len(w) for w in LEXICON)
-_PARTICLE_SET = frozenset(_PARTICLES)
-_AUX_SET = frozenset(_FUNC)
+_PARTICLE_SET = set(_PARTICLES)
+_AUX_SET = set(_FUNC)
+
+
+def install_entries(entries: Dict[str, int],
+                    particles: Iterable[str] = (),
+                    aux: Iterable[str] = ()) -> None:
+    """Merge external dictionary entries (word -> unigram cost) into the
+    live lexicon; ``particles``/``aux`` assign connection-cost classes.
+    External entries OVERRIDE vendored costs (a real dictionary knows
+    better)."""
+    global _MAX_WORD
+    LEXICON.update(entries)
+    _PARTICLE_SET.update(particles)
+    _AUX_SET.update(aux)
+    _MAX_WORD = max(_MAX_WORD, max((len(w) for w in entries), default=0))
+
+
+def load_ipadic_csv(path: str, *, encoding: str = "utf-8",
+                    limit: int = 0) -> int:
+    """Load an IPADIC-format CSV dictionary (mecab-ipadic layout:
+    ``surface,left_id,right_id,wcost,POS1,POS2,...``) into the lexicon —
+    the drop-in path to full Kuromoji-grade coverage (SURVEY.md §3.19).
+
+    Mapping: POS1 助詞 -> particle class, 助動詞 -> aux class, everything
+    else content. IPADIC word costs (roughly [-2000, 15000], lower =
+    common) rescale into this lattice's unigram band via
+    ``200 + max(0, wcost + 2000) // 12`` clipped to [120, 2600] — ordinal
+    order is preserved, which is what the Viterbi compares. Accepts a
+    file or a directory of *.csv (the upstream dictionary ships dozens).
+    Returns the number of entries loaded."""
+    import os
+
+    paths = ([os.path.join(path, f) for f in sorted(os.listdir(path))
+              if f.endswith(".csv")] if os.path.isdir(path) else [path])
+    entries: Dict[str, int] = {}
+    particles: List[str] = []
+    aux: List[str] = []
+    n = 0
+    for p in paths:
+        if limit and n >= limit:
+            break
+        with open(p, encoding=encoding) as fh:
+            for line in fh:
+                parts = line.rstrip("\n").split(",")
+                if len(parts) < 5 or not parts[0]:
+                    continue
+                surface = parts[0]
+                try:
+                    wcost = int(parts[3])
+                except ValueError:
+                    continue
+                pos1 = parts[4]
+                cost = min(2600, max(120, 200 + max(0, wcost + 2000) // 12))
+                prev = entries.get(surface)
+                if prev is None or cost < prev:
+                    entries[surface] = cost
+                if pos1 == "助詞":
+                    particles.append(surface)
+                elif pos1 == "助動詞":
+                    aux.append(surface)
+                n += 1
+                if limit and n >= limit:
+                    break
+    install_entries(entries, particles, aux)
+    return len(entries)
 # Connection-cost classes (round 3): the reference Kuromoji consults a
 # full left/right-id connection matrix; here words fall into four classes
 # — particle, aux/function, content, unknown — with a small transition

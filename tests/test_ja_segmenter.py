@@ -81,3 +81,58 @@ def test_tokenize_cn_stopwords_and_override():
         assert tokenize_cn("我的书") == ["X"]
     finally:
         set_cn_tokenizer(None)
+
+
+def test_ipadic_csv_loader_roundtrip(tmp_path):
+    """IPADIC-format CSV drop-in (round 4): load a fragment in the
+    mecab-ipadic layout, verify the new words win in segmentation and the
+    POS-mapped classes register; vendored behavior is untouched for text
+    not involving the new entries."""
+    import importlib
+    from hivemall_tpu.frame import ja_segmenter as js
+
+    before = js.segment("すもももももももものうち")
+    # two made-up-but-well-formed dictionary words the vendored lexicon
+    # cannot know, in IPADIC column layout: surface,lid,rid,wcost,POS1,...
+    csv = tmp_path / "noun.csv"
+    csv.write_text(
+        "電脳空間,1285,1285,4000,名詞,一般,*,*,*,*,電脳空間,デンノウクウカン,デンノークーカン\n"
+        "超電磁砲,1285,1285,4500,名詞,固有名詞,*,*,*,*,超電磁砲,チョウデンジホウ,チョーデンジホー\n"
+        "ゆえ,305,305,3000,助詞,接続助詞,*,*,*,*,ゆえ,ユエ,ユエ\n",
+        encoding="utf-8")
+    try:
+        n = js.load_ipadic_csv(str(csv))
+        assert n == 3
+        assert "電脳空間" in js.LEXICON and "ゆえ" in js._PARTICLE_SET
+        got = js.segment("電脳空間の超電磁砲")
+        assert got == ["電脳空間", "の", "超電磁砲"], got
+        # cost mapping: common (low wcost) < rare (high wcost)
+        assert js.LEXICON["電脳空間"] < js.LEXICON["超電磁砲"]
+        # vendored behavior unchanged
+        assert js.segment("すもももももももものうち") == before
+    finally:
+        importlib.reload(js)      # restore the vendored lexicon for other
+        # tests (module-level state was mutated by the loader)
+
+
+def test_paradigm_lexicon_scale_and_forms():
+    """The generated lexicon (frame.ja_lexicon) expands seed paradigms to
+    thousands of real surface forms and they resolve in the lattice."""
+    from hivemall_tpu.frame.ja_lexicon import (expand_godan, expand_ichidan,
+                                               expand_i_adjective,
+                                               generated_entries)
+    from hivemall_tpu.frame.ja_segmenter import LEXICON, segment
+
+    assert expand_godan("書く") == ["書く", "書き", "書い", "書か", "書け",
+                                    "書こ"]
+    assert expand_godan("読む") == ["読む", "読み", "読ん", "読ま", "読め",
+                                    "読も"]
+    assert expand_ichidan("食べる") == ["食べる", "食べ"]
+    assert expand_i_adjective("高い") == ["高い", "高く", "高かっ",
+                                          "高けれ"]
+    g = generated_entries()
+    assert len(g) > 3500, len(g)
+    assert len(LEXICON) > 3800, len(LEXICON)
+    # paradigm forms segment: potential stem + auxiliary chain
+    assert segment("漢字が読めます") == ["漢字", "が", "読め", "ます"] or \
+        segment("漢字が読めます")[-2:] == ["読め", "ます"]
