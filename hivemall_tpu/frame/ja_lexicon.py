@@ -51,6 +51,8 @@ _GODAN_ROWS = {
 def expand_godan(dict_form: str) -> List[str]:
     stem, end = dict_form[:-1], dict_form[-1]
     ren, onbin, mizen, e, o = _GODAN_ROWS[end]
+    if dict_form == "行く":            # the one irregular ku-onbin: 行っ(た)
+        onbin = "っ"
     return [dict_form, stem + ren, stem + onbin, stem + mizen,
             stem + e, stem + o]
 
@@ -127,7 +129,7 @@ _I_ADJ = """
 難しい 易しい 優しい 厳しい 忙しい 美しい 可愛い 広い 狭い 重い
 軽い 近い 遠い 甘い 辛い 苦い 酸っぱい 美味しい 不味い 若い 固い
 硬い 柔らかい 太い 細い 厚い 薄い 深い 浅い 丸い 鋭い 鈍い 汚い
-綺麗 眩しい 煩い 煩わしい 恥ずかしい 懐かしい 恋しい 羨ましい
+眩しい 煩い 煩わしい 恥ずかしい 懐かしい 恋しい 羨ましい
 怖い 危ない 痛い 痒い 眠い だるい 苦しい 切ない 悔しい 正しい
 詳しい 等しい 親しい 珍しい 激しい 貧しい 涼しい 大人しい 凄い
 偉い 賢い 緩い きつい 丸い 四角い 青白い 真っ白い 細かい 荒い

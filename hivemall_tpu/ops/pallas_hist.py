@@ -334,31 +334,76 @@ def level_histogram_sorted(bins: jnp.ndarray, loc: jnp.ndarray,
 # n * d*B * M*S.
 # --------------------------------------------------------------------------
 
-_DCHUNK = 1024     # rows per grid step (lane axis): big chunks
-                   # amortize the per-(step, feature) VMEM
-                   # accumulate of the out tile
+_DCHUNK = 512      # rows per grid step (lane axis): sized so the fused
+                   # [d*B, CHUNK] one-hot + the [d*B, cs] out tile coexist
+                   # in VMEM (round 4 — the round-3 kernel used 1024 with
+                   # per-feature [B, CHUNK] one-hots)
 _DCS = 512         # channel lanes per group (VMEM: d*B x 512 f32 <= ~4MB)
 
 
 def _dense_kernel(bins_ref, loc_ref, ws_ref, out_ref, *, precision,
-                  d, n_bins, S, cs):
+                  d, n_bins, S, cs, chunk):
+    """Round-4 fused variant: ONE [d*n_bins, CHUNK] x [CHUNK, cs] matmul
+    per chunk-step instead of d separate [n_bins, CHUNK] matmuls — the
+    M-axis fills the MXU (d*64 = 2048 wide vs 64) and the VMEM out tile
+    accumulates once per step instead of d slice-RMWs (probe_trees.py:
+    1.5x on the hist share, bit-identical results)."""
     g = pl.program_id(0)              # channel (node) group
     first = pl.program_id(1) == 0
     loc = loc_ref[0, :]                                   # [CHUNK] lanes
-    col = jax.lax.broadcasted_iota(jnp.int32, (cs, _DCHUNK), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (cs, chunk), 0)
     node_col = col // S + g * (cs // S)
     s_col = col % S
-    w2t = jnp.zeros((cs, _DCHUNK), jnp.float32)
+    w2t = jnp.zeros((cs, chunk), jnp.float32)
     for s in range(S):
         w2t = jnp.where(s_col == s, ws_ref[s, :][None, :], w2t)
     w2t = jnp.where(node_col == loc[None, :], w2t, 0.0)   # [cs, CHUNK]
-    rows = jax.lax.broadcasted_iota(jnp.int32, (n_bins, _DCHUNK), 0)
+    # fused one-hot over ALL features: [(f, b), CHUNK]
+    fb = jax.lax.broadcasted_iota(jnp.int32, (d * n_bins, chunk), 0)
+    frow = fb // n_bins
+    brow = fb % n_bins
+    bv = jnp.zeros((d * n_bins, chunk), jnp.int32)
+    for f in range(d):
+        bv = jnp.where(frow == f, bins_ref[f, :][None, :], bv)
+    oh = (brow == bv).astype(jnp.bfloat16)           # 0/1 exact in bf16
+    acc = jax.lax.dot_general(
+        oh, w2t.astype(jnp.bfloat16),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32)               # [d*B, cs]
+
+    @pl.when(first)
+    def _init():
+        out_ref[0] = acc
+
+    @pl.when(jnp.logical_not(first))
+    def _accum():
+        out_ref[0] += acc
+
+
+def _dense_kernel_f32(bins_ref, loc_ref, ws_ref, out_ref, *, precision,
+                      d, n_bins, S, cs, chunk):
+    """Per-feature f32 variant for HIGHEST-precision channels (gradient
+    sums): the fused bf16 one-hot is off the table, and at f32 the big
+    fused operand loses to d smaller matmuls (measured: GBT regressed 15%
+    under the fused kernel at chunk 256; this body is the round-3 kernel)."""
+    g = pl.program_id(0)
+    first = pl.program_id(1) == 0
+    loc = loc_ref[0, :]
+    col = jax.lax.broadcasted_iota(jnp.int32, (cs, chunk), 0)
+    node_col = col // S + g * (cs // S)
+    s_col = col % S
+    w2t = jnp.zeros((cs, chunk), jnp.float32)
+    for s in range(S):
+        w2t = jnp.where(s_col == s, ws_ref[s, :][None, :], w2t)
+    w2t = jnp.where(node_col == loc[None, :], w2t, 0.0)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n_bins, chunk), 0)
     for f in range(d):
         oh = (rows == bins_ref[f, :][None, :]).astype(jnp.float32)
         acc = jax.lax.dot_general(
             oh, w2t, dimension_numbers=(((1,), (1,)), ((), ())),
             precision=precision,
-            preferred_element_type=jnp.float32)           # [B, cs]
+            preferred_element_type=jnp.float32)
 
         @pl.when(first)
         def _init():
@@ -400,16 +445,21 @@ def level_histogram_dense(bins_t: jnp.ndarray, loc: jnp.ndarray,
     from functools import partial as _partial
     prec = (jax.lax.Precision.DEFAULT if fast
             else jax.lax.Precision.HIGHEST)
+    # fast (bf16-exact integer channels): the fused all-features kernel;
+    # HIGHEST (gradient channels): the per-feature f32 kernel at the
+    # round-3 chunk — measured faster there (see _dense_kernel_f32)
+    chunk = _DCHUNK if fast else 1024
+    kern = _dense_kernel if fast else _dense_kernel_f32
     out = pl.pallas_call(
-        _partial(_dense_kernel, precision=prec, d=dp, n_bins=n_bins,
-                 S=S, cs=cs),
-        grid=(n_groups, np_ // _DCHUNK),
+        _partial(kern, precision=prec, d=dp, n_bins=n_bins,
+                 S=S, cs=cs, chunk=chunk),
+        grid=(n_groups, np_ // chunk),
         in_specs=[
-            pl.BlockSpec((dp, _DCHUNK), lambda g, r: (0, r),
+            pl.BlockSpec((dp, chunk), lambda g, r: (0, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _DCHUNK), lambda g, r: (0, r),
+            pl.BlockSpec((1, chunk), lambda g, r: (0, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((S, _DCHUNK), lambda g, r: (0, r),
+            pl.BlockSpec((S, chunk), lambda g, r: (0, r),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, dp * n_bins, cs),

@@ -68,11 +68,20 @@ def quantize_bins(X: np.ndarray, n_bins: int = 64
     E = S[order, :]                          # [n_bins-1, d]
     for f in range(d):
         e = np.unique(E[:, f])
-        col = np.searchsorted(e, X[:, f], side="left").astype(np.uint8)
         pad = np.full(n_bins - 1, np.inf, np.float32)
         pad[:len(e)] = e
         edges[f] = pad
-        codes[:, f] = col
+    # the per-column searchsorted loop measured 1.6-1.9 s of the 1M x 28
+    # RF build — the C++ twin (OpenMP over columns) takes over when built;
+    # inf padding keeps the binary search exact over the full edge rows
+    from hivemall_tpu.utils.native import bin_columns_native
+    ne = np.full(d, n_bins - 1, np.int32)
+    native = bin_columns_native(X, edges, ne)
+    if native is not NotImplemented:
+        return native, edges
+    for f in range(d):
+        codes[:, f] = np.searchsorted(edges[f], X[:, f],
+                                      side="left").astype(np.uint8)
     return codes, edges
 
 

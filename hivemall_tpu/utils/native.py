@@ -17,7 +17,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["get_lib", "mmh3_batch_native", "mhash_batch_native",
+__all__ = ["get_lib", "mmh3_batch_native", "mhash_batch_native", "bin_columns_native",
            "parse_libsvm_native", "canonicalize_fieldmajor_native"]
 
 _LIB: Optional[ctypes.CDLL] = None
@@ -179,3 +179,25 @@ def canonicalize_fieldmajor_native(idx: np.ndarray, val: np.ndarray,
         out_idx.ctypes.data_as(ctypes.c_void_p),
         out_val.ctypes.data_as(ctypes.c_void_p))
     return out_idx, out_val, int(m)
+
+
+def bin_columns_native(X: np.ndarray, edges: np.ndarray,
+                       n_edges: np.ndarray):
+    """C++ twin of quantize_bins' per-column searchsorted loop (round 4:
+    it measured 1.6-1.9 s of the 1M x 28 RF build host side). Returns the
+    uint8 code matrix or NotImplemented when the lib isn't available."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bin_columns"):
+        return NotImplemented          # stale prebuilt .so without the entry
+    X = np.ascontiguousarray(X, np.float32)
+    edges = np.ascontiguousarray(edges, np.float32)
+    n_edges = np.ascontiguousarray(n_edges, np.int32)
+    n, d = X.shape
+    codes = np.empty((n, d), np.uint8)
+    lib.bin_columns(X.ctypes.data_as(ctypes.c_void_p),
+                    ctypes.c_int64(n), ctypes.c_int64(d),
+                    edges.ctypes.data_as(ctypes.c_void_p),
+                    n_edges.ctypes.data_as(ctypes.c_void_p),
+                    ctypes.c_int64(edges.shape[1]),
+                    codes.ctypes.data_as(ctypes.c_void_p))
+    return codes

