@@ -449,6 +449,9 @@ def level_histogram_dense(bins_t: jnp.ndarray, loc: jnp.ndarray,
     # HIGHEST (gradient channels): the per-feature f32 kernel at the
     # round-3 chunk — measured faster there (see _dense_kernel_f32)
     chunk = _DCHUNK if fast else 1024
+    assert np_ % chunk == 0, (
+        f"bins_t rows ({np_}) must pad to a multiple of {chunk} "
+        f"(fast={fast}); ops.trees pads to 1024 which divides both")
     kern = _dense_kernel if fast else _dense_kernel_f32
     out = pl.pallas_call(
         _partial(kern, precision=prec, d=dp, n_bins=n_bins,

@@ -254,11 +254,12 @@ extern "C" void bin_columns(const float* X, int64_t n, int64_t d,
       }
       // side="left": count of edges STRICTLY below x -> use (ej < x);
       // above we counted (x > ej) which is the same predicate.
-      // NaN parity with np.searchsorted: NaN sorts LAST (code = ne),
-      // while (NaN > ej) is false — patch those elements explicitly.
+      // NaN parity with np.searchsorted over the FULL padded edge row:
+      // NaN sorts last -> code = max_edges (the numpy fallback searches
+      // the whole inf-padded row), while (NaN > ej) is false.
       for (int64_t i = 0; i < m; ++i)
         codes[(r0 + i) * d + f] =
-            (buf[i] != buf[i]) ? (uint8_t)ne : cnt[i];
+            (buf[i] != buf[i]) ? (uint8_t)max_edges : cnt[i];
     }
   }
 }
