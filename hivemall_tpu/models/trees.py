@@ -73,6 +73,11 @@ def _rf_spec(name: str) -> OptionSpec:
     s.add("attrs", "attribute_types", default=None,
           help="comma list of Q (quantitative) / C (categorical) specs; "
                "C columns are ordinal-binned (documented delta)")
+    s.add("bootstrap", default="exact",
+          help="exact (reference parity: multinomial resample per tree, "
+               "host-generated) | poisson (Poisson(1) streaming-bootstrap "
+               "approximation, generated ON DEVICE — skips the [trees, n] "
+               "weight transfer, the biggest h2d term of a 1M-row fit)")
     return s
 
 
@@ -113,7 +118,23 @@ class _ForestBase:
     def _blob_extra(self) -> Dict:
         return {}
 
-    def _bootstrap(self, n: int, n_trees: int, rng) -> np.ndarray:
+    def _bootstrap(self, n: int, n_trees: int, rng):
+        mode = str(self.opts.bootstrap)
+        if mode == "poisson":
+            # Poisson(1) bootstrap (the streaming-bootstrap approximation
+            # of multinomial resampling — per-row counts i.i.d. Poisson(1)
+            # instead of jointly summing to n): generated ON DEVICE, so
+            # the [E, n] int8 weights never cross h2d (~16 MB / 1-3 s of
+            # relay per 1M-row forest). Documented delta: per-tree total
+            # weight is n +- sqrt(n), not exactly n.
+            import jax
+            import jax.numpy as jnp
+            key = jax.random.PRNGKey(int(self.opts.seed) + 7)
+            return jax.random.poisson(key, 1.0,
+                                      (n_trees, n)).astype(jnp.int8)
+        if mode != "exact":
+            raise ValueError(f"-bootstrap must be exact|poisson, got "
+                             f"{mode!r}")
         # counts are tiny ints; int8 keeps the h2d transfer 4x smaller
         # than f32, and bincount replaces np.add.at (~100 ms/tree at 1M)
         w = np.empty((n_trees, n), np.int8)
@@ -205,13 +226,19 @@ class RandomForestRegressor(_ForestBase):
             bins, y, w, edges, depth=int(o.depth), n_bins=int(o.bins),
             mtry=mtry, min_split=float(o.min_split),
             min_leaf=float(o.min_leaf), seed=int(o.seed), n_trees=E)
-        preds = predict_bins(self.tree, bins)[..., 0]
-        self.oob_errors = []
-        for e in range(E):
-            oob = w[e] == 0
-            self.oob_errors.append(
-                float(np.mean((preds[e, oob] - y[oob]) ** 2))
-                if oob.any() else 0.0)
+        # per-tree OOB MSE ON DEVICE (same pattern as the classifier):
+        # only [E] floats cross d2h — fetching [E, n] preds + poisson
+        # counts would re-pay the h2d the -bootstrap poisson flag saves
+        import jax.numpy as jnp
+        from hivemall_tpu.ops.trees import predict_bins_device
+        preds = predict_bins_device(self.tree, jnp.asarray(bins))[..., 0]
+        wj = jnp.asarray(w)
+        yj = jnp.asarray(y)
+        oob = wj == 0
+        n_oob = jnp.maximum(oob.sum(1), 1)
+        mse = (((preds - yj[None, :]) ** 2) * oob).sum(1) / n_oob
+        mse = jnp.where(oob.sum(1) == 0, 0.0, mse)
+        self.oob_errors = [float(v) for v in np.asarray(mse)]
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         vals = predict_bins(self.tree, bin_raw(np.asarray(X, np.float32),

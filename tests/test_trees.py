@@ -165,3 +165,28 @@ def test_gbt_fit_then_close_serializes(tmp_path):
     gb = XGBoostClassifier("-num_round 3 -max_depth 3").fit(X, y)
     rows = list(gb.close())          # no process() buffer: must not refit
     assert len(rows) == 3
+
+
+def test_rf_poisson_bootstrap_converges():
+    """-bootstrap poisson: device-generated Poisson(1) counts replace the
+    host multinomial (streaming-bootstrap approximation) — accuracy and
+    OOB behavior must hold; -bootstrap validates its value."""
+    import pytest
+
+    X, y = two_moons_ish(500, seed=2)
+    rf = RandomForestClassifier("-trees 10 -depth 6 -bins 32 -seed 3 "
+                                "-bootstrap poisson")
+    rf.fit(X, y)
+    acc = (rf.predict(X) == y).mean()
+    assert acc > 0.93, acc
+    assert all(0.0 <= e <= 0.6 for e in rf.oob_errors)
+    rr = RandomForestRegressor("-trees 8 -depth 4 -bins 32 -vars 4 "
+                               "-bootstrap poisson")
+    rng = np.random.default_rng(1)
+    Xr = rng.uniform(-1, 1, (400, 4)).astype(np.float32)
+    yr = np.where(Xr[:, 0] > 0, 2.0, -1.0).astype(np.float32)
+    rr.fit(Xr, yr)
+    rmse = float(np.sqrt(np.mean((rr.predict(Xr) - yr) ** 2)))
+    assert rmse < 0.5, rmse
+    with pytest.raises(ValueError, match="exact|poisson"):
+        RandomForestClassifier("-trees 2 -bootstrap wild").fit(X, y)
