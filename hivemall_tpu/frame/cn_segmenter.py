@@ -22,7 +22,8 @@ from __future__ import annotations
 
 from typing import Dict, List
 
-__all__ = ["segment", "CN_LEXICON"]
+__all__ = ["segment", "CN_LEXICON", "install_entries",
+           "load_lexicon_tsv"]
 
 # --- vendored lexicon: word -> unigram cost (lower = preferred) -------------
 # Two bands: ~250 function/grammar words, ~500+ content words (longer known
@@ -85,6 +86,50 @@ for _w in _CONTENT:
     CN_LEXICON.setdefault(_w, 460 + 70 * max(0, len(_w) - 2))
 
 _MAX_WORD = max(len(w) for w in CN_LEXICON)
+
+
+def install_entries(entries: Dict[str, int]) -> None:
+    """Merge external dictionary entries (word -> unigram cost) into the
+    live lexicon — external costs OVERRIDE vendored ones (round 4, the
+    tokenize_ja install_entries twin)."""
+    global _MAX_WORD
+    CN_LEXICON.update(entries)
+    _MAX_WORD = max(_MAX_WORD, max((len(w) for w in entries), default=0))
+
+
+def load_lexicon_tsv(path: str, *, encoding: str = "utf-8",
+                     default_cost: int = 460) -> int:
+    """Load an external word list: one entry per line, either
+    ``word<TAB>frequency`` (SmartCN-style frequency dictionaries — higher
+    frequency maps to lower cost via a log rescale) or a bare ``word``
+    (assigned ``default_cost``). Lines starting with '#' are skipped.
+    Returns the number of entries loaded."""
+    import math
+
+    entries: Dict[str, int] = {}
+    with open(path, encoding=encoding) as fh:
+        for line in fh:
+            line = line.rstrip("\n")
+            if not line or line.startswith("#"):
+                continue
+            word, _, freq = line.partition("\t")
+            word = word.strip()
+            if not word:
+                continue
+            if freq.strip():
+                try:
+                    f = float(freq)
+                except ValueError:
+                    continue
+                # log rescale at 87/decade: freq 1 -> 700, 1e6 -> ~180
+                cost = int(max(150, 700 - 87 * math.log10(max(1.0, f))))
+            else:
+                cost = default_cost
+            prev = entries.get(word)
+            if prev is None or cost < prev:
+                entries[word] = cost
+    install_entries(entries)
+    return len(entries)
 _UNK_HAN = 800          # OOV Han falls back to single characters
 
 

@@ -136,3 +136,4 @@ def test_paradigm_lexicon_scale_and_forms():
     # paradigm forms segment: potential stem + auxiliary chain
     assert segment("漢字が読めます") == ["漢字", "が", "読め", "ます"] or \
         segment("漢字が読めます")[-2:] == ["読め", "ます"]
+

@@ -231,3 +231,25 @@ def test_ja_golden_template_accuracy():
     # into a longer lexicon word), so demand high-but-not-perfect recovery
     assert acc >= 0.9, "\n".join(
         f"{t!r}: got {g} want {e}" for t, g, e in bad[:20])
+
+
+def test_cn_lexicon_loader_roundtrip(tmp_path):
+    """tokenize_cn external-lexicon drop-in (round 4): word+frequency TSV
+    and bare-word lines load, frequency maps to lower cost, segmentation
+    picks up the new words; vendored behavior restored after."""
+    import importlib
+    from hivemall_tpu.frame import cn_segmenter as cs
+
+    before = cs.segment("我们在北京学习中文")
+    tsv = tmp_path / "lex.tsv"
+    tsv.write_text("# comment\n人工智能\t500000\n机器学习\t300000\n"
+                   "深度学习\n", encoding="utf-8")
+    try:
+        n = cs.load_lexicon_tsv(str(tsv))
+        assert n == 3
+        assert cs.CN_LEXICON["人工智能"] < cs.CN_LEXICON["深度学习"]
+        got = cs.segment("我们学习人工智能和机器学习")
+        assert "人工智能" in got and "机器学习" in got, got
+        assert cs.segment("我们在北京学习中文") == before
+    finally:
+        importlib.reload(cs)
