@@ -219,16 +219,20 @@ class LearnerBase:
                 jax.profiler.stop_trace()
         return self
 
-    def _fit_epochs(self, ds, epochs, bs, shuffle, prefetch, ckdir) -> None:
+    def _fit_epochs(self, ds, epochs, bs, shuffle, prefetch, ckdir,
+                    seed0: int = 42) -> None:
         # overlap host batch prep + h2d with compute on accelerators
         # (the prefetcher places on the default device; under -mesh the
-        # dispatch path does its own sharded placement instead)
+        # dispatch path does its own sharded placement instead).
+        # seed0: first epoch's shuffle seed — continuation callers (the
+        # FFM replay cache's fallback) pass 42 + epochs_already_run so the
+        # schedule matches an uninterrupted fit
         if prefetch is None:
             import jax
             prefetch = jax.default_backend() != "cpu" and self.mesh is None
         for ep in range(epochs):
             it = map(self._preprocess_train_batch,
-                     ds.batches(bs, shuffle=shuffle, seed=42 + ep))
+                     ds.batches(bs, shuffle=shuffle, seed=seed0 + ep))
             if prefetch:
                 from ..io.prefetch import DevicePrefetcher
                 it = DevicePrefetcher(it, depth=2)

@@ -507,6 +507,108 @@ class FFMTrainer(FMTrainer):
             return pack_unit_fieldmajor(batch)
         return batch
 
+    _DEVICE_CACHE_MB = 2048      # HBM budget for the -iters replay cache
+
+    def _fit_epochs(self, ds, epochs, bs, shuffle, prefetch, ckdir) -> None:
+        """Multi-epoch fit with a DEVICE-RESIDENT replay cache (round 4).
+
+        The reference's -iters pattern re-reads the corpus every epoch; the
+        round-3 disk replay did too — and through this relay every epoch
+        re-paid the full h2d wall. When the packed input path is active and
+        the dataset fits the HBM budget, epoch 1 streams normally but
+        RETAINS its staged device buffers; epochs >= 2 reshuffle with ONE
+        on-device row gather (~26 ns/row — thousands of times cheaper than
+        re-transferring) and run at near-kernel rate. Padded tail rows stay
+        at the END of the replay matrix so per-batch validity remains a
+        prefix (the packed step's nv-scalar contract)."""
+        if (epochs <= 1 or ckdir or self.mesh is not None
+                or not self._pack_input_on()):
+            return super()._fit_epochs(ds, epochs, bs, shuffle, prefetch,
+                                       ckdir)
+        from ..io.prefetch import DevicePrefetcher
+
+        budget = self._DEVICE_CACHE_MB << 20
+        if prefetch is None:
+            prefetch = jax.default_backend() != "cpu"
+
+        # ---- epoch 1: normal streamed epoch, retaining staged buffers ----
+        staged: list = []
+        cache_on = True
+        cached_bytes = 0
+        it = map(self._preprocess_train_batch,
+                 ds.batches(bs, shuffle=shuffle, seed=42))
+        if prefetch:
+            it = DevicePrefetcher(it, depth=2)
+        try:
+            for b in it:
+                if cache_on and isinstance(b, PackedBatch):
+                    cached_bytes += int(b.buf.size)
+                    if cached_bytes > budget:
+                        # over budget mid-epoch: free the cache NOW (the
+                        # streamed path never retains buffers) and finish
+                        # the epoch + remaining epochs streamed
+                        staged.clear()
+                        cache_on = False
+                    else:
+                        staged.append(b)
+                elif cache_on:
+                    # a batch failed the pack conditions: replay unsafe
+                    staged.clear()
+                    cache_on = False
+                self._dispatch(b)
+        finally:
+            if prefetch:
+                it.close()
+        if not cache_on:
+            return super()._fit_epochs(ds, epochs - 1, bs, shuffle,
+                                       prefetch, ckdir, seed0=43)
+        if not staged:
+            return
+        B, L = staged[0].B, staged[0].L
+        rb = 3 * L + 4                    # packed bytes per row
+        if any(s.B != B or s.L != L for s in staged):
+            return super()._fit_epochs(ds, epochs - 1, bs, shuffle,
+                                       prefetch, ckdir, seed0=43)
+        # rows matrix with REAL rows first, padding rows last (prefix
+        # validity per tail batch); idx bytes and label bytes re-packed
+        # row-major so a row gather moves one contiguous 3L+4 record
+        mats = []
+        n_real = 0
+        pad_rows = []
+        for s in staged:
+            nv = s.B if s.n_valid is None else s.n_valid
+            ni = s.B * L * 3
+            rows_m = jnp.concatenate(
+                [s.buf[:ni].reshape(s.B, L * 3),
+                 s.buf[ni:].reshape(s.B, 4)], axis=1)     # [B, rb]
+            mats.append(rows_m[:nv])
+            n_real += nv
+            if nv < s.B:
+                pad_rows.append(rows_m[nv:])
+        M = jnp.concatenate(mats + pad_rows)              # [N_total, rb]
+        del staged, mats, pad_rows        # bound peak HBM at ~M (+ Mp)
+        n_total = M.shape[0]
+        rng = np.random.default_rng(43)
+
+        for ep in range(1, epochs):
+            if shuffle:
+                perm = rng.permutation(n_real)
+                if n_total > n_real:
+                    perm = np.concatenate(
+                        [perm, np.arange(n_real, n_total)])
+                Mp = M[jnp.asarray(perm.astype(np.int32))]
+            else:
+                Mp = M
+            for s0 in range(0, n_total, B):
+                rows_b = Mp[s0:s0 + B]
+                buf = jnp.concatenate(
+                    [rows_b[:, :L * 3].reshape(-1),
+                     rows_b[:, L * 3:].reshape(-1)])
+                nv = min(B, max(0, n_real - s0))
+                if nv == 0:
+                    break
+                self._dispatch(PackedBatch(buf, B, L, n_valid=nv))
+
     def _pack_input_on(self) -> bool:
         # the mesh/mixer exclusions outrank an explicit "on": _shard_batch
         # and MixClient.touch consume .idx, which packed buffers don't have

@@ -617,3 +617,38 @@ def test_ffm_pack_input_partial_batch_mask():
         np.testing.assert_array_equal(np.asarray(a.params[k2], np.float32),
                                       np.asarray(b.params[k2], np.float32),
                                       err_msg=k2)
+
+
+def test_ffm_device_replay_cache_multi_epoch():
+    """-iters/epochs >= 2 with the packed path: epoch 1 streams, later
+    epochs replay DEVICE-resident rows. shuffle=False replays the exact
+    batch composition, so params must be bit-equal to the uncached path;
+    shuffle=True must still converge with the same example count."""
+    import numpy as np
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.models.fm import FFMTrainer
+
+    B, L, F, K, dims, n = 256, 8, 8, 4, 1 << 20, 900   # 900 = 3*256 + 132
+    rng = np.random.default_rng(11)
+    idx = rng.integers(1, dims, (n, L)).astype(np.int32)
+    fld = np.tile(np.arange(L, dtype=np.int32), (n, 1))
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    indptr = np.arange(0, n * L + 1, L, dtype=np.int64)
+    ds = SparseDataset(idx.ravel(), indptr, np.ones(n * L, np.float32),
+                       lab, fld.ravel())
+    cfg = (f"-dims {dims} -factors {K} -fields {F} -mini_batch {B} "
+           "-opt adagrad -classification -halffloat -seed 5 "
+           "-pack_input on")
+    a = FFMTrainer(cfg)
+    a.fit(ds, epochs=3, shuffle=False, prefetch=False)
+    b = FFMTrainer(cfg.replace("-pack_input on", "-pack_input off"))
+    b.fit(ds, epochs=3, shuffle=False, prefetch=False)
+    for k2 in a.params:
+        np.testing.assert_array_equal(
+            np.asarray(a.params[k2], np.float32),
+            np.asarray(b.params[k2], np.float32), err_msg=k2)
+    assert a._examples == b._examples == 3 * n
+    c = FFMTrainer(cfg)
+    c.fit(ds, epochs=3, shuffle=True, prefetch=False)
+    assert c._examples == 3 * n
+    assert np.isfinite(c.cumulative_loss)
