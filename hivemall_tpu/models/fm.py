@@ -527,7 +527,10 @@ class FFMTrainer(FMTrainer):
                                        ckdir)
         from ..io.prefetch import DevicePrefetcher
 
-        budget = self._DEVICE_CACHE_MB << 20
+        # admission at budget/3: construction transiently holds the
+        # staged buffers + the rows_m copies + M, and shuffled epochs hold
+        # M + Mp — _DEVICE_CACHE_MB bounds the PEAK, not just M
+        budget = (self._DEVICE_CACHE_MB << 20) // 3
         if prefetch is None:
             prefetch = jax.default_backend() != "cpu"
 
@@ -565,7 +568,6 @@ class FFMTrainer(FMTrainer):
         if not staged:
             return
         B, L = staged[0].B, staged[0].L
-        rb = 3 * L + 4                    # packed bytes per row
         if any(s.B != B or s.L != L for s in staged):
             return super()._fit_epochs(ds, epochs - 1, bs, shuffle,
                                        prefetch, ckdir, seed0=43)
