@@ -35,6 +35,112 @@ from .base import LearnerBase, learner_option_spec
 __all__ = ["FMTrainer", "FFMTrainer", "fm_predict", "ffm_predict"]
 
 
+# --- config-cached step builders (round 4) ---------------------------------
+# A fresh jitted closure per TRAINER instance re-traces/compiles for every
+# identical config (the disease that cost word2vec 4x and LDA 10x e2e —
+# each bench/CV iteration constructing a new trainer paid seconds of XLA
+# compile). Steps/scorers are pure functions of the OPTION subset below, so
+# module-level lru_caches keyed on it let instances share one compile;
+# sharing jitted fns is safe (donation applies per CALL to that call's
+# buffers, and all trainer state is passed in, never closed over).
+
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=64)
+def _optimizer_cached(opt, eta_scheme, eta0, total_steps, power_t):
+    return make_optimizer(opt, eta_scheme=eta_scheme, eta0=eta0,
+                          total_steps=total_steps, power_t=power_t,
+                          reg="no")
+
+
+@_lru_cache(maxsize=64)
+def _fm_step_fused_cached(loss_name, opt, eta_scheme, eta0, total_steps,
+                          power_t, lambdas, k):
+    return make_fm_step_fused(
+        get_loss(loss_name),
+        _optimizer_cached(opt, eta_scheme, eta0, total_steps, power_t),
+        lambdas, k)
+
+
+@_lru_cache(maxsize=64)
+def _fm_step_cached(loss_name, opt, eta_scheme, eta0, total_steps,
+                    power_t, lambdas):
+    return make_fm_step(
+        get_loss(loss_name),
+        _optimizer_cached(opt, eta_scheme, eta0, total_steps, power_t),
+        lambdas)
+
+
+@_lru_cache(maxsize=64)
+def _ffm_step_fused_cached(loss_name, opt, eta_scheme, eta0, total_steps,
+                           power_t, lambdas, F, k, fieldmajor, unit_val):
+    return make_ffm_step_fused(
+        get_loss(loss_name),
+        _optimizer_cached(opt, eta_scheme, eta0, total_steps, power_t),
+        lambdas, F, k, fieldmajor=fieldmajor, unit_val=unit_val)
+
+
+@_lru_cache(maxsize=64)
+def _ffm_step_cached(loss_name, opt, eta_scheme, eta0, total_steps,
+                     power_t, lambdas):
+    return make_ffm_step(
+        get_loss(loss_name),
+        _optimizer_cached(opt, eta_scheme, eta0, total_steps, power_t),
+        lambdas)
+
+
+@_lru_cache(maxsize=64)
+def _parts_step_cached(loss_name, eta_scheme, eta0, total_steps, power_t,
+                       lambdas, F, k, MRF, unit_val, interpret):
+    from ..ops.fm_pallas import make_parts_step
+    from ..ops.schedules import make_eta
+    return make_parts_step(get_loss(loss_name),
+                           make_eta(eta_scheme, eta0, total_steps, power_t),
+                           lambdas, F, k, MRF, unit_val=unit_val,
+                           interpret=interpret)
+
+
+@_lru_cache(maxsize=64)
+def _parts_score_cached(F, k, MRF):
+    from ..ops.fm_pallas import make_parts_score
+    return make_parts_score(F, k, MRF)
+
+
+@_lru_cache(maxsize=64)
+def _fm_score_fused_cached(k):
+    return make_fm_score_fused(k)
+
+
+@_lru_cache(maxsize=64)
+def _ffm_score_fused_cached(F, k):
+    return make_ffm_score_fused(F, k)
+
+
+@_lru_cache(maxsize=64)
+def _ffm_score_fieldmajor_cached(F, k):
+    return make_ffm_score_fieldmajor(F, k)
+
+
+@_lru_cache(maxsize=128)
+def _packed_wrap_cached(base_step, B: int, L: int):
+    """Jitted wrapper (cached per (shared base step, batch shape)) that
+    unpacks a PackedBatch buffer on device — 3-byte idx lanes via shifts,
+    f32 labels via bitcast, row mask from the n_valid scalar — then runs
+    the regular unit-val field-major step. The unpack is elementwise and
+    fuses; the win is on the h2d link (see io.sparse.PackedBatch)."""
+    @jax.jit
+    def fn(params, opt_state, t, buf, nv):
+        ni = B * L * 3
+        b3 = buf[:ni].reshape(B, L, 3).astype(jnp.int32)
+        idx = b3[..., 0] | (b3[..., 1] << 8) | (b3[..., 2] << 16)
+        label = jax.lax.bitcast_convert_type(
+            buf[ni:].reshape(B, 4), jnp.float32)
+        mask = (jnp.arange(B) < nv).astype(jnp.float32)
+        return base_step(params, opt_state, t, idx, label, mask)
+
+    return fn
+
 def _factor_spec(name: str, default_factors: int, default_opt: str
                  ) -> OptionSpec:
     s = learner_option_spec(name, classification=True,
@@ -76,11 +182,12 @@ class FMTrainer(LearnerBase):
     def _init_state(self) -> None:
         o = self.opts
         self.classification = bool(o.classification)
-        self.loss = get_loss("logloss" if self.classification
-                             else (o.loss or "squaredloss"))
-        self.optimizer = make_optimizer(
-            o.opt, eta_scheme=o.eta, eta0=o.eta0, total_steps=o.total_steps,
-            power_t=o.power_t, reg="no")
+        self._loss_name = ("logloss" if self.classification
+                           else (o.loss or "squaredloss"))
+        self.loss = get_loss(self._loss_name)
+        self.optimizer = _optimizer_cached(str(o.opt), str(o.eta),
+                                           float(o.eta0), o.total_steps,
+                                           o.power_t)
         self.k = int(o.factors)
         dtype = jnp.bfloat16 if o.halffloat else jnp.float32
         key = jax.random.PRNGKey(int(o.seed))
@@ -114,10 +221,11 @@ class FMTrainer(LearnerBase):
             self.opt_state = {
                 "w0": self.optimizer.init(()),
                 "T": self.optimizer.init((self.Np, self.P * self.W))}
-            self._step = make_fm_step_fused(
-                self.loss, self.optimizer,
+            self._step = _fm_step_fused_cached(
+                self._loss_name, str(o.opt), str(o.eta), float(o.eta0),
+                o.total_steps, o.power_t,
                 (o.lambda0, o.lambda_w, o.lambda_v), self.k)
-            self._fused_score = make_fm_score_fused(self.k)
+            self._fused_score = _fm_score_fused_cached(self.k)
             self._tp_sizes.add(self.Np)    # mesh: shard packed rows over tp
             self.UNIT_VAL_ELISION = True   # fused step accepts val=None
         else:
@@ -129,8 +237,10 @@ class FMTrainer(LearnerBase):
             }
             self.opt_state = {k: self.optimizer.init(v.shape)
                               for k, v in self.params.items()}
-            self._step = make_fm_step(self.loss, self.optimizer,
-                                      (o.lambda0, o.lambda_w, o.lambda_v))
+            self._step = _fm_step_cached(
+                self._loss_name, str(o.opt), str(o.eta), float(o.eta0),
+                o.total_steps, o.power_t,
+                (o.lambda0, o.lambda_w, o.lambda_v))
 
     def _convert_label(self, label: float) -> float:
         if self.classification:
@@ -323,11 +433,12 @@ class FFMTrainer(FMTrainer):
     def _init_state(self) -> None:
         o = self.opts
         self.classification = bool(o.classification)
-        self.loss = get_loss("logloss" if self.classification
-                             else (o.loss or "squaredloss"))
-        self.optimizer = make_optimizer(
-            o.opt, eta_scheme=o.eta, eta0=o.eta0, total_steps=o.total_steps,
-            power_t=o.power_t, reg="no")
+        self._loss_name = ("logloss" if self.classification
+                           else (o.loss or "squaredloss"))
+        self.loss = get_loss(self._loss_name)
+        self.optimizer = _optimizer_cached(str(o.opt), str(o.eta),
+                                           float(o.eta0), o.total_steps,
+                                           o.power_t)
         self.k = int(o.factors)
         self.F = int(o.fields)
         self.layout = str(o.ffm_table)
@@ -347,9 +458,7 @@ class FFMTrainer(FMTrainer):
         dtype = jnp.bfloat16 if o.halffloat else jnp.float32
         key = jax.random.PRNGKey(int(o.seed))
         if self.layout == "parts":
-            from ..ops.fm_pallas import (parts_geometry, make_parts_step,
-                                         make_parts_score, parts_supported)
-            from ..ops.schedules import make_eta
+            from ..ops.fm_pallas import parts_geometry, parts_supported
             if not parts_supported(self.F, self.k, self.optimizer.name,
                                    dtype):
                 raise ValueError(
@@ -373,19 +482,19 @@ class FFMTrainer(FMTrainer):
                 "w0": self.optimizer.init(()),
                 "T2": {"gg": jnp.zeros((self.F * self.MRF * self.HP, 128),
                                        jnp.float32)}}
-            eta_fn = make_eta(o.eta, o.eta0, o.total_steps, o.power_t)
             interp = jax.default_backend() != "tpu"
             lamt = (o.lambda0, o.lambda_w, o.lambda_v)
+            eta_key = (str(o.eta), float(o.eta0), o.total_steps, o.power_t)
             self._step = None
-            self._step_fm = make_parts_step(
-                self.loss, eta_fn, lamt, self.F, self.k, self.MRF,
-                interpret=interp)
-            self._step_fm_unit = make_parts_step(
-                self.loss, eta_fn, lamt, self.F, self.k, self.MRF,
-                unit_val=True, interpret=interp)
+            self._step_fm = _parts_step_cached(
+                self._loss_name, *eta_key, lamt, self.F, self.k, self.MRF,
+                False, interp)
+            self._step_fm_unit = _parts_step_cached(
+                self._loss_name, *eta_key, lamt, self.F, self.k, self.MRF,
+                True, interp)
             self._fused_score = None
-            self._fused_score_fm = make_parts_score(self.F, self.k,
-                                                    self.MRF)
+            self._fused_score_fm = _parts_score_cached(self.F, self.k,
+                                                       self.MRF)
             self.interaction = "fieldmajor"   # parts is fieldmajor-only
             self._pairs = set()
             self._fit_ds = None
@@ -404,21 +513,23 @@ class FFMTrainer(FMTrainer):
             self.params = {"w0": jnp.zeros((), dtype), "T": Tinit}
             self.opt_state = {"w0": self.optimizer.init(()),
                               "T": self.optimizer.init((self.Mr, self.W))}
-            self._step = make_ffm_step_fused(
-                self.loss, self.optimizer,
-                (o.lambda0, o.lambda_w, o.lambda_v), self.F, self.k)
+            opt_key = (str(o.opt), str(o.eta), float(o.eta0),
+                       o.total_steps, o.power_t)
+            lamt = (o.lambda0, o.lambda_w, o.lambda_v)
+            self._step = _ffm_step_fused_cached(
+                self._loss_name, *opt_key, lamt, self.F, self.k,
+                False, False)
             self._step_fm = None if self.interaction == "pairs" else \
-                make_ffm_step_fused(
-                    self.loss, self.optimizer,
-                    (o.lambda0, o.lambda_w, o.lambda_v), self.F, self.k,
-                    fieldmajor=True)
+                _ffm_step_fused_cached(
+                    self._loss_name, *opt_key, lamt, self.F, self.k,
+                    True, False)
             self._step_fm_unit = None if self.interaction == "pairs" else \
-                make_ffm_step_fused(
-                    self.loss, self.optimizer,
-                    (o.lambda0, o.lambda_w, o.lambda_v), self.F, self.k,
-                    fieldmajor=True, unit_val=True)
-            self._fused_score = make_ffm_score_fused(self.F, self.k)
-            self._fused_score_fm = make_ffm_score_fieldmajor(self.F, self.k)
+                _ffm_step_fused_cached(
+                    self._loss_name, *opt_key, lamt, self.F, self.k,
+                    True, True)
+            self._fused_score = _ffm_score_fused_cached(self.F, self.k)
+            self._fused_score_fm = _ffm_score_fieldmajor_cached(self.F,
+                                                                self.k)
             self._tp_sizes.add(self.Mr)     # mesh: shard T rows over tp
         else:
             self.params = {
@@ -433,8 +544,10 @@ class FFMTrainer(FMTrainer):
                 raise ValueError("-ffm_interaction fieldmajor needs the "
                                  "joint layout (-ffm_table joint, "
                                  "power-of-two -dims)")
-            self._step = make_ffm_step(self.loss, self.optimizer,
-                                       (o.lambda0, o.lambda_w, o.lambda_v))
+            self._step = _ffm_step_cached(
+                self._loss_name, str(o.opt), str(o.eta), float(o.eta0),
+                o.total_steps, o.power_t,
+                (o.lambda0, o.lambda_w, o.lambda_v))
             self._step_fm = None
             self._step_fm_unit = None
             self.interaction = "pairs"
@@ -625,31 +738,11 @@ class FFMTrainer(FMTrainer):
         return jax.default_backend() != "cpu"
 
     def _packed_step(self, B: int, L: int):
-        """Jitted wrapper (cached per batch shape) that unpacks a
-        PackedBatch buffer on device — 3-byte idx lanes via shifts, f32
-        labels via bitcast, row mask from the n_valid scalar — then runs
-        the regular unit-val field-major step. The unpack is elementwise
-        and fuses; the win is on the h2d link (see io.sparse.PackedBatch)."""
-        if not hasattr(self, "_packed_steps"):
-            self._packed_steps = {}
-        fn = self._packed_steps.get((B, L))
-        if fn is None:
-            import jax
-            import jax.numpy as jnp
-            base = self._step_fm_unit
-
-            @jax.jit
-            def fn(params, opt_state, t, buf, nv):
-                ni = B * L * 3
-                b3 = buf[:ni].reshape(B, L, 3).astype(jnp.int32)
-                idx = b3[..., 0] | (b3[..., 1] << 8) | (b3[..., 2] << 16)
-                label = jax.lax.bitcast_convert_type(
-                    buf[ni:].reshape(B, 4), jnp.float32)
-                mask = (jnp.arange(B) < nv).astype(jnp.float32)
-                return base(params, opt_state, t, idx, label, mask)
-
-            self._packed_steps[(B, L)] = fn
-        return fn
+        # module-cached on (base step, B, L): the base steps are
+        # themselves config-cached, so same-config trainers share the
+        # packed wrapper's compile too (an instance-keyed dict here undid
+        # the cross-instance sharing on the flagship packed path)
+        return _packed_wrap_cached(self._step_fm_unit, B, L)
 
     def _pad_parts_rows(self, batch: SparseBatch) -> SparseBatch:
         """Pad the batch's row count to the Pallas kernel's grid multiple
