@@ -27,7 +27,8 @@ from ..ops.fm import (ffm_row_hash, ffm_score, fm_pack_geometry, fm_score,
                       make_ffm_step, make_ffm_step_fused,
                       make_fm_score_fused, make_fm_step, make_fm_step_fused)
 from ..ops.losses import get_loss
-from ..ops.optimizers import make_optimizer
+from ..ops.optimizers import (make_optimizer,
+                              make_optimizer_cached)
 from ..utils.hashing import mhash
 from ..utils.options import OptionSpec
 from .base import LearnerBase, learner_option_spec
@@ -48,18 +49,12 @@ from functools import lru_cache as _lru_cache
 
 
 @_lru_cache(maxsize=64)
-def _optimizer_cached(opt, eta_scheme, eta0, total_steps, power_t):
-    return make_optimizer(opt, eta_scheme=eta_scheme, eta0=eta0,
-                          total_steps=total_steps, power_t=power_t,
-                          reg="no")
-
-
-@_lru_cache(maxsize=64)
 def _fm_step_fused_cached(loss_name, opt, eta_scheme, eta0, total_steps,
                           power_t, lambdas, k):
     return make_fm_step_fused(
         get_loss(loss_name),
-        _optimizer_cached(opt, eta_scheme, eta0, total_steps, power_t),
+        make_optimizer_cached(opt, eta_scheme, eta0, total_steps,
+                              power_t),
         lambdas, k)
 
 
@@ -68,7 +63,8 @@ def _fm_step_cached(loss_name, opt, eta_scheme, eta0, total_steps,
                     power_t, lambdas):
     return make_fm_step(
         get_loss(loss_name),
-        _optimizer_cached(opt, eta_scheme, eta0, total_steps, power_t),
+        make_optimizer_cached(opt, eta_scheme, eta0, total_steps,
+                              power_t),
         lambdas)
 
 
@@ -77,7 +73,8 @@ def _ffm_step_fused_cached(loss_name, opt, eta_scheme, eta0, total_steps,
                            power_t, lambdas, F, k, fieldmajor, unit_val):
     return make_ffm_step_fused(
         get_loss(loss_name),
-        _optimizer_cached(opt, eta_scheme, eta0, total_steps, power_t),
+        make_optimizer_cached(opt, eta_scheme, eta0, total_steps,
+                              power_t),
         lambdas, F, k, fieldmajor=fieldmajor, unit_val=unit_val)
 
 
@@ -86,7 +83,8 @@ def _ffm_step_cached(loss_name, opt, eta_scheme, eta0, total_steps,
                      power_t, lambdas):
     return make_ffm_step(
         get_loss(loss_name),
-        _optimizer_cached(opt, eta_scheme, eta0, total_steps, power_t),
+        make_optimizer_cached(opt, eta_scheme, eta0, total_steps,
+                              power_t),
         lambdas)
 
 
@@ -185,9 +183,9 @@ class FMTrainer(LearnerBase):
         self._loss_name = ("logloss" if self.classification
                            else (o.loss or "squaredloss"))
         self.loss = get_loss(self._loss_name)
-        self.optimizer = _optimizer_cached(str(o.opt), str(o.eta),
-                                           float(o.eta0), o.total_steps,
-                                           o.power_t)
+        self._opt_key = (str(o.opt), str(o.eta), float(o.eta0),
+                         o.total_steps, o.power_t)
+        self.optimizer = make_optimizer_cached(*self._opt_key)
         self.k = int(o.factors)
         dtype = jnp.bfloat16 if o.halffloat else jnp.float32
         key = jax.random.PRNGKey(int(o.seed))
@@ -222,8 +220,7 @@ class FMTrainer(LearnerBase):
                 "w0": self.optimizer.init(()),
                 "T": self.optimizer.init((self.Np, self.P * self.W))}
             self._step = _fm_step_fused_cached(
-                self._loss_name, str(o.opt), str(o.eta), float(o.eta0),
-                o.total_steps, o.power_t,
+                self._loss_name, *self._opt_key,
                 (o.lambda0, o.lambda_w, o.lambda_v), self.k)
             self._fused_score = _fm_score_fused_cached(self.k)
             self._tp_sizes.add(self.Np)    # mesh: shard packed rows over tp
@@ -238,8 +235,7 @@ class FMTrainer(LearnerBase):
             self.opt_state = {k: self.optimizer.init(v.shape)
                               for k, v in self.params.items()}
             self._step = _fm_step_cached(
-                self._loss_name, str(o.opt), str(o.eta), float(o.eta0),
-                o.total_steps, o.power_t,
+                self._loss_name, *self._opt_key,
                 (o.lambda0, o.lambda_w, o.lambda_v))
 
     def _convert_label(self, label: float) -> float:
@@ -436,9 +432,9 @@ class FFMTrainer(FMTrainer):
         self._loss_name = ("logloss" if self.classification
                            else (o.loss or "squaredloss"))
         self.loss = get_loss(self._loss_name)
-        self.optimizer = _optimizer_cached(str(o.opt), str(o.eta),
-                                           float(o.eta0), o.total_steps,
-                                           o.power_t)
+        self._opt_key = (str(o.opt), str(o.eta), float(o.eta0),
+                         o.total_steps, o.power_t)
+        self.optimizer = make_optimizer_cached(*self._opt_key)
         self.k = int(o.factors)
         self.F = int(o.fields)
         self.layout = str(o.ffm_table)
@@ -513,8 +509,7 @@ class FFMTrainer(FMTrainer):
             self.params = {"w0": jnp.zeros((), dtype), "T": Tinit}
             self.opt_state = {"w0": self.optimizer.init(()),
                               "T": self.optimizer.init((self.Mr, self.W))}
-            opt_key = (str(o.opt), str(o.eta), float(o.eta0),
-                       o.total_steps, o.power_t)
+            opt_key = self._opt_key
             lamt = (o.lambda0, o.lambda_w, o.lambda_v)
             self._step = _ffm_step_fused_cached(
                 self._loss_name, *opt_key, lamt, self.F, self.k,
@@ -545,8 +540,7 @@ class FFMTrainer(FMTrainer):
                                  "joint layout (-ffm_table joint, "
                                  "power-of-two -dims)")
             self._step = _ffm_step_cached(
-                self._loss_name, str(o.opt), str(o.eta), float(o.eta0),
-                o.total_steps, o.power_t,
+                self._loss_name, *self._opt_key,
                 (o.lambda0, o.lambda_w, o.lambda_v))
             self._step_fm = None
             self._step_fm_unit = None

@@ -23,12 +23,32 @@ import numpy as np
 from ..io.sparse import SparseBatch, SparseDataset
 from ..ops.linear import make_linear_predict, make_linear_step
 from ..ops.losses import get_loss
-from ..ops.optimizers import make_optimizer
+from ..ops.optimizers import make_optimizer_cached
 from .base import LearnerBase
 
 __all__ = ["GeneralClassifier", "GeneralRegressor", "LogressTrainer",
            "AdaGradLogisticTrainer", "AdaDeltaLogisticTrainer"]
 
+
+
+# config-cached step/optimizer builders (round 4 — see models/fm.py: a
+# fresh jitted closure per trainer instance re-traces/compiles for every
+# identical config; these are pure functions of the keyed options)
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=128)
+def _linear_step_cached(loss_name, opt_name, eta_scheme, eta0, total_steps,
+                        power_t, reg, lam, l1_ratio):
+    return make_linear_step(
+        get_loss(loss_name),
+        make_optimizer_cached(opt_name, eta_scheme, eta0,
+                              total_steps, power_t, reg, lam, l1_ratio))
+
+
+@_lru_cache(maxsize=1)
+def _linear_predict_cached():
+    return make_linear_predict()
 
 class _LinearLearner(LearnerBase):
     UNIT_VAL_ELISION = True      # ops.linear.make_linear_step takes val=None
@@ -43,16 +63,16 @@ class _LinearLearner(LearnerBase):
         self.loss = get_loss(self.FIXED_LOSS or o.loss)
         if self.CLASSIFICATION and not self.loss.for_classification:
             raise ValueError(f"loss {self.loss.name} is regression-only")
-        opt_name = self.FIXED_OPT or o.opt
-        self.optimizer = make_optimizer(
-            opt_name, eta_scheme=o.eta, eta0=o.eta0,
-            total_steps=o.total_steps, power_t=o.power_t,
-            reg=o.reg, lam=o["lambda"], l1_ratio=o.l1_ratio)
+        opt_name = str(self.FIXED_OPT or o.opt)
+        loss_name = str(self.FIXED_LOSS or o.loss)
+        opt_key = (opt_name, str(o.eta), float(o.eta0), o.total_steps,
+                   o.power_t, str(o.reg), o["lambda"], o.l1_ratio)
+        self.optimizer = make_optimizer_cached(*opt_key)
         dtype = jnp.bfloat16 if o.halffloat else jnp.float32
         self.w = jnp.zeros(self.dims, dtype)
         self.opt_state = self.optimizer.init(self.dims)
-        self._step = make_linear_step(self.loss, self.optimizer)
-        self._predict = make_linear_predict()
+        self._step = _linear_step_cached(loss_name, *opt_key)
+        self._predict = _linear_predict_cached()
 
     def _convert_label(self, label: float) -> float:
         if self.ZERO_ONE_LABELS:

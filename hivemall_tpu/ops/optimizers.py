@@ -229,3 +229,21 @@ def make_optimizer(name: str = "adagrad", *, eta_scheme: str = "fixed",
 
 OPTIMIZERS = ("sgd", "momentum", "nesterov", "adagrad", "adadelta", "adam",
               "adagrad_rda", "rda", "ftrl")
+
+
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=256)
+def make_optimizer_cached(opt_name, eta_scheme, eta0, total_steps, power_t,
+                          reg="no", lam=0.0, l1_ratio=0.5):
+    """Config-keyed cache over make_optimizer (round 4): Optimizer objects
+    are immutable bundles of pure closures, so identical configs can share
+    one — and more importantly, the jitted STEPS built around them become
+    shareable across trainer instances (a fresh closure per instance
+    re-traces/compiles for every identical config; measured costing
+    word2vec 4x and LDA 10x before the same fix). Callers must pass
+    hashable, consistently-coerced values."""
+    return make_optimizer(opt_name, eta_scheme=eta_scheme, eta0=eta0,
+                          total_steps=total_steps, power_t=power_t,
+                          reg=reg, lam=lam, l1_ratio=l1_ratio)
