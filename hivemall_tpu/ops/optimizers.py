@@ -235,6 +235,13 @@ from functools import lru_cache as _lru_cache
 
 
 @_lru_cache(maxsize=256)
+def _make_optimizer_cached_impl(opt_name, eta_scheme, eta0, total_steps,
+                                power_t, reg, lam, l1_ratio):
+    return make_optimizer(opt_name, eta_scheme=eta_scheme, eta0=eta0,
+                          total_steps=total_steps, power_t=power_t,
+                          reg=reg, lam=lam, l1_ratio=l1_ratio)
+
+
 def make_optimizer_cached(opt_name, eta_scheme, eta0, total_steps, power_t,
                           reg="no", lam=0.0, l1_ratio=0.5):
     """Config-keyed cache over make_optimizer (round 4): Optimizer objects
@@ -242,8 +249,11 @@ def make_optimizer_cached(opt_name, eta_scheme, eta0, total_steps, power_t,
     one — and more importantly, the jitted STEPS built around them become
     shareable across trainer instances (a fresh closure per instance
     re-traces/compiles for every identical config; measured costing
-    word2vec 4x and LDA 10x before the same fix). Callers must pass
-    hashable, consistently-coerced values."""
-    return make_optimizer(opt_name, eta_scheme=eta_scheme, eta0=eta0,
-                          total_steps=total_steps, power_t=power_t,
-                          reg=reg, lam=lam, l1_ratio=l1_ratio)
+    word2vec 4x and LDA 10x before the same fix). The key is normalized
+    HERE — types coerced, defaults applied — so call sites that spell the
+    same config differently (int vs float eta0, omitted vs explicit
+    reg defaults) converge on one cache entry instead of duplicate
+    compiles."""
+    return _make_optimizer_cached_impl(
+        str(opt_name), str(eta_scheme), float(eta0), int(total_steps),
+        float(power_t), str(reg), float(lam), float(l1_ratio))

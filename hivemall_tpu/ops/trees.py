@@ -74,15 +74,24 @@ def quantize_bins(X: np.ndarray, n_bins: int = 64
     # the per-column searchsorted loop measured 1.6-1.9 s of the 1M x 28
     # RF build — the C++ twin (OpenMP over columns) takes over when built;
     # inf padding keeps the binary search exact over the full edge rows
+    return _bin_columns(X, edges), edges
+
+
+def _bin_columns(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Code each column against its FULL inf-padded edge row (NaN sorts
+    last -> n_edges). The ONE binning rule, shared by fit (quantize_bins)
+    and raw predict (bin_raw) so their NaN routing can't diverge."""
+    d = X.shape[1]
     from hivemall_tpu.utils.native import bin_columns_native
-    ne = np.full(d, n_bins - 1, np.int32)
-    native = bin_columns_native(X, edges, ne)
+    ne = np.full(d, edges.shape[1], np.int32)
+    native = bin_columns_native(np.ascontiguousarray(X), edges, ne)
     if native is not NotImplemented:
-        return native, edges
+        return native
+    codes = np.empty(X.shape, np.uint8)
     for f in range(d):
         codes[:, f] = np.searchsorted(edges[f], X[:, f],
                                       side="left").astype(np.uint8)
-    return codes, edges
+    return codes
 
 
 @dataclass
@@ -601,13 +610,16 @@ def predict_bins(tree: Tree, bins: np.ndarray) -> np.ndarray:
 
 
 def bin_raw(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
-    """Quantize raw features with a trained tree's edges."""
+    """Quantize raw features with a trained tree's edges.
+
+    Searches the FULL inf-padded edge row — the same rule quantize_bins /
+    bin_columns_native apply at fit time — so NaN codes as n_bins-1 on both
+    sides even when duplicate quantile edges shorten the finite edge list
+    (stripping non-finite edges here coded NaN as len(finite_edges), which
+    silently routed missing values to a different branch at predict time)."""
     X = np.asarray(X, np.float32)
-    codes = np.empty(X.shape, np.uint8)
-    for f in range(X.shape[1]):
-        e = edges[f][np.isfinite(edges[f])]
-        codes[:, f] = np.searchsorted(e, X[:, f], side="left").astype(np.uint8)
-    return codes
+    edges = np.asarray(edges, np.float32)
+    return _bin_columns(X, edges)
 
 
 def predict_raw(tree: Tree, X: np.ndarray) -> np.ndarray:

@@ -484,6 +484,13 @@ def make_client_ssl_context(cafile: Optional[str] = None):
         ctx = ssl.create_default_context(cafile=cafile)
         ctx.check_hostname = False      # cluster peers connect by IP
         return ctx
+    import warnings
+    # default warnings filter dedupes by caller location — no hand flag
+    warnings.warn(
+        "-ssl without -ssl_cafile encrypts the MIX channel but does NOT "
+        "authenticate the server (an active MITM can read/alter mixed "
+        "weights); pass -ssl_cafile to pin the server certificate",
+        RuntimeWarning, stacklevel=2)
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
     ctx.check_hostname = False
     ctx.verify_mode = ssl.CERT_NONE

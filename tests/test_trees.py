@@ -190,3 +190,31 @@ def test_rf_poisson_bootstrap_converges():
     assert rmse < 0.5, rmse
     with pytest.raises(ValueError, match="exact|poisson"):
         RandomForestClassifier("-trees 2 -bootstrap wild").fit(X, y)
+
+
+def test_nan_binning_fit_predict_roundtrip():
+    """NaN must take the SAME bin code at fit time (quantize_bins /
+    bin_columns_native over the full inf-padded edge row -> n_bins-1) and
+    at raw-predict time (bin_raw). Columns with few distinct values
+    produce duplicate quantile edges, which is exactly where a truncated
+    edge search would code NaN differently (ADVICE r4 #1)."""
+    from hivemall_tpu.ops.trees import bin_raw, quantize_bins
+
+    rng = np.random.default_rng(0)
+    # col 0: only 3 distinct values -> heavy edge duplication after unique()
+    X = np.stack([rng.choice([0.0, 1.0, 2.0], 400),
+                  rng.normal(size=400)], axis=1).astype(np.float32)
+    X[::7, 0] = np.nan
+    X[::11, 1] = np.nan
+    codes, edges = quantize_bins(X, n_bins=64)
+    codes2 = bin_raw(X, edges)
+    np.testing.assert_array_equal(codes, codes2)
+    assert (codes[::7, 0] == 63).all()
+
+    # e2e: a model trained with NaNs routes the same rows to the same
+    # leaves through predict (fit-time codes vs raw-predict codes)
+    y = np.where(np.nan_to_num(X[:, 1], nan=5.0) > 0, 1, 0)
+    rf = RandomForestClassifier("-trees 5 -depth 5 -bins 32 -seed 1")
+    rf.fit(X, y)
+    acc = (rf.predict(X) == y).mean()
+    assert acc > 0.9, acc
