@@ -1,0 +1,154 @@
+"""Phase breakdown of the flagship parts step (round 5).
+
+Where do the 44 ms go?  Floors: gather ~10.7 ns + RMW ~17 ns per slot
+x 1.31M slots = 36 ms; anything above that is fwd/bwd compute, packing,
+and the kernel's opt tail — the only head-room left after
+probe_preagg.py killed duplicate pre-aggregation (85.5 ns/slot pipeline
+vs <=17 ns/slot saving).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import hivemall_tpu.ops.fm_pallas as fp
+from hivemall_tpu.ops.losses import get_loss
+
+B, L, F, K = 32768, 40, 40, 4
+dims = 1 << 24
+MRF, wp, hp = fp.parts_geometry(dims, F, K)
+FK = F * K
+loss = get_loss("logloss")
+rng = np.random.default_rng(0)
+
+
+def eta_fn(t):
+    return 0.05
+
+
+def sync(x):
+    return float(np.asarray(jnp.asarray(x).astype(jnp.float32).sum(),
+                            np.float64))
+
+
+def timeit(fn, iters=20, repeats=3):
+    sync(fn())
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        sync(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+idx = jnp.asarray(rng.integers(1, dims, (B, L)).astype(np.int32))
+lab = jnp.asarray((rng.integers(0, 2, B) * 2 - 1).astype(np.float32))
+mask = jnp.ones((B,), jnp.float32)
+T2 = jnp.asarray(rng.standard_normal((F * MRF * hp, 128)) * 0.01,
+                 jnp.bfloat16)
+S2 = jnp.zeros((F * MRF * hp, 128), jnp.float32)
+w0 = jnp.zeros((), jnp.float32)
+params = {"T2": T2, "w0": w0}
+opt_state = {"T2": {"gg": S2}, "w0": {"gg": jnp.zeros(())}}
+
+# --- full step (donating copies so the timed loop is steady-state) -----
+step = fp.make_parts_step(loss, eta_fn, (0.0, 0.0, 0.0), F, K, MRF,
+                          unit_val=True)
+state = [params, opt_state]
+
+
+def full():
+    p, s, l0 = step(state[0], state[1], 0.0, idx, lab, mask)
+    state[0], state[1] = p, s
+    return l0
+
+
+t_full = timeit(full)
+print(f"full step:            {t_full*1e3:7.2f} ms  "
+      f"-> {B/t_full/1e3:5.0f}k ex/s", flush=True)
+
+# --- gather only -------------------------------------------------------
+
+
+@jax.jit
+def gather_only(T2, idx):
+    idxT = idx.T
+    fieldT = (jnp.arange(L, dtype=jnp.int32) % F)[:, None]
+    rows = fp.parts_row_hash(idxT, fieldT, MRF)
+    T4 = T2.reshape(F, MRF, hp, 128)
+    local_rows = rows - fieldT * MRF
+    slab = jnp.stack([T4[g][local_rows[g]] for g in range(F)])
+    return slab.astype(jnp.float32).sum()
+
+
+t_g = timeit(lambda: gather_only(state[0]["T2"], idx))
+print(f"slab gather only:     {t_g*1e3:7.2f} ms", flush=True)
+
+# --- gather + fwd/bwd (no kernel, no packing) --------------------------
+
+
+@jax.jit
+def fwdbwd(T2, w0, idx, lab, mask):
+    idxT = idx.T
+    val = (idx != 0).astype(jnp.float32)
+    valT = val.T
+    fieldT = (jnp.arange(L, dtype=jnp.int32) % F)[:, None]
+    rows = fp.parts_row_hash(idxT, fieldT, MRF)
+    T4 = T2.reshape(F, MRF, hp, 128)
+    local_rows = rows - fieldT * MRF
+    slab = jnp.stack([T4[g][local_rows[g]] for g in range(F)])
+
+    def batch_loss(w0f, slabf):
+        s = slabf.reshape(L, B, wp)
+        phi = fp._phi_parts(w0f, s, valT, F, K)
+        return (loss.loss(phi, lab) * mask).sum()
+
+    ls, (g0, gslab) = jax.value_and_grad(batch_loss, argnums=(0, 1))(
+        w0.astype(jnp.float32), slab)
+    return ls + gslab.astype(jnp.float32).sum()
+
+
+t_fb = timeit(lambda: fwdbwd(state[0]["T2"], state[0]["w0"], idx, lab, mask))
+print(f"gather+fwd/bwd:       {t_fb*1e3:7.2f} ms  "
+      f"(fwd/bwd share ~{(t_fb-t_g)*1e3:.2f})", flush=True)
+
+# --- kernel only (fixed packed inputs) ---------------------------------
+chunk = min(2048, B)
+r_opt = min(1024, MRF * hp)
+kern = fp._make_scatter_opt_kernel(B, L, F, MRF, hp, chunk, r_opt, FK,
+                                   0.0, 0.0)
+gpack = jnp.asarray(rng.standard_normal((F, B * hp // 16, 16, 128)) * 1e-3,
+                    jnp.bfloat16)
+local = jnp.asarray(
+    rng.integers(0, MRF, (F, B // 128, 128)).astype(np.int32))
+eta_t = jnp.full((1, 1), 0.05, jnp.float32)
+pat = jnp.zeros((8, 128), jnp.float32)
+kstate = [state[0]["T2"], state[1]["T2"]["gg"]]
+kern_j = jax.jit(kern, donate_argnums=(5, 6))
+
+
+def kern_only():
+    Tn, Sn = kern_j(local, eta_t, pat, pat, gpack, kstate[0], kstate[1])
+    kstate[0], kstate[1] = Tn, Sn
+    return Tn[0]
+
+
+t_k = timeit(kern_only)
+print(f"pallas kernel only:   {t_k*1e3:7.2f} ms  "
+      f"(accumulate+opt tail)", flush=True)
+
+print(f"\nunaccounted (pack/transpose/w0/overlap): "
+      f"{(t_full - t_fb - t_k)*1e3:+.2f} ms")
+print(f"floors: gather {1.31e6*10.7e-9*1e3:.1f} + RMW "
+      f"{1.31e6*17e-9*1e3:.1f} = {1.31e6*27.7e-9*1e3:.1f} ms "
+      f"-> ceiling {B/(1.31e6*27.7e-9)/1e3:.0f}k ex/s")

@@ -1,0 +1,129 @@
+"""Probe: cheaper formulations of the parts-layout FFM interaction.
+
+probe_parts_phases.py split the 39.6 ms flagship step into gather 11.4 +
+fwd/bwd 12.3 + kernel 16.6.  The fwd/bwd share moves ~10 GB against a
+~5 GB lower bound — the einsum "gbfk,fbgk->b" forces a (g<->f) transpose
+of the 420 MB C tensor with a K=4 inner dim (element-granular shuffles),
+twice more in the backward.  Candidates keep the same math (phi values
+must match) with friendlier layouts.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+B, F, K = 32768, 40, 4
+FK = F * K
+wp = 256
+L = F
+rng = np.random.default_rng(0)
+
+
+def sync(x):
+    return float(np.asarray(jnp.asarray(x).astype(jnp.float32).sum(),
+                            np.float64))
+
+
+def timeit(fn, iters=20, repeats=3):
+    sync(fn())
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        sync(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+slab = jnp.asarray(rng.standard_normal((L, B, wp)) * 0.1, jnp.bfloat16)
+valT = jnp.asarray((rng.random((L, B)) > 0.0).astype(np.float32))
+lab = jnp.asarray((rng.integers(0, 2, B) * 2 - 1).astype(np.float32))
+
+
+def phi_current(s):
+    Vg = s[..., :FK].reshape(F, B, F, K)
+    wg = s[..., FK].astype(jnp.float32)
+    U = Vg * valT.reshape(F, B, 1, 1).astype(Vg.dtype)
+    C = U.reshape(F, B, F, K)
+    full = jnp.einsum("gbfk,fbgk->b", C, C,
+                      preferred_element_type=jnp.float32)
+    own = jnp.einsum("gbgk->bgk", U).astype(jnp.float32)
+    diag = (own * own).sum((1, 2))
+    return (wg * valT).sum(0) + 0.5 * (full - diag)
+
+
+def phi_kmajor(s):
+    """k-major: full[b] = sum_k <P_kb, P_kb^T> with P [F, F] on the MINOR
+    axes — the transpose is a standard small 2D minor-dim transpose."""
+    Vg = s[..., :FK].reshape(F, B, F, K)
+    wg = s[..., FK].astype(jnp.float32)
+    U = Vg * valT.reshape(F, B, 1, 1).astype(Vg.dtype)
+    P = U.transpose(3, 1, 0, 2)                    # [K, B, F(g), F(f)]
+    full = jnp.einsum("kbgf,kbfg->b", P, P,
+                      preferred_element_type=jnp.float32)
+    own = jnp.einsum("kbgg->bkg", P).astype(jnp.float32)
+    diag = (own * own).sum((1, 2))
+    return (wg * valT).sum(0) + 0.5 * (full - diag)
+
+
+def phi_premat(s):
+    """materialize the transposed C once (block-friendly axes order) and
+    use a plain elementwise-product reduction."""
+    Vg = s[..., :FK].reshape(F, B, F, K)
+    wg = s[..., FK].astype(jnp.float32)
+    U = Vg * valT.reshape(F, B, 1, 1).astype(Vg.dtype)
+    Ct = U.transpose(2, 1, 0, 3)                   # [F(f), B, F(g), K]
+    full = (U.astype(jnp.float32) * Ct.astype(jnp.float32)
+            ).sum((0, 2, 3))
+    own = jnp.einsum("gbgk->bgk", U).astype(jnp.float32)
+    diag = (own * own).sum((1, 2))
+    return (wg * valT).sum(0) + 0.5 * (full - diag)
+
+
+def phi_bmajor(s):
+    """b-major: move B to the front once (big contiguous blocks), then the
+    g<->f swap is a minor-axes transpose of [F, FK]-ish tiles."""
+    Vg = s[..., :FK].reshape(F, B, F, K)
+    wg = s[..., FK].astype(jnp.float32)
+    U = Vg * valT.reshape(F, B, 1, 1).astype(Vg.dtype)
+    Cb = U.transpose(1, 0, 2, 3)                   # [B, F(g), F(f), K]
+    full = jnp.einsum("bgfk,bfgk->b", Cb, Cb,
+                      preferred_element_type=jnp.float32)
+    own = jnp.einsum("bggk->bgk", Cb).astype(jnp.float32)
+    diag = (own * own).sum((1, 2))
+    return (wg * valT).sum(0) + 0.5 * (full - diag)
+
+
+def loss_of(phi_fn):
+    def f(s):
+        phi = phi_fn(s)
+        p = jax.nn.sigmoid(lab * phi)
+        return -(jnp.log(jnp.maximum(p, 1e-12))).sum()
+    return f
+
+
+variants = [("current", phi_current), ("kmajor", phi_kmajor),
+            ("premat", phi_premat), ("bmajor", phi_bmajor)]
+
+ref = None
+for name, fn in variants:
+    fwd = jax.jit(lambda s, fn=fn: fn(s))
+    g = jax.jit(jax.grad(loss_of(fn)))
+    out = np.asarray(fwd(slab), np.float64)
+    if ref is None:
+        ref = out
+    else:
+        err = np.max(np.abs(out - ref) / (np.abs(ref) + 1e-3))
+        assert err < 2e-2, (name, err)
+    t_f = timeit(lambda: fwd(slab))
+    t_g = timeit(lambda: g(slab))
+    print(f"{name:10s} fwd {t_f*1e3:7.2f} ms   fwd+bwd {t_g*1e3:7.2f} ms",
+          flush=True)

@@ -1,0 +1,86 @@
+"""A/B the FULL flagship parts step with the current field-major phi vs
+the b-major phi (probe_phi.py winner) — same process, same inputs, so the
+comparison survives cross-run weather."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import hivemall_tpu.ops.fm_pallas as fp
+from hivemall_tpu.ops.losses import get_loss
+
+B, L, F, K = 32768, 40, 40, 4
+dims = 1 << 24
+MRF, wp, hp = fp.parts_geometry(dims, F, K)
+loss = get_loss("logloss")
+rng = np.random.default_rng(0)
+
+
+def eta_fn(t):
+    return 0.05
+
+
+def sync(x):
+    return float(np.asarray(jnp.asarray(x).astype(jnp.float32).sum(),
+                            np.float64))
+
+
+def phi_bmajor(w0f, slab, val, F, K):
+    L, Bx = val.shape
+    m = L // F
+    FK = F * K
+    Vg = slab[..., :FK].reshape(m, F, Bx, F, K)
+    wg = slab[..., FK].astype(jnp.float32)
+    U = Vg * val.reshape(m, F, Bx, 1, 1).astype(Vg.dtype)
+    Cm = U if m == 1 else U.astype(jnp.float32).sum(0, keepdims=True)
+    Cb = Cm.reshape(F, Bx, F, K).transpose(1, 0, 2, 3)   # [B, g, f, k]
+    full = jnp.einsum("bgfk,bfgk->b", Cb, Cb,
+                      preferred_element_type=jnp.float32)
+    own = jnp.einsum("bggk->bgk", Cb).astype(jnp.float32)
+    diag = (own * own).sum((1, 2))
+    return w0f + (wg * val).sum(0) + 0.5 * (full - diag)
+
+
+def run(phi_impl, label):
+    orig = fp._phi_parts
+    if phi_impl is not None:
+        fp._phi_parts = phi_impl
+    try:
+        step = fp.make_parts_step(loss, eta_fn, (0.0, 0.0, 0.0), F, K, MRF,
+                                  unit_val=True)
+        T2 = jnp.asarray(rng.standard_normal((F * MRF * hp, 128)) * 0.01,
+                         jnp.bfloat16)
+        params = {"T2": T2, "w0": jnp.zeros((), jnp.float32)}
+        opt_state = {"T2": {"gg": jnp.zeros((F * MRF * hp, 128),
+                                            jnp.float32)},
+                     "w0": {"gg": jnp.zeros(())}}
+        idx = jnp.asarray(rng.integers(1, dims, (B, L)).astype(np.int32))
+        lab = jnp.asarray((rng.integers(0, 2, B) * 2 - 1).astype(np.float32))
+        mask = jnp.ones((B,), jnp.float32)
+        p, s, l0 = step(params, opt_state, 0.0, idx, lab, mask)
+        sync(l0)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(20):
+                p, s, l0 = step(p, s, float(i), idx, lab, mask)
+            sync(l0)
+            best = min(best, (time.perf_counter() - t0) / 20)
+        print(f"{label:12s} {best*1e3:7.2f} ms -> {B/best/1e3:5.0f}k ex/s",
+              flush=True)
+        return float(np.asarray(l0))
+    finally:
+        fp._phi_parts = orig
+
+
+l_a = run(None, "fieldmajor")
+l_b = run(phi_bmajor, "bmajor")
+print(f"loss agreement: {l_a:.6g} vs {l_b:.6g} "
+      f"(rel {abs(l_a-l_b)/abs(l_a):.2e})")
