@@ -35,7 +35,8 @@ _topic()
 
 # --- anomaly (SURVEY.md §3.11) ---------------------------------------------
 register("changefinder", "UDF", "hivemall_tpu.models.anomaly:changefinder",
-         description="SDAR outlier + change-point scores over a stream",
+         description="SDAR outlier + change-point scores over a double or "
+                     "array<double> stream",
          reference="hivemall.anomaly.ChangeFinderUDF")
 register("sst", "UDF", "hivemall_tpu.models.anomaly:sst",
          description="singular-spectrum-transform change detection",

@@ -275,18 +275,32 @@ class MFTrainer:
         # train_mf at ~750k ex/s while the step alone sustains multiples).
         # Stage each epoch's permuted columns on device ONCE and feed the
         # step device slices; the short tail reuses the row path.
-        u = np.ascontiguousarray(users, np.int32)
-        i = np.ascontiguousarray(items, np.int32)
-        r = np.ascontiguousarray(ratings, self._COL3_DTYPE)
+        # Callers may pass DEVICE arrays (jnp) to skip the h2d entirely
+        # across repeated fits — shuffling then permutes on device.
+        dev_in = not isinstance(users, np.ndarray) and hasattr(
+            users, "devices")
+        if dev_in:
+            u = jnp.asarray(users, jnp.int32)
+            i = jnp.asarray(items, jnp.int32)
+            r = jnp.asarray(ratings, self._COL3_DTYPE)
+        else:
+            u = np.ascontiguousarray(users, np.int32)
+            i = np.ascontiguousarray(items, np.int32)
+            r = np.ascontiguousarray(ratings, self._COL3_DTYPE)
         md = jnp.ones(bs, jnp.float32)
         ud = id_ = rd = None              # staged once unless shuffling
         nb = n - n % bs
         for ep in range(epochs):
             if shuffle:
                 order = rng.permutation(n)
-                uo, io_, ro = u[order], i[order], r[order]
-                ud, id_, rd = (jnp.asarray(uo), jnp.asarray(io_),
-                               jnp.asarray(ro))
+                if dev_in:
+                    oj = jnp.asarray(order.astype(np.int32))
+                    uo = io_ = ro = None        # device-side permute
+                    ud, id_, rd = u[oj], i[oj], r[oj]
+                else:
+                    uo, io_, ro = u[order], i[order], r[order]
+                    ud, id_, rd = (jnp.asarray(uo), jnp.asarray(io_),
+                                   jnp.asarray(ro))
             else:
                 uo, io_, ro = u, i, r
                 if ud is None:            # identical columns: ONE h2d
@@ -298,7 +312,14 @@ class MFTrainer:
                     ud[s:s + bs], id_[s:s + bs], rd[s:s + bs], md)
                 self._post_step(loss, bs)
             if nb < n:
-                self._dispatch(list(zip(uo[nb:], io_[nb:], ro[nb:])))
+                if uo is None or not isinstance(uo, np.ndarray):
+                    # device input: fetch ONLY the tail rows for the row
+                    # path, not the whole permuted columns
+                    tails = (np.asarray(ud[nb:]), np.asarray(id_[nb:]),
+                             np.asarray(rd[nb:]))
+                else:
+                    tails = (uo[nb:], io_[nb:], ro[nb:])
+                self._dispatch(list(zip(*tails)))
         return self
 
     # -- scoring / emission --------------------------------------------------

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.sparse import pow2_len
-from ..utils.hashing import mhash
+from ..utils.hashing import mhash, mhash_batch
 from ..utils.options import OptionSpec
 
 __all__ = ["LDATrainer", "PLSATrainer", "lda_predict", "plsa_predict"]
@@ -164,9 +164,114 @@ class LDATrainer:
             for i in order:
                 yield (k, self._vocab_names[i], float(probs[k, i]))
 
-    def fit(self, docs: Sequence[Sequence[str]]) -> "LDATrainer":
+    def _word_ids_flat(self, docs: Sequence[Sequence[str]]
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Vectorized batch form of _word_ids: ALL docs' tokens hash in one
+        mhash_batch call (the C++ murmur path that runs LIBSVM ingest at
+        700k rows/s) and per-doc aggregation is one sort + reduceat —
+        round 4 profiled the per-doc Python tokenize/hash loop at
+        ~70 us/doc, leaving the TPU idle (LDA was host-bound at 13.5k
+        docs/s). Returns (unique ids, summed counts, doc_starts); within
+        each doc the uniques come in FIRST-OCCURRENCE order, so
+        max_doc_len truncation keeps the same words _word_ids' insertion-
+        ordered dict keeps (the E-step itself is order-invariant)."""
+        # token interning: hashing / ":count" parsing / vocab-name upkeep
+        # run once per UNIQUE token — corpora repeat tokens heavily, and
+        # mhash_batch's per-string packing measured ~1 us/token while a
+        # dict intern runs the whole stream at ~0.3 us/token
+        intern: Dict[str, int] = {}
+        get = intern.setdefault
+        lens = []
+        tok: List[int] = []
         for d in docs:
-            self.process(d)
+            n0 = len(tok)
+            tok.extend(get(str(w), len(intern))
+                       for w in d if w not in (None, ""))
+            lens.append(len(tok) - n0)
+        if not tok:
+            return (np.zeros(0, np.int32), np.zeros(0, np.float32),
+                    np.zeros(len(docs) + 1, np.int64))
+        uniq = list(intern)
+        u_cts = np.ones(len(uniq), np.float32)
+        names = uniq
+        if any(":" in u for u in uniq):
+            names = list(uniq)
+            for i, u in enumerate(uniq):       # rare "word:count" tokens
+                if ":" in u:
+                    name, _, v = u.rpartition(":")
+                    if _floatable(v):
+                        names[i] = name
+                        u_cts[i] = float(v)
+        u_ids = (mhash_batch(names, self.V) - 1).astype(np.int64)
+        for i, nm in zip(u_ids, names):        # one dict op per unique
+            self._vocab_names.setdefault(int(i), nm)
+        tok_a = np.asarray(tok, np.int64)
+        ids = u_ids[tok_a]
+        cts = u_cts[tok_a]
+        # per-(doc, id) count aggregation: sort + segment reduceat
+        doc_idx = np.repeat(np.arange(len(docs), dtype=np.int64),
+                            np.asarray(lens, np.int64))
+        key = doc_idx * self.V + ids
+        order = np.argsort(key, kind="stable")
+        sk, sc = key[order], cts[order]
+        starts = np.flatnonzero(np.concatenate(
+            [[True], sk[1:] != sk[:-1]]))
+        sums = np.add.reduceat(sc, starts).astype(np.float32)
+        uk = sk[starts]
+        u_doc = uk // self.V
+        u_ids = (uk % self.V).astype(np.int32)
+        # re-order each doc's uniques by FIRST OCCURRENCE (stable sort =>
+        # positions within a group ascend, so order[starts] is the
+        # group's first original position) — max_doc_len truncation then
+        # drops the same late-appearing words the streaming dict drops,
+        # not an arbitrary hash-ordered subset
+        first_pos = order[starts]
+        ord2 = np.lexsort((first_pos, u_doc))
+        u_ids, sums, u_doc = u_ids[ord2], sums[ord2], u_doc[ord2]
+        doc_starts = np.searchsorted(u_doc, np.arange(len(docs) + 1))
+        return u_ids, sums, doc_starts
+
+    def fit(self, docs: Sequence[Sequence[str]]) -> "LDATrainer":
+        """Batch fit: vectorized tokenize/hash/aggregate + vectorized
+        batch padding — no per-doc Python on the hot path (the round-4
+        ingest loop left the chip idle at 13.5k docs/s)."""
+        B = int(self.opts.mini_batch)
+        chunk = max(B * 8, 2048)       # bound the flat token buffer
+        for s in range(0, len(docs), chunk):
+            sub = docs[s:s + chunk]
+            uids, sums, doc_starts = self._word_ids_flat(sub)
+            rl = np.minimum(np.diff(doc_starts),
+                            int(self.opts.max_doc_len)).astype(np.int64)
+            keep = np.flatnonzero(rl > 0)     # empty docs never dispatch
+            rl_k = rl[keep]
+            for b0 in range(0, len(keep), B):
+                sel = keep[b0:b0 + B]
+                rls = rl_k[b0:b0 + B]
+                n = len(sel)
+                Lp = pow2_len(int(rls.max()))
+                ids = np.zeros((B, Lp), np.int32)
+                cts = np.zeros((B, Lp), np.float32)
+                mask = np.zeros((B, Lp), np.float32)
+                rows = np.repeat(np.arange(n), rls)
+                cols = (np.arange(len(rows), dtype=np.int64)
+                        - np.repeat(np.cumsum(rls) - rls, rls))
+                src = np.repeat(doc_starts[sel], rls) + cols
+                ids[rows, cols] = uids[src]
+                cts[rows, cols] = sums[src]
+                mask[rows, cols] = 1.0
+                if n == B and not self._buf:
+                    self.lam, self._last_gamma = self._step(
+                        self.lam, float(self._t), ids, cts, mask)
+                    self._t += 1
+                else:
+                    # short tail, or a pre-existing process() buffer that
+                    # must keep its position: route through the streaming
+                    # buffer (flushing at B exactly as process() does)
+                    for b in range(n):
+                        self._buf.append((ids[b, :rls[b]].copy(),
+                                          cts[b, :rls[b]].copy()))
+                        if len(self._buf) >= B:
+                            self._flush()
         self._flush()
         return self
 

@@ -29,8 +29,8 @@ from ..utils.options import OptionSpec
 
 __all__ = ["RandomForestClassifier", "RandomForestRegressor",
            "GradientBoosting", "XGBoostClassifier", "XGBoostRegressor",
-           "XGBoostMulticlassClassifier", "tree_predict", "tree_model_meta",
-           "rf_ensemble",
+           "XGBoostMulticlassClassifier", "StagedMatrix", "tree_predict",
+           "tree_model_meta", "rf_ensemble",
            "guess_attribute_types", "serialize_tree", "deserialize_tree"]
 
 
@@ -72,7 +72,10 @@ def _rf_spec(name: str) -> OptionSpec:
     s.add("seed", type=int, default=31, help="rng seed")
     s.add("attrs", "attribute_types", default=None,
           help="comma list of Q (quantitative) / C (categorical) specs; "
-               "C columns are ordinal-binned (documented delta)")
+               "C columns with cardinality <= -bins split NOMINALLY "
+               "(one-hot membership columns — a threshold split tests "
+               "set membership, not order); higher-cardinality C columns "
+               "fall back to ordinal binning (documented delta)")
     s.add("bootstrap", default="exact",
           help="exact (reference parity: multinomial resample per tree, "
                "host-generated) | poisson (Poisson(1) streaming-bootstrap "
@@ -101,9 +104,12 @@ class _ForestBase:
         self._X.append([float(v) for v in features])
         self._y.append(label)
 
-    def fit(self, X: np.ndarray, y: np.ndarray) -> "_ForestBase":
-        self._X = list(np.asarray(X, np.float32))
-        self._y = list(y)
+    def fit(self, X, y) -> "_ForestBase":
+        # X may be a raw [n, d] array or a StagedMatrix (pre-binned,
+        # device-staged — quantize + h2d paid once across many fits)
+        self._X = X if isinstance(X, StagedMatrix) else \
+            list(np.asarray(X, np.float32))
+        self._y = np.asarray(y)
         self._train()
         return self
 
@@ -116,7 +122,41 @@ class _ForestBase:
                    float(self.oob_errors[e]))
 
     def _blob_extra(self) -> Dict:
+        if getattr(self, "_expander", None) is not None:
+            return self._expander.to_blob()
         return {}
+
+    def _features_for_train(self):
+        """(binsj, edges, n, d) with -attrs nominal expansion applied.
+        C columns (cardinality <= -bins) become one-hot membership
+        columns via CatExpander; the expander rides the model for
+        predict-time expansion and is serialized into tree blobs."""
+        o = self.opts
+        self._expander = None
+        attrs = getattr(o, "attrs", None)
+        if attrs is not None:
+            if isinstance(self._X, StagedMatrix):
+                raise ValueError(
+                    "-attrs with C columns is applied at quantize time; "
+                    "pass raw X, not a StagedMatrix")
+            X = np.asarray(self._X, np.float32)
+            is_cat = _parse_attrs(attrs, X.shape[1])
+            if any(is_cat):
+                exp = CatExpander(is_cat, X, int(o.bins))
+                if exp.active:
+                    self._expander = exp
+                    X2 = exp.transform(X)
+                    codes, edges = exp.quantize(X2, int(o.bins))
+                    import jax.numpy as jnp
+                    return (jnp.asarray(codes), edges,
+                            X2.shape[0], X2.shape[1])
+        return _staged_or_quantize(self._X, int(o.bins))
+
+    def _predict_codes(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        if getattr(self, "_expander", None) is not None:
+            X = self._expander.transform(X)
+        return bin_raw(X, self.tree.edges)
 
     def _bootstrap(self, n: int, n_trees: int, rng):
         mode = str(self.opts.bootstrap)
@@ -144,6 +184,140 @@ class _ForestBase:
         return w
 
 
+class StagedMatrix:
+    """Pre-binned, device-staged feature matrix — the xgboost-DMatrix
+    analog for every tree family. quantize_bins + the bins h2d transfer
+    are the dominant per-fit costs that do NOT depend on the model
+    (measured at 1M x 28: ~0.7 s host quantize + ~28 MB over a 5-38 MB/s
+    relay); staging pays them ONCE and every RandomForest*/XGBoost*/
+    GradientBoosting fit() accepts the staged object in place of X."""
+
+    def __init__(self, binsj, edges: np.ndarray, n_bins: int):
+        self.binsj = binsj                    # device [n, d] uint8 codes
+        self.edges = edges                    # [d, n_bins-1] f32 (host)
+        self.n_bins = int(n_bins)
+        self.shape = tuple(binsj.shape)
+
+    @classmethod
+    def stage(cls, X: np.ndarray, n_bins: int = 64) -> "StagedMatrix":
+        import jax.numpy as jnp
+        bins, edges = quantize_bins(np.asarray(X, np.float32), n_bins)
+        return cls(jnp.asarray(bins), edges, n_bins)
+
+
+def _staged_or_quantize(X, n_bins: int):
+    """(binsj, edges, n, d) from a raw array / row-list or StagedMatrix."""
+    if isinstance(X, StagedMatrix):
+        if X.n_bins != n_bins:
+            raise ValueError(
+                f"StagedMatrix was staged with n_bins={X.n_bins} but the "
+                f"trainer wants -bins {n_bins}; re-stage with the "
+                f"trainer's bin count")
+        return X.binsj, X.edges, X.shape[0], X.shape[1]
+    import jax.numpy as jnp
+    X = np.asarray(X, np.float32)
+    bins, edges = quantize_bins(X, n_bins)
+    return jnp.asarray(bins), edges, X.shape[0], X.shape[1]
+
+
+def _parse_attrs(spec: str, d: int) -> List[bool]:
+    """-attrs 'Q,C,...' -> per-column is-categorical flags."""
+    parts = [p.strip().upper() for p in str(spec).split(",")]
+    if len(parts) != d:
+        raise ValueError(f"-attrs lists {len(parts)} columns but the data "
+                         f"has {d}")
+    bad = [p for p in parts if p not in ("Q", "C")]
+    if bad:
+        raise ValueError(f"-attrs entries must be Q or C, got {bad[0]!r}")
+    return [p == "C" for p in parts]
+
+
+class CatExpander:
+    """-attrs C columns as NOMINAL features: each categorical column with
+    cardinality <= n_bins expands into one 0/1 membership column per
+    observed category, so a single threshold split IS a set-membership
+    split (value == v goes right). Ordinal binning treats categories as
+    ordered — a 'perfect' single-category split in the middle of the
+    sort order is then unreachable at depth 1 (SURVEY.md §3.9 -attrs
+    semantics; the round-4 ordinal approximation was a documented
+    delta). Categorical columns with MORE distinct values than n_bins
+    keep ordinal binning (documented fallback)."""
+
+    def __init__(self, is_cat: List[bool], X: np.ndarray, n_bins: int):
+        self.plan: List[Optional[np.ndarray]] = []
+        for j, c in enumerate(is_cat):
+            vals = None
+            if c:
+                u = np.unique(X[:, j])
+                u = u[np.isfinite(u)]
+                if 2 <= len(u) <= n_bins:
+                    vals = u.astype(np.float32)
+            self.plan.append(vals)
+
+    @property
+    def active(self) -> bool:
+        return any(v is not None for v in self.plan)
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        cols = []
+        for j, vals in enumerate(self.plan):
+            if vals is None:
+                cols.append(X[:, j:j + 1])
+            else:
+                cols.append((X[:, j:j + 1] == vals[None, :]
+                             ).astype(np.float32))
+        return np.concatenate(cols, axis=1)
+
+    def indicator_cols(self) -> np.ndarray:
+        out = []
+        k = 0
+        for vals in self.plan:
+            w = 1 if vals is None else len(vals)
+            if vals is not None:
+                out.extend(range(k, k + w))
+            k += w
+        return np.asarray(out, np.int64)
+
+    def quantize(self, X2: np.ndarray, n_bins: int):
+        """quantize_bins on the expanded matrix, with indicator columns
+        coded EXACTLY (edge row [0.5, inf...]): quantile edges of a 0/1
+        column degenerate when one side is rarer than 1/n_bins, which
+        would silently remove the membership split."""
+        codes, edges = quantize_bins(X2, n_bins)
+        ind = self.indicator_cols()
+        if len(ind):
+            row = np.full(n_bins - 1, np.inf, np.float32)
+            row[0] = 0.5
+            edges[ind] = row
+            codes[:, ind] = (X2[:, ind] > 0.5).astype(np.uint8)
+        return codes, edges
+
+    def to_blob(self) -> Dict[str, np.ndarray]:
+        cols = [j for j, v in enumerate(self.plan) if v is not None]
+        vals = ([np.zeros(0, np.float32)] +
+                [self.plan[j] for j in cols])
+        offs = np.cumsum([0] + [len(self.plan[j]) for j in cols])
+        return {"cat_cols": np.asarray(cols, np.int64),
+                "cat_vals": np.concatenate(vals).astype(np.float32),
+                "cat_offs": offs.astype(np.int64),
+                "cat_ncols": np.int64(len(self.plan))}
+
+    @classmethod
+    def from_blob(cls, extra: Dict) -> Optional["CatExpander"]:
+        if "cat_cols" not in extra:
+            return None
+        self = cls.__new__(cls)
+        ncols = int(extra["cat_ncols"])
+        plan: List[Optional[np.ndarray]] = [None] * ncols
+        offs = np.asarray(extra["cat_offs"])
+        vals = np.asarray(extra["cat_vals"], np.float32)
+        for i, j in enumerate(np.asarray(extra["cat_cols"])):
+            plan[int(j)] = vals[offs[i]:offs[i + 1]]
+        self.plan = plan
+        return self
+
+
 class RandomForestClassifier(_ForestBase):
     """SQL: train_randomforest_classifier — reference
     hivemall.smile.classification.RandomForestClassifierUDTF."""
@@ -152,20 +326,18 @@ class RandomForestClassifier(_ForestBase):
 
     def _train(self) -> None:
         o = self.opts
-        X = np.asarray(self._X, np.float32)
-        labels = np.asarray([int(v) for v in self._y])
+        labels = np.asarray(self._y).astype(np.int64)
         classes = np.unique(labels)
         self.classes_ = classes
         y = np.searchsorted(classes, labels)
-        n, d = X.shape
         C = len(classes)
-        bins, edges = quantize_bins(X, int(o.bins))
+        # one h2d; build + OOB share it (or zero h2d with a StagedMatrix)
+        binsj, edges, n, d = self._features_for_train()
         rng = np.random.default_rng(int(o.seed))
         E = int(o.trees)
         mtry = int(o["vars"]) or max(1, int(np.sqrt(d)))
         w = self._bootstrap(n, E, rng)
         import jax.numpy as jnp
-        binsj = jnp.asarray(bins)      # one h2d; build + OOB share it
         mesh = None
         if o.mesh:
             from ..parallel.mesh import make_mesh, parse_mesh_spec
@@ -194,11 +366,12 @@ class RandomForestClassifier(_ForestBase):
         self.oob_errors = [float(v) for v in np.asarray(err)]
 
     def _blob_extra(self) -> Dict:
-        return {"classes": self.classes_}
+        extra = super()._blob_extra()
+        extra["classes"] = self.classes_
+        return extra
 
     def predict_proba(self, X: np.ndarray) -> np.ndarray:
-        counts = predict_bins(self.tree, bin_raw(np.asarray(X, np.float32),
-                                                 self.tree.edges))
+        counts = predict_bins(self.tree, self._predict_codes(X))
         probs = counts / np.maximum(counts.sum(-1, keepdims=True), 1e-12)
         return probs.mean(0)
 
@@ -214,16 +387,14 @@ class RandomForestRegressor(_ForestBase):
 
     def _train(self) -> None:
         o = self.opts
-        X = np.asarray(self._X, np.float32)
         y = np.asarray(self._y, np.float32)
-        n, d = X.shape
-        bins, edges = quantize_bins(X, int(o.bins))
+        binsj, edges, n, d = self._features_for_train()
         rng = np.random.default_rng(int(o.seed))
         E = int(o.trees)
         mtry = int(o["vars"]) or max(1, d // 3)
         w = self._bootstrap(n, E, rng)
         self.tree = build_tree_regressor(
-            bins, y, w, edges, depth=int(o.depth), n_bins=int(o.bins),
+            binsj, y, w, edges, depth=int(o.depth), n_bins=int(o.bins),
             mtry=mtry, min_split=float(o.min_split),
             min_leaf=float(o.min_leaf), seed=int(o.seed), n_trees=E)
         # per-tree OOB MSE ON DEVICE (same pattern as the classifier):
@@ -231,7 +402,7 @@ class RandomForestRegressor(_ForestBase):
         # counts would re-pay the h2d the -bootstrap poisson flag saves
         import jax.numpy as jnp
         from hivemall_tpu.ops.trees import predict_bins_device
-        preds = predict_bins_device(self.tree, jnp.asarray(bins))[..., 0]
+        preds = predict_bins_device(self.tree, binsj)[..., 0]
         wj = jnp.asarray(w)
         yj = jnp.asarray(y)
         oob = wj == 0
@@ -241,8 +412,7 @@ class RandomForestRegressor(_ForestBase):
         self.oob_errors = [float(v) for v in np.asarray(mse)]
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        vals = predict_bins(self.tree, bin_raw(np.asarray(X, np.float32),
-                                               self.tree.edges))[..., 0]
+        vals = predict_bins(self.tree, self._predict_codes(X))[..., 0]
         return vals.mean(0)
 
 
@@ -317,20 +487,18 @@ class GradientBoosting:
                 "multi:softmax is the multiclass trainer's objective — use "
                 "XGBoostMulticlassClassifier "
                 "(train_multiclass_xgboost_classifier)")
-        X = np.asarray(X, np.float32)
         y = np.asarray(y, np.float32)
         if self.objective == "binary:logistic":
             y = (y > 0).astype(np.float32)
-        n, d = X.shape
         self.eta = float(o.eta)
-        bins, edges = quantize_bins(X, int(o.bins))
+        binsj, edges, n, d = _staged_or_quantize(X, int(o.bins))
         mtry = colsample_mtry(float(o.colsample_bytree), d)
         loop = boost_loop_xgb(self.objective, int(o.num_round),
                               int(o.max_depth), int(o.bins), mtry,
                               float(o.min_child_weight), float(o["lambda"]),
                               self.eta, float(o.subsample),
                               use_pallas_default())
-        packed, _ = loop(jnp.asarray(bins), jnp.asarray(y),
+        packed, _ = loop(binsj, jnp.asarray(y),
                          self.base_score,
                          jax.random.PRNGKey(int(o.seed)))
         # the single np.asarray fetch IS the device sync (block_until_ready
@@ -383,21 +551,19 @@ class XGBoostMulticlassClassifier(GradientBoosting):
         import jax
         import jax.numpy as jnp
         o = self.opts
-        X = np.asarray(X, np.float32)
-        labels = np.asarray([int(v) for v in y])
+        labels = np.asarray(y).astype(np.int64)
         self.classes_ = np.unique(labels)
         yc = np.searchsorted(self.classes_, labels)
         C = len(self.classes_)
-        n, d = X.shape
         self.eta = float(o.eta)
-        bins, edges = quantize_bins(X, int(o.bins))
+        binsj, edges, n, d = _staged_or_quantize(X, int(o.bins))
         mtry = colsample_mtry(float(o.colsample_bytree), d)
         loop = boost_loop_xgb("multi:softmax", int(o.num_round),
                               int(o.max_depth), int(o.bins), mtry,
                               float(o.min_child_weight), float(o["lambda"]),
                               self.eta, float(o.subsample),
                               use_pallas_default(), n_class=C)
-        packed, _ = loop(jnp.asarray(bins),
+        packed, _ = loop(binsj,
                          jnp.asarray(yc.astype(np.float32)), 0.0,
                          jax.random.PRNGKey(int(o.seed)))
         packed = np.asarray(packed)          # one fetch for all R x C trees
@@ -446,8 +612,11 @@ def tree_predict(model_blob: str, features: Sequence[float],
     """SQL: tree_predict(model, features[, classification]) — reference
     hivemall.smile.tools.TreePredictUDF (StackMachine VM -> gather walk)."""
     tree, extra = deserialize_tree(model_blob)
-    out = predict_bins(tree, bin_raw(np.asarray([features], np.float32),
-                                     tree.edges))[0, 0]
+    X = np.asarray([features], np.float32)
+    exp = CatExpander.from_blob(extra)
+    if exp is not None:
+        X = exp.transform(X)
+    out = predict_bins(tree, bin_raw(X, tree.edges))[0, 0]
     if "eta" in extra:               # boosting tree: raw leaf value
         if "cls" in extra:           # multiclass softmax: (class, leaf) so
             # the SQL pattern GROUP BY rowid, cls / sum(leaf) / argmax works

@@ -481,13 +481,17 @@ def make_fm_step_fused(loss: Loss, optimizer: Optimizer,
     the optimizer, which factor trainers always use: -lambda* L2 is
     applied per-occurrence at slab level here instead). Duplicate-id
     accumulation inside the batch is handled by the scatter-add in
-    sparse_update exactly as before."""
-    lam0, lam_w, lam_v = lambdas
+    sparse_update exactly as before.
+
+    lambdas=None builds the DYNAMIC-lambda variant: the step takes a
+    trailing `lams` [3] array (lam0, lam_w, lam_v) so train_fm's -adareg
+    can adapt regularization per epoch without a recompile per value."""
+    dyn = lambdas is None
     assert optimizer.sparse_update is not None
     Wf, P = fm_pack_geometry(K)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, t, idx, val, label, row_mask):
+    def body(params, opt_state, t, idx, val, label, row_mask, lams):
+        lam0, lam_w, lam_v = (lams[0], lams[1], lams[2]) if dyn else lambdas
         if val is None:
             # unit-value elision (io.sparse.SparseBatch): categorical
             # batches never transfer val; rebuild it from idx on device
@@ -509,9 +513,7 @@ def make_fm_step_fused(loss: Loss, optimizer: Optimizer,
 
         # per-occurrence L2 on present entries (reference -lambda* semantics)
         pm = (val != 0).astype(jnp.float32) * row_mask[:, None]
-        lam_col = jnp.concatenate([
-            jnp.full((K,), lam_v, jnp.float32),
-            jnp.full((Wf - K,), lam_w, jnp.float32)])
+        lam_col = jnp.where(jnp.arange(Wf) < K, lam_v, lam_w)
         gslab = gslab + lam_col * slab.astype(jnp.float32) * pm[..., None]
         g0 = g0 + lam0 * w0.astype(jnp.float32)
 
@@ -526,6 +528,91 @@ def make_fm_step_fused(loss: Loss, optimizer: Optimizer,
         return ({"T": Tn, "w0": w0n.astype(w0.dtype)},
                 {"T": sT, "w0": s0}, loss_sum)
 
+    if dyn:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, t, idx, val, label, row_mask, lams):
+            return body(params, opt_state, t, idx, val, label, row_mask,
+                        lams)
+    else:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, t, idx, val, label, row_mask):
+            return body(params, opt_state, t, idx, val, label, row_mask,
+                        None)
+    return step
+
+
+def make_fm_step_minibatch(loss: Loss, optimizer: Optimizer,
+                           lambdas: Tuple[float, float, float],
+                           K: int) -> Callable:
+    """train_fm step over the packed fused table with MINIBATCH-summed
+    accumulators — the FFM joint fused step's update shape applied to FM.
+
+    Why: the per-occurrence sparse chain (make_fm_step_fused +
+    Optimizer.sparse_update) spends 5 table-row index ops per slot
+    (gather, gg scatter-add, gg re-gather, w scatter-add, + the forward
+    gather), and on this hardware index ops ARE the cost (docs/
+    PERFORMANCE.md cost model) — train_fm measured 0.47x of the per-chip
+    share while the strictly harder FFM ran 1.145x. This step does ONE
+    forward gather + ONE scatter-add of the batch gradient into a dense
+    G, then the optimizer's dense elementwise update: 2 index ops per
+    slot, plus an O(table) pass that costs ~5 ms against 819 GB/s.
+
+    Semantics delta (documented, same as the FFM fused/parts paths):
+    adaptive accumulators see the square of the SUMMED minibatch
+    gradient rather than per-occurrence squares. Per-occurrence L2 is
+    unchanged — it folds into the slab gradient BEFORE the scatter,
+    exactly like make_fm_step_fused.
+
+    lambdas=None builds the dynamic-lambda variant (trailing `lams` [3]
+    step argument) for -adareg."""
+    dyn = lambdas is None
+    Wf, P = fm_pack_geometry(K)
+
+    def body(params, opt_state, t, idx, val, label, row_mask, lams):
+        lam0, lam_w, lam_v = (lams[0], lams[1], lams[2]) if dyn else lambdas
+        if val is None:
+            val = (idx != 0).astype(jnp.float32)
+        T, w0 = params["T"], params["w0"]
+        rows, sub = idx // P, idx % P
+        slab128 = T[rows]                            # ONE 128-lane gather
+        slab = _fm_unpack(slab128, sub, Wf, P)
+
+        def batch_loss(w0f, slabf):
+            s32 = slabf.astype(jnp.float32)
+            phi = _fm_slab_phi(w0f, s32[..., K], s32[..., :K], val)
+            return (loss.loss(phi, label) * row_mask).sum()
+
+        loss_sum, (g0, gslab) = jax.value_and_grad(
+            batch_loss, argnums=(0, 1))(w0.astype(jnp.float32), slab)
+        gslab = gslab.astype(jnp.float32)
+
+        pm = (val != 0).astype(jnp.float32) * row_mask[:, None]
+        lam_col = jnp.where(jnp.arange(Wf) < K, lam_v, lam_w)
+        gslab = gslab + lam_col * slab.astype(jnp.float32) * pm[..., None]
+        g0 = g0 + lam0 * w0.astype(jnp.float32)
+
+        oh = jax.nn.one_hot(sub, P, dtype=jnp.float32)       # [B, L, P]
+        g128 = (oh[..., None] * gslab[..., None, :]).reshape(
+            *idx.shape, P * Wf)
+        G = jnp.zeros(T.shape, jnp.float32).at[rows.reshape(-1)].add(
+            g128.reshape(-1, P * Wf))                # ONE scatter-add
+        Tn, sT = optimizer.update(T.astype(jnp.float32), G,
+                                  opt_state["T"], t)
+        w0n, s0 = optimizer.update(w0.astype(jnp.float32), g0,
+                                   opt_state["w0"], t)
+        return ({"T": Tn.astype(T.dtype), "w0": w0n.astype(w0.dtype)},
+                {"T": sT, "w0": s0}, loss_sum)
+
+    if dyn:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, t, idx, val, label, row_mask, lams):
+            return body(params, opt_state, t, idx, val, label, row_mask,
+                        lams)
+    else:
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, t, idx, val, label, row_mask):
+            return body(params, opt_state, t, idx, val, label, row_mask,
+                        None)
     return step
 
 

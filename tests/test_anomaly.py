@@ -1,6 +1,7 @@
 import numpy as np
 
-from hivemall_tpu.models.anomaly import ChangeFinder, changefinder, sst
+from hivemall_tpu.models.anomaly import (ChangeFinder, ChangeFinder2D,
+                                         SDAR2D, changefinder, sst)
 
 
 def shifted_series(n1=150, n2=150, seed=0):
@@ -31,11 +32,69 @@ def test_changefinder_outlier_spike():
 
 
 def test_streaming_matches_batch():
+    """The batched scan path must agree with the sequential oracle — the
+    scan runs f32 with the warmup embedded as identity blocks, so
+    tolerance is float-level, not bitwise."""
     x = shifted_series(40, 40)
     cf = ChangeFinder(0.05, 2, 5, 5)
-    stream = [cf.update(v) for v in x]
-    batch = changefinder(x, "-r 0.05 -k 2 -T1 5 -T2 5")
-    np.testing.assert_allclose(stream, batch, rtol=1e-9)
+    stream = np.asarray([cf.update(v) for v in x])
+    batch = np.asarray(changefinder(x, "-r 0.05 -k 2 -T1 5 -T2 5"))
+    np.testing.assert_allclose(stream, batch, rtol=2e-3, atol=2e-3)
+
+
+def test_scan_matches_oracle_long_series():
+    """Longer series + default k=3: EMA contraction keeps the f32 scan
+    within tolerance of the f64 sequential oracle end to end."""
+    rng = np.random.default_rng(7)
+    x = np.concatenate([rng.normal(0, 1, 400), rng.normal(3, 1.5, 400)])
+    cf = ChangeFinder(0.02, 3, 7, 7)
+    stream = np.asarray([cf.update(v) for v in x])
+    batch = np.asarray(changefinder(x))
+    np.testing.assert_allclose(stream, batch, rtol=5e-3, atol=5e-3)
+
+
+def test_changefinder_vector_stream_2d():
+    """array<double> rows (reference ChangeFinder2D): a correlated-mean
+    shift in a 2D stream is flagged near the boundary."""
+    rng = np.random.default_rng(3)
+    a = rng.normal(0.0, 0.3, (150, 2))
+    b = rng.normal([3.0, -2.0], 0.3, (150, 2))
+    x = np.concatenate([a, b])
+    scores = changefinder(x, "-r 0.05 -k 2 -T1 5 -T2 5")
+    assert len(scores) == 300
+    cp = np.asarray([s[1] for s in scores])
+    peak = int(np.argmax(cp[30:])) + 30
+    assert 145 <= peak <= 175, peak
+    assert cp[100] < cp[peak] * 0.5
+
+
+def test_streaming_2d_matches_batch():
+    rng = np.random.default_rng(4)
+    x = np.concatenate([rng.normal(0, 0.5, (60, 3)),
+                        rng.normal(2, 0.5, (60, 3))])
+    cf = ChangeFinder2D(3, 0.05, 2, 5, 5)
+    stream = np.asarray([cf.update(v) for v in x])
+    batch = np.asarray(changefinder(x, "-r 0.05 -k 2 -T1 5 -T2 5"))
+    np.testing.assert_allclose(stream, batch, rtol=5e-3, atol=5e-3)
+
+
+def test_sdar2d_d1_matches_sdar1d():
+    """SDAR2D with d=1 must reduce to the scalar recurrence."""
+    from hivemall_tpu.models.anomaly import SDAR1D
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, 100)
+    s1 = SDAR1D(0.03, 3)
+    s2 = SDAR2D(0.03, 3, 1)
+    a = [s1.update(v) for v in x]
+    b = [s2.update(np.asarray([v])) for v in x]
+    np.testing.assert_allclose(a, b, rtol=1e-8, atol=1e-10)
+
+
+def test_changefinder_empty_and_tiny():
+    assert changefinder([]) == []
+    out = changefinder([1.0])
+    assert len(out) == 1 and np.isfinite(out[0]).all()
 
 
 def test_sst_flags_frequency_change():

@@ -479,7 +479,10 @@ def test_fm_fused_layout_matches_split():
     ds = SparseDataset.from_rows(rows, labels)
     opts = ("-dims 64 -factors 4 -classification -opt adagrad -eta fixed "
             "-eta0 0.1 -mini_batch 64 -iters 4 -sigma 0.3")
-    tf = FMTrainer(opts + " -fm_table fused")
+    # -fm_update occurrence: the split layout's sparse chain is
+    # per-occurrence AdaGrad, so the exact-match claim needs the fused
+    # layout on the same update shape (minibatch is the throughput default)
+    tf = FMTrainer(opts + " -fm_table fused -fm_update occurrence")
     tsp = FMTrainer(opts + " -fm_table split")
     tf.fit(ds)
     tsp.fit(ds)
@@ -498,6 +501,81 @@ def test_fm_fused_rejects_dense_only_optimizer():
         FMTrainer("-dims 64 -opt adam -fm_table fused")
     t = FMTrainer("-dims 64 -opt adam")          # auto falls back to split
     assert t.fm_layout == "split"
+
+
+def test_fm_adareg_increases_lambda_on_overfit():
+    """-adareg (SURVEY §3.6 train_fm row): on an overfittable task (tiny
+    sample, label noise, ample capacity) held-out loss degrades as the fit
+    memorizes -> lambda_w/lambda_v must be adapted UP from their start."""
+    rng = np.random.default_rng(0)
+    n, d = 120, 512
+    rows = [(np.sort(rng.choice(np.arange(1, d), 6, replace=False)).astype(
+        np.int32), np.ones(6, np.float32)) for _ in range(n)]
+    labels = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)  # pure noise
+    ds = SparseDataset.from_rows(rows, labels)
+    t = FMTrainer(f"-dims {d} -factors 8 -classification -opt adagrad "
+                  "-eta fixed -eta0 0.5 -mini_batch 32 -iters 8 "
+                  "-sigma 0.3 -adareg -va_ratio 0.2 "
+                  "-lambda_w 0.001 -lambda_v 0.001")
+    assert t._adareg
+    t.fit(ds)
+    # noise labels: validation loss trends worse as training memorizes
+    assert t._lams[1] > 0.001 and t._lams[2] > 0.001, t._lams
+
+    # option validation
+    with pytest.raises(ValueError, match="va_ratio"):
+        FMTrainer("-dims 64 -adareg -va_ratio 0.9")
+    with pytest.raises(ValueError, match="adareg"):
+        FMTrainer("-dims 64 -opt ftrl -adareg")
+
+
+def test_fm_adareg_matches_static_when_never_adapted():
+    """Epoch 1 runs on the initial lambdas; with -iters 1 the adareg path
+    (dynamic-lambda step + holdout) must train the same model the static
+    step trains on the same rows."""
+    rows, _, labels = _xor_dataset(200)
+    ds = SparseDataset.from_rows(rows, labels)
+    opts = ("-dims 64 -factors 4 -classification -opt adagrad -eta fixed "
+            "-eta0 0.1 -mini_batch 64 -iters 1 -sigma 0.3")
+    ta = FMTrainer(opts + " -adareg -va_ratio 0.1")
+    ta.fit(ds)
+    # same split, same seed: rebuild the training subset and fit static
+    rng = np.random.default_rng(42)
+    perm = rng.permutation(len(ds))
+    n_va = max(1, int(round(len(ds) * 0.1)))
+    labels_conv = np.where(np.asarray(labels) > 0, 1.0, -1.0)
+    ds_conv = SparseDataset(ds.indices, ds.indptr, ds.values,
+                            labels_conv, ds.fields)
+    ds_tr = ds_conv.take(perm[n_va:])
+    ts = FMTrainer(opts)
+    ts._fit_epochs(ds_tr, 1, 64, True, None, None, seed0=42)
+    np.testing.assert_allclose(np.asarray(ta.params["T"], np.float32),
+                               np.asarray(ts.params["T"], np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fm_minibatch_update_converges_like_occurrence():
+    """-fm_update minibatch (one scatter into dense G + dense AdaGrad, the
+    FFM fused paths' accumulator semantics) is the adagrad default; it
+    must reach the same solution quality as the per-occurrence chain and
+    stay close in function space."""
+    rows, _, labels = _xor_dataset(600)
+    ds = SparseDataset.from_rows(rows, labels)
+    opts = ("-dims 64 -factors 4 -classification -opt adagrad -eta fixed "
+            "-eta0 0.1 -mini_batch 64 -iters 4 -sigma 0.3")
+    tm = FMTrainer(opts)
+    assert tm.fm_layout == "fused"
+    to = FMTrainer(opts + " -fm_update occurrence")
+    tm.fit(ds)
+    to.fit(ds)
+    y = np.asarray(labels)
+    assert auc(y, tm.predict(ds)) > 0.95
+    # same optimization problem, mildly different adaptive scaling:
+    # predictions agree in rank almost everywhere
+    am, ao = tm.predict(ds), to.predict(ds)
+    assert np.corrcoef(am, ao)[0, 1] > 0.98
+    with pytest.raises(ValueError, match="minibatch"):
+        FMTrainer("-dims 64 -opt sgd -fm_update minibatch")
 
 
 def test_fm_fused_unit_val_elision():
@@ -652,6 +730,50 @@ def test_ffm_device_replay_cache_multi_epoch():
     c.fit(ds, epochs=3, shuffle=True, prefetch=False)
     assert c._examples == 3 * n
     assert np.isfinite(c.cumulative_loss)
+
+
+def test_ffm_fit_stream_replay_cache_multi_epoch():
+    """fit_stream with an epoch factory: epoch 1 streams + retains the
+    staged device buffers, epochs >= 2 replay on device — bit-equal to
+    re-streaming the same epochs when replay_shuffle=False (VERDICT r4
+    weak #5: the out-of-core path re-paid the link every epoch)."""
+    import numpy as np
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.models.fm import FFMTrainer
+
+    B, L, F, K, dims, n = 128, 8, 8, 4, 1 << 20, 520
+    rng = np.random.default_rng(12)
+    idx = rng.integers(1, dims, (n, L)).astype(np.int32)
+    fld = np.tile(np.arange(L, dtype=np.int32), (n, 1))
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    indptr = np.arange(0, n * L + 1, L, dtype=np.int64)
+    ds = SparseDataset(idx.ravel(), indptr, np.ones(n * L, np.float32),
+                       lab, fld.ravel())
+    cfg = (f"-dims {dims} -factors {K} -fields {F} -mini_batch {B} "
+           "-opt adagrad -classification -halffloat -seed 5 "
+           "-pack_input on")
+
+    def factory():
+        return ds.batches(B, shuffle=False)
+
+    a = FFMTrainer(cfg)
+    a.fit_stream(factory, epochs=3, replay_shuffle=False)
+    # uncached reference: identical epochs, streamed each time
+    b = FFMTrainer(cfg.replace("-pack_input on", "-pack_input off"))
+    for _ in range(3):
+        b.fit_stream(factory())
+    for k2 in a.params:
+        np.testing.assert_array_equal(
+            np.asarray(a.params[k2], np.float32),
+            np.asarray(b.params[k2], np.float32), err_msg=k2)
+    assert a._examples == b._examples == 3 * n
+
+    # iterable + epochs>1 is a usage error; factory with epochs=1 works
+    with pytest.raises(ValueError, match="factory"):
+        FFMTrainer(cfg).fit_stream(factory(), epochs=2)
+    c = FFMTrainer(cfg)
+    c.fit_stream(factory, epochs=1)
+    assert c._examples == n
 
 
 def test_step_builders_shared_across_instances():

@@ -56,3 +56,29 @@ def test_udtf_lifecycle():
         t.process(["a", "b", "a"])
     rows = list(t.close())
     assert rows and len(rows[0]) == 3
+
+
+def test_lda_batch_fit_matches_streaming_process():
+    """fit()'s vectorized ingest (intern + mhash_batch + sort/reduceat +
+    vectorized padding) must produce the same model as per-doc process()
+    — including ':count' tokens, empty docs, and the short-tail buffer."""
+    import numpy as np
+
+    from hivemall_tpu.models.topicmodel import LDATrainer
+
+    rng = np.random.default_rng(3)
+    vocab = [f"w{i}" for i in range(25)]
+    docs = [[vocab[j] for j in rng.integers(0, 25, 12)] + ["heavy:2.5"]
+            for _ in range(40)] + [[], ["w1", "w1", "w2"]]
+    a = LDATrainer("-topics 2 -mini_batch 16").fit(docs)
+    b = LDATrainer("-topics 2 -mini_batch 16")
+    for d in docs:
+        b.process(d)
+    b._flush()
+    la, lb = np.asarray(a.lam), np.asarray(b.lam)
+    np.testing.assert_allclose(la, lb, rtol=5e-4, atol=5e-4)
+    assert a._t == b._t and len(a._buf) == len(b._buf)
+    # vocab names flow through for close() emission
+    rows_a = sorted(set(w for _, w, _ in a.close(top_n=5)))
+    rows_b = sorted(set(w for _, w, _ in b.close(top_n=5)))
+    assert rows_a == rows_b

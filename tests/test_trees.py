@@ -218,3 +218,87 @@ def test_nan_binning_fit_predict_roundtrip():
     rf.fit(X, y)
     acc = (rf.predict(X) == y).mean()
     assert acc > 0.9, acc
+
+
+def test_staged_matrix_fits_match_raw():
+    """StagedMatrix (pre-binned device-staged X, the DMatrix analog) must
+    train the same models as raw-X fits for RF, GBT, and multiclass —
+    same seeds, same bins => identical trees."""
+    from hivemall_tpu.models.trees import StagedMatrix, XGBoostMulticlassClassifier
+
+    X, y = two_moons_ish(500, seed=4)
+    Xs = StagedMatrix.stage(X, 32)
+    a = RandomForestClassifier("-trees 6 -depth 5 -bins 32 -seed 3").fit(X, y)
+    b = RandomForestClassifier("-trees 6 -depth 5 -bins 32 -seed 3").fit(Xs, y)
+    np.testing.assert_array_equal(a.tree.feat, b.tree.feat)
+    np.testing.assert_allclose(a.tree.thr, b.tree.thr)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+    Xs64 = StagedMatrix.stage(X, 64)
+    ga = XGBoostClassifier("-num_round 4 -max_depth 4 -seed 5").fit(X, y)
+    gb = XGBoostClassifier("-num_round 4 -max_depth 4 -seed 5").fit(Xs64, y)
+    np.testing.assert_allclose(ga.decision_function(X),
+                               gb.decision_function(X), rtol=1e-6)
+
+    rng = np.random.default_rng(2)
+    Xm = rng.normal(size=(300, 4)).astype(np.float32)
+    ym = rng.integers(0, 3, 300)
+    ma = XGBoostMulticlassClassifier("-num_round 3 -max_depth 3").fit(Xm, ym)
+    mb = XGBoostMulticlassClassifier("-num_round 3 -max_depth 3").fit(
+        StagedMatrix.stage(Xm, 64), ym)
+    np.testing.assert_array_equal(ma.predict(Xm), mb.predict(Xm))
+
+    rr = RandomForestRegressor("-trees 4 -depth 4 -bins 32")
+    yr = X[:, 0].astype(np.float32)
+    ra = RandomForestRegressor("-trees 4 -depth 4 -bins 32").fit(X, yr)
+    rb = RandomForestRegressor("-trees 4 -depth 4 -bins 32").fit(Xs, yr)
+    np.testing.assert_allclose(ra.predict(X), rb.predict(X), rtol=1e-6)
+
+    with pytest.raises(ValueError, match="n_bins"):
+        RandomForestClassifier("-trees 2 -bins 64").fit(Xs, y)   # staged 32
+
+
+def test_nominal_categorical_split_beats_ordinal():
+    """-attrs C (SURVEY §3.9): y = [x2 == 30] with category 30 in the
+    MIDDLE of the value order. A depth-1 ordinal threshold can only cut
+    the order into a prefix/suffix (best acc ~0.8 here); the nominal
+    one-hot membership column makes the perfect split reachable in one
+    level. The expander must ride predict AND serialized tree blobs."""
+    from hivemall_tpu.models.trees import tree_predict
+
+    rng = np.random.default_rng(0)
+    n = 600
+    cats = rng.choice([10.0, 20.0, 30.0, 40.0, 50.0], n)
+    noise = rng.normal(size=n).astype(np.float32)
+    X = np.stack([noise, cats], axis=1).astype(np.float32)
+    y = (cats == 30.0).astype(int)
+
+    ordinal = RandomForestClassifier(
+        "-trees 5 -depth 1 -bins 32 -seed 3 -vars 2").fit(X, y)
+    acc_ord = (ordinal.predict(X) == y).mean()
+    assert acc_ord < 0.99, acc_ord         # prefix cut can't isolate {30}
+
+    nominal = RandomForestClassifier(
+        "-trees 5 -depth 1 -bins 32 -seed 3 -vars 6 -attrs Q,C").fit(X, y)
+    acc_nom = (nominal.predict(X) == y).mean()
+    assert acc_nom == 1.0, acc_nom
+
+    # serialized blob round trip carries the expander
+    blob = next(iter(nominal.close()))[1]
+    row = [0.3, 30.0]
+    assert tree_predict(blob, row, True) == 1
+    assert tree_predict(blob, [0.3, 40.0], True) == 0
+
+    # regressor path + validation errors
+    yr = np.where(cats == 30.0, 5.0, -1.0).astype(np.float32)
+    rr = RandomForestRegressor(
+        "-trees 4 -depth 1 -bins 32 -vars 6 -attrs Q,C").fit(X, yr)
+    rmse = float(np.sqrt(np.mean((rr.predict(X) - yr) ** 2)))
+    assert rmse < 0.2, rmse
+
+    with pytest.raises(ValueError, match="attrs"):
+        RandomForestClassifier("-trees 2 -attrs Q").fit(X, y)
+    from hivemall_tpu.models.trees import StagedMatrix
+    with pytest.raises(ValueError, match="StagedMatrix"):
+        RandomForestClassifier("-trees 2 -attrs Q,C").fit(
+            StagedMatrix.stage(X, 64), y)
