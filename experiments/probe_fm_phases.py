@@ -1,0 +1,110 @@
+"""Phase breakdown of the round-5 train_fm minibatch step (411k ex/s =
+79.7 ms at B=32k, L=32, K=8, dims=2^24): where do the ~34 ms above the
+gather+scatter floor go?"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+B, L, K = 32768, 32, 8
+dims = 1 << 24
+P, Wf = 8, 16
+Np = dims // P
+rng = np.random.default_rng(0)
+idx = jnp.asarray(rng.integers(1, dims, (B, L)).astype(np.int32))
+T = jnp.asarray(rng.standard_normal((Np, 128)) * 0.01, jnp.bfloat16)
+S = jnp.zeros((Np, 128), jnp.float32)
+lab = jnp.asarray((rng.integers(0, 2, B) * 2 - 1).astype(np.float32))
+
+
+def sync(x):
+    return float(np.asarray(jnp.asarray(x).astype(jnp.float32).sum()))
+
+
+def timeit(fn, iters=10):
+    sync(fn())
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        sync(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+rows = idx // P
+
+
+@jax.jit
+def gather(T, idx):
+    return T[idx // P].astype(jnp.float32).sum()
+
+
+print(f"gather:         {timeit(lambda: gather(T, idx))*1e3:7.2f} ms",
+      flush=True)
+
+g128 = jnp.asarray(rng.standard_normal((B, L, 128)) * 1e-3, jnp.float32)
+
+
+@jax.jit
+def scat(g, rows):
+    return jnp.zeros((Np, 128), jnp.float32).at[rows.reshape(-1)].add(
+        g.reshape(-1, 128)).sum()
+
+
+print(f"scatter-add:    {timeit(lambda: scat(g128, rows))*1e3:7.2f} ms",
+      flush=True)
+
+
+@jax.jit
+def dense(T, S, G):
+    gg = S + G * G
+    Tn = T.astype(jnp.float32) - 0.1 * G / (jnp.sqrt(gg) + 1e-6)
+    return Tn.astype(jnp.bfloat16).sum()
+
+
+G = jnp.zeros((Np, 128), jnp.float32)
+print(f"dense adagrad:  {timeit(lambda: dense(T, S, G))*1e3:7.2f} ms",
+      flush=True)
+
+from hivemall_tpu.ops.fm import _fm_slab_phi, _fm_unpack  # noqa: E402
+from hivemall_tpu.ops.losses import get_loss  # noqa: E402
+
+loss = get_loss("logloss")
+
+
+@jax.jit
+def fwdbwd(T, idx, lab):
+    rows, sub = idx // P, idx % P
+    slab = _fm_unpack(T[rows], sub, Wf, P)
+
+    def bl(s):
+        s32 = s.astype(jnp.float32)
+        phi = _fm_slab_phi(0.0, s32[..., K], s32[..., :K],
+                           jnp.ones((B, L)))
+        return (loss.loss(phi, lab)).sum()
+
+    return jax.grad(bl)(slab).sum()
+
+
+print(f"gather+fwd/bwd: {timeit(lambda: fwdbwd(T, idx, lab))*1e3:7.2f} ms",
+      flush=True)
+
+gslab = jnp.asarray(rng.standard_normal((B, L, Wf)), jnp.float32)
+
+
+@jax.jit
+def onehot_expand(gslab, sub):
+    oh = jax.nn.one_hot(sub, P, dtype=jnp.float32)
+    return (oh[..., None] * gslab[..., None, :]).reshape(B, L, P * Wf).sum()
+
+
+print(f"one-hot expand: "
+      f"{timeit(lambda: onehot_expand(gslab, idx % P))*1e3:7.2f} ms",
+      flush=True)

@@ -348,6 +348,19 @@ def changefinder(series, options: str = "") -> List[Tuple[float, float]]:
     if T == 0:
         return []
     pad = _bucket(T)
+    # memory guard: the batched path holds O(bucket * (k*d)^2) f32 for
+    # the Yule-Walker systems (plus the [T, k, k, d, d] block build) —
+    # fine for the scalar/small-d streams it was built for, but a wide
+    # vector stream would allocate gigabytes. Route those through the
+    # O(k^2 d^2)-memory streaming oracle instead (identical math).
+    k = int(ns.k)
+    batch_bytes = pad * ((k * d) ** 2 * 3 + (k + 1) * d * d * 4) * 4
+    if batch_bytes > (256 << 20):
+        if d == 1:
+            cf = ChangeFinder(float(ns.r), k, int(ns.T1), int(ns.T2))
+            return [cf.update(float(v[0])) for v in x]
+        cf2 = ChangeFinder2D(d, float(ns.r), k, int(ns.T1), int(ns.T2))
+        return [cf2.update(v) for v in x]
     xp = np.zeros((pad, d), np.float32)
     xp[:T] = x
     run = _changefinder_jit(float(ns.r), int(ns.k), int(ns.T1),

@@ -14,6 +14,7 @@ M3); see parallel.dp / __graft_entry__.dryrun_multichip.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -360,8 +361,19 @@ class FMTrainer(LearnerBase):
         ds_tr = ds.take(perm[n_va:])
         prev = None
         for ep in range(epochs):
-            super()._fit_epochs(ds_tr, 1, bs, shuffle, prefetch, ckdir,
+            # ckdir handled HERE so bundle names carry the REAL epoch
+            # number (the inner call's local epoch is always 1)
+            super()._fit_epochs(ds_tr, 1, bs, shuffle, prefetch, None,
                                 seed0=seed0 + ep)
+            if ckdir:
+                from ..utils.metrics import get_stream
+                os.makedirs(ckdir, exist_ok=True)
+                path = os.path.join(ckdir, f"{self.NAME}-ep{ep + 1}.npz")
+                self.save_bundle(path)
+                stream = get_stream()
+                if stream.enabled:
+                    stream.emit("checkpoint", trainer=self.NAME,
+                                epoch=ep + 1, path=path)
             va = self._mean_loss(ds_va)
             if prev is not None:
                 scale = (self._ADAREG_UP if va > prev * (1 + 1e-9)

@@ -136,9 +136,12 @@ class _ForestBase:
         attrs = getattr(o, "attrs", None)
         if attrs is not None:
             if isinstance(self._X, StagedMatrix):
-                raise ValueError(
-                    "-attrs with C columns is applied at quantize time; "
-                    "pass raw X, not a StagedMatrix")
+                is_cat = _parse_attrs(attrs, self._X.shape[1])
+                if any(is_cat):
+                    raise ValueError(
+                        "-attrs with C columns is applied at quantize "
+                        "time; pass raw X, not a StagedMatrix")
+                return _staged_or_quantize(self._X, int(o.bins))
             X = np.asarray(self._X, np.float32)
             is_cat = _parse_attrs(attrs, X.shape[1])
             if any(is_cat):

@@ -441,12 +441,18 @@ def fm_pack_geometry(K: int) -> Tuple[int, int]:
 
 
 def _fm_unpack(slab128, sub, Wf: int, P: int):
-    """Select each slot's [Wf] block out of its packed [P*Wf] row (VPU
-    select over the small static P axis, not a gather)."""
+    """Select each slot's [Wf] block out of its packed [P*Wf] row as a
+    one-hot masked SUM over the small static P axis — pure VPU work.
+    take_along_axis here lowered to a REAL per-slot XLA gather (and its
+    adjoint to a per-slot scatter): measured ~27 ms of fwd/bwd at
+    B=32k x L=32 (experiments/probe_fm_phases.py), i.e. a second pair of
+    table-row index ops per slot hidden inside the step. The masked sum
+    is exact (7 of the P=8 addends are true zeros; one-hot is exact in
+    bf16) and its adjoint is a broadcast multiply, not a scatter."""
     B, L = sub.shape
     blocks = slab128.reshape(B, L, P, Wf)
-    return jnp.take_along_axis(blocks, sub[..., None, None],
-                               axis=2)[:, :, 0, :]
+    oh = jax.nn.one_hot(sub, P, dtype=blocks.dtype)
+    return (blocks * oh[..., None]).sum(2)
 
 
 def make_fm_score_fused(K: int):
@@ -500,27 +506,29 @@ def make_fm_step_fused(loss: Loss, optimizer: Optimizer,
         T, w0 = params["T"], params["w0"]
         rows, sub = idx // P, idx % P
         slab128 = T[rows]                            # ONE 128-lane gather
-        slab = _fm_unpack(slab128, sub, Wf, P)
 
-        def batch_loss(w0f, slabf):
-            s32 = slabf.astype(jnp.float32)
-            phi = _fm_slab_phi(w0f, s32[..., K], s32[..., :K], val)
-            return (loss.loss(phi, label) * row_mask).sum()
-
-        loss_sum, (g0, gslab) = jax.value_and_grad(
-            batch_loss, argnums=(0, 1))(w0.astype(jnp.float32), slab)
-        gslab = gslab.astype(jnp.float32)
-
-        # per-occurrence L2 on present entries (reference -lambda* semantics)
+        # differentiate wrt the PACKED rows (see make_fm_step_minibatch:
+        # the masked-sum unpack's adjoint IS the one-hot expansion), with
+        # per-occurrence L2 as the same zero-valued autodiff term
         pm = (val != 0).astype(jnp.float32) * row_mask[:, None]
         lam_col = jnp.where(jnp.arange(Wf) < K, lam_v, lam_w)
-        gslab = gslab + lam_col * slab.astype(jnp.float32) * pm[..., None]
+
+        def batch_loss(w0f, s128):
+            slab = _fm_unpack(s128, sub, Wf, P).astype(jnp.float32)
+            phi = _fm_slab_phi(w0f, slab[..., K], slab[..., :K], val)
+            data = (loss.loss(phi, label) * row_mask).sum()
+            if dyn or lam_w or lam_v:
+                s2 = slab * slab
+                data = data + 0.5 * jnp.sum(
+                    lam_col * pm[..., None]
+                    * (s2 - jax.lax.stop_gradient(s2)))
+            return data
+
+        loss_sum, (g0, g128) = jax.value_and_grad(
+            batch_loss, argnums=(0, 1))(w0.astype(jnp.float32), slab128)
+        g128 = g128.astype(jnp.float32)
         g0 = g0 + lam0 * w0.astype(jnp.float32)
 
-        # expand each slot's [Wf] grad into its packed row: one-hot over P
-        oh = jax.nn.one_hot(sub, P, dtype=jnp.float32)       # [B, L, P]
-        g128 = (oh[..., None] * gslab[..., None, :]).reshape(
-            *idx.shape, P * Wf)
         Tn, sT = optimizer.sparse_update(
             T, g128.reshape(-1, P * Wf), opt_state["T"], rows.ravel(), t)
         w0n, s0 = optimizer.update(w0.astype(jnp.float32), g0,
@@ -575,25 +583,37 @@ def make_fm_step_minibatch(loss: Loss, optimizer: Optimizer,
         T, w0 = params["T"], params["w0"]
         rows, sub = idx // P, idx % P
         slab128 = T[rows]                            # ONE 128-lane gather
-        slab = _fm_unpack(slab128, sub, Wf, P)
 
-        def batch_loss(w0f, slabf):
-            s32 = slabf.astype(jnp.float32)
-            phi = _fm_slab_phi(w0f, s32[..., K], s32[..., :K], val)
-            return (loss.loss(phi, label) * row_mask).sum()
-
-        loss_sum, (g0, gslab) = jax.value_and_grad(
-            batch_loss, argnums=(0, 1))(w0.astype(jnp.float32), slab)
-        gslab = gslab.astype(jnp.float32)
-
+        # differentiate wrt the PACKED rows: _fm_unpack's masked-sum
+        # adjoint IS the one-hot expansion, so g128 arrives fused — no
+        # separate expand pass and no hidden per-slot gather/scatter
+        # (probe_fm_phases.py: take_along_axis + manual expand cost
+        # ~38 ms of the 80 ms step)
         pm = (val != 0).astype(jnp.float32) * row_mask[:, None]
         lam_col = jnp.where(jnp.arange(Wf) < K, lam_v, lam_w)
-        gslab = gslab + lam_col * slab.astype(jnp.float32) * pm[..., None]
+
+        def batch_loss(w0f, s128):
+            slab = _fm_unpack(s128, sub, Wf, P).astype(jnp.float32)
+            phi = _fm_slab_phi(w0f, slab[..., K], slab[..., :K], val)
+            data = (loss.loss(phi, label) * row_mask).sum()
+            # per-occurrence L2 on the occupied block THROUGH autodiff:
+            # 0.5*lam*pm*(slab^2 - sg(slab^2)) has value exactly 0 and
+            # gradient lam*pm*slab — folded into the same backward pass
+            # instead of a separate masked multiply chain over the
+            # [B, L, 128] packed grad (the one-hot mask rides the unpack
+            # adjoint, so sibling blocks get exact zeros)
+            if dyn or lam_w or lam_v:
+                s2 = slab * slab
+                data = data + 0.5 * jnp.sum(
+                    lam_col * pm[..., None]
+                    * (s2 - jax.lax.stop_gradient(s2)))
+            return data
+
+        loss_sum, (g0, g128) = jax.value_and_grad(
+            batch_loss, argnums=(0, 1))(w0.astype(jnp.float32), slab128)
+        g128 = g128.astype(jnp.float32)
         g0 = g0 + lam0 * w0.astype(jnp.float32)
 
-        oh = jax.nn.one_hot(sub, P, dtype=jnp.float32)       # [B, L, P]
-        g128 = (oh[..., None] * gslab[..., None, :]).reshape(
-            *idx.shape, P * Wf)
         G = jnp.zeros(T.shape, jnp.float32).at[rows.reshape(-1)].add(
             g128.reshape(-1, P * Wf))                # ONE scatter-add
         Tn, sT = optimizer.update(T.astype(jnp.float32), G,

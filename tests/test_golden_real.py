@@ -12,6 +12,8 @@ Expected drop-ins (reference quality baselines in parentheses):
   real/a9a.t          LIBSVM test
   real/news20.binary  LIBSVM        (AUC ~0.97 on a held-out tail split)
   real/ml-100k.tsv    user \t item \t rating (MF RMSE < 1.0 @2 epochs)
+  real/text8          unzipped text8 corpus (word2vec similarity sanity:
+                      related pairs beat unrelated on >= 75%)
 """
 
 import os
@@ -85,3 +87,52 @@ def test_movielens_real_mf_rmse():
     t.fit(u[:cut], i[:cut], r[:cut], epochs=2)
     pred = t.predict(u[cut:], i[cut:])
     assert rmse(r[cut:], pred) < 1.0
+
+
+def test_text8_real_word2vec_similarity():
+    """BASELINE config #4 quality side (VERDICT r4 weak #7): drop the
+    text8 corpus (mattmahoney.net/dc/text8.zip, unzipped) into
+    tests/resources/real/text8 and this trains SkipGram-NS on the first
+    ~2M tokens, then asserts a word-similarity sanity metric: for known
+    related/unrelated word pairs, cosine(related) must beat
+    cosine(unrelated) on a clear majority — the cheap, stable slice of
+    the wordsim/analogy evaluations the reference families are judged
+    by. The metric value is printed for the record."""
+    (p,) = _need("text8")
+    from hivemall_tpu.models.word2vec import Word2VecTrainer
+
+    with open(p) as f:
+        toks = f.read(12_000_000).split()       # ~2M tokens
+    t = Word2VecTrainer("-dim 100 -window 5 -neg 10 -min_count 5 "
+                        "-mini_batch 16384 -sample 1e-4 -iter 2")
+    t.train([toks])
+    vecs = t.vectors()
+
+    def cos(a, b):
+        va, vb = vecs.get(a), vecs.get(b)
+        if va is None or vb is None:
+            return None
+        return float(np.dot(va, vb)
+                     / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+
+    pairs = [("king", "queen", "king", "cat"),
+             ("man", "woman", "man", "tree"),
+             ("paris", "france", "paris", "dog"),
+             ("water", "river", "water", "king"),
+             ("three", "four", "three", "music"),
+             ("day", "night", "day", "metal"),
+             ("good", "bad", "good", "seven"),
+             ("war", "army", "war", "fruit")]
+    wins, total, margins = 0, 0, []
+    for a, b, c, d in pairs:
+        s_rel, s_unrel = cos(a, b), cos(c, d)
+        if s_rel is None or s_unrel is None:
+            continue
+        total += 1
+        margins.append(s_rel - s_unrel)
+        if s_rel > s_unrel:
+            wins += 1
+    assert total >= 5, f"vocabulary too small ({total} pairs scored)"
+    print(f"text8 similarity: {wins}/{total} related>unrelated, "
+          f"mean margin {np.mean(margins):.3f}")
+    assert wins / total >= 0.75, (wins, total, margins)
