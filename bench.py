@@ -309,11 +309,15 @@ def bench_ffm_parquet_stream(n_rows: int = 131072) -> dict:
         best, med, _ = _repeat(run, 3)
         # multi-epoch production path: epoch 1 streams + retains staged
         # buffers, epochs >= 2 replay device-resident (no link re-cross).
-        # replay rate = the 3 extra epochs over (4-epoch wall - 1-epoch
-        # best): isolates what -iters epochs >= 2 now cost.
+        # The replay ops compile at the FULL corpus shapes, so warm them
+        # with one 2-epoch run first (one-off compile, not steady state),
+        # then time: replay rate = the 3 extra epochs over (4-epoch wall
+        # - 1-epoch best) — what -iters epochs >= 2 now cost.
+        factory = lambda: stream.batches(B, epochs=1, max_len=L)  # noqa: E731
+        t.fit_stream(factory, epochs=2)
+        _sync(t)
         t0 = time.perf_counter()
-        t.fit_stream(lambda: stream.batches(B, epochs=1, max_len=L),
-                     epochs=4)
+        t.fit_stream(factory, epochs=4)
         _sync(t)
         t4 = time.perf_counter() - t0
         replay_rate = 3 * n_rows / max(t4 - best, 1e-9)
@@ -759,7 +763,17 @@ def bench_changefinder() -> dict:
     n = 50_000
     x = np.concatenate([rng.normal(0, 1, n // 2),
                         rng.normal(4, 1, n // 2)])
-    changefinder(x)            # warm the full-length bucket's compile
+    # warm the full-length bucket's compile; the relay's remote_compile
+    # endpoint drops connections transiently under load — retry the
+    # one-off warm call rather than failing the whole metric
+    for attempt in range(3):
+        try:
+            changefinder(x)
+            break
+        except Exception:
+            if attempt == 2:
+                raise
+            time.sleep(5)
     outs = []
     best, med, _ = _repeat(lambda: outs.append(changefinder(x)), 3)
     assert len(outs[0]) == n
@@ -917,7 +931,7 @@ def _supervised():
         try:
             out = subprocess.run([sys.executable, __file__], env=e1,
                                  capture_output=True, text=True,
-                                 timeout=300)
+                                 timeout=360)
             lines = [l for l in out.stdout.strip().splitlines()
                      if l.startswith("{")]
             if out.returncode == 0 and lines:
@@ -927,16 +941,16 @@ def _supervised():
                              f"stderr tail: {out.stderr[-800:]}"}
         except subprocess.TimeoutExpired:
             return {"metric": name, "value": 0.0, "unit": "failed",
-                    "error": "timed out after 300s"}
+                    "error": "timed out after 360s"}
 
     for name in _BENCHES:
-        if _time.monotonic() - t_start > 1300:
+        if _time.monotonic() - t_start > 1400:
             configs.append({"metric": name, "value": 0.0, "unit": "failed",
                             "error": "skipped: bench time budget exhausted"})
             continue
         rec = run_one(name)
         if rec.get("unit") == "failed" and \
-                _time.monotonic() - t_start < 1200:
+                _time.monotonic() - t_start < 1300:
             # one retry: the relay's compile service drops connections
             # transiently ("response body closed"), which is not a
             # property of the config being measured

@@ -74,12 +74,42 @@ def learner_option_spec(name: str, *, classification: bool,
     return s
 
 
+_STEP_BUILDER_CACHE: dict = {}
+
+
+def shared_step(trainer, tag: str, builder):
+    """Config-cached jitted step: same-class trainers with identical
+    scalar options share ONE compiled step instead of re-tracing per
+    instance (the per-instance re-jit disease — measured costing
+    word2vec 4x and LDA 10x before the same fix; fm/ffm/linear use
+    module-level lru_caches, this is the generic form for trainers whose
+    steps are built from bound-method closures over opts). Safe because
+    the steps take all state as arguments and the closures are pure
+    functions of the keyed option values (donation applies per CALL)."""
+    key = (type(trainer).__name__, tag,
+           tuple(sorted((k, v) for k, v in trainer.opts.items()
+                        if isinstance(v, (int, float, str, bool))
+                        or v is None)))
+    fn = _STEP_BUILDER_CACHE.get(key)
+    if fn is None:
+        # bounded like the fm/linear lru_caches: a sweep over many
+        # distinct configs must not grow compiled-step memory forever
+        if len(_STEP_BUILDER_CACHE) >= 256:
+            _STEP_BUILDER_CACHE.pop(next(iter(_STEP_BUILDER_CACHE)))
+        fn = builder()
+        _STEP_BUILDER_CACHE[key] = fn
+    return fn
+
+
 class LearnerBase:
     """Subclasses set NAME/CLASSIFICATION/DEFAULT_LOSS and _build/_step."""
 
     NAME = "learner"
     CLASSIFICATION = True
     DEFAULT_LOSS = "hingeloss"
+
+    def _shared_step(self, tag: str, builder):
+        return shared_step(self, tag, builder)
 
     @classmethod
     def spec(cls) -> OptionSpec:

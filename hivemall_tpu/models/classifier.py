@@ -110,8 +110,9 @@ class _OnlineBase(LearnerBase):
         if mode not in ("aggregate", "sequential"):
             raise ValueError(f"-batch_mode must be aggregate|sequential, "
                              f"got {mode!r}")
-        self._step = (self._make_step_sequential() if mode == "sequential"
-                      else self._make_step())
+        self._step = self._shared_step(
+            mode, self._make_step_sequential if mode == "sequential"
+            else self._make_step)
 
     # subclass: (margin_y, v, xx, y, params) -> (alpha_like, beta_like)
     #   margin_y = y * (w.x); v = sigma-weighted or plain ||x||^2
@@ -286,11 +287,14 @@ class PassiveAggressiveTrainer(_OnlineBase):
     """SQL: train_pa — tau = hinge/||x||^2 (Crammer et al. PA-0)."""
     NAME = "train_pa"
 
-    def _tau(self, loss, xx):
-        return loss / jnp.maximum(xx, 1e-12)
+    def _tau_factory(self):
+        # returns a closure over SCALARS only — capturing a bound method
+        # here pinned the first trainer instance (and its dims-sized
+        # tables) inside the global step cache forever
+        return lambda loss, xx: loss / jnp.maximum(xx, 1e-12)
 
     def _rates(self):
-        tau_fn = self._tau
+        tau_fn = self._tau_factory()
 
         def rates(m, v):
             loss = jnp.maximum(0.0, 1.0 - m)
@@ -303,17 +307,19 @@ class PA1Trainer(PassiveAggressiveTrainer):
     """SQL: train_pa1 — tau capped at C."""
     NAME = "train_pa1"
 
-    def _tau(self, loss, xx):
-        return jnp.minimum(float(self.opts.c),
-                           loss / jnp.maximum(xx, 1e-12))
+    def _tau_factory(self):
+        c = float(self.opts.c)
+        return lambda loss, xx: jnp.minimum(
+            c, loss / jnp.maximum(xx, 1e-12))
 
 
 class PA2Trainer(PassiveAggressiveTrainer):
     """SQL: train_pa2 — tau = loss / (||x||^2 + 1/(2C))."""
     NAME = "train_pa2"
 
-    def _tau(self, loss, xx):
-        return loss / (xx + 1.0 / (2.0 * float(self.opts.c)))
+    def _tau_factory(self):
+        c = float(self.opts.c)
+        return lambda loss, xx: loss / (xx + 1.0 / (2.0 * c))
 
 
 def _phi_of(opts) -> float:
@@ -429,7 +435,7 @@ class AdaGradRDATrainer(_OnlineBase):
         self.sigma = None
         self.u = jnp.zeros(self.dims, jnp.float32)
         self.gg = jnp.zeros(self.dims, jnp.float32)
-        self._step = self._make_rda_step()
+        self._step = self._shared_step("rda", self._make_rda_step)
 
     def _make_rda_step(self):
         lam = float(self.opts["lambda"])

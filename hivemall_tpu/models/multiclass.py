@@ -63,8 +63,10 @@ class _MulticlassBase:
         if mode not in ("aggregate", "sequential"):
             raise ValueError(f"-batch_mode must be aggregate|sequential, "
                              f"got {mode!r}")
-        self._step = (self._make_step_sequential() if mode == "sequential"
-                      else self._make_step())
+        from .base import shared_step
+        self._step = shared_step(
+            self, mode, self._make_step_sequential if mode == "sequential"
+            else self._make_step)
         self._t = 0
 
     # -- full-state checkpointing (io.checkpoint bundles, SURVEY.md §6) ------
@@ -334,11 +336,12 @@ class MulticlassPATrainer(_MulticlassBase):
     """SQL: train_multiclass_pa — tau = hinge(1 - m) / v."""
     NAME = "train_multiclass_pa"
 
-    def _tau(self, loss, v):
-        return loss / jnp.maximum(v, 1e-12)
+    def _tau_factory(self):
+        # scalars-only closure (see classifier.PassiveAggressiveTrainer)
+        return lambda loss, v: loss / jnp.maximum(v, 1e-12)
 
     def _rates(self):
-        tau_fn = self._tau
+        tau_fn = self._tau_factory()
 
         def rates(m, v):
             loss = jnp.maximum(0.0, 1.0 - m)
@@ -350,16 +353,18 @@ class MulticlassPATrainer(_MulticlassBase):
 class MulticlassPA1Trainer(MulticlassPATrainer):
     NAME = "train_multiclass_pa1"
 
-    def _tau(self, loss, v):
-        return jnp.minimum(float(self.opts.c),
-                           loss / jnp.maximum(v, 1e-12))
+    def _tau_factory(self):
+        c = float(self.opts.c)
+        return lambda loss, v: jnp.minimum(
+            c, loss / jnp.maximum(v, 1e-12))
 
 
 class MulticlassPA2Trainer(MulticlassPATrainer):
     NAME = "train_multiclass_pa2"
 
-    def _tau(self, loss, v):
-        return loss / (v + 1.0 / (2.0 * float(self.opts.c)))
+    def _tau_factory(self):
+        c = float(self.opts.c)
+        return lambda loss, v: loss / (v + 1.0 / (2.0 * c))
 
 
 class MulticlassCWTrainer(_MulticlassBase):

@@ -150,3 +150,28 @@ def test_multiclass_families():
         assert acc == 3, (cls.NAME, acc)
         rows = list(t.model_rows())
         assert rows and rows[0][0].startswith("class")
+
+
+def test_steps_shared_across_instances_cw_arow():
+    """Round 5: CW/AROW/SCW/multiclass steps are config-cached (the
+    generic shared_step) — two same-config instances share one compiled
+    step; different configs don't; state stays independent."""
+    from hivemall_tpu.models.classifier import AROWTrainer, SCW1Trainer
+    from hivemall_tpu.models.multiclass import MulticlassAROWTrainer
+
+    a = AROWTrainer("-dims 128 -mini_batch 16")
+    b = AROWTrainer("-dims 128 -mini_batch 16")
+    c = AROWTrainer("-dims 128 -mini_batch 16 -r 2.0")
+    assert a._step is b._step
+    assert a._step is not c._step
+    assert SCW1Trainer("-dims 128")._step is not a._step
+    m1 = MulticlassAROWTrainer("-dims 128")
+    m2 = MulticlassAROWTrainer("-dims 128")
+    assert m1._step is m2._step
+    # independence: training one must not touch the other's tables
+    rng = np.random.default_rng(0)
+    for _ in range(30):
+        ids = np.sort(rng.choice(np.arange(1, 100), 5, replace=False))
+        a.process([f"{i}:1" for i in ids], 1 if ids[0] % 2 else -1)
+    a._flush()
+    assert float(np.abs(np.asarray(b.w)).sum()) == 0.0
