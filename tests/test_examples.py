@@ -42,3 +42,11 @@ def test_criteo_ffm_example_on_fragment():
                 "--data", os.path.join(RES, "criteo_ffm.frag.tsv")])
     assert rec["train_auc"] > 0.72
     assert rec["cumulative_logloss"] < 0.75
+
+
+def test_anomaly_stream_example():
+    rec = _run(["examples/anomaly_stream.py", "--points", "600"])
+    n, half = rec["points"], rec["points"] // 2
+    assert abs(rec["scalar_outlier_at"] - rec["scalar_outlier_true"]) <= 2
+    assert abs(rec["scalar_change_at"] - half) <= 40
+    assert abs(rec["vector_change_at"] - half) <= 40
