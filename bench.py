@@ -712,23 +712,40 @@ def bench_mix() -> dict:
         # measured ~2x faster and would not be comparable)
         for ks in keysets:
             ks += np.int64(1 << 23)
+        n0 = len(done)
         threads = [threading.Thread(target=client, args=(i,))
                    for i in range(n_clients)]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        # a dead server makes client threads raise and vanish — that must
+        # FAIL the metric, not report an absurdly fast wall time
+        assert len(done) == n0 + n_clients, \
+            f"only {len(done) - n0}/{n_clients} clients completed"
 
     best, med, _ = _repeat(run, 3)
     counters = srv.counters()
     srv.stop()
     total = n_clients * n_msgs * n_keys        # per run; counters span 3
+
+    # same workload against the C++ epoll server (native/mix_server.cpp,
+    # the reference's Netty-runtime analog; identical wire protocol)
+    native = {}
+    from hivemall_tpu.parallel.mix_native import (NativeMixServer,
+                                                  native_available)
+    if native_available():               # python-only environments skip
+        with NativeMixServer() as nsrv:
+            srv = nsrv                   # client() targets srv.port
+            bn, mn, _ = _repeat(run, 3)
+        native = {"value_native": round(total / bn, 1),
+                  "value_native_median": round(total / mn, 1)}
     return {"metric": "mix_server_key_updates_per_sec",
             "value": round(total / best, 1),
             "value_median": round(total / med, 1),
             "unit": "key-updates/sec",
             "seconds": round(best, 3), "clients": n_clients,
-            "runs": 3,
+            "runs": 3, **native,
             "server_counters_all_runs": counters}
 
 

@@ -162,7 +162,12 @@ def _cmd_predict(args) -> int:
 
 
 def _cmd_mixserv(args) -> int:
-    """The bin/run_mixserv.sh analog: a standalone mix server."""
+    """The bin/run_mixserv.sh analog: a standalone mix server.
+
+    --impl native runs the C++ epoll server (native/mix_server.cpp, the
+    reference's Netty-runtime analog; same wire protocol); python runs
+    the asyncio implementation (required for --ssl-*); auto prefers
+    native when a toolchain built it and no TLS was requested."""
     from ..parallel.mix_service import MixServer, make_server_ssl_context
 
     ctx = None
@@ -172,15 +177,32 @@ def _cmd_mixserv(args) -> int:
         return 2
     if args.ssl_cert:
         ctx = make_server_ssl_context(args.ssl_cert, args.ssl_key)
-    srv = MixServer(args.host, args.port, ssl_context=ctx).start()
-    print(json.dumps({"host": srv.host, "port": srv.port,
-                      "ssl": bool(ctx)}))
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        srv.stop()
-    return 0
+    def serve(srv, impl_name: str, ssl_on: bool) -> int:
+        print(json.dumps({"host": srv.host, "port": srv.port,
+                          "ssl": ssl_on, "impl": impl_name}))
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            srv.stop()
+        return 0
+
+    impl = args.impl
+    if impl == "native" and ctx is not None:
+        print("--impl native has no TLS; use --impl python with --ssl-*",
+              file=sys.stderr)
+        return 2
+    if impl in ("auto", "native") and ctx is None:
+        from ..parallel.mix_native import NativeMixServer, native_available
+        if native_available():
+            return serve(NativeMixServer(args.host, args.port).start(),
+                         "native", False)
+        if impl == "native":
+            print("native mix server unavailable (no g++?)",
+                  file=sys.stderr)
+            return 1
+    return serve(MixServer(args.host, args.port, ssl_context=ctx).start(),
+                 "python", bool(ctx))
 
 
 def _cmd_define_all(args) -> int:
@@ -238,6 +260,10 @@ def main(argv=None) -> int:
     m.add_argument("--ssl-key", default=None, help="TLS private key file")
     m.add_argument("--host", default="0.0.0.0")
     m.add_argument("--port", type=int, default=11212)
+    m.add_argument("--impl", default="auto",
+                   choices=("auto", "native", "python"),
+                   help="native = C++ epoll server (no TLS), python = "
+                        "asyncio, auto = native when available")
     m.set_defaults(fn=_cmd_mixserv)
 
     d = sub.add_parser("define-all", help="print the function manifest")

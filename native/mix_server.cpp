@@ -1,0 +1,372 @@
+// Native MIX server — the C++ runtime twin of parallel/mix_service.py's
+// asyncio MixServer (reference: hivemall.mix.server.MixServer, a Netty
+// JVM server; SURVEY.md §3.16/§4.3 calls for a native-runtime
+// equivalent, not a Python-only stand-in).
+//
+// Same length-prefixed little-endian wire protocol as the Python server
+// (MixMessage analog), so hivemall_tpu.parallel.mix_service.MixClient
+// connects unchanged:
+//   u32 body_len | u8 event, u16 group_len, group utf-8, u32 n,
+//   n x { i64 key, f32 weight, f32 covar, i32 delta_updates }   (packed)
+// Events: 1=average (running sum(w*du)/sum(du) per key), 2=argmin_kld
+// (precision-weighted mean + merged variance), 3=closegroup, 4=stats
+// (reply carries a JSON counters object in the group field).
+//
+// Design: single-threaded epoll loop (the reference's server is also
+// logically single-threaded per session), per-group open-addressing
+// key->row table over growable flat aggregate arrays — the same layout
+// the Python server vectorizes with numpy, here as straight loops the
+// compiler vectorizes. TLS and fault-injection stay on the Python
+// implementation (tests/ops tooling); this binary is the in-cluster
+// plaintext data path.
+//
+// Build (done on demand by parallel/mix_native.py):
+//   g++ -O3 -std=c++17 -o mix_server_native mix_server.cpp
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/prctl.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <fcntl.h>
+
+#include <csignal>
+#include <cstdint>
+#include <cstdio>
+#include <cerrno>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t EV_AVERAGE = 1;
+constexpr uint8_t EV_ARGMIN_KLD = 2;
+constexpr uint8_t EV_CLOSEGROUP = 3;
+constexpr uint8_t EV_STATS = 4;
+constexpr int64_t EMPTY = -(int64_t(1) << 62);
+
+#pragma pack(push, 1)
+struct Rec {
+  int64_t k;
+  float w;
+  float c;
+  int32_t d;
+};
+#pragma pack(pop)
+static_assert(sizeof(Rec) == 20, "wire record must be packed to 20 bytes");
+
+struct Group {
+  // open-addressing key -> dense row (same scheme as _NpIndex)
+  std::vector<int64_t> slot_key;
+  std::vector<int64_t> slot_row;
+  size_t n = 0;
+  std::vector<double> sum_w_du, sum_prec, sum_w_prec;
+  std::vector<int64_t> total_du;
+
+  Group() { rehash(12); }
+
+  static uint64_t mix(int64_t k) {
+    uint64_t h = uint64_t(k);
+    h ^= h >> 33;
+    h *= 0xFF51AFD7ED558CCDULL;
+    h ^= h >> 33;
+    return h;
+  }
+
+  void rehash(size_t bits) {
+    std::vector<int64_t> ok(std::move(slot_key)), orow(std::move(slot_row));
+    size_t cap = size_t(1) << bits;
+    slot_key.assign(cap, EMPTY);
+    slot_row.assign(cap, 0);
+    uint64_t mask = cap - 1;
+    for (size_t i = 0; i < ok.size(); ++i) {
+      if (ok[i] == EMPTY) continue;
+      uint64_t s = mix(ok[i]) & mask;
+      while (slot_key[s] != EMPTY) s = (s + 1) & mask;
+      slot_key[s] = ok[i];
+      slot_row[s] = orow[i];
+    }
+  }
+
+  int64_t row_for(int64_t key) {
+    if ((n + 1) * 10 > slot_key.size() * 7) {
+      size_t bits = 12;
+      while ((size_t(1) << bits) < (n + 1) * 2) ++bits;
+      rehash(bits + 1);
+    }
+    uint64_t mask = slot_key.size() - 1;
+    uint64_t s = mix(key) & mask;
+    while (true) {
+      if (slot_key[s] == key) return slot_row[s];
+      if (slot_key[s] == EMPTY) {
+        slot_key[s] = key;
+        int64_t r = int64_t(n++);
+        slot_row[s] = r;
+        if (n > sum_w_du.size()) {
+          size_t cap = sum_w_du.size() ? sum_w_du.size() * 2 : 1024;
+          sum_w_du.resize(cap, 0.0);
+          sum_prec.resize(cap, 0.0);
+          sum_w_prec.resize(cap, 0.0);
+          total_du.resize(cap, 0);
+        }
+        return r;
+      }
+      s = (s + 1) & mask;
+    }
+  }
+};
+
+struct Conn {
+  std::vector<uint8_t> in;   // accumulated unparsed bytes
+  std::vector<uint8_t> out;  // pending unwritten bytes
+  size_t out_off = 0;
+};
+
+struct Server {
+  std::unordered_map<std::string, Group> sessions;
+  uint64_t requests = 0, keys_folded = 0, bytes_in = 0, bytes_out = 0;
+
+  std::vector<int64_t> rows_scratch;
+
+  // fold one message, then rewrite w/c fields of recs as the reply.
+  // Two passes so duplicate keys WITHIN one message all see the
+  // message-final aggregate — the Python server's np.add.at-then-read
+  // semantics.
+  void fold(uint8_t event, Group& g, Rec* recs, uint32_t cnt) {
+    rows_scratch.resize(cnt);
+    if (event == EV_ARGMIN_KLD) {
+      for (uint32_t i = 0; i < cnt; ++i) {
+        int64_t r = g.row_for(recs[i].k);
+        rows_scratch[i] = r;
+        double c = recs[i].c;
+        double prec = 1.0 / (c > 1e-12 ? c : 1e-12);
+        g.sum_prec[r] += prec;
+        g.sum_w_prec[r] += double(recs[i].w) * prec;
+      }
+      for (uint32_t i = 0; i < cnt; ++i) {
+        double sp = g.sum_prec[rows_scratch[i]];
+        recs[i].w = float(g.sum_w_prec[rows_scratch[i]] / sp);
+        recs[i].c = float(1.0 / sp);
+      }
+    } else {
+      for (uint32_t i = 0; i < cnt; ++i) {
+        int64_t r = g.row_for(recs[i].k);
+        rows_scratch[i] = r;
+        int64_t du = recs[i].d > 1 ? recs[i].d : 1;
+        g.sum_w_du[r] += double(recs[i].w) * double(du);
+        g.total_du[r] += du;
+      }
+      for (uint32_t i = 0; i < cnt; ++i) {
+        int64_t r = rows_scratch[i];
+        int64_t td = g.total_du[r] > 1 ? g.total_du[r] : 1;
+        recs[i].w = float(g.sum_w_du[r] / double(td));
+        recs[i].c = 0.0f;
+      }
+    }
+    keys_folded += cnt;
+  }
+
+  static constexpr size_t CLOSE = size_t(-1);
+
+  // returns bytes consumed from buf (0 = incomplete frame, CLOSE = drop
+  // the connection — the asyncio server's decode exception likewise
+  // closes, so a version-skewed client gets EOF instead of hanging on a
+  // reply that will never come); appends any reply to out
+  size_t handle(const uint8_t* buf, size_t len, std::vector<uint8_t>& out) {
+    if (len < 4) return 0;
+    uint32_t body;
+    std::memcpy(&body, buf, 4);
+    if (len < 4 + size_t(body)) return 0;
+    const uint8_t* p = buf + 4;
+    bytes_in += 4 + body;
+    if (body < 7) return CLOSE;  // malformed
+    uint8_t event = p[0];
+    uint16_t glen;
+    std::memcpy(&glen, p + 1, 2);
+    if (size_t(3) + glen + 4 > body) return CLOSE;
+    std::string group(reinterpret_cast<const char*>(p + 3), glen);
+    uint32_t cnt;
+    std::memcpy(&cnt, p + 3 + glen, 4);
+    size_t rec_off = 3 + size_t(glen) + 4;
+    if (rec_off + size_t(cnt) * sizeof(Rec) > body) return CLOSE;
+
+    if (event == EV_CLOSEGROUP) {
+      sessions.erase(group);
+      return 4 + body;
+    }
+    if (event == EV_STATS) {
+      char js[256];
+      int jn = std::snprintf(
+          js, sizeof(js),
+          "{\"requests\": %llu, \"keys_folded\": %llu, \"bytes_in\": %llu, "
+          "\"bytes_out\": %llu, \"groups\": %zu, \"impl\": \"native\"}",
+          (unsigned long long)requests, (unsigned long long)keys_folded,
+          (unsigned long long)bytes_in, (unsigned long long)bytes_out,
+          sessions.size());
+      uint32_t rbody = 3 + uint32_t(jn) + 4;
+      size_t base = out.size();
+      out.resize(base + 4 + rbody);
+      uint8_t* q = out.data() + base;
+      std::memcpy(q, &rbody, 4);
+      q[4] = EV_STATS;
+      uint16_t jl = uint16_t(jn);
+      std::memcpy(q + 5, &jl, 2);
+      std::memcpy(q + 7, js, jn);
+      uint32_t zero = 0;
+      std::memcpy(q + 7 + jn, &zero, 4);
+      bytes_out += 4 + rbody;
+      return 4 + body;
+    }
+
+    ++requests;
+    Group& g = sessions[group];
+    // build the reply as a copy of the frame with folded w/c
+    size_t base = out.size();
+    out.resize(base + 4 + body);
+    uint8_t* q = out.data() + base;
+    std::memcpy(q, buf, 4 + body);
+    Rec* recs = reinterpret_cast<Rec*>(q + 4 + rec_off);
+    fold(event, g, recs, cnt);
+    bytes_out += 4 + body;
+    return 4 + body;
+  }
+};
+
+volatile std::sig_atomic_t g_stop = 0;
+void on_term(int) { g_stop = 1; }
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* host = "127.0.0.1";
+  int port = 0;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    if (!std::strcmp(argv[i], "--host")) host = argv[i + 1];
+    if (!std::strcmp(argv[i], "--port")) port = std::atoi(argv[i + 1]);
+  }
+  std::signal(SIGTERM, on_term);
+  std::signal(SIGINT, on_term);
+  std::signal(SIGPIPE, SIG_IGN);
+  // supervised child: never outlive the launcher (mix_native.py / the
+  // mixserv CLI) — an abrupt parent death must not leak a listener
+  prctl(PR_SET_PDEATHSIG, SIGTERM);
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    std::fprintf(stderr, "--host must be a numeric IPv4 address, got %s\n",
+                 host);
+    return 1;
+  }
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(lfd, 64) != 0) {
+    std::perror("bind/listen");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::printf("PORT %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  int ep = epoll_create1(0);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = lfd;
+  epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev);
+
+  Server srv;
+  std::unordered_map<int, Conn> conns;
+  std::vector<epoll_event> events(64);
+  uint8_t rbuf[1 << 16];
+
+  while (!g_stop) {
+    int nev = epoll_wait(ep, events.data(), int(events.size()), 200);
+    for (int i = 0; i < nev; ++i) {
+      int fd = events[i].data.fd;
+      if (fd == lfd) {
+        int cfd = accept(lfd, nullptr, nullptr);
+        if (cfd < 0) continue;
+        setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        // non-blocking: a stalled reader must never freeze the
+        // single-threaded loop — partial writes park in Conn.out and
+        // drain on EPOLLOUT
+        fcntl(cfd, F_SETFL, fcntl(cfd, F_GETFL, 0) | O_NONBLOCK);
+        epoll_event cev{};
+        cev.events = EPOLLIN;
+        cev.data.fd = cfd;
+        epoll_ctl(ep, EPOLL_CTL_ADD, cfd, &cev);
+        conns[cfd];
+        continue;
+      }
+      auto it = conns.find(fd);
+      if (it == conns.end()) continue;
+      Conn& c = it->second;
+      bool closed = false;
+      if (events[i].events & EPOLLIN) {
+        while (true) {
+          ssize_t got = recv(fd, rbuf, sizeof(rbuf), MSG_DONTWAIT);
+          if (got > 0) {
+            c.in.insert(c.in.end(), rbuf, rbuf + got);
+            if (got < ssize_t(sizeof(rbuf))) break;
+          } else if (got == 0) {
+            closed = true;
+            break;
+          } else {
+            break;  // EAGAIN
+          }
+        }
+        size_t off = 0;
+        while (off < c.in.size()) {
+          size_t used = srv.handle(c.in.data() + off, c.in.size() - off,
+                                   c.out);
+          if (used == Server::CLOSE) {
+            closed = true;
+            break;
+          }
+          if (!used) break;
+          off += used;
+        }
+        if (off) c.in.erase(c.in.begin(), c.in.begin() + off);
+      }
+      // drain pending replies; EAGAIN parks the rest for EPOLLOUT
+      while (!closed && c.out_off < c.out.size()) {
+        ssize_t sent = send(fd, c.out.data() + c.out_off,
+                            c.out.size() - c.out_off, MSG_DONTWAIT);
+        if (sent > 0) {
+          c.out_off += size_t(sent);
+        } else if (sent < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          break;
+        } else {
+          closed = true;
+          break;
+        }
+      }
+      bool pending = c.out_off < c.out.size();
+      if (!pending) {
+        c.out.clear();
+        c.out_off = 0;
+      }
+      if (closed || (events[i].events & (EPOLLHUP | EPOLLERR))) {
+        epoll_ctl(ep, EPOLL_CTL_DEL, fd, nullptr);
+        close(fd);
+        conns.erase(it);
+        continue;
+      }
+      epoll_event mev{};
+      mev.events = pending ? (EPOLLIN | EPOLLOUT) : EPOLLIN;
+      mev.data.fd = fd;
+      epoll_ctl(ep, EPOLL_CTL_MOD, fd, &mev);
+    }
+  }
+  close(lfd);
+  return 0;
+}

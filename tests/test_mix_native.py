@@ -1,0 +1,136 @@
+"""Native (C++) MIX server: protocol + semantics parity with the asyncio
+implementation, driven through the SAME MixClient / trainer surface the
+Python server's tests use (native/mix_server.cpp, parallel/mix_native.py).
+Skips cleanly where no g++ toolchain exists."""
+
+import json
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.parallel.mix_native import NativeMixServer, native_available
+from hivemall_tpu.parallel.mix_service import (EVENT_ARGMIN_KLD,
+                                               EVENT_AVERAGE,
+                                               EVENT_CLOSEGROUP, EVENT_STATS,
+                                               MixClient, MixMessage,
+                                               MixServer)
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="no g++ toolchain for the native "
+                                       "mix server")
+
+
+def _roundtrip(sock, msg):
+    sock.sendall(msg.encode())
+    (ln,) = struct.unpack("<I", sock.recv(4, socket.MSG_WAITALL))
+    body = b""
+    while len(body) < ln:
+        body += sock.recv(ln - len(body))
+    return MixMessage.decode(body)
+
+
+def test_native_server_mixclient_roundtrip():
+    with NativeMixServer() as srv:
+        c = MixClient(f"127.0.0.1:{srv.port}", "g1", threshold=1)
+        c._connect()
+        msg = MixMessage(EVENT_AVERAGE, "g1", np.asarray([5], np.int64),
+                         np.asarray([2.0], np.float32),
+                         np.asarray([1.0], np.float32),
+                         np.asarray([1], np.int32))
+        c._sock.sendall(msg.encode())
+        assert c._read_reply().weights[0] == 2.0
+        msg2 = MixMessage(EVENT_AVERAGE, "g1", np.asarray([5], np.int64),
+                          np.asarray([4.0], np.float32),
+                          np.asarray([1.0], np.float32),
+                          np.asarray([1], np.int32))
+        c._sock.sendall(msg2.encode())
+        assert abs(c._read_reply().weights[0] - 3.0) < 1e-6
+        c.close_group()
+
+
+def test_native_matches_python_fold_semantics():
+    """Same message sequence (dup keys, delta weights, KLD covar merge)
+    against both servers -> identical replies."""
+    rng = np.random.default_rng(3)
+    msgs = []
+    for i in range(6):
+        n = int(rng.integers(1, 12))
+        msgs.append(MixMessage(
+            EVENT_AVERAGE if i % 2 else EVENT_ARGMIN_KLD,
+            "g", rng.integers(0, 9, n).astype(np.int64),
+            rng.normal(size=n).astype(np.float32),
+            rng.uniform(0.1, 2.0, n).astype(np.float32),
+            rng.integers(1, 5, n).astype(np.int32)))
+
+    def run(server):
+        out = []
+        s = socket.create_connection(("127.0.0.1", server.port))
+        try:
+            for m in msgs:
+                r = _roundtrip(s, m)
+                out.append((r.weights.copy(), r.covars.copy()))
+        finally:
+            s.close()
+        return out
+
+    with NativeMixServer() as nat:
+        got_n = run(nat)
+    py = MixServer().start()
+    try:
+        got_p = run(py)
+    finally:
+        py.stop()
+    for (wn, cn), (wp, cp) in zip(got_n, got_p):
+        np.testing.assert_allclose(wn, wp, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(cn, cp, rtol=1e-6, atol=1e-7)
+
+
+def test_native_closegroup_and_stats():
+    with NativeMixServer() as srv:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        try:
+            one = lambda ev, g, k, w: MixMessage(   # noqa: E731
+                ev, g, np.asarray([k], np.int64),
+                np.asarray([w], np.float32), np.asarray([1.0], np.float32),
+                np.asarray([1], np.int32))
+            _roundtrip(s, one(EVENT_AVERAGE, "gone", 1, 10.0))
+            # closegroup drops the session: the next fold restarts at w
+            s.sendall(one(EVENT_CLOSEGROUP, "gone", 0, 0.0).encode())
+            r = _roundtrip(s, one(EVENT_AVERAGE, "gone", 1, 4.0))
+            assert r.weights[0] == 4.0
+            st = json.loads(_roundtrip(
+                s, MixMessage(EVENT_STATS, "", np.zeros(0, np.int64),
+                              np.zeros(0, np.float32),
+                              np.zeros(0, np.float32),
+                              np.zeros(0, np.int32))).group)
+            assert st["impl"] == "native" and st["requests"] == 2
+            assert st["groups"] == 1
+        finally:
+            s.close()
+
+
+def test_trainers_converge_via_native_mix():
+    """The Python-server trainer convergence test, against the C++ server:
+    two replicas' shared-feature weights pull together through -mix."""
+    from hivemall_tpu.models.linear import GeneralClassifier
+
+    def train(mix_opts: str):
+        opts = ("-dims 64 -mini_batch 8 -eta fixed -eta0 0.5 -reg no "
+                + mix_opts)
+        a = GeneralClassifier(opts)
+        b = GeneralClassifier(opts)
+        for i in range(64):
+            a.process(["1:1.0"], 1)
+            b.process(["1:1.0"], -1 if i % 4 == 0 else 1)
+        return dict(a.close()), dict(b.close()), a, b
+
+    with NativeMixServer() as srv:
+        ma, mb, a, b = train(f"-mix 127.0.0.1:{srv.port} -mix_session s1 "
+                             f"-mix_threshold 2")
+        assert a._mixer.exchanges > 0 and b._mixer.exchanges > 0
+        mixed_gap = abs(ma["1"] - mb["1"])
+    ua, ub, _, _ = train("")
+    unmixed_gap = abs(ua["1"] - ub["1"])
+    assert mixed_gap < 0.5 * unmixed_gap, (mixed_gap, unmixed_gap)
