@@ -195,9 +195,19 @@ def _cmd_mixserv(args) -> int:
     if impl in ("auto", "native") and ctx is None:
         from ..parallel.mix_native import NativeMixServer, native_available
         if native_available():
-            return serve(NativeMixServer(args.host, args.port).start(),
-                         "native", False)
-        if impl == "native":
+            try:
+                return serve(NativeMixServer(args.host, args.port).start(),
+                             "native", False)
+            except (RuntimeError, OSError) as e:
+                # e.g. hostname --host (the C++ server wants numeric IPv4)
+                # or a bound port: auto falls back to the asyncio server,
+                # an explicit --impl native reports the real cause
+                if impl == "native":
+                    print(f"native mix server failed: {e}", file=sys.stderr)
+                    return 1
+                print(f"native mix server failed ({e}); "
+                      f"falling back to --impl python", file=sys.stderr)
+        elif impl == "native":
             print("native mix server unavailable (no g++?)",
                   file=sys.stderr)
             return 1

@@ -33,21 +33,11 @@ __all__ = ["NativeMixServer", "native_available", "build_native_server"]
 def build_native_server() -> Optional[str]:
     """Path to the server binary, building it if needed; None if the
     toolchain or source is unavailable (callers fall back to the asyncio
-    server)."""
-    if os.environ.get("HIVEMALL_TPU_NO_NATIVE"):
-        return None
-    if os.path.exists(_BIN) and (not os.path.exists(_SRC) or
-                                 os.path.getmtime(_BIN)
-                                 >= os.path.getmtime(_SRC)):
-        return _BIN
-    if not os.path.exists(_SRC):
-        return None
-    try:
-        subprocess.run(["g++", "-O3", "-std=c++17", "-o", _BIN, _SRC],
-                       check=True, capture_output=True, timeout=120)
-    except (OSError, subprocess.SubprocessError):
-        return None
-    return _BIN
+    server). Shares utils.native's build-on-first-use helper and the
+    single HIVEMALL_TPU_NO_NATIVE=1 switch."""
+    from ..utils.native import build_if_stale
+
+    return _BIN if build_if_stale(_SRC, _BIN, []) else None
 
 
 def native_available() -> bool:
@@ -69,14 +59,20 @@ class NativeMixServer:
         if binpath is None:
             raise RuntimeError(
                 "native mix server unavailable (no g++ toolchain or "
-                "HIVEMALL_TPU_NO_NATIVE set); use mix_service.MixServer")
+                "HIVEMALL_TPU_NO_NATIVE=1); use mix_service.MixServer")
         self._proc = subprocess.Popen(
             [binpath, "--host", self.host, "--port", str(self.port)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         line = self._proc.stdout.readline().strip()
         if not line.startswith("PORT "):
+            try:
+                _, err = self._proc.communicate(timeout=5)
+            except subprocess.TimeoutExpired:
+                err = ""
             self.stop()
-            raise RuntimeError(f"native mix server failed to bind: {line!r}")
+            raise RuntimeError(
+                "native mix server failed to bind: "
+                f"{(err or line).strip() or 'no output'!r}")
         self.port = int(line.split()[1])
         return self
 

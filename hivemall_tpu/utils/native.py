@@ -29,20 +29,33 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(
 _SO = os.path.join(os.path.dirname(_SRC), "_native.so")
 
 
-def _build() -> bool:
+def native_disabled() -> bool:
+    """The ONE switch for every native path (the .so AND the mix server):
+    HIVEMALL_TPU_NO_NATIVE=1 disables both."""
+    return os.environ.get("HIVEMALL_TPU_NO_NATIVE") == "1"
+
+
+def build_if_stale(src: str, out: str, flags) -> bool:
+    """Shared build-on-first-use: (re)compile `src` -> `out` with g++ when
+    the artifact is missing or older than the source. Returns whether a
+    usable artifact exists; never raises (no-toolchain environments fall
+    back to the pure paths)."""
+    if native_disabled() or not os.path.exists(src):
+        return False
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return True
     try:
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-fopenmp",
-               _SRC, "-o", _SO]
-        r = subprocess.run(cmd, capture_output=True, timeout=120)
-        if r.returncode == 0:
-            return True
-        # toolchains without libgomp: rebuild single-threaded
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-               _SRC, "-o", _SO]
-        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        r = subprocess.run(["g++", "-O3", "-std=c++17", *flags, src,
+                            "-o", out], capture_output=True, timeout=120)
         return r.returncode == 0
     except (OSError, subprocess.SubprocessError):
         return False
+
+
+def _build() -> bool:
+    # toolchains without libgomp: retry single-threaded
+    return (build_if_stale(_SRC, _SO, ["-shared", "-fPIC", "-fopenmp"])
+            or build_if_stale(_SRC, _SO, ["-shared", "-fPIC"]))
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -50,13 +63,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True
-    if os.environ.get("HIVEMALL_TPU_NO_NATIVE") == "1":
+    if native_disabled():
         return None
-    if not os.path.exists(_SO) or (os.path.exists(_SRC) and
-                                   os.path.getmtime(_SO)
-                                   < os.path.getmtime(_SRC)):
-        if not os.path.exists(_SRC) or not _build():
-            return None
+    if not _build():
+        return None
     try:
         lib = ctypes.CDLL(_SO)
     except OSError:

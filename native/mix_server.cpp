@@ -337,8 +337,12 @@ int main(int argc, char** argv) {
         }
         if (off) c.in.erase(c.in.begin(), c.in.begin() + off);
       }
-      // drain pending replies; EAGAIN parks the rest for EPOLLOUT
-      while (!closed && c.out_off < c.out.size()) {
+      // drain pending replies BEFORE honoring closed: a client that
+      // pipelines N requests then shutdown(SHUT_WR) still gets all N
+      // replies (the asyncio server replies per-message before it sees
+      // EOF). EAGAIN parks the rest for EPOLLOUT.
+      bool dead = false;
+      while (c.out_off < c.out.size()) {
         ssize_t sent = send(fd, c.out.data() + c.out_off,
                             c.out.size() - c.out_off, MSG_DONTWAIT);
         if (sent > 0) {
@@ -346,7 +350,7 @@ int main(int argc, char** argv) {
         } else if (sent < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
           break;
         } else {
-          closed = true;
+          dead = true;
           break;
         }
       }
@@ -355,14 +359,18 @@ int main(int argc, char** argv) {
         c.out.clear();
         c.out_off = 0;
       }
-      if (closed || (events[i].events & (EPOLLHUP | EPOLLERR))) {
+      if (dead || (closed && !pending)
+          || (events[i].events & (EPOLLHUP | EPOLLERR))) {
         epoll_ctl(ep, EPOLL_CTL_DEL, fd, nullptr);
         close(fd);
         conns.erase(it);
         continue;
       }
+      // backpressure: while replies are parked, stop reading this
+      // connection (EPOLLOUT only) so a stalled reader cannot grow
+      // c.out without bound — the asyncio server's writer.drain()
       epoll_event mev{};
-      mev.events = pending ? (EPOLLIN | EPOLLOUT) : EPOLLIN;
+      mev.events = pending ? EPOLLOUT : EPOLLIN;
       mev.data.fd = fd;
       epoll_ctl(ep, EPOLL_CTL_MOD, fd, &mev);
     }

@@ -64,6 +64,25 @@ def test_ffm_joint_mesh_matches_single_device():
                                np.asarray(sharded.params["T"]), atol=1e-4)
 
 
+def test_fm_minibatch_mesh_matches_single_device():
+    """train_fm's round-5 default (minibatch scatter + dense AdaGrad over
+    the packed fused table) under GSPMD: the -mesh model must match the
+    single-device model on identical batch streams — the scatter into G
+    and the dense optimizer pass both partition over (dp, tp)."""
+    from hivemall_tpu.models.fm import FMTrainer
+
+    ds = _linear_ds(n=384)
+    opts = ("-dims 4096 -factors 4 -mini_batch 128 -opt adagrad "
+            "-classification")
+    single = FMTrainer(opts).fit(ds, epochs=2)
+    sharded = FMTrainer(opts + " -mesh dp=2,tp=4").fit(ds, epochs=2)
+    assert single._step is not None
+    np.testing.assert_allclose(np.asarray(single.params["T"]),
+                               np.asarray(sharded.params["T"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(single.params["w0"]),
+                               np.asarray(sharded.params["w0"]), atol=1e-5)
+
+
 def test_ffm_ftrl_mesh_matches_single_device():
     ds = _ffm_ds(seed=3)
     opts = "-dims 4096 -factors 4 -fields 8 -mini_batch 128 -opt ftrl " \
