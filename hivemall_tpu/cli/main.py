@@ -196,17 +196,22 @@ def _cmd_mixserv(args) -> int:
         from ..parallel.mix_native import NativeMixServer, native_available
         if native_available():
             try:
-                return serve(NativeMixServer(args.host, args.port).start(),
-                             "native", False)
+                # only STARTUP failures fall back; once bound, serve()
+                # owns the process (a post-start error must not leave the
+                # native child running while python doubles the listener)
+                nsrv = NativeMixServer(args.host, args.port).start()
             except (RuntimeError, OSError) as e:
                 # e.g. hostname --host (the C++ server wants numeric IPv4)
                 # or a bound port: auto falls back to the asyncio server,
                 # an explicit --impl native reports the real cause
+                nsrv = None
                 if impl == "native":
                     print(f"native mix server failed: {e}", file=sys.stderr)
                     return 1
                 print(f"native mix server failed ({e}); "
                       f"falling back to --impl python", file=sys.stderr)
+            if nsrv is not None:
+                return serve(nsrv, "native", False)
         elif impl == "native":
             print("native mix server unavailable (no g++?)",
                   file=sys.stderr)

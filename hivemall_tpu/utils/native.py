@@ -40,8 +40,11 @@ def build_if_stale(src: str, out: str, flags) -> bool:
     the artifact is missing or older than the source. Returns whether a
     usable artifact exists; never raises (no-toolchain environments fall
     back to the pure paths)."""
-    if native_disabled() or not os.path.exists(src):
+    if native_disabled():
         return False
+    if not os.path.exists(src):
+        # binary-only installs (source pruned): use the shipped artifact
+        return os.path.exists(out)
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return True
     try:

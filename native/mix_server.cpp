@@ -125,6 +125,7 @@ struct Conn {
   std::vector<uint8_t> in;   // accumulated unparsed bytes
   std::vector<uint8_t> out;  // pending unwritten bytes
   size_t out_off = 0;
+  bool closing = false;      // close after pending replies flush
 };
 
 struct Server {
@@ -310,8 +311,8 @@ int main(int argc, char** argv) {
       auto it = conns.find(fd);
       if (it == conns.end()) continue;
       Conn& c = it->second;
-      bool closed = false;
-      if (events[i].events & EPOLLIN) {
+      bool closed = c.closing;
+      if (!closed && (events[i].events & EPOLLIN)) {
         while (true) {
           ssize_t got = recv(fd, rbuf, sizeof(rbuf), MSG_DONTWAIT);
           if (got > 0) {
@@ -359,6 +360,9 @@ int main(int argc, char** argv) {
         c.out.clear();
         c.out_off = 0;
       }
+      c.closing = closed;        // persist close-after-flush across
+      // events (a malformed frame seen while replies are parked must
+      // still end the connection once they drain)
       if (dead || (closed && !pending)
           || (events[i].events & (EPOLLHUP | EPOLLERR))) {
         epoll_ctl(ep, EPOLL_CTL_DEL, fd, nullptr);

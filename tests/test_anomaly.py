@@ -114,3 +114,12 @@ def test_sst_flags_frequency_change():
 
 def test_sst_short_series_zero():
     assert sst([1.0, 2.0, 3.0], "-w 16") == [0.0, 0.0, 0.0]
+
+
+def test_changefinder_constant_and_single_point_series():
+    """Degenerate streams: a constant series (zero variance — the sigma
+    floor must keep NLLs finite) and near-empty series."""
+    out = changefinder(np.ones(400), "-r 0.05 -k 2")
+    assert np.isfinite(out).all()
+    out2 = changefinder(np.ones((50, 3)) * 2.5, "-r 0.05 -k 2")
+    assert np.isfinite(out2).all()

@@ -804,3 +804,48 @@ def test_step_builders_shared_across_instances():
     list(a.close())
     assert not np.array_equal(np.asarray(a.params["T"], np.float32),
                               np.asarray(b.params["T"], np.float32))
+
+
+def test_fm_adareg_regression_objective():
+    """-adareg with the squared-loss (regression) objective: the holdout
+    loss path must work for non-classification FM too."""
+    rng = np.random.default_rng(0)
+    rows = [(np.sort(rng.choice(np.arange(1, 50), 4,
+                                replace=False)).astype(np.int32),
+             np.ones(4, np.float32)) for _ in range(120)]
+    y = rng.normal(size=120).astype(np.float32)
+    t = FMTrainer("-dims 64 -factors 4 -opt adagrad -mini_batch 32 "
+                  "-iters 3 -adareg -va_ratio 0.2")
+    t.fit(SparseDataset.from_rows(rows, y))
+    assert np.isfinite(t._lams).all() and (t._lams > 0).all()
+
+
+def test_ffm_fit_stream_fail_open_over_budget():
+    """fit_stream(epochs>1) with a cache budget the epoch cannot fit:
+    replay falls open to re-streaming the factory — same model, same
+    example count (no silent data loss)."""
+    import numpy as np
+    from hivemall_tpu.models.fm import FFMTrainer
+
+    B, L, F, K, dims, n = 128, 8, 8, 4, 1 << 20, 384
+    rng = np.random.default_rng(9)
+    idx = rng.integers(1, dims, (n, L)).astype(np.int32)
+    fld = np.tile(np.arange(L, dtype=np.int32), (n, 1))
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    indptr = np.arange(0, n * L + 1, L, dtype=np.int64)
+    ds = SparseDataset(idx.ravel(), indptr, np.ones(n * L, np.float32),
+                       lab, fld.ravel())
+    cfg = (f"-dims {dims} -factors {K} -fields {F} -mini_batch {B} "
+           "-opt adagrad -classification -halffloat -seed 5 "
+           "-pack_input on")
+    a = FFMTrainer(cfg)
+    a._DEVICE_CACHE_MB = 0          # force over-budget -> fail-open
+    a.fit_stream(lambda: ds.batches(B, shuffle=False), epochs=3,
+                 replay_shuffle=False)
+    b = FFMTrainer(cfg)
+    for _ in range(3):
+        b.fit_stream(ds.batches(B, shuffle=False))
+    assert a._examples == b._examples == 3 * n
+    np.testing.assert_array_equal(
+        np.asarray(a.params["T"], np.float32),
+        np.asarray(b.params["T"], np.float32))
