@@ -349,19 +349,27 @@ class RandomForestClassifier(_ForestBase):
                 raise ValueError("tree ensembles shard over dp only "
                                  f"(got tp={tp})")
             mesh = make_mesh(dp=dp)
-        self.tree = build_tree_classifier(
+        self.tree, node_dev, v_dev = build_tree_classifier(
             binsj, y, w, edges, C, depth=int(o.depth), n_bins=int(o.bins),
             mtry=mtry, min_split=float(o.min_split),
             min_leaf=float(o.min_leaf), seed=int(o.seed), n_trees=E,
-            mesh=mesh)
-        # out-of-bag error per tree, computed ON DEVICE — fetching the
-        # full [E, n, C] prediction tensor to the host cost ~5 s of d2h
-        # at 1M rows through the 25 MB/s relay; only [E] floats move now
-        from hivemall_tpu.ops.trees import predict_bins_device
-        preds = predict_bins_device(self.tree, binsj)
-        pe = preds.argmax(-1)                          # [E, n]
+            mesh=mesh, return_nodes=True)
+        # out-of-bag error per tree, ON DEVICE, from the builder's OWN row
+        # routing: the builder already walked every row to its final node
+        # (weights don't affect routing), so OOB is one small-table class
+        # lookup per (tree, row) instead of re-predicting the whole forest
+        # — the level-sweep re-predict measured 0.9 s of the 2.4 s warm
+        # 1M-row fit (experiments/probe_rf_warm.py). Only [E] floats d2h.
+        import jax
         wj = jnp.asarray(w)
         yj = jnp.asarray(y)
+        if node_dev is not None:
+            pcls = jnp.argmax(v_dev, -1)                         # [E, Nn]
+            pe = jax.vmap(lambda p, nd: p[nd])(pcls, node_dev)   # [E, n]
+        else:
+            # mesh path: the sharded builder doesn't carry node ids
+            from hivemall_tpu.ops.trees import predict_bins_device
+            pe = predict_bins_device(self.tree, binsj).argmax(-1)
         oob = wj == 0
         n_oob = jnp.maximum(oob.sum(1), 1)
         err = ((pe != yj[None, :]) & oob).sum(1) / n_oob
@@ -396,16 +404,17 @@ class RandomForestRegressor(_ForestBase):
         E = int(o.trees)
         mtry = int(o["vars"]) or max(1, d // 3)
         w = self._bootstrap(n, E, rng)
-        self.tree = build_tree_regressor(
+        self.tree, node_dev, v_dev = build_tree_regressor(
             binsj, y, w, edges, depth=int(o.depth), n_bins=int(o.bins),
             mtry=mtry, min_split=float(o.min_split),
-            min_leaf=float(o.min_leaf), seed=int(o.seed), n_trees=E)
-        # per-tree OOB MSE ON DEVICE (same pattern as the classifier):
-        # only [E] floats cross d2h — fetching [E, n] preds + poisson
-        # counts would re-pay the h2d the -bootstrap poisson flag saves
+            min_leaf=float(o.min_leaf), seed=int(o.seed), n_trees=E,
+            return_nodes=True)
+        # per-tree OOB MSE ON DEVICE from the builder's own row routing
+        # (see the classifier: no forest re-predict); only [E] floats d2h
+        import jax
         import jax.numpy as jnp
-        from hivemall_tpu.ops.trees import predict_bins_device
-        preds = predict_bins_device(self.tree, binsj)[..., 0]
+        v0 = v_dev[..., 0]                               # [E, Nn] means
+        preds = jax.vmap(lambda p, nd: p[nd])(v0, node_dev)
         wj = jnp.asarray(w)
         yj = jnp.asarray(y)
         oob = wj == 0

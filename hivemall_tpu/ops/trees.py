@@ -335,7 +335,8 @@ def make_forest_builder_sharded(build, mesh):
 @lru_cache(maxsize=128)
 def _cached_builder(task: str, n_channels: int, depth: int, n_bins: int,
                     mtry: int, min_split: float, min_leaf: float,
-                    lam: float, vmapped: bool, use_pallas: bool):
+                    lam: float, vmapped: bool, use_pallas: bool,
+                    return_nodes: bool = False):
     if task == "gini":
         gain, leaf, count = _gini_gain, (lambda p: p), (lambda s: s.sum(-1))
     elif task == "var":
@@ -351,7 +352,8 @@ def _cached_builder(task: str, n_channels: int, depth: int, n_bins: int,
     build = _make_builder(n_channels, lambda aux: aux, gain, leaf, count,
                           depth, n_bins, mtry, min_split, min_leaf,
                           min_gain=1e-7, use_pallas=use_pallas,
-                          hist_fast=(task == "gini"))
+                          hist_fast=(task == "gini"),
+                          return_nodes=return_nodes)
     if vmapped:
         build = jax.vmap(build, in_axes=(None, None, 0, 0))
     return jax.jit(build)
@@ -363,13 +365,19 @@ def build_tree_classifier(bins: np.ndarray, labels: np.ndarray,
                           n_bins: int = 64, mtry: int = 0,
                           min_split: float = 2.0, min_leaf: float = 1.0,
                           seed: int = 42, n_trees: int = 1,
-                          mesh=None) -> Tree:
+                          mesh=None, return_nodes: bool = False):
     """Gini trees; weights [E, n] give per-tree bootstrap counts. With
-    ``mesh`` (a dp-axis jax Mesh), trees shard over devices."""
+    ``mesh`` (a dp-axis jax Mesh), trees shard over devices.
+
+    ``return_nodes=True`` (single-device only) additionally returns the
+    [E, n] DEVICE array of each row's final node id — the builder routes
+    every row (bootstrap weight plays no part in routing), so OOB error
+    needs no separate predict pass over the forest."""
     onehot = jax.nn.one_hot(labels, n_classes)
     build = _cached_builder("gini", n_classes, depth, n_bins, mtry,
                             float(min_split), float(min_leaf), 0.0, True,
-                            use_pallas_default())
+                            use_pallas_default(),
+                            return_nodes=return_nodes and mesh is None)
     keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
     if mesh is not None:
         dp = mesh.shape["dp"]
@@ -378,23 +386,51 @@ def build_tree_classifier(bins: np.ndarray, labels: np.ndarray,
         build = make_forest_builder_sharded(build.__wrapped__
                                             if hasattr(build, "__wrapped__")
                                             else build, mesh)
-    f, t, v = build(jnp.asarray(bins), onehot, jnp.asarray(weights), keys)
-    return Tree(np.asarray(f), np.asarray(t), np.asarray(v), edges)
+    out = build(jnp.asarray(bins), onehot, jnp.asarray(weights), keys)
+    if return_nodes and mesh is None:
+        f, t, v, node = out
+        # v stays DEVICE-resident for the OOB lookup (re-uploading the
+        # just-fetched host copy would re-pay the relay round trip
+        # _fetch_tree exists to avoid)
+        return _fetch_tree(f, t, v, edges), node, v
+    f, t, v = out
+    tree = _fetch_tree(f, t, v, edges)
+    return (tree, None, None) if return_nodes else tree
+
+
+def _fetch_tree(f, t, v, edges) -> Tree:
+    """ONE device->host fetch for (feat, thr, value): the relay pays
+    ~80-200 ms latency PER FETCH regardless of size, so three separate
+    np.asarray calls taxed every forest fit ~2 extra round trips."""
+    E, Nn = f.shape
+    packed = np.asarray(jnp.concatenate(
+        [f.astype(jnp.float32).reshape(E, Nn, 1),
+         t.astype(jnp.float32).reshape(E, Nn, 1),
+         v.astype(jnp.float32)], axis=-1))
+    return Tree(packed[..., 0].astype(np.int32),
+                packed[..., 1].astype(np.uint8),
+                np.ascontiguousarray(packed[..., 2:]), edges)
 
 
 def build_tree_regressor(bins: np.ndarray, targets: np.ndarray,
                          weights: np.ndarray, edges: np.ndarray, *,
                          depth: int = 8, n_bins: int = 64, mtry: int = 0,
                          min_split: float = 2.0, min_leaf: float = 1.0,
-                         seed: int = 42, n_trees: int = 1) -> Tree:
+                         seed: int = 42, n_trees: int = 1,
+                         return_nodes: bool = False):
     """Variance-split trees; leaf value = weighted mean target."""
     y = jnp.asarray(targets, jnp.float32)
     aux = jnp.stack([jnp.ones_like(y), y, y * y], axis=1)
     build = _cached_builder("var", 3, depth, n_bins, mtry, float(min_split),
-                            float(min_leaf), 0.0, True, use_pallas_default())
+                            float(min_leaf), 0.0, True, use_pallas_default(),
+                            return_nodes=return_nodes)
     keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
-    f, t, v = build(jnp.asarray(bins), aux, jnp.asarray(weights), keys)
-    return Tree(np.asarray(f), np.asarray(t), np.asarray(v), edges)
+    out = build(jnp.asarray(bins), aux, jnp.asarray(weights), keys)
+    if return_nodes:
+        f, t, v, node = out
+        return _fetch_tree(f, t, v, edges), node, v
+    f, t, v = out
+    return _fetch_tree(f, t, v, edges)
 
 
 def build_tree_xgb(bins: np.ndarray, grads: np.ndarray, hess: np.ndarray,

@@ -302,3 +302,30 @@ def test_nominal_categorical_split_beats_ordinal():
     with pytest.raises(ValueError, match="StagedMatrix"):
         RandomForestClassifier("-trees 2 -attrs Q,C").fit(
             StagedMatrix.stage(X, 64), y)
+
+
+def test_oob_from_builder_nodes_matches_repredict():
+    """Round 5: OOB error comes from the builder's own row routing
+    (return_nodes) instead of re-predicting the forest — both paths must
+    agree exactly (same tree, same bins, same leaf values)."""
+    import jax.numpy as jnp
+
+    from hivemall_tpu.ops.trees import predict_bins_device, quantize_bins
+
+    X, y = two_moons_ish(400, seed=6)
+    rf = RandomForestClassifier("-trees 6 -depth 5 -bins 32 -seed 3")
+    rf.fit(X, y)
+    # recompute OOB the old way from the serialized model + train bins
+    bins, _ = quantize_bins(X, 32)
+    w = rf._bootstrap(len(y), 6, np.random.default_rng(3))
+    # _bootstrap(exact) consumed the same rng stream inside fit; rebuild
+    # it the same way fit did (seed -> quantize uses no rng)
+    labels = np.asarray(y)
+    yy = np.searchsorted(np.unique(labels), labels)
+    preds = predict_bins_device(rf.tree, jnp.asarray(bins))
+    pe = np.asarray(preds.argmax(-1))
+    oob = np.asarray(w) == 0
+    n_oob = np.maximum(oob.sum(1), 1)
+    err = ((pe != yy[None, :]) & oob).sum(1) / n_oob
+    err = np.where(oob.sum(1) == 0, 0.0, err)
+    np.testing.assert_allclose(rf.oob_errors, err, atol=1e-12)
