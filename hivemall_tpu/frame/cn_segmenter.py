@@ -14,16 +14,26 @@ per-codepoint splitter:
 - exact min-cost segmentation by Viterbi over the word lattice.
 
 我们在北京学习中文 → 我们/在/北京/学习/中文 — a per-codepoint splitter
-cannot recover 我们 or 学习. For full SmartCN-grade analysis install any
-callable via frame.nlp.set_cn_tokenizer — the option surface is identical.
+cannot recover 我们 or 学习.
+
+Round 5: on first use the segmenter auto-loads a full-coverage frequency
+dictionary (the installed jieba package's MIT-licensed dict.txt, ~349k Han
+entries) via load_system_dictionary(), giving SmartCN-scale coverage out
+of the box; the vendored lexicon remains the fail-soft floor and
+HIVEMALL_TPU_CN_DICT=compact pins it. set_cn_tokenizer still accepts a
+full drop-in callable — the option surface is identical.
 """
 
 from __future__ import annotations
 
+import math
+import re
+import threading
 from typing import Dict, List
 
 __all__ = ["segment", "CN_LEXICON", "install_entries",
-           "load_lexicon_tsv"]
+           "load_lexicon_tsv", "load_system_dictionary",
+           "system_dictionary_info"]
 
 # --- vendored lexicon: word -> unigram cost (lower = preferred) -------------
 # Two bands: ~250 function/grammar words, ~500+ content words (longer known
@@ -86,15 +96,25 @@ for _w in _CONTENT:
     CN_LEXICON.setdefault(_w, 460 + 70 * max(0, len(_w) - 2))
 
 _MAX_WORD = max(len(w) for w in CN_LEXICON)
+_USER_WORDS: set = set()    # words installed via the public loader APIs
 
 
 def install_entries(entries: Dict[str, int]) -> None:
     """Merge external dictionary entries (word -> unigram cost) into the
     live lexicon — external costs OVERRIDE vendored ones (round 4, the
-    tokenize_ja install_entries twin)."""
+    tokenize_ja install_entries twin). User entries also take precedence
+    over the lazily-loaded system dictionary, whichever arrives first."""
     global _MAX_WORD
     CN_LEXICON.update(entries)
+    _USER_WORDS.update(entries)
     _MAX_WORD = max(_MAX_WORD, max((len(w) for w in entries), default=0))
+
+
+def _freq_to_cost(f: float) -> int:
+    """Shared frequency -> unigram-cost rescale (87 cost per decade:
+    freq 1 -> 700, 1e6 -> ~180) so drop-in TSVs and the system
+    dictionary land on one comparable scale."""
+    return int(max(150, 700 - 87 * math.log10(max(1.0, f))))
 
 
 def load_lexicon_tsv(path: str, *, encoding: str = "utf-8",
@@ -104,8 +124,6 @@ def load_lexicon_tsv(path: str, *, encoding: str = "utf-8",
     frequency maps to lower cost via a log rescale) or a bare ``word``
     (assigned ``default_cost``). Lines starting with '#' are skipped.
     Returns the number of entries loaded."""
-    import math
-
     entries: Dict[str, int] = {}
     with open(path, encoding=encoding) as fh:
         for line in fh:
@@ -121,8 +139,7 @@ def load_lexicon_tsv(path: str, *, encoding: str = "utf-8",
                     f = float(freq)
                 except ValueError:
                     continue
-                # log rescale at 87/decade: freq 1 -> 700, 1e6 -> ~180
-                cost = int(max(150, 700 - 87 * math.log10(max(1.0, f))))
+                cost = _freq_to_cost(f)
             else:
                 cost = default_cost
             prev = entries.get(word)
@@ -130,12 +147,133 @@ def load_lexicon_tsv(path: str, *, encoding: str = "utf-8",
                 entries[word] = cost
     install_entries(entries)
     return len(entries)
+
+
+# --- full-coverage system dictionary (round 5) ------------------------------
+# SmartCN ships a ~multi-hundred-thousand-entry bigram dictionary; the
+# vendored lexicon above is ~900 entries. When the MIT-licensed jieba
+# package is installed (it is in this image), its frequency dictionary
+# (~349k Han entries, "word freq [pos]" per line) gives the segmenter full
+# out-of-the-box coverage. Loaded lazily on the first segment() call;
+# HIVEMALL_TPU_CN_DICT=compact pins the vendored lexicon (tests of the
+# compact band structure use this).
+
+_SYSTEM_DICT = {"state": "pending", "entries": 0, "source": None}
+_SYSTEM_DICT_LOCK = threading.Lock()
+# Han codepoint ranges — single source for both the _is_han() run splitter
+# and the dictionary-entry filter, so the two can never drift apart
+_HAN_RANGES = ((0x4E00, 0x9FFF), (0x3400, 0x4DBF))
+_HAN_RUN = re.compile("[%s]+" % "".join(
+    "%s-%s" % (chr(lo), chr(hi)) for lo, hi in _HAN_RANGES))
+
+
+def system_dictionary_info() -> Dict[str, object]:
+    """State of the lazy full-dictionary load (pending/loaded/absent/
+    off/error — error = a source exists but failed to parse), entry
+    count, and source path."""
+    return dict(_SYSTEM_DICT)
+
+
+def load_system_dictionary(path: str | None = None) -> int:
+    """Install a full-coverage frequency dictionary into the live lexicon.
+
+    ``path`` may point at any "word freq [pos]" space-separated file
+    (jieba's dict.txt format). With no path, the installed jieba package's
+    dictionary is used if present. Non-Han entries are skipped (latin/digit
+    runs pass through the segmenter whole, so they never consult the
+    lexicon). Frequencies map to unigram costs on the same 87-cost/decade
+    log scale as load_lexicon_tsv, keeping drop-in TSVs comparable.
+    Words already installed through install_entries/load_lexicon_tsv keep
+    their user-assigned costs — the system dictionary merges BELOW user
+    entries (and above the vendored band) regardless of load order.
+    Returns the number of entries installed (0 if no source was found)."""
+    with _SYSTEM_DICT_LOCK:
+        return _load_system_dictionary_locked(path)
+
+
+def _load_system_dictionary_locked(path: str | None) -> int:
+    if path is None:
+        try:
+            import importlib.util
+            spec = importlib.util.find_spec("jieba")
+            if spec is None or not spec.submodule_search_locations:
+                _SYSTEM_DICT.update(state="absent", entries=0, source=None)
+                return 0
+            import os
+            path = os.path.join(
+                list(spec.submodule_search_locations)[0], "dict.txt")
+            if not os.path.exists(path):
+                _SYSTEM_DICT.update(state="absent", entries=0, source=None)
+                return 0
+        except Exception:
+            _SYSTEM_DICT.update(state="absent", entries=0, source=None)
+            return 0
+
+    entries: Dict[str, int] = {}
+    han_full = _HAN_RUN.fullmatch
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            parts = line.split(" ")
+            if len(parts) < 2:
+                continue
+            word = parts[0]
+            if not word or han_full(word) is None:
+                continue
+            try:
+                f = float(parts[1])
+            except ValueError:
+                continue
+            cost = _freq_to_cost(f)
+            prev = entries.get(word)
+            if prev is None or cost < prev:
+                entries[word] = cost
+    # merge below user precedence: never clobber install_entries/
+    # load_lexicon_tsv costs, whichever load order the user chose. The
+    # single C-level dict.update keeps concurrently segmenting threads
+    # from ever observing a half-merged lexicon (str/int entries don't
+    # re-enter Python mid-update).
+    global _MAX_WORD
+    to_install = {w: c for w, c in entries.items() if w not in _USER_WORDS}
+    CN_LEXICON.update(to_install)
+    _MAX_WORD = max(_MAX_WORD,
+                    max((len(w) for w in entries), default=0))
+    _SYSTEM_DICT.update(state="loaded", entries=len(entries), source=path)
+    return len(entries)
+
+
+def _ensure_system_dictionary() -> None:
+    if _SYSTEM_DICT["state"] != "pending":
+        return
+    # serialize the first load: concurrent first segment() calls (the repo
+    # ships threaded paths — io.prefetch, parallel.mix_service) must not
+    # both run the ~2s parse or read a half-installed lexicon
+    with _SYSTEM_DICT_LOCK:
+        if _SYSTEM_DICT["state"] != "pending":
+            return
+        import os
+        if os.environ.get("HIVEMALL_TPU_CN_DICT", "").lower() == "compact":
+            _SYSTEM_DICT.update(state="off", entries=0, source=None)
+            return
+        try:
+            _load_system_dictionary_locked(None)   # lock already held
+        except Exception as exc:
+            # distinct from "absent" (no jieba): the source exists but the
+            # parse failed — warn so the silent quality degradation to the
+            # compact lexicon is diagnosable
+            import warnings
+            warnings.warn(
+                "tokenize_cn: system dictionary load failed (%s: %s); "
+                "falling back to the compact vendored lexicon"
+                % (type(exc).__name__, exc), RuntimeWarning)
+            _SYSTEM_DICT.update(state="error", entries=0, source=None)
+
+
 _UNK_HAN = 800          # OOV Han falls back to single characters
 
 
 def _is_han(ch: str) -> bool:
     o = ord(ch)
-    return 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF
+    return any(lo <= o <= hi for lo, hi in _HAN_RANGES)
 
 
 def _segment_han(text: str) -> List[str]:
@@ -176,6 +314,7 @@ def _segment_han(text: str) -> List[str]:
 def segment(text: str) -> List[str]:
     """Segment mixed text: Viterbi over Han runs, whole-run latin/digit
     tokens, punctuation/whitespace as separators."""
+    _ensure_system_dictionary()
     toks: List[str] = []
     buf = ""        # latin/digit run
     han = ""        # han run

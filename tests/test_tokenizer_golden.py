@@ -83,7 +83,8 @@ JA_GOLD = [
 
 CN_GOLD = [
     ("我爱北京", ["我", "爱", "北京"]),
-    ("今天天气很好", ["今天", "天气", "很", "好"]),
+    ("今天天气很好", (["今天", "天气", "很", "好"],
+     ["今天天气", "很", "好"])),
     ("我是学生", ["我", "是", "学生"]),
     ("他是老师", ["他", "是", "老师"]),
     ("我们在学校学习", ["我们", "在", "学校", "学习"]),
@@ -103,10 +104,12 @@ CN_GOLD = [
     ("爸爸看报纸", ["爸爸", "看", "报纸"]),
     ("哥哥在银行工作", ["哥哥", "在", "银行", "工作"]),
     ("妹妹是护士", ["妹妹", "是", "护士"]),
-    ("朋友来我家", ["朋友", "来", "我", "家"]),
-    ("我坐地铁上班", ["我", "坐", "地铁", "上班"]),
+    ("朋友来我家", (["朋友", "来", "我", "家"], ["朋友", "来", "我家"])),
+    ("我坐地铁上班", (["我", "坐", "地铁", "上班"],
+     ["我", "坐地铁", "上班"])),
     ("他开汽车回家", ["他", "开", "汽车", "回家"]),
-    ("我们坐飞机去上海", ["我们", "坐", "飞机", "去", "上海"]),
+    ("我们坐飞机去上海", (["我们", "坐", "飞机", "去", "上海"],
+     ["我们", "坐飞机", "去", "上海"])),
     ("火车站很远", ["火车站", "很", "远"]),
     ("机场在城市外面", ["机场", "在", "城市", "外面"]),
     ("图书馆里有很多书", ["图书馆", "里", "有", "很多", "书"]),
@@ -114,11 +117,14 @@ CN_GOLD = [
     ("那个办法很简单", ["那个", "办法", "很", "简单"]),
     ("中文很有趣", ["中文", "很", "有趣"]),
     ("英语比较容易", ["英语", "比较", "容易"]),
-    ("经济发展很快", ["经济", "发展", "很", "快"]),
+    ("经济发展很快", (["经济", "发展", "很", "快"],
+     ["经济", "发展", "很快"])),
     ("社会在变化", ["社会", "在", "变化"]),
-    ("科学技术很重要", ["科学", "技术", "很", "重要"]),
+    ("科学技术很重要", (["科学", "技术", "很", "重要"],
+     ["科学技术", "很", "重要"])),
     ("教育是基本问题", ["教育", "是", "基本", "问题"]),
-    ("他认为这样不对", ["他", "认为", "这样", "不", "对"]),
+    ("他认为这样不对", (["他", "认为", "这样", "不", "对"],
+     ["他", "认为", "这样", "不对"])),
     ("我觉得很高兴", ["我", "觉得", "很", "高兴"]),
     ("大家都知道", ["大家", "都", "知道"]),
     ("我希望明天晴天", ["我", "希望", "明天", "晴天"]),
@@ -136,9 +142,23 @@ CN_GOLD = [
 
 
 def _check(pairs, fn):
+    # expect is one exact list, or a (compact, full-dict) tuple — the full
+    # system dictionary (round 5) merges some compounds the compact
+    # lexicon splits (今天天气, 坐地铁, ...). Pin to the alternative the
+    # ACTIVE dictionary should produce, so a regression on either path
+    # cannot hide behind the other.
+    full = False
+    if any(isinstance(e, tuple) for _, e in pairs):   # CN set only — don't
+        # make the JA goldens pay the ~2s CN dictionary load
+        from hivemall_tpu.frame.cn_segmenter import (segment,
+                                                     system_dictionary_info)
+        segment("的")  # trigger the lazy dictionary load before reading state
+        full = system_dictionary_info()["state"] == "loaded"
     bad = []
     for text, expect in pairs:
         got = fn(text)
+        if isinstance(expect, tuple):
+            expect = expect[1] if full else expect[0]
         if got != expect:
             bad.append((text, got, expect))
     assert not bad, "\n".join(
@@ -253,3 +273,94 @@ def test_cn_lexicon_loader_roundtrip(tmp_path):
         assert cs.segment("我们在北京学习中文") == before
     finally:
         importlib.reload(cs)
+
+
+def test_cn_system_dictionary_loaded():
+    """Round 5: tokenize_cn auto-loads the full-coverage frequency
+    dictionary from the installed jieba package (MIT, ~349k Han entries)
+    on first use — SmartCN-scale coverage out of the box, closing the
+    'full dictionaries arrive only via drop-in loaders' gap for Chinese.
+    """
+    from hivemall_tpu.frame import cn_segmenter as cs
+
+    cs.segment("触发加载")          # trigger the lazy load
+    info = cs.system_dictionary_info()
+    if info["state"] == "absent":   # image without jieba: fail-soft path
+        assert info["entries"] == 0
+        return
+    assert info["state"] == "loaded"
+    assert info["entries"] > 300_000
+    assert len(cs.CN_LEXICON) > 300_000
+    # classic ambiguous spans the compact lexicon cannot resolve
+    assert cs.segment("南京市长江大桥") == ["南京市", "长江大桥"]
+    assert cs.segment("研究生命的起源") == ["研究", "生命", "的", "起源"]
+    got = cs.segment("人工智能正在改变世界")
+    assert "人工智能" in got and "世界" in got, got
+
+
+def test_cn_system_dictionary_explicit_path(tmp_path):
+    """load_system_dictionary(path) parses 'word freq [pos]' lines,
+    skips non-Han entries, and maps frequency to cost on the shared
+    87/decade scale."""
+    import importlib
+    from hivemall_tpu.frame import cn_segmenter as cs
+
+    f = tmp_path / "d.txt"
+    f.write_text("甲乙丙丁 1000000 n\nABC 50 nz\n丙丁 10 n\n",
+                 encoding="utf-8")
+    try:
+        n = cs.load_system_dictionary(str(f))
+        assert n == 2                       # latin entry skipped
+        assert cs.CN_LEXICON["甲乙丙丁"] < cs.CN_LEXICON["丙丁"]
+    finally:
+        importlib.reload(cs)
+
+
+def test_cn_compact_pin_env():
+    """HIVEMALL_TPU_CN_DICT=compact pins the vendored lexicon (fresh
+    interpreter: the dictionary state is per-process module state)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # single-client TPU relay
+    env["HIVEMALL_TPU_CN_DICT"] = "compact"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from hivemall_tpu.frame import cn_segmenter as cs\n"
+        "assert cs.segment('我们在北京') == ['我们', '在', '北京']\n"
+        "info = cs.system_dictionary_info()\n"
+        "assert info['state'] == 'off', info\n"
+        "assert len(cs.CN_LEXICON) < 2000, len(cs.CN_LEXICON)\n"
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+
+
+def test_cn_user_entries_survive_system_load():
+    """User-installed costs take precedence over the lazily-loaded system
+    dictionary regardless of load order (install BEFORE the first
+    segment() call, then trigger the load — the user's cost must win)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("HIVEMALL_TPU_CN_DICT", None)
+    r = subprocess.run([sys.executable, "-c", (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from hivemall_tpu.frame import cn_segmenter as cs\n"
+        "cs.install_entries({'人工智能': 999})\n"
+        "cs.segment('触发')\n"                       # lazy system load
+        "info = cs.system_dictionary_info()\n"
+        "if info['state'] == 'loaded':\n"
+        "    assert info['entries'] > 300000, info\n"
+        "assert cs.CN_LEXICON['人工智能'] == 999, "
+        "cs.CN_LEXICON['人工智能']\n"
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
