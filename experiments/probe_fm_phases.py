@@ -108,3 +108,31 @@ def onehot_expand(gslab, sub):
 print(f"one-hot expand: "
       f"{timeit(lambda: onehot_expand(gslab, idx % P))*1e3:7.2f} ms",
       flush=True)
+
+
+# --- round-5 follow-up: is there a cheap win left in the scatter+dense
+# tail? Measured (same shapes, one jit per variant, value-synced):
+#   zeros+scatter+dense fused in ONE jit : 28.28 ms   <- the step's actual
+#       tail (better than the 23.6 + 9.8 sum of the isolated phases above:
+#       XLA fuses the zero-init and the elementwise update around the
+#       scatter when they share a jit)
+#   donated pre-zeroed G (re-zeroed by the dense pass, no memset): 32.21 ms
+#       — WORSE: donation pins the buffer and defeats the fusion
+#   f32 table (no bf16<->f32 astype copies in the dense pass): 30.51 ms
+#       — WORSE: the wider gather/update traffic costs more than the
+#       conversions saved
+# Conclusion: the minibatch step is at its structural floor —
+# gather+fwd/bwd ~28 ms + fused tail ~28 ms = ~56-61 ms measured e2e
+# (535k ex/s clean). The remaining alternatives all price out at net <= 0
+# by the cost model (docs/PERFORMANCE.md "table-row operations are the
+# scarce resource"):
+#   - sort + segment-sum pre-aggregation: 1.05M slots into 2M rows is
+#     mostly UNIQUE (uniform hashing, <=30% collisions) — nothing to
+#     pre-aggregate, and the sorted-order permutation is itself a 1.05M
+#     row gather (~18 ms).
+#   - sorted-range Pallas VMEM accumulate + fused AdaGrad (the FFM parts
+#     treatment): FM has no field structure, so slots hit the whole 2M-row
+#     table; bucketing needs a device sort (~8-10 ms) AND the kernel's
+#     random g128 reads pay the same ~17 ns/row the XLA scatter pays —
+#     net ~0. The FFM kernel wins only because canonical field-major
+#     batches arrive PRE-GROUPED by partition.
