@@ -1,4 +1,4 @@
-"""Generated Japanese lexicon — inflection-paradigm expansion (round 4).
+"""Generated Japanese lexicon — inflection-paradigm expansion (rounds 4-5).
 
 Reference (SURVEY.md §3.19): Kuromoji consults IPADIC (~400k entries).
 Round 3 shipped the lattice/Viterbi MECHANISM with a few hundred
@@ -66,7 +66,7 @@ def expand_i_adjective(dict_form: str) -> List[str]:
     return [dict_form, stem + "く", stem + "かっ", stem + "けれ"]
 
 
-# --- seed stems (dictionary forms; all standard JLPT N5-N3 vocabulary) ----
+# --- seed stems (dictionary forms; JLPT N5-N3 core + round-5 N2 bands) ----
 
 _GODAN = """
 会う 合う 買う 使う 思う 言う 歌う 習う 払う 笑う 洗う 手伝う 向かう
@@ -90,6 +90,15 @@ _GODAN = """
 広がる 繋がる 助かる 見つかる 受かる 預かる 儲かる 捕まる 温まる
 強まる 弱まる 高まる 深まる 早まる 静まる 泊まる 固まる 埋まる
 加わる 伝わる 教わる 終わる 関わる 代わる 換わる 刺さる 挟まる
+行う 祝う 争う 従う 奪う 養う 雇う 伺う 味わう 補う 覆う
+抜く 吹く 拭く 巻く 突く 付く 描く 築く 響く 傾く 嘆く 裂く
+担ぐ 塞ぐ 研ぐ
+伸ばす 飛ばす 外す 励ます 促す 冷ます 覚ます 交わす 散らす 漏らす 活かす
+経つ 絶つ 断つ 放つ 撃つ
+及ぶ 滅ぶ 忍ぶ
+編む 組む 刻む 縮む 拒む 憎む 囲む 絡む 励む 臨む 止む
+飾る 削る 語る 握る 殴る 練る 滑る 焦る 誤る 劣る 探る 蹴る
+募る 凝る 粘る 茂る 頼る 限る 迫る 余る 実る 参る
 """
 
 _ICHIDAN = """
@@ -106,6 +115,11 @@ _ICHIDAN = """
 倒れる 壊れる 汚れる 濡れる 折れる 切れる 割れる 破れる 倒れる
 売れる 取れる 外れる 離れる 流れる 溢れる 現れる 表れる 隠れる
 触れる 晴れる 枯れる 暮れる 遅れる 優れる 慣れる 揺れる 別れる
+生きる 過ぎる 閉じる 応じる 命じる 禁じる 演じる
+述べる 構える 整える 揃える 備える 蓄える 例える 唱える 抱える
+押さえる 鍛える 与える 求める 認める 収める 納める 治める
+改める 緩める 強める 弱める 深める 広める 高める 埋める 染める
+諦める 丸める 固める 掲げる
 """
 
 _SURU_NOUNS = """
@@ -120,6 +134,11 @@ _SURU_NOUNS = """
 予防 治療 回復 増加 減少 変化 発展 進歩 成長 拡大 縮小 移動 停止
 開始 終了 継続 中止 延期 変更 修正 訂正 削除 追加 選択 決定 判断
 比較 区別 分類 整理 管理 経営 営業 宣伝 広告 募集 応募 採用 解雇
+意識 認識 把握 維持 保存 保証 設定 設置 設立 建設 建築 破壊 開発
+開催 解決 解釈 解説 分析 負担 担当 操作 処理 対応 対策 適用 応用
+利用 使用 活用 雇用 作成 制作 提供 提案 提出 支持 支援 援助 救助
+攻撃 防止 禁止 駐車 発売 発行 発生 発見 発明 実施 実行 実現 実験
+経験 体験 検討 修理 改善 改革
 """
 
 _I_ADJ = """
@@ -134,6 +153,8 @@ _I_ADJ = """
 詳しい 等しい 親しい 珍しい 激しい 貧しい 涼しい 大人しい 凄い
 偉い 賢い 緩い きつい 丸い 四角い 青白い 真っ白い 細かい 荒い
 粗い 淡い 濃い 渋い 鈍い 温い 生ぬるい ぬるい しつこい くどい
+面白い 情けない 騒がしい 好ましい 望ましい 険しい 乏しい 著しい
+頼もしい 久しい 幼い 醜い 憎い 清い 潔い
 """
 
 _NA_ADJ_ADV_NOUN = """
@@ -173,6 +194,20 @@ _NA_ADJ_ADV_NOUN = """
 一つ 二つ 三つ 四つ 五つ 六つ 七つ 八つ 九つ 十 二十 三十 四十
 五十 六十 七十 八十 九十 半 倍 数 番号 番 号 位 等 割 割合 率
 全体 部分 一部 大部分 多く 少数 複数 単数 合計 平均 約 およそ
+情報 結果 原因 理由 目的 目標 方法 手段 内容 状態 状況 場合 場所 意見
+場面 相手 関係 関心 興味 印象 効果 性格 性質 特徴 種類 条件 基準
+標準 水準 程度 範囲 地域 地方 都市 都会 田舎 郊外 国内 国際 海外
+外国 国民 市民 住民 人口 人間 人生 人類 男性 女性 大人 子供 若者
+老人 高齢者 青年 少年 少女 年齢 名字 住所 郵便 郵便局 葉書 切手
+封筒 小包 宅配 雑誌 辞書 辞典 教科書 参考書 漫画 絵本 書類 資料
+記事 作者 著者 読者 筆者 画家 俳優 女優 監督 選手 審判 観客 舞台
+劇場 映画館 美術館 水族館 遊園地 温泉 旅館 空港 線路 道路 交差点
+信号 横断歩道 歩道 車道 地下 地上 屋上 屋根 壁 床 天井 階段 廊下
+玄関 台所 居間 寝室 風呂 押入れ 引き出し 棚 本棚 冷蔵庫 洗濯機
+掃除機 炊飯器 扇風機 暖房 冷房 電気 電池 電源 電球
+なかなか ほとんど しばらく だんだん どんどん そろそろ いよいよ
+ますます わざわざ しっかり はっきり のんびり いきなり 再び 既に
+一応
 """
 
 

@@ -79,6 +79,15 @@ JA_GOLD = [
     ("毎日勉強します", ["毎日", "勉強", "し", "ます"]),
     ("毎朝走ります", ["毎朝", "走り", "ます"]),
     ("時々映画を見ます", ["時々", "映画", "を", "見", "ます"]),
+    # round-5 lexicon expansion (N2 vocabulary bands)
+    ("情報を分析します", ["情報", "を", "分析", "し", "ます"]),
+    ("新しい方法を提案します", ["新しい", "方法", "を", "提案", "し", "ます"]),
+    ("面白い漫画を読みます", ["面白い", "漫画", "を", "読み", "ます"]),
+    ("空港まで荷物を運びます", ["空港", "まで", "荷物", "を", "運び", "ます"]),
+    ("問題の原因を調べます", ["問題", "の", "原因", "を", "調べ", "ます"]),
+    ("会議で意見を述べます", ["会議", "で", "意見", "を", "述べ", "ます"]),
+    ("目標を高く掲げます", ["目標", "を", "高く", "掲げ", "ます"]),
+    ("経験を活かします", ["経験", "を", "活かし", "ます"]),
 ]
 
 CN_GOLD = [
