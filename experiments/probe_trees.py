@@ -191,3 +191,16 @@ def phase_breakdown():
 
 if __name__ == "__main__":
     phase_breakdown()
+
+# --- round-5 A/B: is the fused kernel's one-hot CONSTRUCTION the lever?
+# Variants of the [d*n_bins, CHUNK] bin one-hot build, measured at the RF
+# bench shape (1M rows, d=28, 64 bins, S=2, chunk 512, 256 nodes):
+#   d-loop of jnp.where (current)     : 25.26 ms   bit-identical
+#   broadcast_to + reshape            : 33.99 ms   (sublane-collapse
+#       reshape lowers WORSE than the where-chain)
+#   pltpu.repeat(bins, n_bins, 0)     : 25.39 ms   (speed-neutral; row
+#       order is b-major so out rows would need the inverse permute)
+# Conclusion: the construction is NOT separable overhead — Mosaic already
+# overlaps it; the ~27-31% MXU ceiling is the intrinsic compare+accumulate
+# mix of this layout, and ROADMAP gap #3 ("a radically different binning
+# layout for the next factor") stands confirmed.
