@@ -203,75 +203,110 @@ def _ema_scan(a, b):
     return jax.lax.associative_scan(comp, (a, b), axis=0)[1]
 
 
-def _solve_small(G, R):
-    """Batched solve of [T, n, n] systems by closed form for n <= 3
-    (division / 2x2 adjugate / 3x3 Cramer) — pure elementwise VPU work.
-    jnp.linalg.solve's batched LU measured 64.2 ms vs 8.9 ms for this at
-    [65536, 3, 3] on v5e (7.2x), and the default 1D changefinder pays TWO
-    such solves per run. n > 3 (e.g. the 2D stream's kd = 6 Yule-Walker)
-    falls back to the LAPACK-style path.
+def _solve_small(G, R, pd: bool = False, with_logdet: bool = False):
+    """Batched solve of symmetric [T, n, n] systems by closed form for
+    n <= 3 — pure elementwise VPU work. jnp.linalg.solve's batched LU
+    measured 64.2 ms vs 8.9 ms at [65536, 3, 3] on v5e (7.2x), and the
+    default 1D changefinder pays TWO such solves per run. n > 3 (the 2D
+    stream's kd = 6 Yule-Walker) falls back to the LAPACK-style path.
 
-    Numerical design (assumes PD-ish systems with nonzero diagonals —
-    ridged covariances, which is every call site here): each system is
-    Jacobi-equilibrated by D = diag(1/sqrt(|G_ii|)) — solve
-    (D G D) y = D R, x = D y. Unlike one global max-scale, this respects
-    HETEROGENEOUS channel scales (a [1e12, 1e-6] diagonal equilibrates to
-    a correlation-like matrix with unit diagonal instead of drowning the
-    small channel), keeps the degree-n determinant products inside f32
-    range (covariance entries ~1e13 overflowed the raw 3x3 det where
-    LU's pivoting stayed finite), and makes the det floor meaningful:
-    |det| of the equilibrated matrix is floored at f32 cancellation
-    noise (1e-7 — below that the explicit product of O(1) entries is
-    noise and the division would return inf/NaN where LU degrades
-    gracefully)."""
+    Numerical design: each system is Jacobi-equilibrated by
+    D = diag(1/sqrt(|G_ii|)) — solve (D G D) y = D R, x = D y — then
+    solved by an UNROLLED LDL^T factorization. Equilibration respects
+    heterogeneous channel scales (a [1e12, 1e-6] diagonal becomes a
+    correlation-like matrix instead of drowning the small channel) and
+    keeps products inside f32 range (covariance entries ~1e13 overflowed
+    an explicit 3x3 det). LDL rather than Cramer/adjugate because the
+    SEQUENTIAL pivots are each individually f32-representable: a smooth
+    series (ChangeFinder's stage-2 input) makes the YW matrix
+    near-all-ones, whose true ridge-induced det ~1e-12 is far below the
+    ~1e-7 cancellation noise of an explicit cofactor product — Cramer +
+    a det floor returned coefficients ~1e5 off there, while LDL's pivots
+    carry only per-factor rounding (the same reason LAPACK works in f32).
+
+    pd=False (default): pivots keep their sign, floored at |1e-7| — the
+    SDAR discounted-moment Toeplitz is INDEFINITE in general (its c[j]
+    are cross-moments, not true autocovariances; a measured t=4 stage-2
+    system had det(correlation) = -0.0037 with a legitimate -0.018 third
+    pivot that a positive clamp turned into garbage x1e5). pd=True: the
+    caller asserts PD (ridged sigma from an outer-product EMA + PD
+    init), so a non-positive pivot is pure f32 cancellation noise and
+    clamps POSITIVE.
+
+    with_logdet=True (requires pd=True, n <= 3): also return
+    log det(G) = sum_i log d_i + 2 sum_i log s_i computed from the SAME
+    floored pivots the solve used — the caller's Gaussian NLL then pairs
+    a Mahalanobis term and a logdet that assume one determinant, by
+    construction rather than by parallel code.
+
+    Known limit (documented, not defended): unpivoted LDL on an
+    INDEFINITE system whose leading 2x2 block is near-singular while the
+    full matrix is well-conditioned (c0 ~= c1 with c2 << c0) floors d2
+    and computes x2 as a difference of ~1/1e-7-scaled terms — ~O(1)
+    relative error for that system where pivoted LU is exact. Scores
+    stay finite (SDAR absorbs one bad prediction into sigma), the
+    pattern needs an autocorrelation shape smooth/noisy streams don't
+    produce, and per-system pivoting would forfeit the closed form."""
     import jax.numpy as jnp
 
     n = G.shape[-1]
     if n == 1:
-        return R / G[..., 0:1, :]
+        x = R / G[..., 0:1, :]
+        if with_logdet:
+            return x, jnp.log(jnp.abs(G[..., 0, 0]))
+        return x
     if n > 3:
         # LAPACK-style path on the RAW system (pivoting handles scale)
+        assert not with_logdet
         return jnp.linalg.solve(G, R)
     s = jnp.sqrt(jnp.maximum(
         jnp.abs(jnp.diagonal(G, axis1=-2, axis2=-1)), 1e-30))   # [..., n]
     G = G / (s[..., :, None] * s[..., None, :])
     R = R / s[..., :, None]
 
-    def _floor(det):
-        # PD assumption (docstring): the true det is positive, so a zero
-        # or negative explicit product is pure cancellation noise — clamp
-        # POSITIVE, matching the d==2 logdet's jnp.maximum(detc, 1e-7) so
-        # both halves of the NLL assume the same determinant
-        return jnp.maximum(det, 1e-7)
+    if pd:
+        def _piv(dd):
+            return jnp.maximum(dd, 1e-7)
+    else:
+        def _piv(dd):
+            return jnp.where(jnp.abs(dd) < 1e-7,
+                             jnp.where(dd < 0, -1e-7, 1e-7), dd)
 
-    def _unscale(y):
-        return y / s[..., :, None]
+    def _with_ld(x, pivots):
+        if not with_logdet:
+            return x
+        assert pd, "with_logdet requires a PD system (log of pivots)"
+        ld = 2.0 * jnp.log(s).sum(-1)
+        for dd in pivots:
+            ld = ld + jnp.log(dd)
+        return x, ld
+
     if n == 2:
-        a, b = G[..., 0, 0], G[..., 0, 1]
-        c, d = G[..., 1, 0], G[..., 1, 1]
-        det = _floor(a * d - b * c)
-        adj = jnp.stack([jnp.stack([d, -b], -1),
-                         jnp.stack([-c, a], -1)], -2)
-        return _unscale(
-            jnp.einsum("...ij,...jk->...ik", adj, R) / det[..., None, None])
-    a, b, c = G[..., 0, 0], G[..., 0, 1], G[..., 0, 2]
-    d, e, f = G[..., 1, 0], G[..., 1, 1], G[..., 1, 2]
-    g, h, i = G[..., 2, 0], G[..., 2, 1], G[..., 2, 2]
-    A = e * i - f * h
-    B = -(b * i - c * h)
-    C = b * f - c * e
-    D = -(d * i - f * g)
-    E = a * i - c * g
-    F = -(a * f - c * d)
-    Gc = d * h - e * g
-    H = -(a * h - b * g)
-    I = a * e - b * d
-    det = _floor(a * A + d * B + g * C)   # first-column cofactors
-    adj = jnp.stack([jnp.stack([A, B, C], -1),
-                     jnp.stack([D, E, F], -1),
-                     jnp.stack([Gc, H, I], -1)], -2)
-    return _unscale(
-        jnp.einsum("...ij,...jk->...ik", adj, R) / det[..., None, None])
+        d1 = _piv(G[..., 0, 0])
+        l21 = G[..., 1, 0] / d1
+        d2 = _piv(G[..., 1, 1] - l21 * l21 * d1)
+        z1 = R[..., 0, :]
+        z2 = R[..., 1, :] - l21[..., None] * z1
+        x2 = z2 / d2[..., None]
+        x1 = z1 / d1[..., None] - l21[..., None] * x2
+        return _with_ld(jnp.stack([x1, x2], axis=-2) / s[..., :, None],
+                        (d1, d2))
+
+    d1 = _piv(G[..., 0, 0])
+    l21 = G[..., 1, 0] / d1
+    l31 = G[..., 2, 0] / d1
+    d2 = _piv(G[..., 1, 1] - l21 * l21 * d1)
+    l32 = (G[..., 2, 1] - l31 * l21 * d1) / d2
+    d3 = _piv(G[..., 2, 2] - l31 * l31 * d1 - l32 * l32 * d2)
+    z1 = R[..., 0, :]
+    z2 = R[..., 1, :] - l21[..., None] * z1
+    z3 = R[..., 2, :] - l31[..., None] * z1 - l32[..., None] * z2
+    x3 = z3 / d3[..., None]
+    x2 = z2 / d2[..., None] - l32[..., None] * x3
+    x1 = (z1 / d1[..., None] - l21[..., None] * x2
+          - l31[..., None] * x3)
+    return _with_ld(jnp.stack([x1, x2, x3], axis=-2) / s[..., :, None],
+                    (d1, d2, d3))
 
 
 def _sdar_scores(x, r: float, k: int):
@@ -359,21 +394,16 @@ def _sdar_scores(x, r: float, k: int):
     # per-diagonal relative ridge (same rationale as the YW system's)
     sd = jnp.abs(jnp.diagonal(sigma, axis1=-2, axis2=-1))        # [T, d]
     sig = sigma + jnp.eye(d) * (1e-9 * jnp.maximum(sd, 1.0))[:, :, None]
-    if d == 2:
-        # closed-form logdet via the Jacobi-equilibrated (correlation)
-        # matrix — per-channel scales survive heterogeneous magnitudes,
-        # and the det floor matches _solve_small's 1e-7 so the logdet and
-        # Mahalanobis halves of the same NLL assume the SAME determinant
-        sc = jnp.sqrt(jnp.maximum(
-            jnp.abs(jnp.diagonal(sig, axis1=-2, axis2=-1)), 1e-30))
-        cor = sig / (sc[:, :, None] * sc[:, None, :])
-        detc = cor[:, 0, 0] * cor[:, 1, 1] - cor[:, 0, 1] * cor[:, 1, 0]
-        logdet = (2.0 * jnp.log(sc).sum(-1)
-                  + jnp.log(jnp.maximum(detc, 1e-7)))
+    if d <= 3:
+        # one LDL factorization serves both halves of the NLL: the
+        # Mahalanobis solve and the logdet come from the SAME equilibrated
+        # floored pivots, so they assume one determinant by construction
+        sol, logdet = _solve_small(sig, err[..., None], pd=True,
+                                   with_logdet=True)
     else:
         _, logdet = jnp.linalg.slogdet(sig)
-    maha = jnp.einsum("td,td->t", err,
-                      _solve_small(sig, err[..., None])[..., 0])
+        sol = jnp.linalg.solve(sig, err[..., None])
+    maha = jnp.einsum("td,td->t", err, sol[..., 0])
     return 0.5 * (d * jnp.log(2 * jnp.pi) + logdet + maha)
 
 

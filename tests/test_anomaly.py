@@ -146,18 +146,20 @@ def test_solve_small_matches_linalg_solve():
 
 
 def test_solve_small_large_magnitude_no_overflow():
-    """The max-scaling inside _solve_small keeps the explicit det/adjugate
-    finite at covariance magnitudes (~1e13) a |x| ~ 5e6 series produces —
-    the unscaled f32 3x3 determinant overflowed there (round-5 review
-    finding), and changefinder itself must stay finite end to end."""
+    """Jacobi equilibration inside _solve_small keeps the closed-form
+    LDL solve finite and accurate at covariance magnitudes (~1e13) a
+    |x| ~ 5e6 series produces — the original unscaled explicit 3x3
+    determinant overflowed f32 there (round-5 review finding), and
+    changefinder itself must stay finite end to end."""
     import jax.numpy as jnp
     import numpy as np
 
     from hivemall_tpu.models.anomaly import _solve_small, changefinder
 
     rng = np.random.default_rng(11)
-    G = jnp.asarray((rng.standard_normal((32, 3, 3)) + 4 * np.eye(3))
-                    * 2.5e13, jnp.float32)
+    B = rng.standard_normal((32, 3, 3))
+    G = jnp.asarray((B @ B.transpose(0, 2, 1) + 4 * np.eye(3))
+                    * 2.5e13, jnp.float32)   # symmetric, like every caller
     R = jnp.asarray(rng.standard_normal((32, 3, 1)) * 2.5e13, jnp.float32)
     got = np.asarray(_solve_small(G, R))
     assert np.isfinite(got).all()
@@ -211,3 +213,25 @@ def test_changefinder_heterogeneous_channel_scales():
     cf = ChangeFinder2D(2, 0.02, 2, 7, 7)
     stream = np.asarray([cf.update(v) for v in x])
     np.testing.assert_allclose(stream[:, 0], out, rtol=5e-3, atol=5e-3)
+
+
+def test_solve_small_indefinite_yw_system():
+    """The discounted-moment Toeplitz is INDEFINITE in general (its
+    lags are cross-moments). This is the measured stage-2 t=4 system
+    whose correlation det is negative: the sign-preserving pivot floor
+    must reproduce the LU solution (a positive clamp returned
+    coefficients ~1e5 off and broke the anomaly example's change
+    detection)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hivemall_tpu.models.anomaly import _solve_small
+
+    T = np.array([[5.10714, 4.55693, 2.98017],
+                  [4.55693, 5.10714, 4.55693],
+                  [2.98017, 4.55693, 5.10714]]) + 1e-6 * np.eye(3)
+    R = np.array([4.55693, 2.98017, 0.0])[:, None]
+    got = np.asarray(_solve_small(jnp.asarray(T, jnp.float32)[None],
+                                  jnp.asarray(R, jnp.float32)[None]))[0]
+    want = np.linalg.solve(T, R)
+    np.testing.assert_allclose(got, want, rtol=2e-3)

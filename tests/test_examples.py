@@ -50,3 +50,19 @@ def test_anomaly_stream_example():
     assert abs(rec["scalar_outlier_at"] - rec["scalar_outlier_true"]) <= 2
     assert abs(rec["scalar_change_at"] - half) <= 40
     assert abs(rec["vector_change_at"] - half) <= 40
+
+
+def test_higgs_trees_example():
+    rec = _run(["examples/higgs_trees.py", "--rows", "2048"])
+    assert rec["rf_train_accuracy"] > 0.8
+    assert rec["gbdt_train_accuracy"] > 0.8
+    assert rec["rf_rows_per_sec"] > 0
+
+
+def test_text8_word2vec_example():
+    rec = _run(["examples/text8_word2vec.py", "--docs", "120"])
+    assert rec["vocab"] > 0
+    # tiny synthetic corpora need not separate topics; the contract here
+    # is the pipeline runs and reports finite similarity metrics
+    assert -1.0 <= rec["within_topic_cos"] <= 1.0
+    assert -1.0 <= rec["across_topic_cos"] <= 1.0
