@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""NLP -> topic-model pipeline: tokenize_cn / tokenize_ja feed train_lda.
+
+Reference parity (SURVEY.md §3.19 + §3.10): hivemall.nlp tokenizers feed
+hivemall LDA/pLSA in SQL; here the same composition runs through the
+catalog — tokenize_cn auto-loads its full-coverage system dictionary
+(~349k entries from the in-image jieba package, round 5) so Chinese text
+segments at SmartCN quality out of the box, then LDA's vectorized batch
+ingest learns topics over the token stream.
+
+Usage: python examples/nlp_topics.py [--docs 400] [--topics 2]
+Synthetic bilingual corpus: half the documents talk about technology,
+half about food — LDA should separate them.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=400)
+    ap.add_argument("--topics", type=int, default=2)
+    args = ap.parse_args()
+
+    from hivemall_tpu.catalog.registry import lookup
+
+    tokenize_cn = lookup("tokenize_cn").resolve()
+    tokenize_ja = lookup("tokenize_ja").resolve()
+    LDA = lookup("train_lda").resolve()
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+
+    tech_cn = "人工智能 改变 世界 计算机 网络 数据 软件 系统 技术 发展".split()
+    food_cn = "米饭 面条 饺子 水果 苹果 蔬菜 咖啡 牛奶 好吃 新鲜".split()
+    tech_ja = ["技術", "科学", "計算", "情報", "研究"]
+    food_ja = ["料理", "野菜", "果物", "美味しい", "食事"]
+
+    def make_doc(topic_words, n=12):
+        return "".join(rng.choice(topic_words, n))
+
+    docs, labels = [], []
+    n = max(args.docs, 40)
+    for i in range(n):
+        tech = i % 2 == 0
+        cn_words = tech_cn if tech else food_cn
+        ja_words = tech_ja if tech else food_ja
+        toks = tokenize_cn(make_doc(cn_words))
+        toks += tokenize_ja("".join(rng.choice(ja_words, 4)))
+        docs.append(toks)
+        labels.append(0 if tech else 1)
+
+    from hivemall_tpu.frame.cn_segmenter import system_dictionary_info
+    info = system_dictionary_info()
+
+    t0 = time.time()
+    lda = LDA(f"-topics {args.topics} -iter 20")
+    lda.fit(docs)
+    fit_s = time.time() - t0
+
+    # doc -> argmax topic; purity = each topic votes its majority
+    # construction label (valid for any -topics, not just 2)
+    assign = np.asarray([int(np.argmax(lda.transform(d))) for d in docs])
+    labels = np.asarray(labels)
+    correct = 0
+    for t in range(args.topics):
+        in_t = labels[assign == t]
+        if in_t.size:
+            correct += int(max((in_t == 0).sum(), (in_t == 1).sum()))
+    purity = correct / len(labels)
+
+    print(json.dumps({
+        "config": "nlp_topics",
+        "docs": n,
+        "cn_dictionary": info["state"],
+        "cn_dictionary_entries": info["entries"],
+        "fit_seconds": round(fit_s, 2),
+        "docs_per_sec": round(n / fit_s, 1),
+        "topic_purity": round(purity, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

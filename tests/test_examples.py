@@ -66,3 +66,9 @@ def test_text8_word2vec_example():
     # is the pipeline runs and reports finite similarity metrics
     assert -1.0 <= rec["within_topic_cos"] <= 1.0
     assert -1.0 <= rec["across_topic_cos"] <= 1.0
+
+
+def test_nlp_topics_example():
+    rec = _run(["examples/nlp_topics.py", "--docs", "80"])
+    assert rec["cn_dictionary"] in ("loaded", "absent")
+    assert rec["topic_purity"] >= 0.9
