@@ -62,6 +62,10 @@ def main():
 
     sst_scores = np.asarray(sst(shift, "-w 24 -r 3"))
     sst_hit = int(np.argmax(sst_scores))
+    # the reference's fast power-iteration score function (round 5):
+    # batched matmuls only, ~100x the SVD path on TPU, same peak
+    sst_ika = np.asarray(sst(shift, "-w 24 -r 3 -scorefunc ika"))
+    sst_ika_hit = int(np.argmax(sst_ika))
 
     print(json.dumps({
         "points": n,
@@ -69,6 +73,7 @@ def main():
         "scalar_change_at": shift_hit, "scalar_change_true": half,
         "vector_change_at": shift2_hit, "vector_change_true": half,
         "sst_change_at": sst_hit,
+        "sst_ika_change_at": sst_ika_hit,
     }))
 
 
