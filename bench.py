@@ -166,15 +166,25 @@ def bench_ffm_kernel(n_steps: int = 30, warmup: int = 5) -> dict:
     }
 
 
-def _criteo_synth(n_rows: int, seed: int):
+def _criteo_synth(n_rows: int, seed: int, smoke: bool = False):
     """Shared Criteo-shaped synthetic corpus + warmed flagship trainer for
-    the end-to-end benches (one recipe so their numbers stay comparable)."""
+    the end-to-end benches (one recipe so their numbers stay comparable).
+    smoke=True shrinks every shape to CPU-feasible sizes (--smoke mode:
+    the harness plumbing is what's under test, not the kernels) and pins
+    -ingest_workers 2 so the pipeline stage counters are exercised."""
     import numpy as np
     from hivemall_tpu.io.sparse import SparseDataset
     from hivemall_tpu.models.fm import FFMTrainer
 
-    B, L, F, K = 16384, 39, 39, 4
-    dims = 1 << 22
+    if smoke:
+        B, L, F, K = 128, 8, 8, 2
+        dims = 1 << 12
+        extra = "-ingest_workers 2"     # joint layout: Pallas interpret
+                                        # mode on CPU is not smoke material
+    else:
+        B, L, F, K = 16384, 39, 39, 4
+        dims = 1 << 22
+        extra = "-ffm_table parts"
     rng = np.random.default_rng(seed)
     idx = rng.integers(1, dims, (n_rows, L)).astype(np.int32)
     fld = np.tile(np.arange(L, dtype=np.int32), (n_rows, 1))
@@ -183,8 +193,7 @@ def _criteo_synth(n_rows: int, seed: int):
     ds = SparseDataset(idx.ravel(), indptr,
                        np.ones(n_rows * L, np.float32), lab, fld.ravel())
     t = FFMTrainer(f"-dims {dims} -factors {K} -fields {F} -mini_batch {B} "
-                   f"-opt adagrad -classification -halffloat "
-                   f"-ffm_table parts")
+                   f"-opt adagrad -classification -halffloat {extra}")
     # warm the jitted step OUTSIDE the timed region (compile time is not
     # the input path these benches characterize) — through the SAME
     # preprocess path fit() takes, so the canonical/unit-val variant that
@@ -196,7 +205,7 @@ def _criteo_synth(n_rows: int, seed: int):
     return ds, t, B, L
 
 
-def bench_ffm_e2e(n_rows: int = 131072) -> dict:
+def bench_ffm_e2e(n_rows: int = 131072, smoke: bool = False) -> dict:
     """End-to-end FFM: host CSR -> pad/batch -> canonicalize -> h2d ->
     fused train step. This is the input-path-included number SURVEY §8
     warns about ('the input path can easily be the bottleneck'). Best of
@@ -204,29 +213,41 @@ def bench_ffm_e2e(n_rows: int = 131072) -> dict:
     import numpy as np
     import jax
     import jax.numpy as jnp
-    ds, t, B, L = _criteo_synth(n_rows, seed=1)
+    ds, t, B, L = _criteo_synth(n_rows, seed=1, smoke=smoke)
 
     def run():
         t.fit(ds, epochs=1)
         _sync(t)
 
     best, med, _ = _repeat(run, 3)
+    # stage decomposition from the LAST fit's pipeline counters (reset per
+    # fit): prep busy/wait, h2d stage time, train-loop wait on input, and
+    # the prepared-batch queue occupancy — the observability hook every
+    # later ingest PR reads
+    pipeline_stats = t.pipeline_stats.as_dict()
     # --- overlap decomposition (VERDICT r4 item 1): time the two legs the
     # e2e wall is made of, in the same process. T_in = the input pipeline
-    # alone (host prep + canonicalize + pack + h2d through the prefetcher,
-    # value-synced); T_comp = the step loop alone on a pre-staged batch.
+    # alone (host prep + canonicalize + pack + h2d through the SAME
+    # ingest-pipeline + prefetcher stack fit uses, value-synced); T_comp =
+    # the step loop alone on a pre-staged batch.
     # overlap = how much of min(T_in, T_comp) the pipeline hid.
     from hivemall_tpu.io.prefetch import DevicePrefetcher
 
     def input_only():
-        it = DevicePrefetcher(map(t._preprocess_train_batch,
-                                  ds.batches(B, shuffle=False)), depth=2)
+        closers = []
+        it = t._ingest_iter(ds.batches(B, shuffle=False), closers)
+        it = t._wrap_prefetch(it, closers)
         tot = jnp.zeros((), jnp.uint32)
         n_b = 0
-        for b in it:
-            buf = b.buf if hasattr(b, "buf") else b.idx
-            tot = tot + jnp.asarray(buf).ravel()[:8].astype(jnp.uint32).sum()
-            n_b += 1
+        try:
+            for b in it:
+                buf = b.buf if hasattr(b, "buf") else b.idx
+                tot = tot + jnp.asarray(buf).ravel()[:8].astype(
+                    jnp.uint32).sum()
+                n_b += 1
+        finally:
+            for c in reversed(closers):
+                c()
         float(np.asarray(tot))          # force every transfer to complete
         return n_b
 
@@ -278,6 +299,8 @@ def bench_ffm_e2e(n_rows: int = 131072) -> dict:
         "wire_bytes_per_row": round(wire_bytes / n_rows, 1),
         "relay_bandwidth_ceiling_examples_per_sec": round(n_rows / t_wire, 1),
         "delivery_fraction": round((n_rows / best) / (n_rows / t_wire), 3),
+        "pipeline": pipeline_stats,
+        "ingest_workers": t._resolved_ingest_workers(),
         "note": "overlap = (T_in + T_comp - wall) / min(T_in, T_comp); "
                 "input leg = host canonicalize+pack + h2d (ONE packed "
                 "uint8 buffer per batch: 3-byte idx lanes, f32 label "
@@ -288,18 +311,19 @@ def bench_ffm_e2e(n_rows: int = 131072) -> dict:
     }
 
 
-def bench_ffm_parquet_stream(n_rows: int = 131072) -> dict:
+def bench_ffm_parquet_stream(n_rows: int = 131072, smoke: bool = False) -> dict:
     """Out-of-core production path: Parquet shards on disk -> ParquetStream
-    (per-epoch shard re-read, prefetch overlap) -> fused FFM train step.
+    (decode-ahead shard re-read, prefetch overlap) -> fused FFM train step.
     Same corpus recipe as bench_ffm_e2e so the numbers are comparable."""
     import shutil
     import tempfile
     from hivemall_tpu.io.arrow import ParquetStream, write_parquet_shards
 
-    ds, t, B, L = _criteo_synth(n_rows, seed=3)
+    ds, t, B, L = _criteo_synth(n_rows, seed=3, smoke=smoke)
     tmp = tempfile.mkdtemp(prefix="bench_ffm_pq_")
     try:
-        write_parquet_shards(ds, tmp, rows_per_shard=32768)
+        write_parquet_shards(ds, tmp,
+                             rows_per_shard=2 * B if smoke else 32768)
         stream = ParquetStream(tmp)
 
         def run():
@@ -307,6 +331,12 @@ def bench_ffm_parquet_stream(n_rows: int = 131072) -> dict:
             _sync(t)
 
         best, med, _ = _repeat(run, 3)
+        # snapshot the stage counters NOW: both ParquetStream.batches()
+        # and fit_stream reset stats per call, and the replay runs below
+        # would otherwise overwrite the streaming run the headline number
+        # came from
+        shard_decode = stream.stats.as_dict()
+        pipeline_stats = t.pipeline_stats.as_dict()
         # multi-epoch production path: epoch 1 streams + retains staged
         # buffers, epochs >= 2 replay device-resident (no link re-cross).
         # The replay ops compile at the FULL corpus shapes, so warm them
@@ -330,6 +360,9 @@ def bench_ffm_parquet_stream(n_rows: int = 131072) -> dict:
         "seconds": round(best, 3),
         "value_replay_epochs_per_sec": round(replay_rate, 1),
         "replay_epochs": 3,
+        "decode_ahead": stream.decode_ahead,
+        "shard_decode": shard_decode,
+        "pipeline": pipeline_stats,
     }
 
 
@@ -942,6 +975,60 @@ def main_one(name: str) -> None:
     print(json.dumps(rec))
 
 
+# --smoke: tiny-size benchmark shapes. Covers the benches the ingest
+# pipeline touches (plus the emit/summary plumbing); run by run_tests.sh so
+# pipeline refactors can't silently break the bench harness. Asserts only
+# that every metric emits and json-parses — the numbers are meaningless.
+_SMOKE = (
+    ("bench_ingest", {"n_rows": 2000}),
+    ("bench_ffm_e2e", {"n_rows": 512, "smoke": True}),
+    ("bench_ffm_parquet_stream", {"n_rows": 512, "smoke": True}),
+)
+
+# bench_ffm_e2e stage-metric keys the smoke run requires (the acceptance
+# surface of the parallel-ingest observability hook)
+_PIPELINE_KEYS = ("prep_seconds", "prep_wait_seconds",
+                  "prep_backpressure_seconds", "stage_seconds",
+                  "consume_wait_seconds", "avg_queue_occupancy",
+                  "queue_peak", "batches_prepared", "batches_staged")
+
+
+def main_smoke() -> int:
+    """Run every _SMOKE bench at tiny shapes; fail loudly if any record
+    fails to emit, parse, or (for the e2e bench) carry the pipeline stage
+    metrics. Exit code is the number of failures."""
+    import sys
+    t0 = time.perf_counter()
+    failures = 0
+    configs = []
+    for name, kw in _SMOKE:
+        try:
+            rec = json.loads(json.dumps(globals()[name](**kw)))
+            assert rec.get("metric") and "value" in rec \
+                and rec.get("unit") != "failed", rec
+            if name == "bench_ffm_e2e":
+                missing = [k for k in _PIPELINE_KEYS
+                           if k not in rec.get("pipeline", {})]
+                assert not missing, f"pipeline keys missing: {missing}"
+            print(f"smoke {name}: OK ({rec['value']} {rec['unit']})",
+                  file=sys.stderr)
+        except Exception:
+            failures += 1
+            rec = {"metric": name, "value": 0.0, "unit": "failed",
+                   "error": traceback.format_exc()[-600:]}
+            print(f"smoke {name}: FAILED\n{rec['error']}", file=sys.stderr)
+        configs.append(rec)
+    try:
+        _emit(configs)                  # the emit + summary-line plumbing
+    except Exception:
+        failures += 1
+        print(f"smoke emit: FAILED\n{traceback.format_exc()[-600:]}",
+              file=sys.stderr)
+    print(f"bench --smoke: {len(configs)} configs, {failures} failures, "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    return failures
+
+
 def _supervised():
     """Run the bench in a child process with a hang watchdog.
 
@@ -1053,6 +1140,9 @@ def _supervised():
 
 if __name__ == "__main__":
     import os
+    import sys
+    if "--smoke" in sys.argv[1:]:
+        sys.exit(main_smoke())
     if os.environ.get("HIVEMALL_TPU_BENCH_EMIT"):
         _emit(json.loads(os.environ["HIVEMALL_TPU_BENCH_EMIT"]))
     elif os.environ.get("HIVEMALL_TPU_BENCH_ONE"):

@@ -2,3 +2,4 @@ from .sparse import SparseBatch, SparseDataset, pad_examples  # noqa: F401
 from .libsvm import read_libsvm, write_libsvm  # noqa: F401
 from .amplify import amplify, rand_amplify  # noqa: F401
 from .replay import ReplayCache  # noqa: F401
+from .pipeline import IngestPipeline, PipelineStats  # noqa: F401

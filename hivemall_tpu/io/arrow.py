@@ -245,14 +245,71 @@ class ParquetStream:
 
     def __init__(self, path: str, *, feature_col: str = "features",
                  label_col: str = "label", dims: Optional[int] = None,
-                 ffm: bool = False, num_fields: int = 64):
+                 ffm: bool = False, num_fields: int = 64,
+                 decode_ahead: int = 1):
         self.files = _parquet_files(path)
         self._kw = dict(feature_col=feature_col, label_col=label_col,
                         dims=dims, ffm=ffm, num_fields=num_fields)
+        # decode-ahead: while training consumes the current shard's batches,
+        # a reader thread decodes the NEXT decode_ahead shards (Parquet
+        # read + string parse + hashing — pyarrow releases the GIL on the
+        # IO/decode legs). 0 restores the synchronous per-shard re-read.
+        self.decode_ahead = max(0, int(decode_ahead))
+        from .pipeline import PipelineStats
+        self.stats = PipelineStats(pool="decode-ahead",
+                                   workers=self.decode_ahead)
 
     def _shard(self, path: str) -> SparseDataset:
         import pyarrow.parquet as pq
         return table_to_dataset(pq.read_table(path), **self._kw)
+
+    def _iter_shards(self, files: List[str]) -> Iterator[SparseDataset]:
+        """Yield decoded shards in order, reading up to ``decode_ahead``
+        shards beyond the one being consumed. Row-shuffle rng calls stay in
+        the CONSUMING loop, so shuffled epochs are bit-identical to the
+        synchronous path — only the disk read/parse moves off it."""
+        import time as _time
+        if self.decode_ahead <= 0:
+            for f in files:
+                t0 = _time.perf_counter()
+                ds = self._shard(f)
+                self.stats.add(prep_seconds=_time.perf_counter() - t0,
+                               batches_prepared=1)
+                yield ds
+            return
+        import concurrent.futures as cf
+        ex = cf.ThreadPoolExecutor(max_workers=1,
+                                   thread_name_prefix="pq-decode")
+        try:
+            import itertools
+            from collections import deque
+            pending = deque()
+            it = iter(files)
+
+            def timed_shard(f):
+                t0 = _time.perf_counter()
+                ds = self._shard(f)
+                self.stats.add(prep_seconds=_time.perf_counter() - t0,
+                               batches_prepared=1)
+                return ds
+
+            # prime exactly decode_ahead futures: with the shard the
+            # consumer holds, at most decode_ahead decoded shards sit in
+            # ``pending`` — the memory bound the docs promise
+            for f in itertools.islice(it, self.decode_ahead):
+                pending.append(ex.submit(timed_shard, f))
+            while pending:
+                t0 = _time.perf_counter()
+                ds = pending.popleft().result()
+                self.stats.add(prep_wait_seconds=_time.perf_counter() - t0)
+                nxt = next(it, None)
+                if nxt is not None:
+                    pending.append(ex.submit(timed_shard, nxt))
+                yield ds
+        finally:
+            for p in pending:
+                p.cancel()
+            ex.shutdown(wait=False)
 
     def __len__(self) -> int:
         import pyarrow.parquet as pq
@@ -277,14 +334,19 @@ class ParquetStream:
                 shuffle: bool = True, seed: int = 42,
                 max_len: Optional[int] = None,
                 truncate: bool = False) -> Iterator[SparseBatch]:
+        # fresh decode counters per stream traversal: repeat-fit callers
+        # (the bench's best-of-3) read a per-call snapshot, not a lifetime
+        # accumulation masquerading as one run's decode cost
+        from .pipeline import PipelineStats
+        self.stats = PipelineStats(pool="decode-ahead",
+                                   workers=self.decode_ahead)
         L = max_len or self.max_row_len
         rng = np.random.default_rng(seed)
         for ep in range(epochs):
             order = rng.permutation(len(self.files)) if shuffle \
                 else np.arange(len(self.files))
             carry: Optional[SparseDataset] = None
-            for fi in order:
-                ds = self._shard(self.files[fi])
+            for ds in self._iter_shards([self.files[fi] for fi in order]):
                 if carry is not None:
                     ds = _concat_datasets(carry, ds)
                     carry = None

@@ -13,13 +13,22 @@ Usage:
     for batch in DevicePrefetcher(ds.batches(bs), depth=2):
         step(params, batch)           # batch arrays already on device
 
-LearnerBase.fit uses this automatically on accelerator backends.
+LearnerBase.fit uses this automatically on accelerator backends; with
+``-ingest_workers > 1`` the source is an :class:`io.pipeline.IngestPipeline`
+and the two stages share one :class:`io.pipeline.PipelineStats`.
+
+All queue operations BLOCK (no poll loops): the end of the stream is a
+poison pill the worker always delivers, and ``close()`` wakes a worker
+blocked on a full queue by draining until the thread exits. The previous
+0.1 s timeout-poll put/get loops burned a core and added up to 100 ms
+latency per batch at shutdown boundaries.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, Iterator, Optional
 
 import jax
@@ -54,71 +63,84 @@ class DevicePrefetcher:
 
     The worker thread only calls device_put (thread-safe in JAX) and dies
     with the iterator; errors in ``src`` re-raise in the consumer thread.
+    Single-consumer: ``__next__`` and ``close()`` are meant to be called
+    from one thread (the pattern every fit loop follows).
+
+    ``stats`` (optional PipelineStats) records the h2d stage: batches
+    staged, summed device_put seconds, and the consumer's blocked-on-get
+    wait — the three numbers that say whether the wall is transfer-bound.
     """
 
     def __init__(self, src: Iterable[SparseBatch], depth: int = 2,
-                 device=None):
+                 device=None, stats=None):
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
-        self._err: Optional[BaseException] = None
-        self._device = device
+        self._errbox: list = []         # worker's exception, surfaced on next()
         self._closed = threading.Event()
+        self._stats = stats
+
+        # the worker closure captures LOCALS only, never self: a closure
+        # over self would keep an abandoned prefetcher reachable forever
+        # (the thread is a GC root), so __del__ could never fire to
+        # release a worker blocked on a full queue
+        q, closed, errbox = self._q, self._closed, self._errbox
 
         def work():
             try:
                 for b in src:
-                    staged = stage_batch(b, self._device)
-                    while not self._closed.is_set():
-                        try:
-                            self._q.put(staged, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if self._closed.is_set():
+                    t0 = time.perf_counter()
+                    staged = stage_batch(b, device)
+                    if stats is not None:
+                        stats.add(stage_seconds=time.perf_counter() - t0,
+                                  batches_staged=1)
+                    # blocking put: no poll loop. If the consumer abandons
+                    # the stream, close() drains the queue until this
+                    # thread exits, so a put blocked on a full queue
+                    # always wakes.
+                    q.put(staged)
+                    if closed.is_set():
                         return          # consumer abandoned the stream
             except BaseException as e:          # surfaced on next()
-                self._err = e
+                errbox.append(e)
             finally:
-                # the sentinel MUST reach the consumer or __next__ blocks
-                # forever; only an explicit close() may abandon delivery
-                while not self._closed.is_set():
-                    try:
-                        self._q.put(_STOP, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                # the poison pill MUST reach the consumer or __next__
+                # blocks forever; a blocked put here is woken by close()'s
+                # drain-until-exit loop exactly like the staging put above
+                q.put(_STOP)
 
         self._thread = threading.Thread(target=work, daemon=True)
         self._thread.start()
 
     def close(self) -> None:
-        """Release the worker (called on early exit; safe to call twice)."""
+        """Release the worker (called on early exit; safe to call twice).
+        Drains the queue until the worker exits so a blocked put wakes;
+        bounded at 5 s so a device_put hung on the relay can't turn
+        close() into a permanent hang (the daemon thread is abandoned)."""
         self._closed.set()
-        while True:                     # drain so a blocked put wakes up
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                break
-        self._thread.join(timeout=5)
+        from .pipeline import drain_until_dead
+        drain_until_dead(self._q, self._thread)
 
     def __iter__(self) -> Iterator[SparseBatch]:
         return self
 
     def __next__(self) -> SparseBatch:
-        while True:
-            if self._closed.is_set():       # closed stream ends, never hangs
-                raise StopIteration
-            try:
-                item = self._q.get(timeout=0.1)
-                break
-            except queue.Empty:
-                continue
+        if self._closed.is_set():       # closed stream ends, never hangs
+            raise StopIteration
+        t0 = time.perf_counter()
+        item = self._q.get()            # blocking; the pill always arrives
+        if self._stats is not None:
+            self._stats.add(consume_wait_seconds=time.perf_counter() - t0)
         if item is _STOP:
             self._closed.set()          # further next() calls end immediately
             self._thread.join()
-            if self._err is not None:
-                raise self._err
+            if self._errbox:
+                raise self._errbox[0]
             raise StopIteration
         return item
 
     def __del__(self):
-        self._closed.set()
+        # actually release the worker: setting the event alone left a
+        # worker blocked on a full queue alive until process exit
+        try:
+            self.close()
+        except BaseException:
+            pass

@@ -20,6 +20,7 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tupl
 
 import numpy as np
 
+from ..io.pipeline import PipelineStats
 from ..io.sparse import SparseBatch, SparseDataset, pow2_len, split_feature
 from ..utils.hashing import mhash
 from ..utils.metrics import Meter, get_stream
@@ -47,6 +48,11 @@ def learner_option_spec(name: str, *, classification: bool,
     s.add("iters", "iterations", type=int, default=1, help="epochs")
     s.add("mini_batch", "mini_batch_size", type=int, default=256,
           help="minibatch size dispatched per jitted step")
+    s.add("ingest_workers", type=int, default=0,
+          help="host batch-prep pool size for fit/fit_stream: 0 = auto "
+               "(cores-1 capped at 8 on accelerators, 1 on CPU); 1 = "
+               "strict sequential (bit-exact pre-pipeline behavior); "
+               "N > 1 = N prep worker threads delivering in order")
     s.add("dims", "feature_dimensions", type=int, default=1 << 24,
           help="model table size (hashed feature space)")
     s.flag("dense", "densemodel",
@@ -131,6 +137,7 @@ class LearnerBase:
         self._loss_pending = 0.0              # on-device partial, folded in
         self._examples = 0
         self._meter = Meter()                 # rolling examples/sec (§6)
+        self.pipeline_stats = PipelineStats()  # last fit's ingest metrics
         self._mixer = None
         self._fit_ds = None                   # columnar dataset ref (fit)
         self.mesh = None                      # jax Mesh when -mesh is set
@@ -241,6 +248,7 @@ class LearnerBase:
             import jax
             LearnerBase._profiled = True
             jax.profiler.start_trace(prof_dir)
+        self.pipeline_stats = PipelineStats()   # fresh counters per fit
         try:
             self._fit_epochs(ds, epochs, bs, shuffle, prefetch, ckdir)
         finally:
@@ -261,17 +269,17 @@ class LearnerBase:
             import jax
             prefetch = jax.default_backend() != "cpu" and self.mesh is None
         for ep in range(epochs):
-            it = map(self._preprocess_train_batch,
-                     ds.batches(bs, shuffle=shuffle, seed=seed0 + ep))
+            closers: List = []
+            it = self._ingest_iter(
+                ds.batches(bs, shuffle=shuffle, seed=seed0 + ep), closers)
             if prefetch:
-                from ..io.prefetch import DevicePrefetcher
-                it = DevicePrefetcher(it, depth=2)
+                it = self._wrap_prefetch(it, closers)
             try:
                 for b in it:
                     self._dispatch(b)
             finally:
-                if prefetch:
-                    it.close()       # release the worker on early exit too
+                for c in reversed(closers):
+                    c()              # release the workers on early exit too
             if ckdir:
                 os.makedirs(ckdir, exist_ok=True)
                 path = os.path.join(ckdir, f"{self.NAME}-ep{ep + 1}.npz")
@@ -316,12 +324,82 @@ class LearnerBase:
         return batch
 
     def _preprocess_train_batch(self, batch: SparseBatch):
-        """TRAINING-ONLY per-batch hook (fit / fit_stream / process-flush).
-        Defaults to _preprocess_batch; subclasses whose training dispatch
-        accepts a representation scoring can't consume (e.g. FFM's packed
-        uint8 transfer buffers) override THIS, keeping _preprocess_batch —
-        which the scoring paths share — representation-stable."""
+        """TRAINING-ONLY per-batch hook (fit / fit_stream / process-flush):
+        the serial leg then the parallel leg. Subclasses whose training
+        dispatch accepts a representation scoring can't consume (e.g.
+        FFM's packed uint8 transfer buffers) override the LEGS below,
+        keeping _preprocess_batch — which the scoring paths share —
+        representation-stable."""
+        return self._preprocess_train_parallel(
+            self._preprocess_train_serial(batch))
+
+    def _preprocess_train_serial(self, batch: SparseBatch):
+        """STREAM-ORDER-DEPENDENT training prep. Runs on ONE thread in
+        source order even under -ingest_workers > 1 (the pipeline's
+        submitter side), because the base elision latch (_elision_off)
+        makes a batch's representation depend on the batches before it —
+        fanning it out would make the output order-dependent and break
+        the N-worker == sequential bit-exactness the tests pin."""
         return self._preprocess_batch(batch)
+
+    def _preprocess_train_parallel(self, batch):
+        """ORDER-INDEPENDENT training prep — the leg that fans out across
+        the -ingest_workers pool. Must be a pure function of the batch
+        (FFM's canonicalize + pack lives here)."""
+        return batch
+
+    # -- parallel host ingest (SURVEY.md §8: the input path IS the wall) ----
+    def _resolved_ingest_workers(self) -> int:
+        """-ingest_workers with 0 = auto: cores-1 (cap 8) on accelerators —
+        host prep there runs against a waiting chip — and 1 (strict
+        sequential) on CPU, where the train step already owns the cores.
+        Auto also collapses to 1 when the trainer never overrode the
+        parallel prep leg (base identity): a pool whose workers each run
+        ``return batch`` is pure queue overhead. An EXPLICIT N is always
+        honored (tests drive the pipeline machinery through it)."""
+        n = int(self.opts.get("ingest_workers") or 0)
+        if n > 0:
+            return n
+        if type(self)._preprocess_train_parallel \
+                is LearnerBase._preprocess_train_parallel:
+            return 1
+        import jax
+        if jax.default_backend() == "cpu":
+            return 1
+        from ..io.pipeline import auto_workers
+        return auto_workers()
+
+    def _ingest_iter(self, src, closers: List):
+        """Route ``_preprocess_train_batch`` over ``src`` through the
+        parallel ingest pipeline (io.pipeline). workers <= 1 is a strict
+        sequential fallback — a plain ``map``, bit-exact with pre-pipeline
+        behavior. An opened pipeline's close lands in ``closers`` for the
+        caller's finally; batches arrive in source order either way.
+        workers <= 1 uses the pipeline's inline sequential mode — literally
+        next(src) then fn(item), no threads — so the stage counters emit
+        on both paths.
+
+        The serial leg (_preprocess_train_serial: the elision latch) is
+        composed into the SOURCE, so the pipeline's single submitter
+        thread runs it in stream order; only the order-independent
+        parallel leg fans out. The composition equals
+        _preprocess_train_batch exactly on every path."""
+        from ..io.pipeline import IngestPipeline
+        pipe = IngestPipeline(map(self._preprocess_train_serial, src),
+                              self._preprocess_train_parallel,
+                              workers=self._resolved_ingest_workers(),
+                              stats=self.pipeline_stats)
+        closers.append(pipe.close)
+        return pipe
+
+    def _wrap_prefetch(self, it, closers: List, depth: int = 2):
+        """Stage ``it`` onto the device ahead of compute, sharing this
+        trainer's PipelineStats so prep/transfer/compute waits land in one
+        struct (the bench's stage decomposition reads it)."""
+        from ..io.prefetch import DevicePrefetcher
+        pf = DevicePrefetcher(it, depth=depth, stats=self.pipeline_stats)
+        closers.append(pf.close)
+        return pf
 
     # -- mesh sharding (SURVEY.md §3.17 / §8 M3) -----------------------------
     def _apply_mesh(self, spec: str) -> None:
@@ -393,12 +471,15 @@ class LearnerBase:
         Epoch count is owned by the stream (ParquetStream re-reads shards
         per epoch — the NioStatefulSegment analog at corpus scale). On
         accelerators the shard read/parse overlaps device compute via the
-        same DevicePrefetcher fit() uses."""
+        same DevicePrefetcher fit() uses; -ingest_workers > 1 additionally
+        shards the batch prep (canonicalize/pack) across a worker pool."""
         import jax
+        self.pipeline_stats = PipelineStats()
 
         def host_side() -> Iterator[SparseBatch]:
-            # label conversion + pair tracking stay on HOST arrays, before
-            # the prefetcher stages anything onto the device
+            # label conversion + pair tracking stay on HOST arrays and in
+            # STREAM ORDER (the source side of the pipeline is serial);
+            # _preprocess_train_batch then fans out over the prep workers
             for b in batches:
                 if convert_labels:
                     b = SparseBatch(b.idx, b.val,
@@ -406,19 +487,19 @@ class LearnerBase:
                                     b.field, n_valid=b.n_valid,
                                     fieldmajor=b.fieldmajor)
                 self._note_batch(b)
-                yield self._preprocess_train_batch(b)
+                yield b
 
-        it: Iterable[SparseBatch] = host_side()
+        closers: List = []
+        it: Iterable[SparseBatch] = self._ingest_iter(host_side(), closers)
         prefetch = jax.default_backend() != "cpu" and self.mesh is None
         if prefetch:
-            from ..io.prefetch import DevicePrefetcher
-            it = DevicePrefetcher(it, depth=2)
+            it = self._wrap_prefetch(it, closers)
         try:
             for b in it:
                 self._dispatch(b)
         finally:
-            if prefetch:
-                it.close()
+            for c in reversed(closers):
+                c()
         return self
 
     def _note_batch(self, batch: SparseBatch) -> None:

@@ -721,10 +721,18 @@ class FFMTrainer(FMTrainer):
             batch = self._pad_parts_rows(batch)
         return batch
 
-    def _preprocess_train_batch(self, batch: SparseBatch):
+    def _preprocess_train_serial(self, batch: SparseBatch):
+        # FFM prep has no cross-batch state (no elision latch: unit-ness
+        # is decided per batch inside _canonicalize_batch) — everything
+        # runs on the parallel leg, nothing on the serial one
+        return batch
+
+    def _preprocess_train_parallel(self, batch: SparseBatch):
         # packing lives on the TRAIN hook only: scoring shares
         # _preprocess_batch and consumes .idx/.val, which a PackedBatch
-        # deliberately doesn't carry
+        # deliberately doesn't carry. Canonicalize + pack are pure
+        # per-batch NumPy (GIL-releasing) — the heavy leg the
+        # -ingest_workers pool shards.
         batch = self._preprocess_batch(batch)
         if (batch.fieldmajor and batch.val is None
                 and self._pack_input_on() and self._step_fm_unit is not None
@@ -751,21 +759,20 @@ class FFMTrainer(FMTrainer):
                 or not self._pack_input_on()):
             return super()._fit_epochs(ds, epochs, bs, shuffle, prefetch,
                                        ckdir)
-        from ..io.prefetch import DevicePrefetcher
-
         if prefetch is None:
             prefetch = jax.default_backend() != "cpu"
 
         # ---- epoch 1: normal streamed epoch, retaining staged buffers ----
-        it = map(self._preprocess_train_batch,
-                 ds.batches(bs, shuffle=shuffle, seed=42))
+        closers: list = []
+        it = self._ingest_iter(ds.batches(bs, shuffle=shuffle, seed=42),
+                               closers)
         if prefetch:
-            it = DevicePrefetcher(it, depth=2)
+            it = self._wrap_prefetch(it, closers)
         try:
             staged = self._dispatch_retaining(it)
         finally:
-            if prefetch:
-                it.close()
+            for c in reversed(closers):
+                c()
         mat = self._staged_matrix(staged)
         del staged           # free the per-batch buffers BEFORE replay:
         # peak device memory stays ~M (+Mp), not M + the staged copies
@@ -899,18 +906,20 @@ class FFMTrainer(FMTrainer):
                                     b.field, n_valid=b.n_valid,
                                     fieldmajor=b.fieldmajor)
                 self._note_batch(b)
-                yield self._preprocess_train_batch(b)
+                yield b
 
-        it = host_side()
+        from ..io.pipeline import PipelineStats
+        self.pipeline_stats = PipelineStats()
+        closers: list = []
+        it = self._ingest_iter(host_side(), closers)
         prefetch = jax.default_backend() != "cpu"
         if prefetch:
-            from ..io.prefetch import DevicePrefetcher
-            it = DevicePrefetcher(it, depth=2)
+            it = self._wrap_prefetch(it, closers)
         try:
             staged = self._dispatch_retaining(it)
         finally:
-            if prefetch:
-                it.close()
+            for c in reversed(closers):
+                c()
         mat = self._staged_matrix(staged)
         del staged           # peak device memory ~M (+Mp), not M + copies
         if mat == ():

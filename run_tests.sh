@@ -2,5 +2,10 @@
 # Run the test suite on CPU (8 virtual devices), never touching the TPU
 # tunnel: PALLAS_AXON_POOL_IPS triggers a relay dial at interpreter boot via
 # sitecustomize, and the relay is single-client — tests must stay off it.
-exec env -u PALLAS_AXON_POOL_IPS -u JAX_PLATFORMS \
-    python -m pytest tests/ -q "$@"
+env -u PALLAS_AXON_POOL_IPS -u JAX_PLATFORMS \
+    python -m pytest tests/ -q "$@" || exit $?
+
+# bench harness smoke: tiny-shape runs of the ingest-path benches assert
+# every metric still emits and parses (pipeline refactors must not silently
+# break bench.py). Same CPU isolation as the tests.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python bench.py --smoke

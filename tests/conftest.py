@@ -27,3 +27,23 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def assert_batches_equal(a, b):
+    """``a`` == ``b`` over EVERY dataclass field — tree structure and
+    values. Introspects dataclasses.fields so staging/prep paths can never
+    silently drop metadata the batch dataclass grows later. Handles host
+    and device (staged) arrays alike, ``None`` fields included."""
+    import dataclasses
+
+    import numpy as np
+    assert type(a) is type(b)
+    for f in dataclasses.fields(a):
+        x, y = getattr(a, f.name), getattr(b, f.name)
+        if x is None or y is None:
+            assert x is None and y is None, f.name
+        elif isinstance(x, np.ndarray) or hasattr(x, "shape"):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                          err_msg=f.name)
+        else:
+            assert x == y, f.name

@@ -3,6 +3,7 @@
 import numpy as np
 import pytest
 
+from conftest import assert_batches_equal as _assert_staged_round_trip
 from hivemall_tpu.io.libsvm import synthetic_classification
 from hivemall_tpu.io.prefetch import DevicePrefetcher, stage_batch
 
@@ -36,6 +37,36 @@ def test_stage_batch_keeps_fields():
     assert staged.field is None and staged.n_valid == b.n_valid
 
 
+def test_stage_batch_round_trip_sparse():
+    """Every SparseBatch field survives staging — incl. field ids, val,
+    n_valid and the fieldmajor flag."""
+    rng = np.random.default_rng(8)
+    B, L = 8, 5
+    from hivemall_tpu.io.sparse import SparseBatch
+    b = SparseBatch(rng.integers(1, 100, (B, L)).astype(np.int32),
+                    rng.uniform(0.5, 1.5, (B, L)).astype(np.float32),
+                    rng.normal(0, 1, B).astype(np.float32),
+                    rng.integers(0, 4, (B, L)).astype(np.int32),
+                    n_valid=6, fieldmajor=False)
+    _assert_staged_round_trip(b, stage_batch(b))
+    # unit-value elision (val=None) and fieldmajor are preserved as-is
+    u = SparseBatch(b.idx, None, b.label, None, n_valid=6, fieldmajor=True)
+    _assert_staged_round_trip(u, stage_batch(u))
+
+
+def test_stage_batch_round_trip_packed():
+    """Every PackedBatch field survives staging (B/L/n_valid/fieldmajor
+    metadata ride beside the single uint8 buffer)."""
+    from hivemall_tpu.io.sparse import (SparseBatch, pack_unit_fieldmajor)
+    rng = np.random.default_rng(9)
+    B, L = 8, 4
+    idx = rng.integers(1, 1 << 20, (B, L)).astype(np.int32)
+    hb = pack_unit_fieldmajor(
+        SparseBatch(idx, None, rng.normal(0, 1, B).astype(np.float32),
+                    None, n_valid=7, fieldmajor=True))
+    _assert_staged_round_trip(hb, stage_batch(hb))
+
+
 def test_fit_with_forced_prefetch():
     """fit() with the prefetcher produces the same model as without."""
     from hivemall_tpu.models.linear import GeneralClassifier
@@ -65,3 +96,26 @@ def test_next_after_close_raises_stopiteration():
     it.close()
     with pytest.raises(StopIteration):
         next(it)
+
+
+def test_del_releases_worker():
+    """__del__ must actually release a worker blocked on a full queue, not
+    just set the closed event."""
+    ds, _ = synthetic_classification(400, 5, seed=8)
+    it = DevicePrefetcher(ds.batches(8, shuffle=False), depth=1)
+    next(it)                       # worker now blocked on the full queue
+    thread = it._thread
+    it.__del__()
+    thread.join(timeout=5)
+    assert not thread.is_alive()
+
+
+def test_prefetcher_records_stats():
+    from hivemall_tpu.io.pipeline import PipelineStats
+
+    ds, _ = synthetic_classification(64, 5, seed=9)
+    stats = PipelineStats()
+    n = len(list(DevicePrefetcher(ds.batches(8, shuffle=False), depth=2,
+                                  stats=stats)))
+    assert stats.batches_staged == n == 8
+    assert stats.stage_seconds >= 0.0

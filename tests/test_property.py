@@ -1,6 +1,9 @@
 """Property tests (SURVEY.md §5: hypothesis for codecs and parsers)."""
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
