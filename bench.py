@@ -301,6 +301,7 @@ def bench_ffm_e2e(n_rows: int = 131072, smoke: bool = False) -> dict:
         "delivery_fraction": round((n_rows / best) / (n_rows / t_wire), 3),
         "pipeline": pipeline_stats,
         "ingest_workers": t._resolved_ingest_workers(),
+        "steps_per_dispatch": t._resolved_steps_per_dispatch(),
         "note": "overlap = (T_in + T_comp - wall) / min(T_in, T_comp); "
                 "input leg = host canonicalize+pack + h2d (ONE packed "
                 "uint8 buffer per batch: 3-byte idx lanes, f32 label "
@@ -399,6 +400,68 @@ def bench_ingest(n_rows: int = 200000) -> dict:
         "value_median": round(n_rows / med, 1),
         "unit": "rows/sec",
         "mb_per_sec": round(len(text) / 1e6 / best, 1),
+    }
+
+
+def bench_dispatch_fusion(n_batches: int = 512, smoke: bool = False) -> dict:
+    """Dispatch-overhead microbench (PR 2, -steps_per_dispatch): steps/sec
+    of the SAME trainer/dataset at batch=256 with per-batch dispatch (K=1)
+    vs 8-step fused windows (K=8: one h2d + one jitted lax.scan per 8
+    optimizer steps, state donated through the scan carry). The per-STEP
+    compute is identical, so the ratio isolates what fusion amortizes:
+    Python->jit call latency, transfer count, and (where donation can't
+    carry across separate calls) the per-step table copy. run_tests.sh
+    fails the smoke run if K=8 falls below K=1 — the floor that catches
+    accidental defusion."""
+    import numpy as np
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.models.linear import GeneralClassifier
+
+    B, L = 256, 8
+    dims = 1 << 14 if smoke else 1 << 22
+    n = B * n_batches
+    rng = np.random.default_rng(7)
+    idx = rng.integers(1, dims, (n, L)).astype(np.int32)
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    ds = SparseDataset(idx.ravel(), np.arange(0, n * L + 1, L,
+                                              dtype=np.int64),
+                       np.ones(n * L, np.float32), lab)
+
+    def rate(k):
+        t = GeneralClassifier(f"-dims {dims} -mini_batch {B} "
+                              f"-opt adagrad -steps_per_dispatch {k}")
+        t.fit(ds, epochs=1, shuffle=False)       # warm the compile(s)
+        _sync(t)
+
+        def run():
+            t.fit(ds, epochs=1, shuffle=False)
+            _sync(t)
+
+        best, med, _ = _repeat(run, 3)
+        return n_batches / best, n_batches / med, t
+
+    k1, k1_med, _ = rate(1)
+    k8, k8_med, t8 = rate(8)
+    stats = t8.pipeline_stats.as_dict()
+    return {
+        "metric": "dispatch_fusion_k8_steps_per_sec",
+        "value": round(k8, 1),
+        "value_median": round(k8_med, 1),
+        "unit": "steps/sec",
+        "k1_steps_per_sec": round(k1, 1),
+        "k1_steps_per_sec_median": round(k1_med, 1),
+        "k8_steps_per_sec": round(k8, 1),
+        "fusion_speedup": round(k8 / k1, 3),
+        "batch_size": B,
+        "dims": dims,
+        "megabatches_staged": stats["megabatches_staged"],
+        "singles_flushed": stats["singles_flushed"],
+        "stack_seconds": stats["stack_seconds"],
+        "note": "same trainer, same batches; K=8 = one jitted lax.scan "
+                "over 8 stacked minibatches with donated state. The "
+                "ratio is pure dispatch overhead — per-step math is "
+                "identical (trajectory pinned bit-exact by "
+                "tests/test_dispatch_fusion.py)",
     }
 
 
@@ -886,7 +949,8 @@ def bench_topk_knn() -> dict:
 
 
 _BENCHES = ("bench_linear", "bench_ffm_kernel", "bench_ffm_e2e",
-            "bench_ffm_parquet_stream", "bench_ingest", "bench_fm",
+            "bench_ffm_parquet_stream", "bench_ingest",
+            "bench_dispatch_fusion", "bench_fm",
             "bench_mf", "bench_word2vec", "bench_trees", "bench_gbt",
             "bench_seq_exact", "bench_mix", "bench_lda",
             "bench_changefinder", "bench_topk_knn")
@@ -983,6 +1047,7 @@ _SMOKE = (
     ("bench_ingest", {"n_rows": 2000}),
     ("bench_ffm_e2e", {"n_rows": 512, "smoke": True}),
     ("bench_ffm_parquet_stream", {"n_rows": 512, "smoke": True}),
+    ("bench_dispatch_fusion", {"n_batches": 24, "smoke": True}),
 )
 
 # bench_ffm_e2e stage-metric keys the smoke run requires (the acceptance
@@ -1010,6 +1075,14 @@ def main_smoke() -> int:
                 missing = [k for k in _PIPELINE_KEYS
                            if k not in rec.get("pipeline", {})]
                 assert not missing, f"pipeline keys missing: {missing}"
+            if name == "bench_dispatch_fusion":
+                # the defusion floor (PR 2): fused K=8 dispatch must not
+                # run slower than per-batch K=1 — run_tests.sh fails on
+                # this exit code
+                assert rec["k8_steps_per_sec"] >= rec["k1_steps_per_sec"], \
+                    (f"K=8 fused dispatch ({rec['k8_steps_per_sec']} "
+                     f"steps/s) regressed below K=1 "
+                     f"({rec['k1_steps_per_sec']} steps/s) — defusion?")
             print(f"smoke {name}: OK ({rec['value']} {rec['unit']})",
                   file=sys.stderr)
         except Exception:

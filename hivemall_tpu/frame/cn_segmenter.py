@@ -255,7 +255,23 @@ def _ensure_system_dictionary() -> None:
             _SYSTEM_DICT.update(state="off", entries=0, source=None)
             return
         try:
-            _load_system_dictionary_locked(None)   # lock already held
+            n = _load_system_dictionary_locked(None)   # lock already held
+            if n:
+                # warn-once (the pending->loaded state machine guarantees
+                # this branch runs a single time per process): the full
+                # dictionary changes segmentations vs the compact vendored
+                # lexicon, so hashed token features of models trained
+                # before round 5 (or with the env var pinned) won't line
+                # up — surface the knob instead of silently degrading
+                # scoring quality of -loadmodel'd models
+                import logging
+                logging.getLogger("hivemall_tpu.frame.cn_segmenter").warning(
+                    "tokenize_cn: auto-loaded the jieba system dictionary "
+                    "(%d entries, %s) — segmentations (and therefore "
+                    "hashed token feature ids) differ from the compact "
+                    "vendored lexicon; set HIVEMALL_TPU_CN_DICT=compact "
+                    "to pin the pre-round-5 behavior for existing models",
+                    n, _SYSTEM_DICT["source"])
         except Exception as exc:
             # distinct from "absent" (no jieba): the source exists but the
             # parse failed — warn so the silent quality degradation to the

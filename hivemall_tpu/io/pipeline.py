@@ -126,6 +126,10 @@ class PipelineStats:
     batches_staged: int = 0                # h2d stage outputs (device_put)
     stage_seconds: float = 0.0             # summed device_put time
     consume_wait_seconds: float = 0.0      # train loop blocked on h2d output
+    steps_per_dispatch: int = 1            # fused-dispatch window K
+    megabatches_staged: int = 0            # K-step windows stacked
+    stack_seconds: float = 0.0             # host stacking time (stager)
+    singles_flushed: int = 0               # K=1 fallbacks (ragged/kind-mix)
     queue_occupancy_sum: int = 0           # qsize sampled at each get
     queue_samples: int = 0
     queue_peak: int = 0
@@ -162,6 +166,10 @@ class PipelineStats:
             "batches_staged": self.batches_staged,
             "stage_seconds": round(self.stage_seconds, 4),
             "consume_wait_seconds": round(self.consume_wait_seconds, 4),
+            "steps_per_dispatch": self.steps_per_dispatch,
+            "megabatches_staged": self.megabatches_staged,
+            "stack_seconds": round(self.stack_seconds, 4),
+            "singles_flushed": self.singles_flushed,
             "avg_queue_occupancy": round(self.avg_queue_occupancy, 3),
             "queue_peak": self.queue_peak,
         }

@@ -32,10 +32,11 @@ import time
 from typing import Iterable, Iterator, Optional
 
 import jax
+import numpy as np
 
-from .sparse import PackedBatch, SparseBatch
+from .sparse import MegaBatch, PackedBatch, PackedMegaBatch, SparseBatch
 
-__all__ = ["DevicePrefetcher", "stage_batch"]
+__all__ = ["DevicePrefetcher", "MegabatchStager", "stage_batch"]
 
 _STOP = object()
 
@@ -46,11 +47,36 @@ def stage_batch(b, device=None):
     the val transfer is the point: the host->device link is the e2e
     bottleneck (measured ~25 MB/s through the relay here), and the jitted
     unit-val step variants rebuild val from idx on device for free.
-    A PackedBatch stages its single uint8 buffer — ONE transfer."""
+    A PackedBatch stages its single uint8 buffer — ONE transfer.
+
+    Megabatches (MegaBatch / PackedMegaBatch — K stacked steps, ONE
+    transfer) additionally BLOCK until the transfer completes before
+    returning: the MegabatchStager upstream reuses its staging buffers
+    across windows, and the reuse contract is "the previous window's
+    h2d is done by the time the next window stacks" (this staging call
+    and the next stack run on the same prefetcher worker thread, so
+    blocking here is exactly that barrier). Transfer/compute overlap is
+    untouched — the consumer thread keeps running the train step."""
     put = (lambda a: jax.device_put(a, device)) if device is not None \
         else jax.device_put
     if isinstance(b, PackedBatch):
         return PackedBatch(put(b.buf), b.B, b.L, b.n_valid)
+    if isinstance(b, PackedMegaBatch):
+        staged = PackedMegaBatch(put(b.buf), b.B, b.L, nv=b.nv,
+                                 nv_dev=put(b.nv))
+        jax.block_until_ready((staged.buf, staged.nv_dev))
+        return staged
+    if isinstance(b, MegaBatch):
+        staged = MegaBatch(put(b.idx),
+                           None if b.val is None else put(b.val),
+                           put(b.label),
+                           None if b.field is None else put(b.field),
+                           nv=b.nv, nv_dev=put(b.nv),
+                           fieldmajor=b.fieldmajor)
+        jax.block_until_ready(
+            [a for a in (staged.idx, staged.val, staged.label,
+                         staged.field, staged.nv_dev) if a is not None])
+        return staged
     return SparseBatch(put(b.idx),
                        None if b.val is None else put(b.val),
                        put(b.label),
@@ -144,3 +170,169 @@ class DevicePrefetcher:
             self.close()
         except BaseException:
             pass
+
+
+class MegabatchStager:
+    """Stack runs of K consecutive same-kind prepared batches into one
+    megabatch (io.sparse.MegaBatch / PackedMegaBatch) so the dispatch
+    path pays ONE h2d transfer and ONE jitted call per K optimizer steps
+    (``-steps_per_dispatch``; ops.scan runs the K steps as a lax.scan).
+
+    Synchronous iterator — no thread of its own; it runs on whichever
+    thread consumes it (the DevicePrefetcher worker in the standard
+    stack, the train loop when prefetch is off).
+
+    Grouping: a window only ever holds batches of one KIND — same class
+    (SparseBatch vs PackedBatch), same array shapes, same val/field
+    presence, same fieldmajor flag. Unit-value elision therefore
+    survives stacking: an idx-only window stays idx-only (no val array
+    is ever materialized for it), and a real-valued batch arriving
+    mid-window flushes the window instead of poisoning it. Flushed
+    partials — ragged tails (last window < K), kind changes, stream end
+    — fall back to the K=1 path one batch at a time, so every batch
+    trains in source order exactly once either way.
+
+    Staging-buffer reuse: on accelerator backends the stacked arrays are
+    written into a per-kind ring of TWO pinned staging buffer sets. The
+    downstream ``stage_batch`` blocks until each megabatch's transfer
+    completes (same thread), so when window N stacks, window N-1's
+    transfer is done and N-2's buffers are free — no copy races. On the
+    CPU backend buffers are freshly allocated instead: device_put there
+    may alias host memory, so reuse could corrupt a batch mid-step.
+
+    ``stats`` (PipelineStats) records stack time, megabatches staged and
+    singles flushed — the dispatch-overhead decomposition the bench
+    reads.
+
+    ``reuse=True`` is only valid when a DevicePrefetcher consumes this
+    stager on its worker thread (its ``stage_batch`` provides the
+    transfer-complete barrier the ring depends on); callers feeding
+    megabatches straight into the train loop (mesh path, prefetch off)
+    must leave it False — there device_put/dispatch is async and a
+    reused buffer could be rewritten mid-transfer."""
+
+    def __init__(self, src: Iterable, k: int, stats=None,
+                 reuse: bool = False):
+        if k < 2:
+            raise ValueError(f"MegabatchStager needs k >= 2, got {k}")
+        self._src = iter(src)
+        self._k = int(k)
+        self._stats = stats
+        if stats is not None:
+            stats.steps_per_dispatch = self._k
+        self._window: list = []
+        self._out: list = []            # flushed items pending emission
+        self._done = False
+        self._reuse = bool(reuse) and jax.default_backend() != "cpu"
+        self._rings: dict = {}          # kind key -> [bufset, bufset]
+        self._ring_pos: dict = {}
+
+    # -- kind/grouping -------------------------------------------------------
+    @staticmethod
+    def _kind(b):
+        if isinstance(b, PackedBatch):
+            return ("packed", b.B, b.L, int(b.buf.size))
+        if isinstance(b, SparseBatch) and isinstance(b.idx, np.ndarray):
+            return ("sparse", b.idx.shape, b.val is None,
+                    b.field is None, b.fieldmajor)
+        return None                     # device-staged/foreign: never stack
+
+    # -- staging-buffer ring -------------------------------------------------
+    def _staging(self, key, shapes_dtypes):
+        """One SET of stacked staging buffers for ``key`` — a dict of
+        np arrays matching (name -> (shape, dtype)). Ring of two on
+        accelerators (see class docstring); fresh allocation on CPU."""
+        def alloc():
+            return {name: np.empty(shape, dtype)
+                    for name, (shape, dtype) in shapes_dtypes.items()}
+        if not self._reuse:
+            return alloc()
+        ring = self._rings.get(key)
+        if ring is None:
+            ring = [None, None]
+            self._rings[key] = ring
+            self._ring_pos[key] = 0
+        pos = self._ring_pos[key]
+        self._ring_pos[key] = 1 - pos
+        bufs = ring[pos]
+        if bufs is None or any(bufs[n].shape != sd[0] or bufs[n].dtype != sd[1]
+                               for n, sd in shapes_dtypes.items()):
+            bufs = alloc()
+            ring[pos] = bufs
+        return bufs
+
+    def _stack(self, window):
+        t0 = time.perf_counter()
+        K = len(window)
+        first = window[0]
+        nv = np.asarray(
+            [(b.n_valid if b.n_valid is not None else b.batch_size)
+             for b in window], np.int32)
+        if isinstance(first, PackedBatch):
+            bufs = self._staging(self._kind(first),
+                                 {"buf": ((K, first.buf.size), np.uint8)})
+            for i, b in enumerate(window):
+                bufs["buf"][i] = b.buf
+            out = PackedMegaBatch(bufs["buf"], first.B, first.L, nv=nv)
+        else:
+            spec = {"idx": ((K,) + first.idx.shape, np.int32),
+                    "label": ((K,) + first.label.shape, np.float32)}
+            if first.val is not None:
+                spec["val"] = ((K,) + first.val.shape, np.float32)
+            if first.field is not None:
+                spec["field"] = ((K,) + first.field.shape, np.int32)
+            bufs = self._staging(self._kind(first), spec)
+            for i, b in enumerate(window):
+                bufs["idx"][i] = b.idx
+                bufs["label"][i] = b.label
+                if "val" in bufs:
+                    bufs["val"][i] = b.val
+                if "field" in bufs:
+                    bufs["field"][i] = b.field
+            out = MegaBatch(bufs["idx"], bufs.get("val"), bufs["label"],
+                            bufs.get("field"), nv=nv,
+                            fieldmajor=first.fieldmajor)
+        if self._stats is not None:
+            self._stats.add(stack_seconds=time.perf_counter() - t0,
+                            megabatches_staged=1)
+        return out
+
+    def _flush(self, full: bool) -> None:
+        """Move the current window to the output queue: stacked when it
+        reached K, one-at-a-time (K=1 fallback) otherwise."""
+        if not self._window:
+            return
+        if full:
+            self._out.append(self._stack(self._window))
+        else:
+            self._out.extend(self._window)
+            if self._stats is not None:
+                self._stats.add(singles_flushed=len(self._window))
+        self._window = []
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self._out:
+            if self._done:
+                raise StopIteration
+            try:
+                b = next(self._src)
+            except StopIteration:
+                self._done = True
+                self._flush(full=False)        # ragged tail -> K=1 path
+                continue
+            kind = self._kind(b)
+            if kind is None:
+                self._flush(full=False)
+                self._out.append(b)
+                if self._stats is not None:
+                    self._stats.add(singles_flushed=1)
+                continue
+            if self._window and self._kind(self._window[0]) != kind:
+                self._flush(full=False)        # kind change -> K=1 path
+            self._window.append(b)
+            if len(self._window) >= self._k:
+                self._flush(full=True)
+        return self._out.pop(0)

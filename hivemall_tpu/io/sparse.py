@@ -19,9 +19,9 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["SparseBatch", "SparseDataset", "canonicalize_fieldmajor",
-           "pad_examples", "parse_feature_strings", "split_feature",
-           "pow2_len"]
+__all__ = ["SparseBatch", "SparseDataset", "MegaBatch", "PackedMegaBatch",
+           "canonicalize_fieldmajor", "pad_examples",
+           "parse_feature_strings", "split_feature", "pow2_len"]
 
 
 def pow2_len(n: int) -> int:
@@ -113,6 +113,69 @@ class PackedBatch:
     @property
     def batch_size(self) -> int:
         return self.B
+
+
+@dataclass
+class MegaBatch:
+    """K same-shape minibatches stacked on the leading axis for ONE
+    host->device transfer and ONE jitted ``lax.scan`` dispatch of all K
+    optimizer steps (``-steps_per_dispatch``, ops.scan.make_megastep).
+
+    Built by io.prefetch.MegabatchStager from consecutive same-kind
+    SparseBatches: a window never mixes unit-valued (``val=None``) and
+    real-valued batches, so unit-value elision survives stacking — an
+    idx-only window transfers no val array at all.
+
+    ``nv`` is the per-step valid-row count as a HOST int32 [K] vector
+    (the accounting side reads it without a device sync); ``nv_dev`` is
+    its staged device copy, set by ``io.prefetch.stage_batch`` so the
+    scan body can rebuild each step's row mask on device (4*B fewer
+    bytes per step on the link than shipping the float masks)."""
+
+    idx: np.ndarray                  # int32 [K, B, L]
+    val: Optional[np.ndarray]        # float32 [K, B, L]; None = unit values
+    label: np.ndarray                # float32 [K, B]
+    field: Optional[np.ndarray] = None  # int32 [K, B, L], FFM pairs path
+    nv: Optional[np.ndarray] = None  # int32 [K] valid rows per step (host)
+    nv_dev: Optional[object] = None  # staged device copy of nv
+    fieldmajor: bool = False
+
+    @property
+    def n_steps(self) -> int:
+        return int(self.label.shape[0])
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.label.shape[1])
+
+    @property
+    def n_examples(self) -> int:
+        return int(self.nv.sum())
+
+
+@dataclass
+class PackedMegaBatch:
+    """K packed unit-value field-major batches (io.sparse.PackedBatch)
+    stacked into one uint8 [K, nbytes] buffer — one transfer for K whole
+    steps of the flagship packed FFM path."""
+
+    buf: np.ndarray                  # uint8 [K, B*L*3 + B*4]
+    B: int
+    L: int
+    nv: np.ndarray = None            # int32 [K] (host)
+    nv_dev: Optional[object] = None
+
+    @property
+    def n_steps(self) -> int:
+        return int(self.buf.shape[0])
+
+    @property
+    def batch_size(self) -> int:
+        return self.B
+
+    @property
+    def n_examples(self) -> int:
+        return int(self.nv.sum())
 
 
 def pack_unit_fieldmajor(batch: SparseBatch) -> PackedBatch:

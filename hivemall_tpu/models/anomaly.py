@@ -77,7 +77,14 @@ class SDAR1D:
                 for j in range(m):
                     T[i, j] = self.c[abs(i - j)]
             try:
-                a = np.linalg.solve(T + 1e-6 * np.eye(m), self.c[1:m + 1])
+                # per-diagonal relative ridge (floored at the absolute
+                # 1e-6), matching the batched path: right after warmup the
+                # system is a rank-1 outer product, and against moments
+                # ~1e13 (|x| ~ 5e6 series) an absolute 1e-6 is nothing —
+                # the near-singular solve returns garbage ~1e16 that the
+                # batch path (relatively ridged) never produces
+                rg = 1e-6 * np.maximum(np.abs(np.diag(T)), 1.0)
+                a = np.linalg.solve(T + np.diag(rg), self.c[1:m + 1])
             except np.linalg.LinAlgError:
                 a = np.zeros(m)
             pred = self.mu + sum(a[j] * (hist[-1 - j] - self.mu)
@@ -133,7 +140,10 @@ class SDAR2D:
                     G[i * d:(i + 1) * d, j * d:(j + 1) * d] = (
                         blk if i <= j else blk.T)
             try:
-                S = np.linalg.solve(G + 1e-6 * np.eye(m * d), R)
+                # per-diagonal relative ridge (same rationale as SDAR1D's
+                # and the batched path's _sdar_scores ridge)
+                rg = 1e-6 * np.maximum(np.abs(np.diag(G)), 1.0)
+                S = np.linalg.solve(G + np.diag(rg), R)
             except np.linalg.LinAlgError:
                 S = np.zeros((m * d, d))
             pred = self.mu.copy()
@@ -144,7 +154,11 @@ class SDAR2D:
         err = x - pred
         self.sigma = (1 - r) * self.sigma + r * np.outer(err, err)
         self.hist.append(x)
-        sig = self.sigma + 1e-9 * np.eye(d)
+        # relative per-diagonal ridge, mirroring the batch path's sigma
+        # ridge (1e-9 * max(diag, 1)) so the two stay score-equivalent at
+        # any channel magnitude
+        sig = self.sigma + np.diag(
+            1e-9 * np.maximum(np.abs(np.diag(self.sigma)), 1.0))
         sign, logdet = np.linalg.slogdet(sig)
         maha = float(err @ np.linalg.solve(sig, err))
         return 0.5 * (d * np.log(2 * np.pi) + logdet + maha)
@@ -254,9 +268,23 @@ def _solve_small(G, R, pd: bool = False, with_logdet: bool = False):
 
     n = G.shape[-1]
     if n == 1:
-        x = R / G[..., 0:1, :]
+        # same contract as n >= 2: equilibrate, floor the (single) pivot
+        # at 1e-7 — a zero 1x1 system must return a finite solve and a
+        # finite logdet, not inf — and keep logdet from the SAME floored
+        # pivot the solve used
         if with_logdet:
-            return x, jnp.log(jnp.abs(G[..., 0, 0]))
+            assert pd, "with_logdet requires a PD system (log of pivots)"
+        g = G[..., 0, 0]
+        s2 = jnp.maximum(jnp.abs(g), 1e-30)       # Jacobi scale squared
+        gn = g / s2                               # equilibrated pivot, ±1|0
+        if pd:
+            d1 = jnp.maximum(gn, 1e-7)
+        else:
+            d1 = jnp.where(jnp.abs(gn) < 1e-7,
+                           jnp.where(gn < 0, -1e-7, 1e-7), gn)
+        x = R / (d1 * s2)[..., None, None]
+        if with_logdet:
+            return x, jnp.log(d1) + jnp.log(s2)
         return x
     if n > 3:
         # LAPACK-style path on the RAW system (pivoting handles scale)
@@ -586,7 +614,12 @@ def _sst_ika_jit(w: int, n: int, m: int, g: int, r: int, Tpad: int):
     start = w + n - 1
     K = Tpad - g - m - start
     base_p = start - n - w + 1                     # = 0
-    base_f = start + g - w
+    # future column j covers x[t+g-w+1+j : t+g+1+j] — the FIRST future
+    # window ends at t+g, the first post-gap point (without the +1 it
+    # ended at t+g-1, scoring a window that never looked past the gap);
+    # the svd scorer below builds the same window, pinned by
+    # test_sst_ika_matches_svd_detection's argmax tolerance of 1
+    base_f = start + g - w + 1
 
     @jax.jit
     def run(xj):
@@ -651,7 +684,10 @@ def sst(series: Sequence[float], options: str = "") -> List[float]:
     @jax.jit
     def score_at(t):
         past = hankel(t - n + 1 - 1, n)       # ends at t-1... columns upto t
-        fut = hankel(t + g - 1, m)
+        fut = hankel(t + g, m)                # first column ends at t+g (the
+        # first post-gap point) — the same window the ika path's base_f
+        # builds, so the two score functions disagree only by iteration
+        # convergence, never by alignment
         up, _, _ = jnp.linalg.svd(past, full_matrices=False)
         uf, _, _ = jnp.linalg.svd(fut, full_matrices=False)
         s = jnp.linalg.svd(up[:, :r].T @ uf[:, :r], compute_uv=False)

@@ -21,7 +21,8 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tupl
 import numpy as np
 
 from ..io.pipeline import PipelineStats
-from ..io.sparse import SparseBatch, SparseDataset, pow2_len, split_feature
+from ..io.sparse import (MegaBatch, PackedMegaBatch, SparseBatch,
+                         SparseDataset, pow2_len, split_feature)
 from ..utils.hashing import mhash
 from ..utils.metrics import Meter, get_stream
 from ..utils.options import OptionSpec, Parsed
@@ -53,6 +54,14 @@ def learner_option_spec(name: str, *, classification: bool,
                "(cores-1 capped at 8 on accelerators, 1 on CPU); 1 = "
                "strict sequential (bit-exact pre-pipeline behavior); "
                "N > 1 = N prep worker threads delivering in order")
+    s.add("steps_per_dispatch", type=int, default=0,
+          help="fused multi-step dispatch: stack K prepared minibatches "
+               "into ONE h2d transfer and run all K optimizer steps in "
+               "one jitted lax.scan (donated state — no per-step table "
+               "copies). 0 = auto (8 on accelerators for trainers with "
+               "a scannable step, 1 on CPU); 1 = per-batch dispatch "
+               "(bit-exact pre-fusion behavior); ragged tails and mixed "
+               "batch kinds fall back to 1")
     s.add("dims", "feature_dimensions", type=int, default=1 << 24,
           help="model table size (hashed feature space)")
     s.flag("dense", "densemodel",
@@ -272,6 +281,7 @@ class LearnerBase:
             closers: List = []
             it = self._ingest_iter(
                 ds.batches(bs, shuffle=shuffle, seed=seed0 + ep), closers)
+            it = self._wrap_megabatch(it, prefetch=prefetch)
             if prefetch:
                 it = self._wrap_prefetch(it, closers)
             try:
@@ -401,6 +411,47 @@ class LearnerBase:
         closers.append(pf.close)
         return pf
 
+    # -- fused multi-step dispatch (-steps_per_dispatch, ops.scan) -----------
+    def _supports_megastep(self) -> bool:
+        """Whether this trainer's step is scannable: the jitted step
+        carries its pure ``(state, batch) -> (state, loss)`` core
+        (ops.scan.scannable) and the trainer uses the standard
+        (params-or-w, opt_state) state pair. Trainers with bespoke state
+        (covariance pairs, tree ensembles, ...) fall out here and keep
+        per-batch dispatch."""
+        return getattr(getattr(self, "_step", None), "core", None) \
+            is not None
+
+    def _resolved_steps_per_dispatch(self) -> int:
+        """-steps_per_dispatch with 0 = auto: 8 on accelerators — the
+        per-batch jit call + h2d latency is the post-PR-1 e2e wall there
+        — and 1 (per-batch, bit-exact pre-fusion behavior) on CPU, where
+        dispatch overhead is noise and the test suite pins the K=1
+        trajectory. Collapses to 1 for trainers without a scannable step
+        and under MIX (the mix client touches every batch's idx on host
+        at step cadence — fusing K steps would skip exchanges)."""
+        k = int(self.opts.get("steps_per_dispatch") or 0)
+        if k < 0:
+            raise ValueError(f"-steps_per_dispatch must be >= 0, got {k}")
+        if not self._supports_megastep() or self._mixer is not None:
+            return 1
+        if k > 0:
+            return k
+        import jax
+        return 8 if jax.default_backend() != "cpu" else 1
+
+    def _wrap_megabatch(self, it, *, prefetch: bool):
+        """Insert the K-step stacking stage between host prep and the
+        h2d prefetcher. Staging-buffer reuse is only armed when a
+        DevicePrefetcher consumes the stager (its stage_batch provides
+        the transfer-complete barrier the buffer ring needs)."""
+        k = self._resolved_steps_per_dispatch()
+        if k <= 1:
+            return it
+        from ..io.prefetch import MegabatchStager
+        return MegabatchStager(it, k, stats=self.pipeline_stats,
+                               reuse=prefetch and self.mesh is None)
+
     # -- mesh sharding (SURVEY.md §3.17 / §8 M3) -----------------------------
     def _apply_mesh(self, spec: str) -> None:
         """Shard this trainer's state over a (dp, tp) device mesh.
@@ -492,6 +543,7 @@ class LearnerBase:
         closers: List = []
         it: Iterable[SparseBatch] = self._ingest_iter(host_side(), closers)
         prefetch = jax.default_backend() != "cpu" and self.mesh is None
+        it = self._wrap_megabatch(it, prefetch=prefetch)
         if prefetch:
             it = self._wrap_prefetch(it, closers)
         try:
@@ -567,7 +619,15 @@ class LearnerBase:
         self._dispatch(self._preprocess_train_batch(
             SparseBatch(idx, val, lab, n_valid=nv if nv < B else None)))
 
-    def _dispatch(self, batch: SparseBatch) -> None:
+    # test/debug hook: when set to a list, every dispatched step appends
+    # its per-batch loss sum (host float) — the K>1 == K=1 trajectory
+    # tests pin exact batch order through it. None (default) costs one
+    # attribute check per dispatch and never syncs the device.
+    _trace_losses: Optional[List[float]] = None
+
+    def _dispatch(self, batch) -> None:
+        if isinstance(batch, (MegaBatch, PackedMegaBatch)):
+            return self._dispatch_mega(batch)
         nv = batch.n_valid or batch.batch_size
         if self.mesh is not None:
             batch = self._shard_batch(batch)
@@ -580,6 +640,8 @@ class LearnerBase:
         self._loss_pending = self._loss_pending + loss_sum
         self._examples += nv
         self._meter.add(nv)
+        if self._trace_losses is not None:
+            self._trace_losses.append(float(loss_sum))
         if self._t % 256 == 0:
             self._fold_loss()
             stream = get_stream()
@@ -592,6 +654,101 @@ class LearnerBase:
         if self._mixer is not None:
             self._mixer.touch(batch.idx[:nv])
             self._mixer.maybe_mix(self)
+
+    def _dispatch_mega(self, mb) -> None:
+        """Dispatch one K-step megabatch: ONE jitted lax.scan call runs
+        all K optimizer steps with the state donated through the scan
+        carry (no per-step table copies, no per-step Python). The [K]
+        per-step loss vector stays on device; its sum folds into the
+        host float64 at the same 256-step cadence as the K=1 path, so no
+        step ever blocks the host."""
+        K = mb.n_steps
+        nv_total = mb.n_examples
+        if self.mesh is not None:
+            mb = self._shard_megabatch(mb)
+        losses = self._train_megabatch(mb)          # [K] device array
+        self._t += K
+        self._loss_pending = self._loss_pending + losses.sum()
+        self._examples += nv_total
+        self._meter.add(nv_total)
+        if self._trace_losses is not None:
+            import numpy as np
+            self._trace_losses.extend(
+                float(v) for v in np.asarray(losses))
+        # fold when this window crossed a multiple-of-256 step boundary
+        # (the K=1 condition `t % 256 == 0` is the K=1 case of this)
+        if self._t % 256 < K:
+            self._fold_loss()
+            stream = get_stream()
+            if stream.enabled:
+                stream.emit("train_step", trainer=self.NAME, step=self._t,
+                            examples=self._examples,
+                            examples_per_sec=round(self._meter.rate, 1),
+                            avg_loss=round(self._loss_sum
+                                           / max(1, self._examples), 6))
+
+    def _megastep_state(self) -> Tuple[Any, Any]:
+        """(model-state, optimizer-state) pair threaded through the scan
+        carry. Covers the standard attribute names; trainers with other
+        state override this and `_set_megastep_state` as a pair."""
+        s1 = getattr(self, "params", None)
+        if s1 is None:
+            s1 = self.w
+        return s1, self.opt_state
+
+    def _set_megastep_state(self, s1, s2) -> None:
+        if getattr(self, "params", None) is not None:
+            self.params = s1
+        else:
+            self.w = s1
+        self.opt_state = s2
+
+    def _mega_field(self, mb):
+        """Per-step field arrays for the megastep (FFM pairs path only —
+        the base/linear/FM cores take no field argument, so a stacked
+        field array, if the dataset carries one, is simply not fed)."""
+        return None
+
+    def _mega_lams(self):
+        """Broadcast (non-scanned) extra for the megastep — train_fm's
+        -adareg runtime lambdas. None for everyone else."""
+        return None
+
+    def _train_megabatch(self, mb):
+        """Run K steps through the shared megastep built from this
+        trainer's scannable step core (ops.scan.megastep_for). Returns
+        the [K] per-step loss sums as a device array."""
+        import jax.numpy as jnp
+        from ..ops.scan import megastep_for
+        mega = megastep_for(self._step, none_val=True)
+        nv = mb.nv_dev if mb.nv_dev is not None else jnp.asarray(mb.nv)
+        s1, s2 = self._megastep_state()
+        s1, s2, losses = mega(s1, s2, float(self._t), nv, mb.idx, mb.val,
+                              mb.label, self._mega_field(mb),
+                              self._mega_lams())
+        self._set_megastep_state(s1, s2)
+        return losses
+
+    def _shard_megabatch(self, mb):
+        """Mesh placement for one stacked window: per-step batch rows
+        sharded over 'dp' (axis 1 — axis 0 is the scan axis), nv
+        replicated. The scan body then compiles under GSPMD exactly like
+        the K=1 step (same per-step shardings)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..io.sparse import MegaBatch
+
+        def put(a, spec):
+            return jax.device_put(jnp.asarray(a),
+                                  NamedSharding(self.mesh, spec))
+        return MegaBatch(
+            put(mb.idx, P(None, "dp", None)),
+            None if mb.val is None else put(mb.val, P(None, "dp", None)),
+            put(mb.label, P(None, "dp")),
+            None if mb.field is None else put(mb.field,
+                                              P(None, "dp", None)),
+            nv=mb.nv, nv_dev=put(mb.nv, P()), fieldmajor=mb.fieldmajor)
 
     def _fold_loss(self) -> None:
         self._loss_sum += float(self._loss_pending)

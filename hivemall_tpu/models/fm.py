@@ -47,6 +47,7 @@ __all__ = ["FMTrainer", "FFMTrainer", "fm_predict", "ffm_predict"]
 # buffers, and all trainer state is passed in, never closed over).
 
 from functools import lru_cache as _lru_cache
+from functools import partial as _partial
 
 
 @_lru_cache(maxsize=64)
@@ -132,21 +133,57 @@ def _ffm_score_fieldmajor_cached(F, k):
     return make_ffm_score_fieldmajor(F, k)
 
 
+def _unpack_on_device(buf, nv, B: int, L: int):
+    """Device-side decode of ONE io.sparse.PackedBatch wire buffer:
+    3-byte little-endian idx lanes reassembled via shifts, f32 labels via
+    bitcast, valid-row mask from the nv scalar. The single source of the
+    packed wire format on the consume side — the K=1 wrapper and the
+    K-step scan body below both call it, so a layout change can never
+    reach one dispatch path and not the other. Elementwise, fuses into
+    the step; the win is on the h2d link (see io.sparse.PackedBatch)."""
+    ni = B * L * 3
+    b3 = buf[:ni].reshape(B, L, 3).astype(jnp.int32)
+    idx = b3[..., 0] | (b3[..., 1] << 8) | (b3[..., 2] << 16)
+    label = jax.lax.bitcast_convert_type(
+        buf[ni:].reshape(B, 4), jnp.float32)
+    mask = (jnp.arange(B) < nv).astype(jnp.float32)
+    return idx, label, mask
+
+
+@_lru_cache(maxsize=128)
+def _packed_megawrap_cached(base_step, B: int, L: int):
+    """K-step fused dispatch for the PACKED flagship path
+    (-steps_per_dispatch > 1 + pack_input): one jitted lax.scan over a
+    [K, nbytes] stacked uint8 buffer, each step unpacking its window
+    (_unpack_on_device) and running the SAME unit-val field-major step
+    core the K=1 path compiled. Model/optimizer state is donated through
+    the scan carry — XLA updates the tables in place across all K
+    steps."""
+    core = getattr(base_step, "core", base_step)
+
+    @_partial(jax.jit, donate_argnums=(0, 1))
+    def fn(params, opt_state, t0, bufs, nvs):
+        def body(carry, x):
+            p, s, t = carry
+            idx, label, mask = _unpack_on_device(x["buf"], x["nv"], B, L)
+            p, s, loss = core(p, s, t, idx, label, mask)
+            return (p, s, t + 1.0), loss
+
+        (p, s, _), losses = jax.lax.scan(
+            body, (params, opt_state, t0), {"buf": bufs, "nv": nvs})
+        return p, s, losses
+
+    return fn
+
+
 @_lru_cache(maxsize=128)
 def _packed_wrap_cached(base_step, B: int, L: int):
     """Jitted wrapper (cached per (shared base step, batch shape)) that
-    unpacks a PackedBatch buffer on device — 3-byte idx lanes via shifts,
-    f32 labels via bitcast, row mask from the n_valid scalar — then runs
-    the regular unit-val field-major step. The unpack is elementwise and
-    fuses; the win is on the h2d link (see io.sparse.PackedBatch)."""
+    unpacks a PackedBatch buffer on device (_unpack_on_device) then runs
+    the regular unit-val field-major step."""
     @jax.jit
     def fn(params, opt_state, t, buf, nv):
-        ni = B * L * 3
-        b3 = buf[:ni].reshape(B, L, 3).astype(jnp.int32)
-        idx = b3[..., 0] | (b3[..., 1] << 8) | (b3[..., 2] << 16)
-        label = jax.lax.bitcast_convert_type(
-            buf[ni:].reshape(B, 4), jnp.float32)
-        mask = (jnp.arange(B) < nv).astype(jnp.float32)
+        idx, label, mask = _unpack_on_device(buf, nv, B, L)
         return base_step(params, opt_state, t, idx, label, mask)
 
     return fn
@@ -330,6 +367,15 @@ class FMTrainer(LearnerBase):
         if self._adareg:
             return (jnp.asarray(self._lams),)
         return ()
+
+    def _mega_lams(self):
+        # -adareg runtime lambdas ride the megastep as a BROADCAST extra
+        # (not scanned): all K steps in a window see the same lambdas,
+        # exactly as K consecutive K=1 steps within one epoch do
+        # (adaptation happens per epoch, between fits)
+        if self._adareg:
+            return jnp.asarray(self._lams)
+        return None
 
     def _train_batch(self, batch: SparseBatch) -> float:
         self.params, self.opt_state, loss_sum = self._step(
@@ -1007,6 +1053,51 @@ class FFMTrainer(FMTrainer):
             val2 = None
         return SparseBatch(idx2, val2, batch.label, None,
                            n_valid=batch.n_valid, fieldmajor=True)
+
+    # -- fused multi-step dispatch (-steps_per_dispatch) ---------------------
+    def _supports_megastep(self) -> bool:
+        # the FFM dispatch picks among THREE steps per batch kind (pairs /
+        # fieldmajor / fieldmajor-unit+packed); fusion is on when any of
+        # them is scannable — a window of a non-scannable kind (only
+        # possible under the mesh-sharded parts steps, which also null
+        # self._step) simply never forms. parts layout keeps
+        # self._step = None, so the base check alone would disable the
+        # flagship path.
+        return any(
+            getattr(s, "core", None) is not None
+            for s in (self._step, self._step_fm, self._step_fm_unit))
+
+    def _mega_field(self, mb):
+        # pairs-path megabatches carry stacked per-step field arrays; the
+        # pairs core takes them as its trailing batch argument
+        return mb.field
+
+    def _train_megabatch(self, mb):
+        """Route one stacked window to the megastep of the SAME step the
+        K=1 dispatch would pick for its kind: PackedMegaBatch -> the
+        packed scan wrapper over the unit-val field-major core (one uint8
+        buffer, per-step unpack on device); field-major MegaBatch -> the
+        field-major (unit or real-valued) core; anything else -> the base
+        generic megastep over the pairs core."""
+        from ..io.sparse import PackedMegaBatch
+        from ..ops.scan import megastep_for
+        if isinstance(mb, PackedMegaBatch):
+            nv = (mb.nv_dev if mb.nv_dev is not None
+                  else jnp.asarray(mb.nv))
+            mega = _packed_megawrap_cached(self._step_fm_unit, mb.B, mb.L)
+            self.params, self.opt_state, losses = mega(
+                self.params, self.opt_state, float(self._t), mb.buf, nv)
+            return losses
+        if mb.fieldmajor and self._step_fm is not None:
+            step = self._step_fm_unit if mb.val is None else self._step_fm
+            mega = megastep_for(step)
+            nv = (mb.nv_dev if mb.nv_dev is not None
+                  else jnp.asarray(mb.nv))
+            self.params, self.opt_state, losses = mega(
+                self.params, self.opt_state, float(self._t), nv, mb.idx,
+                mb.val, mb.label, None, None)
+            return losses
+        return super()._train_megabatch(mb)
 
     def _train_batch(self, batch: SparseBatch) -> float:
         if isinstance(batch, PackedBatch):

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from .losses import Loss
 from .optimizers import Optimizer
+from .scan import scannable
 
 __all__ = ["fm_score", "ffm_score", "make_fm_step", "make_ffm_step",
            "ffm_joint_slot", "ffm_row_hash", "make_ffm_step_fused",
@@ -122,8 +123,7 @@ def _make_factor_step_dense(score_fn: Callable, loss: Loss,
     sparse form."""
     lam0, lam_w, lam_v = lambdas
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, t, idx, val, label, row_mask, *extra):
+    def core(params, opt_state, t, idx, val, label, row_mask, *extra):
         def batch_loss(p):
             phi = score_fn(p["w0"], p["w"], p["V"], idx, val, *extra)
             return (loss.loss(phi, label) * row_mask).sum()
@@ -143,7 +143,7 @@ def _make_factor_step_dense(score_fn: Callable, loss: Loss,
             new_s[k] = sk
         return new_p, new_s, loss_sum
 
-    return step
+    return scannable(partial(jax.jit, donate_argnums=(0, 1))(core), core)
 
 
 def _make_factor_step_sparse(kind: str, loss: Loss, optimizer: Optimizer,
@@ -161,8 +161,7 @@ def _make_factor_step_sparse(kind: str, loss: Loss, optimizer: Optimizer,
     lam0, lam_w, lam_v = lambdas
     assert optimizer.sparse_update is not None
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, t, idx, val, label, row_mask, *extra):
+    def core(params, opt_state, t, idx, val, label, row_mask, *extra):
         w0, w, V = params["w0"], params["w"], params["V"]
         wg = w[idx].astype(jnp.float32)                       # [B, L]
         # presence mask: a feature slot participates only if its value is
@@ -234,7 +233,7 @@ def _make_factor_step_sparse(kind: str, loss: Loss, optimizer: Optimizer,
         return ({"w0": w0n.astype(w0.dtype), "w": wn, "V": Vn},
                 {"w0": s0, "w": sw, "V": sV}, loss_sum)
 
-    return step
+    return scannable(partial(jax.jit, donate_argnums=(0, 1))(core), core)
 
 
 def ffm_row_hash(idx, Mr: int):
@@ -406,24 +405,21 @@ def make_ffm_step_fused(loss: Loss, optimizer: Optimizer,
     if unit_val:
         assert fieldmajor, "unit_val implies the canonical fieldmajor batch"
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, t, idx, label, row_mask):
+        def core(params, opt_state, t, idx, label, row_mask):
             # unit-value elision: val == (idx != 0) by construction, so the
             # val array is never transferred — rebuild it on device
             val = (idx != 0).astype(jnp.float32)
             return body(params, opt_state, t, idx, val, label, row_mask,
                         None)
     elif fieldmajor:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, t, idx, val, label, row_mask):
+        def core(params, opt_state, t, idx, val, label, row_mask):
             return body(params, opt_state, t, idx, val, label, row_mask,
                         None)
     else:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, t, idx, val, label, row_mask, field):
+        def core(params, opt_state, t, idx, val, label, row_mask, field):
             return body(params, opt_state, t, idx, val, label, row_mask,
                         field)
-    return step
+    return scannable(partial(jax.jit, donate_argnums=(0, 1))(core), core)
 
 
 def fm_pack_geometry(K: int) -> Tuple[int, int]:
@@ -537,16 +533,14 @@ def make_fm_step_fused(loss: Loss, optimizer: Optimizer,
                 {"T": sT, "w0": s0}, loss_sum)
 
     if dyn:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, t, idx, val, label, row_mask, lams):
+        def core(params, opt_state, t, idx, val, label, row_mask, lams):
             return body(params, opt_state, t, idx, val, label, row_mask,
                         lams)
     else:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, t, idx, val, label, row_mask):
+        def core(params, opt_state, t, idx, val, label, row_mask):
             return body(params, opt_state, t, idx, val, label, row_mask,
                         None)
-    return step
+    return scannable(partial(jax.jit, donate_argnums=(0, 1))(core), core)
 
 
 def make_fm_step_minibatch(loss: Loss, optimizer: Optimizer,
@@ -624,16 +618,14 @@ def make_fm_step_minibatch(loss: Loss, optimizer: Optimizer,
                 {"T": sT, "w0": s0}, loss_sum)
 
     if dyn:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, t, idx, val, label, row_mask, lams):
+        def core(params, opt_state, t, idx, val, label, row_mask, lams):
             return body(params, opt_state, t, idx, val, label, row_mask,
                         lams)
     else:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, t, idx, val, label, row_mask):
+        def core(params, opt_state, t, idx, val, label, row_mask):
             return body(params, opt_state, t, idx, val, label, row_mask,
                         None)
-    return step
+    return scannable(partial(jax.jit, donate_argnums=(0, 1))(core), core)
 
 
 def make_fm_step(loss, optimizer, lambdas):

@@ -429,16 +429,18 @@ def make_parts_step(loss: Loss, eta_fn: Callable, lambdas, F: int, K: int,
                 {"T2": {"gg": S2n}, "w0": {"gg": gg0}}, loss_sum)
 
     if unit_val:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, t, idx, label, row_mask):
+        def core(params, opt_state, t, idx, label, row_mask):
             return step_impl(params, opt_state, t, idx, None, label,
                              row_mask)
     else:
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, t, idx, val, label, row_mask):
+        def core(params, opt_state, t, idx, val, label, row_mask):
             return step_impl(params, opt_state, t, idx, val, label,
                              row_mask)
-    return step
+    # scannable: -steps_per_dispatch > 1 runs this same core as a lax.scan
+    # body (the pallas_call is an ordinary custom call in the loop body;
+    # state flows through the donated scan carry)
+    from .scan import scannable
+    return scannable(partial(jax.jit, donate_argnums=(0, 1))(core), core)
 
 
 def _phi_parts_sharded(w0f, slab, val_l, F: int, Fl: int,
@@ -497,13 +499,7 @@ def make_parts_step_sharded(loss: Loss, eta_fn: Callable, lambdas, F: int,
         mesh=None path (its rate is the flagship headline).
     """
     from jax.sharding import PartitionSpec as P
-    import inspect
-    try:
-        from jax import shard_map as _sm
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as _sm
-    flag = ("check_vma" if "check_vma" in inspect.signature(_sm).parameters
-            else "check_rep")
+    from ..utils.jax_compat import shard_map as _sm
     dp, tp = mesh.shape["dp"], mesh.shape["tp"]
     assert F % tp == 0, (F, tp)
     Fl = F // tp
@@ -627,7 +623,7 @@ def make_parts_step_sharded(loss: Loss, eta_fn: Callable, lambdas, F: int,
         in_specs = (param_spec, opt_spec, P(), P("dp", None),
                     P("dp", None), P("dp"), P("dp"))
     smapped = _sm(fn, mesh=mesh, in_specs=in_specs,
-                  out_specs=(param_spec, opt_spec, P()), **{flag: False})
+                  out_specs=(param_spec, opt_spec, P()), check_vma=False)
     return jax.jit(smapped, donate_argnums=(0, 1))
 
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from .losses import Loss
 from .optimizers import Optimizer
+from .scan import scannable
 
 __all__ = ["make_linear_step", "linear_margin", "make_linear_predict"]
 
@@ -37,11 +38,13 @@ def linear_margin(w: jnp.ndarray, idx: jnp.ndarray, val: jnp.ndarray
 def make_linear_step(loss: Loss, optimizer: Optimizer) -> Callable:
     """Build the jitted train step: (w, opt_state, t, batch) -> updated."""
 
-    # donation lets XLA update the weight/accumulator tables in place
-    # instead of copying them every minibatch (O(dims) tables; the copy,
-    # not the math, dominates at -dims 2^24)
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(w, opt_state, t, idx, val, label, row_mask):
+    # the pure scannable core: the K=1 path jits it directly (donation
+    # lets XLA update the weight/accumulator tables in place instead of
+    # copying them every minibatch — O(dims) tables; the copy, not the
+    # math, dominates at -dims 2^24) and -steps_per_dispatch > 1 runs the
+    # SAME function as a lax.scan body (ops.scan.make_megastep) with the
+    # state threaded through the donated scan carry
+    def core(w, opt_state, t, idx, val, label, row_mask):
         wf = w.astype(jnp.float32)
         if val is None:
             # unit-value elision (io.sparse.SparseBatch): categorical rows
@@ -57,7 +60,7 @@ def make_linear_step(loss: Loss, optimizer: Optimizer) -> Callable:
         loss_sum = (loss.loss(margin, label) * row_mask).sum()
         return w_new.astype(w.dtype), opt_state, loss_sum
 
-    return step
+    return scannable(partial(jax.jit, donate_argnums=(0, 1))(core), core)
 
 
 def make_linear_predict() -> Callable:

@@ -14,7 +14,11 @@ the analog of WeightValueParamsF1/F2 cells.
 
 API: ``opt.init(shape) -> state``; ``opt.update(w, g, state, t) -> (w, state)``
 with t the 0-based global step; ``opt.finalize(w, state) -> w`` materializes
-lazy weights (RDA/FTRL). All pieces are pytrees, safe under jit/shard_map.
+lazy weights (RDA/FTRL). All pieces are pytrees, safe under jit/shard_map —
+and under ``lax.scan``: every update is a pure function of (w, g, state, t)
+with no step-count side state of its own (t arrives as an argument), which
+is what lets the fused-dispatch path (ops.scan) thread K optimizer steps
+through one donated scan carry without touching this module.
 """
 
 from __future__ import annotations

@@ -315,21 +315,12 @@ def make_forest_builder_sharded(build, mesh):
     gather happens on the host over the [E]-sharded outputs."""
     import jax
     from jax.sharding import PartitionSpec as P
-    import inspect
-    try:
-        from jax import shard_map as _sm
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as _sm
-    # the flag was spelled check_rep before check_vma, in BOTH import
-    # locations across jax versions — key on the actual signature
-    flag = ("check_vma" if "check_vma" in inspect.signature(_sm).parameters
-            else "check_rep")
-    nocheck = {flag: False}
+    from ..utils.jax_compat import shard_map as _sm
     return jax.jit(_sm(
         build, mesh=mesh,
         in_specs=(P(), P(), P("dp"), P("dp")),
         out_specs=(P("dp"), P("dp"), P("dp")),
-        **nocheck))
+        check_vma=False))
 
 
 @lru_cache(maxsize=128)

@@ -22,8 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
-
+from ..utils.jax_compat import shard_map
 from ..ops.losses import Loss
 from ..ops.optimizers import Optimizer
 

@@ -1,0 +1,28 @@
+"""jax version-compat shims shared across the repo.
+
+One place for the import/signature dances that would otherwise be
+copy-pasted wherever jax moved or renamed an API between the versions
+this repo runs under (0.4.x in the container, newer on dev machines).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:                               # jax >= 0.6 exports it at top level
+    from jax import shard_map as _shard_map
+except ImportError:                # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+__all__ = ["shard_map"]
+
+
+def shard_map(*args, **kwargs):
+    """`jax.shard_map` with the replication-check flag normalized: the
+    flag was spelled ``check_rep`` before ``check_vma``, in BOTH import
+    locations across jax versions — callers pass ``check_vma`` and this
+    shim rewrites it when the installed signature wants the old name."""
+    if "check_vma" in kwargs and \
+            "check_vma" not in inspect.signature(_shard_map).parameters:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)

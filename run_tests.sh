@@ -7,5 +7,8 @@ env -u PALLAS_AXON_POOL_IPS -u JAX_PLATFORMS \
 
 # bench harness smoke: tiny-shape runs of the ingest-path benches assert
 # every metric still emits and parses (pipeline refactors must not silently
-# break bench.py). Same CPU isolation as the tests.
+# break bench.py), and the dispatch-fusion microbench enforces its floor —
+# K=8 fused smoke throughput below the K=1 number fails the run (catches
+# accidental defusion of the -steps_per_dispatch path). Same CPU isolation
+# as the tests.
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python bench.py --smoke

@@ -174,6 +174,69 @@ def test_solve_small_large_magnitude_no_overflow():
     assert np.isfinite(scores2).all()
 
 
+def test_solve_small_1x1_degenerate_and_logdet():
+    """The n==1 branch follows the n>=2 contract: the (single) pivot is
+    equilibrated and floored at 1e-7, so a zero/denormal 1x1 system
+    returns a FINITE solve and logdet instead of inf (the raw divide
+    returned inf and log(0) = -inf); with_logdet asserts pd."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from hivemall_tpu.models.anomaly import _solve_small
+
+    G = jnp.asarray([[[4.0]], [[0.0]], [[2.5e13]]], jnp.float32)
+    R = jnp.asarray([[[2.0]], [[3.0]], [[5e13]]], jnp.float32)
+    x, ld = _solve_small(G, R, pd=True, with_logdet=True)
+    x, ld = np.asarray(x), np.asarray(ld)
+    assert np.isfinite(x).all() and np.isfinite(ld).all()
+    np.testing.assert_allclose(x[0, 0, 0], 0.5, rtol=1e-6)
+    np.testing.assert_allclose(ld[0], np.log(4.0), rtol=1e-6)
+    np.testing.assert_allclose(x[2, 0, 0], 2.0, rtol=1e-6)
+    np.testing.assert_allclose(ld[2], np.log(2.5e13), rtol=1e-6)
+    # indefinite (pd=False): sign-preserving floor, still finite
+    Gm = jnp.asarray([[[-4.0]], [[0.0]]], jnp.float32)
+    Rm = jnp.asarray([[[2.0]], [[1.0]]], jnp.float32)
+    xm = np.asarray(_solve_small(Gm, Rm))
+    assert np.isfinite(xm).all()
+    np.testing.assert_allclose(xm[0, 0, 0], -0.5, rtol=1e-6)
+    with pytest.raises(AssertionError):
+        _solve_small(Gm, Rm, pd=False, with_logdet=True)
+
+
+def test_streaming_large_magnitude_matches_batch():
+    """|x| ~ 5e6 series (covariances ~1e13): the streaming SDAR oracles
+    now apply the SAME relative per-diagonal ridge as the batch path, so
+    the >256MB changefinder() fallback route can't hit the near-singular
+    warmup solve the batch path was fixed for — and both paths agree."""
+    import numpy as np
+
+    from hivemall_tpu.models.anomaly import (ChangeFinder, ChangeFinder2D,
+                                             changefinder)
+
+    rng = np.random.default_rng(17)
+    x = np.concatenate([rng.normal(5e6, 2e5, 120),
+                        rng.normal(-3e6, 2e5, 120)])
+    cf = ChangeFinder(0.05, 2, 5, 5)
+    stream = np.asarray([cf.update(v) for v in x])
+    assert np.isfinite(stream).all()
+    batch = np.asarray(changefinder(x, "-r 0.05 -k 2 -T1 5 -T2 5"))
+    np.testing.assert_allclose(stream, batch, rtol=5e-3, atol=5e-3)
+
+    x2 = np.stack([rng.normal(5e6, 3e5, 150),
+                   rng.normal(-4e6, 3e5, 150)], axis=1)
+    x2[75:] *= 0.4                      # change point at t=75
+    cf2 = ChangeFinder2D(2, 0.05, 2, 5, 5)
+    stream2 = np.asarray([cf2.update(v) for v in x2])
+    assert np.isfinite(stream2).all()
+    batch2 = np.asarray(changefinder(x2, "-r 0.05 -k 2 -T1 5 -T2 5"))
+    # skip the first handful of warmup rows: at covariance scale ~1e13
+    # the f32 scan's first rank-1 systems round differently from the f64
+    # oracle; past warmup the EMA contraction holds both to ~2%
+    np.testing.assert_allclose(stream2[10:], batch2[10:],
+                               rtol=2.5e-2, atol=2.5e-2)
+
+
 def test_solve_small_heterogeneous_diagonal():
     """Jacobi equilibration (not global max-scaling) keeps _solve_small
     exact when diagonal entries span many decades — diag(2e10, 2e4, 2e4)
@@ -249,7 +312,11 @@ def test_sst_ika_matches_svd_detection():
                         np.sin(np.arange(600) * 0.33)])
     si = np.asarray(sst(x, "-w 24 -r 3 -scorefunc ika"))
     sv = np.asarray(sst(x, "-w 24 -r 3 -scorefunc svd"))
-    assert abs(int(np.argmax(si)) - int(np.argmax(sv))) <= 5
+    # both score functions build the SAME future window (first future
+    # column ends at t+g — the base_f off-by-one is fixed), so the only
+    # residual disagreement is power-iteration convergence on the flat
+    # pre-change spectrum: the argmax may wobble one offset, never five
+    assert abs(int(np.argmax(si)) - int(np.argmax(sv))) <= 1
     assert np.abs(si - sv).max() < 0.12
     assert np.isfinite(si).all() and (si >= 0).all() and (si <= 1).all()
 

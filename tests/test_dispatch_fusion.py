@@ -1,0 +1,327 @@
+"""Fused multi-step dispatch (-steps_per_dispatch, PR 2).
+
+K prepared minibatches stack into ONE h2d transfer and ONE jitted
+lax.scan running all K optimizer steps with donated state (ops.scan,
+io.prefetch.MegabatchStager, LearnerBase._dispatch_mega). The contract
+these tests pin:
+
+- K>1 runs the SAME per-step core the K=1 path jits, on the SAME batches
+  in the SAME order -> the per-step loss trajectory and the final model
+  state are identical (`_trace_losses` records both paths' per-step loss
+  sums without changing dispatch).
+- Ragged tails (last window < K), kind changes (unit-valued vs
+  real-valued batches mid-stream) and foreign batch kinds flush to the
+  K=1 path one batch at a time — every batch trains exactly once either
+  way.
+- Donated scan carries never leave stale buffers behind: interleaving
+  save_bundle/model_rows with further fused fits equals an uninterrupted
+  run.
+- The scan body compiles under GSPMD: -steps_per_dispatch with -mesh
+  matches the K=1 mesh trajectory (the driver's dryrun_multichip checks
+  the same on its virtual mesh).
+"""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.io.sparse import (MegaBatch, PackedMegaBatch, SparseBatch,
+                                    SparseDataset)
+from hivemall_tpu.models.fm import FFMTrainer, FMTrainer
+from hivemall_tpu.models.linear import GeneralClassifier
+
+
+def _linear_ds(n=2200, L=8, dims=1 << 12, seed=0, unit=True):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(1, dims, (n, L)).astype(np.int32)
+    val = (np.ones(n * L, np.float32) if unit
+           else rng.uniform(0.5, 1.5, n * L).astype(np.float32))
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    return SparseDataset(idx.ravel(), np.arange(0, n * L + 1, L),
+                         val, lab)
+
+
+def _ffm_ds(n=1500, L=8, dims=1 << 12, F=8, seed=1, unit=True):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(1, dims, (n, L)).astype(np.int32)
+    fld = np.tile(np.arange(L, dtype=np.int32) % F, (n, 1))
+    val = (np.ones(n * L, np.float32) if unit
+           else rng.uniform(0.5, 1.5, n * L).astype(np.float32))
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    return SparseDataset(idx.ravel(), np.arange(0, n * L + 1, L),
+                         val, lab, fld.ravel())
+
+
+def _trajectory(make, ds, k, *, prefetch=False, epochs=1):
+    t = make(k)
+    t._trace_losses = []
+    t.fit(ds, epochs=epochs, shuffle=True, prefetch=prefetch)
+    return np.asarray(t._trace_losses), t
+
+
+def _assert_same_trajectory(make, ds, k=4, *, prefetch=False, epochs=1):
+    l1, t1 = _trajectory(make, ds, 1, prefetch=prefetch, epochs=epochs)
+    lk, tk = _trajectory(make, ds, k, prefetch=prefetch, epochs=epochs)
+    assert len(l1) == len(lk) > 0
+    np.testing.assert_allclose(lk, l1, rtol=1e-6, atol=1e-8)
+    assert tk._examples == t1._examples
+    assert tk._t == t1._t
+    return t1, tk
+
+
+# --- trajectory equality: every dispatch kind -------------------------------
+
+def test_linear_k4_matches_k1_unit_and_real():
+    """K>1 == K=1 on a shuffled epoch, with a ragged tail (2200 rows =
+    8 full 256-row batches + tail; K=4 -> 2 megabatches + 1 single),
+    for BOTH the unit-valued (val=None elision) and real-valued kinds."""
+    for unit in (True, False):
+        ds = _linear_ds(unit=unit)
+        t1, tk = _assert_same_trajectory(
+            lambda k: GeneralClassifier(
+                f"-dims {1 << 12} -mini_batch 256 -opt adagrad "
+                f"-steps_per_dispatch {k}"), ds)
+        np.testing.assert_allclose(np.asarray(tk.w), np.asarray(t1.w),
+                                   rtol=1e-6, atol=1e-8)
+        st = tk.pipeline_stats.as_dict()
+        assert st["steps_per_dispatch"] == 4
+        assert st["megabatches_staged"] == 2
+        assert st["singles_flushed"] == 1
+
+
+def test_fm_fused_k4_matches_k1():
+    for unit in (True, False):
+        ds = _linear_ds(n=1100, unit=unit, seed=3)
+        _assert_same_trajectory(
+            lambda k: FMTrainer(
+                f"-dims {1 << 12} -factors 4 -mini_batch 256 -opt adagrad "
+                f"-classification -steps_per_dispatch {k}"), ds)
+
+
+def test_ffm_fieldmajor_and_packed_k4_match_k1():
+    """The flagship joint-layout kinds: canonical field-major megabatches
+    and (with -pack_input on) PackedMegaBatch — one stacked uint8 buffer
+    per 4 steps, unpacked per scan iteration on device."""
+    ds = _ffm_ds()
+    for extra in ("", "-pack_input on"):
+        t1, tk = _assert_same_trajectory(
+            lambda k: FFMTrainer(
+                f"-dims {1 << 12} -factors 4 -fields 8 -mini_batch 256 "
+                f"-opt adagrad -classification -steps_per_dispatch {k} "
+                f"{extra}"), ds)
+        np.testing.assert_allclose(
+            np.asarray(tk.params["T"], np.float32),
+            np.asarray(t1.params["T"], np.float32), rtol=1e-6, atol=1e-8)
+
+
+def test_ffm_pairs_k4_matches_k1():
+    """Dense layout (non-pow2 dims) runs the general pairs core — field
+    arrays ride the megabatch as a scanned [K, B, L] input."""
+    rng = np.random.default_rng(5)
+    n, L, dims, F = 1100, 8, 5000, 8
+    idx = rng.integers(1, dims, (n, L)).astype(np.int32)
+    fld = rng.integers(0, F, (n, L)).astype(np.int32)
+    val = rng.uniform(0.5, 1.5, n * L).astype(np.float32)
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    ds = SparseDataset(idx.ravel(), np.arange(0, n * L + 1, L), val, lab,
+                       fld.ravel())
+    make = lambda k: FFMTrainer(
+        f"-dims {dims} -factors 3 -fields {F} -mini_batch 256 "
+        f"-opt adagrad -classification -steps_per_dispatch {k}")
+    assert make(1).layout == "dense"
+    _assert_same_trajectory(make, ds)
+
+
+def test_k4_matches_k1_through_prefetcher():
+    """The production stack: stager consumed by the DevicePrefetcher
+    worker thread (megabatch stage_batch blocks on transfer — the
+    staging-ring contract)."""
+    ds = _linear_ds(n=1300, seed=7)
+    _assert_same_trajectory(
+        lambda k: GeneralClassifier(
+            f"-dims {1 << 12} -mini_batch 256 -opt adagrad "
+            f"-steps_per_dispatch {k}"), ds, prefetch=True)
+
+
+def test_multi_epoch_shuffled_k4_matches_k1():
+    ds = _linear_ds(n=1000, seed=9)
+    _assert_same_trajectory(
+        lambda k: GeneralClassifier(
+            f"-dims {1 << 12} -mini_batch 256 -opt sgd "
+            f"-steps_per_dispatch {k}"), ds, epochs=3)
+
+
+# --- stager mechanics -------------------------------------------------------
+
+def _mk_batch(rng, B=64, L=4, unit=True, n_valid=None):
+    idx = rng.integers(1, 1000, (B, L)).astype(np.int32)
+    val = None if unit else rng.uniform(0.5, 1.5, (B, L)).astype(np.float32)
+    lab = (rng.integers(0, 2, B) * 2 - 1).astype(np.float32)
+    return SparseBatch(idx, val, lab, n_valid=n_valid)
+
+
+def test_stager_kind_change_and_ragged_flush():
+    """A real-valued batch arriving mid-window flushes the unit window to
+    the K=1 path instead of poisoning it; stream end flushes the ragged
+    tail; counts preserve every batch exactly once, in order."""
+    from hivemall_tpu.io.prefetch import MegabatchStager
+    rng = np.random.default_rng(11)
+    batches = ([_mk_batch(rng) for _ in range(3)]          # 3 unit
+               + [_mk_batch(rng, unit=False)]              # kind change
+               + [_mk_batch(rng) for _ in range(9)]        # 2 windows + tail
+               + [_mk_batch(rng, n_valid=17)])             # ragged shape-mate
+    out = list(MegabatchStager(iter(batches), 4))
+    singles = [o for o in out if isinstance(o, SparseBatch)]
+    megas = [o for o in out if isinstance(o, MegaBatch)]
+    # 3 unit flushed single (kind change), 1 real single, 8 unit stacked
+    # into 2 megabatches, tail [1 unit + ragged] flushed single
+    assert len(megas) == 2 and all(m.n_steps == 4 for m in megas)
+    assert len(singles) == 6
+    assert all(m.val is None for m in megas)     # unit elision survived
+    total = sum(m.n_steps for m in megas) + len(singles)
+    assert total == len(batches)
+    # order: every source batch appears exactly once, in source order
+    flat_first_rows = []
+    for o in out:
+        if isinstance(o, MegaBatch):
+            flat_first_rows.extend(np.asarray(o.idx)[i, 0, 0]
+                                   for i in range(o.n_steps))
+        else:
+            flat_first_rows.append(np.asarray(o.idx)[0, 0])
+    assert flat_first_rows == [b.idx[0, 0] for b in batches]
+    # per-step validity rides nv: ragged batch's 17 is preserved
+    assert singles[-1].n_valid == 17
+
+
+def test_stager_rejects_k1_and_counts_stats():
+    from hivemall_tpu.io.pipeline import PipelineStats
+    from hivemall_tpu.io.prefetch import MegabatchStager
+    with pytest.raises(ValueError):
+        MegabatchStager(iter([]), 1)
+    rng = np.random.default_rng(13)
+    stats = PipelineStats()
+    out = list(MegabatchStager(iter([_mk_batch(rng) for _ in range(7)]),
+                               3, stats=stats))
+    assert stats.steps_per_dispatch == 3
+    assert stats.megabatches_staged == 2
+    assert stats.singles_flushed == 1
+    assert stats.stack_seconds >= 0
+    assert len(out) == 3
+
+
+def test_mega_nv_accounting():
+    """n_examples (host-side, no device sync) sums per-step valid rows."""
+    from hivemall_tpu.io.prefetch import MegabatchStager
+    rng = np.random.default_rng(17)
+    batches = [_mk_batch(rng, n_valid=17), _mk_batch(rng, n_valid=17)]
+    # same shapes + same kind: n_valid rides nv, windows still stack
+    out = list(MegabatchStager(iter(batches), 2))
+    assert len(out) == 1 and isinstance(out[0], MegaBatch)
+    assert out[0].n_examples == 34
+    assert list(out[0].nv) == [17, 17]
+
+
+# --- donation safety --------------------------------------------------------
+
+def test_donation_safe_across_bundle_and_emission(tmp_path):
+    """The megastep donates the state pytree into the scan carry; reading
+    the state between fused fits (save_bundle, model_rows) and fitting
+    again must equal an uninterrupted pair of fits — no stale donated
+    buffer is ever observable."""
+    ds = _linear_ds(n=1000, seed=21)
+    mk = lambda: GeneralClassifier(
+        f"-dims {1 << 12} -mini_batch 256 -opt adagrad "
+        f"-steps_per_dispatch 4")
+    a, b = mk(), mk()
+    a.fit(ds, epochs=1, shuffle=True, prefetch=False)
+    a.save_bundle(str(tmp_path / "mid.npz"))
+    rows_mid = list(a.model_rows())
+    assert rows_mid                      # emission reads post-scan state
+    a.fit(ds, epochs=1, shuffle=True, prefetch=False)
+    b.fit(ds, epochs=1, shuffle=True, prefetch=False)
+    b.fit(ds, epochs=1, shuffle=True, prefetch=False)
+    np.testing.assert_allclose(np.asarray(a.w), np.asarray(b.w),
+                               rtol=1e-6, atol=1e-8)
+    # and the bundle restores into a trainer that can keep fusing
+    c = mk()
+    c.load_bundle(str(tmp_path / "mid.npz"))
+    c.fit(ds, epochs=1, shuffle=True, prefetch=False)
+    np.testing.assert_allclose(np.asarray(c.w), np.asarray(a.w),
+                               rtol=1e-6, atol=1e-8)
+
+
+# --- resolution / fallbacks -------------------------------------------------
+
+def test_auto_resolution_and_validation():
+    t = GeneralClassifier(f"-dims {1 << 10} -mini_batch 64")
+    import jax
+    expect = 1 if jax.default_backend() == "cpu" else 8
+    assert t._resolved_steps_per_dispatch() == expect
+    te = GeneralClassifier(f"-dims {1 << 10} -steps_per_dispatch 5")
+    assert te._resolved_steps_per_dispatch() == 5
+    with pytest.raises(ValueError):
+        GeneralClassifier(
+            f"-dims {1 << 10} -steps_per_dispatch -2"
+        )._resolved_steps_per_dispatch()
+
+
+def test_non_scannable_trainer_falls_back_to_k1():
+    """Covariance trainers keep bespoke (w, sigma) state — no scannable
+    core, so steps_per_dispatch resolves to 1 (their spec doesn't even
+    expose the knob) and training is untouched."""
+    from hivemall_tpu.models.classifier import AROWTrainer
+    t = AROWTrainer(f"-dims {1 << 10} -mini_batch 64")
+    assert not t._supports_megastep()
+    assert t._resolved_steps_per_dispatch() == 1
+    ds = _linear_ds(n=200, L=4, dims=1 << 10, seed=23)
+    t.fit(ds, epochs=1, prefetch=False)
+    assert t._examples == 200
+
+
+def test_process_flush_replay_matches_fit_k():
+    """The UDTF lifecycle (process/close with -iters replay) also rides
+    the K=1 path unchanged with fusion enabled — fused dispatch only
+    engages where batches stream through _fit_epochs/fit_stream."""
+    rng = np.random.default_rng(29)
+    t = GeneralClassifier(f"-dims {1 << 10} -mini_batch 64 -iters 2 "
+                          f"-steps_per_dispatch 4")
+    for _ in range(150):
+        feats = [f"{rng.integers(1, 1000)}:1" for _ in range(4)]
+        t.process(feats, float(rng.integers(0, 2) * 2 - 1))
+    rows = list(t.close())
+    assert rows and t._examples == 300   # 2 epochs x 150 rows
+    assert np.isfinite(t.cumulative_loss)
+
+
+# --- mesh (GSPMD) -----------------------------------------------------------
+
+def test_mesh_k4_matches_mesh_k1():
+    """The scan body compiles under GSPMD with the K=1 step's shardings
+    (batch rows over dp on axis 1, tables over tp through the donated
+    carry) and reproduces the K=1 mesh trajectory."""
+    ds = _ffm_ds(n=640, dims=1 << 10)
+    make = lambda k: FFMTrainer(
+        f"-dims {1 << 10} -factors 4 -fields 8 -mini_batch 128 "
+        f"-opt adagrad -classification -mesh dp=2,tp=4 "
+        f"-steps_per_dispatch {k}")
+    l1, t1 = _trajectory(make, ds, 1)
+    l4, t4 = _trajectory(make, ds, 4)
+    assert len(l1) == len(l4) == 5
+    np.testing.assert_allclose(l4, l1, rtol=1e-5, atol=1e-6)
+    T1, T4 = t1.params["T"], t4.params["T"]
+    np.testing.assert_allclose(np.asarray(T4, np.float32),
+                               np.asarray(T1, np.float32),
+                               rtol=1e-5, atol=1e-7)
+    # the donated carry preserved the tp sharding
+    assert T4.sharding.shard_shape(T4.shape)[0] == t4.Mr // 4
+
+
+def test_mesh_linear_k4_matches_k1():
+    ds = _linear_ds(n=640, dims=1 << 10, seed=31, unit=False)
+    make = lambda k: GeneralClassifier(
+        f"-dims {1 << 10} -mini_batch 128 -opt adagrad -mesh dp=4,tp=2 "
+        f"-steps_per_dispatch {k}")
+    l1, t1 = _trajectory(make, ds, 1)
+    l4, t4 = _trajectory(make, ds, 4)
+    np.testing.assert_allclose(l4, l1, rtol=1e-5, atol=1e-6)
+    w4 = t4.w
+    assert w4.sharding.shard_shape(w4.shape)[0] == (1 << 10) // 2
