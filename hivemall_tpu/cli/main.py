@@ -80,6 +80,28 @@ def _cmd_train(args) -> int:
             return 2
     if args.load_bundle:
         trainer.load_bundle(args.load_bundle)
+    resumed = False
+    if getattr(args, "resume", False):
+        if args.load_bundle:
+            # both flags name a state source; silently letting the newer
+            # autosave win would train something other than the bundle the
+            # user pinned explicitly
+            print("error: --resume and --load-bundle both restore trainer "
+                  "state; pass one or the other", file=sys.stderr)
+            return 2
+        if not hasattr(trainer, "resume"):
+            print(f"error: {args.algo} does not support --resume",
+                  file=sys.stderr)
+            return 2
+        resumed = trainer.resume()
+        if resumed:
+            print(json.dumps({"resumed": True, "step": int(trainer._t),
+                              "stream_pos": int(getattr(trainer,
+                                                        "_stream_pos", 0))}),
+                  file=sys.stderr)
+        else:
+            print("warning: --resume found no usable checkpoint in "
+                  "-checkpoint_dir; starting fresh", file=sys.stderr)
     ds, streaming = _load_input(args, trainer)
     n_examples = len(ds)
     t0 = time.time()
@@ -91,7 +113,7 @@ def _cmd_train(args) -> int:
             return 2
         epochs = int(getattr(trainer.opts, "iters", 1))
         bs = int(getattr(trainer.opts, "mini_batch", 256))
-        trainer.fit_stream(ds.batches(bs, epochs=epochs))
+        trainer.fit_stream(ds.batches(bs, epochs=epochs), resume=resumed)
         n_examples *= max(1, epochs)   # the stream runs every epoch itself
         rows = None
     elif hasattr(trainer, "fit"):
@@ -257,6 +279,11 @@ def main(argv=None) -> int:
                    help="resume from a full-state checkpoint bundle (.npz)")
     t.add_argument("--save-bundle", default=None,
                    help="write a full-state checkpoint bundle at the end")
+    t.add_argument("--resume", action="store_true",
+                   help="restore the newest usable autosaved bundle from "
+                        "the trainer's -checkpoint_dir before training "
+                        "(shard-directory input resumes mid-stream; file "
+                        "input restarts its epoch with restored state)")
     t.set_defaults(fn=_cmd_train)
 
     pr = sub.add_parser("predict", help="score a LIBSVM file with a model")

@@ -8,29 +8,52 @@ mid-epoch resume does not exist. The rebuild keeps the model-table path
 what the reference lacks: a full bundle of every device array a trainer
 needs to continue exactly where it stopped — weights, optimizer slots,
 covariance tables, the global step (which drives EtaEstimator schedules),
-example counts, and the hashed-id→name map.
+example counts, stream position, and the hashed-id→name map.
 
 Format: one .npz — flattened pytree leaves (bf16 stored as f32, original
 dtype restored from the live trainer's reference tree on load) plus a json
-metadata record. Loading validates trainer name and leaf shapes so a bundle
-can't silently resume onto a mismatched config.
+metadata record carrying a manifest: format version + a sha256 digest over
+the leaf tree, validated with a clear error on load so a truncated or
+bit-flipped bundle can never silently resume. Writes are crash-atomic:
+tmp file → fsync → ``os.replace`` — a crash mid-save leaves the previous
+bundle intact, never a half-written one (docs/RELIABILITY.md).
+
+:class:`CheckpointManager` adds autosave cadence + last-k retention for
+the ``-checkpoint_dir`` / ``-checkpoint_every`` trainer options.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
-from typing import Any, Dict
+import os
+import re
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
-__all__ = ["save_bundle", "load_bundle"]
+__all__ = ["save_bundle", "load_bundle", "CheckpointManager", "list_bundles"]
 
-_FORMAT = 1
+_FORMAT = 2          # 2 adds the digest manifest + stream position
+_STEP_RE = re.compile(r"-step(\d+)\.npz$")
+
+
+def _leaf_digest(arrays: List[np.ndarray]) -> str:
+    """sha256 over the leaf tree — dtype/shape/bytes of every stored leaf,
+    in order. Computed over the arrays as WRITTEN (post bf16→f32 cast) so
+    load-side recomputation sees identical bytes."""
+    h = hashlib.sha256()
+    for i, a in enumerate(arrays):
+        h.update(f"{i}:{a.dtype.str}:{a.shape}".encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
 
 
 def save_bundle(trainer, path: str) -> None:
-    """Write the trainer's full resumable state to ``path`` (.npz).
+    """Write the trainer's full resumable state to ``path`` (.npz),
+    atomically: tmp → fsync → os.replace. A crash at any point leaves
+    either the old bundle or the new one, never a torn file.
 
     Works for any trainer exposing `_checkpoint_arrays`/`_restore_arrays`;
     the LearnerBase counters (_examples, _loss_sum, _names) are optional so
@@ -38,32 +61,66 @@ def save_bundle(trainer, path: str) -> None:
     if hasattr(trainer, "_fold_loss"):
         trainer._fold_loss()
     leaves, treedef = jax.tree_util.tree_flatten(trainer._checkpoint_arrays())
-    meta: Dict[str, Any] = {
-        "format": _FORMAT,
-        "trainer": trainer.NAME,
-        "n_leaves": len(leaves),
-        "t": getattr(trainer, "_t", 0),
-        "examples": getattr(trainer, "_examples", 0),
-        "loss_sum": getattr(trainer, "_loss_sum", 0.0),
-        "names": {str(k): v for k, v in getattr(trainer, "_names",
-                                                {}).items()},
-        "scalars": (trainer._checkpoint_scalars()
-                    if hasattr(trainer, "_checkpoint_scalars") else {}),
-    }
     arrays = {}
+    stored: List[np.ndarray] = []
     for i, leaf in enumerate(leaves):
         a = np.asarray(leaf)
         if a.dtype.name == "bfloat16":      # npz can't take ml_dtypes leaves
             a = a.astype(np.float32)
         arrays[f"leaf_{i}"] = a
-    np.savez(path, __meta__=np.array(json.dumps(meta)), **arrays)
+        stored.append(a)
+    meta: Dict[str, Any] = {
+        "format": _FORMAT,
+        "trainer": trainer.NAME,
+        "n_leaves": len(leaves),
+        "digest": _leaf_digest(stored),
+        "t": getattr(trainer, "_t", 0),
+        "examples": getattr(trainer, "_examples", 0),
+        "loss_sum": getattr(trainer, "_loss_sum", 0.0),
+        "stream_pos": int(getattr(trainer, "_stream_pos", 0)),
+        "names": {str(k): v for k, v in getattr(trainer, "_names",
+                                                {}).items()},
+        "scalars": (trainer._checkpoint_scalars()
+                    if hasattr(trainer, "_checkpoint_scalars") else {}),
+    }
+    rng = getattr(trainer, "_rng", None)
+    if rng is not None and hasattr(rng, "bit_generator"):
+        meta["rng_state"] = rng.bit_generator.state   # np Generator state
+    tmp = f"{path}.{os.getpid()}.tmp.npz"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, __meta__=np.array(json.dumps(meta)), **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):             # failed mid-write: no litter
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    # fsync the directory so the rename itself is durable (best-effort:
+    # not every filesystem supports opening a directory)
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
 
 
 def load_bundle(trainer, path: str) -> None:
-    """Restore a bundle into a freshly constructed trainer (same options)."""
+    """Restore a bundle into a freshly constructed trainer (same options).
+
+    Validates the manifest before touching trainer state: format version,
+    trainer name, leaf count/shapes, and (format >= 2) the sha256 leaf
+    digest — a corrupted or truncated bundle raises ValueError with the
+    cause rather than resuming garbage."""
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
-        if meta.get("format") != _FORMAT:
+        if meta.get("format") not in (1, _FORMAT):
             raise ValueError(
                 f"bundle format {meta.get('format')!r} != supported "
                 f"{_FORMAT} — bundle written by an incompatible version")
@@ -77,9 +134,13 @@ def load_bundle(trainer, path: str) -> None:
             raise ValueError(
                 f"bundle has {meta['n_leaves']} state arrays, trainer "
                 f"expects {len(ref_leaves)} — options mismatch?")
+        raw = [z[f"leaf_{i}"] for i in range(len(ref_leaves))]
+        if "digest" in meta and _leaf_digest(raw) != meta["digest"]:
+            raise ValueError(
+                f"bundle digest mismatch for {path!r} — file corrupted "
+                f"or truncated (copied mid-write?); refusing to resume")
         leaves = []
-        for i, ref in enumerate(ref_leaves):
-            a = z[f"leaf_{i}"]
+        for i, (a, ref) in enumerate(zip(raw, ref_leaves)):
             if tuple(a.shape) != tuple(ref.shape):
                 raise ValueError(
                     f"state array {i}: bundle shape {a.shape} != "
@@ -89,12 +150,89 @@ def load_bundle(trainer, path: str) -> None:
     trainer._t = int(meta["t"])
     for attr, val in (("_examples", int(meta["examples"])),
                       ("_loss_sum", float(meta["loss_sum"])),
-                      ("_loss_pending", 0.0)):
+                      ("_loss_pending", 0.0),
+                      ("_stream_pos", int(meta.get("stream_pos", 0)))):
         if hasattr(trainer, attr):
             setattr(trainer, attr, val)
     if hasattr(trainer, "_names"):
         trainer._names.update({int(k): v for k, v in meta["names"].items()})
     if meta.get("scalars") and hasattr(trainer, "_restore_scalars"):
         trainer._restore_scalars(meta["scalars"])
+    rng = getattr(trainer, "_rng", None)
+    if meta.get("rng_state") and rng is not None \
+            and hasattr(rng, "bit_generator"):
+        rng.bit_generator.state = meta["rng_state"]
     if getattr(trainer, "mesh", None) is not None:
         trainer._reshard_state()      # bundles load replicated; re-shard
+
+
+def list_bundles(checkpoint_dir: str, name: str) -> List[str]:
+    """Autosaved step bundles for ``name`` under ``checkpoint_dir``,
+    newest (highest step) first. Non-step .npz files are ignored."""
+    try:
+        entries = os.listdir(checkpoint_dir)
+    except OSError:
+        return []
+    found = []
+    for fn in entries:
+        if not fn.startswith(f"{name}-step"):
+            continue
+        m = _STEP_RE.search(fn)
+        if m:
+            found.append((int(m.group(1)), os.path.join(checkpoint_dir, fn)))
+    return [p for _, p in sorted(found, reverse=True)]
+
+
+class CheckpointManager:
+    """Autosave cadence + last-k retention over atomic ``save_bundle``.
+
+    Drives the ``-checkpoint_dir`` / ``-checkpoint_every`` /
+    ``-checkpoint_keep`` trainer options inside ``fit_stream``: a bundle
+    lands every ``every`` optimizer steps (windows that cross several
+    boundaries — fused K-step dispatch — save once), plus a final bundle at
+    stream end; only the ``keep`` newest step bundles are retained."""
+
+    def __init__(self, checkpoint_dir: str, name: str, *, keep: int = 3,
+                 every: int = 0, start_step: int = 0):
+        self.dir = checkpoint_dir
+        self.name = name
+        self.keep = max(1, int(keep))
+        self.every = int(every)
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self._next = start_step + self.every if self.every else None
+        self._last_saved_step: Optional[int] = None
+
+    def maybe_save(self, trainer) -> Optional[str]:
+        if self._next is None or trainer._t < self._next:
+            return None
+        path = self.save(trainer)
+        while self._next <= trainer._t:
+            self._next += self.every
+        return path
+
+    def save(self, trainer) -> str:
+        path = os.path.join(self.dir,
+                            f"{self.name}-step{trainer._t:010d}.npz")
+        save_bundle(trainer, path)
+        self._last_saved_step = int(trainer._t)
+        self._prune()
+        from ..utils.metrics import get_stream
+        stream = get_stream()
+        if stream.enabled:
+            stream.emit("checkpoint", trainer=self.name,
+                        step=int(trainer._t), path=path)
+        return path
+
+    def save_final(self, trainer) -> Optional[str]:
+        """End-of-stream bundle, skipped when the cadence already saved
+        this exact step."""
+        if self._last_saved_step == int(trainer._t):
+            return None
+        return self.save(trainer)
+
+    def _prune(self) -> None:
+        for path in list_bundles(self.dir, self.name)[self.keep:]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass

@@ -133,6 +133,9 @@ class PipelineStats:
     queue_occupancy_sum: int = 0           # qsize sampled at each get
     queue_samples: int = 0
     queue_peak: int = 0
+    worker_errors: int = 0                 # prep fn() raised (re-raised in
+                                           # stream position by the consumer)
+    source_errors: int = 0                 # source iterator raised
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
 
@@ -172,6 +175,8 @@ class PipelineStats:
             "singles_flushed": self.singles_flushed,
             "avg_queue_occupancy": round(self.avg_queue_occupancy, 3),
             "queue_peak": self.queue_peak,
+            "worker_errors": self.worker_errors,
+            "source_errors": self.source_errors,
         }
 
 
@@ -256,7 +261,12 @@ class IngestPipeline:
         if self._exec is None:          # sequential fallback
             item = next(self._src)      # StopIteration ends the stream
             t0 = time.perf_counter()
-            out = self._fn(item)
+            try:
+                out = self._fn(item)
+            except BaseException:
+                self.stats.add(worker_errors=1)
+                self._closed.set()
+                raise
             self.stats.add(prep_seconds=time.perf_counter() - t0,
                            batches_prepared=1)
             return out
@@ -268,12 +278,14 @@ class IngestPipeline:
             self._exec.shutdown(wait=False)
             raise StopIteration
         if isinstance(fut, _SourceError):
+            self.stats.add(source_errors=1)
             self.close()
             raise fut.e
         self.stats.sample_queue(self._q.qsize())
         try:
             out, dt = fut.result()      # worker exception re-raises HERE —
         except BaseException:           # within one batch of where it fired
+            self.stats.add(worker_errors=1)
             self.close()
             raise
         self.stats.add(prep_wait_seconds=time.perf_counter() - t0,

@@ -23,7 +23,29 @@ from typing import Iterator, List, Tuple
 
 import numpy as np
 
-__all__ = ["RowSegmentStore"]
+__all__ = ["RowSegmentStore", "skip_batches"]
+
+
+def skip_batches(batches, n: int):
+    """Advance a batch stream past its first ``n`` items — the resume-side
+    half of the checkpoint stream-position contract (SURVEY.md §6):
+    autosaved bundles record how many source batches were dispatched, and
+    a resumed ``fit_stream(..., resume=True)`` re-opens the SAME
+    deterministic stream (same shard order, same shuffle seed) and skips
+    that prefix, so training continues on exactly the batches the crashed
+    run never saw. Raises ValueError if the stream ends inside the skip —
+    that means the caller re-opened a different (shorter) stream than the
+    checkpoint was cut from."""
+    it = iter(batches)
+    for i in range(int(n)):
+        try:
+            next(it)
+        except StopIteration:
+            raise ValueError(
+                f"stream exhausted at batch {i} while skipping to the "
+                f"checkpointed position {n} — resumed stream does not "
+                f"match the one the checkpoint was written against") from None
+    return it
 
 
 def _default_budget() -> int:

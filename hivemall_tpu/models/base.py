@@ -27,7 +27,49 @@ from ..utils.hashing import mhash
 from ..utils.metrics import Meter, get_stream
 from ..utils.options import OptionSpec, Parsed
 
-__all__ = ["LearnerBase", "learner_option_spec"]
+__all__ = ["LearnerBase", "learner_option_spec",
+           "add_mix_reliability_options"]
+
+
+def add_mix_reliability_options(s: OptionSpec) -> OptionSpec:
+    """MIX fault-tolerance knobs (docs/RELIABILITY.md): retry + backoff +
+    circuit breaker replacing the old first-error permanent kill-switch.
+    Shared by the general learner grammar and the bespoke specs of
+    trainers that also mix (covariance classifiers etc.)."""
+    s.add("mix_timeout", type=float, default=2.0, min=1e-3,
+          help="per-socket-op MIX timeout in seconds")
+    s.add("mix_retries", type=int, default=2, min=0,
+          help="extra attempts per MIX exchange after the first fails "
+               "(reconnect + resend with jittered exponential backoff)")
+    s.add("mix_backoff", type=float, default=0.05, min=0.0,
+          help="base MIX retry backoff seconds (doubled per attempt, "
+               "jittered in [0.5x, 1.5x), capped at 2s)")
+    s.add("mix_deadline", type=float, default=0.0, min=0.0,
+          help="wall-clock budget per MIX exchange incl. retries; "
+               "0 = 2x -mix_timeout")
+    s.add("mix_breaker_threshold", type=int, default=3, min=1,
+          help="consecutive failed exchanges that open the MIX circuit "
+               "breaker (exchanges then drop instead of blocking on a "
+               "dead server)")
+    s.add("mix_breaker_cooldown", type=float, default=1.0, min=0.0,
+          help="seconds the breaker stays open before a half-open "
+               "reconnect probe")
+    s.add("mix_breaker_trips", type=int, default=3, min=1,
+          help="consecutive breaker opens (no success between) before "
+               "the client degrades permanently to unmixed training")
+    return s
+
+
+def _mix_knob_defaults() -> dict:
+    """The single source of truth for mix-knob defaults: derived from the
+    option spec above, so bespoke trainer specs that predate a knob fall
+    back to exactly the documented default (no second literal to drift)."""
+    cached = getattr(_mix_knob_defaults, "_cache", None)
+    if cached is None:
+        spec = add_mix_reliability_options(OptionSpec("_mix_knobs"))
+        cached = {o.name: o.default for o in spec.options}
+        _mix_knob_defaults._cache = cached
+    return cached
 
 
 def learner_option_spec(name: str, *, classification: bool,
@@ -78,6 +120,7 @@ def learner_option_spec(name: str, *, classification: bool,
     s.add("mix_threshold", type=int, default=16,
           help="local updates between mix exchanges")
     s.add("mix_session", default=None, help="mix session/group id")
+    add_mix_reliability_options(s)
     s.flag("ssl", help="TLS-wrap the MIX connection (reference LearnerBase "
                        "-ssl); pair with -ssl_cafile to verify the server")
     s.add("ssl_cafile", default=None,
@@ -85,6 +128,17 @@ def learner_option_spec(name: str, *, classification: bool,
                "(omit for encrypted-but-unauthenticated, matching the "
                "reference's in-cluster -ssl)")
     s.add("loadmodel", default=None, help="warm-start from a saved model table")
+    # elastic recovery (SURVEY.md §6): autosaved full-state bundles +
+    # mid-stream resume — see docs/RELIABILITY.md
+    s.add("checkpoint_dir", default=None,
+          help="directory for autosaved checkpoint bundles; enables "
+               "resume() and per-epoch fit() bundles")
+    s.add("checkpoint_every", type=int, default=0, min=0,
+          help="autosave a full-state bundle every N optimizer steps "
+               "during fit_stream (atomic write, last -checkpoint_keep "
+               "retained); 0 = off")
+    s.add("checkpoint_keep", type=int, default=3, min=1,
+          help="how many autosaved step bundles to retain")
     s.flag("cv", help="track cumulative loss for convergence check")
     return s
 
@@ -142,6 +196,7 @@ class LearnerBase:
         from ..io.replay_segment import RowSegmentStore
         self._replay = RowSegmentStore()
         self._t = 0                           # global step (batches seen)
+        self._stream_pos = 0                  # fit_stream batches consumed
         self._loss_sum = 0.0                  # host float64, exact
         self._loss_pending = 0.0              # on-device partial, folded in
         self._examples = 0
@@ -163,12 +218,28 @@ class LearnerBase:
             if self.opts.get("ssl"):
                 from ..parallel.mix_service import make_client_ssl_context
                 sslctx = make_client_ssl_context(self.opts.ssl_cafile)
+            # bespoke trainer specs may predate a knob: fall back to the
+            # spec-derived default rather than requiring every spec to
+            # carry all of add_mix_reliability_options (None = unset,
+            # 0 is a valid setting)
+            defaults = _mix_knob_defaults()
+
+            def knob(name):
+                v = self.opts.get(name)
+                return defaults[name] if v is None else v
             self._mixer = MixClient(
                 self.opts.mix,
                 group=self.opts.mix_session or self.NAME,
                 threshold=int(self.opts.mix_threshold),
                 event=EVENT_ARGMIN_KLD if has_covar else EVENT_AVERAGE,
-                ssl_context=sslctx)
+                timeout=float(knob("mix_timeout")),
+                ssl_context=sslctx,
+                retries=int(knob("mix_retries")),
+                backoff=float(knob("mix_backoff")),
+                deadline=float(knob("mix_deadline")) or None,
+                breaker_threshold=int(knob("mix_breaker_threshold")),
+                breaker_cooldown=float(knob("mix_breaker_cooldown")),
+                breaker_trips=int(knob("mix_breaker_trips")))
         if self.opts.loadmodel:
             self._warm_start(self.opts.loadmodel)
         if self.opts.get("mesh"):
@@ -246,7 +317,9 @@ class LearnerBase:
         if self._wants_fit_ds():
             self._fit_ds = ds             # emission-time metadata (FFM pairs)
         # elastic recovery (SURVEY.md §6): per-epoch bundle when requested
-        ckdir = os.environ.get("HIVEMALL_TPU_CHECKPOINT_DIR")
+        # (-checkpoint_dir option, or the env var the pre-option path used)
+        ckdir = self.opts.get("checkpoint_dir") \
+            or os.environ.get("HIVEMALL_TPU_CHECKPOINT_DIR")
         # tracing/profiling (SURVEY.md §6): HIVEMALL_TPU_PROFILE=<dir>
         # captures a jax.profiler trace of the FIRST fit() in the process —
         # open with tensorboard/xprof; complements the jsonl metrics stream
@@ -515,7 +588,8 @@ class LearnerBase:
             n_valid=batch.n_valid, fieldmajor=batch.fieldmajor)
 
     def fit_stream(self, batches: Iterable[SparseBatch], *,
-                   convert_labels: bool = True) -> "LearnerBase":
+                   convert_labels: bool = True,
+                   resume: bool = False) -> "LearnerBase":
         """Out-of-core training over a stream of padded batches (e.g.
         io.arrow.ParquetStream.batches): each batch dispatches one jitted
         step; nothing is buffered, so resident memory is one shard.
@@ -523,9 +597,28 @@ class LearnerBase:
         per epoch — the NioStatefulSegment analog at corpus scale). On
         accelerators the shard read/parse overlaps device compute via the
         same DevicePrefetcher fit() uses; -ingest_workers > 1 additionally
-        shards the batch prep (canonicalize/pack) across a worker pool."""
+        shards the batch prep (canonicalize/pack) across a worker pool.
+
+        Fault tolerance (docs/RELIABILITY.md): with -checkpoint_dir +
+        -checkpoint_every, a full-state bundle autosaves atomically every
+        N steps plus once at stream end. After a crash, ``resume()`` then
+        ``fit_stream(same_stream, resume=True)`` skips the checkpointed
+        stream prefix and continues; at -steps_per_dispatch 1 the
+        post-restore loss trajectory is bit-exact vs. an uninterrupted
+        run (the stream must be deterministic — same shard order and
+        shuffle seed)."""
         import jax
         self.pipeline_stats = PipelineStats()
+        if resume and self._stream_pos:
+            from ..io.replay_segment import skip_batches
+            batches = skip_batches(batches, self._stream_pos)
+        elif not resume:
+            # a fresh stream starts at position 0 — without this, a second
+            # fit_stream on the same trainer (FFM's per-epoch loop, any
+            # sequential reuse) would checkpoint positions offset by the
+            # previous stream's length and resume would skip wrongly
+            self._stream_pos = 0
+        autosaver = self._autosaver()
 
         def host_side() -> Iterator[SparseBatch]:
             # label conversion + pair tracking stay on HOST arrays and in
@@ -549,10 +642,57 @@ class LearnerBase:
         try:
             for b in it:
                 self._dispatch(b)
+                # stream position = SOURCE batches consumed (a fused K-step
+                # window is K source batches) — what resume() skips past
+                self._stream_pos += int(getattr(b, "n_steps", 1))
+                if autosaver is not None:
+                    autosaver.maybe_save(self)
         finally:
             for c in reversed(closers):
                 c()
+        if autosaver is not None:
+            # completed stream: make the final state durable too (cadence
+            # saves only land on -checkpoint_every boundaries). No save on
+            # the exception path — the last cadence bundle IS the recovery
+            # point a crashed run resumes from.
+            autosaver.save_final(self)
         return self
+
+    def _autosaver(self):
+        """CheckpointManager for this fit_stream, or None when autosave is
+        not configured (-checkpoint_dir AND -checkpoint_every required)."""
+        ckdir = self.opts.get("checkpoint_dir")
+        every = int(self.opts.get("checkpoint_every") or 0)
+        if not ckdir or every <= 0:
+            return None
+        from ..io.checkpoint import CheckpointManager
+        return CheckpointManager(
+            ckdir, self.NAME, keep=int(self.opts.get("checkpoint_keep") or 3),
+            every=every, start_step=self._t)
+
+    def resume(self, checkpoint_dir: Optional[str] = None) -> bool:
+        """Restore the newest USABLE autosaved bundle from
+        ``checkpoint_dir`` (default: the -checkpoint_dir option). Bundles
+        failing validation — truncated file, digest mismatch, options
+        mismatch — are skipped with a warning, falling back to the next
+        newest (the retention window exists exactly for this). Returns
+        True when state was restored; follow with
+        ``fit_stream(same_stream, resume=True)`` to continue mid-stream."""
+        import warnings
+        import zipfile
+        ckdir = checkpoint_dir or self.opts.get("checkpoint_dir")
+        if not ckdir:
+            return False
+        from ..io.checkpoint import list_bundles
+        for path in list_bundles(ckdir, self.NAME):
+            try:
+                self.load_bundle(path)
+                return True
+            except (ValueError, KeyError, OSError,
+                    zipfile.BadZipFile) as e:
+                warnings.warn(f"skipping unusable checkpoint {path}: {e}",
+                              RuntimeWarning, stacklevel=2)
+        return False
 
     def _note_batch(self, batch: SparseBatch) -> None:
         """Hook for emission-time metadata on the streaming path (FFM joint

@@ -74,6 +74,8 @@ def _online_spec(name: str) -> OptionSpec:
     s.add("mix", default=None, help="mix cohort spec")
     s.add("mix_threshold", type=int, default=16)
     s.add("mix_session", default=None)
+    from .base import add_mix_reliability_options
+    add_mix_reliability_options(s)
     s.add("loadmodel", default=None)
     s.flag("dense", "densemodel", help="compat flag (always dense table)")
     s.flag("halffloat", help="bf16 weights")

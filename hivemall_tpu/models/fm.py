@@ -918,8 +918,8 @@ class FFMTrainer(FMTrainer):
                 self._dispatch(PackedBatch(buf, B, L, n_valid=nv))
 
     def fit_stream(self, batches, *, convert_labels: bool = True,
-                   epochs: int = 1, replay_shuffle: bool = True
-                   ) -> "FFMTrainer":
+                   epochs: int = 1, replay_shuffle: bool = True,
+                   resume: bool = False) -> "FFMTrainer":
         """Out-of-core epochs with the device replay cache (VERDICT r4
         weak #5: -iters over Parquet re-paid the link every epoch).
 
@@ -929,10 +929,20 @@ class FFMTrainer(FMTrainer):
         When the packed input path is active and the epoch fits the HBM
         budget, epoch 1 streams normally while RETAINING its staged
         device buffers; epochs >= 2 replay on device exactly like
-        fit(-iters) does (same admission, same fail-open)."""
+        fit(-iters) does (same admission, same fail-open).
+
+        ``resume`` (docs/RELIABILITY.md) is the base single-stream
+        contract; the multi-epoch replay form has no checkpointed stream
+        position to skip into, so the combination is rejected."""
         if epochs <= 1:
             it = batches() if callable(batches) else batches
-            return super().fit_stream(it, convert_labels=convert_labels)
+            return super().fit_stream(it, convert_labels=convert_labels,
+                                      resume=resume)
+        if resume:
+            raise ValueError(
+                "fit_stream(resume=True) needs the single-stream form "
+                "(epochs=1); the epochs>1 replay path has no stream "
+                "position to resume into")
         if not callable(batches):
             raise ValueError(
                 "fit_stream(epochs>1) needs a zero-arg factory returning "

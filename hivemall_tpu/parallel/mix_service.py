@@ -15,7 +15,13 @@ ICI collectives (parallel.mix) don't reach:
 - ``MixClient``: attaches to a trainer (the ModelUpdateHandler analog);
   every ``threshold`` dispatched batches it ships the touched features'
   (weight, covar, delta-updates) and folds the mixed values back. Transport
-  errors permanently disable it (fail-soft), matching the reference.
+  and framing faults NEVER reach the training loop: failed exchanges are
+  retried with jittered exponential backoff, repeated failure opens a
+  circuit breaker (half-open probe after a cooldown), and only a breaker
+  that re-trips ``breaker_trips`` times with no intervening success
+  degrades the client permanently — training continues unmixed either way
+  (fail-soft, matching the reference's degrade-to-local-SGD semantics).
+  See docs/RELIABILITY.md for the knob and counter surface.
 
 Wire format (MixMessage analog), length-prefixed little-endian frames:
   u8 event (1=average, 2=argmin_kld, 3=closegroup), u16 group-utf8-len,
@@ -26,16 +32,19 @@ Wire format (MixMessage analog), length-prefixed little-endian frames:
 from __future__ import annotations
 
 import asyncio
+import random
 import socket
 import struct
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 __all__ = ["MixServer", "MixClient", "MixMessage", "EVENT_AVERAGE",
-           "EVENT_ARGMIN_KLD", "EVENT_CLOSEGROUP", "EVENT_STATS"]
+           "EVENT_ARGMIN_KLD", "EVENT_CLOSEGROUP", "EVENT_STATS",
+           "MAX_FRAME_BYTES", "TRANSPORT_FAULTS"]
 
 EVENT_AVERAGE = 1
 EVENT_ARGMIN_KLD = 2
@@ -45,6 +54,15 @@ EVENT_STATS = 4          # JMX-analog counters probe (reference: MixServer
 
 _HDR = struct.Struct("<BH")
 _LEN = struct.Struct("<I")
+# frame-size ceiling (server and client): a corrupt/poisoned length prefix
+# must never make readexactly buffer gigabytes before the decode can fail
+MAX_FRAME_BYTES = 64 << 20
+_EVENTS = frozenset((1, 2, 3, 4))
+# one fault class, one fate: ssl.SSLError and socket.timeout are OSError
+# subclasses; struct.error / ValueError / UnicodeDecodeError cover corrupt
+# frames escaping MixMessage.decode. Anything here is handled fail-soft.
+TRANSPORT_FAULTS = (OSError, EOFError, struct.error, ValueError,
+                    UnicodeDecodeError, IndexError)
 # one wire record — numpy structured dtype so whole messages encode/decode
 # as single tobytes/frombuffer calls (no per-record Python)
 _REC_DT = np.dtype([("k", "<i8"), ("w", "<f4"), ("c", "<f4"), ("d", "<i4")])
@@ -231,6 +249,11 @@ class MixServer:
         # throttle (reference: MixServer's per-connection throttling): cap
         # on key-updates/sec across all connections; 0 = unlimited
         self.throttle_keys_per_s = 0
+        # a malformed or oversized frame closes ITS connection only — the
+        # handler task is per-connection, other clients keep exchanging
+        self.max_frame_bytes = MAX_FRAME_BYTES
+        self._bad_frames = 0
+        self._oversized_frames = 0
         self._requests = 0
         self._keys_folded = 0
         self._bytes_in = 0
@@ -249,8 +272,18 @@ class MixServer:
             while True:
                 hdr = await reader.readexactly(_LEN.size)
                 (ln,) = _LEN.unpack(hdr)
+                if ln > self.max_frame_bytes:
+                    self._oversized_frames += 1
+                    break
                 body = await reader.readexactly(ln)
-                msg = MixMessage.decode(body)
+                try:
+                    msg = MixMessage.decode(body)
+                    if msg.event not in _EVENTS:
+                        raise ValueError(f"unknown event {msg.event}")
+                except (struct.error, ValueError, UnicodeDecodeError,
+                        IndexError, OverflowError):
+                    self._bad_frames += 1
+                    break
                 self._bytes_in += ln + _LEN.size
                 if msg.event == EVENT_CLOSEGROUP:
                     self._sessions.pop(msg.group, None)
@@ -298,8 +331,8 @@ class MixServer:
                 self._bytes_out += len(buf)
                 writer.write(buf)
                 await writer.drain()
-        except (asyncio.IncompleteReadError, ConnectionResetError):
-            pass
+        except (asyncio.IncompleteReadError, OSError):
+            pass               # peer vanished mid-frame / reset / TLS fault
         finally:
             try:
                 writer.close()
@@ -317,6 +350,8 @@ class MixServer:
             "groups": len(self._sessions),
             "keys_tracked": int(sum(g.index.n
                                     for g in self._sessions.values())),
+            "bad_frames": self._bad_frames,
+            "oversized_frames": self._oversized_frames,
         }
 
     # -- lifecycle -----------------------------------------------------------
@@ -365,13 +400,32 @@ class MixClient:
     batches, ships all features touched since the last exchange with
     delta_updates = batches elapsed (documented approximation of the
     reference's per-weight clocks; convergence semantics match at minibatch
-    granularity). Any transport failure disables the client permanently —
-    training continues unmixed (fail-soft parity).
+    granularity).
+
+    Fault model (docs/RELIABILITY.md): every exchange gets up to
+    ``retries + 1`` attempts inside a per-exchange wall-clock ``deadline``,
+    reconnecting between attempts with jittered exponential backoff
+    (``backoff`` base, doubled per attempt, capped at ``backoff_max``).
+    ``breaker_threshold`` consecutive failed exchanges open a circuit
+    breaker: exchanges are dropped (not attempted) for ``breaker_cooldown``
+    seconds, then ONE half-open probe runs; a probe failure re-opens the
+    breaker, a success closes it fully. Only ``breaker_trips`` consecutive
+    opens with no intervening success set ``alive = False`` permanently.
+    Training continues unmixed through every one of these states — no
+    transport or framing fault ever propagates into the fit loop.
+    A dropped exchange re-marks its keys as touched, so the features ship
+    on the next successful exchange (delivery is at-least-once: a reply
+    lost after the server folded may be re-sent and folded twice —
+    acceptable under the reference's best-effort averaging semantics).
     """
 
     def __init__(self, hosts: str, group: str, threshold: int = 16,
                  event: int = EVENT_AVERAGE, timeout: float = 2.0,
-                 ssl_context=None):
+                 ssl_context=None, *, retries: int = 2,
+                 backoff: float = 0.05, backoff_max: float = 2.0,
+                 deadline: Optional[float] = None,
+                 breaker_threshold: int = 3, breaker_cooldown: float = 1.0,
+                 breaker_trips: int = 3, max_touched: int = 1 << 20):
         host, _, port = hosts.partition(":")
         self.addr = (host or "127.0.0.1", int(port or 11212))
         self.group = group
@@ -379,22 +433,103 @@ class MixClient:
         self.event = event
         self.timeout = timeout
         self.ssl_context = ssl_context    # -ssl: TLS-wrapped exchanges
+        self.retries = max(0, int(retries))
+        self.backoff = float(backoff)
+        self.backoff_max = float(backoff_max)
+        self.deadline = deadline          # None = 2 * timeout, resolved live
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.breaker_cooldown = float(breaker_cooldown)
+        self.breaker_trips = max(1, int(breaker_trips))
+        self.max_touched = int(max_touched)
         self.alive = True
         self.exchanges = 0
+        self.reconnects = 0               # successful re-dials after a fault
+        self.dropped_exchanges = 0        # exchange windows lost to faults
+        self.transport_errors = 0         # individual failed attempts
+        self.breaker_trip_count = 0       # lifetime breaker opens
+        self.touched_overflow = 0         # touch() calls shed over the cap
+        self._trips_since_ok = 0
+        self._consec_failures = 0
+        self._open_until: Optional[float] = None   # monotonic; None=closed
+        self._ever_connected = False
+        # deterministic jitter: tests injecting a fault schedule see the
+        # same backoff sequence run to run (crc32, not hash() — str hash
+        # is salted per interpreter)
+        import zlib
+        self._rng = random.Random(0x5EED ^ zlib.crc32(group.encode()))
         self._sock: Optional[socket.socket] = None
         self._batches = 0
         self._touched: set[int] = set()
 
+    # -- observability -------------------------------------------------------
+    @property
+    def breaker_state(self) -> str:
+        if not self.alive:
+            return "dead"
+        if self._open_until is None:
+            return "closed"
+        return "open" if time.monotonic() < self._open_until else "half-open"
+
+    @property
+    def degraded(self) -> bool:
+        """True while exchanges are suspended (breaker open or permanently
+        failed) — training is running unmixed."""
+        return not self.alive or self._open_until is not None
+
+    def counters(self) -> Dict[str, float]:
+        """Client-side metrics, the peer of MixServer.counters()."""
+        return {
+            "exchanges": self.exchanges,
+            "reconnects": self.reconnects,
+            "dropped_exchanges": self.dropped_exchanges,
+            "transport_errors": self.transport_errors,
+            "breaker_trips": self.breaker_trip_count,
+            "breaker_state": self.breaker_state,
+            "touched_overflow": self.touched_overflow,
+            "alive": self.alive,
+        }
+
+    # -- transport -----------------------------------------------------------
     def _connect(self) -> None:
-        if self._sock is None:
-            s = socket.create_connection(self.addr, timeout=self.timeout)
-            s.settimeout(self.timeout)
-            if self.ssl_context is not None:
-                s = self.ssl_context.wrap_socket(
-                    s, server_hostname=self.addr[0])
-            self._sock = s
+        if self._sock is not None:
+            return
+        s = socket.create_connection(self.addr, timeout=self.timeout)
+        if s.getsockname() == s.getpeername():
+            # TCP simultaneous-open self-connect: dialing a dead local
+            # port can land on source port == dest port, and the client
+            # would happily read back its own frames as "replies" — a
+            # real hazard for a RETRYING client once the server's
+            # ephemeral port is freed. Treat it as the connection refusal
+            # it morally is.
+            s.close()
+            raise OSError("self-connect detected — no server listening "
+                          f"on {self.addr}")
+        s.settimeout(self.timeout)
+        if self.ssl_context is not None:
+            s = self.ssl_context.wrap_socket(
+                s, server_hostname=self.addr[0])
+        self._sock = s
+        if self._ever_connected:
+            self.reconnects += 1
+        self._ever_connected = True
+
+    def _drop_socket(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def touch(self, keys: np.ndarray) -> None:
+        if not self.alive:
+            return
+        if len(self._touched) >= self.max_touched:
+            # outage overflow guard: during a long breaker-open stretch the
+            # touched set must not grow without bound; shed new keys (they
+            # fold on a later touch once exchanges resume)
+            self.touched_overflow += 1
+            return
         self._touched.update(int(k) for k in np.unique(keys) if k != 0)
 
     def maybe_mix(self, trainer) -> None:
@@ -410,33 +545,95 @@ class MixClient:
         self._batches += 1
         if self._batches % self.threshold != 0 or not self._touched:
             return
-        try:
-            keys = np.fromiter(self._touched, np.int64)
-            self._touched.clear()
-            w_at = trainer._get_weights_at(keys)
-            covar = trainer._get_covar_at(keys) \
-                if hasattr(trainer, "_get_covar_at") else None
-            msg = MixMessage(
-                self.event, self.group, keys,
-                np.asarray(w_at, np.float32),
-                (np.asarray(covar, np.float32) if covar is not None
-                 else np.ones(len(keys), np.float32)),
-                np.full(len(keys), self.threshold, np.int32))
-            self._connect()
-            self._sock.sendall(msg.encode())
-            reply = self._read_reply()
-            trainer._set_weights_at(reply.keys, reply.weights)
-            if (self.event == EVENT_ARGMIN_KLD and covar is not None
-                    and hasattr(trainer, "_set_covar_at")):
-                trainer._set_covar_at(reply.keys, reply.covars)
-            self.exchanges += 1
-        except OSError:
-            self.alive = False     # fail-soft: keep training unmixed
-            self._sock = None
+        probing = False
+        if self._open_until is not None:
+            if time.monotonic() < self._open_until:
+                self.dropped_exchanges += 1      # breaker open: skip cheap
+                return
+            probing = True                       # half-open: one attempt
+        keys = np.fromiter(self._touched, np.int64)
+        self._touched.clear()
+        w_at = trainer._get_weights_at(keys)
+        covar = trainer._get_covar_at(keys) \
+            if hasattr(trainer, "_get_covar_at") else None
+        msg = MixMessage(
+            self.event, self.group, keys,
+            np.asarray(w_at, np.float32),
+            (np.asarray(covar, np.float32) if covar is not None
+             else np.ones(len(keys), np.float32)),
+            np.full(len(keys), self.threshold, np.int32))
+        reply = self._exchange(msg, attempts=1 if probing
+                               else self.retries + 1)
+        if reply is None:
+            self.dropped_exchanges += 1
+            self._consec_failures += 1
+            # keep the features on the books — they ship next exchange
+            if len(self._touched) < self.max_touched:
+                self._touched.update(int(k) for k in keys)
+            if probing or self._consec_failures >= self.breaker_threshold:
+                self._trip()
+            return
+        self._consec_failures = 0
+        self._trips_since_ok = 0
+        self._open_until = None                  # breaker fully closed
+        self.exchanges += 1
+        # fold-back runs OUTSIDE the fault guard: the reply is validated,
+        # so an error here is a trainer bug and must surface
+        trainer._set_weights_at(reply.keys, reply.weights)
+        if (self.event == EVENT_ARGMIN_KLD and covar is not None
+                and hasattr(trainer, "_set_covar_at")):
+            trainer._set_covar_at(reply.keys, reply.covars)
+
+    def _exchange(self, msg: MixMessage,
+                  attempts: int) -> Optional[MixMessage]:
+        """One exchange window: up to ``attempts`` tries within the
+        per-exchange deadline; returns the validated reply or None."""
+        payload = msg.encode()
+        budget = self.deadline if self.deadline else 2.0 * self.timeout
+        deadline = time.monotonic() + budget
+        for attempt in range(max(1, attempts)):
+            try:
+                self._connect()
+                self._sock.sendall(payload)
+                reply = self._read_reply()
+                if (reply.event != msg.event
+                        or len(reply.keys) != len(msg.keys)):
+                    raise ValueError(
+                        f"mix reply mismatch: event {reply.event} "
+                        f"n={len(reply.keys)} vs sent {msg.event} "
+                        f"n={len(msg.keys)}")
+                return reply
+            except TRANSPORT_FAULTS:
+                self.transport_errors += 1
+                self._drop_socket()
+            if attempt + 1 >= max(1, attempts):
+                return None
+            delay = min(self.backoff_max, self.backoff * (1 << attempt))
+            delay *= 0.5 + self._rng.random()    # jitter in [0.5, 1.5)
+            if time.monotonic() + delay >= deadline:
+                return None                      # deadline would be blown
+            time.sleep(delay)
+        return None
+
+    def _trip(self) -> None:
+        """Open the breaker; after ``breaker_trips`` consecutive opens with
+        no successful exchange between them, degrade permanently."""
+        self.breaker_trip_count += 1
+        self._trips_since_ok += 1
+        self._consec_failures = 0
+        self._drop_socket()
+        if self._trips_since_ok >= self.breaker_trips:
+            self.alive = False                   # permanent fail-soft
+            self._open_until = None
+        else:
+            self._open_until = time.monotonic() + self.breaker_cooldown
 
     def _read_reply(self) -> MixMessage:
         hdr = self._recvn(_LEN.size)
         (ln,) = _LEN.unpack(hdr)
+        if ln > MAX_FRAME_BYTES:
+            raise ValueError(f"mix reply frame {ln} bytes exceeds "
+                             f"{MAX_FRAME_BYTES} — corrupt length prefix?")
         return MixMessage.decode(self._recvn(ln))
 
     def _recvn(self, n: int) -> bytes:
@@ -449,16 +646,27 @@ class MixClient:
         return buf
 
     def close_group(self) -> None:
-        if self.alive and self._sock is not None:
-            try:
-                self._sock.sendall(MixMessage(
+        """Send CLOSEGROUP (bounded wait) and release the socket. Runs the
+        socket cleanup even on a dead/degraded client — a permanently
+        failed client must not leak its half-open socket — and bounds the
+        send so shutdown can't hang on a wedged server."""
+        sock, self._sock = self._sock, None
+        if sock is None:
+            return
+        try:
+            if self.alive:
+                sock.settimeout(min(self.timeout, 0.5))
+                sock.sendall(MixMessage(
                     EVENT_CLOSEGROUP, self.group, np.zeros(0, np.int64),
                     np.zeros(0, np.float32), np.zeros(0, np.float32),
                     np.zeros(0, np.int32)).encode())
-                self._sock.close()
+        except TRANSPORT_FAULTS:
+            pass
+        finally:
+            try:
+                sock.close()
             except OSError:
                 pass
-            self._sock = None
 
 
 # -- TLS transport (-ssl, SURVEY.md §3.1 LearnerBase MIX options) -----------

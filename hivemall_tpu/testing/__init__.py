@@ -1,0 +1,12 @@
+"""Reusable fault-injection harness (docs/RELIABILITY.md §3)."""
+
+__all__ = ["FlakyProxy", "CrashingSource", "crash_on_nth"]
+
+
+def __getattr__(name):
+    # lazy re-export: keeps `python -m hivemall_tpu.testing.faults` free of
+    # the runpy found-in-sys.modules warning
+    if name in __all__:
+        from . import faults
+        return getattr(faults, name)
+    raise AttributeError(name)

@@ -1,0 +1,345 @@
+"""Fault-injection harness — deterministic failure modes for the runtime.
+
+The reliability spine (resilient MixClient, atomic checkpoint/resume,
+hardened MixServer) is only trustworthy if its failure paths are DRIVEN,
+not assumed. This module provides the three injectors the tests and the
+``run_tests.sh`` smoke use (docs/RELIABILITY.md §3):
+
+- :class:`FlakyProxy` — a threaded TCP shim between a client and its
+  upstream server. A deterministic schedule maps forwarded client→upstream
+  chunk ordinals to faults (``"drop"`` / ``"truncate"`` / ``"rst"`` /
+  ``("delay", s)``), and ``kill()`` / ``restart()`` model a server death
+  and comeback on the SAME port — the mix-cluster outage a production run
+  actually hits.
+- :class:`CrashingSource` — wraps a batch iterator; raises after yielding
+  N items (a wedged/preempted ingest source, or a host crash at an
+  arbitrary training step).
+- :func:`crash_on_nth` — wraps an :class:`IngestPipeline` prep function;
+  the nth call raises. Thread-pool task starts are FIFO, so the nth call
+  is the nth submitted item and the failure is deterministic.
+
+Run ``python -m hivemall_tpu.testing.faults --smoke`` for the seconds-scale
+proof: a trainer mixes through a proxy that kills and restarts the mix
+path mid-run (reconnects > 0, finite weights), and a crash-at-step-N
+``fit_stream`` resumes from its autosaved bundle bit-exactly.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Dict, Iterable, Iterator, Optional, Tuple, Union
+
+__all__ = ["FlakyProxy", "CrashingSource", "crash_on_nth"]
+
+Fault = Union[str, Tuple[str, float]]
+
+
+def _rst(sock: socket.socket) -> None:
+    """Close with SO_LINGER 0 — the peer sees ECONNRESET, not FIN."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class FlakyProxy:
+    """Deterministic TCP fault shim: client → proxy → upstream.
+
+    ``schedule`` maps the ordinal of a client→upstream chunk (0-based,
+    counted across all connections in arrival order) to a fault:
+
+    - ``"drop"``: swallow the chunk — upstream never sees it, the client
+      blocks on a reply until its timeout.
+    - ``"truncate"``: forward only the first half of the chunk, then sever
+      both halves — upstream reads a torn frame.
+    - ``"rst"``: reset the client connection (ECONNRESET mid-exchange).
+    - ``("delay", s)``: hold the chunk for ``s`` seconds, then forward.
+
+    ``kill()`` closes the listener and resets every in-flight connection
+    (the mix server "dies"); ``restart()`` re-listens on the SAME port so
+    a reconnecting client finds the server again. Counters
+    (``chunks_forwarded``, ``faults_applied``, ``conns_accepted``) make
+    assertions cheap."""
+
+    def __init__(self, upstream: Tuple[str, int], *, host: str = "127.0.0.1",
+                 port: int = 0, schedule: Optional[Dict[int, Fault]] = None):
+        self.upstream = upstream
+        self.host = host
+        self.port = port              # 0 = ephemeral; fixed after start()
+        self.schedule: Dict[int, Fault] = dict(schedule or {})
+        self.chunks_forwarded = 0
+        self.faults_applied = 0
+        self.conns_accepted = 0
+        self._lock = threading.Lock()
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: list = []        # (client_sock, upstream_sock) pairs
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "FlakyProxy":
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((self.host, self.port))
+        ls.listen(16)
+        self.port = ls.getsockname()[1]
+        self._listener = ls
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, args=(ls,), daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def kill(self) -> None:
+        """Simulate upstream death: stop accepting, reset live conns.
+        The port is retained so ``restart()`` comes back at the same
+        address a client keeps retrying."""
+        ls, self._listener = self._listener, None
+        if ls is not None:
+            # shutdown BEFORE close: close() alone does not wake a thread
+            # already blocked in accept(2) — the kernel keeps the listener
+            # alive (and accepting!) until that syscall returns, so a
+            # "killed" proxy would service one more connection. shutdown()
+            # interrupts the blocked accept immediately (EINVAL).
+            try:
+                ls.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                ls.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2)
+            self._accept_thread = None
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c, u in conns:
+            _rst(c)
+            _rst(u)
+
+    def restart(self) -> "FlakyProxy":
+        if self._listener is not None:
+            raise RuntimeError("proxy is already running")
+        return self.start()
+
+    def stop(self) -> None:
+        self.kill()
+
+    def __enter__(self) -> "FlakyProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- data path -----------------------------------------------------------
+    def _accept_loop(self, ls: socket.socket) -> None:
+        while True:
+            try:
+                c, _ = ls.accept()
+            except OSError:
+                return                      # listener closed: kill()/stop()
+            try:
+                u = socket.create_connection(self.upstream, timeout=5)
+            except OSError:
+                _rst(c)
+                continue
+            with self._lock:
+                self.conns_accepted += 1
+                self._conns.append((c, u))
+            threading.Thread(target=self._pump_up, args=(c, u),
+                             daemon=True).start()
+            threading.Thread(target=self._pump_down, args=(u, c),
+                             daemon=True).start()
+
+    def _next_fault(self) -> Optional[Fault]:
+        with self._lock:
+            ordinal = self.chunks_forwarded
+            self.chunks_forwarded += 1
+            fault = self.schedule.get(ordinal)
+            if fault is not None:
+                self.faults_applied += 1
+            return fault
+
+    def _pump_up(self, c: socket.socket, u: socket.socket) -> None:
+        """Client→upstream leg: where the fault schedule applies."""
+        try:
+            while True:
+                data = c.recv(1 << 16)
+                if not data:
+                    break
+                fault = self._next_fault()
+                if fault is None:
+                    u.sendall(data)
+                elif fault == "drop":
+                    continue                 # swallowed: client will time out
+                elif fault == "truncate":
+                    u.sendall(data[:max(1, len(data) // 2)])
+                    break                    # sever: the torn frame stays torn
+                elif fault == "rst":
+                    _rst(c)
+                    break
+                elif isinstance(fault, tuple) and fault[0] == "delay":
+                    time.sleep(float(fault[1]))
+                    u.sendall(data)
+                else:
+                    raise ValueError(f"unknown fault {fault!r}")
+        except OSError:
+            pass
+        finally:
+            _rst(c)
+            _rst(u)
+
+    def _pump_down(self, u: socket.socket, c: socket.socket) -> None:
+        """Upstream→client leg: plain forwarding."""
+        try:
+            while True:
+                data = u.recv(1 << 16)
+                if not data:
+                    break
+                c.sendall(data)
+        except OSError:
+            pass
+        finally:
+            _rst(c)
+            _rst(u)
+
+
+class CrashingSource:
+    """Iterator wrapper that raises after yielding ``crash_after`` items —
+    an ingest source dying mid-stream, or (feeding ``fit_stream``) a host
+    crash at an arbitrary training step."""
+
+    def __init__(self, src: Iterable, crash_after: int,
+                 exc: Optional[BaseException] = None):
+        self._it: Iterator = iter(src)
+        self.crash_after = int(crash_after)
+        self.exc = exc if exc is not None else RuntimeError(
+            f"injected source crash after item {crash_after}")
+        self.yielded = 0
+
+    def __iter__(self) -> "CrashingSource":
+        return self
+
+    def __next__(self):
+        if self.yielded >= self.crash_after:
+            raise self.exc
+        item = next(self._it)
+        self.yielded += 1
+        return item
+
+
+def crash_on_nth(fn, n: int, exc: Optional[BaseException] = None):
+    """Wrap an IngestPipeline prep ``fn`` so its nth call (0-based) raises.
+
+    ThreadPoolExecutor starts tasks in submission order (FIFO work queue)
+    and the pipeline submits in source order, so call N is item N — the
+    crash is deterministic per ITEM even under a multi-worker pool."""
+    counter = itertools.count()
+    err = exc if exc is not None else RuntimeError(
+        f"injected worker crash on item {n}")
+
+    def wrapped(item):
+        if next(counter) == n:
+            raise err
+        return fn(item)
+
+    return wrapped
+
+
+# -- seconds-scale smoke (wired into run_tests.sh) ---------------------------
+
+def _smoke_mix_kill_restart() -> dict:
+    """Train through a FlakyProxy'd mix path, kill + restart it mid-run:
+    the client must reconnect (reconnects > 0) and finish with finite
+    weights."""
+    import numpy as np
+    from ..models.linear import GeneralClassifier
+    from ..parallel.mix_service import MixServer
+
+    srv = MixServer().start()
+    proxy = FlakyProxy(("127.0.0.1", srv.port)).start()
+    try:
+        clf = GeneralClassifier(
+            f"-dims 64 -mini_batch 4 -eta fixed -eta0 0.5 -reg no "
+            f"-mix 127.0.0.1:{proxy.port} -mix_threshold 1 "
+            f"-mix_timeout 0.5 -mix_retries 1 -mix_backoff 0.01 "
+            f"-mix_breaker_cooldown 0.05 -mix_breaker_trips 1000")
+
+        def feed(n):
+            for _ in range(n):
+                clf.process(["1:1.0"], 1)
+                clf.process(["2:1.0"], -1)
+
+        feed(8)
+        assert clf._mixer.exchanges > 0, "no exchange before the kill"
+        proxy.kill()
+        feed(8)                       # outage: training continues unmixed
+        proxy.restart()
+        time.sleep(0.1)               # let the breaker cooldown lapse
+        feed(16)
+        model = dict(clf.close())
+        c = clf._mixer.counters()
+        assert c["reconnects"] >= 1, c
+        assert np.isfinite(model["1"]) and np.isfinite(model["2"]), model
+        return c
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+def _smoke_kill_and_resume() -> dict:
+    """Crash fit_stream at an arbitrary step, resume from the autosaved
+    bundle: final weights must be bit-identical to an uninterrupted run."""
+    import tempfile
+
+    import numpy as np
+    from ..io.libsvm import synthetic_classification
+    from ..models.linear import GeneralClassifier
+
+    ds, _ = synthetic_classification(192, 8, seed=3)
+    opts = ("-dims 256 -mini_batch 16 -loss logloss -opt adagrad "
+            "-steps_per_dispatch 1")
+
+    def stream():
+        return ds.batches(16, shuffle=True, seed=5)
+
+    cont = GeneralClassifier(opts)
+    cont.fit_stream(stream())
+    w_cont = np.asarray(cont.w)
+
+    with tempfile.TemporaryDirectory() as d:
+        tr = GeneralClassifier(opts + f" -checkpoint_dir {d} "
+                                      f"-checkpoint_every 4")
+        try:
+            tr.fit_stream(CrashingSource(stream(), 7))
+            raise AssertionError("injected crash did not fire")
+        except RuntimeError:
+            pass
+        r = GeneralClassifier(opts + f" -checkpoint_dir {d}")
+        assert r.resume(), "no usable checkpoint after the crash"
+        resumed_from = int(r._t)
+        r.fit_stream(stream(), resume=True)
+        np.testing.assert_array_equal(np.asarray(r.w), w_cont)
+        return {"resumed_from_step": resumed_from,
+                "final_step": int(r._t), "bit_exact": True}
+
+
+def main(argv=None) -> int:
+    out = {"mix_kill_restart": _smoke_mix_kill_restart(),
+           "kill_and_resume": _smoke_kill_and_resume()}
+    print(json.dumps({"fault_smoke": out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

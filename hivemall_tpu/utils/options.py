@@ -43,6 +43,11 @@ class Option:
     default: Any = None
     help: str = ""
     choices: Optional[Sequence[str]] = None
+    # numeric bounds, validated after type conversion — reliability knobs
+    # (retry counts, cooldowns, retention depths) reject nonsense like
+    # negative backoffs at parse time instead of misbehaving mid-train
+    min: Optional[float] = None
+    max: Optional[float] = None
 
     def convert(self, raw: str) -> Any:
         try:
@@ -56,6 +61,12 @@ class Option:
                 raise OptionError(
                     f"-{self.name}: {raw!r} not in {sorted(self.choices)}")
             return lowered[sv]
+        if self.min is not None and v < self.min:
+            raise OptionError(
+                f"-{self.name}: {v!r} below the minimum {self.min}")
+        if self.max is not None and v > self.max:
+            raise OptionError(
+                f"-{self.name}: {v!r} above the maximum {self.max}")
         return v
 
 
@@ -78,8 +89,11 @@ class OptionSpec:
 
     def add(self, name: str, long: Optional[str] = None, *, has_arg: bool = True,
             type: Callable[[str], Any] = str, default: Any = None,
-            help: str = "", choices: Optional[Sequence[str]] = None) -> "OptionSpec":
-        self.options.append(Option(name, long, has_arg, type, default, help, choices))
+            help: str = "", choices: Optional[Sequence[str]] = None,
+            min: Optional[float] = None,
+            max: Optional[float] = None) -> "OptionSpec":
+        self.options.append(Option(name, long, has_arg, type, default, help,
+                                   choices, min, max))
         return self
 
     def flag(self, name: str, long: Optional[str] = None, *, help: str = "") -> "OptionSpec":

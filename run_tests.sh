@@ -5,6 +5,14 @@
 env -u PALLAS_AXON_POOL_IPS -u JAX_PLATFORMS \
     python -m pytest tests/ -q "$@" || exit $?
 
+# fault-injection smoke (docs/RELIABILITY.md): a FlakyProxy'd MIX exchange
+# survives a mid-run server kill + restart (reconnect counter > 0), and a
+# crash-at-step-N fit_stream resumes from its autosaved bundle with
+# bit-identical final weights. Seconds-scale; the long soak variants live
+# in tests/ marked `slow`.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m hivemall_tpu.testing.faults --smoke || exit $?
+
 # bench harness smoke: tiny-shape runs of the ingest-path benches assert
 # every metric still emits and parses (pipeline refactors must not silently
 # break bench.py), and the dispatch-fusion microbench enforces its floor —

@@ -29,6 +29,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak variants (fault-injection soaks etc.); excluded "
+        "from the tier-1 `-m 'not slow'` run")
+
+
 def assert_batches_equal(a, b):
     """``a`` == ``b`` over EVERY dataclass field — tree structure and
     values. Introspects dataclasses.fields so staging/prep paths can never

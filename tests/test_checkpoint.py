@@ -107,6 +107,65 @@ def test_rda_resume_keeps_dual_accumulators(tmp_path):
                                    rtol=1e-6, atol=1e-7)
 
 
+def test_save_bundle_atomic_leaves_no_litter(tmp_path):
+    """tmp -> fsync -> os.replace: after a save (including overwriting an
+    existing bundle) the directory holds exactly the bundle, no tmp files,
+    and the result round-trips."""
+    import os
+    feats, y = _rows(24)
+    tr = GeneralClassifier(OPTS)
+    for f, lab in zip(feats, y):
+        tr.process(f, lab)
+    tr._flush()
+    p = tmp_path / "ck.npz"
+    tr.save_bundle(str(p))
+    tr.save_bundle(str(p))              # overwrite path also atomic
+    assert os.listdir(tmp_path) == ["ck.npz"]
+    fresh = GeneralClassifier(OPTS)
+    fresh.load_bundle(str(p))
+    assert fresh._t == tr._t
+
+
+def test_bundle_digest_detects_tamper(tmp_path):
+    """The format-2 manifest digest catches a bit-flipped leaf that the
+    zip container itself would happily return."""
+    import json
+    feats, y = _rows(24)
+    tr = GeneralClassifier(OPTS)
+    for f, lab in zip(feats, y):
+        tr.process(f, lab)
+    tr._flush()
+    p = tmp_path / "ck.npz"
+    tr.save_bundle(str(p))
+    with np.load(str(p), allow_pickle=False) as z:
+        data = {k: z[k] for k in z.files}
+    meta = json.loads(str(data["__meta__"]))
+    assert meta["format"] == 2 and "digest" in meta
+    data["leaf_0"] = data["leaf_0"] + 1          # tamper one leaf
+    np.savez(str(p), **data)
+    fresh = GeneralClassifier(OPTS)
+    with pytest.raises(ValueError, match="digest mismatch"):
+        fresh.load_bundle(str(p))
+
+
+def test_checkpoint_manager_retention(tmp_path):
+    """-checkpoint_keep k: only the k newest step bundles survive, and
+    resume() restores the newest."""
+    from hivemall_tpu.io.checkpoint import list_bundles
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    ds, _ = synthetic_classification(192, 8, seed=7)
+    ckdir = str(tmp_path / "ck")
+    opts = (f"{OPTS} -steps_per_dispatch 1 -checkpoint_dir {ckdir} "
+            f"-checkpoint_every 2 -checkpoint_keep 2")
+    tr = GeneralClassifier(opts)
+    tr.fit_stream(ds.batches(16, shuffle=False))     # 12 batches
+    bundles = list_bundles(ckdir, tr.NAME)
+    assert len(bundles) == 2                         # retention enforced
+    r = GeneralClassifier(opts)
+    assert r.resume()
+    assert r._t == tr._t                             # newest == final state
+
+
 def test_bundle_rejects_mismatch(tmp_path):
     feats, y = _rows(16)
     tr = GeneralClassifier(OPTS)

@@ -1,0 +1,370 @@
+"""Fault-injection harness tests (docs/RELIABILITY.md): FlakyProxy-driven
+MIX outages, server frame hardening, and crash/resume bit-exactness — the
+acceptance spine of the fault-tolerant runtime."""
+
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.io.libsvm import synthetic_classification
+from hivemall_tpu.models.linear import GeneralClassifier
+from hivemall_tpu.parallel.mix_service import (EVENT_AVERAGE, MixClient,
+                                               MixMessage, MixServer)
+from hivemall_tpu.testing.faults import (CrashingSource, FlakyProxy,
+                                         crash_on_nth)
+
+
+def _one_key_msg(group="g1", key=5, w=2.0):
+    return MixMessage(EVENT_AVERAGE, group,
+                      np.asarray([key], np.int64),
+                      np.asarray([w], np.float32),
+                      np.asarray([1.0], np.float32),
+                      np.asarray([1], np.int32))
+
+
+# --- FlakyProxy ------------------------------------------------------------
+
+def test_flaky_proxy_passthrough():
+    """No schedule: the proxy is transparent to a real mix roundtrip."""
+    srv = MixServer().start()
+    proxy = FlakyProxy(("127.0.0.1", srv.port)).start()
+    try:
+        c = MixClient(f"127.0.0.1:{proxy.port}", "g1", threshold=1)
+        c._connect()
+        c._sock.sendall(_one_key_msg().encode())
+        assert c._read_reply().weights[0] == 2.0
+        c.close_group()
+        assert proxy.chunks_forwarded >= 1 and proxy.faults_applied == 0
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+def test_faults_never_reach_fit_loop():
+    """RST, drop, and truncate on scheduled exchanges: every fault is
+    absorbed by retry/reconnect — the fit loop never sees an exception,
+    every exchange completes, and the model trains normally."""
+    srv = MixServer().start()
+    proxy = FlakyProxy(("127.0.0.1", srv.port),
+                       schedule={1: "rst", 3: "drop", 5: "truncate"}).start()
+    try:
+        clf = GeneralClassifier(
+            f"-dims 32 -mini_batch 4 -eta fixed -eta0 0.5 -reg no "
+            f"-mix 127.0.0.1:{proxy.port} -mix_threshold 1 "
+            f"-mix_timeout 0.3 -mix_backoff 0.01")
+        for _ in range(20):
+            clf.process(["1:1.0"], 1)
+            clf.process(["2:1.0"], -1)
+        model = dict(clf.close())
+        assert clf._mixer.alive and not clf._mixer.degraded
+        assert clf._mixer.exchanges == 10          # all windows completed
+        assert clf._mixer.transport_errors >= 3    # one per scheduled fault
+        assert clf._mixer.reconnects >= 3
+        assert proxy.faults_applied == 3
+        assert model["1"] > 0 > model["2"]
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+def test_mix_kill_and_restart_reconnects():
+    """ACCEPTANCE: the mix path dies mid-run and comes back; training never
+    stops, the client reconnects (reconnect counter > 0), exchanges resume,
+    and final weights are finite."""
+    srv = MixServer().start()
+    proxy = FlakyProxy(("127.0.0.1", srv.port)).start()
+    try:
+        clf = GeneralClassifier(
+            f"-dims 64 -mini_batch 4 -eta fixed -eta0 0.5 -reg no "
+            f"-mix 127.0.0.1:{proxy.port} -mix_threshold 1 "
+            f"-mix_timeout 0.5 -mix_retries 1 -mix_backoff 0.01 "
+            f"-mix_breaker_cooldown 0.05 -mix_breaker_trips 1000")
+
+        def feed(n):
+            for _ in range(n):
+                clf.process(["1:1.0"], 1)
+                clf.process(["2:1.0"], -1)
+
+        feed(8)                                # healthy warm-up
+        ex_before = clf._mixer.exchanges
+        assert ex_before > 0
+        proxy.kill()                           # the mix server "dies"
+        feed(8)                                # outage: unmixed, no crash
+        assert clf._mixer.dropped_exchanges >= 1
+        proxy.restart()
+        time.sleep(0.08)                       # past the breaker cooldown
+        feed(16)                               # half-open probe reconnects
+        model = dict(clf.close())
+        c = clf._mixer.counters()
+        assert clf._mixer.alive
+        assert c["reconnects"] >= 1, c
+        assert clf._mixer.exchanges > ex_before, c   # resumed exchanging
+        assert np.isfinite(model["1"]) and np.isfinite(model["2"])
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_mix_kill_restart_soak():
+    """Soak variant: three kill/restart cycles; exchanges must resume after
+    every comeback and the client must never degrade permanently."""
+    srv = MixServer().start()
+    proxy = FlakyProxy(("127.0.0.1", srv.port)).start()
+    try:
+        clf = GeneralClassifier(
+            f"-dims 64 -mini_batch 4 -eta fixed -eta0 0.5 -reg no "
+            f"-mix 127.0.0.1:{proxy.port} -mix_threshold 1 "
+            f"-mix_timeout 0.5 -mix_retries 1 -mix_backoff 0.01 "
+            f"-mix_breaker_cooldown 0.05 -mix_breaker_trips 1000")
+
+        def feed(n):
+            for _ in range(n):
+                clf.process(["1:1.0"], 1)
+                clf.process(["2:1.0"], -1)
+
+        for cycle in range(3):
+            feed(8)
+            before = clf._mixer.exchanges
+            assert before > 0
+            proxy.kill()
+            feed(8)
+            proxy.restart()
+            time.sleep(0.1)
+            feed(16)
+            assert clf._mixer.exchanges > before, (cycle,
+                                                   clf._mixer.counters())
+        model = dict(clf.close())
+        assert clf._mixer.alive
+        assert clf._mixer.reconnects >= 3
+        assert np.isfinite(model["1"]) and np.isfinite(model["2"])
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+# --- server frame hardening ------------------------------------------------
+
+def test_mix_server_survives_malformed_frame():
+    """A garbage frame closes ITS connection only; other clients keep
+    exchanging and the bad_frames counter records the event."""
+    srv = MixServer().start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=2)
+        s.settimeout(2)
+        garbage = b"\x07" + b"\xff" * 40      # bogus event + torn header
+        s.sendall(struct.pack("<I", len(garbage)) + garbage)
+        assert s.recv(16) == b""              # server closed this conn
+        s.close()
+        c = MixClient(f"127.0.0.1:{srv.port}", "g1", threshold=1)
+        c._connect()
+        c._sock.sendall(_one_key_msg().encode())
+        assert c._read_reply().weights[0] == 2.0   # still serving
+        c.close_group()
+        assert srv.counters()["bad_frames"] == 1
+    finally:
+        srv.stop()
+
+
+def test_mix_server_rejects_oversized_frame():
+    """A corrupt length prefix must not buffer gigabytes: the connection
+    closes before the body is read."""
+    srv = MixServer().start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=2)
+        s.settimeout(2)
+        s.sendall(struct.pack("<I", 1 << 31))     # 2 GiB claimed frame
+        assert s.recv(16) == b""
+        s.close()
+        assert srv.counters()["oversized_frames"] == 1
+        c = MixClient(f"127.0.0.1:{srv.port}", "g1", threshold=1)
+        c._connect()
+        c._sock.sendall(_one_key_msg().encode())
+        assert c._read_reply().weights[0] == 2.0
+        c.close_group()
+    finally:
+        srv.stop()
+
+
+def test_corrupt_reply_is_fail_soft_not_crash():
+    """Satellite: a server replying garbage (valid length prefix, torn
+    body) must degrade the client, never raise into the fit loop — the old
+    client let struct.error/ValueError escape maybe_mix."""
+    done = []
+
+    def evil_server(port_box):
+        ls = socket.socket()
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind(("127.0.0.1", 0))
+        ls.listen(4)
+        port_box.append(ls.getsockname()[1])
+        ls.settimeout(5)
+        while not done:
+            try:
+                c, _ = ls.accept()
+            except socket.timeout:
+                break
+            try:
+                c.recv(1 << 16)                      # swallow the request
+                c.sendall(struct.pack("<I", 64) + b"\x01" * 64)
+            except OSError:
+                pass
+            finally:
+                c.close()
+        ls.close()
+
+    import threading
+    box: list = []
+    th = threading.Thread(target=evil_server, args=(box,), daemon=True)
+    th.start()
+    while not box:
+        time.sleep(0.005)
+    clf = GeneralClassifier(
+        f"-dims 32 -mini_batch 4 -eta fixed -eta0 0.5 -reg no "
+        f"-mix 127.0.0.1:{box[0]} -mix_threshold 1 -mix_timeout 0.3 "
+        f"-mix_retries 0 -mix_backoff 0.01 -mix_breaker_cooldown 0")
+    for _ in range(16):                              # must not raise
+        clf.process(["1:1.0"], 1)
+        clf.process(["2:1.0"], -1)
+    model = dict(clf.close())
+    done.append(True)
+    assert clf._mixer.degraded
+    assert clf._mixer.transport_errors >= 1
+    assert model["1"] > 0 > model["2"]
+
+
+# --- crash wrappers through the ingest pipeline ----------------------------
+
+def test_crashing_source_fires_deterministically():
+    src = CrashingSource(iter(range(10)), 4)
+    got = []
+    with pytest.raises(RuntimeError, match="injected source crash"):
+        for v in src:
+            got.append(v)
+    assert got == [0, 1, 2, 3]
+
+
+def test_crash_on_nth_worker_surfaces_in_order():
+    """The nth prep call raises inside the pool; the consumer sees it in
+    stream position after every earlier batch, and the stats count it."""
+    from hivemall_tpu.io.pipeline import IngestPipeline, PipelineStats
+    stats = PipelineStats()
+    it = IngestPipeline(iter(range(20)), crash_on_nth(lambda x: x * 2, 6),
+                        workers=3, stats=stats)
+    got = []
+    with pytest.raises(RuntimeError, match="injected worker crash"):
+        for v in it:
+            got.append(v)
+    assert got == [0, 2, 4, 6, 8, 10]     # items 0..5, delivered in order
+    assert stats.worker_errors == 1
+
+
+# --- checkpoint crash + resume ---------------------------------------------
+
+def _stream_opts(extra=""):
+    return ("-dims 512 -mini_batch 16 -loss logloss -opt adagrad "
+            "-steps_per_dispatch 1 " + extra)
+
+
+def test_crash_resume_bit_exact_trajectory(tmp_path):
+    """ACCEPTANCE: crash at an arbitrary step, resume() from the autosaved
+    bundle, and the post-restore loss trajectory AND final weights are
+    bit-exact vs. an uninterrupted run at -steps_per_dispatch 1."""
+    ds, _ = synthetic_classification(192, 10, seed=23)
+
+    def stream():
+        return ds.batches(16, shuffle=True, seed=31)
+
+    cont = GeneralClassifier(_stream_opts())
+    cont._trace_losses = []
+    cont.fit_stream(stream())
+
+    ckdir = str(tmp_path / "ck")
+    tr = GeneralClassifier(_stream_opts(
+        f"-checkpoint_dir {ckdir} -checkpoint_every 4"))
+    with pytest.raises(RuntimeError, match="injected source crash"):
+        tr.fit_stream(CrashingSource(stream(), 9))
+
+    r = GeneralClassifier(_stream_opts(f"-checkpoint_dir {ckdir}"))
+    assert r.resume()
+    assert r._t == 8 and r._stream_pos == 8    # newest cadence bundle
+    r._trace_losses = []
+    r.fit_stream(stream(), resume=True)
+
+    assert r._trace_losses == cont._trace_losses[8:]   # bit-exact floats
+    np.testing.assert_array_equal(np.asarray(r.w), np.asarray(cont.w))
+    assert r._t == cont._t and r._examples == cont._examples
+
+
+def test_resume_falls_back_past_corrupt_latest(tmp_path):
+    """A truncated newest bundle (crash mid-copy, disk bitrot) is skipped
+    with a warning; resume() restores the previous one from the retention
+    window."""
+    ds, _ = synthetic_classification(128, 8, seed=4)
+    ckdir = str(tmp_path / "ck")
+    tr = GeneralClassifier(_stream_opts(
+        f"-checkpoint_dir {ckdir} -checkpoint_every 3"))
+    tr.fit_stream(ds.batches(16, shuffle=False))
+    from hivemall_tpu.io.checkpoint import list_bundles
+    bundles = list_bundles(ckdir, tr.NAME)
+    assert len(bundles) >= 2
+    with open(bundles[0], "r+b") as f:         # truncate the newest
+        f.truncate(100)
+    r = GeneralClassifier(_stream_opts(f"-checkpoint_dir {ckdir}"))
+    with pytest.warns(RuntimeWarning, match="skipping unusable checkpoint"):
+        assert r.resume()
+    assert r._t > 0 and r._t < tr._t           # restored an older step
+
+
+def test_stream_pos_resets_on_fresh_stream(tmp_path):
+    """Sequential fit_stream calls on one trainer (FFM's per-epoch loop,
+    any reuse) restart stream-position accounting — a second stream's
+    checkpoints must not record positions offset by the first stream."""
+    ds, _ = synthetic_classification(96, 8, seed=9)
+    tr = GeneralClassifier(_stream_opts())
+    tr.fit_stream(ds.batches(16, shuffle=False))       # 6 batches
+    assert tr._stream_pos == 6
+    tr.fit_stream(ds.batches(16, shuffle=False))
+    assert tr._stream_pos == 6                         # reset, not 12
+
+
+def test_ffm_fit_stream_accepts_resume_kwarg():
+    """The CLI streaming branch passes resume= unconditionally; the FFM
+    override must accept it (single-stream form) and reject it on the
+    multi-epoch replay form, which has no stream position to skip into."""
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.models.fm import FFMTrainer
+
+    rng = np.random.default_rng(11)
+    n, L, F = 64, 4, 4
+    idx = rng.integers(1, 512, (n, L)).astype(np.int32)
+    fld = np.tile(np.arange(L, dtype=np.int32) % F, (n, 1))
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    indptr = np.arange(0, n * L + 1, L, dtype=np.int64)
+    ds = SparseDataset(idx.ravel(), indptr, np.ones(n * L, np.float32),
+                       lab, fld.ravel())
+    cfg = "-dims 512 -factors 2 -fields 4 -mini_batch 16 -classification"
+    t = FFMTrainer(cfg)
+    t.fit_stream(ds.batches(16, shuffle=False), resume=False)
+    assert t._t > 0
+    with pytest.raises(ValueError, match="single-stream"):
+        FFMTrainer(cfg).fit_stream(
+            lambda: ds.batches(16, shuffle=False), epochs=2, resume=True)
+
+
+def test_resume_skip_rejects_short_stream(tmp_path):
+    """resume=True against a stream shorter than the checkpointed position
+    fails loudly (the caller re-opened the wrong stream), not silently."""
+    ds, _ = synthetic_classification(96, 8, seed=6)
+    ckdir = str(tmp_path / "ck")
+    tr = GeneralClassifier(_stream_opts(
+        f"-checkpoint_dir {ckdir} -checkpoint_every 2"))
+    tr.fit_stream(ds.batches(16, shuffle=False))       # 6 batches
+    r = GeneralClassifier(_stream_opts(f"-checkpoint_dir {ckdir}"))
+    assert r.resume() and r._stream_pos == 6
+    short = list(ds.batches(16, shuffle=False))[:3]
+    with pytest.raises(ValueError, match="stream exhausted"):
+        r.fit_stream(iter(short), resume=True)

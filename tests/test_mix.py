@@ -167,21 +167,51 @@ def test_trainers_converge_via_mix_service():
 
 
 def test_mix_client_fail_soft():
-    """Dead server => training continues unmixed (reference §3.16 fail-soft)."""
+    """Dead server => training continues unmixed (reference §3.16
+    fail-soft). With a zero breaker cooldown every exchange probes, so the
+    breaker re-trips until the trip budget is spent and the client goes
+    PERMANENTLY dead — the old first-error kill-switch as the breaker's
+    end state, not its first reaction."""
     from hivemall_tpu.models.linear import GeneralClassifier
     clf = GeneralClassifier("-dims 32 -mini_batch 4 -eta0 0.5 "
-                            "-mix 127.0.0.1:1 -mix_threshold 1")
+                            "-mix 127.0.0.1:1 -mix_threshold 1 "
+                            "-mix_retries 0 -mix_backoff 0.01 "
+                            "-mix_breaker_cooldown 0")
     for _ in range(16):
         clf.process(["1:1.0"], 1)
         clf.process(["2:1.0"], -1)
     model = dict(clf.close())
     assert clf._mixer.alive is False
+    assert clf._mixer.degraded
+    assert clf._mixer.counters()["breaker_state"] == "dead"
+    assert clf._mixer.dropped_exchanges > 0
     assert model["1"] > 0 > model["2"]   # learned fine without the server
 
 
+def test_mix_client_stays_degraded_not_dead_under_default_breaker():
+    """With the default cooldown the breaker opens but the trip budget is
+    not spent inside a fast run: the client reports degraded (exchanges
+    suspended), stays alive for a later half-open probe, and training is
+    unaffected."""
+    from hivemall_tpu.models.linear import GeneralClassifier
+    clf = GeneralClassifier("-dims 32 -mini_batch 4 -eta0 0.5 "
+                            "-mix 127.0.0.1:1 -mix_threshold 1 "
+                            "-mix_retries 0 -mix_backoff 0.01")
+    for _ in range(16):
+        clf.process(["1:1.0"], 1)
+        clf.process(["2:1.0"], -1)
+    model = dict(clf.close())
+    assert clf._mixer.degraded
+    assert clf._mixer.alive             # breaker open, not permanent
+    assert clf._mixer.counters()["breaker_trips"] >= 1
+    assert model["1"] > 0 > model["2"]
+
+
 def test_mix_fault_injection_drop():
-    """Server that hangs up mid-session: client disables itself, training
-    finishes, and the model is still sane (SURVEY.md §6 fault injection)."""
+    """Server that hangs up on every 2nd request: retry + reconnect rides
+    through EVERY drop — all exchanges complete, the client never
+    degrades, and the reconnect counter shows the recoveries (the old
+    client died permanently on the first drop)."""
     from hivemall_tpu.models.linear import GeneralClassifier
     from hivemall_tpu.parallel.mix_service import MixServer
     srv = MixServer()
@@ -190,20 +220,25 @@ def test_mix_fault_injection_drop():
     try:
         clf = GeneralClassifier(
             f"-dims 32 -mini_batch 4 -eta0 0.5 -reg no -eta fixed "
-            f"-mix 127.0.0.1:{srv.port} -mix_threshold 1")
+            f"-mix 127.0.0.1:{srv.port} -mix_threshold 1 -mix_backoff 0.01")
         for _ in range(32):
             clf.process(["1:1.0"], 1)
             clf.process(["2:1.0"], -1)
         model = dict(clf.close())
-        assert clf._mixer.alive is False          # detected the drop
-        assert clf._mixer.exchanges >= 1          # at least one worked first
+        assert clf._mixer.alive                   # rode through every drop
+        assert not clf._mixer.degraded
+        assert clf._mixer.exchanges >= 8
+        assert clf._mixer.reconnects >= 1
+        assert clf._mixer.transport_errors >= 1
         assert model["1"] > 0 > model["2"]        # training kept going
     finally:
         srv.stop()
 
 
 def test_mix_fault_injection_delay():
-    """Server slower than the client timeout: same fail-soft degradation."""
+    """Server slower than the client timeout: every exchange times out, the
+    breaker trips through its budget (zero cooldown) and the client
+    degrades permanently — fail-soft, training unaffected."""
     from hivemall_tpu.models.linear import GeneralClassifier
     from hivemall_tpu.parallel.mix_service import MixServer
     srv = MixServer()
@@ -212,14 +247,57 @@ def test_mix_fault_injection_delay():
     try:
         clf = GeneralClassifier(
             f"-dims 32 -mini_batch 4 -eta0 0.5 -reg no -eta fixed "
-            f"-mix 127.0.0.1:{srv.port} -mix_threshold 1")
-        clf._mixer.timeout = 0.05                 # client far less patient
+            f"-mix 127.0.0.1:{srv.port} -mix_threshold 1 "
+            f"-mix_timeout 0.05 -mix_retries 0 -mix_backoff 0.01 "
+            f"-mix_breaker_cooldown 0")
         for _ in range(16):
             clf.process(["1:1.0"], 1)
             clf.process(["2:1.0"], -1)
         model = dict(clf.close())
         assert clf._mixer.alive is False
         assert model["1"] > 0 > model["2"]
+    finally:
+        srv.stop()
+
+
+def test_close_group_releases_socket_on_dead_client():
+    """Satellite: a permanently degraded client must still close/clear its
+    half-open socket on close_group (the old guard skipped the cleanup
+    whenever alive was False, leaking the fd)."""
+    from hivemall_tpu.parallel.mix_service import MixClient, MixServer
+    srv = MixServer().start()
+    try:
+        c = MixClient(f"127.0.0.1:{srv.port}", "g1", threshold=1)
+        c._connect()
+        sock = c._sock
+        c.alive = False                  # degraded mid-run, socket open
+        c.close_group()
+        assert c._sock is None
+        assert sock.fileno() == -1       # actually closed, not leaked
+        c.close_group()                  # idempotent
+    finally:
+        srv.stop()
+
+
+def test_mix_client_counters_surface():
+    """counters() — the MixServer.counters() peer — reports a healthy
+    client as closed-breaker/alive with its exchange tally."""
+    from hivemall_tpu.models.linear import GeneralClassifier
+    from hivemall_tpu.parallel.mix_service import MixServer
+    srv = MixServer().start()
+    try:
+        clf = GeneralClassifier(
+            f"-dims 32 -mini_batch 4 -eta0 0.5 -reg no -eta fixed "
+            f"-mix 127.0.0.1:{srv.port} -mix_threshold 1")
+        for _ in range(8):
+            clf.process(["1:1.0"], 1)
+        dict(clf.close())
+        c = clf._mixer.counters()
+        assert c["exchanges"] >= 1 and c["alive"]
+        assert c["breaker_state"] == "closed" and not clf._mixer.degraded
+        assert c["dropped_exchanges"] == 0 == c["transport_errors"]
+        for k in ("reconnects", "breaker_trips", "touched_overflow"):
+            assert k in c
     finally:
         srv.stop()
 
@@ -313,8 +391,10 @@ def test_fm_fused_layout_mixes_linear_weights():
 
 
 def _self_signed_cert(tmp_path):
-    """Self-signed localhost cert via the cryptography package."""
+    """Self-signed localhost cert via the cryptography package (skip the
+    TLS tests cleanly where the container doesn't ship it)."""
     import datetime
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa

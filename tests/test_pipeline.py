@@ -134,6 +134,69 @@ def test_auto_workers_positive():
     assert auto_workers() >= 1
 
 
+def test_close_idempotent_all_modes():
+    """close() is safe to call repeatedly, before or after consumption,
+    in both threaded and sequential modes (the trainer's finally block and
+    __del__ can both fire)."""
+    ds, _ = synthetic_classification(160, 8, seed=30)
+    it = IngestPipeline(ds.batches(16, shuffle=False), lambda b: b,
+                        workers=3)
+    next(it)
+    it.close()
+    it.close()                                # second close: no-op
+    assert not it._submitter.is_alive()
+    with pytest.raises(StopIteration):
+        next(it)
+    # close before any consumption
+    it2 = IngestPipeline(ds.batches(16, shuffle=False), lambda b: b,
+                         workers=3)
+    it2.close()
+    it2.close()
+    assert not it2._submitter.is_alive()
+    # sequential fallback has no threads to release but must stay safe
+    it3 = IngestPipeline(ds.batches(16, shuffle=False), lambda b: b,
+                         workers=1)
+    next(it3)
+    it3.close()
+    it3.close()
+    with pytest.raises(StopIteration):
+        next(it3)
+
+
+def test_drain_until_dead_wedged_producer_cancels():
+    """The cancel=True path with a producer wedged OUTSIDE a queue op
+    (e.g. a device_put hung on the relay): drain must give up after its
+    timeout — abandoning the daemon thread — while still emptying the
+    queue and cancelling every drained future."""
+    import queue
+
+    from hivemall_tpu.io.pipeline import drain_until_dead
+
+    wedge = threading.Event()
+    th = threading.Thread(target=wedge.wait, daemon=True)
+    th.start()
+
+    class _Fut:
+        def __init__(self):
+            self.cancelled = False
+
+        def cancel(self):
+            self.cancelled = True
+
+    q: "queue.Queue" = queue.Queue()
+    futs = [_Fut() for _ in range(3)]
+    for f in futs:
+        q.put(f)
+    t0 = time.monotonic()
+    drain_until_dead(q, th, timeout=0.2, cancel=True)
+    assert time.monotonic() - t0 < 2.0       # returned despite live thread
+    assert th.is_alive()                     # wedged producer abandoned
+    assert q.empty()
+    assert all(f.cancelled for f in futs)
+    wedge.set()
+    th.join(1)
+
+
 def test_fit_ingest_workers_matches_sequential():
     """-ingest_workers N produces the same model as the sequential path."""
     from hivemall_tpu.models.linear import GeneralClassifier
