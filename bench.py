@@ -1061,8 +1061,15 @@ _PIPELINE_KEYS = ("prep_seconds", "prep_wait_seconds",
 def main_smoke() -> int:
     """Run every _SMOKE bench at tiny shapes; fail loudly if any record
     fails to emit, parse, or (for the e2e bench) carry the pipeline stage
-    metrics. Exit code is the number of failures."""
+    metrics. Runs with span tracing ON and asserts the obs registry's
+    acceptance surface after the e2e bench (docs/OBSERVABILITY.md): the
+    merged snapshot must carry pipeline/train/mix/checkpoint/spans with
+    the hot-path dispatch spans recorded. Exit code is the number of
+    failures."""
     import sys
+    from hivemall_tpu.obs.registry import registry
+    from hivemall_tpu.obs.trace import get_tracer
+    get_tracer().enable()
     t0 = time.perf_counter()
     failures = 0
     configs = []
@@ -1075,6 +1082,15 @@ def main_smoke() -> int:
                 missing = [k for k in _PIPELINE_KEYS
                            if k not in rec.get("pipeline", {})]
                 assert not missing, f"pipeline keys missing: {missing}"
+                snap = registry.snapshot()
+                absent = [s for s in ("pipeline", "train", "mix",
+                                      "checkpoint", "spans")
+                          if s not in snap]
+                assert not absent, f"registry sections missing: {absent}"
+                spans = snap["spans"]
+                assert any(spans.get(s, {}).get("count", 0) > 0
+                           for s in ("dispatch.step", "dispatch.megastep")), \
+                    f"no dispatch spans in registry rollup: {spans}"
             if name == "bench_dispatch_fusion":
                 # the defusion floor (PR 2): fused K=8 dispatch must not
                 # run slower than per-batch K=1 — run_tests.sh fails on

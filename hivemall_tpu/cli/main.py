@@ -140,7 +140,19 @@ def _cmd_train(args) -> int:
                "examples_per_sec": round(n_examples / max(dt, 1e-9), 1)}
     if hasattr(trainer, "cumulative_loss"):
         metrics["cumulative_loss"] = round(trainer.cumulative_loss, 6)
-    print(json.dumps(metrics))
+    # the final record IS the obs-registry snapshot (docs/OBSERVABILITY.md):
+    # CLI runs and library runs report one schema — the run summary rides
+    # in its `run` section next to pipeline/train/mix/checkpoint/spans.
+    # default=str mirrors MetricsStream.emit: a stray numpy scalar in a
+    # provider must degrade, not crash a completed run at the last print.
+    from ..obs.registry import registry
+    registry.register("run", lambda: metrics)
+    try:
+        print(json.dumps(registry.snapshot(), default=str))
+    finally:
+        # the registry is process-global: a library caller embedding this
+        # CLI must not see a stale `run` section in later snapshots
+        registry.unregister("run")
     return 0
 
 
@@ -242,6 +254,15 @@ def _cmd_mixserv(args) -> int:
                  "python", bool(ctx))
 
 
+def _cmd_obs(args) -> int:
+    """Live-run summary off a metrics jsonl (docs/OBSERVABILITY.md): event
+    counts, training rate, span stage breakdown, MIX breaker state,
+    checkpoint age. ``--follow`` re-renders as the file grows."""
+    from ..obs.report import render_file
+    return render_file(args.file, follow=args.follow,
+                       interval=args.interval)
+
+
 def _cmd_define_all(args) -> int:
     from ..catalog import registry
     dialect = getattr(args, "dialect", "hive")
@@ -307,6 +328,17 @@ def main(argv=None) -> int:
                    help="native = C++ epoll server (no TLS), python = "
                         "asyncio, auto = native when available")
     m.set_defaults(fn=_cmd_mixserv)
+
+    o = sub.add_parser(
+        "obs", help="summarize a HIVEMALL_TPU_METRICS jsonl stream "
+                    "(rates, stage breakdown, breaker state, checkpoint "
+                    "age)")
+    o.add_argument("file", help="metrics jsonl path")
+    o.add_argument("--follow", action="store_true",
+                   help="keep watching; re-render when the file grows")
+    o.add_argument("--interval", type=float, default=2.0,
+                   help="--follow poll interval seconds")
+    o.set_defaults(fn=_cmd_obs)
 
     d = sub.add_parser("define-all", help="print the function manifest")
     d.add_argument("--dialect", default="hive",

@@ -28,10 +28,14 @@ import hashlib
 import json
 import os
 import re
+import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
+
+from ..obs.trace import get_tracer
 
 __all__ = ["save_bundle", "load_bundle", "CheckpointManager", "list_bundles"]
 
@@ -57,7 +61,14 @@ def save_bundle(trainer, path: str) -> None:
 
     Works for any trainer exposing `_checkpoint_arrays`/`_restore_arrays`;
     the LearnerBase counters (_examples, _loss_sum, _names) are optional so
-    non-LearnerBase trainers (e.g. MF) bundle too."""
+    non-LearnerBase trainers (e.g. MF) bundle too. Traced as a
+    ``checkpoint.save`` span — autosave stalls show up in the obs rollup
+    next to the stages they steal wall time from."""
+    with get_tracer().span("checkpoint.save"):
+        _save_bundle(trainer, path)
+
+
+def _save_bundle(trainer, path: str) -> None:
     if hasattr(trainer, "_fold_loss"):
         trainer._fold_loss()
     leaves, treedef = jax.tree_util.tree_flatten(trainer._checkpoint_arrays())
@@ -201,6 +212,42 @@ class CheckpointManager:
         os.makedirs(checkpoint_dir, exist_ok=True)
         self._next = start_step + self.every if self.every else None
         self._last_saved_step: Optional[int] = None
+        self._last_saved_ts: Optional[float] = None
+        # bundle count is CACHED (updated at save/prune time, one scan
+        # here at construction): the registry provider below runs inline
+        # in the fit loop (-telemetry_every) and on scrape threads, and
+        # the provider contract is cheap/non-blocking — a per-snapshot
+        # listdir on a networked checkpoint FS would stall training
+        self._bundles = len(list_bundles(checkpoint_dir, name))
+        # obs registry section (weakly held — the registry is process-wide
+        # and must not pin a dead manager or its trainer). A trainer-owned
+        # manager is ALSO reachable through the trainer's own `checkpoint`
+        # provider (LearnerBase._register_obs delegates to obs_section),
+        # which re-registers on every trainer construction so a new
+        # trainer can never inherit a previous trainer's section.
+        from ..obs.registry import registry
+        ref = weakref.ref(self)
+
+        def _obs() -> dict:
+            m = ref()
+            return m.obs_section() if m is not None \
+                else {"configured": False}
+
+        registry.register("checkpoint", _obs)
+
+    def obs_section(self) -> dict:
+        """This manager's `checkpoint` registry section (cheap: every
+        field is a cached attribute — no filesystem access)."""
+        return {
+            "configured": True,
+            "dir": self.dir,
+            "every": self.every,
+            "keep": self.keep,
+            "last_saved_step": self._last_saved_step,
+            "age_seconds": (round(time.time() - self._last_saved_ts, 3)
+                            if self._last_saved_ts else None),
+            "bundles": self._bundles,
+        }
 
     def maybe_save(self, trainer) -> Optional[str]:
         if self._next is None or trainer._t < self._next:
@@ -215,12 +262,17 @@ class CheckpointManager:
                             f"{self.name}-step{trainer._t:010d}.npz")
         save_bundle(trainer, path)
         self._last_saved_step = int(trainer._t)
+        self._last_saved_ts = time.time()
         self._prune()
-        from ..utils.metrics import get_stream
-        stream = get_stream()
-        if stream.enabled:
-            stream.emit("checkpoint", trainer=self.name,
-                        step=int(trainer._t), path=path)
+        emit = getattr(trainer, "_emit_checkpoint_event", None)
+        if emit is not None:            # one emitter for every save site
+            emit(path, step=int(trainer._t))
+        else:                           # non-LearnerBase trainers (MF, ...)
+            from ..utils.metrics import get_stream
+            stream = get_stream()
+            if stream.enabled:
+                stream.emit("checkpoint", trainer=self.name,
+                            step=int(trainer._t), path=path)
         return path
 
     def save_final(self, trainer) -> Optional[str]:
@@ -231,8 +283,12 @@ class CheckpointManager:
         return self.save(trainer)
 
     def _prune(self) -> None:
-        for path in list_bundles(self.dir, self.name)[self.keep:]:
+        paths = list_bundles(self.dir, self.name)
+        kept = len(paths)
+        for path in paths[self.keep:]:
             try:
                 os.remove(path)
+                kept -= 1
             except OSError:
                 pass
+        self._bundles = kept

@@ -47,6 +47,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from ..obs.trace import get_tracer
+
 __all__ = ["PipelineStats", "IngestPipeline", "auto_workers"]
 
 _STOP = object()
@@ -91,9 +93,14 @@ def _timed_call(fn, item):
     """Module-level so ProcessPoolExecutor can pickle the task (a bound
     pipeline method would drag the queue/lock along). Returns (result,
     seconds) so prep time is measured in the worker, recorded by the
-    consumer."""
+    consumer. The ``ingest.prep`` span is likewise recorded IN the worker
+    thread — the tracer's ring is thread-safe, and worker-side spans are
+    what the obs rollup attributes prep time with (process pools record
+    into the child's tracer, which is lost — thread pools are the default
+    and the traced configuration)."""
     t0 = time.perf_counter()
-    out = fn(item)
+    with get_tracer().span("ingest.prep"):
+        out = fn(item)
     return out, time.perf_counter() - t0
 
 
@@ -262,7 +269,8 @@ class IngestPipeline:
             item = next(self._src)      # StopIteration ends the stream
             t0 = time.perf_counter()
             try:
-                out = self._fn(item)
+                with get_tracer().span("ingest.prep"):
+                    out = self._fn(item)
             except BaseException:
                 self.stats.add(worker_errors=1)
                 self._closed.set()

@@ -34,6 +34,7 @@ from typing import Iterable, Iterator, Optional
 import jax
 import numpy as np
 
+from ..obs.trace import get_tracer
 from .sparse import MegaBatch, PackedBatch, PackedMegaBatch, SparseBatch
 
 __all__ = ["DevicePrefetcher", "MegabatchStager", "stage_batch"]
@@ -42,6 +43,13 @@ _STOP = object()
 
 
 def stage_batch(b, device=None):
+    """Traced wrapper (``h2d.stage`` span) over :func:`_stage_batch` —
+    the transfer is the seam the obs rollup attributes h2d time with."""
+    with get_tracer().span("h2d.stage"):
+        return _stage_batch(b, device)
+
+
+def _stage_batch(b, device=None):
     """device_put every array of one batch. ``val=None`` (unit-value
     elision, see SparseBatch) and ``field=None`` are preserved — skipping
     the val transfer is the point: the host->device link is the e2e
@@ -262,6 +270,10 @@ class MegabatchStager:
         return bufs
 
     def _stack(self, window):
+        with get_tracer().span("stager.stack"):
+            return self._stack_inner(window)
+
+    def _stack_inner(self, window):
         t0 = time.perf_counter()
         K = len(window)
         first = window[0]

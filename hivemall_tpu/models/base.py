@@ -23,6 +23,7 @@ import numpy as np
 from ..io.pipeline import PipelineStats
 from ..io.sparse import (MegaBatch, PackedMegaBatch, SparseBatch,
                          SparseDataset, pow2_len, split_feature)
+from ..obs.trace import get_tracer
 from ..utils.hashing import mhash
 from ..utils.metrics import Meter, get_stream
 from ..utils.options import OptionSpec, Parsed
@@ -139,6 +140,16 @@ def learner_option_spec(name: str, *, classification: bool,
                "retained); 0 = off")
     s.add("checkpoint_keep", type=int, default=3, min=1,
           help="how many autosaved step bundles to retain")
+    # unified telemetry (docs/OBSERVABILITY.md): registry snapshots into
+    # the jsonl stream at a step cadence, plus the live HTTP surface
+    s.add("telemetry_every", type=int, default=0, min=0,
+          help="emit the full obs-registry snapshot as a 'telemetry' "
+               "jsonl event every N optimizer steps (requires "
+               "HIVEMALL_TPU_METRICS); 0 = off")
+    s.add("obs_port", type=int, default=0, min=0,
+          help="serve the obs registry over HTTP on this port: /snapshot "
+               "(JSON) and /metrics (Prometheus text exposition) — the "
+               "MixServer-JMX analog for the training runtime; 0 = off")
     s.flag("cv", help="track cumulative loss for convergence check")
     return s
 
@@ -201,8 +212,10 @@ class LearnerBase:
         self._loss_pending = 0.0              # on-device partial, folded in
         self._examples = 0
         self._meter = Meter()                 # rolling examples/sec (§6)
+        self._tracer = get_tracer()           # span tracing (obs.trace)
         self.pipeline_stats = PipelineStats()  # last fit's ingest metrics
         self._mixer = None
+        self._ck_manager = None               # fit_stream's autosaver (obs)
         self._fit_ds = None                   # columnar dataset ref (fit)
         self.mesh = None                      # jax Mesh when -mesh is set
         self._tp_sizes = {self.dims}          # axis sizes sharded over 'tp'
@@ -244,6 +257,8 @@ class LearnerBase:
             self._warm_start(self.opts.loadmodel)
         if self.opts.get("mesh"):
             self._apply_mesh(self.opts.mesh)
+        self._telemetry_every = int(self.opts.get("telemetry_every") or 0)
+        self._register_obs()
 
     # -- subclass surface ----------------------------------------------------
     def _init_state(self) -> None:
@@ -257,6 +272,115 @@ class LearnerBase:
 
     def _finalized_weights(self) -> np.ndarray:
         raise NotImplementedError
+
+    # -- unified telemetry (obs.registry, docs/OBSERVABILITY.md) -------------
+    def _register_obs(self) -> None:
+        """Register this trainer's counter surfaces with the central obs
+        registry: ``pipeline`` (ingest/stager/h2d stage counters),
+        ``train`` (step/examples/rate/loss), and ``mix`` (client breaker +
+        exchange counters) when mixing. Providers hold the trainer weakly
+        (the registry is process-global, must not pin dead trainers) and
+        are non-blocking — avg_loss reads the host-side folded sum only,
+        never syncing the device from a scrape thread."""
+        import weakref
+        from ..obs.registry import registry
+        ref = weakref.ref(self)
+
+        def pipeline() -> dict:
+            t = ref()
+            return t.pipeline_stats.as_dict() if t is not None else {}
+
+        def train() -> dict:
+            t = ref()
+            if t is None:
+                return {}
+            return {"trainer": t.NAME, "step": t._t,
+                    "examples": t._examples,
+                    "examples_per_sec": round(t._meter.rate, 1),
+                    "avg_loss": round(t._loss_sum / max(1, t._examples), 6)}
+
+        def mix() -> dict:
+            t = ref()
+            if t is None or t._mixer is None:
+                return {"active": False}
+            c = dict(t._mixer.counters())
+            c["active"] = True
+            return c
+
+        def checkpoint() -> dict:
+            t = ref()
+            m = getattr(t, "_ck_manager", None) if t is not None else None
+            return m.obs_section() if m is not None \
+                else {"configured": False}
+
+        # every section registers UNCONDITIONALLY, bound to THIS trainer:
+        # a trainer without a mixer/autosaver reports inactive rather than
+        # letting a previous trainer's live sections leak into its
+        # snapshots (last-wins registration makes construction the reset)
+        registry.register("pipeline", pipeline)
+        registry.register("train", train)
+        registry.register("mix", mix)
+        registry.register("checkpoint", checkpoint)
+        if int(self.opts.get("obs_port") or 0):
+            from ..obs.http import ensure_server
+            ensure_server(int(self.opts.obs_port))
+
+    def _emit_cadence_events(self, window: int) -> None:
+        """The per-dispatch emission ladder. ``window`` is how many
+        optimizer steps this dispatch advanced (K for a fused megastep).
+
+        Loss-fold cadence (a 256-step boundary crossed): fold the device
+        loss partial into the host float64, then — stream permitting —
+        emit ``train_step`` (the reportProgress analog) and, when tracing,
+        the per-stage ``span_rollup``. ``-telemetry_every`` boundaries
+        additionally emit the full registry snapshot."""
+        if self._t % 256 < window:
+            self._fold_loss()
+            stream = get_stream()
+            if stream.enabled:
+                stream.emit("train_step", trainer=self.NAME, step=self._t,
+                            examples=self._examples,
+                            examples_per_sec=round(self._meter.rate, 1),
+                            avg_loss=round(self._loss_sum
+                                           / max(1, self._examples), 6))
+                if self._tracer.enabled:
+                    stream.emit("span_rollup", trainer=self.NAME,
+                                step=self._t, stages=self._tracer.rollup())
+        every = self._telemetry_every
+        if every and self._t % every < window:
+            stream = get_stream()
+            if stream.enabled:
+                from ..obs.registry import registry
+                stream.emit("telemetry", trainer=self.NAME, step=self._t,
+                            snapshot=registry.snapshot())
+
+    def _emit_train_done(self) -> None:
+        """``train_done`` carrying the merged registry snapshot — the
+        one-record run summary both the jsonl surface and the ``obs`` CLI
+        read — plus the Chrome-trace export when configured."""
+        stream = get_stream()
+        if stream.enabled:
+            from ..obs.registry import registry
+            stream.emit("train_done", trainer=self.NAME, step=self._t,
+                        examples=self._examples,
+                        avg_loss=round(self.cumulative_loss, 6),
+                        telemetry=registry.snapshot())
+        self._tracer.maybe_export()
+
+    def _emit_checkpoint_event(self, path: str, **fields) -> None:
+        """The ONE checkpoint-event emitter (epoch bundles here and in
+        fm.py's adareg loop, CheckpointManager's cadence saves)."""
+        stream = get_stream()
+        if stream.enabled:
+            stream.emit("checkpoint", trainer=self.NAME, path=path, **fields)
+
+    def _save_epoch_bundle(self, ckdir: str, epoch: int) -> str:
+        """Per-epoch full-state bundle + its checkpoint event."""
+        os.makedirs(ckdir, exist_ok=True)
+        path = os.path.join(ckdir, f"{self.NAME}-ep{epoch}.npz")
+        self.save_bundle(path)
+        self._emit_checkpoint_event(path, epoch=epoch)
+        return path
 
     # -- UDTF lifecycle ------------------------------------------------------
     def process(self, features: Sequence[str] | Tuple[np.ndarray, np.ndarray],
@@ -299,11 +423,7 @@ class LearnerBase:
         self._replay.cleanup()
         if self._mixer is not None:
             self._mixer.close_group()
-        stream = get_stream()
-        if stream.enabled:
-            stream.emit("train_done", trainer=self.NAME, step=self._t,
-                        examples=self._examples,
-                        avg_loss=round(self.cumulative_loss, 6))
+        self._emit_train_done()
         yield from self.model_rows()
 
     # -- columnar fast path --------------------------------------------------
@@ -337,6 +457,10 @@ class LearnerBase:
             if tracing:
                 import jax
                 jax.profiler.stop_trace()
+        # one train_done per completed fit (the columnar peer of close()/
+        # fit_stream), carrying the merged registry snapshot; not emitted
+        # on the exception path
+        self._emit_train_done()
         return self
 
     def _fit_epochs(self, ds, epochs, bs, shuffle, prefetch, ckdir,
@@ -364,13 +488,7 @@ class LearnerBase:
                 for c in reversed(closers):
                     c()              # release the workers on early exit too
             if ckdir:
-                os.makedirs(ckdir, exist_ok=True)
-                path = os.path.join(ckdir, f"{self.NAME}-ep{ep + 1}.npz")
-                self.save_bundle(path)
-                stream = get_stream()
-                if stream.enabled:
-                    stream.emit("checkpoint", trainer=self.NAME,
-                                epoch=ep + 1, path=path)
+                self._save_epoch_bundle(ckdir, ep + 1)
 
     def _wants_fit_ds(self) -> bool:
         """Whether fit() should keep a reference to the training dataset for
@@ -589,7 +707,8 @@ class LearnerBase:
 
     def fit_stream(self, batches: Iterable[SparseBatch], *,
                    convert_labels: bool = True,
-                   resume: bool = False) -> "LearnerBase":
+                   resume: bool = False,
+                   _emit_done: bool = True) -> "LearnerBase":
         """Out-of-core training over a stream of padded batches (e.g.
         io.arrow.ParquetStream.batches): each batch dispatches one jitted
         step; nothing is buffered, so resident memory is one shard.
@@ -618,7 +737,11 @@ class LearnerBase:
             # sequential reuse) would checkpoint positions offset by the
             # previous stream's length and resume would skip wrongly
             self._stream_pos = 0
-        autosaver = self._autosaver()
+        # the manager is pinned on the trainer (not a local) so the obs
+        # registry's weakly-held `checkpoint` section — last_saved_step,
+        # age_seconds, bundle count — outlives the stream and stays
+        # readable between runs for as long as the trainer does
+        autosaver = self._ck_manager = self._autosaver()
 
         def host_side() -> Iterator[SparseBatch]:
             # label conversion + pair tracking stay on HOST arrays and in
@@ -656,6 +779,14 @@ class LearnerBase:
             # the exception path — the last cadence bundle IS the recovery
             # point a crashed run resumes from.
             autosaver.save_final(self)
+        # completed stream: one train_done record carrying the merged
+        # registry snapshot (pipeline/train/mix/checkpoint/spans) — the
+        # jsonl peer of `curl /snapshot`. Not emitted on the exception
+        # path (a crashed stream has no "done") nor when this call is one
+        # epoch inside a multi-epoch wrapper (_emit_done=False: FFM's
+        # replay fit_stream emits ONE record for the whole run).
+        if _emit_done:
+            self._emit_train_done()
         return self
 
     def _autosaver(self):
@@ -771,7 +902,12 @@ class LearnerBase:
         nv = batch.n_valid or batch.batch_size
         if self.mesh is not None:
             batch = self._shard_batch(batch)
-        loss_sum = self._train_batch(batch)
+        # the span is the HOST-side dispatch boundary: synchronous compute
+        # on CPU, dispatch latency on accelerators (async tails land in
+        # the next blocking boundary) — the same semantics as the bench's
+        # stage decomposition
+        with self._tracer.span("dispatch.step"):
+            loss_sum = self._train_batch(batch)
         self._t += 1
         # keep the per-step loss on device: float() here would block the host
         # on every minibatch and stall the dispatch pipeline. The device
@@ -782,15 +918,7 @@ class LearnerBase:
         self._meter.add(nv)
         if self._trace_losses is not None:
             self._trace_losses.append(float(loss_sum))
-        if self._t % 256 == 0:
-            self._fold_loss()
-            stream = get_stream()
-            if stream.enabled:              # reportProgress analog (§6)
-                stream.emit("train_step", trainer=self.NAME, step=self._t,
-                            examples=self._examples,
-                            examples_per_sec=round(self._meter.rate, 1),
-                            avg_loss=round(self._loss_sum
-                                           / max(1, self._examples), 6))
+        self._emit_cadence_events(1)        # reportProgress analog (§6)
         if self._mixer is not None:
             self._mixer.touch(batch.idx[:nv])
             self._mixer.maybe_mix(self)
@@ -806,7 +934,8 @@ class LearnerBase:
         nv_total = mb.n_examples
         if self.mesh is not None:
             mb = self._shard_megabatch(mb)
-        losses = self._train_megabatch(mb)          # [K] device array
+        with self._tracer.span("dispatch.megastep"):
+            losses = self._train_megabatch(mb)      # [K] device array
         self._t += K
         self._loss_pending = self._loss_pending + losses.sum()
         self._examples += nv_total
@@ -815,17 +944,9 @@ class LearnerBase:
             import numpy as np
             self._trace_losses.extend(
                 float(v) for v in np.asarray(losses))
-        # fold when this window crossed a multiple-of-256 step boundary
+        # emit when this window crossed a multiple-of-256 step boundary
         # (the K=1 condition `t % 256 == 0` is the K=1 case of this)
-        if self._t % 256 < K:
-            self._fold_loss()
-            stream = get_stream()
-            if stream.enabled:
-                stream.emit("train_step", trainer=self.NAME, step=self._t,
-                            examples=self._examples,
-                            examples_per_sec=round(self._meter.rate, 1),
-                            avg_loss=round(self._loss_sum
-                                           / max(1, self._examples), 6))
+        self._emit_cadence_events(K)
 
     def _megastep_state(self) -> Tuple[Any, Any]:
         """(model-state, optimizer-state) pair threaded through the scan

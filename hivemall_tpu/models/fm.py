@@ -14,7 +14,6 @@ M3); see parallel.dp / __graft_entry__.dryrun_multichip.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -412,14 +411,7 @@ class FMTrainer(LearnerBase):
             super()._fit_epochs(ds_tr, 1, bs, shuffle, prefetch, None,
                                 seed0=seed0 + ep)
             if ckdir:
-                from ..utils.metrics import get_stream
-                os.makedirs(ckdir, exist_ok=True)
-                path = os.path.join(ckdir, f"{self.NAME}-ep{ep + 1}.npz")
-                self.save_bundle(path)
-                stream = get_stream()
-                if stream.enabled:
-                    stream.emit("checkpoint", trainer=self.NAME,
-                                epoch=ep + 1, path=path)
+                self._save_epoch_bundle(ckdir, ep + 1)
             va = self._mean_loss(ds_va)
             if prev is not None:
                 scale = (self._ADAREG_UP if va > prev * (1 + 1e-9)
@@ -951,7 +943,9 @@ class FFMTrainer(FMTrainer):
         if self.mesh is not None or not self._pack_input_on():
             for _ in range(epochs):
                 super().fit_stream(batches(),
-                                   convert_labels=convert_labels)
+                                   convert_labels=convert_labels,
+                                   _emit_done=False)
+            self._emit_train_done()    # ONE record for the whole run
             return self
 
         def host_side():
@@ -979,13 +973,19 @@ class FFMTrainer(FMTrainer):
         mat = self._staged_matrix(staged)
         del staged           # peak device memory ~M (+Mp), not M + copies
         if mat == ():
+            self._emit_train_done()
             return self
         if mat is None:                      # fail-open: re-stream
             for _ in range(epochs - 1):
                 super().fit_stream(batches(),
-                                   convert_labels=convert_labels)
+                                   convert_labels=convert_labels,
+                                   _emit_done=False)
+            self._emit_train_done()
             return self
         self._replay_epochs(mat, epochs - 1, replay_shuffle)
+        # the packed replay path never re-enters base fit_stream after
+        # epoch 1, so the run's single train_done is emitted here
+        self._emit_train_done()
         return self
 
     def _pack_input_on(self) -> bool:

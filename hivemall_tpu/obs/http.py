@@ -1,0 +1,176 @@
+"""Opt-in HTTP obs surface — the MixServer's JMX peer, for the runtime.
+
+A deliberately tiny, SINGLE-THREADED ``http.server`` endpoint serving the
+central registry (``-obs_port`` trainer option, or :func:`ensure_server`):
+
+- ``GET /snapshot`` — ``registry.snapshot()`` as JSON (one merged dict of
+  every subsystem's counters; see obs.registry).
+- ``GET /metrics``  — the same counters flattened to Prometheus text
+  exposition (version 0.0.4): ``hivemall_tpu_<section>_<key> <value>``
+  gauges, booleans as 0/1, non-numeric leaves skipped.
+
+Single-threaded on purpose: one handler at a time means a scrape can never
+pile threads onto a training host; a slow scraper only delays the next
+scrape, never the fit loop (providers are non-blocking by contract). The
+server runs on a daemon thread and dies with the process.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import re
+import threading
+from typing import Optional
+
+from .registry import Registry, registry
+
+__all__ = ["ObsServer", "ensure_server", "to_prometheus"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(parts) -> str:
+    return _NAME_RE.sub("_", "_".join(parts))
+
+
+def to_prometheus(snapshot: dict, prefix: str = "hivemall_tpu") -> str:
+    """Flatten a registry snapshot into Prometheus text exposition.
+
+    Numeric and boolean leaves become label-less gauges named by their
+    dict path (``pipeline.batches_prepared`` ->
+    ``hivemall_tpu_pipeline_batches_prepared``); strings/lists/None are
+    presentation-only and are skipped (the JSON ``/snapshot`` carries
+    them). The top-level ``ts`` is exported as ``<prefix>_snapshot_ts``.
+    """
+    lines = []
+
+    def walk(parts, val):
+        if isinstance(val, bool):
+            emit(parts, 1 if val else 0)
+        elif isinstance(val, (int, float)):
+            emit(parts, val)
+        elif isinstance(val, dict):
+            for k in sorted(val):
+                walk(parts + [str(k)], val[k])
+        # str / list / None: no numeric reading — skipped
+
+    def emit(parts, val):
+        name = _metric_name(parts)
+        lines.append(f"# TYPE {name} gauge")
+        # ints verbatim, floats via repr — NOT %g, which truncates to 6
+        # significant digits and corrupts large counters
+        # (examples=44776121 -> 4.47761e+07) and epoch timestamps
+        out = str(val) if isinstance(val, int) else repr(float(val))
+        lines.append(f"{name} {out}")
+
+    for section in sorted(snapshot):
+        if section == "ts":
+            walk([prefix, "snapshot", "ts"], snapshot[section])
+        else:
+            walk([prefix, section], snapshot[section])
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    # the registry to serve is attached per-server-class (see ObsServer)
+    obs_registry: Registry = registry
+    # per-connection socket timeout: the server handles ONE connection at
+    # a time, so a client that connects and never sends a request line
+    # (half-open TCP, port scanner) must not wedge /metrics for the run —
+    # BaseHTTPRequestHandler turns the timeout into a clean close
+    timeout = 10
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        if path == "/snapshot":
+            # default=str: a stray non-JSON leaf from a provider degrades
+            # to its string form instead of killing the scrape
+            body = json.dumps(self.obs_registry.snapshot(),
+                              default=str).encode()
+            ctype = "application/json"
+        elif path == "/metrics":
+            body = to_prometheus(self.obs_registry.snapshot()).encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        else:
+            self.send_error(404, "unknown path (try /snapshot or /metrics)")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):     # scrapes must not spam the trainer's
+        pass                          # stderr
+
+
+class _QuietHTTPServer(http.server.HTTPServer):
+    def handle_error(self, request, client_address):
+        # a scraper disconnecting mid-response (BrokenPipeError etc.) is
+        # routine, not a traceback on the trainer's stderr
+        pass
+
+
+class ObsServer:
+    """Single-threaded HTTP server over an obs registry.
+
+    ``port=0`` binds an ephemeral port (resolved in ``self.port`` after
+    construction). ``start()`` serves on a daemon thread; ``stop()`` shuts
+    it down. Loopback-only by default — this is an operator surface, not a
+    public API; bind ``host="0.0.0.0"`` explicitly for cluster scrapes.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 obs_registry: Optional[Registry] = None):
+        handler = type("_BoundHandler", (_Handler,),
+                       {"obs_registry": obs_registry or registry})
+        self._httpd = _QuietHTTPServer((host, port), handler)
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ObsServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"obs-http:{self.port}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_server: Optional[ObsServer] = None
+_server_lock = threading.Lock()
+
+
+def ensure_server(port: int, host: str = "127.0.0.1") -> Optional[ObsServer]:
+    """Idempotent process-wide server for the ``-obs_port`` option: the
+    first caller binds, later callers (a second trainer in the same
+    process) reuse it. A bind failure warns and returns None — the obs
+    surface must never take training down."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            if port and port != _server.port:
+                import warnings
+                warnings.warn(
+                    f"obs HTTP server already bound to port "
+                    f"{_server.port}; -obs_port {port} is ignored "
+                    f"(one server per process)",
+                    RuntimeWarning, stacklevel=2)
+            return _server
+        try:
+            _server = ObsServer(port, host).start()
+        except OSError as e:
+            import warnings
+            warnings.warn(f"obs HTTP server failed to bind port {port}: {e};"
+                          " /snapshot and /metrics are unavailable",
+                          RuntimeWarning, stacklevel=2)
+            return None
+        return _server

@@ -1,0 +1,84 @@
+"""Central counter registry — every subsystem's metrics in ONE snapshot.
+
+The reference scattered its counters across Hadoop MapredContext, log4j
+and the MixServer's JMX beans; the rebuild likewise grew four disjoint
+surfaces (PipelineStats, MixClient/MixServer counters(), CheckpointManager,
+Meter). This registry is the merge point: subsystems register a named
+zero-argument provider returning a JSON-ready dict, and ``snapshot()``
+calls them all into one record — the payload of the ``train_done`` /
+``telemetry`` jsonl events, the ``/snapshot`` HTTP endpoint, and (flattened)
+the ``/metrics`` Prometheus exposition.
+
+Contract for providers:
+
+- cheap and non-blocking: snapshot() may be called from another thread
+  WHILE a fit is running (the live-surface case), so a provider must never
+  sync the device, take a long lock, or mutate trainer state;
+- JSON-ready: dicts/lists/str/numbers/bools/None only;
+- failure-isolated: a provider that raises yields an ``{"error": ...}``
+  section, never a broken snapshot.
+
+Registration is last-wins by section name (a new trainer's ``pipeline``
+provider replaces the previous trainer's) and providers should hold their
+subject weakly — the registry is process-global and must not keep dead
+trainers alive.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List
+
+__all__ = ["Registry", "registry"]
+
+Provider = Callable[[], dict]
+
+
+class Registry:
+    """Named sections of JSON-ready counters, merged on demand."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._providers: Dict[str, Provider] = {}
+
+    def register(self, name: str, provider: Provider) -> str:
+        """Bind ``name`` to ``provider`` (last registration wins). Returns
+        the name so callers can later :meth:`unregister` it."""
+        if not callable(provider):
+            raise TypeError(f"provider for {name!r} must be callable")
+        with self._lock:
+            self._providers[name] = provider
+        return name
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._providers.pop(name, None)
+
+    def sections(self) -> List[str]:
+        with self._lock:
+            return sorted(self._providers)
+
+    def snapshot(self) -> dict:
+        """One merged, JSON-ready dict: ``{"ts": ..., section: {...}}``.
+        Provider failures are isolated into their own section — a broken
+        subsystem must never take the whole surface down."""
+        with self._lock:
+            providers = list(self._providers.items())
+        out: dict = {"ts": round(time.time(), 3)}
+        for name, fn in providers:
+            try:
+                out[name] = fn()
+            except Exception as e:          # noqa: BLE001 — isolation is the point
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+
+#: The process-wide registry. Subsystems register themselves on
+#: construction (LearnerBase: pipeline/train/mix; CheckpointManager:
+#: checkpoint; MixServer: mix_server; MetricsStream: metrics_stream;
+#: Tracer: spans). The defaults below guarantee the acceptance sections
+#: exist in every snapshot even before a subsystem comes up.
+registry = Registry()
+registry.register("mix", lambda: {"active": False})
+registry.register("checkpoint", lambda: {"configured": False})

@@ -1,0 +1,247 @@
+"""``hivemall_tpu obs <metrics.jsonl>`` — live-run summary off the stream.
+
+Tails/aggregates a jsonl metrics file (the ``HIVEMALL_TPU_METRICS`` sink)
+into a terminal summary: event counts, current training rate, the
+per-stage span breakdown (from the latest ``span_rollup``), MIX breaker
+state and checkpoint age (from the latest registry snapshot carried by
+``telemetry`` / ``train_done`` events), and metrics-stream health
+(dropped events, rotations). ``--follow`` re-renders as the file grows —
+the poor ops engineer's ``watch`` for a soak run.
+
+Robustness contract: a metrics file from a live (or crashed) run may end
+in a torn line and may interleave events from several trainers;
+unparsable lines are counted, never fatal. Follow mode is built for
+soaks: each tick reads only the appended bytes and folds them into
+BOUNDED incremental aggregates (counts + newest record per event type) —
+memory and per-tick work stay O(1) in the file's history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["load_events", "summarize", "render_file"]
+
+
+class _TailState:
+    """Bounded aggregates over a stream of events: per-event counts,
+    the newest record per event type, the newest registry snapshot, the
+    ts range, and the unparsable-line count. Everything the renderer
+    needs, in O(1) memory."""
+
+    def __init__(self):
+        self.counts: Dict[str, int] = {}
+        self.last: Dict[str, dict] = {}
+        self.snapshot: Optional[dict] = None
+        self.t_lo: Optional[float] = None
+        self.t_hi: Optional[float] = None
+        self.bad = 0
+        self.total = 0
+
+    def add(self, rec: dict) -> None:
+        name = rec["event"]
+        self.total += 1
+        self.counts[name] = self.counts.get(name, 0) + 1
+        self.last[name] = rec
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            self.t_lo = ts if self.t_lo is None else min(self.t_lo, ts)
+            self.t_hi = ts if self.t_hi is None else max(self.t_hi, ts)
+        if name == "telemetry" and isinstance(rec.get("snapshot"), dict):
+            self.snapshot = rec["snapshot"]
+        elif name == "train_done" and isinstance(rec.get("telemetry"),
+                                                 dict):
+            self.snapshot = rec["telemetry"]
+
+    def feed_lines(self, raw: bytes) -> None:
+        """Fold the complete jsonl lines in ``raw`` into the aggregates;
+        unparsable lines are counted in ``bad``."""
+        for line in raw.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                self.bad += 1
+                continue
+            if isinstance(rec, dict) and "event" in rec:
+                self.add(rec)
+            else:
+                self.bad += 1
+
+
+def load_events(path: str) -> Tuple[List[dict], int]:
+    """All parsable events in ``path`` plus the count of unparsable lines
+    (torn tail of a live run, partial writes after a crash)."""
+    events: List[dict] = []
+    bad = 0
+    with open(path, "rb") as f:
+        for line in f.read().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if isinstance(rec, dict) and "event" in rec:
+                events.append(rec)
+            else:
+                bad += 1
+    return events, bad
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v * 1e3:.2f}ms" if v < 1.0 else f"{v:.3f}s"
+
+
+def _render(state: _TailState, path: str = "",
+            now: Optional[float] = None) -> str:
+    now = time.time() if now is None else now
+    if not state.total:
+        return (f"obs: {path or 'stream'}: no parsable events"
+                + (f" ({state.bad} unparsable lines)" if state.bad else ""))
+    out: List[str] = []
+    span_s = 0.0
+    if state.t_lo is not None and state.t_hi is not None:
+        span_s = max(0.0, state.t_hi - state.t_lo)
+    head = (f"obs: {path or 'stream'} — {state.total} events over "
+            f"{span_s:.1f}s")
+    if state.bad:
+        head += f" ({state.bad} unparsable lines)"
+    out.append(head)
+    out.append("events: " + ", ".join(
+        f"{k} x{v}" for k, v in sorted(state.counts.items())))
+
+    # newest progress record wins: a finished run's train_done carries the
+    # final step/examples, a live run only has train_step so far
+    candidates = [r for r in (state.last.get("train_step"),
+                              state.last.get("train_done")) if r]
+    step = max(candidates, key=lambda r: r.get("step", 0), default=None)
+    if step is not None:
+        line = (f"train:  {step.get('trainer', '?')} step {step.get('step')}"
+                f"  examples {step.get('examples')}")
+        if "examples_per_sec" in step:
+            line += f"  rate {step['examples_per_sec']}/s"
+        if "avg_loss" in step:
+            line += f"  avg_loss {step['avg_loss']}"
+        if state.counts.get("train_done"):
+            line += "  [done]"
+        out.append(line)
+
+    roll = state.last.get("span_rollup")
+    snap = state.snapshot
+    stages = (roll or {}).get("stages") \
+        or ((snap or {}).get("spans") if snap else None)
+    if stages:
+        total = sum(s.get("total_s", 0.0) for s in stages.values()) or 1.0
+        out.append("stages (latest rollup):")
+        width = max(len(n) for n in stages)
+        for name in sorted(stages,
+                           key=lambda n: -stages[n].get("total_s", 0.0)):
+            s = stages[name]
+            out.append(
+                f"  {name:<{width}}  count {s.get('count', 0):>7}  "
+                f"total {_fmt_s(s.get('total_s', 0.0)):>9}  "
+                f"p50 {_fmt_s(s.get('p50', 0.0)):>9}  "
+                f"p99 {_fmt_s(s.get('p99', 0.0)):>9}  "
+                f"({100.0 * s.get('total_s', 0.0) / total:4.1f}%)")
+
+    if snap:
+        mix = snap.get("mix") or {}
+        if mix.get("active"):
+            out.append(
+                f"mix:    breaker {mix.get('breaker_state', '?')}"
+                f"  exchanges {mix.get('exchanges', 0)}"
+                f"  dropped {mix.get('dropped_exchanges', 0)}"
+                f"  transport_errors {mix.get('transport_errors', 0)}"
+                f"  alive {mix.get('alive')}")
+        ms = snap.get("metrics_stream") or {}
+        if ms:
+            out.append(f"stream: dropped_events {ms.get('dropped_events', 0)}"
+                       f"  rotations {ms.get('rotations', 0)}")
+
+    ck = state.last.get("checkpoint")
+    if ck is not None:
+        age = now - ck.get("ts", now)
+        where = ck.get("path", "?")
+        at = (f"step {ck['step']}" if "step" in ck
+              else f"epoch {ck.get('epoch', '?')}")
+        out.append(f"ckpt:   last at {at}, {age:.1f}s ago -> {where}")
+    return "\n".join(out)
+
+
+def summarize(events: List[dict], bad: int = 0, path: str = "",
+              now: Optional[float] = None) -> str:
+    """Render the summary text for one loaded event list."""
+    state = _TailState()
+    for rec in events:
+        state.add(rec)
+    state.bad = bad
+    return _render(state, path=path, now=now)
+
+
+def render_file(path: str, follow: bool = False,
+                interval: float = 2.0) -> int:
+    """Print the summary for ``path``; with ``follow`` re-render whenever
+    the file grows (Ctrl-C exits). Returns a process exit code.
+
+    Follow mode tails INCREMENTALLY: each tick reads only the appended
+    bytes, folds them into the bounded aggregates, and defers a partial
+    trailing line — a record mid-write is read whole on the next tick,
+    never counted as torn. A shrinking file (rotation by
+    ``HIVEMALL_TPU_METRICS_MAX_MB``) restarts the tail from zero."""
+    if not os.path.exists(path):
+        print(f"obs: {path}: no such file", file=sys.stderr)
+        return 1
+    if not follow:
+        events, bad = load_events(path)
+        print(summarize(events, bad, path=path))
+        return 0
+    state = _TailState()
+    offset = 0
+    ino = None
+    try:
+        while True:
+            try:
+                st = os.stat(path)
+            except FileNotFoundError:
+                # rotation window: MetricsStream._rotate has os.replace'd
+                # the file and not yet re-opened it — retry next tick
+                time.sleep(max(0.1, interval))
+                continue
+            size = st.st_size
+            # rotation = a FRESH file replaced the tailed one (inode
+            # change — size alone can't tell when the new file already
+            # grew past the old offset) or in-place truncation: restart
+            # from the head. Aggregates keep running across generations;
+            # a generation rotated fully away between polls is lost.
+            if ino is None:
+                ino = st.st_ino
+            elif st.st_ino != ino:
+                ino, offset = st.st_ino, 0
+            if size < offset:
+                offset = 0
+            if size > offset:
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        data = f.read()
+                except FileNotFoundError:  # rotated between stat and open
+                    time.sleep(max(0.1, interval))
+                    continue
+                nl = data.rfind(b"\n")
+                if nl >= 0:              # complete lines only; the torn
+                    offset += nl + 1     # tail waits for its newline
+                    state.feed_lines(data[:nl + 1])
+                    print(_render(state, path=path))
+                    print("-" * 60)
+            time.sleep(max(0.1, interval))
+    except KeyboardInterrupt:
+        return 0

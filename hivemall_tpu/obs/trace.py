@@ -1,0 +1,213 @@
+"""Span tracing — where did this step's wall time go.
+
+A :class:`Tracer` records named monotonic-clock spans into a thread-safe
+ring buffer plus per-stage aggregates, mirroring ``MetricsStream``'s
+contract: when disabled (the default) a span costs ONE attribute check and
+returns a shared no-op context manager — the hot path pays nothing.
+
+Span sites (the training pipeline's real seams — docs/OBSERVABILITY.md):
+
+========================  ===================================================
+``ingest.prep``           host batch prep (IngestPipeline worker fn, both
+                          the pool workers and the sequential fallback)
+``stager.stack``          K-step megabatch stacking (MegabatchStager)
+``h2d.stage``             host->device transfer (prefetch.stage_batch)
+``dispatch.step``         one jitted step dispatch (host-side boundary)
+``dispatch.megastep``     one fused K-step lax.scan dispatch
+``mix.exchange``          one MIX exchange incl. retries + fold-back
+``checkpoint.save``       one atomic bundle save
+========================  ===================================================
+
+Host-side semantics: a dispatch span measures the host's time in the
+dispatch call (on CPU that is the synchronous step; on accelerators it is
+dispatch latency — the async compute tail lands in the NEXT blocking
+boundary, exactly like the bench's stage decomposition). Rollups emit as
+``span_rollup`` jsonl events at the trainer's loss-fold cadence; the raw
+ring exports as Chrome-trace JSON (``chrome://tracing`` / Perfetto) for
+deep dives alongside ``jax.profiler``.
+
+Activation: ``HIVEMALL_TPU_TRACE=1`` enables the process tracer;
+``HIVEMALL_TPU_TRACE=/path/trace.json`` additionally writes the Chrome
+export there at ``train_done``. Or drive it explicitly via
+``get_tracer().enable()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+__all__ = ["Tracer", "get_tracer"]
+
+_RING = 8192          # completed spans kept for the Chrome export
+_RESERVOIR = 512      # per-stage duration reservoir for p50/p99
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disabled-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str):
+        self._tracer = tracer
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer._record(self.name, self.t0,
+                             time.perf_counter() - self.t0)
+        return False
+
+
+class _Stage:
+    __slots__ = ("count", "total_s", "durs")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.durs: deque = deque(maxlen=_RESERVOIR)
+
+
+def _pctl(sorted_durs, q: float) -> float:
+    return sorted_durs[min(len(sorted_durs) - 1,
+                           int(q * (len(sorted_durs) - 1) + 0.5))]
+
+
+class Tracer:
+    """Thread-safe span recorder with per-stage rollups.
+
+    Spans may complete concurrently on ingest workers, the prefetcher
+    thread, and the train loop; one lock guards the (cheap) aggregate
+    update. ``span()`` when disabled allocates nothing and takes no lock.
+    """
+
+    def __init__(self, enabled: bool = False, ring: int = _RING):
+        self.enabled = bool(enabled)
+        self.export_path: Optional[str] = None
+        self._lock = threading.Lock()
+        self._stages: Dict[str, _Stage] = {}
+        self._events: deque = deque(maxlen=max(1, ring))
+        self._origin = time.perf_counter()
+
+    # -- control -------------------------------------------------------------
+    def enable(self) -> "Tracer":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "Tracer":
+        self.enabled = False
+        return self
+
+    def reset(self) -> None:
+        """Drop all recorded spans and aggregates (tests, run boundaries)."""
+        with self._lock:
+            self._stages.clear()
+            self._events.clear()
+
+    # -- recording -----------------------------------------------------------
+    def span(self, name: str):
+        """Context manager timing one span. ~Free when disabled: one
+        attribute check, shared no-op object, no allocation."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name)
+
+    def _record(self, name: str, t0: float, dur: float) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            st = self._stages.get(name)
+            if st is None:
+                st = self._stages[name] = _Stage()
+            st.count += 1
+            st.total_s += dur
+            st.durs.append(dur)
+            self._events.append((name, t0, dur, tid))
+
+    # -- reading -------------------------------------------------------------
+    def rollup(self) -> Dict[str, dict]:
+        """Per-stage ``{count, total_s, p50, p99}`` (percentiles over the
+        last ``_RESERVOIR`` spans of each stage). JSON-ready; safe to call
+        from any thread while spans are being recorded."""
+        with self._lock:
+            items = [(name, st.count, st.total_s, list(st.durs))
+                     for name, st in self._stages.items()]
+        out: Dict[str, dict] = {}
+        for name, count, total, durs in sorted(items):
+            durs.sort()
+            out[name] = {
+                "count": count,
+                "total_s": round(total, 6),
+                "p50": round(_pctl(durs, 0.50), 6) if durs else 0.0,
+                "p99": round(_pctl(durs, 0.99), 6) if durs else 0.0,
+            }
+        return out
+
+    def export_chrome(self, path: str) -> str:
+        """Write the span ring as Chrome-trace JSON (``ph: "X"`` complete
+        events, microsecond timestamps) — open in chrome://tracing or
+        Perfetto. Returns ``path``."""
+        with self._lock:
+            events = list(self._events)
+        pid = os.getpid()
+        trace = {
+            "displayTimeUnit": "ms",
+            "traceEvents": [
+                {"name": name, "ph": "X", "cat": "hivemall_tpu",
+                 "ts": round((t0 - self._origin) * 1e6, 3),
+                 "dur": round(dur * 1e6, 3), "pid": pid, "tid": tid}
+                for name, t0, dur, tid in events
+            ],
+        }
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
+
+    def maybe_export(self) -> Optional[str]:
+        """Chrome export to ``export_path`` when configured (the
+        ``HIVEMALL_TPU_TRACE=<path>.json`` contract); never raises —
+        export is observability, not training."""
+        if not (self.enabled and self.export_path):
+            return None
+        try:
+            return self.export_chrome(self.export_path)
+        except OSError:
+            return None
+
+
+_tracer: Optional[Tracer] = None
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer, bound to ``$HIVEMALL_TPU_TRACE`` on first
+    use (unset/"0" = disabled; a ``*.json`` value doubles as the Chrome
+    export path) and registered as the obs registry's ``spans`` section."""
+    global _tracer
+    if _tracer is None:
+        env = os.environ.get("HIVEMALL_TPU_TRACE", "")
+        t = Tracer(enabled=bool(env) and env != "0")
+        if env.endswith(".json"):
+            t.export_path = env
+        _tracer = t
+        from .registry import registry
+        registry.register("spans", t.rollup)
+    return _tracer

@@ -37,10 +37,13 @@ import socket
 import struct
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..obs.trace import get_tracer
 
 __all__ = ["MixServer", "MixClient", "MixMessage", "EVENT_AVERAGE",
            "EVENT_ARGMIN_KLD", "EVENT_CLOSEGROUP", "EVENT_STATS",
@@ -374,6 +377,16 @@ class MixServer:
         self._thread.start()
         if not self._started.wait(5):
             raise RuntimeError("mix server failed to start")
+        # obs registry section — the JMX-bean analog, also reachable over
+        # HTTP via -obs_port (weakly held: a stopped server must be
+        # collectable)
+        from ..obs.registry import registry
+        ref = weakref.ref(self)
+
+        def _obs() -> Dict[str, float]:
+            srv = ref()                 # single deref: the server may be
+            return srv.counters() if srv is not None else {}   # collected
+        registry.register("mix_server", _obs)
         return self
 
     def stop(self) -> None:
@@ -551,6 +564,14 @@ class MixClient:
                 self.dropped_exchanges += 1      # breaker open: skip cheap
                 return
             probing = True                       # half-open: one attempt
+        # the whole exchange window — gather, wire round-trips incl.
+        # retries/backoff, fold-back — is ONE ``mix.exchange`` span: what
+        # the fit loop actually pays per exchange (a faulted exchange's
+        # span is its retry budget, which is exactly the number to watch)
+        with get_tracer().span("mix.exchange"):
+            self._exchange_window(trainer, probing)
+
+    def _exchange_window(self, trainer, probing: bool) -> None:
         keys = np.fromiter(self._touched, np.int64)
         self._touched.clear()
         w_at = trainer._get_weights_at(keys)

@@ -22,6 +22,7 @@ import json
 import os
 import socket
 import sys
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, IO, Optional
@@ -58,18 +59,44 @@ class MetricsStream:
 
     Records carry {ts, host, pid, event, ...fields}. Failure to write is
     swallowed after disabling the stream — observability must never take
-    training down (the reference's counters are likewise fire-and-forget).
+    training down (the reference's counters are likewise fire-and-forget) —
+    but every event lost that way is COUNTED (``dropped_events``) and
+    surfaced through the obs registry's ``metrics_stream`` section, so a
+    silent disk-full at hour 3 of a soak shows up in the snapshot instead
+    of as a mysteriously short file.
+
+    Thread-safety: emits may arrive from the train loop, ingest workers,
+    and the prefetcher thread at once; one lock serializes the write so
+    lines are never interleaved/torn (json encoding happens outside it).
+
+    Rotation: ``HIVEMALL_TPU_METRICS_MAX_MB=<float>`` bounds an owned-file
+    sink for long soaks — past the limit the file rotates to ``<path>.1``
+    (one generation, overwriting the previous) and a fresh file continues.
     """
 
     def __init__(self, sink: "str | IO[str] | None"):
         self._fh: Optional[IO[str]] = None
         self._own = False
+        self._path: Optional[str] = None
+        self._failed = False             # write failure disabled the stream
+        self.dropped_events = 0          # events lost to failures post-open
+        self.rotations = 0
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._max_bytes = 0
+        try:
+            mb = float(os.environ.get("HIVEMALL_TPU_METRICS_MAX_MB") or 0)
+            self._max_bytes = int(mb * 1e6) if mb > 0 else 0
+        except ValueError:
+            pass
         if sink == "-":
             self._fh = sys.stderr
         elif isinstance(sink, str):
             try:
                 self._fh = open(sink, "a", buffering=1)
                 self._own = True
+                self._path = sink
+                self._bytes = os.path.getsize(sink)
             except OSError as e:            # fail soft: bad path must not
                 print(f"hivemall_tpu: metrics sink {sink!r} unusable ({e}); "
                       "metrics disabled", file=sys.stderr)
@@ -84,23 +111,83 @@ class MetricsStream:
 
     def emit(self, event: str, **fields: Any) -> None:
         if self._fh is None:
+            if self._failed:             # disabled BY failure: count the loss
+                self.dropped_events += 1
             return
         rec: Dict[str, Any] = {"ts": round(time.time(), 3),
                                "host": self._host, "pid": self._pid,
                                "event": event}
         rec.update(fields)
         try:
-            self._fh.write(json.dumps(rec) + "\n")
+            # default=str: registry providers are a public surface and a
+            # numpy scalar slipping into a counter dict must degrade to a
+            # stringified value, never take training down mid-emit
+            line = json.dumps(rec, default=str) + "\n"
+        except (TypeError, ValueError):    # circular refs etc.: drop it
+            self.dropped_events += 1
+            return
+        with self._lock:
+            if self._fh is None:         # lost a race with a failing writer
+                self.dropped_events += 1
+                return
+            try:
+                self._fh.write(line)
+            except (OSError, ValueError):
+                self._fh = None          # fail soft, never raise mid-train
+                self._failed = True
+                self.dropped_events += 1
+                return
+            self._bytes += len(line)
+            if (self._max_bytes and self._own and self._path
+                    and self._bytes >= self._max_bytes):
+                self._rotate()
+
+    def _rotate(self) -> None:
+        """Size-based rotation (lock held): current file -> <path>.1
+        (replacing the previous generation), fresh file continues. Any
+        failure degrades to the fail-soft disable, counted as a drop."""
+        try:
+            self._fh.close()
+            os.replace(self._path, self._path + ".1")
+            self._fh = open(self._path, "a", buffering=1)
+            self._bytes = 0
+            self.rotations += 1
         except OSError:
-            self._fh = None               # fail soft, never raise mid-train
+            self._fh = None
+            self._failed = True
+            self.dropped_events += 1
+
+    def counters(self) -> Dict[str, Any]:
+        """Health surface for the obs registry (``metrics_stream``)."""
+        return {"enabled": self.enabled, "dropped_events": self.dropped_events,
+                "rotations": self.rotations, "path": self._path}
 
     def close(self) -> None:
-        if self._own and self._fh is not None:
-            self._fh.close()
-        self._fh = None
+        with self._lock:
+            if self._own and self._fh is not None:
+                self._fh.close()
+            self._fh = None
 
 
 _stream: Optional[MetricsStream] = None
+
+
+def _stream_counters() -> Dict[str, Any]:
+    # reads the module global so monkeypatched/replaced streams are the
+    # ones reported (tests and obs.smoke install streams by assigning
+    # M._stream directly, never calling get_stream)
+    return _stream.counters() if _stream is not None else {}
+
+
+def _register_stream_section() -> None:
+    # at import, not inside get_stream(): the section must exist no
+    # matter HOW the stream is installed (env-bound via get_stream, or
+    # direct module-global assignment)
+    from ..obs.registry import registry
+    registry.register("metrics_stream", _stream_counters)
+
+
+_register_stream_section()
 
 
 def get_stream() -> MetricsStream:

@@ -13,6 +13,14 @@ env -u PALLAS_AXON_POOL_IPS -u JAX_PLATFORMS \
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.testing.faults --smoke || exit $?
 
+# observability smoke (docs/OBSERVABILITY.md): a seconds-scale traced fit
+# must produce a parseable jsonl stream with train_step/train_done/
+# span_rollup events, a registry snapshot carrying every subsystem
+# section, a working `hivemall_tpu obs` render, and per-step tracing
+# overhead within 5% of tracing disabled (min over alternating pairs).
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m hivemall_tpu.obs.smoke || exit $?
+
 # bench harness smoke: tiny-shape runs of the ingest-path benches assert
 # every metric still emits and parses (pipeline refactors must not silently
 # break bench.py), and the dispatch-fusion microbench enforces its floor —

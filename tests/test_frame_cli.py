@@ -68,8 +68,10 @@ def test_cli_train_predict_roundtrip(tmp_path, capsys):
                "--model", model_p])
     assert rc == 0
     train_out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    # examples counts PROCESSED rows: 400 input rows x -iters 3 epochs
-    assert train_out["examples"] == 1200
+    # the final record is the obs-registry snapshot; the run summary is
+    # its `run` section. examples counts PROCESSED rows: 400 x -iters 3
+    assert train_out["run"]["examples"] == 1200
+    assert "pipeline" in train_out and "train" in train_out
 
     rc = _cli(["predict", "--algo", "train_classifier", "--model", model_p,
                "--input", train_p, "--output", out_p,
@@ -128,7 +130,8 @@ def test_cli_train_bundle_resume(tmp_path, capsys):
     rc = _cli(["train", "--algo", "train_classifier", "--input", train_p,
                "--options", opts, "--save-bundle", bundle_p])
     assert rc == 0 and json.loads(
-        capsys.readouterr().out.strip().splitlines()[-1])["examples"] == 200
+        capsys.readouterr().out.strip().splitlines()[-1]
+    )["run"]["examples"] == 200
 
     rc = _cli(["train", "--algo", "train_classifier", "--input", train_p,
                "--options", opts, "--load-bundle", bundle_p,
@@ -206,5 +209,5 @@ def test_cli_train_from_parquet_shard_dir(tmp_path, capsys):
                "-eta0 0.3 -mini_batch 64 -iters 2"])
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert out["examples"] == 600          # 300 rows x 2 epochs
-    assert np.isfinite(out["cumulative_loss"])
+    assert out["run"]["examples"] == 600   # 300 rows x 2 epochs
+    assert np.isfinite(out["run"]["cumulative_loss"])

@@ -1,0 +1,467 @@
+"""Unified telemetry tests (docs/OBSERVABILITY.md): span tracer, central
+registry, Prometheus/HTTP surface, metrics-stream hardening, and the
+under-concurrency guarantees — spans from multi-worker ingest and faulted
+MIX exchanges are complete, the jsonl stream is never torn, and the
+registry snapshot stays stable while a fit is running."""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import hivemall_tpu.utils.metrics as M
+from hivemall_tpu.io.sparse import SparseBatch
+from hivemall_tpu.models.linear import GeneralClassifier
+from hivemall_tpu.obs.http import ObsServer, to_prometheus
+from hivemall_tpu.obs.registry import Registry, registry
+from hivemall_tpu.obs.trace import Tracer, get_tracer
+
+
+@pytest.fixture
+def tracer():
+    """The process tracer, enabled and reset for one test, always left
+    disabled+clean (it is process-global)."""
+    t = get_tracer()
+    t.reset()
+    t.enable()
+    yield t
+    t.disable()
+    t.reset()
+
+
+def _batches(n, bs=16, dims=256, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        idx = rng.integers(1, dims, (bs, 4)).astype(np.int32)
+        val = rng.normal(size=(bs, 4)).astype(np.float32)
+        lab = (rng.integers(0, 2, bs) * 2 - 1).astype(np.float32)
+        out.append(SparseBatch(idx, val, lab))
+    return out
+
+
+# --- Tracer ----------------------------------------------------------------
+
+def test_tracer_disabled_is_noop():
+    t = Tracer(enabled=False)
+    s1, s2 = t.span("a"), t.span("b")
+    assert s1 is s2                     # shared null object, no allocation
+    with s1:
+        pass
+    assert t.rollup() == {}
+
+
+def test_tracer_records_rollup_percentiles():
+    t = Tracer(enabled=True)
+    for dur in (0.001, 0.002, 0.003):
+        with t.span("stage"):
+            time.sleep(dur)
+    r = t.rollup()
+    assert set(r) == {"stage"}
+    st = r["stage"]
+    assert st["count"] == 3
+    assert st["total_s"] >= 0.006
+    assert 0 < st["p50"] <= st["p99"]
+    t.reset()
+    assert t.rollup() == {}
+
+
+def test_tracer_thread_safe_recording():
+    t = Tracer(enabled=True)
+
+    def work():
+        for _ in range(200):
+            with t.span("conc"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert t.rollup()["conc"]["count"] == 800
+
+
+def test_tracer_chrome_export(tmp_path):
+    t = Tracer(enabled=True)
+    with t.span("exported"):
+        pass
+    p = str(tmp_path / "trace.json")
+    assert t.export_chrome(p) == p
+    trace = json.loads(open(p).read())
+    evs = trace["traceEvents"]
+    assert evs and evs[0]["name"] == "exported" and evs[0]["ph"] == "X"
+    assert evs[0]["dur"] >= 0 and "ts" in evs[0]
+
+
+def test_tracer_ring_is_bounded():
+    t = Tracer(enabled=True, ring=8)
+    for _ in range(100):
+        with t.span("r"):
+            pass
+    assert len(t._events) == 8          # ring, not unbounded growth
+    assert t.rollup()["r"]["count"] == 100   # aggregates keep the truth
+
+
+# --- Registry --------------------------------------------------------------
+
+def test_registry_snapshot_merges_and_overrides():
+    r = Registry()
+    r.register("a", lambda: {"x": 1})
+    r.register("a", lambda: {"x": 2})   # last wins
+    r.register("b", lambda: {"y": True})
+    snap = r.snapshot()
+    assert snap["a"] == {"x": 2} and snap["b"] == {"y": True}
+    assert "ts" in snap
+    r.unregister("a")
+    assert "a" not in r.snapshot()
+
+
+def test_registry_provider_failure_is_isolated():
+    r = Registry()
+    r.register("bad", lambda: 1 / 0)
+    r.register("good", lambda: {"ok": 1})
+    snap = r.snapshot()
+    assert snap["good"] == {"ok": 1}
+    assert "ZeroDivisionError" in snap["bad"]["error"]
+
+
+def test_global_registry_has_default_sections():
+    snap = registry.snapshot()
+    assert "mix" in snap and "checkpoint" in snap
+
+
+def test_trainer_registers_pipeline_and_train_sections():
+    tr = GeneralClassifier("-dims 128 -mini_batch 8")
+    tr.fit_stream(iter(_batches(4, bs=8, dims=128)))
+    snap = registry.snapshot()
+    assert snap["train"]["trainer"] == "train_classifier"
+    assert snap["train"]["step"] == 4
+    assert snap["pipeline"]["batches_prepared"] == 4
+
+
+def test_new_trainer_resets_mix_and_checkpoint_sections(tmp_path):
+    """A later trainer without a mixer/autosaver must not inherit a still-
+    alive earlier trainer's mix/checkpoint sections — construction is the
+    reset (last-wins registration, every section trainer-bound)."""
+    from hivemall_tpu.parallel.mix_service import MixServer
+    srv = MixServer().start()
+    try:
+        a = GeneralClassifier(
+            f"-dims 64 -mini_batch 8 -mix 127.0.0.1:{srv.port} "
+            f"-mix_threshold 1 -mix_timeout 0.3 "
+            f"-checkpoint_dir {tmp_path / 'ck'} -checkpoint_every 2")
+        a.fit_stream(iter(_batches(4, bs=8, dims=64)))
+        snap = registry.snapshot()
+        assert snap["mix"]["active"] is True
+        assert snap["checkpoint"]["configured"] is True
+        b = GeneralClassifier("-dims 64 -mini_batch 8")   # a stays alive
+        snap = registry.snapshot()
+        assert snap["mix"] == {"active": False}
+        assert snap["checkpoint"] == {"configured": False}
+        assert a is not b                                 # keep a referenced
+        a._mixer.close_group()
+    finally:
+        srv.stop()
+
+
+# --- Prometheus / HTTP surface ---------------------------------------------
+
+def test_to_prometheus_exposition_format():
+    text = to_prometheus({"ts": 1.5,
+                          "pipeline": {"batches": 3, "busy_s": 0.25,
+                                       "name": "skipped-string"},
+                          "train": {"examples": 44776121,
+                                    "ts": 1754180000.123},
+                          "mix": {"alive": True,
+                                  "nested": {"deep": 7}}})
+    lines = text.splitlines()
+    assert text.endswith("\n")
+    assert "hivemall_tpu_pipeline_batches 3" in lines
+    assert "hivemall_tpu_pipeline_busy_s 0.25" in lines
+    assert "hivemall_tpu_mix_alive 1" in lines
+    assert "hivemall_tpu_mix_nested_deep 7" in lines
+    # full precision: %g-style 6-sig-digit truncation would corrupt
+    # large counters and epoch timestamps
+    assert "hivemall_tpu_train_examples 44776121" in lines
+    assert "hivemall_tpu_train_ts 1754180000.123" in lines
+    assert not any("skipped-string" in l or "name" in l for l in lines)
+    # exposition validity: every non-comment line is `name value`
+    metric = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]* -?[0-9.eE+-]+$")
+    for l in lines:
+        assert l.startswith("# TYPE ") or metric.match(l), l
+
+
+def test_obs_http_server_snapshot_and_metrics():
+    r = Registry()
+    r.register("unit", lambda: {"value": 42})
+    srv = ObsServer(0, obs_registry=r).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        snap = json.loads(urllib.request.urlopen(f"{base}/snapshot",
+                                                 timeout=5).read())
+        assert snap["unit"]["value"] == 42
+        resp = urllib.request.urlopen(f"{base}/metrics", timeout=5)
+        assert "text/plain" in resp.headers["Content-Type"]
+        assert "hivemall_tpu_unit_value 42" in resp.read().decode()
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        srv.stop()
+
+
+def test_obs_http_idle_connection_cannot_wedge_server():
+    """A client that connects and never sends a request (half-open TCP,
+    port scanner) must not block the single-threaded server forever —
+    the handler timeout closes it and the next scrape succeeds."""
+    import socket
+    r = Registry()
+    r.register("unit", lambda: {"value": 1})
+    srv = ObsServer(0, obs_registry=r).start()
+    srv._httpd.RequestHandlerClass.timeout = 0.3   # keep the test fast
+    try:
+        idle = socket.create_connection(("127.0.0.1", srv.port))
+        try:
+            snap = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/snapshot", timeout=10).read())
+            assert snap["unit"]["value"] == 1
+        finally:
+            idle.close()
+    finally:
+        srv.stop()
+
+
+# --- MetricsStream hardening -----------------------------------------------
+
+class _FailingIO:
+    """IO stub whose write starts failing after ``ok`` successes."""
+
+    def __init__(self, ok: int):
+        self.ok = ok
+        self.lines = []
+
+    def write(self, s):
+        if self.ok <= 0:
+            raise OSError("disk full")
+        self.ok -= 1
+        self.lines.append(s)
+
+
+def test_stream_counts_dropped_events_after_write_failure():
+    io = _FailingIO(ok=2)
+    s = M.MetricsStream(io)
+    s.emit("a")
+    s.emit("b")
+    assert s.dropped_events == 0 and len(io.lines) == 2
+    s.emit("c")                          # write fails -> disable + count
+    assert not s.enabled and s.dropped_events == 1
+    s.emit("d")                          # post-disable emits keep counting
+    s.emit("e")
+    assert s.dropped_events == 3
+    assert s.counters()["dropped_events"] == 3
+
+
+def test_stream_never_counts_drops_when_deliberately_disabled():
+    s = M.MetricsStream(None)
+    s.emit("a")
+    assert s.dropped_events == 0
+
+
+def test_stream_size_rotation(tmp_path, monkeypatch):
+    monkeypatch.setenv("HIVEMALL_TPU_METRICS_MAX_MB", "0.0005")  # 500 bytes
+    p = str(tmp_path / "m.jsonl")
+    s = M.MetricsStream(p)
+    for i in range(40):
+        s.emit("ev", i=i, pad="x" * 64)
+    s.close()
+    assert s.rotations >= 1
+    assert os.path.exists(p + ".1")
+    # every surviving line in both generations is intact jsonl
+    for path in (p, p + ".1"):
+        for line in open(path):
+            assert json.loads(line)["event"] == "ev"
+
+
+# --- telemetry emission from the fit loop ----------------------------------
+
+def test_telemetry_every_and_train_done_snapshot(tmp_path, monkeypatch):
+    p = tmp_path / "t.jsonl"
+    monkeypatch.setattr(M, "_stream", M.MetricsStream(str(p)))
+    tr = GeneralClassifier("-dims 128 -mini_batch 8 -telemetry_every 4")
+    tr.fit_stream(iter(_batches(10, bs=8, dims=128)))
+    M._stream.close()
+    recs = [json.loads(l) for l in p.read_text().splitlines()]
+    tele = [r for r in recs if r["event"] == "telemetry"]
+    assert len(tele) == 2                # steps 4 and 8 of 10
+    assert all("pipeline" in r["snapshot"] and "train" in r["snapshot"]
+               for r in tele)
+    done = [r for r in recs if r["event"] == "train_done"]
+    assert len(done) == 1
+    for section in ("pipeline", "train", "mix", "checkpoint", "spans"):
+        assert section in done[0]["telemetry"]
+
+
+def test_ffm_multi_epoch_stream_emits_one_train_done(tmp_path, monkeypatch):
+    """FFM's multi-epoch fit_stream wrapper runs one base fit_stream per
+    epoch; the run must still report exactly ONE train_done record."""
+    from hivemall_tpu.models.fm import FFMTrainer
+    p = tmp_path / "ffm.jsonl"
+    monkeypatch.setattr(M, "_stream", M.MetricsStream(str(p)))
+    rng = np.random.default_rng(5)
+
+    def epoch():
+        for _ in range(4):
+            idx = rng.integers(1, 64, (8, 4)).astype(np.int32)
+            fld = np.tile(np.arange(4, dtype=np.int32), (8, 1))
+            lab = (rng.integers(0, 2, 8) * 2 - 1).astype(np.float32)
+            yield SparseBatch(idx, np.ones((8, 4), np.float32), lab, fld)
+
+    tr = FFMTrainer("-dims 64 -factors 2 -fields 4 -classification "
+                    "-mini_batch 8")
+    tr.fit_stream(epoch, epochs=3)
+    M._stream.close()
+    recs = [json.loads(l) for l in p.read_text().splitlines()]
+    done = [r for r in recs if r["event"] == "train_done"]
+    assert len(done) == 1
+    assert done[0]["step"] == tr._t      # the FINAL step, all epochs in
+
+
+def test_span_rollup_emitted_at_fold_cadence(tmp_path, monkeypatch, tracer):
+    p = tmp_path / "r.jsonl"
+    monkeypatch.setattr(M, "_stream", M.MetricsStream(str(p)))
+    tr = GeneralClassifier("-dims 128 -mini_batch 8")
+    tr.fit_stream(iter(_batches(260, bs=8, dims=128)))
+    M._stream.close()
+    recs = [json.loads(l) for l in p.read_text().splitlines()]
+    rolls = [r for r in recs if r["event"] == "span_rollup"]
+    assert len(rolls) == 1               # one 256-step boundary crossed
+    stages = rolls[0]["stages"]
+    assert stages["dispatch.step"]["count"] >= 256
+    assert stages["ingest.prep"]["count"] >= 256
+    assert {"count", "total_s", "p50", "p99"} <= set(
+        stages["dispatch.step"])
+
+
+def test_epoch_checkpoint_event_via_shared_helper(tmp_path, monkeypatch):
+    """Both epoch-bundle sites (base + fm adareg) now ride
+    _save_epoch_bundle/_emit_checkpoint_event; the event schema is one."""
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    p = tmp_path / "c.jsonl"
+    monkeypatch.setattr(M, "_stream", M.MetricsStream(str(p)))
+    ds, _ = synthetic_classification(64, 16, seed=3)
+    ck = str(tmp_path / "ck")
+    tr = GeneralClassifier(f"-dims 128 -mini_batch 16 -iters 2 "
+                           f"-checkpoint_dir {ck}")
+    tr.fit(ds)
+    M._stream.close()
+    recs = [json.loads(l) for l in p.read_text().splitlines()]
+    cks = [r for r in recs if r["event"] == "checkpoint"]
+    assert [r["epoch"] for r in cks] == [1, 2]
+    assert all(r["trainer"] == "train_classifier" and "path" in r
+               for r in cks)
+
+
+# --- concurrency: the live-surface guarantees ------------------------------
+
+def test_concurrent_workers_spans_and_stable_snapshot(tmp_path, monkeypatch,
+                                                      tracer):
+    """Spans from ingest_workers>1 pipeline workers land complete, the
+    jsonl stream has no interleaved/torn lines, and registry.snapshot()
+    called from another thread DURING the fit never fails or blocks."""
+    p = tmp_path / "conc.jsonl"
+    monkeypatch.setattr(M, "_stream", M.MetricsStream(str(p)))
+    tr = GeneralClassifier("-dims 256 -mini_batch 16 -ingest_workers 3")
+    stop = threading.Event()
+    snaps, errors = [], []
+
+    def poll():
+        while not stop.is_set():
+            try:
+                snaps.append(registry.snapshot())
+            except Exception as e:      # noqa: BLE001 — the assertion
+                errors.append(e)
+            time.sleep(0.002)
+
+    poller = threading.Thread(target=poll)
+    poller.start()
+    try:
+        tr.fit_stream(iter(_batches(300, bs=16, dims=256)))
+    finally:
+        stop.set()
+        poller.join()
+    M._stream.close()
+    assert not errors
+    assert len(snaps) > 2
+    assert all("pipeline" in s and "spans" in s for s in snaps)
+    # every line written under concurrency parses — no torn writes
+    recs = [json.loads(l) for l in p.read_text().splitlines()]
+    assert {"train_step", "span_rollup", "train_done"} <= \
+        {r["event"] for r in recs}
+    roll = tr._tracer.rollup()
+    assert roll["ingest.prep"]["count"] == 300     # every worker span landed
+    assert roll["dispatch.step"]["count"] == 300
+
+
+def test_mix_exchange_spans_under_faults(tracer):
+    """FlakyProxy-faulted MIX exchanges still record complete
+    mix.exchange spans (one per exchange window, faults absorbed inside),
+    and the registry's mix section tracks the client."""
+    from hivemall_tpu.parallel.mix_service import MixServer
+    from hivemall_tpu.testing.faults import FlakyProxy
+    srv = MixServer().start()
+    proxy = FlakyProxy(("127.0.0.1", srv.port),
+                       schedule={1: "rst", 3: "drop"}).start()
+    try:
+        clf = GeneralClassifier(
+            f"-dims 32 -mini_batch 4 -eta fixed -eta0 0.5 -reg no "
+            f"-mix 127.0.0.1:{proxy.port} -mix_threshold 1 "
+            f"-mix_timeout 0.3 -mix_backoff 0.01")
+        for _ in range(12):
+            clf.process(["1:1.0"], 1)
+            clf.process(["2:1.0"], -1)
+            clf._flush()
+        roll = tracer.rollup()
+        assert roll["mix.exchange"]["count"] == clf._mixer.exchanges
+        assert clf._mixer.exchanges > 0
+        assert proxy.faults_applied >= 1          # the faults really fired
+        snap = registry.snapshot()
+        assert snap["mix"]["active"] is True
+        assert snap["mix"]["exchanges"] == clf._mixer.exchanges
+        clf._mixer.close_group()
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+# --- obs CLI ---------------------------------------------------------------
+
+def test_obs_cli_renders_stream(tmp_path, capsys):
+    from hivemall_tpu.cli.main import main
+    p = tmp_path / "s.jsonl"
+    lines = [
+        {"ts": 1.0, "event": "train_step", "trainer": "t", "step": 256,
+         "examples": 4096, "examples_per_sec": 100.0, "avg_loss": 0.5},
+        {"ts": 2.0, "event": "span_rollup", "trainer": "t", "step": 256,
+         "stages": {"dispatch.step": {"count": 256, "total_s": 1.0,
+                                      "p50": 0.004, "p99": 0.01}}},
+        {"ts": 3.0, "event": "checkpoint", "trainer": "t", "step": 256,
+         "path": "/tmp/x.npz"},
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in lines)
+                 + "\n{torn-line")
+    assert main(["obs", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "train_step x1" in out
+    assert "dispatch.step" in out
+    assert "unparsable" in out           # the torn tail is counted, not fatal
+    assert "ckpt:" in out
+
+
+def test_obs_cli_missing_file(capsys):
+    from hivemall_tpu.cli.main import main
+    assert main(["obs", "/nonexistent/x.jsonl"]) == 1
