@@ -465,6 +465,90 @@ def bench_dispatch_fusion(n_batches: int = 512, smoke: bool = False) -> dict:
     }
 
 
+def bench_serve(n_requests: int = 2000, concurrency: int = 8,
+                smoke: bool = False) -> dict:
+    """Online-serving throughput/latency microbench (docs/SERVING.md):
+    in-process PredictEngine + MicroBatcher (no HTTP socket noise — the
+    serve smoke covers that layer), ``concurrency`` client threads each
+    submitting pre-parsed single-row requests as fast as responses come
+    back. Emits request qps (primary), p50/p99 per-request milliseconds,
+    and the observed mean coalesced batch size — the number that shows
+    dynamic micro-batching actually amortizing dispatch."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import numpy as np
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    from hivemall_tpu.models.linear import GeneralClassifier
+    from hivemall_tpu.serve.batcher import MicroBatcher
+    from hivemall_tpu.serve.engine import PredictEngine
+
+    if smoke:
+        n_requests, concurrency = 300, 4
+    dims = 1 << 12 if smoke else 1 << 18
+    opts = f"-dims {dims} -loss logloss -opt adagrad -mini_batch 128"
+    ds, _ = synthetic_classification(1024, 200, seed=13)
+    tmp = tempfile.mkdtemp(prefix="hivemall_tpu_bench_serve_")
+    try:
+        t = GeneralClassifier(opts)
+        t.fit(ds)
+        path = os.path.join(tmp, f"{t.NAME}-step{t._t:010d}.npz")
+        t.save_bundle(path)
+        engine = PredictEngine("train_classifier", opts, bundle=path,
+                               warmup_len=ds.max_row_len)
+        parsed = [engine.parse(
+            [f"{int(a)}:{float(v)!r}" for a, v in zip(*ds.row(i))])
+            for i in range(256)]
+        batcher = MicroBatcher(engine.predict_rows, max_batch=256,
+                               max_delay_ms=1.0)
+        lat = np.zeros(n_requests, np.float64)
+        nxt = iter(range(n_requests))
+        lock = threading.Lock()
+
+        def client():
+            while True:
+                with lock:
+                    i = next(nxt, None)
+                if i is None:
+                    return
+                t0 = time.perf_counter()
+                batcher.submit([parsed[i % len(parsed)]]).result(30)
+                lat[i] = time.perf_counter() - t0
+
+        # warm the serve path end to end before timing
+        batcher.submit([parsed[0]]).result(30)
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client)
+                   for _ in range(concurrency)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        dt = time.perf_counter() - t0
+        st = batcher.stats()
+        batcher.close()
+        engine.close()
+        return {
+            "metric": "serve_qps",
+            "value": round(n_requests / dt, 1),
+            "value_median": round(n_requests / dt, 1),
+            "unit": "requests/sec",
+            "p50_ms": round(float(np.percentile(lat * 1000, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat * 1000, 99)), 3),
+            "concurrency": concurrency,
+            "mean_batch_rows": st["mean_batch_rows"],
+            "batches": st["batches"],
+            "shed": st["shed"],
+            "dims": dims,
+            "note": "single-row requests through the dynamic "
+                    "micro-batcher; mean_batch_rows > 1 = coalescing "
+                    "amortizing dispatch",
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_linear(n_steps: int = 60, warmup: int = 8) -> dict:
     """BASELINE config #1 shape: train_classifier AdaGrad logloss."""
     import numpy as np
@@ -950,7 +1034,7 @@ def bench_topk_knn() -> dict:
 
 _BENCHES = ("bench_linear", "bench_ffm_kernel", "bench_ffm_e2e",
             "bench_ffm_parquet_stream", "bench_ingest",
-            "bench_dispatch_fusion", "bench_fm",
+            "bench_dispatch_fusion", "bench_serve", "bench_fm",
             "bench_mf", "bench_word2vec", "bench_trees", "bench_gbt",
             "bench_seq_exact", "bench_mix", "bench_lda",
             "bench_changefinder", "bench_topk_knn")
@@ -1048,6 +1132,7 @@ _SMOKE = (
     ("bench_ffm_e2e", {"n_rows": 512, "smoke": True}),
     ("bench_ffm_parquet_stream", {"n_rows": 512, "smoke": True}),
     ("bench_dispatch_fusion", {"n_batches": 24, "smoke": True}),
+    ("bench_serve", {"smoke": True}),
 )
 
 # bench_ffm_e2e stage-metric keys the smoke run requires (the acceptance
@@ -1091,6 +1176,12 @@ def main_smoke() -> int:
                 assert any(spans.get(s, {}).get("count", 0) > 0
                            for s in ("dispatch.step", "dispatch.megastep")), \
                     f"no dispatch spans in registry rollup: {spans}"
+            if name == "bench_serve":
+                # the serving acceptance keys (docs/SERVING.md): latency
+                # percentiles present and nothing shed at smoke load
+                assert rec["value"] > 0 and rec["p50_ms"] > 0 \
+                    and rec["p99_ms"] >= rec["p50_ms"], rec
+                assert rec["shed"] == 0, rec
             if name == "bench_dispatch_fusion":
                 # the defusion floor (PR 2): fused K=8 dispatch must not
                 # run slower than per-batch K=1 — run_tests.sh fails on

@@ -254,6 +254,41 @@ def _cmd_mixserv(args) -> int:
                  "python", bool(ctx))
 
 
+def _cmd_serve(args) -> int:
+    """Online prediction server (docs/SERVING.md): load a checkpoint
+    bundle, serve /predict with dynamic micro-batching, hot-reload newer
+    autosaved bundles from --checkpoint-dir (a live trainer writing into
+    the same directory is the intended pairing)."""
+    from ..serve.engine import PredictEngine
+    from ..serve.http import PredictServer
+
+    try:
+        engine = PredictEngine(
+            args.algo, args.options or "",
+            bundle=args.bundle, checkpoint_dir=args.checkpoint_dir,
+            max_batch=args.serve_max_batch,
+            watch_interval=args.watch_interval,
+            warmup=not args.no_warmup)
+    except (FileNotFoundError, ValueError, NotImplementedError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    srv = PredictServer(
+        engine, host=args.host, port=args.port,
+        max_delay_ms=args.serve_max_delay_ms,
+        max_queue_rows=args.serve_max_queue,
+        deadline_ms=args.serve_deadline_ms).start()
+    print(json.dumps({"host": srv.host, "port": srv.port,
+                      "algo": args.algo,
+                      "model_step": engine.model_step,
+                      "model_path": engine.model_path}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
 def _cmd_obs(args) -> int:
     """Live-run summary off a metrics jsonl (docs/OBSERVABILITY.md): event
     counts, training rate, span stage breakdown, MIX breaker state,
@@ -328,6 +363,43 @@ def main(argv=None) -> int:
                    help="native = C++ epoll server (no TLS), python = "
                         "asyncio, auto = native when available")
     m.set_defaults(fn=_cmd_mixserv)
+
+    sv = sub.add_parser(
+        "serve", help="online prediction server over a checkpoint bundle "
+                      "(dynamic micro-batching + hot reload; "
+                      "docs/SERVING.md)")
+    sv.add_argument("--algo", required=True,
+                    help="catalog trainer the bundle was written by")
+    sv.add_argument("--options", default="",
+                    help="trainer options (must match the training config "
+                         "— table shapes are validated at load)")
+    sv.add_argument("--checkpoint-dir", default=None,
+                    help="directory of autosaved step bundles to serve "
+                         "and watch for hot reload (may be the live "
+                         "trainer's -checkpoint_dir)")
+    sv.add_argument("--bundle", default=None,
+                    help="explicit bundle (.npz) to serve instead of the "
+                         "newest in --checkpoint-dir")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8080)
+    sv.add_argument("--serve-max-batch", type=int, default=256,
+                    help="max rows coalesced into one predict dispatch")
+    sv.add_argument("--serve-max-delay-ms", type=float, default=2.0,
+                    help="max milliseconds a request waits for batch "
+                         "coalescing")
+    sv.add_argument("--serve-max-queue", type=int, default=None,
+                    help="bounded queue size in rows (default "
+                         "8x max-batch); submits past it are shed with "
+                         "503")
+    sv.add_argument("--serve-deadline-ms", type=float, default=0.0,
+                    help="default per-request deadline (0 = none); "
+                         "expired requests get 504")
+    sv.add_argument("--watch-interval", type=float, default=2.0,
+                    help="seconds between hot-reload checkpoint-dir polls")
+    sv.add_argument("--no-warmup", action="store_true",
+                    help="skip pre-compiling the batch-size buckets at "
+                         "startup")
+    sv.set_defaults(fn=_cmd_serve)
 
     o = sub.add_parser(
         "obs", help="summarize a HIVEMALL_TPU_METRICS jsonl stream "

@@ -30,14 +30,15 @@ import os
 import re
 import time
 import weakref
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ..obs.trace import get_tracer
 
-__all__ = ["save_bundle", "load_bundle", "CheckpointManager", "list_bundles"]
+__all__ = ["save_bundle", "load_bundle", "CheckpointManager", "list_bundles",
+           "bundle_step", "newest_bundle"]
 
 _FORMAT = 2          # 2 adds the digest manifest + stream position
 _STEP_RE = re.compile(r"-step(\d+)\.npz$")
@@ -192,6 +193,29 @@ def list_bundles(checkpoint_dir: str, name: str) -> List[str]:
         if m:
             found.append((int(m.group(1)), os.path.join(checkpoint_dir, fn)))
     return [p for _, p in sorted(found, reverse=True)]
+
+
+def bundle_step(path: str) -> Optional[int]:
+    """Optimizer step encoded in an autosaved bundle's filename, or None
+    for non-step bundles (epoch bundles, explicit --save-bundle paths)."""
+    m = _STEP_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def newest_bundle(checkpoint_dir: str, name: str
+                  ) -> Optional[Tuple[int, str]]:
+    """Newest autosaved step bundle for ``name`` as ``(step, path)``, or
+    None when the directory holds none. The serve engine's hot-reload
+    watch polls this: atomic ``os.replace`` writes mean a listed bundle is
+    always complete (never a torn file), and the in-progress ``.tmp.npz``
+    files a live trainer writes into a SHARED directory never match the
+    step pattern, so trainer and server can safely share
+    ``-checkpoint_dir``."""
+    paths = list_bundles(checkpoint_dir, name)
+    if not paths:
+        return None
+    step = bundle_step(paths[0])
+    return None if step is None else (step, paths[0])
 
 
 class CheckpointManager:

@@ -21,7 +21,8 @@ import numpy as np
 
 __all__ = ["SparseBatch", "SparseDataset", "MegaBatch", "PackedMegaBatch",
            "canonicalize_fieldmajor", "pad_examples",
-           "parse_feature_strings", "split_feature", "pow2_len"]
+           "parse_feature_strings", "split_feature", "pow2_len",
+           "bucket_size", "score_batches"]
 
 
 def pow2_len(n: int) -> int:
@@ -30,6 +31,55 @@ def pow2_len(n: int) -> int:
     while p < n:
         p <<= 1
     return p
+
+
+def bucket_size(n: int, lo: int = 1, hi: Optional[int] = None) -> int:
+    """Power-of-two shape bucket for ``n``, clamped to ``[lo, hi]``.
+
+    The shared batch-dimension bucketing of the scoring paths (online
+    serve engine and offline ``score_batches``): padding every batch up to
+    a power-of-two bucket bounds the number of distinct jit shapes at
+    log2(hi/lo) + 1 instead of one compile per request/dataset size.
+    ``lo`` floors tiny batches into one bucket; ``hi`` caps the bucket at
+    the configured batch size (a tail can never out-shape the body: past
+    ``hi`` the bucket IS ``hi`` itself — for a non-power-of-two batch
+    size that is the body shape, already compiled)."""
+    b = pow2_len(max(int(n), int(lo)))
+    if hi is not None and b > int(hi):
+        b = int(hi)
+    return b
+
+
+def score_batches(ds: "SparseDataset", batch_size: int, *,
+                  min_rows: int = 8
+                  ) -> Iterator[Tuple[int, "SparseBatch"]]:
+    """Shape-BUCKETED scoring batches over ``ds``: ``(start_row, batch)``.
+
+    The offline peer of the serve engine's bucketed predict (both sides
+    share :func:`bucket_size`): row length is padded to the power-of-two
+    bucket of the dataset max — datasets of nearby widths score through
+    ONE compiled kernel instead of recompiling per max_row_len — and the
+    ragged tail is padded to its own power-of-two row bucket (>=
+    ``min_rows``, <= ``batch_size``) rather than the full batch size, so
+    large offline scoring reuses a bounded set of (B, L) compiles and
+    never burns a full-batch pad on a short tail. Padding is
+    arithmetically inert (idx 0 / val 0), so per-row scores are unchanged;
+    ``n_valid`` marks the real rows."""
+    n = len(ds)
+    if n == 0:
+        return
+    bs = int(batch_size)
+    L = pow2_len(ds.max_row_len)
+    full_end = (n // bs) * bs
+    if full_end:
+        it = ds.batches(bs, shuffle=False, max_len=L, drop_remainder=True)
+        for s, b in zip(range(0, full_end, bs), it):
+            yield s, b
+    if full_end < n:
+        tail = n - full_end
+        Bt = bucket_size(tail, lo=min(int(min_rows), bs), hi=bs)
+        tb = ds.take(np.arange(full_end, n, dtype=np.int64))
+        yield full_end, next(tb.batches(Bt, shuffle=False, max_len=L))
 
 
 def split_feature(f) -> Tuple[str, str]:

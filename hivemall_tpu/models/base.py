@@ -22,14 +22,23 @@ import numpy as np
 
 from ..io.pipeline import PipelineStats
 from ..io.sparse import (MegaBatch, PackedMegaBatch, SparseBatch,
-                         SparseDataset, pow2_len, split_feature)
+                         SparseDataset, pow2_len, score_batches,
+                         split_feature)
 from ..obs.trace import get_tracer
 from ..utils.hashing import mhash
 from ..utils.metrics import Meter, get_stream
 from ..utils.options import OptionSpec, Parsed
 
 __all__ = ["LearnerBase", "learner_option_spec",
-           "add_mix_reliability_options"]
+           "add_mix_reliability_options", "sigmoid_np"]
+
+
+def sigmoid_np(x: np.ndarray) -> np.ndarray:
+    """Numerically-stable host-side sigmoid — THE margin->probability map
+    of every classification scoring path (predict_proba and the serve
+    engine share it, so online and offline probabilities bit-match)."""
+    return np.where(x >= 0, 1.0 / (1.0 + np.exp(-x)),
+                    np.exp(x) / (1.0 + np.exp(x)))
 
 
 def add_mix_reliability_options(s: OptionSpec) -> OptionSpec:
@@ -1019,6 +1028,47 @@ class LearnerBase:
     def cumulative_loss(self) -> float:
         self._fold_loss()
         return self._loss_sum / max(1, self._examples)
+
+    # -- scoring surface (offline predict + online serve share it) ----------
+    def _make_margin_fn(self):
+        """Raw-score closure over the trainer's CURRENT weights:
+        ``fn(padded SparseBatch) -> [B] margins``. Anything expensive to
+        derive from training state (the optimizer finalization of the
+        linear family) is captured ONCE here, not per batch — the serve
+        engine calls this at model-load/swap time and then scores with the
+        frozen closure. Trainers without a row-scoring surface (anomaly,
+        topic models, ...) leave this unimplemented."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no row-scoring surface")
+
+    def make_scorer(self):
+        """Output-space scoring closure: ``fn(padded SparseBatch) ->
+        np.float32 [B]`` — probabilities for classification trainers
+        (sigmoid_np over the margin, exactly what ``predict_proba``
+        computes), raw margins for regression. The serve engine's predict
+        core; weights are captured at call time, so a hot-reload builds a
+        fresh scorer and swaps it atomically with the model."""
+        margin = self._make_margin_fn()
+        if getattr(self, "classification",
+                   getattr(self, "CLASSIFICATION", False)):
+            return lambda b: sigmoid_np(
+                np.asarray(margin(b), np.float32))
+        return lambda b: np.asarray(margin(b), np.float32)
+
+    def _score_dataset(self, ds: SparseDataset,
+                       batch_size: Optional[int] = None) -> np.ndarray:
+        """Margin-score a whole dataset through the shared shape-bucketed
+        batch iterator (io.sparse.score_batches): one compiled kernel per
+        (pow2-B, pow2-L) bucket instead of per dataset shape, ragged tails
+        padded to their own power-of-two bucket. The decision_function of
+        every scoring trainer routes through here."""
+        margin = self._make_margin_fn()
+        bs = int(batch_size or self.opts.mini_batch)
+        out = np.empty(len(ds), np.float32)
+        for s, b in score_batches(ds, bs):
+            nv = b.n_valid or b.batch_size
+            out[s:s + nv] = np.asarray(margin(b))[:nv]
+        return out
 
     # -- model emission (the close()-time forward of (feature, weight)) -----
     def model_rows(self) -> Iterator[Tuple[str, float]]:

@@ -261,15 +261,14 @@ class _OnlineBase(LearnerBase):
                 yield (self._names.get(int(i), str(int(i))), float(w[i]),
                        float(sig[i]))
 
-    def decision_function(self, ds: SparseDataset) -> np.ndarray:
+    def _make_margin_fn(self):
+        from .linear import _linear_predict_cached
         w = jnp.asarray(self._finalized_weights())
-        out = np.empty(len(ds), np.float32)
-        bs = max(int(self.opts.mini_batch), 256)
-        for s, b in zip(range(0, len(ds), bs), ds.batches(bs, shuffle=False)):
-            nv = b.n_valid or b.batch_size
-            out[s:s + nv] = np.asarray(
-                (w[b.idx] * b.val).sum(-1))[:nv]
-        return out
+        predict = _linear_predict_cached()   # shared jitted gather+sum
+        return lambda b: predict(w, b.idx, b.val)
+
+    def decision_function(self, ds: SparseDataset) -> np.ndarray:
+        return self._score_dataset(ds, max(int(self.opts.mini_batch), 256))
 
     def predict_proba(self, ds: SparseDataset) -> np.ndarray:
         return _sigmoid(self.decision_function(ds))

@@ -434,19 +434,30 @@ class FMTrainer(LearnerBase):
         return np.asarray(fm_score(p["w0"], p["w"], p["V"],
                                    batch.idx, batch.val))
 
+    def _make_margin_fn(self):
+        # _score_batch reads self.params at call time (no finalization
+        # pass to freeze); the serve engine still swaps trainer + scorer
+        # as one ref, so a hot-reload can never mix versions mid-batch
+        return self._score_batch
+
     def decision_function(self, ds: SparseDataset) -> np.ndarray:
-        out = np.empty(len(ds), np.float32)
-        bs = int(self.opts.mini_batch)
-        for s, b in zip(range(0, len(ds), bs), ds.batches(bs, shuffle=False)):
-            nv = b.n_valid or b.batch_size
-            out[s:s + nv] = self._score_batch(b)[:nv]
-        return out
+        return self._score_dataset(ds)
 
     def predict(self, ds: SparseDataset) -> np.ndarray:
         phi = self.decision_function(ds)
         if self.classification:
             return 1.0 / (1.0 + np.exp(-phi))
         return phi
+
+    def make_scorer(self):
+        # mirror predict()'s historical sigmoid form exactly so online
+        # scores bit-match the offline FM predict path
+        margin = self._make_margin_fn()
+        if self.classification:
+            return lambda b: np.asarray(
+                1.0 / (1.0 + np.exp(-np.asarray(margin(b), np.float32))),
+                np.float32)
+        return lambda b: np.asarray(margin(b), np.float32)
 
     def _fused_rows(self):
         """Per-feature [>=dims, Wf] view of the packed fused table (device).

@@ -24,7 +24,7 @@ from ..io.sparse import SparseBatch, SparseDataset
 from ..ops.linear import make_linear_predict, make_linear_step
 from ..ops.losses import get_loss
 from ..ops.optimizers import make_optimizer_cached
-from .base import LearnerBase
+from .base import LearnerBase, sigmoid_np as _sigmoid
 
 __all__ = ["GeneralClassifier", "GeneralRegressor", "LogressTrainer",
            "AdaGradLogisticTrainer", "AdaDeltaLogisticTrainer"]
@@ -94,22 +94,19 @@ class _LinearLearner(LearnerBase):
         self.w = jnp.asarray(w, self.w.dtype)
 
     # -- scoring (the predict-is-a-join path, SURVEY.md §4.2) ---------------
-    def decision_function(self, ds: SparseDataset) -> np.ndarray:
+    def _make_margin_fn(self):
+        # optimizer finalization (RDA truncation etc.) captured ONCE per
+        # scorer — the serve engine swaps scorers per model version, the
+        # offline path builds one per decision_function call
         w = jnp.asarray(self._finalized_weights())
-        out = np.empty(len(ds), np.float32)
-        bs = int(self.opts.mini_batch)
-        for s, b in zip(range(0, len(ds), bs), ds.batches(bs, shuffle=False)):
-            nv = b.n_valid or b.batch_size
-            out[s:s + nv] = np.asarray(self._predict(w, b.idx, b.val))[:nv]
-        return out
+        predict = self._predict
+        return lambda b: predict(w, b.idx, b.val)
+
+    def decision_function(self, ds: SparseDataset) -> np.ndarray:
+        return self._score_dataset(ds)
 
     def predict_proba(self, ds: SparseDataset) -> np.ndarray:
         return _sigmoid(self.decision_function(ds))
-
-
-def _sigmoid(x: np.ndarray) -> np.ndarray:
-    return np.where(x >= 0, 1.0 / (1.0 + np.exp(-x)),
-                    np.exp(x) / (1.0 + np.exp(x)))
 
 
 class GeneralClassifier(_LinearLearner):

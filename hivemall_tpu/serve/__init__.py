@@ -1,0 +1,36 @@
+"""Online prediction serving (docs/SERVING.md).
+
+The missing half of the train->predict loop: a compiled, dynamically
+micro-batched scoring surface over the training runtime's checkpoint
+bundles — Hivemall's prediction-UDF-over-a-published-model pattern rebuilt
+as a live server, in the spirit of Clipper-style prediction serving.
+
+  engine.PredictEngine   — model lifecycle: load a checkpoint bundle,
+                           bucketed jitted predict (bounded recompiles,
+                           warmup), hot-reload on newer autosaved bundles
+  batcher.MicroBatcher   — dynamic micro-batching: coalesce concurrent
+                           requests, per-request deadlines, fail-fast
+                           load shedding on a bounded queue
+  http.PredictServer     — HTTP front end: /predict /healthz /reload +
+                           the obs registry's /snapshot and /metrics
+
+CLI: ``python -m hivemall_tpu.cli serve --algo ... --checkpoint-dir ...``.
+Imports stay lazy here — ``hivemall_tpu.serve`` must be importable without
+paying for jax/catalog until a server is actually constructed.
+"""
+
+__all__ = ["PredictEngine", "MicroBatcher", "PredictServer",
+           "ServeOverload", "ServeDeadline"]
+
+
+def __getattr__(name):
+    if name == "PredictEngine":
+        from .engine import PredictEngine
+        return PredictEngine
+    if name in ("MicroBatcher", "ServeOverload", "ServeDeadline"):
+        from . import batcher
+        return getattr(batcher, name)
+    if name == "PredictServer":
+        from .http import PredictServer
+        return PredictServer
+    raise AttributeError(name)

@@ -1,0 +1,262 @@
+"""Dynamic micro-batcher — Clipper-style request coalescing.
+
+Concurrent ``/predict`` requests land here and are coalesced into one
+bucketed predict call: the dispatch thread waits up to ``max_delay_ms``
+past the FIRST queued request (or until ``max_batch`` rows are queued)
+then scores everything waiting in one batch — at low load a request pays
+at most the delay bound, at high load batches fill instantly and
+amortize dispatch overhead across the whole batch.
+
+Overload is handled by FAILING FAST, not queue collapse: the queue is
+bounded at ``max_queue_rows`` and a submit that would exceed it is shed
+immediately with :class:`ServeOverload` (HTTP 503) — a client sees the
+rejection in microseconds instead of a timeout, and the queue can never
+grow a latency backlog that outlives the burst. Each request may also
+carry a deadline; a request whose deadline passed while queued is
+completed with :class:`ServeDeadline` (HTTP 504) instead of wasting a
+batch slot on an answer nobody is waiting for.
+
+Requests are never split across batches (a request's rows score
+together, on one model version); a single request larger than
+``max_batch`` rows is admitted alone as an oversized batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..io.sparse import pow2_len
+from ..obs.trace import get_tracer
+from ..utils.metrics import Meter
+
+__all__ = ["MicroBatcher", "ServeOverload", "ServeDeadline"]
+
+
+class ServeOverload(RuntimeError):
+    """Queue full — request shed (fail-fast backpressure, HTTP 503)."""
+    status = 503
+
+
+class ServeDeadline(RuntimeError):
+    """Request deadline expired while queued (HTTP 504)."""
+    status = 504
+
+
+@dataclass
+class _Req:
+    rows: list
+    n: int
+    fut: Future
+    t_enq: float
+    t_deadline: Optional[float]
+
+
+class MicroBatcher:
+    """Coalesce concurrent predict requests into bounded batches."""
+
+    def __init__(self, predict_fn, *, max_batch: int = 256,
+                 max_delay_ms: float = 2.0,
+                 max_queue_rows: Optional[int] = None,
+                 deadline_ms: float = 0.0):
+        self._predict = predict_fn
+        self.max_batch = int(max_batch)
+        self.max_delay = float(max_delay_ms) / 1000.0
+        self.max_queue_rows = int(max_queue_rows
+                                  if max_queue_rows is not None
+                                  else 8 * self.max_batch)
+        self.deadline_ms = float(deadline_ms)
+        self._tracer = get_tracer()
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._queued_rows = 0
+        self._closed = False
+        # counters (merged into the obs `serve` section by the engine)
+        self.requests = 0
+        self.rows_in = 0
+        self.batches = 0
+        self.batch_rows_sum = 0
+        self.coalesced_sum = 0          # requests folded into batches
+        self.shed = 0
+        self.expired = 0
+        self.errors = 0
+        self.batch_hist: Dict[int, int] = {}   # pow2 rows-bucket -> count
+        self._req_meter = Meter()
+        self._row_meter = Meter()
+        self._thread = threading.Thread(target=self._run,
+                                        name="serve-batcher", daemon=True)
+        self._thread.start()
+
+    # -- submit side ---------------------------------------------------------
+    def submit(self, rows: list, deadline_ms: Optional[float] = None
+               ) -> Future:
+        """Enqueue one request (a list of parsed rows). Returns a Future
+        resolving to float32 scores [len(rows)] — or, when the predict
+        fn returns ``(scores, meta)``, to ``(scores_slice, meta)``.
+        Raises ServeOverload synchronously when the bounded queue is
+        full."""
+        fut: Future = Future()
+        n = len(rows)
+        if n == 0:
+            fut.set_result(np.zeros(0, np.float32))
+            return fut
+        dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        now = time.monotonic()
+        t_deadline = now + dl / 1000.0 if dl > 0 else None
+        with self._tracer.span("serve.enqueue"):
+            with self._cv:
+                if self._closed:
+                    raise RuntimeError("batcher is closed")
+                # fail-fast shed: an over-budget request never queues —
+                # except a single oversized request against an EMPTY
+                # queue, which is admitted alone (it could never fit)
+                if self._queued_rows + n > self.max_queue_rows and self._q:
+                    self.shed += 1
+                    raise ServeOverload(
+                        f"queue full ({self._queued_rows} rows queued, "
+                        f"max {self.max_queue_rows}); request shed")
+                self._q.append(_Req(rows, n, fut, now, t_deadline))
+                self._queued_rows += n
+                self.requests += 1
+                self.rows_in += n
+                self._req_meter.add(1)
+                self._cv.notify()
+        return fut
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._q)
+
+    # -- dispatch side -------------------------------------------------------
+    def _take_batch(self) -> Optional[List[_Req]]:
+        """Block until a coalescing window closes; pop its requests.
+        Returns None only at close time."""
+        with self._cv:
+            while not self._q:
+                if self._closed:
+                    return None
+                self._cv.wait()        # submit() and close() both notify
+            # window: up to max_delay past the FIRST request, closed
+            # early once max_batch rows are waiting
+            t_close = self._q[0].t_enq + self.max_delay
+            while self._queued_rows < self.max_batch:
+                tmo = t_close - time.monotonic()
+                if tmo <= 0 or self._closed:
+                    break
+                self._cv.wait(tmo)
+            batch: List[_Req] = []
+            nrows = 0
+            while self._q:
+                r = self._q[0]
+                if batch and nrows + r.n > self.max_batch:
+                    break              # never split a request
+                self._q.popleft()
+                self._queued_rows -= r.n
+                batch.append(r)
+                nrows += r.n
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            now = time.monotonic()
+            live: List[_Req] = []
+            for r in batch:
+                if r.t_deadline is not None and now > r.t_deadline:
+                    self.expired += 1
+                    r.fut.set_exception(ServeDeadline(
+                        f"deadline expired after "
+                        f"{(now - r.t_enq) * 1000:.1f}ms in queue"))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            rows = [row for r in live for row in r.rows]
+            with self._tracer.span("serve.batch"):
+                try:
+                    out = self._predict(rows)
+                except Exception as e:   # noqa: BLE001 — score-time
+                    # failure: isolate per request so one bad client's
+                    # rows cannot 500 the innocent requests coalesced
+                    # into the same batch; the dispatch loop survives
+                    if len(live) == 1:
+                        self.errors += 1
+                        live[0].fut.set_exception(e)
+                    else:
+                        self._score_individually(live)
+                    continue
+            # a predict fn may return (scores, meta) — meta (e.g. the
+            # model step that scored this batch) rides along to every
+            # request future in the batch
+            meta = None
+            scores = out
+            if isinstance(out, tuple):
+                scores, meta = out
+            self.batches += 1
+            self.batch_rows_sum += len(rows)
+            self.coalesced_sum += len(live)
+            b = pow2_len(len(rows))
+            self.batch_hist[b] = self.batch_hist.get(b, 0) + 1
+            self._row_meter.add(len(rows))
+            off = 0
+            for r in live:
+                part = np.asarray(scores[off:off + r.n], np.float32)
+                r.fut.set_result(part if meta is None else (part, meta))
+                off += r.n
+
+    def _score_individually(self, reqs: List[_Req]) -> None:
+        """Fallback after a coalesced batch raised: re-score each request
+        alone, failing only the one(s) whose rows actually raise."""
+        for r in reqs:
+            try:
+                out = self._predict(r.rows)
+                scores, meta = (out if isinstance(out, tuple)
+                                else (out, None))
+                part = np.asarray(scores[:r.n], np.float32)
+                r.fut.set_result(part if meta is None else (part, meta))
+            except Exception as e:     # noqa: BLE001 — per-request fate
+                self.errors += 1
+                r.fut.set_exception(e)
+
+    # -- stats / lifecycle ---------------------------------------------------
+    def stats(self) -> dict:
+        """JSON-ready counters for the obs ``serve`` section."""
+        return {
+            "qps": round(self._req_meter.rate, 1),
+            "rows_per_sec": round(self._row_meter.rate, 1),
+            "queue_depth": len(self._q),
+            "queued_rows": self._queued_rows,
+            "requests": self.requests,
+            "rows": self.rows_in,
+            "batches": self.batches,
+            "mean_batch_rows": round(
+                self.batch_rows_sum / max(1, self.batches), 2),
+            "mean_coalesced": round(
+                self.coalesced_sum / max(1, self.batches), 2),
+            "batch_hist": {str(k): v
+                           for k, v in sorted(self.batch_hist.items())},
+            "shed": self.shed,
+            "expired": self.expired,
+            "errors": self.errors,
+        }
+
+    def close(self) -> None:
+        """Stop the dispatch thread; requests still queued fail with a
+        closed error rather than hanging their futures forever."""
+        with self._cv:
+            self._closed = True
+            pending = list(self._q)
+            self._q.clear()
+            self._queued_rows = 0
+            self._cv.notify_all()
+        for r in pending:
+            r.fut.set_exception(RuntimeError("batcher closed"))
+        self._thread.join(timeout=5)

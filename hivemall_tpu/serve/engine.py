@@ -1,0 +1,367 @@
+"""PredictEngine — model lifecycle + compiled bucketed predict.
+
+One engine serves one algorithm config (the trainer options used at
+training time). It loads a full-state checkpoint bundle (io.checkpoint:
+manifest digest validated on load, so a corrupt or truncated file can
+never become the serving model), builds an output-space scorer from the
+trainer (``LearnerBase.make_scorer`` — the SAME kernels and sigmoid the
+offline ``predict_proba`` path runs, so online scores bit-match offline),
+and scores request rows through SHAPE-BUCKETED padded batches:
+
+- batch dimension padded to the power-of-two bucket of the row count
+  (io.sparse.bucket_size), row length to the power-of-two bucket of the
+  widest row — so jit compiles are bounded at ~log2(max_batch) x
+  log2(max_len) shapes instead of one per request shape, and ``warmup()``
+  pre-compiles the batch buckets at startup so no request pays XLA
+  compile latency;
+
+- hot-reload: ``poll()`` (driven by a watcher thread or the ``/reload``
+  endpoint) checks the watched ``-checkpoint_dir`` for an autosaved
+  bundle with a HIGHER step than the serving model, loads it into a
+  FRESH trainer (never mutating the live one), and swaps the
+  ``(trainer, scorer)`` pair behind one atomic reference — in-flight
+  predictions keep the ref they grabbed, so a swap never drops or mixes
+  versions mid-batch. A bundle that fails validation is skipped (counted,
+  remembered by mtime so a bad file isn't re-read every poll) and the old
+  model keeps serving. Atomic checkpoint writes + the step-pattern filter
+  mean a live trainer autosaving into the same directory is safe.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..io.checkpoint import bundle_step, list_bundles
+from ..io.sparse import SparseBatch, bucket_size
+from ..obs.trace import get_tracer
+
+__all__ = ["PredictEngine"]
+
+# serving never emits model rows, so the hashed-id -> name memo a
+# trainer's _parse_row keeps is dead weight here; cap it so a stream of
+# novel feature names can't grow host memory without bound
+_NAMES_CAP = 1 << 20
+
+
+@dataclass
+class _Model:
+    """One immutable model version — swapped as a single reference."""
+    trainer: Any
+    scorer: Any                      # fn(SparseBatch) -> np.float32 [B]
+    step: int
+    path: Optional[str]
+    loaded_at: float = field(default_factory=time.time)
+    needs_field: bool = False        # FFM-style rows carry field ids
+
+
+class PredictEngine:
+    """Compiled bucketed predict over hot-reloadable checkpoint bundles."""
+
+    def __init__(self, algo: str, options: str = "", *,
+                 bundle: Optional[str] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 max_batch: int = 256,
+                 max_row_features: int = 4096,
+                 min_len_bucket: int = 8,
+                 watch_interval: float = 2.0,
+                 warmup: bool = True,
+                 warmup_len: int = 16):
+        from ..catalog import lookup
+        self.algo = algo
+        self.options = options
+        self._cls = lookup(algo).resolve()
+        self.max_batch = int(max_batch)
+        self.max_row_features = int(max_row_features)
+        self.min_len_bucket = int(min_len_bucket)
+        self.watch_interval = float(watch_interval)
+        self._tracer = get_tracer()
+        self._reload_lock = threading.Lock()   # serializes poll()/reload()
+        self._watch_thread: Optional[threading.Thread] = None
+        self._watch_stop = threading.Event()
+        # counters (obs `serve` section)
+        self.reloads = 0
+        self.reload_failures = 0
+        self.last_reload_error: Optional[str] = None
+        self._failed: Dict[str, float] = {}    # bad bundle path -> mtime
+        self._batcher = None
+        # initial model: an explicit bundle wins; otherwise the newest
+        # usable autosave in the watched directory. The option fallback
+        # parses the grammar only — constructing a trainer here would
+        # allocate (and discard) a full dims-sized table
+        ckdir = checkpoint_dir
+        if not ckdir and hasattr(self._cls, "spec"):
+            try:
+                ckdir = self._cls.spec().parse(options).get(
+                    "checkpoint_dir")
+            except Exception:          # noqa: BLE001 — bad options fail
+                ckdir = None           # properly at trainer construction
+        self.checkpoint_dir = ckdir
+        if bundle:
+            self._model = self._load_model(bundle)
+        elif ckdir:
+            m = self._load_newest(min_step=-1)
+            if m is None:
+                raise FileNotFoundError(
+                    f"no usable {algo} checkpoint bundle in {ckdir!r}")
+            self._model = m
+        else:
+            raise ValueError(
+                "PredictEngine needs a model source: pass bundle=... or "
+                "checkpoint_dir=... (or -checkpoint_dir in options)")
+        self._register_obs()
+        if warmup:
+            self.warmup(warmup_len)
+
+    # -- model loading -------------------------------------------------------
+    def _fresh_trainer(self):
+        return self._cls(self.options)
+
+    def _load_model(self, path: str) -> _Model:
+        t = self._fresh_trainer()
+        t.load_bundle(path)            # validates format/digest/shapes
+        step = int(getattr(t, "_t", 0))
+        return _Model(t, t.make_scorer(), step, path,
+                      needs_field=self._needs_field(t))
+
+    @staticmethod
+    def _needs_field(trainer) -> bool:
+        row = trainer._parse_row([])
+        return isinstance(row, tuple) and len(row) == 3
+
+    def _load_newest(self, min_step: int) -> Optional[_Model]:
+        """Newest loadable bundle with step > min_step, skipping (and
+        remembering) bundles that fail validation."""
+        name = self._cls.NAME
+        listed = list_bundles(self.checkpoint_dir, name)
+        if self._failed:
+            # drop memo entries for bundles retention has pruned away —
+            # a weeks-long watch must not grow the dict one dead path at
+            # a time
+            live = set(listed)
+            self._failed = {p: m for p, m in self._failed.items()
+                            if p in live}
+        for path in listed:
+            step = bundle_step(path)
+            if step is None or step <= min_step:
+                break                  # list is newest-first
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue               # pruned between listdir and stat
+            if self._failed.get(path) == mtime:
+                continue               # known-bad, unchanged since
+            try:
+                return self._load_model(path)
+            except Exception as e:     # noqa: BLE001 — a corrupt bundle
+                # must degrade to "keep serving the old model", never
+                # take the server down
+                self.reload_failures += 1
+                self.last_reload_error = f"{path}: {type(e).__name__}: {e}"
+                self._failed[path] = mtime
+        return None
+
+    # -- hot reload ----------------------------------------------------------
+    @property
+    def model_step(self) -> int:
+        return self._model.step
+
+    @property
+    def model_path(self) -> Optional[str]:
+        return self._model.path
+
+    @property
+    def model_age_seconds(self) -> float:
+        return round(time.time() - self._model.loaded_at, 3)
+
+    def poll(self) -> bool:
+        """Check the watched directory once; swap in the newest usable
+        bundle that is NEWER than the serving model. Returns True when a
+        swap happened. Safe from any thread; in-flight predictions finish
+        on the model version they started with."""
+        if not self.checkpoint_dir:
+            return False
+        with self._reload_lock:
+            m = self._load_newest(min_step=self._model.step)
+            if m is None:
+                return False
+            self._model = m            # atomic ref swap
+            self.reloads += 1
+            return True
+
+    def reload(self, path: Optional[str] = None) -> bool:
+        """Force a reload: from an explicit bundle path, or the watched
+        directory (newer-step bundles only, like :meth:`poll`).
+
+        An explicit path must live INSIDE the watched checkpoint
+        directory — /reload is reachable over the network, and the model
+        directory is the trust boundary (an arbitrary filesystem path
+        would let any client probe the disk or swap in a planted file).
+        Raises ValueError for an out-of-tree path."""
+        if path is None:
+            return self.poll()
+        if not self.checkpoint_dir:
+            raise ValueError(
+                "explicit-path reload needs a watched checkpoint dir "
+                "(this server was started from a pinned --bundle)")
+        real = os.path.realpath(path)
+        root = os.path.realpath(self.checkpoint_dir)
+        if os.path.commonpath([real, root]) != root:
+            raise ValueError(
+                "reload path is outside the watched checkpoint directory")
+        with self._reload_lock:
+            try:
+                m = self._load_model(path)
+            except Exception as e:     # noqa: BLE001 — same degrade
+                self.reload_failures += 1
+                self.last_reload_error = f"{path}: {type(e).__name__}: {e}"
+                return False
+            self._model = m
+            self.reloads += 1
+            return True
+
+    def start_watch(self) -> None:
+        """Poll the checkpoint directory on a daemon thread — the live
+        trainer + live server recipe (docs/SERVING.md)."""
+        if self._watch_thread is not None or not self.checkpoint_dir:
+            return
+        self._watch_stop.clear()
+
+        def run():
+            while not self._watch_stop.wait(self.watch_interval):
+                try:
+                    self.poll()
+                except Exception as e:   # noqa: BLE001 — watcher survives
+                    self.last_reload_error = f"{type(e).__name__}: {e}"
+
+        self._watch_thread = threading.Thread(
+            target=run, name="serve-watch", daemon=True)
+        self._watch_thread.start()
+
+    def close(self) -> None:
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5)
+            self._watch_thread = None
+
+    # -- predict -------------------------------------------------------------
+    def parse(self, features: Sequence[str]) -> tuple:
+        """One request row ("name:value" / "field:index:value" feature
+        strings) through the trainer's OWN hashing path (_parse_row /
+        ftvec mhash) — serving and training can never hash differently."""
+        t = self._model.trainer
+        row = t._parse_row(features)
+        # bound the row-length shape bucket at the REQUEST boundary: one
+        # arbitrarily wide row would otherwise force a fresh XLA compile
+        # + a huge allocation on the dispatch thread, stalling every
+        # coalesced request behind it (the rejection is a per-request
+        # 400, never a batch failure)
+        if len(row[0]) > self.max_row_features:
+            raise ValueError(
+                f"request row has {len(row[0])} features > "
+                f"max_row_features {self.max_row_features}")
+        names = getattr(t, "_names", None)
+        if names is not None and len(names) > _NAMES_CAP:
+            names.clear()
+        return row
+
+    def predict_rows(self, rows: List[tuple]) -> np.ndarray:
+        """Score parsed rows through one bucketed padded batch. Returns
+        float32 [len(rows)] output-space scores (probabilities for
+        classification). The model ref is grabbed once, so a concurrent
+        hot-swap never mixes versions inside a batch."""
+        return self._predict_with(self._model, rows)
+
+    def predict_rows_versioned(self, rows: List[tuple]):
+        """Batcher predict fn for the HTTP front end: ``(scores, step)``
+        where ``step`` is the step of the model version that ACTUALLY
+        scored this batch — across a hot swap, the response tag must name
+        the version that produced the scores, not whatever is newest by
+        response time."""
+        m = self._model
+        return self._predict_with(m, rows), m.step
+
+    def _predict_with(self, m: _Model, rows: List[tuple]) -> np.ndarray:
+        n = len(rows)
+        if n == 0:
+            return np.zeros(0, np.float32)
+        with self._tracer.span("serve.predict"):
+            batch = self._pad(rows, m.needs_field)
+            return np.asarray(m.scorer(batch), np.float32)[:n]
+
+    def _pad(self, rows: List[tuple], needs_field: bool) -> SparseBatch:
+        """Bucketed padding: B = pow2 bucket of the row count, L = pow2
+        bucket of the widest row (>= min_len_bucket) — the serve-side
+        instance of the shared io.sparse bucketing."""
+        n = len(rows)
+        B = bucket_size(n)
+        L = bucket_size(max(len(r[0]) for r in rows), lo=self.min_len_bucket)
+        idx = np.zeros((B, L), np.int32)
+        val = np.zeros((B, L), np.float32)
+        fld = np.zeros((B, L), np.int32) if needs_field else None
+        for b, row in enumerate(rows):
+            ln = len(row[0])
+            idx[b, :ln] = row[0]
+            val[b, :ln] = row[1]
+            if fld is not None:
+                fld[b, :ln] = row[2]
+        lab = np.zeros(B, np.float32)
+        return SparseBatch(idx, val, lab, fld,
+                           n_valid=n if n < B else None)
+
+    def warmup(self, warmup_len: int = 16) -> int:
+        """Pre-compile the scorer at every power-of-two batch bucket up to
+        ``max_batch`` (at one representative row-length bucket): startup
+        pays the XLA compiles, requests don't. Returns the bucket count."""
+        m = self._model
+        L = bucket_size(warmup_len, lo=self.min_len_bucket)
+        count = 0
+        B = 1
+        while B <= bucket_size(self.max_batch):
+            fld = (np.zeros((B, L), np.int32) if m.needs_field else None)
+            m.scorer(SparseBatch(np.zeros((B, L), np.int32),
+                                 np.zeros((B, L), np.float32),
+                                 np.zeros(B, np.float32), fld,
+                                 n_valid=None))
+            count += 1
+            B <<= 1
+        return count
+
+    # -- obs (docs/OBSERVABILITY.md `serve` section) -------------------------
+    def attach_batcher(self, batcher) -> None:
+        """Merge a MicroBatcher's queue/batch counters into this engine's
+        ``serve`` registry section (the HTTP front end wires this)."""
+        self._batcher = batcher
+
+    def obs_section(self) -> dict:
+        d = {
+            "algo": self.algo,
+            "model_step": self.model_step,
+            "model_age_seconds": self.model_age_seconds,
+            "model_path": self.model_path,
+            "reloads": self.reloads,
+            "reload_failures": self.reload_failures,
+            "watching": bool(self._watch_thread is not None),
+        }
+        if self.last_reload_error:
+            d["last_reload_error"] = self.last_reload_error
+        b = self._batcher
+        if b is not None:
+            d.update(b.stats())
+        return d
+
+    def _register_obs(self) -> None:
+        import weakref
+        from ..obs.registry import registry
+        ref = weakref.ref(self)
+
+        def serve() -> dict:
+            e = ref()
+            return e.obs_section() if e is not None else {"active": False}
+
+        registry.register("serve", serve)

@@ -1,0 +1,201 @@
+"""HTTP front end for the serve subsystem (docs/SERVING.md).
+
+A threaded ``http.server`` endpoint (one thread per connection — request
+parsing/hashing runs concurrently on connection threads; actual scoring
+is serialized through the MicroBatcher's single dispatch thread, which is
+exactly what makes concurrent requests coalesce):
+
+- ``POST /predict`` — body ``{"rows": [["f1:1", "f2:0.5"], ...]}`` (or
+  ``{"features": [...]}`` for one row; FFM rows use
+  ``"field:index:value"`` tokens), optional ``"deadline_ms"``. Features
+  hash through the trainer's own ftvec/mhash path. Response:
+  ``{"scores": [...], "model_step": N, "n": N}``. Shed requests get 503,
+  expired deadlines 504, parse errors 400.
+- ``GET /healthz`` — liveness + model step/age + queue depth.
+- ``POST /reload`` — force a hot-reload check (body optionally
+  ``{"path": "...npz"}`` to load an explicit bundle).
+- ``GET /snapshot`` / ``GET /metrics`` — the central obs registry (the
+  ``serve`` section rides next to pipeline/train/mix/checkpoint/spans),
+  inherited from the obs HTTP handler.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+from typing import Optional
+
+from ..obs.http import _Handler as _ObsHandler
+from .batcher import MicroBatcher, ServeDeadline, ServeOverload
+
+__all__ = ["PredictServer"]
+
+
+class _ServeHandler(_ObsHandler):
+    """Extends the obs handler (/snapshot, /metrics, timeout, quiet logs)
+    with the predict surface. The owning PredictServer is attached on the
+    per-server subclass."""
+
+    server_ref: "PredictServer" = None   # type: ignore[assignment]
+
+    # -- helpers -------------------------------------------------------------
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> dict:
+        ln = int(self.headers.get("Content-Length") or 0)
+        if ln <= 0:
+            return {}
+        if ln > (64 << 20):
+            raise ValueError(f"request body {ln} bytes > 64MB cap")
+        obj = json.loads(self.rfile.read(ln) or b"{}")
+        if not isinstance(obj, dict):
+            raise ValueError("request body must be a JSON object")
+        return obj
+
+    # -- routes --------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            s = self.server_ref
+            self._json(200, {
+                "status": "ok",
+                "algo": s.engine.algo,
+                "model_step": s.engine.model_step,
+                "model_age_seconds": s.engine.model_age_seconds,
+                "queue_depth": s.batcher.queue_depth,
+            })
+            return
+        super().do_GET()               # /snapshot, /metrics, 404
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        s = self.server_ref
+        if path == "/reload":
+            try:
+                body = self._read_body()
+            except (ValueError, json.JSONDecodeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            try:
+                swapped = s.engine.reload(body.get("path"))
+            except ValueError as e:    # out-of-tree path: the model dir
+                self._json(403, {"error": str(e)})   # is the trust boundary
+                return
+            self._json(200, {"reloaded": swapped,
+                             "model_step": s.engine.model_step,
+                             "reload_failures": s.engine.reload_failures})
+            return
+        if path != "/predict":
+            self.send_error(404, "unknown path (try /predict, /healthz, "
+                                 "/reload, /snapshot or /metrics)")
+            return
+        try:
+            body = self._read_body()
+            rows = body.get("rows")
+            if rows is None:
+                feats = body.get("features")
+                if feats is None:
+                    raise ValueError('body needs "rows" or "features"')
+                rows = [feats]
+            if not isinstance(rows, list) \
+                    or not all(isinstance(r, list) for r in rows):
+                raise ValueError('"rows" must be a list of feature-string '
+                                 'lists (a bare string would be read as '
+                                 'per-character rows)')
+            deadline_ms = body.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)   # malformed -> 400
+            # hashing/parsing on THIS connection thread — concurrent
+            # requests parse in parallel, only scoring serializes
+            parsed = [s.engine.parse(r) for r in rows]
+        except (ValueError, TypeError, KeyError,
+                json.JSONDecodeError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        try:
+            fut = s.batcher.submit(parsed, deadline_ms=deadline_ms)
+            res = fut.result(timeout=s.request_timeout)
+        except ServeOverload as e:
+            self._json(503, {"error": str(e), "shed": True})
+            return
+        except ServeDeadline as e:
+            self._json(504, {"error": str(e), "expired": True})
+            return
+        except Exception as e:         # noqa: BLE001 — predict failure is
+            # a 500 on THIS request, never a handler crash
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        if isinstance(res, tuple):
+            scores, step = res
+        else:                          # zero-row request short-circuit
+            scores, step = res, s.engine.model_step
+        self._json(200, {"scores": [float(v) for v in scores],
+                         "model_step": int(step),
+                         "n": len(scores)})
+
+
+class _ThreadedHTTPServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        pass                           # client disconnects are routine
+
+
+class PredictServer:
+    """Engine + batcher + HTTP endpoint, wired into the obs registry.
+
+    ``port=0`` binds an ephemeral port (read ``self.port``). Loopback-only
+    by default; bind ``host="0.0.0.0"`` explicitly to serve a fleet.
+    Starting the server also starts the engine's checkpoint watcher when a
+    watch directory is configured (the train+serve shared-dir recipe)."""
+
+    def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
+                 max_batch: Optional[int] = None,
+                 max_delay_ms: float = 2.0,
+                 max_queue_rows: Optional[int] = None,
+                 deadline_ms: float = 0.0,
+                 request_timeout: float = 60.0,
+                 watch: bool = True):
+        self.engine = engine
+        self.request_timeout = float(request_timeout)
+        self._watch = bool(watch)
+        # the versioned predict fn: each response carries the step of the
+        # model version that actually scored it (correct across hot swaps)
+        self.batcher = MicroBatcher(
+            engine.predict_rows_versioned,
+            max_batch=int(max_batch or engine.max_batch),
+            max_delay_ms=max_delay_ms,
+            max_queue_rows=max_queue_rows,
+            deadline_ms=deadline_ms)
+        engine.attach_batcher(self.batcher)
+        handler = type("_BoundServeHandler", (_ServeHandler,),
+                       {"server_ref": self})
+        self._httpd = _ThreadedHTTPServer((host, port), handler)
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PredictServer":
+        if self._watch:
+            self.engine.start_watch()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"serve-http:{self.port}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.batcher.close()
+        self.engine.close()

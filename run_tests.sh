@@ -21,6 +21,14 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.obs.smoke || exit $?
 
+# serve smoke (docs/SERVING.md): a checkpoint trained in-process is served
+# over HTTP with dynamic micro-batching — concurrent predicts must
+# coalesce (mean batch > 1), bit-match offline predict_proba on the same
+# rows, stay under the p99 latency budget, and a newer checkpoint written
+# mid-traffic must hot-reload without dropping in-flight requests.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m hivemall_tpu.serve.smoke || exit $?
+
 # bench harness smoke: tiny-shape runs of the ingest-path benches assert
 # every metric still emits and parses (pipeline refactors must not silently
 # break bench.py), and the dispatch-fusion microbench enforces its floor —

@@ -46,8 +46,17 @@ WORKER = textwrap.dedent("""
 """)
 
 
+# the capability this test needs: cross-process collectives on the local
+# backend. jaxlib's CPU backend (through at least 0.4/0.5) rejects them
+# with exactly this error — a build/environment limitation, not a repo
+# regression, so it must skip, not fail (GPU/TPU runs still assert).
+_NO_MP_COLLECTIVES = "Multiprocess computations aren't implemented"
+
+
 def test_two_process_dcn_pmean(tmp_path):
     import os
+
+    import pytest
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
@@ -68,6 +77,10 @@ def test_two_process_dcn_pmean(tmp_path):
         for p in procs:          # never orphan a hung rank
             if p.poll() is None:
                 p.kill()
+    if any(rc != 0 and _NO_MP_COLLECTIVES in err for rc, _, err in outs):
+        pytest.skip("backend lacks multiprocess collectives "
+                    "(CPU-only jaxlib); DCN pmean needs a real "
+                    "distributed backend")
     for rc, out, err in outs:
         assert rc == 0, err[-2000:]
         assert "ok" in out

@@ -1,0 +1,513 @@
+"""Online serving subsystem (hivemall_tpu/serve, docs/SERVING.md):
+micro-batcher coalescing/deadline/shedding semantics, engine hot-reload
+(corrupt bundles ignored, newer steps swapped mid-traffic), HTTP front
+end + obs registry integration, and the shared shape-bucketing helper
+the offline scoring path reuses."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.serve.batcher import (MicroBatcher, ServeDeadline,
+                                        ServeOverload)
+
+
+class GatedPredict:
+    """Fake predict fn whose completion is gated by an Event — makes the
+    coalescing-window tests deterministic (requests submitted while the
+    worker is blocked MUST coalesce into the next batch)."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.calls = []                 # row count per predict call
+
+    def __call__(self, rows):
+        self.calls.append(len(rows))
+        assert self.gate.wait(timeout=10), "test gate never opened"
+        return np.arange(len(rows), dtype=np.float32)
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.002)
+    return False
+
+
+# --- batcher ----------------------------------------------------------------
+
+def test_batcher_coalesces_concurrent_requests():
+    p = GatedPredict()
+    b = MicroBatcher(p, max_batch=64, max_delay_ms=2.0)
+    try:
+        f1 = b.submit([("a",)])
+        assert _wait(lambda: len(p.calls) == 1)     # worker holds batch 1
+        f2 = b.submit([("b",), ("c",)])
+        f3 = b.submit([("d",)])
+        assert _wait(lambda: b.queue_depth == 2)
+        p.gate.set()
+        assert np.array_equal(f1.result(5), [0.0])
+        # both requests queued behind the gate land in ONE batch,
+        # split back per request
+        assert np.array_equal(f2.result(5), [0.0, 1.0])
+        assert np.array_equal(f3.result(5), [2.0])
+        assert p.calls == [1, 3]
+        st = b.stats()
+        assert st["batches"] == 2 and st["requests"] == 3
+        assert st["mean_coalesced"] == 1.5
+        assert st["batch_hist"] == {"1": 1, "4": 1}  # pow2 rows buckets
+    finally:
+        p.gate.set()
+        b.close()
+
+
+def test_batcher_respects_max_batch_and_never_splits():
+    p = GatedPredict()
+    p.gate.set()
+    b = MicroBatcher(p, max_batch=4, max_delay_ms=0.0)
+    try:
+        p.gate.clear()
+        f0 = b.submit([(0,)])
+        assert _wait(lambda: len(p.calls) == 1)
+        futs = [b.submit([(i,), (i,), (i,)]) for i in range(3)]
+        p.gate.set()
+        for f in futs + [f0]:
+            f.result(5)
+        # 3-row requests against max_batch=4: one request per batch —
+        # a request is never split across batches
+        assert p.calls == [1, 3, 3, 3]
+    finally:
+        p.gate.set()
+        b.close()
+
+
+def test_batcher_deadline_expires_queued_request():
+    p = GatedPredict()
+    b = MicroBatcher(p, max_batch=8, max_delay_ms=1.0)
+    try:
+        fa = b.submit([("a",)])                    # occupies the worker
+        assert _wait(lambda: len(p.calls) == 1)
+        fb = b.submit([("b",)], deadline_ms=15.0)
+        time.sleep(0.08)                           # let B's deadline pass
+        p.gate.set()
+        assert np.array_equal(fa.result(5), [0.0])
+        with pytest.raises(ServeDeadline):
+            fb.result(5)
+        assert b.expired == 1
+        assert b.stats()["expired"] == 1
+    finally:
+        p.gate.set()
+        b.close()
+
+
+def test_batcher_sheds_on_full_queue():
+    p = GatedPredict()
+    b = MicroBatcher(p, max_batch=8, max_delay_ms=0.0, max_queue_rows=4)
+    try:
+        first = b.submit([("a",)])                 # taken by the worker
+        assert _wait(lambda: len(p.calls) == 1)
+        q1 = b.submit([("b",), ("c",)])
+        q2 = b.submit([("d",), ("e",)])            # queue now at 4 rows
+        with pytest.raises(ServeOverload):
+            b.submit([("f",)])                     # fail-fast shed
+        assert b.shed == 1 and b.stats()["shed"] == 1
+        p.gate.set()
+        for f in (first, q1, q2):
+            f.result(5)                            # queued work unharmed
+    finally:
+        p.gate.set()
+        b.close()
+
+
+def test_batcher_oversized_request_admitted_alone():
+    p = GatedPredict()
+    p.gate.set()
+    b = MicroBatcher(p, max_batch=4, max_delay_ms=0.0, max_queue_rows=4)
+    try:
+        f = b.submit([(i,) for i in range(9)])     # > max_queue_rows but
+        assert len(f.result(5)) == 9               # queue was empty
+    finally:
+        b.close()
+
+
+def test_batcher_predict_error_fails_only_that_batch():
+    calls = []
+
+    def boom(rows):
+        calls.append(len(rows))
+        if len(calls) == 1:
+            raise RuntimeError("kernel exploded")
+        return np.zeros(len(rows), np.float32)
+
+    b = MicroBatcher(boom, max_batch=8, max_delay_ms=0.0)
+    try:
+        f1 = b.submit([("a",)])
+        with pytest.raises(RuntimeError, match="kernel exploded"):
+            f1.result(5)
+        f2 = b.submit([("b",)])
+        assert len(f2.result(5)) == 1              # dispatch loop survived
+        assert b.errors == 1
+    finally:
+        b.close()
+
+
+def test_batcher_passes_meta_through():
+    """A predict fn returning (scores, meta) resolves every request in
+    the batch to (slice, meta) — how /predict tags responses with the
+    step of the model version that ACTUALLY scored them."""
+    p = GatedPredict()
+    inner = p
+
+    def with_meta(rows):
+        return inner(rows), 42
+
+    b = MicroBatcher(with_meta, max_batch=8, max_delay_ms=2.0)
+    try:
+        f1 = b.submit([("a",)])
+        assert _wait(lambda: len(p.calls) == 1)
+        f2 = b.submit([("b",), ("c",)])
+        p.gate.set()
+        s1, m1 = f1.result(5)
+        s2, m2 = f2.result(5)
+        assert m1 == 42 and m2 == 42
+        assert np.array_equal(s1, [0.0]) and np.array_equal(s2, [0.0, 1.0])
+    finally:
+        p.gate.set()
+        b.close()
+
+
+def test_batcher_isolates_bad_request_in_coalesced_batch():
+    """One request whose rows raise at score time must not fail the
+    innocent requests coalesced into the same batch."""
+    gate = threading.Event()
+    calls = []
+
+    def picky(rows):
+        calls.append(len(rows))
+        assert gate.wait(10), "test gate never opened"
+        if ("bad",) in rows:
+            raise ValueError("unscorable row")
+        return np.zeros(len(rows), np.float32)
+
+    b = MicroBatcher(picky, max_batch=8, max_delay_ms=2.0)
+    try:
+        f0 = b.submit([("x",)])                     # occupies the worker
+        assert _wait(lambda: len(calls) == 1)
+        f_bad = b.submit([("bad",)])
+        f_ok = b.submit([("ok",)])
+        assert _wait(lambda: b.queue_depth == 2)
+        gate.set()
+        f0.result(5)
+        with pytest.raises(ValueError, match="unscorable"):
+            f_bad.result(5)
+        assert len(f_ok.result(5)) == 1             # batchmate survived
+        assert b.errors == 1
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_batcher_close_fails_pending():
+    p = GatedPredict()
+    b = MicroBatcher(p, max_batch=8, max_delay_ms=50.0)
+    f1 = b.submit([("a",)])
+    assert _wait(lambda: len(p.calls) == 1)
+    f2 = b.submit([("b",)])
+    p.gate.set()
+    b.close()
+    f1.result(5)                                   # in-flight completed
+    with pytest.raises(RuntimeError, match="closed"):
+        f2.result(5)
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit([("c",)])
+
+
+# --- shared shape bucketing (io.sparse) -------------------------------------
+
+def test_bucket_size_clamps():
+    from hivemall_tpu.io.sparse import bucket_size
+    assert bucket_size(0) == 1
+    assert bucket_size(3) == 4
+    assert bucket_size(3, lo=8) == 8
+    assert bucket_size(100, hi=64) == 64
+    assert bucket_size(64, lo=8, hi=256) == 64
+    # non-power-of-two cap: the bucket is hi ITSELF (the body batch
+    # shape, already compiled), never pow2(hi) > hi
+    assert bucket_size(70, lo=8, hi=100) == 100
+
+
+def test_score_batches_buckets_and_coverage():
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    from hivemall_tpu.io.sparse import pow2_len, score_batches
+    ds, _ = synthetic_classification(100, 50, seed=3)
+    L = pow2_len(ds.max_row_len)
+    seen = np.zeros(100, bool)
+    shapes = []
+    for s, b in score_batches(ds, 32):
+        nv = b.n_valid or b.batch_size
+        assert np.array_equal(np.asarray(b.label[:nv]),
+                              ds.labels[s:s + nv])
+        seen[s:s + nv] = True
+        shapes.append(b.idx.shape)
+    assert seen.all()
+    # body at (32, L); the 4-row tail padded to its pow2 bucket (>= 8),
+    # not the full batch size
+    assert shapes[:-1] == [(32, L)] * 3
+    assert shapes[-1] == (8, L)
+
+
+def test_offline_scoring_unchanged_by_bucketing():
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    from hivemall_tpu.models.linear import GeneralClassifier
+    ds, _ = synthetic_classification(70, 40, seed=5)
+    t = GeneralClassifier("-dims 512 -loss logloss -mini_batch 32")
+    t.fit(ds)
+    proba = t.predict_proba(ds)
+    # reference: per-row margins computed directly from the weight table
+    w = t._finalized_weights()
+    ref = np.empty(len(ds), np.float32)
+    for i in range(len(ds)):
+        idx, val = ds.row(i)
+        ref[i] = float((w[idx] * val).sum())
+    ref = np.where(ref >= 0, 1.0 / (1.0 + np.exp(-ref)),
+                   np.exp(ref) / (1.0 + np.exp(ref)))
+    np.testing.assert_allclose(proba, ref, rtol=1e-5, atol=1e-6)
+
+
+# --- engine -----------------------------------------------------------------
+
+OPTS = "-dims 1024 -loss logloss -opt adagrad -mini_batch 32"
+
+
+@pytest.fixture()
+def trained(tmp_path):
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    from hivemall_tpu.models.linear import GeneralClassifier
+    ds, _ = synthetic_classification(120, 64, seed=11)
+    t = GeneralClassifier(OPTS)
+    t.fit(ds)
+    path = os.path.join(tmp_path, f"{t.NAME}-step{t._t:010d}.npz")
+    t.save_bundle(path)
+    return t, ds, str(tmp_path), path
+
+
+def _engine(ckdir, **kw):
+    from hivemall_tpu.serve.engine import PredictEngine
+    kw.setdefault("warmup", False)
+    return PredictEngine("train_classifier", OPTS, checkpoint_dir=ckdir,
+                         **kw)
+
+
+def _rows_of(ds, n):
+    out = []
+    for i in range(n):
+        idx, val = ds.row(i)
+        out.append([f"{int(a)}:{float(v)!r}" for a, v in zip(idx, val)])
+    return out
+
+
+def test_engine_bitmatches_offline_predict_proba(trained):
+    from hivemall_tpu.io.sparse import SparseDataset
+    t, ds, ckdir, _ = trained
+    eng = _engine(ckdir)
+    rows = _rows_of(ds, 17)
+    parsed = [t._parse_row(r) for r in rows]
+    ref = t.predict_proba(SparseDataset.from_rows(parsed,
+                                                  [1.0] * len(parsed)))
+    # batched and one-at-a-time land in different (B, L) buckets; both
+    # must bit-match the offline path (padding is inert)
+    got = eng.predict_rows([eng.parse(r) for r in rows])
+    assert np.array_equal(got, ref)
+    one = np.concatenate([eng.predict_rows([eng.parse(r)]) for r in rows])
+    assert np.array_equal(one, ref)
+
+
+def test_engine_requires_a_model_source(tmp_path):
+    from hivemall_tpu.serve.engine import PredictEngine
+    with pytest.raises(ValueError, match="model source"):
+        PredictEngine("train_classifier", OPTS)
+    with pytest.raises(FileNotFoundError):
+        PredictEngine("train_classifier", OPTS,
+                      checkpoint_dir=str(tmp_path))
+
+
+def test_engine_warmup_compiles_buckets(trained):
+    _, _, ckdir, _ = trained
+    eng = _engine(ckdir, max_batch=16)
+    assert eng.warmup(8) == 5          # B = 1,2,4,8,16
+
+
+def test_engine_ignores_corrupt_bundle_and_swaps_newer(trained):
+    t, ds, ckdir, _ = trained
+    eng = _engine(ckdir)
+    step0 = eng.model_step
+    # a corrupt bundle with the HIGHEST step: must be skipped (and
+    # remembered), never served
+    bad = os.path.join(ckdir, f"{t.NAME}-step{step0 + 999:010d}.npz")
+    with open(bad, "wb") as f:
+        f.write(b"this is not a checkpoint bundle")
+    assert eng.poll() is False
+    assert eng.model_step == step0
+    assert eng.reload_failures == 1
+    assert "step" in (eng.last_reload_error or "")
+    eng.poll()
+    assert eng.reload_failures == 1    # known-bad file not re-read
+    # train on: a newer VALID bundle behind the corrupt one swaps in
+    t.fit(ds)
+    good = os.path.join(ckdir, f"{t.NAME}-step{t._t:010d}.npz")
+    t.save_bundle(good)
+    assert eng.poll() is True
+    assert eng.model_step == t._t and eng.reloads == 1
+    # served scores now come from the NEW weights
+    rows = _rows_of(ds, 5)
+    from hivemall_tpu.io.sparse import SparseDataset
+    parsed = [t._parse_row(r) for r in rows]
+    ref = t.predict_proba(SparseDataset.from_rows(parsed, [1.0] * 5))
+    assert np.array_equal(eng.predict_rows([eng.parse(r) for r in rows]),
+                          ref)
+
+
+def test_engine_rejects_wide_rows_and_out_of_tree_reload(trained):
+    t, ds, ckdir, path = trained
+    eng = _engine(ckdir, max_row_features=4)
+    with pytest.raises(ValueError, match="max_row_features"):
+        eng.parse([f"{i}:1" for i in range(1, 7)])   # 6 features > cap
+    eng.parse(["1:1", "2:1"])                        # under the cap: fine
+    # /reload trust boundary: only paths INSIDE the watched dir load
+    outside = os.path.join(os.path.dirname(ckdir), "planted.npz")
+    with pytest.raises(ValueError, match="outside the watched"):
+        eng.reload(outside)
+    assert eng.reload(path) is True                  # in-tree: allowed
+    # a bundle-pinned server (no watched dir) rejects any explicit path
+    from hivemall_tpu.serve.engine import PredictEngine
+    eng2 = PredictEngine("train_classifier", OPTS, bundle=path,
+                         warmup=False)
+    with pytest.raises(ValueError, match="watched checkpoint dir"):
+        eng2.reload(path)
+
+
+def test_engine_swap_keeps_inflight_model(trained):
+    """A hot swap mid-batch never mixes versions: the batch scored with
+    the ref it grabbed."""
+    t, ds, ckdir, _ = trained
+    eng = _engine(ckdir)
+    m0 = eng._model
+    t.fit(ds)
+    p2 = os.path.join(ckdir, f"{t.NAME}-step{t._t:010d}.npz")
+    t.save_bundle(p2)
+    assert eng.poll() is True
+    assert eng._model is not m0        # new ref swapped in
+    # the OLD ref still scores (an in-flight request holding it finishes)
+    rows = [eng.parse(r) for r in _rows_of(ds, 3)]
+    out_old = np.asarray(m0.scorer(eng._pad(rows, m0.needs_field)))[:3]
+    assert out_old.shape == (3,)
+
+
+# --- HTTP front end + obs ---------------------------------------------------
+
+def _post(url, obj, timeout=15.0):
+    req = urllib.request.Request(url, json.dumps(obj).encode(),
+                                 {"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+def test_http_predict_healthz_reload_and_obs(trained):
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.serve.http import PredictServer
+    t, ds, ckdir, _ = trained
+    eng = _engine(ckdir)
+    srv = PredictServer(eng, port=0, max_delay_ms=1.0, watch=False).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        rows = _rows_of(ds, 4)
+        r = _post(base + "/predict", {"rows": rows})
+        parsed = [t._parse_row(x) for x in rows]
+        ref = t.predict_proba(SparseDataset.from_rows(parsed, [1.0] * 4))
+        assert np.array_equal(np.asarray(r["scores"], np.float32), ref)
+        assert r["model_step"] == eng.model_step and r["n"] == 4
+        # single-row "features" form
+        r1 = _post(base + "/predict", {"features": rows[0]})
+        assert np.float32(r1["scores"][0]) == ref[0]
+        # healthz
+        hz = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())
+        assert hz["status"] == "ok" and hz["model_step"] == eng.model_step
+        # bad request -> 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + "/predict", {"nope": 1})
+        assert ei.value.code == 400
+        # reload with nothing newer -> no swap, but a clean 200
+        rr = _post(base + "/reload", {})
+        assert rr["reloaded"] is False
+        assert rr["model_step"] == eng.model_step
+        # obs: serve section present in /snapshot and /metrics
+        snap = json.loads(urllib.request.urlopen(
+            base + "/snapshot", timeout=10).read())
+        sv = snap["serve"]
+        for k in ("qps", "queue_depth", "batch_hist", "shed",
+                  "model_step", "model_age_seconds"):
+            assert k in sv, k
+        assert sv["requests"] >= 2
+        prom = urllib.request.urlopen(base + "/metrics",
+                                      timeout=10).read().decode()
+        assert "hivemall_tpu_serve_model_step" in prom
+        assert "hivemall_tpu_serve_shed 0" in prom
+        # the central registry carries the same section (any obs surface
+        # — the trainer's -obs_port server included — would export it)
+        from hivemall_tpu.obs.registry import registry
+        assert "serve" in registry.snapshot()
+    finally:
+        srv.stop()
+
+
+def test_http_deadline_maps_to_504(trained):
+    from hivemall_tpu.serve.http import PredictServer
+    _, ds, ckdir, _ = trained
+    eng = _engine(ckdir)
+    srv = PredictServer(eng, port=0, max_delay_ms=1.0, watch=False).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        gate = threading.Event()
+        orig = srv.batcher._predict
+
+        def slow(rows):
+            gate.wait(timeout=10)
+            return orig(rows)
+
+        srv.batcher._predict = slow
+        rows = _rows_of(ds, 1)
+        first = threading.Thread(
+            target=lambda: _post(base + "/predict", {"rows": rows}))
+        first.start()                  # occupies the dispatch thread
+        time.sleep(0.05)
+        err = {}
+
+        def second():
+            try:
+                _post(base + "/predict",
+                      {"rows": rows, "deadline_ms": 10})
+            except urllib.error.HTTPError as e:
+                err["code"] = e.code
+        t2 = threading.Thread(target=second)
+        t2.start()
+        # deterministic ordering: wait until the second request is
+        # actually QUEUED, then let its deadline lapse, then release —
+        # fixed sleeps alone race the HTTP connect under CI load
+        assert _wait(lambda: srv.batcher.queue_depth == 1)
+        time.sleep(0.05)
+        gate.set()
+        first.join(10)
+        t2.join(10)
+        assert err.get("code") == 504
+        assert srv.batcher.expired == 1
+    finally:
+        gate.set()
+        srv.stop()
