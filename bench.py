@@ -166,12 +166,15 @@ def bench_ffm_kernel(n_steps: int = 30, warmup: int = 5) -> dict:
     }
 
 
-def _criteo_synth(n_rows: int, seed: int, smoke: bool = False):
+def _criteo_synth(n_rows: int, seed: int, smoke: bool = False,
+                  extra_opts: str = ""):
     """Shared Criteo-shaped synthetic corpus + warmed flagship trainer for
     the end-to-end benches (one recipe so their numbers stay comparable).
     smoke=True shrinks every shape to CPU-feasible sizes (--smoke mode:
     the harness plumbing is what's under test, not the kernels) and pins
-    -ingest_workers 2 so the pipeline stage counters are exercised."""
+    -ingest_workers 2 so the pipeline stage counters are exercised.
+    extra_opts appends trainer options (bench_shard_cache adds the cache
+    dir + -pack_input on so the packed path runs on CPU too)."""
     import numpy as np
     from hivemall_tpu.io.sparse import SparseDataset
     from hivemall_tpu.models.fm import FFMTrainer
@@ -185,6 +188,7 @@ def _criteo_synth(n_rows: int, seed: int, smoke: bool = False):
         B, L, F, K = 16384, 39, 39, 4
         dims = 1 << 22
         extra = "-ffm_table parts"
+    extra = f"{extra} {extra_opts}".strip()
     rng = np.random.default_rng(seed)
     idx = rng.integers(1, dims, (n_rows, L)).astype(np.int32)
     fld = np.tile(np.arange(L, dtype=np.int32), (n_rows, 1))
@@ -364,6 +368,69 @@ def bench_ffm_parquet_stream(n_rows: int = 131072, smoke: bool = False) -> dict:
         "decode_ahead": stream.decode_ahead,
         "shard_decode": shard_decode,
         "pipeline": pipeline_stats,
+    }
+
+
+def bench_shard_cache(n_rows: int = 131072, smoke: bool = False) -> dict:
+    """Packed shard cache (round 6, -shard_cache_dir): cold epoch (live
+    parse/canonicalize/pack + cache build tee) vs warm epoch (mmap'd
+    records straight into the dispatch path) at the bench_ffm_e2e corpus
+    shape, plus a no-cache baseline so the cache-build overhead is its
+    own number. The warm epoch's PipelineStats must show the prep legs at
+    ZERO — the whole point of the cache — and --smoke floors warm >= cold
+    (a cache that loses to live prep is a regression)."""
+    import os
+    import shutil
+    import tempfile
+    from hivemall_tpu.obs.registry import registry
+
+    tmp = tempfile.mkdtemp(prefix="bench_shard_cache_")
+    try:
+        cache_dir = os.path.join(tmp, "cache")
+        # baseline: identical config and corpus, no cache dir
+        ds, t_base, B, L = _criteo_synth(n_rows, seed=11, smoke=smoke,
+                                         extra_opts="-pack_input on")
+        def fit_once(t):
+            t.fit(ds, epochs=1, shuffle=False)
+            _sync(t)
+
+        base_best, base_med, _ = _repeat(lambda: fit_once(t_base), 3)
+        _, t_cache, _, _ = _criteo_synth(
+            n_rows, seed=11, smoke=smoke,
+            extra_opts=f"-pack_input on -shard_cache_dir {cache_dir}")
+
+        def cold_run():
+            shutil.rmtree(cache_dir, ignore_errors=True)
+            fit_once(t_cache)
+
+        cold_best, cold_med, _ = _repeat(cold_run, 2)
+        cold_stats = t_cache.pipeline_stats.as_dict()
+        fit_once(t_cache)                   # ensure the cache is built
+        warm_best, warm_med, _ = _repeat(lambda: fit_once(t_cache), 3)
+        warm_stats = t_cache.pipeline_stats.as_dict()
+        cache_section = registry.snapshot().get("ingest_cache", {})
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "metric": "shard_cache_warm_epoch_examples_per_sec",
+        "value": round(n_rows / warm_best, 1),
+        "value_median": round(n_rows / warm_med, 1),
+        "unit": "examples/sec",
+        "cold_epoch_examples_per_sec": round(n_rows / cold_best, 1),
+        "baseline_nocache_examples_per_sec": round(n_rows / base_best, 1),
+        "warm_vs_cold": round(cold_best / warm_best, 3),
+        "build_overhead_frac": round(cold_best / base_best - 1.0, 3),
+        "warm_seconds": round(warm_best, 3),
+        "cold_seconds": round(cold_best, 3),
+        "pipeline_warm": warm_stats,
+        "pipeline_cold": cold_stats,
+        "ingest_cache": cache_section,
+        "note": "cold = live prep + cache-build tee (fresh dir each rep), "
+                "warm = mmap'd record replay (prep legs at zero by "
+                "construction — pipeline_warm pins it), baseline = same "
+                "fit without a cache dir; build_overhead_frac = what the "
+                "tee adds to epoch 1, warm_vs_cold = what every later "
+                "epoch/restart gets back",
     }
 
 
@@ -1033,7 +1100,7 @@ def bench_topk_knn() -> dict:
 
 
 _BENCHES = ("bench_linear", "bench_ffm_kernel", "bench_ffm_e2e",
-            "bench_ffm_parquet_stream", "bench_ingest",
+            "bench_ffm_parquet_stream", "bench_shard_cache", "bench_ingest",
             "bench_dispatch_fusion", "bench_serve", "bench_fm",
             "bench_mf", "bench_word2vec", "bench_trees", "bench_gbt",
             "bench_seq_exact", "bench_mix", "bench_lda",
@@ -1131,6 +1198,7 @@ _SMOKE = (
     ("bench_ingest", {"n_rows": 2000}),
     ("bench_ffm_e2e", {"n_rows": 512, "smoke": True}),
     ("bench_ffm_parquet_stream", {"n_rows": 512, "smoke": True}),
+    ("bench_shard_cache", {"n_rows": 8192, "smoke": True}),
     ("bench_dispatch_fusion", {"n_batches": 24, "smoke": True}),
     ("bench_serve", {"smoke": True}),
 )
@@ -1182,6 +1250,20 @@ def main_smoke() -> int:
                 assert rec["value"] > 0 and rec["p50_ms"] > 0 \
                     and rec["p99_ms"] >= rec["p50_ms"], rec
                 assert rec["shed"] == 0, rec
+            if name == "bench_shard_cache":
+                # the cache floor (round 6): a warm mmap epoch must never
+                # run slower than the cold build epoch, and its prep legs
+                # (parse/canonicalize/pack) must be EXACTLY zero — the
+                # batches came off the cache, not the prep pipeline
+                assert rec["warm_vs_cold"] >= 1.0, \
+                    (f"warm cached epoch ({rec['value']} ex/s) regressed "
+                     f"below the cold build epoch "
+                     f"({rec['cold_epoch_examples_per_sec']} ex/s)")
+                pw = rec["pipeline_warm"]
+                assert pw["batches_prepared"] == 0 \
+                    and pw["prep_seconds"] == 0.0 \
+                    and pw["cache_batches"] > 0, pw
+                assert rec["ingest_cache"].get("hits", 0) >= 1, rec
             if name == "bench_dispatch_fusion":
                 # the defusion floor (PR 2): fused K=8 dispatch must not
                 # run slower than per-batch K=1 — run_tests.sh fails on

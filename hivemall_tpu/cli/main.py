@@ -52,7 +52,11 @@ def _load_input(args, trainer):
         kw.update(ffm=True, num_fields=trainer.F)
     if os.path.isdir(path):
         from ..io.arrow import ParquetStream
-        return ParquetStream(path, **kw), True
+        # the trainer's -shard_cache_dir also caches each shard's decoded
+        # CSR columns, so epoch >= 2 / restarts skip Parquet read + parse
+        opts = getattr(trainer, "opts", None)
+        cache_dir = opts.get("shard_cache_dir") if opts is not None else None
+        return ParquetStream(path, cache_dir=cache_dir, **kw), True
     if path.endswith((".parquet", ".pq")):
         from ..io.arrow import read_parquet
         return read_parquet(path, **kw), False

@@ -144,8 +144,18 @@ def read_parquet(path: str, **kw) -> SparseDataset:
     For larger-than-RAM corpora use ParquetStream instead."""
     import pyarrow.parquet as pq
     pa = _pa()
-    tables = [pq.read_table(f) for f in _parquet_files(path)]
-    return table_to_dataset(pa.concat_tables(tables), **kw)
+    files = _parquet_files(path)
+    ds = table_to_dataset(pa.concat_tables([pq.read_table(f)
+                                            for f in files]), **kw)
+    if len(files) == 1:
+        # file identity for the packed shard cache (io.shard_cache):
+        # mtime/size staleness discipline + the parse config (the same
+        # bytes parsed differently are a different dataset)
+        from .shard_cache import file_source_id
+        sid = file_source_id(files[0], {"reader": "parquet", **kw})
+        if sid:
+            ds.source_id = sid
+    return ds
 
 
 def read_csv(path: str, *, feature_cols: Optional[Sequence[str]] = None,
@@ -246,7 +256,7 @@ class ParquetStream:
     def __init__(self, path: str, *, feature_col: str = "features",
                  label_col: str = "label", dims: Optional[int] = None,
                  ffm: bool = False, num_fields: int = 64,
-                 decode_ahead: int = 1):
+                 decode_ahead: int = 1, cache_dir: Optional[str] = None):
         self.files = _parquet_files(path)
         self._kw = dict(feature_col=feature_col, label_col=label_col,
                         dims=dims, ffm=ffm, num_fields=num_fields)
@@ -255,13 +265,30 @@ class ParquetStream:
         # read + string parse + hashing — pyarrow releases the GIL on the
         # IO/decode legs). 0 restores the synchronous per-shard re-read.
         self.decode_ahead = max(0, int(decode_ahead))
+        # per-shard decoded-CSR cache (io.shard_cache.ShardDecodeCache):
+        # the first decode of each (shard mtime/size, parse config) also
+        # persists the parsed columns, so epoch >= 2 and RESTARTS mmap
+        # them instead of re-paying Parquet read + string parse + murmur
+        # hashing — the string-parse-heavy leg of the streaming wall
+        # (docs/PERFORMANCE.md "Shard cache"). None = off.
+        self._cache = None
+        if cache_dir:
+            from .shard_cache import ShardDecodeCache
+            self._cache = ShardDecodeCache(cache_dir, self._kw)
         from .pipeline import PipelineStats
         self.stats = PipelineStats(pool="decode-ahead",
                                    workers=self.decode_ahead)
 
     def _shard(self, path: str) -> SparseDataset:
         import pyarrow.parquet as pq
-        return table_to_dataset(pq.read_table(path), **self._kw)
+        if self._cache is not None:
+            ds = self._cache.load(path)
+            if ds is not None:
+                return ds
+        ds = table_to_dataset(pq.read_table(path), **self._kw)
+        if self._cache is not None:
+            self._cache.store(path, ds)
+        return ds
 
     def _iter_shards(self, files: List[str]) -> Iterator[SparseDataset]:
         """Yield decoded shards in order, reading up to ``decode_ahead``
@@ -318,10 +345,18 @@ class ParquetStream:
     @property
     def max_row_len(self) -> int:
         """Longest row across shards, from the list column's OFFSETS only —
-        no string parse, no hashing, one column read per shard."""
+        no string parse, no hashing, one column read per shard. With the
+        decode cache on, cached shards answer from a header-only read, so
+        a fully warm traversal never opens the source Parquet bytes at
+        all."""
         import pyarrow.parquet as pq
         m = 1
         for f in self.files:
+            if self._cache is not None:
+                hint = self._cache.max_row_len_hint(f)
+                if hint is not None:
+                    m = max(m, hint)
+                    continue
             pf = pq.ParquetFile(f)
             col = "indices" if "indices" in pf.schema_arrow.names \
                 else self._kw["feature_col"]

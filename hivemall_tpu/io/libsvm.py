@@ -42,12 +42,25 @@ def read_libsvm(path: str, *, zero_based: bool = False,
     their own label convention); indices are shifted +1 if ``zero_based`` so
     id 0 stays the padding/bias slot.
     """
+    from .shard_cache import file_source_id
+    parse_cfg = {"reader": "libsvm", "zero_based": zero_based, "ffm": ffm,
+                 "num_fields": num_fields if ffm else None, "dims": dims}
+
+    def _with_sid(ds: SparseDataset) -> SparseDataset:
+        # file identity for the packed shard cache (io.shard_cache):
+        # mtime/size staleness discipline + the parse config (the same
+        # bytes parsed differently are a different dataset)
+        sid = file_source_id(path, parse_cfg)
+        if sid:
+            ds.source_id = sid
+        return ds
+
     if not ffm:
         try:
             from ..utils.native import parse_libsvm_native
             parsed = parse_libsvm_native(path, zero_based=zero_based)
             if parsed is not None:
-                return parsed
+                return _with_sid(parsed)
         except ImportError:
             pass
     labels = []
@@ -87,10 +100,10 @@ def read_libsvm(path: str, *, zero_based: bool = False,
                     indices.append(int(i) + shift)
                 values.append(float(v) if v else 1.0)
             indptr.append(len(indices))
-    return SparseDataset(
+    return _with_sid(SparseDataset(
         np.asarray(indices, np.int32), np.asarray(indptr, np.int64),
         np.asarray(values, np.float32), np.asarray(labels, np.float32),
-        None if fields is None else np.asarray(fields, np.int32))
+        None if fields is None else np.asarray(fields, np.int32)))
 
 
 def write_libsvm(ds: SparseDataset, path: str) -> None:

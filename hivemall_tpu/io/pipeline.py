@@ -137,6 +137,9 @@ class PipelineStats:
     megabatches_staged: int = 0            # K-step windows stacked
     stack_seconds: float = 0.0             # host stacking time (stager)
     singles_flushed: int = 0               # K=1 fallbacks (ragged/kind-mix)
+    cache_batches: int = 0                 # batches served from the packed
+                                           # shard cache (no live prep ran)
+    cache_assemble_seconds: float = 0.0    # mmap gather + buffer re-slice
     queue_occupancy_sum: int = 0           # qsize sampled at each get
     queue_samples: int = 0
     queue_peak: int = 0
@@ -180,6 +183,8 @@ class PipelineStats:
             "megabatches_staged": self.megabatches_staged,
             "stack_seconds": round(self.stack_seconds, 4),
             "singles_flushed": self.singles_flushed,
+            "cache_batches": self.cache_batches,
+            "cache_assemble_seconds": round(self.cache_assemble_seconds, 4),
             "avg_queue_occupancy": round(self.avg_queue_occupancy, 3),
             "queue_peak": self.queue_peak,
             "worker_errors": self.worker_errors,

@@ -400,6 +400,25 @@ class SparseDataset:
     def __len__(self) -> int:
         return len(self.labels)
 
+    def content_key(self) -> str:
+        """sha256 over the CSR payload — the identity the shard cache keys
+        RAM-only datasets by (io.shard_cache; file-backed datasets carry a
+        ``source_id`` mtime/size identity from their reader instead).
+        Cached after the first call; a SparseDataset is write-once."""
+        ck = self.__dict__.get("_content_key")
+        if ck is None:
+            import hashlib
+            h = hashlib.sha256()
+            for a in (self.indices, self.indptr, self.values, self.labels,
+                      self.fields):
+                if a is not None:
+                    a = np.ascontiguousarray(a)
+                    h.update(f"{a.dtype.str}:{a.shape};".encode())
+                    h.update(memoryview(a).cast("B"))
+            ck = h.hexdigest()
+            self.__dict__["_content_key"] = ck
+        return ck
+
     @property
     def max_row_len(self) -> int:
         if len(self) == 0:

@@ -106,6 +106,22 @@ def learner_option_spec(name: str, *, classification: bool,
                "(cores-1 capped at 8 on accelerators, 1 on CPU); 1 = "
                "strict sequential (bit-exact pre-pipeline behavior); "
                "N > 1 = N prep worker threads delivering in order")
+    s.add("ingest_pool", default="auto",
+          help="prep pool kind for -ingest_workers > 1: thread (default — "
+               "the canonicalize/pack prep is GIL-releasing NumPy/C++) | "
+               "process (true multi-process prep for string-parse-heavy "
+               "Python-bound sources; the trainer's prep must be a "
+               "picklable config-built function — FFM and the base "
+               "trainers qualify) | auto (thread)")
+    s.add("shard_cache_dir", default=None,
+          help="ahead-of-time packed shard cache directory "
+               "(io.shard_cache): after the first epoch parses/"
+               "canonicalizes/packs a source, the prepared buffers "
+               "persist keyed by (source identity, prep-config digest); "
+               "later epochs, -iters replays and restarts mmap them and "
+               "skip host prep entirely. Parquet shard directories also "
+               "cache their decoded CSR columns here. See "
+               "docs/PERFORMANCE.md 'Shard cache'")
     s.add("steps_per_dispatch", type=int, default=0,
           help="fused multi-step dispatch: stack K prepared minibatches "
                "into ONE h2d transfer and run all K optimizer steps in "
@@ -161,6 +177,13 @@ def learner_option_spec(name: str, *, classification: bool,
                "MixServer-JMX analog for the training runtime; 0 = off")
     s.flag("cv", help="track cumulative loss for convergence check")
     return s
+
+
+def _identity_prep(batch):
+    """Module-level identity prep — the picklable stand-in for trainers
+    whose parallel prep leg is the base no-op, so ``-ingest_pool process``
+    works for every trainer (a bound method would not cross the fork)."""
+    return batch
 
 
 _STEP_BUILDER_CACHE: dict = {}
@@ -442,7 +465,10 @@ class LearnerBase:
         epochs = int(self.opts.iters) if epochs is None else epochs
         bs = int(self.opts.mini_batch)
         labels = self._convert_labels(ds.labels)
+        sid = getattr(ds, "source_id", None)   # survives the label rebuild:
         ds = SparseDataset(ds.indices, ds.indptr, ds.values, labels, ds.fields)
+        if sid:                                # the shard cache keys on it
+            ds.source_id = sid
         if self._wants_fit_ds():
             self._fit_ds = ds             # emission-time metadata (FFM pairs)
         # elastic recovery (SURVEY.md §6): per-epoch bundle when requested
@@ -579,6 +605,30 @@ class LearnerBase:
         from ..io.pipeline import auto_workers
         return auto_workers()
 
+    def _resolved_ingest_pool(self) -> str:
+        """-ingest_pool with auto = thread: the in-tree prep profile
+        (padding fancy-indexing, canonicalize, pack) is GIL-releasing
+        NumPy/C++, so threads win by skipping per-batch pickling; process
+        is the explicit opt-in for Python-bound string-parse prep."""
+        p = str(self.opts.get("ingest_pool") or "auto")
+        if p not in ("auto", "thread", "process"):
+            raise ValueError(
+                f"-ingest_pool must be auto|thread|process, got {p!r}")
+        return "thread" if p == "auto" else p
+
+    def _picklable_prep(self):
+        """The parallel prep leg as a PICKLABLE callable for
+        ``-ingest_pool process`` (a bound trainer method cannot cross the
+        fork: it would drag the whole trainer — device arrays included —
+        through pickle per task). Base trainers' parallel leg is the
+        identity, which is trivially picklable; trainers that override the
+        leg must also override this (FFM builds one from a plain prep
+        config dataclass) or process pools fall back to threads."""
+        if type(self)._preprocess_train_parallel \
+                is LearnerBase._preprocess_train_parallel:
+            return _identity_prep
+        return None
+
     def _ingest_iter(self, src, closers: List):
         """Route ``_preprocess_train_batch`` over ``src`` through the
         parallel ingest pipeline (io.pipeline). workers <= 1 is a strict
@@ -593,12 +643,29 @@ class LearnerBase:
         composed into the SOURCE, so the pipeline's single submitter
         thread runs it in stream order; only the order-independent
         parallel leg fans out. The composition equals
-        _preprocess_train_batch exactly on every path."""
+        _preprocess_train_batch exactly on every path.
+
+        ``-ingest_pool process`` swaps the bound parallel leg for the
+        trainer's picklable config-built equivalent (same function of the
+        batch, pinned bit-exact by tests/test_pipeline.py); trainers
+        without one fall back to the thread pool with a warning."""
         from ..io.pipeline import IngestPipeline
-        pipe = IngestPipeline(map(self._preprocess_train_serial, src),
-                              self._preprocess_train_parallel,
+        pool = self._resolved_ingest_pool()
+        fn = self._preprocess_train_parallel
+        if pool == "process":
+            pfn = self._picklable_prep()
+            if pfn is None:
+                import warnings
+                warnings.warn(
+                    f"{type(self).__name__} has no picklable prep for "
+                    f"-ingest_pool process; falling back to threads",
+                    RuntimeWarning, stacklevel=2)
+                pool = "thread"
+            else:
+                fn = pfn
+        pipe = IngestPipeline(map(self._preprocess_train_serial, src), fn,
                               workers=self._resolved_ingest_workers(),
-                              stats=self.pipeline_stats)
+                              pool=pool, stats=self.pipeline_stats)
         closers.append(pipe.close)
         return pipe
 

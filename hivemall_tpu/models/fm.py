@@ -545,6 +545,118 @@ class FMTrainer(LearnerBase):
         self.params["w"] = jnp.asarray(w, self.params["w"].dtype)
 
 
+# --- FFM host prep as pure config-parameterized functions -------------------
+# The parallel prep leg (canonicalize -> parts row pad -> pack) must exist
+# in TWO callable forms with identical semantics: the bound trainer methods
+# (thread pools, sequential fallback) and a PICKLABLE config-built callable
+# for -ingest_pool process — a bound method would drag the whole trainer
+# (device tables included) through pickle per task and cannot cross the
+# fork. Both forms call the module functions below, so they can never
+# drift; tests/test_pipeline.py pins process == thread == sequential
+# bit-exact.
+
+from dataclasses import dataclass as _dataclass
+
+
+def _ffm_canonicalize(batch: SparseBatch, F: int, canon_on: bool,
+                      forced: bool) -> SparseBatch:
+    """Canonicalize one host batch into field-major slots (slot s holds a
+    feature of field s % F) so the jitted step can run the static
+    field-grouped interaction — no L^2 intermediate, no per-slot field
+    array. Skipped (general pair path) when the trainer/layout doesn't use
+    it (``canon_on``), when a row has > 4 same-field features, or when the
+    canonical width m*F would more than double the batch (rows sparse
+    relative to the field space — the pair kernel is cheaper there).
+    ``forced`` (-ffm_interaction fieldmajor) disables the width bail and
+    raises on overflow instead of falling back."""
+    if not canon_on or batch.fieldmajor or batch.field is None:
+        return batch
+    L = int(batch.idx.shape[1])
+    if not forced and F > 2 * L:            # even m=1 inflates > 2x
+        return batch
+    res = canonicalize_fieldmajor(
+        np.asarray(batch.idx), np.asarray(batch.val),
+        np.asarray(batch.field), F)
+    if res is None or (not forced and res[2] * F > 2 * L):
+        if forced and res is None:
+            raise ValueError(
+                "-ffm_interaction fieldmajor: a row has more than 4 "
+                "features in one field; use -ffm_interaction auto")
+        return batch
+    idx2, val2, _ = res
+    if np.array_equal(val2, (idx2 != 0).astype(np.float32)):
+        # unit-value elision: skip the val array entirely (a third of
+        # the h2d bytes; the step rebuilds it from idx on device)
+        val2 = None
+    return SparseBatch(idx2, val2, batch.label, None,
+                       n_valid=batch.n_valid, fieldmajor=True)
+
+
+def _parts_row_target(B: int, dp: int = 1) -> int:
+    """The parts kernel's allocated row count for ``B`` logical rows:
+    whole 128-row tiles (the SMEM row-id packing) up to 2048, then whole
+    2048-row chunks, scaled by the dp axis. The ONE copy of the grid rule
+    — the streamed pad (_ffm_pad_parts) and the shard cache's batch
+    assembly (_cache_row_pad) must agree or cached batches stop matching
+    the compiled buckets."""
+    mult = 128 * dp if B <= 2048 * dp else 2048 * dp
+    return -(-B // mult) * mult
+
+
+def _ffm_pad_parts(batch: SparseBatch, dp: int = 1) -> SparseBatch:
+    """Pad the batch's row count to the Pallas parts kernel's grid
+    multiple (_parts_row_target); padded rows carry idx 0 and are masked
+    out of the loss by n_valid. Under -mesh each dp rank must receive
+    whole tiles, so the multiple scales by dp on both branches."""
+    B = batch.batch_size
+    target = _parts_row_target(B, dp)
+    if target == B:
+        return batch
+    pad = target - B
+    idx = np.pad(np.asarray(batch.idx), ((0, pad), (0, 0)))
+    val = None if batch.val is None else np.pad(
+        np.asarray(batch.val), ((0, pad), (0, 0)))
+    lab = np.pad(np.asarray(batch.label), (0, pad))
+    nv = batch.n_valid if batch.n_valid is not None else B
+    return SparseBatch(idx, val, lab, None, n_valid=nv, fieldmajor=True)
+
+
+@_dataclass(frozen=True)
+class FFMPrep:
+    """Picklable FFM train-prep: a plain dataclass of the option-derived
+    booleans the bound prep reads off the trainer, so a process-pool
+    worker rebuilds the exact same function from ~5 scalars instead of a
+    pickled trainer. ``__call__`` IS ``_preprocess_train_parallel``."""
+
+    F: int
+    canon: bool          # a field-major step exists (joint/parts layouts)
+    forced: bool         # -ffm_interaction fieldmajor
+    parts: bool          # parts layout: kernel-grid row padding
+    pack: bool           # packed uint8 wire format conditions all hold
+    parts_dp: int = 1
+
+    def __call__(self, batch: SparseBatch):
+        batch = _ffm_canonicalize(batch, self.F, self.canon, self.forced)
+        if self.parts and batch.fieldmajor:
+            batch = _ffm_pad_parts(batch, self.parts_dp)
+        if (self.pack and batch.fieldmajor and batch.val is None
+                and isinstance(batch.idx, np.ndarray)):
+            return pack_unit_fieldmajor(batch)
+        return batch
+
+
+def _tee_into_writer(src, writer, order, bs: int):
+    """Yield prepared batches unchanged while scattering each one's rows
+    into a shard-cache writer (batch i covers order[i*bs:(i+1)*bs] — the
+    same chunking ds.batches applied to the same permutation). Runs on
+    whatever single thread consumes the prep pipeline."""
+    i = 0
+    for b in src:
+        writer.add(b, order[i * bs:(i + 1) * bs])
+        i += 1
+        yield b
+
+
 class FFMTrainer(FMTrainer):
     """SQL: train_ffm — reference hivemall.fm.FieldAwareFactorizationMachineUDTF.
 
@@ -790,31 +902,167 @@ class FFMTrainer(FMTrainer):
             return pack_unit_fieldmajor(batch)
         return batch
 
+    def _picklable_prep(self):
+        # the process-pool form of the leg above: same module functions,
+        # parameterized by a plain dataclass instead of bound state
+        return FFMPrep(
+            F=self.F, canon=self._step_fm is not None,
+            forced=self.interaction == "fieldmajor",
+            parts=self.layout == "parts",
+            parts_dp=(self.mesh.shape["dp"] if self.mesh is not None
+                      else 1),
+            pack=(self._pack_input_on() and self._step_fm_unit is not None
+                  and self.dims <= (1 << 24)))
+
     _DEVICE_CACHE_MB = 2048      # HBM budget for the -iters replay cache
 
-    def _fit_epochs(self, ds, epochs, bs, shuffle, prefetch, ckdir) -> None:
-        """Multi-epoch fit with a DEVICE-RESIDENT replay cache (round 4).
+    # -- the on-disk packed shard cache (-shard_cache_dir, io.shard_cache) --
+    def _prep_cache_config(self) -> dict:
+        """The prep-config identity the shard cache keys on: everything
+        that changes the canonical packed bytes a source row preps into —
+        layout geometry AND label conversion. Batch size is deliberately
+        absent (the cache is row-level; any bs re-slices the same
+        records)."""
+        o = self.opts
+        return {"trainer": self.NAME, "record": 1, "dims": self.dims,
+                "fields": self.F, "layout": self.layout,
+                "interaction": self.interaction,
+                "classification": bool(self.classification),
+                "min_target": o.min_target, "max_target": o.max_target}
 
-        The reference's -iters pattern re-reads the corpus every epoch; the
-        round-3 disk replay did too — and through this relay every epoch
-        re-paid the full h2d wall. When the packed input path is active and
-        the dataset fits the HBM budget, epoch 1 streams normally but
-        RETAINS its staged device buffers; epochs >= 2 reshuffle with ONE
-        on-device row gather (~26 ns/row — thousands of times cheaper than
-        re-transferring) and run at near-kernel rate. Padded tail rows stay
-        at the END of the replay matrix so per-batch validity remains a
-        prefix (the packed step's nv-scalar contract)."""
-        if (epochs <= 1 or ckdir or self.mesh is not None
-                or not self._pack_input_on()):
+    def _packed_cache(self):
+        """PackedShardCache when -shard_cache_dir is set AND this config's
+        prep lands on the packed wire format (the cache stores exactly
+        those bytes); None otherwise — dense layout, pairs-only
+        interaction, mesh/mix (pack off), or dims past the 3-byte lane
+        range all decline."""
+        ckdir = self.opts.get("shard_cache_dir")
+        if not ckdir or self.layout == "dense":
+            return None
+        if self._step_fm is None or self._step_fm_unit is None:
+            return None
+        if not self._pack_input_on() or self.dims > (1 << 24):
+            return None
+        from ..io.shard_cache import PackedShardCache
+        return PackedShardCache(ckdir, self._prep_cache_config(),
+                                F=self.F, name=self.NAME)
+
+    def _cache_row_pad(self, B: int) -> int:
+        """Allocated row count for a cached batch of ``B`` logical rows —
+        the parts layout's kernel-grid padding (single-chip rule; the
+        cache is off under -mesh), identity for joint."""
+        return _parts_row_target(B) if self.layout == "parts" else B
+
+    def _streamed_epoch(self, ds, bs, shuffle, seed, prefetch, writer,
+                        order) -> None:
+        """One base-loop epoch (prep pipeline -> megabatch stacking ->
+        prefetch -> dispatch), optionally teeing every prepared
+        PackedBatch into a shard-cache writer."""
+        closers: list = []
+        it = self._ingest_iter(ds.batches(bs, shuffle=shuffle, seed=seed),
+                               closers)
+        if writer is not None:
+            it = _tee_into_writer(it, writer, order, bs)
+        it = self._wrap_megabatch(it, prefetch=prefetch)
+        if prefetch:
+            it = self._wrap_prefetch(it, closers)
+        try:
+            for b in it:
+                self._dispatch(b)
+        finally:
+            for c in reversed(closers):
+                c()
+
+    def _cached_epoch(self, shard, bs, order, prefetch) -> None:
+        """One epoch served from the mmap'd shard cache: parse,
+        canonicalize and pack never run — record gather + h2d + step is
+        the whole host leg."""
+        closers: list = []
+        it = shard.batches(bs, order, stats=self.pipeline_stats,
+                           pad_rows=self._cache_row_pad)
+        it = self._wrap_megabatch(it, prefetch=prefetch)
+        if prefetch:
+            it = self._wrap_prefetch(it, closers)
+        try:
+            for b in it:
+                self._dispatch(b)
+        finally:
+            for c in reversed(closers):
+                c()
+
+    def _fit_epochs(self, ds, epochs, bs, shuffle, prefetch, ckdir,
+                    seed0: int = 42) -> None:
+        """Multi-epoch fit with TWO replay caches.
+
+        DEVICE-RESIDENT replay (round 4): the reference's -iters pattern
+        re-reads the corpus every epoch; the round-3 disk replay did too —
+        and through this relay every epoch re-paid the full h2d wall. When
+        the packed input path is active and the dataset fits the HBM
+        budget, epoch 1 streams normally but RETAINS its staged device
+        buffers; epochs >= 2 reshuffle with ONE on-device row gather
+        (~26 ns/row — thousands of times cheaper than re-transferring) and
+        run at near-kernel rate. Padded tail rows stay at the END of the
+        replay matrix so per-batch validity remains a prefix (the packed
+        step's nv-scalar contract).
+
+        ON-DISK packed shard cache (round 6, -shard_cache_dir): the cold
+        epoch additionally tees its prepared PackedBatches into a
+        digest-keyed cache file; RESTARTS, repeat fits, and any epoch the
+        HBM replay can't cover (over budget, -checkpoint_dir runs, CPU
+        hosts) then mmap the prepared records and skip parse/canonicalize/
+        pack entirely — shuffled or not, bit-exact vs the streamed path
+        (warm epoch ep reuses the exact seed0+ep permutation). Both caches
+        compose: a warm shard-cache epoch 1 still feeds the HBM retention
+        for on-device epochs >= 2."""
+        cache = self._packed_cache()
+        if cache is None and (epochs <= 1 or ckdir or self.mesh is not None
+                              or not self._pack_input_on()):
             return super()._fit_epochs(ds, epochs, bs, shuffle, prefetch,
-                                       ckdir)
+                                       ckdir, seed0)
         if prefetch is None:
             prefetch = jax.default_backend() != "cpu"
+        shard = writer = None
+        if cache is not None:
+            shard = cache.load(ds)
+            if shard is None:
+                writer = cache.writer(ds)   # None: uncacheable rows
 
-        # ---- epoch 1: normal streamed epoch, retaining staged buffers ----
+        def order_for(ep):
+            return (np.random.default_rng(seed0 + ep).permutation(len(ds))
+                    if shuffle else np.arange(len(ds)))
+
+        device_replay = (epochs > 1 and not ckdir and self.mesh is None
+                         and self._pack_input_on())
+        if not device_replay:
+            # shard-cache orchestration for the configs HBM replay
+            # excludes (single epoch, -checkpoint_dir): warm epochs serve
+            # from the cache, the first cold epoch tees into the writer
+            for ep in range(epochs):
+                if shard is not None:
+                    self._cached_epoch(shard, bs, order_for(ep), prefetch)
+                else:
+                    self._streamed_epoch(ds, bs, shuffle, seed0 + ep,
+                                         prefetch,
+                                         writer if ep == 0 else None,
+                                         order_for(ep))
+                    if ep == 0 and writer is not None:
+                        shard = writer.commit()   # None: build fell open
+                        writer = None
+                if ckdir:
+                    self._save_epoch_bundle(ckdir, ep + 1)
+            return
+
+        # ---- epoch 1: streamed (or shard-cache-served) epoch, retaining
+        # staged buffers for the on-device replay of epochs >= 2 ----
         closers: list = []
-        it = self._ingest_iter(ds.batches(bs, shuffle=shuffle, seed=42),
-                               closers)
+        if shard is not None:
+            it = shard.batches(bs, order_for(0), stats=self.pipeline_stats,
+                               pad_rows=self._cache_row_pad)
+        else:
+            it = self._ingest_iter(
+                ds.batches(bs, shuffle=shuffle, seed=seed0), closers)
+            if writer is not None:
+                it = _tee_into_writer(it, writer, order_for(0), bs)
         if prefetch:
             it = self._wrap_prefetch(it, closers)
         try:
@@ -822,12 +1070,23 @@ class FFMTrainer(FMTrainer):
         finally:
             for c in reversed(closers):
                 c()
+        if writer is not None:
+            shard = writer.commit()
         mat = self._staged_matrix(staged)
         del staged           # free the per-batch buffers BEFORE replay:
         # peak device memory stays ~M (+Mp), not M + the staged copies
         if mat is None:
-            return super()._fit_epochs(ds, epochs - 1, bs, shuffle,
-                                       prefetch, ckdir, seed0=43)
+            # HBM replay unsafe or over budget: warm shard-cache epochs
+            # when available (exactly the -iters-over-budget case the disk
+            # cache exists for), else re-stream on the uninterrupted
+            # seed schedule
+            for ep in range(1, epochs):
+                if shard is not None:
+                    self._cached_epoch(shard, bs, order_for(ep), prefetch)
+                else:
+                    super()._fit_epochs(ds, 1, bs, shuffle, prefetch, None,
+                                        seed0=seed0 + ep)
+            return
         if mat == ():
             return                       # empty dataset, nothing to replay
         self._replay_epochs(mat, epochs - 1, shuffle)
@@ -1020,60 +1279,18 @@ class FFMTrainer(FMTrainer):
         return _packed_wrap_cached(self._step_fm_unit, B, L)
 
     def _pad_parts_rows(self, batch: SparseBatch) -> SparseBatch:
-        """Pad the batch's row count to the Pallas kernel's grid multiple
-        (128 rows — the SMEM row-id packing — up to 2048, then 2048-row
-        chunks); padded rows carry idx 0 and are masked out of the loss by
-        n_valid. Under -mesh each dp rank must receive whole 128-row
-        tiles, so the multiple scales by dp."""
-        B = batch.batch_size
-        dp = self.mesh.shape["dp"] if self.mesh is not None else 1
-        # per-rank rows must be a multiple of 128 and, above 2048, of 2048
-        # (the kernel's chunk grid floors otherwise) — so the GLOBAL
-        # multiple scales by dp on both branches
-        mult = 128 * dp if B <= 2048 * dp else 2048 * dp
-        target = -(-B // mult) * mult
-        if target == B:
-            return batch
-        pad = target - B
-        idx = np.pad(np.asarray(batch.idx), ((0, pad), (0, 0)))
-        val = None if batch.val is None else np.pad(
-            np.asarray(batch.val), ((0, pad), (0, 0)))
-        lab = np.pad(np.asarray(batch.label), (0, pad))
-        nv = batch.n_valid if batch.n_valid is not None else B
-        return SparseBatch(idx, val, lab, None, n_valid=nv,
-                           fieldmajor=True)
+        """Parts-layout kernel-grid row padding (see _ffm_pad_parts — the
+        module function is the single implementation, shared with the
+        picklable process-pool prep)."""
+        return _ffm_pad_parts(
+            batch, self.mesh.shape["dp"] if self.mesh is not None else 1)
 
     def _canonicalize_batch(self, batch: SparseBatch) -> SparseBatch:
-        """Canonicalize one host batch into field-major slots (slot s holds
-        a feature of field s % F) so the jitted step can run the static
-        field-grouped interaction — no L^2 intermediate, no per-slot field
-        array. Skipped (general pair path) when the trainer/layout doesn't
-        use it, when a row has > 4 same-field features, or when the
-        canonical width m*F would more than double the batch (rows sparse
-        relative to the field space — the pair kernel is cheaper there)."""
-        if (self._step_fm is None or batch.fieldmajor
-                or batch.field is None):
-            return batch
-        L = int(batch.idx.shape[1])
-        forced = self.interaction == "fieldmajor"
-        if not forced and self.F > 2 * L:       # even m=1 inflates > 2x
-            return batch
-        res = canonicalize_fieldmajor(
-            np.asarray(batch.idx), np.asarray(batch.val),
-            np.asarray(batch.field), self.F)
-        if res is None or (not forced and res[2] * self.F > 2 * L):
-            if forced and res is None:
-                raise ValueError(
-                    "-ffm_interaction fieldmajor: a row has more than 4 "
-                    "features in one field; use -ffm_interaction auto")
-            return batch
-        idx2, val2, _ = res
-        if np.array_equal(val2, (idx2 != 0).astype(np.float32)):
-            # unit-value elision: skip the val array entirely (a third of
-            # the h2d bytes; the step rebuilds it from idx on device)
-            val2 = None
-        return SparseBatch(idx2, val2, batch.label, None,
-                           n_valid=batch.n_valid, fieldmajor=True)
+        """Field-major canonicalization (see _ffm_canonicalize — the
+        module function is the single implementation, shared with the
+        picklable process-pool prep)."""
+        return _ffm_canonicalize(batch, self.F, self._step_fm is not None,
+                                 self.interaction == "fieldmajor")
 
     # -- fused multi-step dispatch (-steps_per_dispatch) ---------------------
     def _supports_megastep(self) -> bool:

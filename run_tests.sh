@@ -29,6 +29,27 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.serve.smoke || exit $?
 
+# shard-cache smoke (docs/PERFORMANCE.md "Shard cache"): a cold fit must
+# build the packed cache, a fresh-trainer warm fit must bit-match its loss
+# trajectory with ZERO live prep, and the Parquet decode cache must keep
+# serving the original bytes after the source shard's content is mutated
+# in place (mtime/size preserved) — proof warm epochs never re-read the
+# source.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m hivemall_tpu.io.shard_cache --smoke || exit $?
+
+# native-canonicalizer CI guard: the C++ canonicalizer is the DEFAULT in
+# every prep path (fit / fit_stream / serve-side scoring), with the numpy
+# twin as the fallback — when _native.so exists, the bit-equality parity
+# test must actually RUN (a silent skip would unpin the default path).
+if [ -f native/_native.so ]; then
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_native.py::test_canonicalize_native_matches_numpy -q \
+        2>&1 | grep -q "1 passed" || {
+        echo "FAIL: canonicalizer parity test skipped/failed although" \
+             "native/_native.so exists"; exit 1; }
+fi
+
 # bench harness smoke: tiny-shape runs of the ingest-path benches assert
 # every metric still emits and parses (pipeline refactors must not silently
 # break bench.py), and the dispatch-fusion microbench enforces its floor —

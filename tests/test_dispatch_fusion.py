@@ -325,3 +325,47 @@ def test_mesh_linear_k4_matches_k1():
     np.testing.assert_allclose(l4, l1, rtol=1e-5, atol=1e-6)
     w4 = t4.w
     assert w4.sharding.shard_shape(w4.shape)[0] == (1 << 10) // 2
+
+
+def test_shard_cached_k8_matches_k1_streamed(tmp_path):
+    """The packed shard cache (round 6, -shard_cache_dir) feeds the SAME
+    megabatch stacking the streamed path uses: warm (mmap-served) epochs
+    at K=1 and K=8 reproduce the streamed K=1 trajectory bit-exactly, and
+    K=8 actually forms fused windows from the cached PackedBatches."""
+    ds = _ffm_ds(n=4096, dims=1 << 12, seed=40)
+    cdir = str(tmp_path / "cache")
+
+    def make(k, cache):
+        extra = f" -shard_cache_dir {cdir}" if cache else ""
+        return FFMTrainer(
+            f"-dims {1 << 12} -factors 2 -fields 8 -mini_batch 256 "
+            f"-classification -pack_input on -steps_per_dispatch {k}"
+            + extra)
+
+    def traj(k, cache):
+        t = make(k, cache)
+        t._trace_losses = []
+        t.fit(ds, epochs=1, shuffle=True)
+        return np.asarray(t._trace_losses), t
+
+    l1, _ = traj(1, False)                   # streamed reference
+    l1_cold, _ = traj(1, True)               # cold: builds the cache
+    l1_warm, t1w = traj(1, True)             # warm K=1
+    l8_warm, t8w = traj(8, True)             # warm K=8 through the stager
+    np.testing.assert_array_equal(l1, l1_cold)
+    np.testing.assert_array_equal(l1, l1_warm)
+    np.testing.assert_array_equal(l1, l8_warm)
+    # warm runs never prep; K=8 stacked the cached batches into megasteps
+    assert t1w.pipeline_stats.batches_prepared == 0
+    assert t8w.pipeline_stats.batches_prepared == 0
+    assert t8w.pipeline_stats.megabatches_staged == 2   # 16 batches @ K=8
+    assert t8w.pipeline_stats.cache_batches == 16
+    # a COLD build under K=8 (tee sits before the stager, so it records
+    # singles) produces a cache a K=1 warm run replays bit-exactly too
+    import shutil
+    shutil.rmtree(cdir)
+    l8_cold, t8c = traj(8, True)
+    np.testing.assert_array_equal(l1, l8_cold)
+    assert t8c.pipeline_stats.megabatches_staged == 2
+    l1_warm2, _ = traj(1, True)
+    np.testing.assert_array_equal(l1, l1_warm2)

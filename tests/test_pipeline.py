@@ -112,6 +112,62 @@ def _double_idx(b):
                    n_valid=b.n_valid, fieldmajor=b.fieldmajor)
 
 
+def test_ffm_process_pool_prep_bit_exact():
+    """-ingest_pool process on the flagship prep (canonicalize + pack via
+    the picklable FFMPrep config, NOT a bound trainer method): bit-exact
+    vs the thread pool and the sequential path, in order."""
+    import json
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.models.fm import FFMTrainer
+
+    rng = np.random.default_rng(23)
+    n, L, F = 256, 8, 8
+    idx = rng.integers(1, 2048, (n, L)).astype(np.int32)
+    fld = np.tile(np.arange(L, dtype=np.int32) % F, (n, 1))
+    lab = (rng.integers(0, 2, n) * 2 - 1).astype(np.float32)
+    indptr = np.arange(0, n * L + 1, L, dtype=np.int64)
+    ds = SparseDataset(idx.ravel(), indptr, np.ones(n * L, np.float32),
+                       lab, fld.ravel())
+    cfg = ("-dims 2048 -factors 2 -fields 8 -mini_batch 64 "
+           "-classification -iters 2")
+    seq = FFMTrainer(cfg + " -ingest_workers 1").fit(ds)
+    thr = FFMTrainer(cfg + " -ingest_workers 3 -ingest_pool thread").fit(ds)
+    prc = FFMTrainer(cfg + " -ingest_workers 2 -ingest_pool process").fit(ds)
+    assert prc.pipeline_stats.pool == "process"
+    s = json.dumps(seq.model_table(), sort_keys=True, default=str)
+    assert s == json.dumps(thr.model_table(), sort_keys=True, default=str)
+    assert s == json.dumps(prc.model_table(), sort_keys=True, default=str)
+
+
+def test_process_pool_without_picklable_prep_falls_back_to_threads():
+    """A trainer whose parallel prep leg is bound-only must warn and run
+    the thread pool instead of crashing in the child."""
+    from hivemall_tpu.models.linear import GeneralClassifier
+
+    class BoundPrep(GeneralClassifier):
+        def _preprocess_train_parallel(self, batch):
+            return batch
+
+    ds, _ = synthetic_classification(128, 8, seed=24)
+    t = BoundPrep("-dims 256 -mini_batch 32 -ingest_workers 2 "
+                  "-ingest_pool process")
+    with pytest.warns(RuntimeWarning, match="picklable"):
+        t.fit(ds)
+    assert t.pipeline_stats.pool == "thread"
+    assert t.pipeline_stats.batches_prepared > 0
+
+
+def test_base_trainer_process_pool_matches_sequential():
+    from hivemall_tpu.models.linear import GeneralClassifier
+
+    ds, _ = synthetic_classification(300, 20, seed=25)
+    opts = "-dims 512 -loss logloss -opt adagrad -mini_batch 32"
+    seq = GeneralClassifier(opts + " -ingest_workers 1").fit(ds)
+    prc = GeneralClassifier(opts + " -ingest_workers 2 "
+                                   "-ingest_pool process").fit(ds)
+    np.testing.assert_array_equal(np.asarray(seq.w), np.asarray(prc.w))
+
+
 def test_backpressure_bounds_inflight():
     """A slow consumer must not let the pipeline race ahead unbounded."""
     produced = []
