@@ -262,7 +262,13 @@ def _cmd_serve(args) -> int:
     """Online prediction server (docs/SERVING.md): load a checkpoint
     bundle, serve /predict with dynamic micro-batching, hot-reload newer
     autosaved bundles from --checkpoint-dir (a live trainer writing into
-    the same directory is the intended pairing)."""
+    the same directory is the intended pairing).
+
+    ``--replicas N`` switches to the fleet topology (docs/SERVING.md
+    "Fleet topology"): N engine processes behind a health-gated router,
+    with manager-coordinated rolling hot reload and crash respawn."""
+    if args.replicas > 0:
+        return _cmd_serve_fleet(args)
     from ..serve.engine import PredictEngine
     from ..serve.http import PredictServer
 
@@ -290,6 +296,49 @@ def _cmd_serve(args) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         srv.stop()
+    return 0
+
+
+def _cmd_serve_fleet(args) -> int:
+    """`serve --replicas N`: replica manager + front-end router."""
+    from ..serve.fleet import Fleet
+
+    try:
+        fleet = Fleet(
+            args.algo, args.options or "",
+            checkpoint_dir=args.checkpoint_dir, bundle=args.bundle,
+            replicas=args.replicas, host=args.host, port=args.port,
+            policy=args.router_policy,
+            watch_interval=args.watch_interval,
+            serve_kwargs={
+                "max_batch": args.serve_max_batch,
+                "max_delay_ms": args.serve_max_delay_ms,
+                "max_queue_rows": args.serve_max_queue,
+                "deadline_ms": args.serve_deadline_ms,
+            }).start(wait_ready=True)
+    except (FileNotFoundError, ValueError, RuntimeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    ready = sum(1 for h in fleet.router.replicas() if h.ready)
+    print(json.dumps({"host": fleet.host, "port": fleet.port,
+                      "algo": args.algo, "replicas": args.replicas,
+                      "ready_replicas": ready,
+                      "policy": args.router_policy,
+                      "fleet_step": fleet.manager.fleet_step}), flush=True)
+    # SIGTERM (systemd stop, docker stop, kill <pid>) must tear the fleet
+    # down like Ctrl-C does — the default handler would kill this process
+    # and orphan every replica worker on its ephemeral port
+    import signal
+
+    def on_term(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, on_term)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        fleet.stop()
     return 0
 
 
@@ -403,6 +452,16 @@ def main(argv=None) -> int:
     sv.add_argument("--no-warmup", action="store_true",
                     help="skip pre-compiling the batch-size buckets at "
                          "startup")
+    sv.add_argument("--replicas", type=int, default=0,
+                    help="fleet mode: spawn N replica processes (one "
+                         "engine each) behind a health-gated router with "
+                         "rolling hot reload and crash respawn; 0 = "
+                         "single in-process server")
+    sv.add_argument("--router-policy", default="least_loaded",
+                    choices=("least_loaded", "hash"),
+                    help="fleet routing: least in-flight with "
+                         "consistent-hash tiebreak (default), or strict "
+                         "consistent hashing of the request body")
     sv.set_defaults(fn=_cmd_serve)
 
     o = sub.add_parser(

@@ -38,7 +38,7 @@ import numpy as np
 from ..obs.trace import get_tracer
 
 __all__ = ["save_bundle", "load_bundle", "CheckpointManager", "list_bundles",
-           "bundle_step", "newest_bundle"]
+           "bundle_step", "newest_bundle", "verify_bundle"]
 
 _FORMAT = 2          # 2 adds the digest manifest + stream position
 _STEP_RE = re.compile(r"-step(\d+)\.npz$")
@@ -123,6 +123,29 @@ def _save_bundle(trainer, path: str) -> None:
         pass
 
 
+def _read_validated(z, path: str, name: Optional[str]):
+    """The shared manifest validation (format version, trainer name,
+    sha256 leaf digest) for a loaded npz — ONE implementation, called by
+    both ``load_bundle`` and ``verify_bundle`` so the fleet manager's
+    pre-roll verification can never drift from what replicas actually
+    enforce at load. Returns ``(meta, raw_leaf_arrays)``."""
+    meta = json.loads(str(z["__meta__"]))
+    if meta.get("format") not in (1, _FORMAT):
+        raise ValueError(
+            f"bundle format {meta.get('format')!r} != supported "
+            f"{_FORMAT} — bundle written by an incompatible version")
+    if name is not None and meta.get("trainer") != name:
+        raise ValueError(
+            f"bundle was written by {meta.get('trainer')!r}, "
+            f"cannot resume {name!r}")
+    raw = [z[f"leaf_{i}"] for i in range(int(meta["n_leaves"]))]
+    if "digest" in meta and _leaf_digest(raw) != meta["digest"]:
+        raise ValueError(
+            f"bundle digest mismatch for {path!r} — file corrupted "
+            f"or truncated (copied mid-write?); refusing to resume")
+    return meta, raw
+
+
 def load_bundle(trainer, path: str) -> None:
     """Restore a bundle into a freshly constructed trainer (same options).
 
@@ -131,26 +154,13 @@ def load_bundle(trainer, path: str) -> None:
     digest — a corrupted or truncated bundle raises ValueError with the
     cause rather than resuming garbage."""
     with np.load(path, allow_pickle=False) as z:
-        meta = json.loads(str(z["__meta__"]))
-        if meta.get("format") not in (1, _FORMAT):
-            raise ValueError(
-                f"bundle format {meta.get('format')!r} != supported "
-                f"{_FORMAT} — bundle written by an incompatible version")
-        if meta.get("trainer") != trainer.NAME:
-            raise ValueError(
-                f"bundle was written by {meta.get('trainer')!r}, "
-                f"cannot resume {trainer.NAME!r}")
+        meta, raw = _read_validated(z, path, trainer.NAME)
         ref_leaves, treedef = jax.tree_util.tree_flatten(
             trainer._checkpoint_arrays())
         if meta["n_leaves"] != len(ref_leaves):
             raise ValueError(
                 f"bundle has {meta['n_leaves']} state arrays, trainer "
                 f"expects {len(ref_leaves)} — options mismatch?")
-        raw = [z[f"leaf_{i}"] for i in range(len(ref_leaves))]
-        if "digest" in meta and _leaf_digest(raw) != meta["digest"]:
-            raise ValueError(
-                f"bundle digest mismatch for {path!r} — file corrupted "
-                f"or truncated (copied mid-write?); refusing to resume")
         leaves = []
         for i, (a, ref) in enumerate(zip(raw, ref_leaves)):
             if tuple(a.shape) != tuple(ref.shape):
@@ -176,6 +186,22 @@ def load_bundle(trainer, path: str) -> None:
         rng.bit_generator.state = meta["rng_state"]
     if getattr(trainer, "mesh", None) is not None:
         trainer._reshard_state()      # bundles load replicated; re-shard
+
+
+def verify_bundle(path: str, name: Optional[str] = None) -> dict:
+    """Validate a bundle WITHOUT constructing a trainer: format version,
+    trainer name (when ``name`` is given), and the sha256 leaf digest.
+    Returns the bundle's meta dict on success; raises ValueError on any
+    mismatch.
+
+    The fleet replica manager runs this ONCE per newer bundle before
+    rolling it across replicas — a corrupt autosave is rejected at the
+    manager, not N times by N replicas mid-roll. Cheaper than a trainer
+    load: no table allocation, no device transfer, no resharding. Runs
+    the SAME validation block replicas run at load (_read_validated)."""
+    with np.load(path, allow_pickle=False) as z:
+        meta, _ = _read_validated(z, path, name)
+    return meta
 
 
 def list_bundles(checkpoint_dir: str, name: str) -> List[str]:

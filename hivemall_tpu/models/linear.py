@@ -86,9 +86,16 @@ class _LinearLearner(LearnerBase):
             batch.idx, batch.val, batch.label, batch.row_mask)
         return loss_sum
 
+    def _finalize_device(self):
+        """Optimizer-finalized weights as a DEVICE array — the one
+        finalization expression; _finalized_weights and the sharded
+        margin fn must never diverge (the online/offline bit-match
+        hangs on it)."""
+        return self.optimizer.finalize(self.w.astype(jnp.float32),
+                                       self.opt_state)
+
     def _finalized_weights(self) -> np.ndarray:
-        w = self.optimizer.finalize(self.w.astype(jnp.float32), self.opt_state)
-        return np.asarray(w)
+        return np.asarray(self._finalize_device())
 
     def _load_weights(self, w: np.ndarray) -> None:
         self.w = jnp.asarray(w, self.w.dtype)
@@ -98,7 +105,16 @@ class _LinearLearner(LearnerBase):
         # optimizer finalization (RDA truncation etc.) captured ONCE per
         # scorer — the serve engine swaps scorers per model version, the
         # offline path builds one per decision_function call
-        w = jnp.asarray(self._finalized_weights())
+        if self.mesh is not None:
+            # GSPMD-sharded scorer (serving tables too big for one chip):
+            # finalize on device and keep the weight table tp-sharded —
+            # np round-tripping here would gather the whole dims-sized
+            # table onto one device and un-shard every predict
+            import jax
+            w = self._finalize_device()
+            w = jax.device_put(w, self._state_sharding(w))
+        else:
+            w = jnp.asarray(self._finalized_weights())
         predict = self._predict
         return lambda b: predict(w, b.idx, b.val)
 

@@ -89,3 +89,11 @@ registry.register("ingest_cache", lambda: {
     "configured": False, "hits": 0, "misses": 0, "invalid": 0,
     "rebuilds": 0, "build_failed": 0, "bytes_mmapped": 0,
     "bytes_written": 0, "canonicalizer": "unresolved"})
+# serve.fleet.ReplicaManager overrides this with its live replica/roll
+# counters when a fleet is running in this process; the stub mirrors the
+# live provider's key set (ReplicaManager.obs_section) so the gauges a
+# dashboard keys on never appear/vanish across manager lifecycle
+registry.register("fleet", lambda: {
+    "replicas": 0, "ready": 0, "respawns": 0, "rolls": 0,
+    "roll_failures": 0, "rejected_bundles": 0, "fleet_step": None,
+    "model_steps": {}})

@@ -13,14 +13,23 @@ as a live server, in the spirit of Clipper-style prediction serving.
                            load shedding on a bounded queue
   http.PredictServer     — HTTP front end: /predict /healthz /reload +
                            the obs registry's /snapshot and /metrics
+  router.RouterServer    — scale-out front door: health-gated least-loaded
+                           (consistent-hash fallback) fan-out over replica
+                           servers, transport-level retry, aggregated
+                           fleet /snapshot + /metrics
+  fleet.ReplicaManager   — one engine PROCESS per replica/device: spawn,
+  fleet.Fleet              health-monitor + respawn, fleet-wide rolling
+                           hot reload (verify once, roll one at a time)
 
-CLI: ``python -m hivemall_tpu.cli serve --algo ... --checkpoint-dir ...``.
+CLI: ``python -m hivemall_tpu.cli serve --algo ... --checkpoint-dir ...``
+(add ``--replicas N`` for the fleet topology).
 Imports stay lazy here — ``hivemall_tpu.serve`` must be importable without
 paying for jax/catalog until a server is actually constructed.
 """
 
 __all__ = ["PredictEngine", "MicroBatcher", "PredictServer",
-           "ServeOverload", "ServeDeadline"]
+           "ServeOverload", "ServeDeadline", "RouterServer",
+           "ReplicaManager", "Fleet"]
 
 
 def __getattr__(name):
@@ -33,4 +42,10 @@ def __getattr__(name):
     if name == "PredictServer":
         from .http import PredictServer
         return PredictServer
+    if name == "RouterServer":
+        from .router import RouterServer
+        return RouterServer
+    if name in ("ReplicaManager", "Fleet"):
+        from . import fleet
+        return getattr(fleet, name)
     raise AttributeError(name)

@@ -248,15 +248,30 @@ class MicroBatcher:
             "errors": self.errors,
         }
 
-    def close(self) -> None:
-        """Stop the dispatch thread; requests still queued fail with a
-        closed error rather than hanging their futures forever."""
+    def close(self, drain: bool = False, timeout: float = 5.0) -> None:
+        """Stop the dispatch thread. New submits fail immediately with a
+        closed error in either mode; what happens to requests ALREADY
+        queued is the ``drain`` choice:
+
+        - ``drain=False`` (default): queued requests fail with the closed
+          error rather than hanging their futures forever — the abrupt
+          shutdown path.
+        - ``drain=True``: the dispatch thread keeps scoring until the
+          queue is empty, so every accepted request completes — the
+          graceful shutdown path (a fleet replica answering its last
+          in-flight requests before the process exits).
+
+        The in-flight batch (already handed to the predict fn) always
+        completes in both modes."""
         with self._cv:
             self._closed = True
-            pending = list(self._q)
-            self._q.clear()
-            self._queued_rows = 0
+            if drain:
+                pending: List[_Req] = []
+            else:
+                pending = list(self._q)
+                self._q.clear()
+                self._queued_rows = 0
             self._cv.notify_all()
         for r in pending:
             r.fut.set_exception(RuntimeError("batcher closed"))
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=timeout)

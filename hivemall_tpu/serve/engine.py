@@ -58,6 +58,7 @@ class _Model:
     path: Optional[str]
     loaded_at: float = field(default_factory=time.time)
     needs_field: bool = False        # FFM-style rows carry field ids
+    bundle_mtime: Optional[float] = None   # source file mtime (bundle age)
 
 
 class PredictEngine:
@@ -70,7 +71,7 @@ class PredictEngine:
                  max_row_features: int = 4096,
                  min_len_bucket: int = 8,
                  watch_interval: float = 2.0,
-                 warmup: bool = True,
+                 warmup=True,
                  warmup_len: int = 16):
         from ..catalog import lookup
         self.algo = algo
@@ -84,6 +85,14 @@ class PredictEngine:
         self._reload_lock = threading.Lock()   # serializes poll()/reload()
         self._watch_thread: Optional[threading.Thread] = None
         self._watch_stop = threading.Event()
+        # readiness (the /healthz gate external LBs and the fleet router
+        # key on): set once warmup completes — or immediately when warmup
+        # was explicitly opted out (the operator chose to serve cold).
+        # warmup="background" starts the HTTP surface cold and flips ready
+        # when a daemon thread finishes pre-compiling — the fleet-replica
+        # recipe (router excludes the replica until it reports ready).
+        self._ready = threading.Event()
+        self._warmed_len: Optional[int] = None  # set once warmup() ran
         # counters (obs `serve` section)
         self.reloads = 0
         self.reload_failures = 0
@@ -115,8 +124,14 @@ class PredictEngine:
                 "PredictEngine needs a model source: pass bundle=... or "
                 "checkpoint_dir=... (or -checkpoint_dir in options)")
         self._register_obs()
-        if warmup:
+        if warmup == "background":
+            t = threading.Thread(target=self._warm_bg, args=(warmup_len,),
+                                 name="serve-warmup", daemon=True)
+            t.start()
+        elif warmup:
             self.warmup(warmup_len)
+        else:
+            self._ready.set()          # cold serving was the caller's call
 
     # -- model loading -------------------------------------------------------
     def _fresh_trainer(self):
@@ -126,8 +141,39 @@ class PredictEngine:
         t = self._fresh_trainer()
         t.load_bundle(path)            # validates format/digest/shapes
         step = int(getattr(t, "_t", 0))
-        return _Model(t, t.make_scorer(), step, path,
-                      needs_field=self._needs_field(t))
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = None
+        m = _Model(t, self._wrap_scorer(t, t.make_scorer()), step, path,
+                   needs_field=self._needs_field(t), bundle_mtime=mtime)
+        if self._warmed_len is not None:
+            # a previously warmed engine never swaps in a cold scorer: the
+            # new version pre-compiles its batch buckets BEFORE the atomic
+            # ref swap, so a rolling hot reload cannot spike p99 with XLA
+            # compiles on the dispatch thread (usually a cache hit — the
+            # jitted predict kernels are config-cached across trainers)
+            self._warm_model(m, self._warmed_len)
+        return m
+
+    def _wrap_scorer(self, trainer, scorer):
+        """GSPMD seam: when the trainer carries a device mesh (`-mesh
+        dp=..,tp=..` in the serve options — dims-sized tables sharded over
+        'tp' across chips), place each padded request batch on the mesh
+        before scoring: rows over 'dp' when the batch bucket divides, else
+        replicated (tiny buckets below dp). Single-device trainers score
+        the host batch directly, unchanged."""
+        mesh = getattr(trainer, "mesh", None)
+        if mesh is None or not hasattr(trainer, "_shard_batch"):
+            return scorer
+        dp = int(mesh.shape["dp"])
+
+        def sharded(batch):
+            if dp > 1 and batch.idx.shape[0] % dp == 0:
+                batch = trainer._shard_batch(batch)
+            return scorer(batch)
+
+        return sharded
 
     @staticmethod
     def _needs_field(trainer) -> bool:
@@ -178,6 +224,24 @@ class PredictEngine:
     @property
     def model_age_seconds(self) -> float:
         return round(time.time() - self._model.loaded_at, 3)
+
+    @property
+    def bundle_age_seconds(self) -> Optional[float]:
+        """Age of the serving bundle FILE (now - its mtime at load) — how
+        stale the model itself is, as opposed to model_age_seconds (how
+        long ago this process loaded it). External LBs and the fleet
+        router read this off /healthz to spot a fleet stuck on an old
+        bundle while training keeps publishing newer ones."""
+        mt = self._model.bundle_mtime
+        return None if mt is None else round(time.time() - mt, 3)
+
+    @property
+    def ready(self) -> bool:
+        """Warmup complete (or explicitly skipped) — the readiness gate."""
+        return self._ready.is_set()
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        return self._ready.wait(timeout)
 
     def poll(self) -> bool:
         """Check the watched directory once; swap in the newest usable
@@ -317,8 +381,25 @@ class PredictEngine:
     def warmup(self, warmup_len: int = 16) -> int:
         """Pre-compile the scorer at every power-of-two batch bucket up to
         ``max_batch`` (at one representative row-length bucket): startup
-        pays the XLA compiles, requests don't. Returns the bucket count."""
-        m = self._model
+        pays the XLA compiles, requests don't. Marks the engine ready (the
+        /healthz gate) and arms pre-swap warming for every later hot
+        reload. Returns the bucket count."""
+        count = self._warm_model(self._model, warmup_len)
+        self._warmed_len = int(warmup_len)
+        self._ready.set()
+        return count
+
+    def _warm_bg(self, warmup_len: int) -> None:
+        """warmup="background": serve /healthz as warming while the
+        buckets compile, then flip ready. A warmup failure must leave the
+        replica NOT ready (the router keeps excluding it) rather than
+        crash the process — the manager's health monitor surfaces it."""
+        try:
+            self.warmup(warmup_len)
+        except Exception as e:           # noqa: BLE001 — degrade to cold
+            self.last_reload_error = f"warmup: {type(e).__name__}: {e}"
+
+    def _warm_model(self, m: _Model, warmup_len: int) -> int:
         L = bucket_size(warmup_len, lo=self.min_len_bucket)
         count = 0
         B = 1
@@ -341,13 +422,18 @@ class PredictEngine:
     def obs_section(self) -> dict:
         d = {
             "algo": self.algo,
+            "ready": self.ready,
             "model_step": self.model_step,
             "model_age_seconds": self.model_age_seconds,
+            "bundle_age_seconds": self.bundle_age_seconds,
             "model_path": self.model_path,
             "reloads": self.reloads,
             "reload_failures": self.reload_failures,
             "watching": bool(self._watch_thread is not None),
         }
+        mesh = getattr(self._model.trainer, "mesh", None)
+        if mesh is not None:
+            d["mesh"] = "dp={dp},tp={tp}".format(**dict(mesh.shape))
         if self.last_reload_error:
             d["last_reload_error"] = self.last_reload_error
         b = self._batcher

@@ -1,0 +1,615 @@
+"""Replica manager — a fleet of serve processes behind one router.
+
+Scale-out serving (docs/SERVING.md "Fleet topology"): the single
+PredictServer tops out at one process's parse+dispatch throughput, so the
+fleet runs ONE ENGINE PER PROCESS (its own MicroBatcher, its own warmed
+compile caches, its own GIL) — on a multi-device host, one replica per
+accelerator via per-replica env overrides. All replicas load from the
+same watched checkpoint dir; a front-end RouterServer fans /predict
+across them.
+
+Lifecycle, all manager-owned:
+
+- **spawn**: each replica is a fresh interpreter running this module's
+  worker entry (``python -m hivemall_tpu.serve.fleet --worker <json>``),
+  binding an ephemeral loopback port and printing one ready line; the
+  manager registers it with the router as NOT ready and lets the health
+  monitor flip it once ``/healthz`` reports warmup complete (engines
+  warm in the background, so a replica is probe-able while cold).
+- **health monitor**: polls every replica's ``/healthz``; readiness
+  drives the router's gate; a dead process is respawned and the dead
+  handle removed from the router (which has usually already shed to
+  survivors at the first failed forward).
+- **rolling hot reload**: the manager — not each replica — watches the
+  checkpoint dir. A newer bundle is digest-verified ONCE
+  (io.checkpoint.verify_bundle), then rolled across replicas ONE AT A
+  TIME via each replica's ``/reload {"path": ...}``: every replica
+  loads the SAME verified bundle (no step skew from racing polls), the
+  in-replica atomic swap keeps it serving its old model mid-load, and
+  sequencing means fleet capacity never drops. A corrupt bundle is
+  rejected at the manager: zero replica churn.
+- **graceful stop**: SIGTERM; workers drain their batcher (accepted
+  requests complete) before exiting; SIGKILL only after a timeout.
+
+``Fleet`` bundles manager + router into one start()/stop() — the
+``serve --replicas N`` CLI surface and what bench_serve/fleet smoke
+drive.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from .router import RouterServer
+
+__all__ = ["ReplicaManager", "Fleet"]
+
+# env vars that must never leak into replica workers: the TPU-tunnel
+# sitecustomize dials a single-client relay at interpreter boot, so a
+# second process inheriting it deadlocks the fleet (same scrub
+# run_tests.sh applies to every smoke)
+_SCRUB_ENV = ("PALLAS_AXON_POOL_IPS",)
+
+
+def _worker_env(overrides: Optional[dict]) -> dict:
+    env = dict(os.environ)
+    for k in _SCRUB_ENV:
+        env.pop(k, None)
+    for k, v in (overrides or {}).items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = str(v)
+    return env
+
+
+class _Replica:
+    """Manager-side record of one worker process."""
+
+    def __init__(self, rid: str, proc: subprocess.Popen, slot: int):
+        self.rid = rid
+        self.proc = proc
+        self.slot = slot               # resource slot (core/device pin) —
+        self.port: Optional[int] = None   # a respawn must inherit it
+        self.model_step: Optional[int] = None
+        self.ready = False
+        self.last_health: dict = {}
+
+    def base(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+class ReplicaManager:
+    """Spawn/heal/roll N serve replicas; membership flows to a router."""
+
+    def __init__(self, algo: str, options: str = "", *,
+                 checkpoint_dir: Optional[str] = None,
+                 bundle: Optional[str] = None,
+                 replicas: int = 2,
+                 router: Optional[RouterServer] = None,
+                 env: Optional[dict] = None,
+                 per_replica_env: Optional[List[dict]] = None,
+                 serve_kwargs: Optional[dict] = None,
+                 pin_cpus: bool = False,
+                 spawn_timeout: float = 180.0,
+                 health_interval: float = 0.5,
+                 watch_interval: float = 2.0):
+        if not checkpoint_dir and not bundle:
+            raise ValueError("fleet needs checkpoint_dir=... or bundle=...")
+        self.algo = algo
+        self.options = options
+        self.checkpoint_dir = checkpoint_dir
+        self.bundle = bundle
+        self.n_replicas = int(replicas)
+        self.router = router
+        self.env = env
+        # per-replica env overlays (device pinning: replica i gets e.g.
+        # {"CUDA_VISIBLE_DEVICES": str(i)} on a multi-device host)
+        self.per_replica_env = per_replica_env or []
+        # one-core-per-replica pinning (the CPU-host analog of
+        # one-replica-per-accelerator): replica in slot i is affined to
+        # core i%N, so each replica's whole thread set — Python AND the
+        # XLA host threadpool — owns exactly one core and N replicas
+        # scale across N cores instead of every replica's XLA pool
+        # thrashing all of them
+        self.pin_cpus = bool(pin_cpus)
+        self.serve_kwargs = dict(serve_kwargs or {})
+        self.spawn_timeout = float(spawn_timeout)
+        self.health_interval = float(health_interval)
+        self.watch_interval = float(watch_interval)
+        from ..catalog import lookup
+        self._name = lookup(algo).resolve().NAME
+        self._replicas: Dict[str, _Replica] = {}
+        self._lock = threading.Lock()
+        self._next_rid = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._respawning: set = set()    # slots with a respawn in flight
+        # counters (the cached `fleet` obs registry section)
+        self.respawns = 0
+        self.rolls = 0
+        self.roll_failures = 0
+        self.rejected_bundles = 0
+        self.fleet_step: Optional[int] = None
+        self.last_error: Optional[str] = None
+        self._register_obs()
+
+    # -- spawning ------------------------------------------------------------
+    def _spec(self, slot: int) -> dict:
+        spec = {"algo": self.algo, "options": self.options,
+                "checkpoint_dir": self.checkpoint_dir,
+                "bundle": self.bundle, "host": "127.0.0.1", "port": 0}
+        if self.pin_cpus:
+            n = os.cpu_count() or 1
+            spec["cpu_affinity"] = [slot % n]
+        spec.update(self.serve_kwargs)
+        return spec
+
+    def _spawn(self, slot: int) -> _Replica:
+        with self._lock:                   # concurrent slot respawns
+            rid = f"r{self._next_rid}"
+            self._next_rid += 1
+        env = dict(self.env or {})
+        if slot < len(self.per_replica_env):
+            env.update(self.per_replica_env[slot])
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "hivemall_tpu.serve.fleet", "--worker",
+             json.dumps(self._spec(slot))],
+            stdout=subprocess.PIPE, stderr=None, text=True,
+            env=_worker_env(env),
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        return _Replica(rid, proc, slot)
+
+    def _wait_ready_line(self, r: _Replica, deadline: float) -> None:
+        """Read the worker's single ready line (its bound port) with a
+        hard deadline — a worker that hangs before binding (e.g. a wedged
+        backend init) must fail the spawn, not block the manager. The
+        worker warms up in the background AFTER this, so N replicas
+        compile concurrently and the health monitor gates admission."""
+        got: list = []
+
+        def read():
+            try:
+                got.append(r.proc.stdout.readline())
+            except Exception:            # noqa: BLE001 — pipe teardown
+                pass
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(timeout=max(0.1, deadline - time.monotonic()))
+        if not got or not got[0].strip():
+            if r.proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {r.rid} exited rc={r.proc.returncode} "
+                    f"before binding")
+            raise RuntimeError(f"replica {r.rid} never reported its port "
+                               f"within the spawn timeout")
+        msg = json.loads(got[0])
+        r.port = int(msg["port"])
+        r.model_step = msg.get("model_step")
+        # keep draining worker stdout so a chatty replica can't fill the
+        # pipe and wedge itself
+        threading.Thread(target=self._drain, args=(r,), daemon=True).start()
+
+    @staticmethod
+    def _drain(r: _Replica) -> None:
+        try:
+            for _ in r.proc.stdout:
+                pass
+        except Exception:                # noqa: BLE001 — pipe teardown
+            pass
+
+    def start(self) -> "ReplicaManager":
+        deadline = time.monotonic() + self.spawn_timeout
+        rs = [self._spawn(i) for i in range(self.n_replicas)]
+        try:
+            for r in rs:
+                self._wait_ready_line(r, deadline)
+        except Exception:
+            for r in rs:
+                if r.proc.poll() is None:
+                    r.proc.kill()
+            raise
+        with self._lock:
+            for r in rs:
+                self._replicas[r.rid] = r
+                if self.router is not None:
+                    self.router.add_replica(r.rid, "127.0.0.1", r.port)
+        for target, name in ((self._monitor, "fleet-health"),
+                             (self._watch, "fleet-watch")):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def wait_ready(self, n: Optional[int] = None,
+                   timeout: float = 180.0) -> bool:
+        """Block until ``n`` (default: all) replicas report ready."""
+        want = self.n_replicas if n is None else int(n)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if sum(1 for r in self.replicas() if r.ready) >= want:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def replicas(self) -> List[_Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    # -- health monitor + respawn --------------------------------------------
+    def _probe(self, r: _Replica) -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(r.base() + "/healthz",
+                                        timeout=2.0) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read())    # 503 while warming: a real
+            except Exception:                  # noqa: BLE001 — health body
+                return None
+        except Exception:                      # noqa: BLE001 — unreachable
+            return None
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.health_interval):
+            for r in self.replicas():
+                if r.proc.poll() is not None:
+                    # the replacement inherits the DEAD replica's resource
+                    # slot (its core/device pin) — dict position would
+                    # drift after churn and double-book a live replica's
+                    # core/device
+                    self._replace(r.slot, r)
+                    continue
+                h = self._probe(r)
+                if h is None:
+                    continue               # transient; process still alive
+                r.last_health = h
+                r.ready = bool(h.get("ready"))
+                r.model_step = h.get("model_step", r.model_step)
+                if self.router is not None:
+                    self.router.set_ready(r.rid, r.ready)
+
+    def _replace(self, slot: int, dead: _Replica) -> None:
+        """Retire a crashed replica and respawn its slot on a DEDICATED
+        thread — the monitor must keep polling the survivors' health
+        while the replacement boots (a wedged respawn would otherwise
+        freeze readiness updates fleet-wide: a survivor gated out by one
+        transient forward error could never be revived). The router has
+        already shed to the survivors (first failed forward marks the
+        dead replica unready)."""
+        with self._lock:
+            if self._stop.is_set() or dead.rid not in self._replicas:
+                return
+            del self._replicas[dead.rid]
+            if slot in self._respawning:   # one respawn per slot
+                return
+            self._respawning.add(slot)
+        if self.router is not None:
+            self.router.remove_replica(dead.rid)
+        self.respawns += 1
+        threading.Thread(target=self._respawn_slot, args=(slot,),
+                         name=f"fleet-respawn-{slot}", daemon=True).start()
+
+    def _respawn_slot(self, slot: int) -> None:
+        """Respawn ``slot`` until it sticks: a transient spawn failure
+        (fork pressure, slow boot past the timeout) retries rather than
+        permanently shrinking the fleet. A stop() racing the spawn kills
+        the fresh worker instead of orphaning it."""
+        try:
+            while not self._stop.is_set():
+                r = None
+                try:
+                    r = self._spawn(slot)
+                    self._wait_ready_line(
+                        r, time.monotonic() + self.spawn_timeout)
+                except Exception as e:     # noqa: BLE001 — retry the slot
+                    self.last_error = f"respawn: {type(e).__name__}: {e}"
+                    if r is not None and r.proc.poll() is None:
+                        r.proc.kill()      # half-spawned worker reaped
+                    if self._stop.wait(1.0):
+                        return
+                    continue
+                with self._lock:
+                    if self._stop.is_set():
+                        # stop() already terminated + cleared the fleet;
+                        # this late arrival must not become an orphan
+                        r.proc.terminate()
+                        return
+                    self._replicas[r.rid] = r
+                if self.router is not None:
+                    self.router.add_replica(r.rid, "127.0.0.1", r.port)
+                return
+        finally:
+            self._respawning.discard(slot)
+
+    # -- fleet-wide rolling hot reload ---------------------------------------
+    def _watch(self) -> None:
+        if not self.checkpoint_dir:
+            return
+        while not self._stop.wait(self.watch_interval):
+            try:
+                self.check_and_roll()
+            except Exception as e:         # noqa: BLE001 — watcher survives
+                self.last_error = f"watch: {type(e).__name__}: {e}"
+
+    def check_and_roll(self) -> bool:
+        """One watch tick: is there a newer verified bundle? Roll it.
+        Returns True when a roll happened."""
+        from ..io.checkpoint import newest_bundle, verify_bundle
+        if not self.checkpoint_dir:
+            return False
+        nb = newest_bundle(self.checkpoint_dir, self._name)
+        if nb is None:
+            return False
+        step, path = nb
+        cur = self.fleet_step
+        if cur is None:
+            cur = min((r.model_step or 0) for r in self.replicas()) \
+                if self.replicas() else 0
+            self.fleet_step = cur
+        if step <= cur:
+            return False
+        try:
+            verify_bundle(path, self._name)   # ONCE, at the manager
+        except (ValueError, KeyError, OSError) as e:
+            self.rejected_bundles += 1
+            self.last_error = f"bundle {path}: {e}"
+            return False
+        self.roll(path, step)
+        return True
+
+    def roll(self, path: str, step: int) -> None:
+        """Roll one verified bundle across the fleet, one replica at a
+        time. Each replica keeps serving its OLD model while loading (the
+        engine's atomic swap + pre-swap warmup), so rolling is about
+        blast radius — a bundle that loads at the manager's verify but
+        fails in a replica stops the roll at one replica, not N."""
+        for r in self.replicas():
+            if self._stop.is_set():
+                return
+            try:
+                body = json.dumps({"path": path}).encode()
+                req = urllib.request.Request(
+                    r.base() + "/reload", body,
+                    {"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=120.0) as resp:
+                    out = json.loads(resp.read())
+                if not out.get("reloaded"):
+                    raise RuntimeError(
+                        f"replica {r.rid} refused bundle: {out}")
+                r.model_step = out.get("model_step", step)
+            except Exception as e:         # noqa: BLE001 — stop the roll,
+                # keep serving: every replica still runs a complete model
+                # (old or new step). fleet_step stays put, so the next
+                # watch tick retries the roll — by then the monitor has
+                # respawned whatever replica broke it
+                self.roll_failures += 1
+                self.last_error = f"roll {r.rid}: {type(e).__name__}: {e}"
+                return
+        self.fleet_step = step
+        self.rolls += 1
+
+    # -- obs -----------------------------------------------------------------
+    def obs_section(self) -> dict:
+        rs = self.replicas()
+        d = {
+            "replicas": len(rs),
+            "ready": sum(1 for r in rs if r.ready),
+            "respawns": self.respawns,
+            "rolls": self.rolls,
+            "roll_failures": self.roll_failures,
+            "rejected_bundles": self.rejected_bundles,
+            "fleet_step": self.fleet_step,
+            "model_steps": {r.rid: r.model_step for r in rs},
+        }
+        if self.last_error:
+            d["last_error"] = self.last_error
+        return d
+
+    def _register_obs(self) -> None:
+        import weakref
+        from ..obs.registry import registry
+        ref = weakref.ref(self)
+
+        def fleet() -> dict:
+            m = ref()
+            if m is None:              # manager GC'd: same key set as the
+                return {"replicas": 0, "ready": 0, "respawns": 0,
+                        "rolls": 0, "roll_failures": 0,   # registry stub
+                        "rejected_bundles": 0, "fleet_step": None,
+                        "model_steps": {}}
+            return m.obs_section()
+
+        registry.register("fleet", fleet)
+
+    # -- lifecycle -----------------------------------------------------------
+    def stop(self, timeout: float = 15.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        rs = self.replicas()
+        for r in rs:
+            if r.proc.poll() is None:
+                r.proc.terminate()         # workers drain + exit on SIGTERM
+        deadline = time.monotonic() + timeout
+        for r in rs:
+            try:
+                r.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                r.proc.kill()
+                r.proc.wait(timeout=5)
+            if self.router is not None:
+                self.router.remove_replica(r.rid)
+        with self._lock:
+            self._replicas.clear()
+
+
+class Fleet:
+    """Router + replica manager as one unit — the `serve --replicas N`
+    topology. ``port=0`` binds the router on an ephemeral port (read
+    ``self.port`` after construction)."""
+
+    def __init__(self, algo: str, options: str = "", *,
+                 checkpoint_dir: Optional[str] = None,
+                 bundle: Optional[str] = None,
+                 replicas: int = 2,
+                 host: str = "127.0.0.1", port: int = 0,
+                 policy: str = "least_loaded",
+                 env: Optional[dict] = None,
+                 per_replica_env: Optional[List[dict]] = None,
+                 serve_kwargs: Optional[dict] = None,
+                 pin_cpus: bool = False,
+                 health_interval: float = 0.5,
+                 watch_interval: float = 2.0,
+                 spawn_timeout: float = 180.0):
+        self.router = RouterServer(host=host, port=port, policy=policy,
+                                   on_reload_cb=self._on_reload)
+        self.manager = ReplicaManager(
+            algo, options, checkpoint_dir=checkpoint_dir, bundle=bundle,
+            replicas=replicas, router=self.router, env=env,
+            per_replica_env=per_replica_env, serve_kwargs=serve_kwargs,
+            pin_cpus=pin_cpus,
+            health_interval=health_interval, watch_interval=watch_interval,
+            spawn_timeout=spawn_timeout)
+        self.host = host
+        self.port = self.router.port
+
+    def _on_reload(self, body: bytes) -> dict:
+        obj = json.loads(body or b"{}")
+        path = obj.get("path")
+        if path:
+            # same trust boundary as the single server's /reload: the
+            # router is network-reachable and the model directory is the
+            # boundary — an out-of-tree path must not even be stat'd
+            ckdir = self.manager.checkpoint_dir
+            if not ckdir:
+                return {"error": "explicit-path reload needs a watched "
+                                 "checkpoint dir"}
+            real = os.path.realpath(path)
+            root = os.path.realpath(ckdir)
+            if os.path.commonpath([real, root]) != root:
+                return {"error": "reload path is outside the watched "
+                                 "checkpoint directory"}
+            from ..io.checkpoint import bundle_step, verify_bundle
+            verify_bundle(path, self.manager._name)
+            step = bundle_step(path) or 0
+            self.manager.roll(path, step)
+            rolled = self.manager.fleet_step == step
+        else:
+            rolled = self.manager.check_and_roll()
+        return {"reloaded": rolled, "fleet_step": self.manager.fleet_step,
+                "roll_failures": self.manager.roll_failures}
+
+    def start(self, wait_ready: bool = True,
+              timeout: float = 180.0) -> "Fleet":
+        self.router.start()
+        self.manager.start()
+        if wait_ready:
+            self.manager.wait_ready(timeout=timeout)
+        return self
+
+    def stop(self) -> None:
+        self.manager.stop()
+        self.router.stop()
+
+
+# ---------------------------------------------------------------------------
+# worker entry: one replica process
+# ---------------------------------------------------------------------------
+
+def _worker(spec_json: str) -> int:
+    """Run one replica: engine + micro-batcher + HTTP server on an
+    ephemeral loopback port. Prints ONE json line (the bound port) on
+    stdout, then serves until SIGTERM — on which it drains (accepted
+    requests complete) and exits 0."""
+    spec = json.loads(spec_json)
+    aff = spec.get("cpu_affinity")
+    if aff and hasattr(os, "sched_setaffinity"):
+        # pin BEFORE jax spins up its host threadpool so every thread
+        # this replica creates inherits the affinity
+        try:
+            os.sched_setaffinity(0, set(int(c) for c in aff))
+        except OSError:
+            pass                       # cores went away: run unpinned
+    # the manager's env overlay may pin this replica to a device; make
+    # the platform choice authoritative before jax initializes backends
+    # (the TPU-plugin sitecustomize overrides the env var via jax.config)
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "axon" not in want:
+        import jax
+        jax.config.update("jax_platforms", want)
+
+    from .engine import PredictEngine
+    from .http import PredictServer
+
+    def opt(key, default, conv):
+        # explicit None check: `or default` would silently override a
+        # legitimate 0 (e.g. --serve-max-delay-ms 0 = dispatch
+        # immediately) and diverge fleet replicas from single-server mode
+        v = spec.get(key)
+        return default if v is None else conv(v)
+
+    engine = PredictEngine(
+        spec["algo"], spec.get("options") or "",
+        bundle=spec.get("bundle"),
+        checkpoint_dir=spec.get("checkpoint_dir"),
+        max_batch=opt("max_batch", 256, int),
+        max_row_features=opt("max_row_features", 4096, int),
+        watch_interval=opt("watch_interval", 2.0, float),
+        # background: bind + report the port NOW, warm concurrently —
+        # the router health-gates on /healthz readiness
+        warmup="background",
+        warmup_len=opt("warmup_len", 16, int))
+    srv = PredictServer(
+        engine,
+        host=spec.get("host") or "127.0.0.1",
+        port=opt("port", 0, int),
+        max_delay_ms=opt("max_delay_ms", 2.0, float),
+        max_queue_rows=spec.get("max_queue_rows"),
+        deadline_ms=opt("deadline_ms", 0.0, float),
+        # the MANAGER owns reload sequencing fleet-wide; a replica
+        # polling on its own would race the roll and skew steps
+        watch=bool(spec.get("self_watch") or False)).start()
+
+    stop = threading.Event()
+
+    def on_term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    print(json.dumps({"ready": True, "port": srv.port, "pid": os.getpid(),
+                      "model_step": engine.model_step}), flush=True)
+    while not stop.wait(1.0):            # timed wait: signal-interruptible
+        pass
+    srv.stop(drain=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(prog="hivemall_tpu.serve.fleet")
+    ap.add_argument("--worker", metavar="SPEC_JSON",
+                    help="run one replica worker from a json spec "
+                         "(internal: spawned by ReplicaManager)")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return _worker(args.worker)
+    ap.error("only --worker mode is runnable directly; use "
+             "`hivemall_tpu serve --replicas N` for a fleet")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,233 @@
+"""Fleet smoke — run by run_tests.sh (docs/SERVING.md "Fleet topology").
+
+The acceptance surface of scale-out serving, seconds-scale, on real
+replica PROCESSES:
+
+1. two replicas behind the router serve concurrent predicts that
+   BIT-MATCH offline ``predict_proba`` on the same feature strings, and
+   every replica takes traffic (the router actually fans out);
+2. the aggregated fleet obs surface works: router ``/healthz`` reports
+   both replicas ready, ``/snapshot`` carries per-replica serve sections
+   + the cross-replica aggregate, ``/metrics`` exports fleet gauges;
+3. KILLING one replica under live traffic costs ZERO failed requests
+   (router retries transport failures on the survivor) and the manager
+   respawns back to full strength;
+4. a newer checkpoint written mid-traffic ROLLS across the fleet (the
+   manager verifies once, rolls one replica at a time) with zero dropped
+   requests, converging every replica to the new step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+
+def _train_bundle(ckdir: str, opts: str, ds):
+    from ..io.checkpoint import newest_bundle
+    from ..models.linear import GeneralClassifier
+    t = GeneralClassifier(opts)
+    nb = newest_bundle(ckdir, t.NAME)
+    if nb is not None:
+        t.load_bundle(nb[1])
+    t.fit(ds)
+    path = os.path.join(ckdir, f"{t.NAME}-step{t._t:010d}.npz")
+    t.save_bundle(path)
+    return t, path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="hivemall_tpu.serve.fleet_smoke")
+    ap.add_argument("--rows", type=int, default=300)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--threads", type=int, default=6)
+    ap.add_argument("--replicas", type=int, default=2)
+    args = ap.parse_args(argv)
+    tmp = tempfile.mkdtemp(prefix="hivemall_tpu_fleet_smoke_")
+    try:
+        return _run(args, tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run(args, tmp: str) -> int:
+    from ..io.libsvm import synthetic_classification
+    from ..io.sparse import SparseDataset
+    from ..serve.fleet import Fleet
+    from ..serve.http import KeepAliveClient
+
+    opts = "-dims 4096 -loss logloss -opt adagrad -mini_batch 64"
+    ds, _ = synthetic_classification(args.rows, 200, seed=7)
+    trainer, _ = _train_bundle(tmp, opts, ds)
+
+    rows = []
+    for i in range(args.requests):
+        idx, val = ds.row(i % args.rows)
+        rows.append([f"{int(a)}:{float(v)!r}" for a, v in zip(idx, val)])
+    parsed = [trainer._parse_row(r) for r in rows]
+    ref = trainer.predict_proba(
+        SparseDataset.from_rows(parsed, [1.0] * len(parsed)))
+
+    fleet = Fleet(
+        "train_classifier", opts, checkpoint_dir=tmp,
+        replicas=args.replicas,
+        watch_interval=0.3, health_interval=0.2,
+        serve_kwargs={"max_batch": 64, "max_delay_ms": 3.0,
+                      "max_queue_rows": 4096,
+                      "warmup_len": max(len(r) for r in rows)})
+    t0 = time.time()
+    fleet.start(wait_ready=True, timeout=180.0)
+    print(f"fleet smoke: {args.replicas} replicas ready in "
+          f"{time.time() - t0:.1f}s on port {fleet.port}", file=sys.stderr)
+    try:
+        return _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient)
+    finally:
+        fleet.stop()
+
+
+def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
+    failures = []
+
+    def check(name, ok, detail=""):
+        print(f"fleet smoke {name}: {'OK' if ok else 'FAILED'} {detail}",
+              file=sys.stderr)
+        if not ok:
+            failures.append(name)
+
+    host, port = "127.0.0.1", fleet.port
+
+    # -- 1. concurrent predicts bit-match, fan-out covers every replica ---
+    scores = [None] * len(rows)
+    errs = []
+    pos = iter(range(len(rows)))
+    lock = threading.Lock()
+
+    def worker():
+        cli = KeepAliveClient(host, port)
+        while True:
+            with lock:
+                i = next(pos, None)
+            if i is None:
+                cli.close()
+                return
+            try:
+                code, r = cli.post_json("/predict", {"rows": [rows[i]]})
+                assert code == 200, (code, r)
+                scores[i] = r["scores"][0]
+            except Exception as e:     # noqa: BLE001 — collected
+                errs.append(f"req {i}: {e}")
+
+    ts = [threading.Thread(target=worker) for _ in range(args.threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    check("requests", not errs,
+          f"({len(rows)} requests, {len(errs)} errors) {errs[:2]}")
+    got = np.asarray([np.nan if s is None else s for s in scores],
+                     np.float32)
+    check("bit_match", np.array_equal(got, ref),
+          f"(max abs diff {np.abs(got - ref).max():.2e})")
+    handles = fleet.router.replicas()
+    check("fan_out", len(handles) == args.replicas
+          and all(h.forwarded > 0 for h in handles),
+          f"({[(h.rid, h.forwarded) for h in handles]})")
+
+    # -- 2. aggregated obs surface ----------------------------------------
+    hz = json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/healthz", timeout=10).read())
+    check("healthz", hz.get("status") == "ok"
+          and hz.get("ready_replicas") == args.replicas, f"({hz})")
+    snap = json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/snapshot", timeout=10).read())
+    fl = snap.get("fleet", {})
+    agg = fl.get("aggregate", {})
+    per = fl.get("replicas", {})
+    check("obs_snapshot",
+          len(per) == args.replicas
+          and agg.get("requests", 0) >= len(rows)
+          and all("model_step" in sec for sec in per.values())
+          and "router" in fl
+          and "respawns" in fl.get("manager", {}),
+          f"(aggregate {agg}, manager {fl.get('manager')})")
+    prom = urllib.request.urlopen(
+        f"http://{host}:{port}/metrics", timeout=10).read().decode()
+    check("obs_metrics",
+          "hivemall_tpu_fleet_aggregate_requests" in prom
+          and "hivemall_tpu_fleet_router_ready_replicas" in prom)
+
+    # -- live traffic for phases 3 + 4 ------------------------------------
+    stop = threading.Event()
+    traffic_errs = []
+    traffic_n = [0]
+
+    def traffic():
+        cli = KeepAliveClient(host, port)
+        i = 0
+        while not stop.is_set():
+            try:
+                code, r = cli.post_json(
+                    "/predict", {"rows": [rows[i % len(rows)]]})
+                if code != 200:
+                    traffic_errs.append(f"status {code}: {r}")
+            except Exception as e:     # noqa: BLE001 — collected
+                traffic_errs.append(str(e))
+            i += 1
+            traffic_n[0] += 1
+        cli.close()
+
+    tt = [threading.Thread(target=traffic) for _ in range(4)]
+    for t in tt:
+        t.start()
+    time.sleep(0.5)                    # traffic flowing
+
+    # -- 3. kill one replica mid-traffic: zero failed requests ------------
+    victim = fleet.manager.replicas()[0]
+    os.kill(victim.proc.pid, signal.SIGKILL)
+    deadline = time.time() + 90
+    while time.time() < deadline and (
+            fleet.manager.respawns == 0
+            or not fleet.manager.wait_ready(args.replicas, timeout=0.1)):
+        time.sleep(0.2)
+    n_ready = sum(1 for h in fleet.router.replicas() if h.ready)
+    check("kill_respawn", fleet.manager.respawns >= 1
+          and n_ready == args.replicas,
+          f"(respawns {fleet.manager.respawns}, ready {n_ready})")
+    check("kill_no_drops", not traffic_errs,
+          f"({len(traffic_errs)} failed during kill, "
+          f"{traffic_n[0]} total) {traffic_errs[:2]}")
+
+    # -- 4. rolling hot reload mid-traffic: zero drops, steps converge ----
+    t2, _ = _train_bundle(
+        tmp, "-dims 4096 -loss logloss -opt adagrad -mini_batch 64", ds)
+    deadline = time.time() + 60
+    while time.time() < deadline and fleet.manager.fleet_step != t2._t:
+        time.sleep(0.2)
+    stop.set()
+    for t in tt:
+        t.join()
+    check("rolling_reload", fleet.manager.fleet_step == t2._t
+          and fleet.manager.rolls >= 1,
+          f"(fleet_step {fleet.manager.fleet_step}, expected {t2._t}, "
+          f"rolls {fleet.manager.rolls})")
+    steps = sorted({r.model_step for r in fleet.manager.replicas()})
+    check("steps_converge", steps == [t2._t], f"({steps})")
+    check("reload_no_drops", not traffic_errs,
+          f"({len(traffic_errs)} failed during roll) {traffic_errs[:2]}")
+
+    print(f"fleet smoke: {len(failures)} failures", file=sys.stderr)
+    return len(failures)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,7 +11,10 @@ exactly what makes concurrent requests coalesce):
   hash through the trainer's own ftvec/mhash path. Response:
   ``{"scores": [...], "model_step": N, "n": N}``. Shed requests get 503,
   expired deadlines 504, parse errors 400.
-- ``GET /healthz`` — liveness + model step/age + queue depth.
+- ``GET /healthz`` — READINESS: 200 once warmup completed, 503 while
+  warming (so the fleet router / an external LB can gate cold replicas),
+  with model step, model/bundle age, queue depth and the cheap serving
+  counters.
 - ``POST /reload`` — force a hot-reload check (body optionally
   ``{"path": "...npz"}`` to load an explicit bundle).
 - ``GET /snapshot`` / ``GET /metrics`` — the central obs registry (the
@@ -21,6 +24,7 @@ exactly what makes concurrent requests coalesce):
 
 from __future__ import annotations
 
+import http.client
 import http.server
 import json
 import threading
@@ -29,7 +33,67 @@ from typing import Optional
 from ..obs.http import _Handler as _ObsHandler
 from .batcher import MicroBatcher, ServeDeadline, ServeOverload
 
-__all__ = ["PredictServer"]
+__all__ = ["PredictServer", "KeepAliveClient"]
+
+
+class KeepAliveClient:
+    """Minimal keep-alive HTTP client for ONE endpoint, one per thread.
+
+    The serving stack talks HTTP/1.1 end to end (client -> router ->
+    replica); per-request TCP setup was measurable overhead in
+    bench_serve at high concurrency, so the bench/smoke drivers hold one
+    persistent connection per client thread instead of urllib's
+    connection-per-request. Reconnects transparently once when the server
+    side closed an idle connection (their 10s reaper, an error response's
+    Connection: close). NOT thread-safe — by design, one per thread."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.host, self.port, self.timeout = host, int(port), timeout
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def _connect(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+            conn.connect()
+            # headers and body go out as separate small sends; without
+            # NODELAY, Nagle holds the second one for the delayed ACK
+            import socket as _socket
+            conn.sock.setsockopt(_socket.IPPROTO_TCP,
+                                 _socket.TCP_NODELAY, 1)
+            self._conn = conn
+        return self._conn
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def request(self, method: str, path: str, body: Optional[bytes] = None):
+        """Returns (status, payload bytes). Retries once on a dead kept-
+        alive connection; a server actively refusing still raises."""
+        for attempt in (0, 1):
+            conn = self._connect()
+            try:
+                conn.request(method, path, body,
+                             {"Content-Type": "application/json"}
+                             if body is not None else {})
+                resp = conn.getresponse()
+                payload = resp.read()
+                if resp.will_close:
+                    self.close()
+                return resp.status, payload
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self.close()
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+    def post_json(self, path: str, obj: dict):
+        """Returns (status, parsed json)."""
+        code, payload = self.request("POST", path,
+                                     json.dumps(obj).encode())
+        return code, json.loads(payload)
 
 
 class _ServeHandler(_ObsHandler):
@@ -39,42 +103,92 @@ class _ServeHandler(_ObsHandler):
 
     server_ref: "PredictServer" = None   # type: ignore[assignment]
 
+    # HTTP/1.1 => keep-alive by default: per-request TCP setup (handshake
+    # + slow-start + a fresh connection thread) is measurable overhead in
+    # bench_serve at high concurrency, and the fleet router holds pooled
+    # connections to every replica. Safe here because every response path
+    # (_json, the obs handler, send_error) carries Content-Length; the
+    # threaded server gives each kept-alive connection its own thread, and
+    # the inherited 10s socket timeout reaps idle ones.
+    protocol_version = "HTTP/1.1"
+    # http.server writes status line / headers / body as SEPARATE small
+    # sends; on a kept-alive connection Nagle + delayed ACK turns that
+    # into ~40ms stalls per response (measured: fleet p50 went 73ms ->
+    # sub-ms with NODELAY). The close-per-request HTTP/1.0 server never
+    # saw it because close() flushed.
+    disable_nagle_algorithm = True
+
     # -- helpers -------------------------------------------------------------
+    _body_read = False                   # per-request; reset in do_*
+
     def _json(self, code: int, obj: dict) -> None:
         body = json.dumps(obj, default=str).encode()
+        if code >= 400 and not self._body_read:
+            # an error sent BEFORE the request body was consumed (e.g.
+            # the 64MB cap rejects before reading) leaves bytes on the
+            # wire that keep-alive would parse as the next request line —
+            # those responses close the connection. Errors after a full
+            # read (503 shed, 504 expired, 400 parse) keep it open: at
+            # overload, forcing every shed client to re-handshake TCP
+            # would amplify load exactly when the server is saturated
+            self.close_connection = True
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
 
     def _read_body(self) -> dict:
         ln = int(self.headers.get("Content-Length") or 0)
         if ln <= 0:
+            self._body_read = True
             return {}
         if ln > (64 << 20):
             raise ValueError(f"request body {ln} bytes > 64MB cap")
-        obj = json.loads(self.rfile.read(ln) or b"{}")
+        raw = self.rfile.read(ln)
+        self._body_read = True           # wire is clean past this point
+        obj = json.loads(raw or b"{}")
         if not isinstance(obj, dict):
             raise ValueError("request body must be a JSON object")
         return obj
 
     # -- routes --------------------------------------------------------------
     def do_GET(self):  # noqa: N802 — http.server API
+        self._body_read = True           # GETs carry no body to drain
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
+            # READINESS, not bare liveness: 200 only once warmup completed
+            # (503 while warming), so the fleet router — and any external
+            # LB probing this port — can gate cold/warming replicas out of
+            # rotation instead of routing requests into XLA compiles. The
+            # body carries the cheap serving counters the replica manager
+            # folds into its cached fleet obs section.
             s = self.server_ref
-            self._json(200, {
-                "status": "ok",
-                "algo": s.engine.algo,
-                "model_step": s.engine.model_step,
-                "model_age_seconds": s.engine.model_age_seconds,
-                "queue_depth": s.batcher.queue_depth,
+            e = s.engine
+            b = s.batcher
+            ready = e.ready
+            self._json(200 if ready else 503, {
+                "status": "ok" if ready else "warming",
+                "ready": ready,
+                "algo": e.algo,
+                "model_step": e.model_step,
+                "model_age_seconds": e.model_age_seconds,
+                "bundle_age_seconds": e.bundle_age_seconds,
+                "queue_depth": b.queue_depth,
+                "requests": b.requests,
+                "shed": b.shed,
+                "expired": b.expired,
+                "errors": b.errors,
+                "reloads": e.reloads,
+                "reload_failures": e.reload_failures,
             })
             return
         super().do_GET()               # /snapshot, /metrics, 404
 
     def do_POST(self):  # noqa: N802 — http.server API
+        self._body_read = False          # fresh request on this connection
         path = self.path.split("?", 1)[0]
         s = self.server_ref
         if path == "/reload":
@@ -191,11 +305,15 @@ class PredictServer:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False) -> None:
+        """Shut down: stop accepting connections, then close the batcher.
+        ``drain=True`` is the graceful path (a fleet replica on SIGTERM):
+        requests already accepted score to completion before the batcher
+        stops; the default fails queued requests fast."""
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        self.batcher.close()
+        self.batcher.close(drain=drain, timeout=30.0 if drain else 5.0)
         self.engine.close()

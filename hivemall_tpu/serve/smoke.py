@@ -116,6 +116,9 @@ def _drive(args, tmp, ds, rows, ref, engine, srv, base) -> int:
             failures.append(name)
 
     # -- concurrent predicts: coalescing + bit-match + latency ------------
+    # each worker holds ONE keep-alive connection (HTTP/1.1 — the
+    # PredictServer reuse path runs in CI, not just in bench_serve)
+    from .http import KeepAliveClient
     scores = [None] * len(rows)
     lat = [0.0] * len(rows)
     errs = []
@@ -123,14 +126,17 @@ def _drive(args, tmp, ds, rows, ref, engine, srv, base) -> int:
     lock = threading.Lock()
 
     def worker():
+        cli = KeepAliveClient("127.0.0.1", srv.port)
         while True:
             with lock:
                 i = next(pos, None)
             if i is None:
+                cli.close()
                 return
             t0 = time.perf_counter()
             try:
-                r = _post(base + "/predict", {"rows": [rows[i]]})
+                code, r = cli.post_json("/predict", {"rows": [rows[i]]})
+                assert code == 200, (code, r)
                 scores[i] = r["scores"][0]
             except Exception as e:     # noqa: BLE001 — collected
                 errs.append(f"req {i}: {e}")

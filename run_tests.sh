@@ -29,6 +29,15 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.serve.smoke || exit $?
 
+# fleet smoke (docs/SERVING.md "Fleet topology"): 2 replica PROCESSES
+# behind the front-end router — concurrent routed predicts bit-match
+# predict_proba and fan across both replicas; killing one replica under
+# live traffic costs ZERO failed requests (router retry + manager
+# respawn); a newer checkpoint rolls across the fleet one replica at a
+# time with zero drops, converging every replica to the new step.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m hivemall_tpu.serve.fleet_smoke || exit $?
+
 # shard-cache smoke (docs/PERFORMANCE.md "Shard cache"): a cold fit must
 # build the packed cache, a fresh-trainer warm fit must bit-match its loss
 # trajectory with ZERO live prep, and the Parquet decode cache must keep

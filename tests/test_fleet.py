@@ -1,0 +1,260 @@
+"""Scale-out serving fleet (hivemall_tpu/serve/{router,fleet}.py,
+docs/SERVING.md "Fleet topology"): router placement policy (least-loaded
+with consistent-hash fallback), health gating, transport retry on dead
+replicas, verbatim relay, aggregated fleet obs — against real in-process
+PredictServers as replicas (cheap: no worker processes). The full
+multi-process lifecycle (spawn, kill+respawn, rolling reload under
+traffic) is pinned by the fleet smoke in run_tests.sh and by the `slow`
+test at the bottom.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.serve.router import RouterServer, _Ring
+
+OPTS = "-dims 1024 -loss logloss -opt adagrad -mini_batch 32"
+
+
+@pytest.fixture()
+def trained(tmp_path):
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    from hivemall_tpu.models.linear import GeneralClassifier
+    ds, _ = synthetic_classification(120, 64, seed=11)
+    t = GeneralClassifier(OPTS)
+    t.fit(ds)
+    path = os.path.join(tmp_path, f"{t.NAME}-step{t._t:010d}.npz")
+    t.save_bundle(path)
+    return t, ds, str(tmp_path), path
+
+
+def _replica(ckdir):
+    """A real PredictServer used as an in-process 'replica'."""
+    from hivemall_tpu.serve.engine import PredictEngine
+    from hivemall_tpu.serve.http import PredictServer
+    eng = PredictEngine("train_classifier", OPTS, checkpoint_dir=ckdir,
+                        warmup=False)
+    return PredictServer(eng, port=0, max_delay_ms=1.0, watch=False).start()
+
+
+def _rows_of(ds, n):
+    out = []
+    for i in range(n):
+        idx, val = ds.row(i)
+        out.append([f"{int(a)}:{float(v)!r}" for a, v in zip(idx, val)])
+    return out
+
+
+def _post(url, obj, timeout=15.0):
+    req = urllib.request.Request(url, json.dumps(obj).encode(),
+                                 {"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+# --- consistent-hash ring ----------------------------------------------------
+
+def test_ring_stability_and_coverage():
+    ring = _Ring()
+    ring.rebuild(["a", "b", "c"])
+    picks = {ring.pick(k * 2654435761 % (1 << 64), {"a", "b", "c"})
+             for k in range(200)}
+    assert picks == {"a", "b", "c"}          # every replica reachable
+    # same key -> same replica, deterministically
+    for k in (1, 99, 12345):
+        assert ring.pick(k, {"a", "b", "c"}) == ring.pick(k, {"a", "b", "c"})
+    # removing one replica only remaps ITS keys: survivors keep theirs
+    before = {k: ring.pick(k, {"a", "b", "c"}) for k in range(500)}
+    after = {k: ring.pick(k, {"a", "b"}) for k in range(500)}
+    for k, rid in before.items():
+        if rid != "c":
+            assert after[k] == rid
+
+
+def test_ring_excludes_ineligible():
+    ring = _Ring()
+    ring.rebuild(["a", "b"])
+    assert ring.pick(7, {"b"}) == "b"
+    assert ring.pick(7, set()) is None
+
+
+# --- router placement + gating ----------------------------------------------
+
+def test_router_health_gating_and_least_loaded(trained):
+    t, ds, ckdir, _ = trained
+    r1, r2 = _replica(ckdir), _replica(ckdir)
+    router = RouterServer(port=0).start()
+    try:
+        router.add_replica("r1", "127.0.0.1", r1.port)
+        router.add_replica("r2", "127.0.0.1", r2.port)
+        body = json.dumps({"rows": _rows_of(ds, 1)}).encode()
+        # nothing ready: shed with 503, never forwarded
+        code, raw, fb = router.route_predict(body)
+        assert code == 503 and raw is None and fb["shed"]
+        assert router.no_replica == 1
+        # only r2 ready: all traffic lands there
+        router.set_ready("r2", True)
+        for _ in range(5):
+            code, raw, _ = router.route_predict(body)
+            assert code == 200 and raw is not None
+        handles = {h.rid: h for h in router.replicas()}
+        assert handles["r1"].forwarded == 0
+        assert handles["r2"].forwarded == 5
+        # both ready: both take traffic (least-loaded spreads at equal
+        # load via the hash fallback over distinct bodies)
+        router.set_ready("r1", True)
+        rows = _rows_of(ds, 16)
+        for i in range(32):
+            b = json.dumps({"rows": [rows[i % 16]]}).encode()
+            code, _, _ = router.route_predict(b)
+            assert code == 200
+        assert handles["r1"].forwarded > 0
+    finally:
+        router.stop()
+        r1.stop()
+        r2.stop()
+
+
+def test_router_hash_policy_affinity(trained):
+    t, ds, ckdir, _ = trained
+    r1, r2 = _replica(ckdir), _replica(ckdir)
+    router = RouterServer(port=0, policy="hash").start()
+    try:
+        router.add_replica("r1", "127.0.0.1", r1.port, ready=True)
+        router.add_replica("r2", "127.0.0.1", r2.port, ready=True)
+        rows = _rows_of(ds, 4)
+        # strict affinity: one body always routes to one replica
+        for row in rows:
+            body = json.dumps({"rows": [row]}).encode()
+            first = {h.rid: h.forwarded for h in router.replicas()}
+            for _ in range(4):
+                assert router.route_predict(body)[0] == 200
+            moved = [rid for rid, h in
+                     ((h.rid, h) for h in router.replicas())
+                     if h.forwarded - first[rid] not in (0, 4)]
+            assert not moved, moved
+    finally:
+        router.stop()
+        r1.stop()
+        r2.stop()
+
+
+def test_router_retries_on_dead_replica_and_relays(trained):
+    """The zero-failed-requests property: a replica dying mid-traffic is
+    retried transparently on a survivor; the response relays the
+    SURVIVOR's scores verbatim."""
+    from hivemall_tpu.io.sparse import SparseDataset
+    t, ds, ckdir, _ = trained
+    live, dead = _replica(ckdir), _replica(ckdir)
+    router = RouterServer(port=0).start()
+    try:
+        router.add_replica("live", "127.0.0.1", live.port, ready=True)
+        dead_port = dead.port
+        router.add_replica("dead", "127.0.0.1", dead_port, ready=True)
+        dead.stop()                       # replica gone; handle still ready
+        # DISTINCT bodies: the least-loaded tie-break is consistent-hash,
+        # so varied keys guarantee the dead replica gets picked at least
+        # once before its first failure gates it out
+        rows = _rows_of(ds, 12)
+        parsed = [t._parse_row(r) for r in rows]
+        ref = t.predict_proba(SparseDataset.from_rows(parsed, [1.0] * 12))
+        ok = 0
+        for i in range(12):
+            body = json.dumps({"rows": [rows[i]]}).encode()
+            code, raw, _ = router.route_predict(body)
+            assert code == 200, (code, i)    # never a client-visible error
+            payload = raw.split(b"\r\n\r\n", 1)[1]
+            got = np.float32(json.loads(payload)["scores"][0])
+            assert got == ref[i]
+            ok += 1
+        assert ok == 12
+        handles = {h.rid: h for h in router.replicas()}
+        assert not handles["dead"].ready     # gated on first failure
+        assert handles["dead"].transport_errors >= 1
+        assert router.retries >= 1
+    finally:
+        router.stop()
+        live.stop()
+
+
+def test_router_http_surface_and_fleet_snapshot(trained):
+    t, ds, ckdir, _ = trained
+    rep = _replica(ckdir)
+    router = RouterServer(port=0).start()
+    base = f"http://127.0.0.1:{router.port}"
+    try:
+        # no replica yet: router healthz gates (external LB semantics)
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz", timeout=10)
+        assert ei.value.code == 503
+        router.add_replica("r0", "127.0.0.1", rep.port, ready=True)
+        hz = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())
+        assert hz["status"] == "ok" and hz["ready_replicas"] == 1
+        # predict over the router's HTTP front door (verbatim relay)
+        rows = _rows_of(ds, 2)
+        out = _post(base + "/predict", {"rows": rows})
+        assert out["n"] == 2 and out["model_step"] == t._t
+        # aggregated snapshot: per-replica serve sections + aggregate
+        snap = json.loads(urllib.request.urlopen(
+            base + "/snapshot", timeout=10).read())
+        fl = snap["fleet"]
+        assert "r0" in fl["replicas"]
+        assert fl["replicas"]["r0"]["model_step"] == t._t
+        assert fl["aggregate"]["requests"] >= 1
+        assert fl["aggregate"]["model_step_min"] == t._t
+        assert fl["router"]["routed"] >= 1
+        prom = urllib.request.urlopen(
+            base + "/metrics", timeout=10).read().decode()
+        assert "hivemall_tpu_fleet_aggregate_requests" in prom
+        assert "hivemall_tpu_fleet_router_routed" in prom
+        # unknown path: 404, bad predict body relays the replica's 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + "/nope", {})
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + "/predict", {"nope": 1})
+        assert ei.value.code == 400
+    finally:
+        router.stop()
+        rep.stop()
+
+
+def test_router_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="policy"):
+        RouterServer(policy="round_robin")
+
+
+# --- the real thing: worker processes (slow; smoke covers it in CI) ---------
+
+@pytest.mark.slow
+def test_fleet_processes_end_to_end(trained):
+    from hivemall_tpu.io.sparse import SparseDataset
+    from hivemall_tpu.serve.fleet import Fleet
+    t, ds, ckdir, _ = trained
+    fleet = Fleet("train_classifier", OPTS, checkpoint_dir=ckdir,
+                  replicas=2, health_interval=0.2, watch_interval=0.3,
+                  serve_kwargs={"max_batch": 32, "max_delay_ms": 2.0})
+    fleet.start(wait_ready=True, timeout=180.0)
+    base = f"http://127.0.0.1:{fleet.port}"
+    try:
+        rows = _rows_of(ds, 5)
+        parsed = [t._parse_row(r) for r in rows]
+        ref = t.predict_proba(SparseDataset.from_rows(parsed, [1.0] * 5))
+        out = _post(base + "/predict", {"rows": rows})
+        assert np.array_equal(np.asarray(out["scores"], np.float32), ref)
+        # rolling reload via the router's admin /reload
+        t.fit(ds)
+        p2 = os.path.join(ckdir, f"{t.NAME}-step{t._t:010d}.npz")
+        t.save_bundle(p2)
+        rr = _post(base + "/reload", {"path": p2}, timeout=120.0)
+        assert rr["reloaded"] and rr["fleet_step"] == t._t
+        steps = {r.model_step for r in fleet.manager.replicas()}
+        assert steps == {t._t}
+    finally:
+        fleet.stop()

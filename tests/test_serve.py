@@ -230,6 +230,80 @@ def test_batcher_close_fails_pending():
         b.submit([("c",)])
 
 
+def test_batcher_drain_completes_queued_and_rejects_new():
+    """Graceful shutdown (the fleet replica's SIGTERM path): close(
+    drain=True) mid-traffic completes every ACCEPTED request — the one
+    in flight at the predict fn AND the ones still queued behind it —
+    while new submits are rejected cleanly."""
+    p = GatedPredict()
+    b = MicroBatcher(p, max_batch=2, max_delay_ms=50.0)
+    f1 = b.submit([("a",)])
+    assert _wait(lambda: len(p.calls) == 1)        # in-flight, gated
+    queued = [b.submit([(f"q{i}",)]) for i in range(5)]
+    assert b.queue_depth == 5
+
+    done = threading.Event()
+
+    def closer():
+        b.close(drain=True, timeout=30.0)
+        done.set()
+
+    t = threading.Thread(target=closer)
+    t.start()
+    # close() has been called: new work must already be rejected even
+    # though the queue is still draining behind the gate
+    assert _wait(lambda: b._closed)
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit([("late",)])
+    p.gate.set()                                   # release the scorer
+    assert np.array_equal(f1.result(10), [0.0])
+    for f in queued:                               # every queued request
+        assert len(f.result(10)) == 1              # scored, none dropped
+    assert done.wait(10)
+    t.join(5)
+    assert sum(p.calls) == 6                       # all 6 rows scored
+
+
+def test_batcher_drain_mid_traffic_under_load():
+    """Drain while concurrent submitters are still racing: accepted
+    requests all complete, late ones all fail with the closed error —
+    nothing hangs and nothing is silently dropped."""
+    import numpy as _np
+
+    def predict(rows):
+        time.sleep(0.001)
+        return _np.zeros(len(rows), _np.float32)
+
+    b = MicroBatcher(predict, max_batch=8, max_delay_ms=0.5)
+    results = {"ok": 0, "closed": 0, "other": []}
+    lock = threading.Lock()
+
+    def submitter():
+        for _ in range(50):
+            try:
+                f = b.submit([("x",)])
+                f.result(10)
+                with lock:
+                    results["ok"] += 1
+            except RuntimeError as e:
+                if "closed" in str(e):
+                    with lock:
+                        results["closed"] += 1
+                else:
+                    with lock:
+                        results["other"].append(str(e))
+    ts = [threading.Thread(target=submitter) for _ in range(4)]
+    for t in ts:
+        t.start()
+    time.sleep(0.03)                               # traffic in flight
+    b.close(drain=True, timeout=30.0)
+    for t in ts:
+        t.join(15)
+    assert not results["other"], results
+    assert results["ok"] > 0 and results["closed"] > 0
+    assert results["ok"] + results["closed"] == 200
+
+
 # --- shared shape bucketing (io.sparse) -------------------------------------
 
 def test_bucket_size_clamps():
@@ -392,6 +466,129 @@ def test_engine_rejects_wide_rows_and_out_of_tree_reload(trained):
                          warmup=False)
     with pytest.raises(ValueError, match="watched checkpoint dir"):
         eng2.reload(path)
+
+
+def test_engine_readiness_gates_and_background_warmup(trained):
+    """warmup="background": the engine is constructed NOT ready (healthz
+    must 503 so a router/LB keeps the replica out of rotation), flips
+    ready when the warmup thread finishes; explicit warmup=False means
+    the operator opted into cold serving => ready immediately."""
+    _, _, ckdir, _ = trained
+    eng = _engine(ckdir)                   # warmup=False
+    assert eng.ready                       # opted out => ready
+    ev = threading.Event()
+
+    from hivemall_tpu.serve.engine import PredictEngine
+    orig = PredictEngine._warm_model
+
+    def slow_warm(self, m, warmup_len):
+        assert ev.wait(10)
+        return orig(self, m, warmup_len)
+
+    PredictEngine._warm_model = slow_warm
+    try:
+        bg = PredictEngine("train_classifier", OPTS, checkpoint_dir=ckdir,
+                           warmup="background", max_batch=4)
+        assert not bg.ready                # cold: gated out
+        ev.set()
+        assert bg.wait_ready(10)
+        assert bg.ready
+        bg.close()
+    finally:
+        PredictEngine._warm_model = orig
+    assert eng.bundle_age_seconds is not None
+    assert eng.bundle_age_seconds >= 0
+
+
+def test_http_healthz_reports_readiness(trained):
+    import urllib.error
+    from hivemall_tpu.serve.engine import PredictEngine
+    from hivemall_tpu.serve.http import PredictServer
+    _, _, ckdir, _ = trained
+    ev = threading.Event()
+    orig = PredictEngine._warm_model
+
+    def slow_warm(self, m, warmup_len):
+        assert ev.wait(10)
+        return orig(self, m, warmup_len)
+
+    PredictEngine._warm_model = slow_warm
+    try:
+        eng = PredictEngine("train_classifier", OPTS, checkpoint_dir=ckdir,
+                            warmup="background", max_batch=4)
+        srv = PredictServer(eng, port=0, watch=False).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/healthz", timeout=10)
+            assert ei.value.code == 503        # warming => gated
+            warming = json.loads(ei.value.read())
+            assert warming["status"] == "warming"
+            assert warming["ready"] is False
+            ev.set()
+            assert eng.wait_ready(10)
+            hz = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=10).read())
+            assert hz["status"] == "ok" and hz["ready"] is True
+            # readiness body carries the gating/diagnosis fields the
+            # fleet manager folds into its cached obs section
+            for k in ("model_step", "bundle_age_seconds", "queue_depth",
+                      "requests", "shed", "expired"):
+                assert k in hz, k
+        finally:
+            srv.stop()
+    finally:
+        PredictEngine._warm_model = orig
+
+
+def test_engine_prewarms_scorer_before_swap(trained):
+    """A warmed engine never swaps in a cold scorer: the reload path
+    warms the NEW model's buckets before the atomic ref swap."""
+    from hivemall_tpu.serve.engine import PredictEngine
+    t, ds, ckdir, _ = trained
+    eng = _engine(ckdir, max_batch=4)
+    eng.warmup(8)
+    warmed = []
+    orig = PredictEngine._warm_model
+
+    def spy(self, m, warmup_len):
+        warmed.append(m.step)
+        return orig(self, m, warmup_len)
+
+    PredictEngine._warm_model = spy
+    try:
+        t.fit(ds)
+        p2 = os.path.join(ckdir, f"{t.NAME}-step{t._t:010d}.npz")
+        t.save_bundle(p2)
+        assert eng.poll() is True
+        assert warmed == [t._t]            # new version warmed pre-swap
+        assert eng.ready
+    finally:
+        PredictEngine._warm_model = orig
+
+
+def test_engine_sharded_scorer_matches_unsharded(trained):
+    """The GSPMD serving path (`-mesh dp=..,tp=..` in the serve options):
+    tables load tp-sharded across the virtual 8-device CPU mesh, request
+    batches place over dp when the bucket divides — and scores BIT-match
+    the unsharded engine on the same bundle."""
+    from hivemall_tpu.serve.engine import PredictEngine
+    t, ds, ckdir, path = trained
+    plain = _engine(ckdir)
+    sharded = PredictEngine("train_classifier", OPTS + " -mesh dp=2,tp=4",
+                            checkpoint_dir=ckdir, warmup=False)
+    w = sharded._model.trainer.w
+    shard_rows = w.sharding.shard_shape(w.shape)[0]
+    assert shard_rows == w.shape[0] // 4   # tp=4 table sharding
+    assert sharded.obs_section()["mesh"] == "dp=2,tp=4"
+    rows = _rows_of(ds, 9)                 # pow2 bucket 16 (dp-divisible)
+    a = plain.predict_rows([plain.parse(r) for r in rows])
+    b = sharded.predict_rows([sharded.parse(r) for r in rows])
+    assert np.array_equal(a, b)
+    # single-row requests land in the B=1 bucket (< dp): replicated path
+    one = np.concatenate([sharded.predict_rows([sharded.parse(r)])
+                          for r in rows])
+    assert np.array_equal(one, a)
 
 
 def test_engine_swap_keeps_inflight_model(trained):
