@@ -544,6 +544,7 @@ class _RawClient:
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.rfile = self.sock.makefile("rb")
+        self.last_hops = None            # raw hop headers of the last 200
 
     @staticmethod
     def build(host: str, port: int, path: str, body: bytes) -> bytes:
@@ -552,19 +553,27 @@ class _RawClient:
                 f"Content-Length: {len(body)}\r\n\r\n").encode() + body
 
     def exchange(self, request: bytes) -> int:
-        """Send one pre-built request, read one response, return status."""
+        """Send one pre-built request, read one response, return status.
+        The response's per-hop breakdown headers (x-hivemall-hop[-router])
+        land raw in ``self.last_hops`` — parsed AFTER the timed loop so
+        the harness share of each request stays negligible."""
         self.sock.sendall(request)
         line = self.rfile.readline(65537)
         status = int(line.split(None, 2)[1])
         clen = 0
+        self.last_hops = None
         while True:
             h = self.rfile.readline(65537)
             if not h:
                 raise ConnectionError("closed mid-headers")
             if h in (b"\r\n", b"\n"):
                 break
-            if h.lower().startswith(b"content-length:"):
+            low = h.lower()
+            if low.startswith(b"content-length:"):
                 clen = int(h.split(b":", 1)[1])
+            elif low.startswith(b"x-hivemall-hop"):
+                self.last_hops = (h if self.last_hops is None
+                                  else self.last_hops + h)
         if clen and len(self.rfile.read(clen)) != clen:
             raise ConnectionError("closed mid-body")
         return status
@@ -608,6 +617,7 @@ def _bench_fleet_point(tmp: str, opts: str, rows, n_requests: int,
                                  for j in range(k)]}).encode())
             for i in range(0, 256, k)]
         lat = np.zeros(n_requests, np.float64)
+        hop_raw = [None] * n_requests    # parsed after the timed loop
         nxt = iter(range(n_requests))
         lock = threading.Lock()
         errs = []
@@ -625,6 +635,8 @@ def _bench_fleet_point(tmp: str, opts: str, rows, n_requests: int,
                     code = cli.exchange(reqs[i % len(reqs)])
                     if code != 200:
                         errs.append(code)
+                    else:
+                        hop_raw[i] = cli.last_hops
                 except Exception as e:      # noqa: BLE001 — counted
                     errs.append(str(e))
                 lat[i] = time.perf_counter() - t0
@@ -655,9 +667,47 @@ def _bench_fleet_point(tmp: str, opts: str, rows, n_requests: int,
             "shed": int(agg.get("shed", 0)),
             "expired": int(agg.get("expired", 0)),
             "router_retries": fleet.router.retries,
+            # where each request's wall went at THIS saturation point
+            # (ms p50/p99 per hop, off the response breakdown headers):
+            # router relay vs replica parse/queue/assemble/predict
+            "hops_ms": _summarize_hops(hop_raw),
         }
     finally:
         fleet.stop()
+
+
+def _summarize_hops(hop_raw) -> dict:
+    """Fold the raw x-hivemall-hop[-router] header lines captured per
+    request into per-hop p50/p99 milliseconds. The replica emits
+    parse/queue/assemble/predict/other/total; the router stacks
+    relay/total (as router_total) on top — together one additive
+    decomposition of the end-to-end wall."""
+    import numpy as np
+    series: dict = {}
+    for raw in hop_raw:
+        if not raw:
+            continue
+        for line in raw.splitlines():
+            try:
+                name, vals = line.decode("ascii").split(":", 1)
+            except (UnicodeDecodeError, ValueError):
+                continue
+            router = name.strip().lower().endswith("-router")
+            for kv in vals.strip().split(","):
+                try:
+                    key, v = kv.split("=")
+                    v = float(v)
+                except ValueError:
+                    continue
+                if router:
+                    key = "router_total" if key == "total" else key
+                series.setdefault(key, []).append(v)
+    out = {}
+    for key, vals in sorted(series.items()):
+        a = np.asarray(vals, np.float64)
+        out[key] = {"p50": round(float(np.percentile(a, 50)), 3),
+                    "p99": round(float(np.percentile(a, 99)), 3)}
+    return out
 
 
 def bench_serve(n_requests: int = 2000, concurrency: int = 8,

@@ -286,7 +286,9 @@ def _cmd_serve(args) -> int:
         engine, host=args.host, port=args.port,
         max_delay_ms=args.serve_max_delay_ms,
         max_queue_rows=args.serve_max_queue,
-        deadline_ms=args.serve_deadline_ms).start()
+        deadline_ms=args.serve_deadline_ms,
+        slo_p99_ms=args.slo_p99_ms,
+        slo_availability=args.slo_availability).start()
     print(json.dumps({"host": srv.host, "port": srv.port,
                       "algo": args.algo,
                       "model_step": engine.model_step,
@@ -310,6 +312,9 @@ def _cmd_serve_fleet(args) -> int:
             replicas=args.replicas, host=args.host, port=args.port,
             policy=args.router_policy,
             watch_interval=args.watch_interval,
+            slo_p99_ms=args.slo_p99_ms,
+            slo_availability=args.slo_availability,
+            trace_sample=args.trace_sample,
             serve_kwargs={
                 "max_batch": args.serve_max_batch,
                 "max_delay_ms": args.serve_max_delay_ms,
@@ -345,7 +350,14 @@ def _cmd_serve_fleet(args) -> int:
 def _cmd_obs(args) -> int:
     """Live-run summary off a metrics jsonl (docs/OBSERVABILITY.md): event
     counts, training rate, span stage breakdown, MIX breaker state,
-    checkpoint age. ``--follow`` re-renders as the file grows."""
+    checkpoint age. ``--follow`` re-renders as the file grows. ``--slo``
+    instead renders a serving SLO report (burn rates, windowed p99,
+    drift events) from a serve/router ``/slo`` endpoint or a saved JSON
+    file."""
+    if args.slo:
+        from ..obs.report import render_slo_source
+        return render_slo_source(args.file, follow=args.follow,
+                                 interval=args.interval)
     from ..obs.report import render_file
     return render_file(args.file, follow=args.follow,
                        interval=args.interval)
@@ -462,17 +474,35 @@ def main(argv=None) -> int:
                     help="fleet routing: least in-flight with "
                          "consistent-hash tiebreak (default), or strict "
                          "consistent hashing of the request body")
+    sv.add_argument("--slo-p99-ms", type=float, default=100.0,
+                    help="latency SLO: p99 objective in ms — /slo "
+                         "reports the fraction of requests over it and "
+                         "the burn rate vs the 1%% allowance")
+    sv.add_argument("--slo-availability", type=float, default=0.999,
+                    help="availability SLO target in (0,1); errors+shed "
+                         "burn the error budget (/slo burn rates over "
+                         "5m/1h windows)")
+    sv.add_argument("--trace-sample", type=float, default=0.01,
+                    help="fleet mode: fraction of routed requests the "
+                         "router mints an x-hivemall-trace id for when "
+                         "HIVEMALL_TPU_TRACE is enabled (client-supplied "
+                         "ids are always honored)")
     sv.set_defaults(fn=_cmd_serve)
 
     o = sub.add_parser(
         "obs", help="summarize a HIVEMALL_TPU_METRICS jsonl stream "
                     "(rates, stage breakdown, breaker state, checkpoint "
                     "age)")
-    o.add_argument("file", help="metrics jsonl path")
+    o.add_argument("file", help="metrics jsonl path (or, with --slo, a "
+                                "serve/router base URL or /slo JSON file)")
     o.add_argument("--follow", action="store_true",
                    help="keep watching; re-render when the file grows")
     o.add_argument("--interval", type=float, default=2.0,
                    help="--follow poll interval seconds")
+    o.add_argument("--slo", action="store_true",
+                   help="render a serving SLO report instead: FILE is a "
+                        "http(s)://host:port serve/router base (its /slo "
+                        "endpoint is fetched) or a saved /slo JSON file")
     o.set_defaults(fn=_cmd_obs)
 
     d = sub.add_parser("define-all", help="print the function manifest")

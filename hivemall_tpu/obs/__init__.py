@@ -24,11 +24,23 @@ without reading bench output. This package is the layer that unifies them:
   the registry — the MixServer's JMX peer, back.
 - :mod:`report` — the ``hivemall_tpu obs <metrics.jsonl>`` terminal
   summary (rates, stage breakdown, breaker state, checkpoint age).
+- :mod:`histo` — cumulative fixed-bucket histograms (the Prometheus
+  ``_bucket/_sum/_count`` primitive) feeding serve request-latency and
+  batch-size families on ``/metrics``, and window diffs in :mod:`slo`.
+- :mod:`slo` — the fleet SLO engine: ring time series over serving
+  totals, 5 m / 1 h error-budget burn rates (``/slo``), and in-tree
+  changefinder drift detection over the latency and prediction-score
+  streams (``slo_drift`` events in the metrics jsonl).
 
-See docs/OBSERVABILITY.md for the event schema and span names.
+See docs/OBSERVABILITY.md for the event schema, span names, and the
+"Serving traces and SLOs" tier (request-scoped trace propagation across
+the serving fleet, per-hop latency breakdowns, burn-rate math).
 """
 
+from .histo import Histogram
 from .registry import Registry, registry
-from .trace import Tracer, get_tracer
+from .slo import SloEngine
+from .trace import Tracer, get_tracer, mint_trace_id
 
-__all__ = ["Registry", "registry", "Tracer", "get_tracer"]
+__all__ = ["Registry", "registry", "Tracer", "get_tracer",
+           "mint_trace_id", "Histogram", "SloEngine"]

@@ -1,0 +1,120 @@
+"""Cumulative histograms — the Prometheus-native latency primitive.
+
+The PR-4 obs surface exported only gauges snapshotted from rollups
+(p50/p99 over the tracer's last-512 reservoir), which an external scraper
+cannot window, rate, or aggregate across replicas. :class:`Histogram` is
+the fix: fixed upper bounds, CUMULATIVE bucket counts (`le` semantics),
+plus ``sum``/``count`` — exactly the Prometheus histogram type, so
+``histogram_quantile()`` works over arbitrary scrape windows and the SLO
+engine can diff two snapshots of the same histogram to get the true
+latency distribution of any time window (obs.slo).
+
+Lock-cheap by design: ``observe()`` does the bucket search (bisect over a
+tuple, no allocation) OUTSIDE the lock and holds it only for three scalar
+updates — the serve hot path calls this once per request and once per
+batch. Readers (``snapshot()``) take the same lock briefly to copy the
+counters, so a scrape can never tear a bucket array mid-increment.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Sequence
+
+__all__ = ["Histogram", "quantile_from_buckets",
+           "LATENCY_BUCKETS_S", "BATCH_SIZE_BUCKETS"]
+
+#: request-latency bounds in SECONDS: sub-ms to 10 s, roughly
+#: logarithmic — the serving SLO range (docs/OBSERVABILITY.md)
+LATENCY_BUCKETS_S = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: batch-size bounds in ROWS: the batcher's pow2 coalescing buckets
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+class Histogram:
+    """Thread-safe fixed-bucket cumulative histogram.
+
+    ``bounds`` are inclusive upper bounds (Prometheus ``le``); an implicit
+    ``+Inf`` bucket catches the tail. Counters only ever increase, so two
+    snapshots taken at different times can be subtracted bucket-wise to
+    recover the exact distribution of the interval between them.
+    """
+
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, bounds: Sequence[float]):
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        self._counts = [0] * (len(self.bounds) + 1)   # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        """Record one observation. Bucket search happens outside the lock;
+        the critical section is three scalar updates."""
+        v = float(value)
+        i = bisect_left(self.bounds, v)   # first bound >= v (le semantics)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def snapshot(self) -> dict:
+        """JSON-ready registry form, recognized by ``obs.http``'s
+        Prometheus encoder (``_type: histogram`` → ``_bucket``/``_sum``/
+        ``_count`` series) and consumed cumulatively by ``obs.slo``:
+
+        ``{"_type": "histogram", "buckets": [[le, cumulative], ...,
+        ["+Inf", total]], "sum": ..., "count": ...}``
+        """
+        with self._lock:
+            counts = list(self._counts)
+            s, n = self._sum, self._count
+        cum = 0
+        buckets = []
+        for bound, c in zip(self.bounds, counts):
+            cum += c
+            buckets.append([bound, cum])
+        buckets.append(["+Inf", cum + counts[-1]])
+        return {"_type": "histogram", "buckets": buckets,
+                "sum": round(s, 6), "count": n}
+
+
+def quantile_from_buckets(buckets, q: float) -> float:
+    """Estimate the ``q``-quantile from cumulative ``[le, count]`` pairs
+    (a :meth:`Histogram.snapshot` ``buckets`` list, or a bucket-wise DIFF
+    of two snapshots — the SLO engine's windowed-p99 path). Linear
+    interpolation inside the winning bucket, Prometheus
+    ``histogram_quantile`` style; the +Inf bucket clamps to the largest
+    finite bound. Returns 0.0 for an empty distribution."""
+    if not buckets:
+        return 0.0
+    total = buckets[-1][1]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in buckets:
+        if cum >= target:
+            if bound == "+Inf":
+                return float(prev_bound)
+            if cum == prev_cum:          # degenerate: empty bucket hit
+                return float(bound)
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return float(prev_bound) + frac * (float(bound) - prev_bound)
+        if bound != "+Inf":
+            prev_bound, prev_cum = float(bound), cum
+    return float(prev_bound)

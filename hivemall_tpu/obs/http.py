@@ -7,7 +7,12 @@ central registry (``-obs_port`` trainer option, or :func:`ensure_server`):
   every subsystem's counters; see obs.registry).
 - ``GET /metrics``  — the same counters flattened to Prometheus text
   exposition (version 0.0.4): ``hivemall_tpu_<section>_<key> <value>``
-  gauges, booleans as 0/1, non-numeric leaves skipped.
+  gauges, booleans as 0/1, non-numeric leaves skipped; dict leaves
+  shaped by :meth:`obs.histo.Histogram.snapshot` become real histogram
+  families (``_bucket{le=...}``/``_sum``/``_count``).
+- ``GET /trace``    — the process tracer's span ring as Chrome-trace
+  JSON (wall-clock-anchored; the fleet router merges these per-replica
+  exports into one cross-process timeline).
 
 Single-threaded on purpose: one handler at a time means a scrape can never
 pile threads onto a training host; a slow scraper only delays the next
@@ -31,7 +36,21 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
 
 def _metric_name(parts) -> str:
-    return _NAME_RE.sub("_", "_".join(parts))
+    """Join a snapshot path into a valid Prometheus metric name: every
+    illegal character becomes ``_`` (dots, dashes — snapshot keys are
+    arbitrary provider strings) and a leading digit gets an underscore
+    prefix (the grammar requires ``[a-zA-Z_:]`` first)."""
+    name = _NAME_RE.sub("_", "_".join(parts))
+    if name[:1].isdigit():
+        name = "_" + name
+    return name
+
+
+def _fmt_value(val) -> str:
+    # ints verbatim, floats via repr — NOT %g, which truncates to 6
+    # significant digits and corrupts large counters
+    # (examples=44776121 -> 4.47761e+07) and epoch timestamps
+    return str(val) if isinstance(val, int) else repr(float(val))
 
 
 def to_prometheus(snapshot: dict, prefix: str = "hivemall_tpu") -> str:
@@ -41,7 +60,13 @@ def to_prometheus(snapshot: dict, prefix: str = "hivemall_tpu") -> str:
     dict path (``pipeline.batches_prepared`` ->
     ``hivemall_tpu_pipeline_batches_prepared``); strings/lists/None are
     presentation-only and are skipped (the JSON ``/snapshot`` carries
-    them). The top-level ``ts`` is exported as ``<prefix>_snapshot_ts``.
+    them). Dict leaves carrying ``"_type": "histogram"``
+    (:meth:`obs.histo.Histogram.snapshot`) export as real histogram
+    families — cumulative ``_bucket{le="..."}`` series plus ``_sum`` and
+    ``_count`` — so scrapers can ``histogram_quantile()`` over arbitrary
+    windows instead of reading snapshot-time p99 gauges. Every family
+    carries ``# HELP`` (its snapshot dot-path) and ``# TYPE``. The
+    top-level ``ts`` is exported as ``<prefix>_snapshot_ts``.
     """
     lines = []
 
@@ -51,18 +76,30 @@ def to_prometheus(snapshot: dict, prefix: str = "hivemall_tpu") -> str:
         elif isinstance(val, (int, float)):
             emit(parts, val)
         elif isinstance(val, dict):
+            if val.get("_type") == "histogram":
+                emit_histogram(parts, val)
+                return
             for k in sorted(val):
                 walk(parts + [str(k)], val[k])
         # str / list / None: no numeric reading — skipped
 
+    def head(name, parts, mtype):
+        lines.append(f"# HELP {name} {'.'.join(parts[1:])}")
+        lines.append(f"# TYPE {name} {mtype}")
+
     def emit(parts, val):
         name = _metric_name(parts)
-        lines.append(f"# TYPE {name} gauge")
-        # ints verbatim, floats via repr — NOT %g, which truncates to 6
-        # significant digits and corrupts large counters
-        # (examples=44776121 -> 4.47761e+07) and epoch timestamps
-        out = str(val) if isinstance(val, int) else repr(float(val))
-        lines.append(f"{name} {out}")
+        head(name, parts, "gauge")
+        lines.append(f"{name} {_fmt_value(val)}")
+
+    def emit_histogram(parts, hist):
+        name = _metric_name(parts)
+        head(name, parts, "histogram")
+        for bound, cum in hist.get("buckets") or []:
+            le = "+Inf" if bound == "+Inf" else _fmt_value(bound)
+            lines.append(f'{name}_bucket{{le="{le}"}} {int(cum)}')
+        lines.append(f"{name}_sum {_fmt_value(float(hist.get('sum', 0.0)))}")
+        lines.append(f"{name}_count {int(hist.get('count', 0))}")
 
     for section in sorted(snapshot):
         if section == "ts":
@@ -92,8 +129,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         elif path == "/metrics":
             body = to_prometheus(self.obs_registry.snapshot()).encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/trace":
+            # the process tracer's span ring as Chrome-trace JSON; the
+            # fleet router fetches this per replica and merges the events
+            # (distinct pids) into one cross-process request flame
+            from .trace import get_tracer
+            body = json.dumps(get_tracer().chrome_dict()).encode()
+            ctype = "application/json"
         else:
-            self.send_error(404, "unknown path (try /snapshot or /metrics)")
+            self.send_error(404, "unknown path (try /snapshot, /metrics "
+                                 "or /trace)")
             return
         self.send_response(200)
         self.send_header("Content-Type", ctype)

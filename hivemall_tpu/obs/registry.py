@@ -97,3 +97,6 @@ registry.register("fleet", lambda: {
     "replicas": 0, "ready": 0, "respawns": 0, "rolls": 0,
     "roll_failures": 0, "rejected_bundles": 0, "fleet_step": None,
     "model_steps": {}})
+# obs.slo.SloEngine overrides this with live burn rates when a serve
+# surface configures an SLO; the stub keeps the section shape-stable
+registry.register("slo", lambda: {"configured": False})

@@ -24,7 +24,8 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["load_events", "summarize", "render_file"]
+__all__ = ["load_events", "summarize", "render_file", "render_slo",
+           "render_slo_source"]
 
 
 class _TailState:
@@ -187,16 +188,75 @@ def summarize(events: List[dict], bad: int = 0, path: str = "",
     return _render(state, path=path, now=now)
 
 
+class _FollowTail:
+    """One incremental follow of a metrics jsonl path: each :meth:`tick`
+    folds only the bytes appended since the last tick into the bounded
+    aggregates and returns the re-rendered summary (or None when nothing
+    new landed). Factored out of :func:`render_file` so the
+    rotation-under-follow contract is testable without driving a thread
+    through the sleep loop.
+
+    Rotation contract (``HIVEMALL_TPU_METRICS_MAX_MB``): when
+    ``MetricsStream._rotate`` replaces ``<path>`` with a FRESH file (the
+    old generation moves to ``<path>.1``), the tail detects the inode
+    change and REOPENS ``<path>`` from offset 0 — it never opens
+    ``<path>.1``, so rotated-away history is not replayed into the
+    aggregates (events already folded stay folded; a generation rotated
+    fully away between ticks is lost, by design). A bare truncation
+    (same inode, smaller size) likewise restarts from the head. A stat
+    or open that lands in the replace window (file briefly absent)
+    retries next tick."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.state = _TailState()
+        self._offset = 0
+        self._ino: Optional[int] = None
+
+    def tick(self) -> Optional[str]:
+        try:
+            st = os.stat(self.path)
+        except FileNotFoundError:
+            # rotation window: MetricsStream._rotate has os.replace'd
+            # the file and not yet re-opened it — retry next tick
+            return None
+        size = st.st_size
+        # rotation = a FRESH file replaced the tailed one (inode change —
+        # size alone can't tell when the new file already grew past the
+        # old offset) or in-place truncation: restart from the head.
+        if self._ino is None:
+            self._ino = st.st_ino
+        elif st.st_ino != self._ino:
+            self._ino, self._offset = st.st_ino, 0
+        if size < self._offset:
+            self._offset = 0
+        if size <= self._offset:
+            return None
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                data = f.read()
+        except FileNotFoundError:        # rotated between stat and open
+            return None
+        nl = data.rfind(b"\n")
+        if nl < 0:                       # complete lines only; the torn
+            return None                  # tail waits for its newline
+        self._offset += nl + 1
+        self.state.feed_lines(data[:nl + 1])
+        return _render(self.state, path=self.path)
+
+
 def render_file(path: str, follow: bool = False,
                 interval: float = 2.0) -> int:
     """Print the summary for ``path``; with ``follow`` re-render whenever
     the file grows (Ctrl-C exits). Returns a process exit code.
 
-    Follow mode tails INCREMENTALLY: each tick reads only the appended
-    bytes, folds them into the bounded aggregates, and defers a partial
-    trailing line — a record mid-write is read whole on the next tick,
-    never counted as torn. A shrinking file (rotation by
-    ``HIVEMALL_TPU_METRICS_MAX_MB``) restarts the tail from zero."""
+    Follow mode tails INCREMENTALLY via :class:`_FollowTail`: each tick
+    reads only the appended bytes, folds them into the bounded
+    aggregates, and defers a partial trailing line — a record mid-write
+    is read whole on the next tick, never counted as torn. A file
+    replaced by ``HIVEMALL_TPU_METRICS_MAX_MB`` rotation is reopened
+    from its head without replaying ``<path>.1``."""
     if not os.path.exists(path):
         print(f"obs: {path}: no such file", file=sys.stderr)
         return 1
@@ -204,44 +264,85 @@ def render_file(path: str, follow: bool = False,
         events, bad = load_events(path)
         print(summarize(events, bad, path=path))
         return 0
-    state = _TailState()
-    offset = 0
-    ino = None
+    tail = _FollowTail(path)
     try:
         while True:
-            try:
-                st = os.stat(path)
-            except FileNotFoundError:
-                # rotation window: MetricsStream._rotate has os.replace'd
-                # the file and not yet re-opened it — retry next tick
-                time.sleep(max(0.1, interval))
-                continue
-            size = st.st_size
-            # rotation = a FRESH file replaced the tailed one (inode
-            # change — size alone can't tell when the new file already
-            # grew past the old offset) or in-place truncation: restart
-            # from the head. Aggregates keep running across generations;
-            # a generation rotated fully away between polls is lost.
-            if ino is None:
-                ino = st.st_ino
-            elif st.st_ino != ino:
-                ino, offset = st.st_ino, 0
-            if size < offset:
-                offset = 0
-            if size > offset:
-                try:
-                    with open(path, "rb") as f:
-                        f.seek(offset)
-                        data = f.read()
-                except FileNotFoundError:  # rotated between stat and open
-                    time.sleep(max(0.1, interval))
-                    continue
-                nl = data.rfind(b"\n")
-                if nl >= 0:              # complete lines only; the torn
-                    offset += nl + 1     # tail waits for its newline
-                    state.feed_lines(data[:nl + 1])
-                    print(_render(state, path=path))
-                    print("-" * 60)
+            out = tail.tick()
+            if out is not None:
+                print(out)
+                print("-" * 60)
             time.sleep(max(0.1, interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+# --- serving SLO report (docs/OBSERVABILITY.md "Serving traces and SLOs")
+
+
+def render_slo(slo: dict, source: str = "") -> str:
+    """Human rendering of a serve/router ``/slo`` payload: targets, the
+    per-window burn-rate table, and recent drift events."""
+    t = slo.get("targets") or {}
+    out = [f"slo: {source or 'serving'} — targets: "
+           f"p99 <= {t.get('p99_ms', '?')}ms, "
+           f"availability >= {t.get('availability', '?')}"
+           f"  ({slo.get('samples', 0)} samples)"]
+    wins = slo.get("windows") or {}
+    if not wins:
+        out.append("  no samples yet")
+    for name in sorted(wins, key=lambda k: wins[k].get("seconds", 0)):
+        w = wins[name]
+        p99 = w.get("p99_ms")
+        out.append(
+            f"  {name:>3}: qps {w.get('qps', 0):>8}  "
+            f"avail {w.get('availability', 1.0):.6f} "
+            f"(burn {w.get('availability_burn_rate', 0.0):g}x)  "
+            f"p99 {('%.1fms' % p99) if p99 is not None else '—':>9}  "
+            f"over-slo {100.0 * w.get('frac_over_slo', 0.0):.2f}% "
+            f"(burn {w.get('latency_burn_rate', 0.0):g}x)")
+    sc = slo.get("score")
+    if sc:
+        out.append(f"  score: mean {sc.get('mean')}  std {sc.get('std')}")
+    dr = slo.get("drift") or {}
+    out.append(f"  drift: latency x{dr.get('latency_events', 0)}  "
+               f"score x{dr.get('score_events', 0)}")
+    for ev in (dr.get("recent") or [])[-4:]:
+        out.append(f"    [{ev.get('series')}] change "
+                   f"{ev.get('change_score')} at value {ev.get('value')} "
+                   f"(ts {ev.get('ts')})")
+    return "\n".join(out)
+
+
+def _fetch_slo(source: str) -> dict:
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+        url = source.rstrip("/")
+        if not url.endswith("/slo"):
+            url += "/slo"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read())
+    with open(source, "rb") as f:
+        return json.loads(f.read())
+
+
+def render_slo_source(source: str, follow: bool = False,
+                      interval: float = 2.0) -> int:
+    """``hivemall_tpu obs --slo <url-or-file>``: fetch and render the SLO
+    report; ``--follow`` re-renders on the poll interval."""
+    try:
+        print(render_slo(_fetch_slo(source), source=source))
+    except (OSError, ValueError) as e:
+        print(f"obs --slo: {source}: {e}", file=sys.stderr)
+        return 1
+    if not follow:
+        return 0
+    try:
+        while True:
+            time.sleep(max(0.1, interval))
+            try:
+                print("-" * 60)
+                print(render_slo(_fetch_slo(source), source=source))
+            except (OSError, ValueError) as e:
+                print(f"obs --slo: {source}: {e}", file=sys.stderr)
     except KeyboardInterrupt:
         return 0

@@ -1,0 +1,426 @@
+"""Fleet SLO engine — error-budget burn rates + drift detection.
+
+Answers the two questions the span/gauge surface could not (ROADMAP
+items 1/2): *are we inside our latency/availability budget over the last
+5 minutes / hour*, and *did the latency or prediction-score distribution
+just shift*.
+
+Inputs are CUMULATIVE serving totals — request/error/shed counters plus
+a cumulative latency :class:`~hivemall_tpu.obs.histo.Histogram` snapshot
+— sampled on a fixed cadence into a bounded in-memory ring (the single
+``PredictServer`` samples its own micro-batcher; the fleet's
+``ReplicaManager`` sums every replica's ``/healthz`` ``slo`` section each
+health tick). ``evaluate()`` then diffs the newest sample against the
+sample at each window's far edge, which recovers the EXACT distribution
+of that window from monotonic counters — no decaying averages, and a
+replica respawn (counters reset) degrades to a clamped-at-zero diff
+instead of a negative rate.
+
+Per window (5 m / 1 h by default):
+
+- **availability**: ``1 - (errors + shed) / requests`` vs the
+  ``--slo-availability`` target; burn rate = bad-fraction / error-budget
+  (>1 = burning budget faster than allowed; 1.0 = exactly on budget).
+- **latency**: the fraction of requests over ``--slo-p99-ms`` vs the 1 %
+  allowance a p99 objective implies; burn rate = over-fraction / 0.01.
+  The window's true p99 is interpolated from the bucket diff.
+
+Drift detection (ROADMAP item 2's "point the changefinder at the
+latency and score streams"): every sample tick feeds the interval's mean
+latency and the fleet's prediction-score mean into two in-tree
+:class:`~hivemall_tpu.models.anomaly.ChangeFinder` instances. A change
+score beyond ``drift_sigma`` standard deviations of the detector's own
+running change-score distribution flags a drift event: counted, kept in
+a bounded recent-events list, and emitted as an ``slo_drift`` record
+into the metrics jsonl stream — the same stream ``hivemall_tpu obs``
+tails, so a latency regression or model-score shift shows up next to
+train/serve telemetry without any external alerting stack.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from .histo import quantile_from_buckets
+
+__all__ = ["SloEngine"]
+
+#: evaluation windows: SRE-standard fast/slow burn pair
+WINDOWS = (("5m", 300.0), ("1h", 3600.0))
+
+
+class _Sample:
+    __slots__ = ("ts", "offered", "bad", "buckets", "lat_sum",
+                 "lat_count", "score_sum", "score_sumsq", "score_n")
+
+    def __init__(self, ts, offered, bad, buckets, lat_sum, lat_count,
+                 score_sum, score_sumsq, score_n):
+        self.ts = ts
+        self.offered = offered          # accepted + shed: what clients
+        self.bad = bad                  # actually attempted
+        self.buckets = buckets          # cumulative [le, count] pairs
+        self.lat_sum = lat_sum
+        self.lat_count = lat_count
+        self.score_sum = score_sum      # cumulative score moments
+        self.score_sumsq = score_sumsq
+        self.score_n = score_n
+
+
+def _diff_buckets(new, old) -> List[list]:
+    """Bucket-wise clamped difference of two cumulative bucket lists —
+    the distribution of the interval between the two snapshots. Bounds
+    are positional (both sides come from the same Histogram config).
+    A counter reset (replica respawn) clamps at zero, and a PARTIAL
+    fleet reset (one replica's history vanishes while survivors grow)
+    can leave the per-bucket clamps non-monotone — a running max
+    restores a valid cumulative series so downstream quantiles and
+    over-SLO fractions stay in range."""
+    if not new:
+        return []
+    if not old or len(old) != len(new):
+        return [[b, int(c)] for b, c in new]
+    out = []
+    run = 0
+    for (b, c), (_, oc) in zip(new, old):
+        run = max(run, int(c) - int(oc))
+        out.append([b, run])
+    return out
+
+
+class SloEngine:
+    """Windowed SLO evaluation + changefinder drift over serving totals.
+
+    Thread-safe: ``sample()`` runs on a sampler/health thread while
+    ``evaluate()`` serves ``/slo`` scrapes. Registers itself as the obs
+    registry's ``slo`` section (last engine wins, weakly held).
+    """
+
+    #: ring capacity; paired with _RING_GAP thinning below so the ring
+    #: always covers the FULL 1 h window no matter how fast the sampler
+    #: ticks (the fleet manager samples every health_interval, 0.2-0.5 s)
+    _CAPACITY = 4096
+    #: minimum spacing between RING entries: capacity x gap > 1 h, so a
+    #: sub-second cadence thins into the ring instead of evicting the
+    #: window edge; drift detection still sees every raw tick
+    _RING_GAP = 3600.0 / (_CAPACITY - 256)
+
+    def __init__(self, *, p99_ms: float = 100.0,
+                 availability: float = 0.999,
+                 drift_sigma: float = 6.0,
+                 drift_warmup: int = 32,
+                 interval: float = 1.0):
+        if not 0.0 < availability < 1.0:
+            raise ValueError(f"availability target must be in (0, 1), "
+                             f"got {availability}")
+        self.p99_ms = float(p99_ms)
+        self.availability = float(availability)
+        self.drift_sigma = float(drift_sigma)
+        self.drift_warmup = int(drift_warmup)
+        self.interval = float(interval)
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self._CAPACITY)
+        self._last: Optional[_Sample] = None   # newest RAW sample (the
+        # ring is gap-thinned; evaluation freshness must not be)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # drift detectors over the per-tick series (in-tree changefinder,
+        # PAPER.md [B]); lazily constructed so importing obs.slo doesn't
+        # pull the anomaly module into every process
+        from ..models.anomaly import ChangeFinder
+        self._cf = {"latency_ms": ChangeFinder(), "score": ChangeFinder()}
+        # Welford stats per (series, stage): BOTH changefinder stages are
+        # watched — the stage-2 change score for gradual drifts, the
+        # stage-1 outlier score for step regressions (a sustained 30x
+        # latency step spikes stage 1 immediately while stage 2's
+        # double-smoothing flattens it); each threshold self-calibrates
+        # to its own score distribution
+        self._cf_stats: Dict[tuple, list] = {
+            (k, s): [0, 0.0, 0.0]       # n, mean, M2
+            for k in self._cf for s in ("outlier", "change")}
+        self.drift_events: deque = deque(maxlen=64)
+        self.drift_counts = {k: 0 for k in self._cf}
+        self.samples = 0
+        self._register_obs()
+
+    # -- sampling ------------------------------------------------------------
+    def sample(self, totals: dict, ts: Optional[float] = None) -> None:
+        """Fold one snapshot of cumulative serving totals into the ring.
+
+        ``totals`` keys (all optional, cumulative unless noted):
+        ``requests``, ``errors``, ``shed``, ``expired``, ``latency`` (a
+        ``Histogram.snapshot()`` dict), ``score_sum`` / ``score_sumsq`` /
+        ``score_n`` (cumulative score moments, fleet-summable), plus
+        ``reset`` (bool, NOT cumulative): the sampler observed a
+        counter reset inside this interval (a replica respawned), so
+        the tick's deltas are unreliable — fold the sample into the
+        windows (diffs clamp) but skip the drift feed.
+        """
+        ts = time.time() if ts is None else float(ts)
+        lat = totals.get("latency") or {}
+        shed = int(totals.get("shed") or 0)
+        cur = _Sample(
+            ts,
+            # the batcher's `requests` counts ACCEPTED requests (a shed
+            # submit raises before the counter) — the availability
+            # denominator must be what clients OFFERED, or overload
+            # reads as >100% failure
+            int(totals.get("requests") or 0) + shed,
+            # every client-visible failure burns the availability
+            # budget: errors (500s), shed (503s) AND expired (504s)
+            int(totals.get("errors") or 0) + shed
+            + int(totals.get("expired") or 0),
+            [[b, int(c)] for b, c in (lat.get("buckets") or [])],
+            float(lat.get("sum") or 0.0),
+            int(lat.get("count") or 0),
+            float(totals.get("score_sum") or 0.0),
+            float(totals.get("score_sumsq") or 0.0),
+            int(totals.get("score_n") or 0))
+        with self._lock:
+            prev = self._last
+            self._last = cur
+            # gap-thinned ring: sub-second cadences keep full 1h window
+            # coverage instead of evicting the far edge (evaluate() uses
+            # self._last for freshness, the ring for window edges)
+            if not self._ring or cur.ts - self._ring[-1].ts \
+                    >= self._RING_GAP:
+                self._ring.append(cur)
+            self.samples += 1
+        if not totals.get("reset"):
+            self._detect_drift(prev, cur)
+
+    def start(self, provider: Callable[[], dict]) -> "SloEngine":
+        """Sample ``provider()`` every ``interval`` seconds on a daemon
+        thread — the single-server recipe (the fleet manager calls
+        :meth:`sample` from its own health loop instead)."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.sample(provider())
+                except Exception:        # noqa: BLE001 — obs never takes
+                    pass                 # serving down
+
+        self._thread = threading.Thread(target=run, name="slo-sampler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- drift ---------------------------------------------------------------
+    def _detect_drift(self, prev: Optional[_Sample], cur: _Sample) -> None:
+        if prev is None:
+            return
+        feeds = []
+        # negative sum deltas happen on PARTIAL fleet counter resets
+        # (one replica respawned, the others kept counting): the tick's
+        # interval mean is unknowable, so skip the feed — a garbage
+        # negative value would flag a spurious drift event exactly
+        # during the crash-recovery the fleet is built to absorb
+        if cur.lat_count > prev.lat_count \
+                and cur.lat_sum >= prev.lat_sum:
+            d = cur.lat_count - prev.lat_count
+            feeds.append(("latency_ms",
+                          (cur.lat_sum - prev.lat_sum) / d * 1000.0))
+        if cur.score_n > prev.score_n:
+            # the INTERVAL's mean score (moment diff), not the cumulative
+            # mean — a model-score shift must hit the detector at full
+            # magnitude, not diluted by the whole run's history; scores
+            # may legitimately be negative, so the reset guard here is
+            # the sumsq moment (monotonic for real data)
+            if cur.score_sumsq >= prev.score_sumsq:
+                dn = cur.score_n - prev.score_n
+                feeds.append(("score",
+                              (cur.score_sum - prev.score_sum) / dn))
+        for series, x in feeds:
+            outlier, change = self._cf[series].update(x)
+            flagged = None
+            for stage, score in (("outlier", outlier),
+                                 ("change", change)):
+                st = self._cf_stats[(series, stage)]
+                st[0] += 1
+                n = st[0]
+                delta = score - st[1]
+                st[1] += delta / n
+                st[2] += delta * (score - st[1])
+                if n <= self.drift_warmup:
+                    continue
+                std = (st[2] / max(1, n - 1)) ** 0.5
+                if std > 0 and score > st[1] + self.drift_sigma * std:
+                    flagged = flagged or stage
+            if flagged:                   # at most one event per tick
+                ev = {"ts": round(cur.ts, 3), "series": series,
+                      "stage": flagged,
+                      "value": round(float(x), 6),
+                      "outlier_score": round(float(outlier), 4),
+                      "change_score": round(float(change), 4)}
+                with self._lock:          # evaluate() copies the deque
+                    self.drift_counts[series] += 1   # from HTTP threads
+                    self.drift_events.append(ev)
+                # into the jsonl metrics stream, next to train/serve
+                # telemetry — `hivemall_tpu obs` renders it
+                from ..utils.metrics import get_stream
+                get_stream().emit("slo_drift", **ev)
+
+    # -- evaluation ----------------------------------------------------------
+    def _window_edge(self, samples: List[_Sample], now: float,
+                     seconds: float) -> Optional[_Sample]:
+        """The newest sample at or beyond the window's far edge (falling
+        back to the oldest sample when history is shorter than the
+        window — the diff then covers everything we have)."""
+        lo = now - seconds
+        edge = None
+        for s in samples:               # oldest -> newest
+            if s.ts <= lo:
+                edge = s
+            else:
+                break
+        return edge or (samples[0] if samples else None)
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """The ``/slo`` payload: per-window traffic, availability and
+        latency vs target with error-budget burn rates, plus drift
+        state. JSON-ready and cheap enough per scrape (one pass over the
+        bounded ring per window)."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            samples = list(self._ring)
+            cur = self._last
+            drift_recent = list(self.drift_events)[-8:]
+            drift_counts = dict(self.drift_counts)
+        if cur is not None and (not samples or samples[-1] is not cur):
+            samples.append(cur)          # freshest raw sample wins
+        out: dict = {
+            "ts": round(now, 3),
+            "configured": True,
+            "samples": len(samples),
+            "targets": {"p99_ms": self.p99_ms,
+                        "availability": self.availability},
+            "windows": {},
+            "drift": {
+                "latency_events": drift_counts["latency_ms"],
+                "score_events": drift_counts["score"],
+                "recent": drift_recent,
+            },
+        }
+        if not samples:
+            return out
+        for name, seconds in WINDOWS:
+            base = self._window_edge(samples, now, seconds)
+            span = max(1e-9, cur.ts - base.ts) if base is not cur else 0.0
+            d_req = max(0, cur.offered - base.offered) \
+                if base is not cur else cur.offered
+            d_bad = max(0, cur.bad - base.bad) \
+                if base is not cur else cur.bad
+            # a PARTIAL fleet reset can clamp the offered delta harder
+            # than the bad delta (the dead replica held good history);
+            # bad ⊆ offered by definition, so bound it — availability
+            # must never go negative
+            d_bad = min(d_bad, d_req)
+            diff = _diff_buckets(cur.buckets,
+                                 base.buckets if base is not cur else None)
+            d_cnt = diff[-1][1] if diff else 0
+            w: dict = {
+                "seconds": seconds,
+                "covered_seconds": round(span, 1),
+                "requests": d_req,
+                "bad": d_bad,
+                "qps": round(d_req / span, 2) if span else 0.0,
+            }
+            avail = 1.0 - (d_bad / d_req) if d_req else 1.0
+            w["availability"] = round(avail, 6)
+            w["availability_burn_rate"] = round(
+                (1.0 - avail) / (1.0 - self.availability), 3)
+            if d_cnt:
+                p99_s = quantile_from_buckets(diff, 0.99)
+                w["p99_ms"] = round(p99_s * 1000.0, 3)
+                over = max(0, d_cnt
+                           - self._count_le(diff, self.p99_ms / 1000.0))
+                frac_over = over / d_cnt
+                w["frac_over_slo"] = round(frac_over, 6)
+                # a p99 objective allows 1% of requests over the bound
+                w["latency_burn_rate"] = round(frac_over / 0.01, 3)
+            else:
+                w["p99_ms"] = None
+                w["frac_over_slo"] = 0.0
+                w["latency_burn_rate"] = 0.0
+            d_sn = max(0, cur.score_n - base.score_n) \
+                if base is not cur else cur.score_n
+            if d_sn:
+                ds = cur.score_sum - (base.score_sum
+                                      if base is not cur else 0.0)
+                dss = cur.score_sumsq - (base.score_sumsq
+                                         if base is not cur else 0.0)
+                m = ds / d_sn
+                var = dss / d_sn - m * m
+                # moment-consistency guard (the partial-reset hardening
+                # the availability/latency paths above get): sumsq is
+                # monotone for real data and mean² <= E[s²] by
+                # Cauchy–Schwarz — a window diff violating either mixes
+                # pre- and post-reset history, so suppress rather than
+                # report a garbage score_mean
+                if dss >= 0.0 and var >= -1e-9:
+                    w["score_mean"] = round(m, 6)
+                    w["score_std"] = round(max(0.0, var) ** 0.5, 6)
+            out["windows"][name] = w
+        if cur.score_n > 0:
+            m = cur.score_sum / cur.score_n
+            out["score"] = {"mean": round(m, 6),
+                            "std": round(max(
+                                0.0, cur.score_sumsq / cur.score_n
+                                - m * m) ** 0.5, 6)}
+        return out
+
+    @staticmethod
+    def _count_le(diff, bound_s: float) -> int:
+        """Requests at or under ``bound_s`` in a bucket diff: the
+        cumulative count of the LARGEST bucket bound <= the target —
+        conservative for an SLO (a target between two bounds counts the
+        straddling bucket as violations, never as compliance)."""
+        best = 0
+        for b, c in diff:
+            if b == "+Inf" or float(b) > bound_s:
+                break
+            best = int(c)
+        return best
+
+    # -- obs -----------------------------------------------------------------
+    def obs_section(self) -> dict:
+        """The registry ``slo`` section: the numeric core of
+        :meth:`evaluate` (burn rates + drift counters flatten into
+        ``/metrics`` gauges; the full payload lives at ``/slo``)."""
+        ev = self.evaluate()
+        d: dict = {"configured": True, "samples": ev["samples"],
+                   "target_p99_ms": self.p99_ms,
+                   "target_availability": self.availability,
+                   "drift_latency_events": ev["drift"]["latency_events"],
+                   "drift_score_events": ev["drift"]["score_events"]}
+        for name, w in ev["windows"].items():
+            d[name] = {"qps": w["qps"], "availability": w["availability"],
+                       "availability_burn_rate":
+                           w["availability_burn_rate"],
+                       "p99_ms": w["p99_ms"],
+                       "latency_burn_rate": w["latency_burn_rate"]}
+        return d
+
+    def _register_obs(self) -> None:
+        import weakref
+        from .registry import registry
+        ref = weakref.ref(self)
+
+        def slo() -> dict:
+            e = ref()
+            return e.obs_section() if e is not None \
+                else {"configured": False}
+
+        registry.register("slo", slo)

@@ -30,10 +30,23 @@ Activation: ``HIVEMALL_TPU_TRACE=1`` enables the process tracer;
 ``HIVEMALL_TPU_TRACE=/path/trace.json`` additionally writes the Chrome
 export there at ``train_done``. Or drive it explicitly via
 ``get_tracer().enable()``.
+
+Request-scoped tracing (docs/OBSERVABILITY.md "Serving traces and
+SLOs"): a serving request sampled by the fleet router (or carrying an
+explicit ``x-hivemall-trace`` header) flows its trace id through
+:meth:`Tracer.context` — a thread-local tag that every span completed
+inside the ``with`` block records into its Chrome-export ``args``. The
+export timestamps are WALL-CLOCK anchored (epoch microseconds), so the
+router's and each replica's independently-recorded spans line up on one
+Perfetto timeline when merged (each process keeps its own ``pid``); the
+router's ``/trace`` endpoint does exactly that merge. Disabled-tracer
+cost is unchanged: ``span()``/``context()`` stay one attribute check
+returning a shared no-op.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -41,7 +54,7 @@ import time
 from collections import deque
 from typing import Dict, Optional
 
-__all__ = ["Tracer", "get_tracer"]
+__all__ = ["Tracer", "get_tracer", "mint_trace_id"]
 
 _RING = 8192          # completed spans kept for the Chrome export
 _RESERVOIR = 512      # per-stage duration reservoir for p50/p99
@@ -60,6 +73,39 @@ class _NullSpan:
 
 
 _NULL_SPAN = _NullSpan()
+
+# per-process salt keeps minted ids unique across replica restarts on one
+# host (pid alone recycles); 2 bytes is plenty for a serving fleet
+_TRACE_SALT = int.from_bytes(os.urandom(2), "big")
+_trace_seq = itertools.count(1)
+
+
+def mint_trace_id() -> str:
+    """A new request trace id: ``<pid>-<salt>-<seq>`` hex — unique across
+    the processes of one fleet without any coordination."""
+    return f"{os.getpid():x}-{_TRACE_SALT:04x}-{next(_trace_seq):x}"
+
+
+class _TraceCtx:
+    """Thread-local trace tag: spans completed inside the block record
+    ``tag`` into their Chrome-export args. Nestable (restores the outer
+    tag on exit); created only when the tracer is enabled AND a request
+    is actually traced, so the untraced hot path never sees it."""
+
+    __slots__ = ("_tls", "tag", "_prev")
+
+    def __init__(self, tls, tag: str):
+        self._tls = tls
+        self.tag = tag
+
+    def __enter__(self):
+        self._prev = getattr(self._tls, "trace", None)
+        self._tls.trace = self.tag
+        return self
+
+    def __exit__(self, *exc):
+        self._tls.trace = self._prev
+        return False
 
 
 class _Span:
@@ -104,10 +150,18 @@ class Tracer:
     def __init__(self, enabled: bool = False, ring: int = _RING):
         self.enabled = bool(enabled)
         self.export_path: Optional[str] = None
+        # shows as the Chrome-export process name next to the pid, so a
+        # merged fleet trace reads router/replica instead of bare pids
+        self.process_label = f"pid{os.getpid()}"
         self._lock = threading.Lock()
         self._stages: Dict[str, _Stage] = {}
         self._events: deque = deque(maxlen=max(1, ring))
+        self._tls = threading.local()
+        # paired clocks: spans time with the monotonic perf counter, the
+        # export anchors them to the wall clock so independently-recorded
+        # processes share one timeline when their exports merge
         self._origin = time.perf_counter()
+        self._origin_wall = time.time()
 
     # -- control -------------------------------------------------------------
     def enable(self) -> "Tracer":
@@ -132,8 +186,28 @@ class Tracer:
             return _NULL_SPAN
         return _Span(self, name)
 
-    def _record(self, name: str, t0: float, dur: float) -> None:
+    def context(self, trace_id: Optional[str]):
+        """Tag every span completed in this ``with`` block (on THIS
+        thread) with ``trace_id`` — the request-scoped tracing seam.
+        One attribute check + shared no-op when disabled or untagged."""
+        if not self.enabled or not trace_id:
+            return _NULL_SPAN
+        return _TraceCtx(self._tls, trace_id)
+
+    def add_span(self, name: str, dur_s: float,
+                 trace: Optional[str] = None) -> None:
+        """Record an already-measured span ending ~now (the router's
+        forward loop measures across retries and can't wrap a single
+        ``with``). No-op when disabled."""
+        if not self.enabled:
+            return
+        self._record(name, time.perf_counter() - dur_s, dur_s, trace=trace)
+
+    def _record(self, name: str, t0: float, dur: float,
+                trace: Optional[str] = "\0tls") -> None:
         tid = threading.get_ident()
+        if trace == "\0tls":             # default: the thread's context tag
+            trace = getattr(self._tls, "trace", None)
         with self._lock:
             st = self._stages.get(name)
             if st is None:
@@ -141,7 +215,7 @@ class Tracer:
             st.count += 1
             st.total_s += dur
             st.durs.append(dur)
-            self._events.append((name, t0, dur, tid))
+            self._events.append((name, t0, dur, tid, trace))
 
     # -- reading -------------------------------------------------------------
     def rollup(self) -> Dict[str, dict]:
@@ -162,24 +236,36 @@ class Tracer:
             }
         return out
 
-    def export_chrome(self, path: str) -> str:
-        """Write the span ring as Chrome-trace JSON (``ph: "X"`` complete
-        events, microsecond timestamps) — open in chrome://tracing or
-        Perfetto. Returns ``path``."""
+    def chrome_dict(self) -> dict:
+        """The span ring as a Chrome-trace dict (``ph: "X"`` complete
+        events). Timestamps are wall-clock epoch MICROSECONDS (the
+        monotonic span clock re-anchored through the paired origins), so
+        exports from different processes merge onto one timeline — the
+        fleet router concatenates replicas' ``traceEvents`` under their
+        own pids to render one request as one cross-process flame."""
         with self._lock:
             events = list(self._events)
         pid = os.getpid()
-        trace = {
-            "displayTimeUnit": "ms",
-            "traceEvents": [
-                {"name": name, "ph": "X", "cat": "hivemall_tpu",
-                 "ts": round((t0 - self._origin) * 1e6, 3),
-                 "dur": round(dur * 1e6, 3), "pid": pid, "tid": tid}
-                for name, t0, dur, tid in events
-            ],
-        }
+        wall0 = self._origin_wall - self._origin
+        out = []
+        for name, t0, dur, tid, trace in events:
+            ev = {"name": name, "ph": "X", "cat": "hivemall_tpu",
+                  "ts": round((wall0 + t0) * 1e6, 3),
+                  "dur": round(dur * 1e6, 3), "pid": pid, "tid": tid}
+            if trace is not None:
+                ev["args"] = {"trace": trace}
+            out.append(ev)
+        # metadata last: consumers indexing traceEvents[0] still see the
+        # first real span; viewers read ph:"M" anywhere in the list
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "tid": 0, "args": {"name": self.process_label}})
+        return {"displayTimeUnit": "ms", "traceEvents": out}
+
+    def export_chrome(self, path: str) -> str:
+        """Write :meth:`chrome_dict` as JSON — open in chrome://tracing
+        or Perfetto. Returns ``path``."""
         with open(path, "w") as f:
-            json.dump(trace, f)
+            json.dump(self.chrome_dict(), f)
         return path
 
     def maybe_export(self) -> Optional[str]:

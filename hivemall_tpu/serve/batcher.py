@@ -33,6 +33,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..io.sparse import pow2_len
+from ..obs.histo import BATCH_SIZE_BUCKETS, LATENCY_BUCKETS_S, Histogram
 from ..obs.trace import get_tracer
 from ..utils.metrics import Meter
 
@@ -56,6 +57,7 @@ class _Req:
     fut: Future
     t_enq: float
     t_deadline: Optional[float]
+    trace_id: Optional[str] = None
 
 
 class MicroBatcher:
@@ -87,6 +89,16 @@ class MicroBatcher:
         self.expired = 0
         self.errors = 0
         self.batch_hist: Dict[int, int] = {}   # pow2 rows-bucket -> count
+        # real Prometheus histograms (docs/OBSERVABILITY.md "Serving
+        # traces and SLOs"): cumulative, so external scrapers can window
+        # them and the SLO engine can diff two snapshots
+        self.latency_hist = Histogram(LATENCY_BUCKETS_S)   # enqueue->scored
+        self.batch_size_hist = Histogram(BATCH_SIZE_BUCKETS)
+        # cumulative prediction-score moments (fleet-summable; the SLO
+        # engine's score-drift changefinder reads mean/std off these)
+        self.score_sum = 0.0
+        self.score_sumsq = 0.0
+        self.score_n = 0
         self._req_meter = Meter()
         self._row_meter = Meter()
         self._thread = threading.Thread(target=self._run,
@@ -94,13 +106,17 @@ class MicroBatcher:
         self._thread.start()
 
     # -- submit side ---------------------------------------------------------
-    def submit(self, rows: list, deadline_ms: Optional[float] = None
-               ) -> Future:
+    def submit(self, rows: list, deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
         """Enqueue one request (a list of parsed rows). Returns a Future
         resolving to float32 scores [len(rows)] — or, when the predict
         fn returns ``(scores, meta)``, to ``(scores_slice, meta)``.
-        Raises ServeOverload synchronously when the bounded queue is
-        full."""
+        After completion the future carries a ``hop`` attribute with the
+        request's queue/assemble/predict second decomposition (the HTTP
+        front end turns it into the per-hop breakdown headers).
+        ``trace_id`` tags the dispatch-side spans of the batch this
+        request lands in (request-scoped tracing). Raises ServeOverload
+        synchronously when the bounded queue is full."""
         fut: Future = Future()
         n = len(rows)
         if n == 0:
@@ -121,7 +137,8 @@ class MicroBatcher:
                     raise ServeOverload(
                         f"queue full ({self._queued_rows} rows queued, "
                         f"max {self.max_queue_rows}); request shed")
-                self._q.append(_Req(rows, n, fut, now, t_deadline))
+                self._q.append(_Req(rows, n, fut, now, t_deadline,
+                                    trace_id))
                 self._queued_rows += n
                 self.requests += 1
                 self.rows_in += n
@@ -172,6 +189,12 @@ class MicroBatcher:
             for r in batch:
                 if r.t_deadline is not None and now > r.t_deadline:
                     self.expired += 1
+                    # the request's time-in-queue at expiry enters the
+                    # latency histogram (a lower bound of its would-be
+                    # latency) — otherwise the SLO latency window reads
+                    # healthy during a timeout collapse, exactly when
+                    # the worst latencies are happening
+                    self.latency_hist.observe(now - r.t_enq)
                     r.fut.set_exception(ServeDeadline(
                         f"deadline expired after "
                         f"{(now - r.t_enq) * 1000:.1f}ms in queue"))
@@ -180,19 +203,34 @@ class MicroBatcher:
             if not live:
                 continue
             rows = [row for r in live for row in r.rows]
-            with self._tracer.span("serve.batch"):
-                try:
-                    out = self._predict(rows)
-                except Exception as e:   # noqa: BLE001 — score-time
-                    # failure: isolate per request so one bad client's
-                    # rows cannot 500 the innocent requests coalesced
-                    # into the same batch; the dispatch loop survives
-                    if len(live) == 1:
-                        self.errors += 1
-                        live[0].fut.set_exception(e)
-                    else:
-                        self._score_individually(live)
-                    continue
+            # request-scoped tracing: the batch's dispatch-side spans
+            # (serve.batch + the engine's serve.predict inside the
+            # predict fn) carry every traced request's id — _NULL_SPAN
+            # when the tracer is off or nothing in the batch is traced
+            tids = [r.trace_id for r in live if r.trace_id]
+            ctx = self._tracer.context(",".join(tids) if tids else None)
+            # `now` was taken right after the batch was popped — queue
+            # time ends THERE; everything from the pop to the predict
+            # call (expiry filter, row flatten, trace setup) is batch
+            # assembly and must not masquerade as queue wait
+            t_deq = now
+            with ctx:
+                with self._tracer.span("serve.batch"):
+                    t_p0 = time.monotonic()
+                    try:
+                        out = self._predict(rows)
+                    except Exception as e:   # noqa: BLE001 — score-time
+                        # failure: isolate per request so one bad
+                        # client's rows cannot 500 the innocent requests
+                        # coalesced into the same batch; the dispatch
+                        # loop survives
+                        if len(live) == 1:
+                            self.errors += 1
+                            live[0].fut.set_exception(e)
+                        else:
+                            self._score_individually(live, t_deq)
+                        continue
+                    t_p1 = time.monotonic()
             # a predict fn may return (scores, meta) — meta (e.g. the
             # model step that scored this batch) rides along to every
             # request future in the batch
@@ -205,22 +243,55 @@ class MicroBatcher:
             self.coalesced_sum += len(live)
             b = pow2_len(len(rows))
             self.batch_hist[b] = self.batch_hist.get(b, 0) + 1
+            self.batch_size_hist.observe(len(rows))
             self._row_meter.add(len(rows))
+            sc = np.asarray(scores[:len(rows)], np.float64)
+            self.score_sum += float(sc.sum())
+            self.score_sumsq += float((sc * sc).sum())
+            self.score_n += len(rows)
+            # per-hop decomposition, shared by the batch: assembly =
+            # expiry filter + row flatten, predict = the scorer call
+            assemble_s = t_p0 - t_deq
+            predict_s = t_p1 - t_p0
+            t_done = time.monotonic()
             off = 0
             for r in live:
                 part = np.asarray(scores[off:off + r.n], np.float32)
+                self.latency_hist.observe(t_done - r.t_enq)
+                r.fut.hop = {"queue_s": t_deq - r.t_enq,
+                             "assemble_s": assemble_s,
+                             "predict_s": predict_s}
                 r.fut.set_result(part if meta is None else (part, meta))
                 off += r.n
 
-    def _score_individually(self, reqs: List[_Req]) -> None:
+    def _score_individually(self, reqs: List[_Req],
+                            t_deq: Optional[float] = None) -> None:
         """Fallback after a coalesced batch raised: re-score each request
-        alone, failing only the one(s) whose rows actually raise."""
+        alone, failing only the one(s) whose rows actually raise.
+        ``t_deq`` is when the shared batch was dequeued — queue time ends
+        there; the failed shared predict and earlier siblings' rescores
+        land in the handler's ``other`` residual, not in ``queue``."""
         for r in reqs:
             try:
-                out = self._predict(r.rows)
+                t_p0 = time.monotonic()
+                with self._tracer.context(r.trace_id):
+                    out = self._predict(r.rows)
+                t_p1 = time.monotonic()
                 scores, meta = (out if isinstance(out, tuple)
                                 else (out, None))
                 part = np.asarray(scores[:r.n], np.float32)
+                self.latency_hist.observe(t_p1 - r.t_enq)
+                # the fallback's requests must stay visible to the
+                # score-drift detector — a model shift coinciding with
+                # batch failures would otherwise be diluted
+                sc = np.asarray(part, np.float64)
+                self.score_sum += float(sc.sum())
+                self.score_sumsq += float((sc * sc).sum())
+                self.score_n += r.n
+                r.fut.hop = {"queue_s": (t_deq if t_deq is not None
+                                         else t_p0) - r.t_enq,
+                             "assemble_s": 0.0,
+                             "predict_s": t_p1 - t_p0}
                 r.fut.set_result(part if meta is None else (part, meta))
             except Exception as e:     # noqa: BLE001 — per-request fate
                 self.errors += 1
@@ -246,6 +317,32 @@ class MicroBatcher:
             "shed": self.shed,
             "expired": self.expired,
             "errors": self.errors,
+            # real Prometheus histogram families on /metrics
+            # (hivemall_tpu_serve_request_latency_seconds_bucket, ...)
+            "request_latency_seconds": self.latency_hist.snapshot(),
+            "batch_size_rows": self.batch_size_hist.snapshot(),
+            "score_mean": round(self.score_sum / self.score_n, 6)
+            if self.score_n else None,
+            "score_std": round(max(
+                0.0, self.score_sumsq / self.score_n
+                - (self.score_sum / self.score_n) ** 2) ** 0.5, 6)
+            if self.score_n else None,
+        }
+
+    def slo_totals(self) -> dict:
+        """Cumulative totals for the SLO engine (obs.slo): counters, the
+        latency histogram snapshot, and raw score moments — all
+        monotonic and summable across a fleet's replicas (the manager
+        aggregates each replica's copy off ``/healthz``)."""
+        return {
+            "requests": self.requests,
+            "errors": self.errors,
+            "shed": self.shed,
+            "expired": self.expired,
+            "latency": self.latency_hist.snapshot(),
+            "score_sum": round(self.score_sum, 6),
+            "score_sumsq": round(self.score_sumsq, 6),
+            "score_n": self.score_n,
         }
 
     def close(self, drain: bool = False, timeout: float = 5.0) -> None:

@@ -102,7 +102,8 @@ class ReplicaManager:
                  pin_cpus: bool = False,
                  spawn_timeout: float = 180.0,
                  health_interval: float = 0.5,
-                 watch_interval: float = 2.0):
+                 watch_interval: float = 2.0,
+                 slo=None):
         if not checkpoint_dir and not bundle:
             raise ValueError("fleet needs checkpoint_dir=... or bundle=...")
         self.algo = algo
@@ -141,6 +142,12 @@ class ReplicaManager:
         self.rejected_bundles = 0
         self.fleet_step: Optional[int] = None
         self.last_error: Optional[str] = None
+        # fleet SLO engine (obs.slo): every health tick sums the
+        # replicas' cumulative /healthz `slo` totals (latency histogram,
+        # request/error/shed counters, score moments) into one
+        # fleet-wide sample — the manager IS the sampler
+        self.slo = slo
+        self._slo_seen: Dict[str, int] = {}   # rid -> last requests seen
         self._register_obs()
 
     # -- spawning ------------------------------------------------------------
@@ -279,6 +286,54 @@ class ReplicaManager:
                 r.model_step = h.get("model_step", r.model_step)
                 if self.router is not None:
                     self.router.set_ready(r.rid, r.ready)
+            if self.slo is not None:
+                try:
+                    self.slo.sample(self._slo_totals())
+                except Exception as e:     # noqa: BLE001 — obs must never
+                    self.last_error = f"slo: {type(e).__name__}: {e}"
+
+    def _slo_totals(self) -> dict:
+        """Sum every live replica's cumulative /healthz ``slo`` section
+        into one fleet-wide totals dict (histogram buckets add bucket-
+        wise: all replicas share the default bounds). A replica respawn
+        resets its share; the engine clamps window diffs at zero, and
+        the tick is flagged ``reset`` so the drift detector skips it —
+        a PARTIAL reset masked by the other replicas' growth would
+        otherwise feed the changefinder a garbage interval mean exactly
+        during crash recovery."""
+        agg: dict = {"requests": 0, "errors": 0, "shed": 0, "expired": 0,
+                     "score_sum": 0.0, "score_sumsq": 0.0, "score_n": 0}
+        buckets = None
+        lat_sum, lat_count = 0.0, 0
+        seen = {}
+        for r in self.replicas():
+            t = (r.last_health or {}).get("slo")
+            if not isinstance(t, dict):
+                continue
+            for k in ("requests", "errors", "shed", "expired", "score_n"):
+                agg[k] += int(t.get(k) or 0)
+            for k in ("score_sum", "score_sumsq"):
+                agg[k] += float(t.get(k) or 0.0)
+            lat = t.get("latency") or {}
+            lat_sum += float(lat.get("sum") or 0.0)
+            lat_count += int(lat.get("count") or 0)
+            bs = lat.get("buckets") or []
+            if buckets is None:
+                buckets = [[b, int(c)] for b, c in bs]
+            elif len(bs) == len(buckets):
+                for i, (_, c) in enumerate(bs):
+                    buckets[i][1] += int(c)
+            seen[r.rid] = int(t.get("requests") or 0)
+        agg["latency"] = {"buckets": buckets or [], "sum": lat_sum,
+                          "count": lat_count}
+        # reset detection: a rid vanished (respawned under a new rid) or
+        # went backwards since the last tick — this interval's deltas
+        # mix pre- and post-reset history
+        prev = self._slo_seen
+        agg["reset"] = any(rid not in seen or seen[rid] < n
+                           for rid, n in prev.items())
+        self._slo_seen = seen
+        return agg
 
     def _replace(self, slot: int, dead: _Replica) -> None:
         """Retire a crashed replica and respawn its slot on a DEDICATED
@@ -472,16 +527,28 @@ class Fleet:
                  pin_cpus: bool = False,
                  health_interval: float = 0.5,
                  watch_interval: float = 2.0,
-                 spawn_timeout: float = 180.0):
+                 spawn_timeout: float = 180.0,
+                 slo_p99_ms: float = 100.0,
+                 slo_availability: float = 0.999,
+                 trace_sample: float = 0.01):
+        from ..obs.slo import SloEngine
+        from ..obs.trace import get_tracer
+        get_tracer().process_label = "router"   # the merged /trace view
+        # ONE fleet-wide SLO engine: the manager samples it from health
+        # polls, the router serves it at /slo
+        self.slo = SloEngine(p99_ms=slo_p99_ms,
+                             availability=slo_availability)
         self.router = RouterServer(host=host, port=port, policy=policy,
-                                   on_reload_cb=self._on_reload)
+                                   on_reload_cb=self._on_reload,
+                                   trace_sample=trace_sample,
+                                   slo=self.slo)
         self.manager = ReplicaManager(
             algo, options, checkpoint_dir=checkpoint_dir, bundle=bundle,
             replicas=replicas, router=self.router, env=env,
             per_replica_env=per_replica_env, serve_kwargs=serve_kwargs,
             pin_cpus=pin_cpus,
             health_interval=health_interval, watch_interval=watch_interval,
-            spawn_timeout=spawn_timeout)
+            spawn_timeout=spawn_timeout, slo=self.slo)
         self.host = host
         self.port = self.router.port
 
@@ -550,6 +617,7 @@ def _worker(spec_json: str) -> int:
         import jax
         jax.config.update("jax_platforms", want)
 
+    from ..obs.trace import get_tracer
     from .engine import PredictEngine
     from .http import PredictServer
 
@@ -580,7 +648,14 @@ def _worker(spec_json: str) -> int:
         deadline_ms=opt("deadline_ms", 0.0, float),
         # the MANAGER owns reload sequencing fleet-wide; a replica
         # polling on its own would race the roll and skew steps
-        watch=bool(spec.get("self_watch") or False)).start()
+        watch=bool(spec.get("self_watch") or False),
+        # likewise the manager owns the fleet SLO engine (it sums the
+        # replicas' cumulative /healthz totals); a per-replica sampler
+        # would just burn a thread per process
+        slo=False).start()
+    # label this process's span export so the router-merged /trace
+    # reads replica:<port> instead of a bare pid
+    get_tracer().process_label = f"replica:{srv.port}"
 
     stop = threading.Event()
 

@@ -14,7 +14,13 @@ replica PROCESSES:
    respawns back to full strength;
 4. a newer checkpoint written mid-traffic ROLLS across the fleet (the
    manager verifies once, rolls one replica at a time) with zero dropped
-   requests, converging every replica to the new step.
+   requests, converging every replica to the new step;
+5. request tracing propagates END TO END: a request carrying an
+   ``x-hivemall-trace`` id gets it echoed on the response, its per-hop
+   latency breakdown (router relay + replica parse/queue/assemble/
+   predict/other) sums to the router-measured wall, and the id appears
+   in spans exported from BOTH the router and the scoring replica —
+   merged into one Chrome-trace file by the router's ``/trace``.
 """
 
 from __future__ import annotations
@@ -78,10 +84,16 @@ def _run(args, tmp: str) -> int:
     ref = trainer.predict_proba(
         SparseDataset.from_rows(parsed, [1.0] * len(parsed)))
 
+    # request tracing on for the propagation phase: the router process's
+    # tracer records its forward spans; the worker env turns each
+    # replica's tracer on so serve.* spans land in their /trace exports
+    from ..obs.trace import get_tracer
+    get_tracer().enable()
     fleet = Fleet(
         "train_classifier", opts, checkpoint_dir=tmp,
         replicas=args.replicas,
         watch_interval=0.3, health_interval=0.2,
+        env={"HIVEMALL_TPU_TRACE": "1"},
         serve_kwargs={"max_batch": 64, "max_delay_ms": 3.0,
                       "max_queue_rows": 4096,
                       "warmup_len": max(len(r) for r in rows)})
@@ -164,7 +176,59 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
         f"http://{host}:{port}/metrics", timeout=10).read().decode()
     check("obs_metrics",
           "hivemall_tpu_fleet_aggregate_requests" in prom
-          and "hivemall_tpu_fleet_router_ready_replicas" in prom)
+          and "hivemall_tpu_fleet_router_ready_replicas" in prom
+          and "request_latency_seconds_bucket" in prom)
+    # the fleet SLO engine: the manager has been sampling replicas'
+    # /healthz totals since start; burn-rate windows must report the
+    # traffic phase 1 pushed through
+    time.sleep(0.5)                    # >= one health/sample tick
+    slo = json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/slo", timeout=10).read())
+    w5 = (slo.get("windows") or {}).get("5m") or {}
+    check("slo_surface", slo.get("configured") is True
+          and w5.get("requests", 0) >= len(rows)
+          and "availability_burn_rate" in w5 and "p99_ms" in w5,
+          f"(5m window {w5})")
+
+    # -- 2b. end-to-end request tracing + per-hop breakdown ----------------
+    tid = "smoke-trace-1"
+    t0 = time.time()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/predict",
+        json.dumps({"rows": [rows[0]]}).encode(),
+        {"Content-Type": "application/json", "x-hivemall-trace": tid})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        resp.read()
+        wall_ms = (time.time() - t0) * 1000.0
+        echo = resp.headers.get("x-hivemall-trace")
+        hop = resp.headers.get("x-hivemall-hop") or ""
+        rhop = resp.headers.get("x-hivemall-hop-router") or ""
+    check("trace_echo", echo == tid, f"(got {echo!r})")
+    try:
+        parts = dict(kv.split("=") for kv in hop.split(","))
+        rparts = dict(kv.split("=") for kv in rhop.split(","))
+        total = float(rparts["total"])
+        hop_sum = (sum(float(v) for k, v in parts.items() if k != "total")
+                   + float(rparts["relay"]))
+    except (KeyError, ValueError):
+        parts, total, hop_sum = {}, 0.0, -1.0
+    # parts close the router-measured wall by construction (the replica's
+    # `other` + the router's `relay` are residuals); the client adds only
+    # loopback + urllib overhead on top
+    check("hop_breakdown",
+          abs(hop_sum - total) <= 0.05 * total + 0.25 and total > 0
+          and total <= wall_ms + 1.0,
+          f"(hops {hop} | router {rhop} | client wall {wall_ms:.1f}ms)")
+    trace = json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/trace", timeout=10).read())
+    tagged = [e for e in trace.get("traceEvents", [])
+              if tid in str((e.get("args") or {}).get("trace"))]
+    pids = {e["pid"] for e in tagged}
+    names = {e["name"] for e in tagged}
+    check("trace_merged", len(pids) >= 2
+          and "router.forward" in names and "serve.predict" in names,
+          f"({len(tagged)} spans, pids {sorted(pids)}, "
+          f"names {sorted(names)})")
 
     # -- live traffic for phases 3 + 4 ------------------------------------
     stop = threading.Event()

@@ -17,9 +17,21 @@ exactly what makes concurrent requests coalesce):
   counters.
 - ``POST /reload`` — force a hot-reload check (body optionally
   ``{"path": "...npz"}`` to load an explicit bundle).
-- ``GET /snapshot`` / ``GET /metrics`` — the central obs registry (the
-  ``serve`` section rides next to pipeline/train/mix/checkpoint/spans),
+- ``GET /slo`` — the SLO engine's windowed burn rates + drift state
+  (docs/OBSERVABILITY.md "Serving traces and SLOs").
+- ``GET /snapshot`` / ``GET /metrics`` / ``GET /trace`` — the central
+  obs registry (the ``serve`` section rides next to
+  pipeline/train/mix/checkpoint/spans) and the process span ring,
   inherited from the obs HTTP handler.
+
+Request tracing + per-hop breakdown: a request carrying an
+``x-hivemall-trace`` header (client-supplied, or minted by the fleet
+router's sampler) has its id tagged onto the ``serve.enqueue`` /
+``serve.batch`` / ``serve.predict`` spans and echoed on the response.
+EVERY ``/predict`` response additionally carries ``x-hivemall-hop`` —
+``parse=,queue=,assemble=,predict=,other=,total=`` milliseconds whose
+parts sum to the replica's measured wall for that request — which the
+router extends with its own relay hop.
 """
 
 from __future__ import annotations
@@ -28,9 +40,12 @@ import http.client
 import http.server
 import json
 import threading
+import time
 from typing import Optional
 
 from ..obs.http import _Handler as _ObsHandler
+from ..obs.slo import SloEngine
+from ..obs.trace import get_tracer
 from .batcher import MicroBatcher, ServeDeadline, ServeOverload
 
 __all__ = ["PredictServer", "KeepAliveClient"]
@@ -49,6 +64,7 @@ class KeepAliveClient:
 
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.host, self.port, self.timeout = host, int(port), timeout
+        self.last_headers: dict = {}
         self._conn: Optional[http.client.HTTPConnection] = None
 
     def _connect(self) -> http.client.HTTPConnection:
@@ -69,17 +85,22 @@ class KeepAliveClient:
             self._conn.close()
             self._conn = None
 
-    def request(self, method: str, path: str, body: Optional[bytes] = None):
+    def request(self, method: str, path: str, body: Optional[bytes] = None,
+                headers: Optional[dict] = None):
         """Returns (status, payload bytes). Retries once on a dead kept-
-        alive connection; a server actively refusing still raises."""
+        alive connection; a server actively refusing still raises. The
+        last response's headers stay readable on ``self.last_headers``
+        (the trace/hop breakdown assertions in the smokes read them)."""
         for attempt in (0, 1):
             conn = self._connect()
             try:
-                conn.request(method, path, body,
-                             {"Content-Type": "application/json"}
-                             if body is not None else {})
+                hdrs = dict(headers or {})
+                if body is not None:
+                    hdrs.setdefault("Content-Type", "application/json")
+                conn.request(method, path, body, hdrs)
                 resp = conn.getresponse()
                 payload = resp.read()
+                self.last_headers = dict(resp.headers)
                 if resp.will_close:
                     self.close()
                 return resp.status, payload
@@ -89,10 +110,12 @@ class KeepAliveClient:
                     raise
         raise AssertionError("unreachable")
 
-    def post_json(self, path: str, obj: dict):
+    def post_json(self, path: str, obj: dict,
+                  headers: Optional[dict] = None):
         """Returns (status, parsed json)."""
         code, payload = self.request("POST", path,
-                                     json.dumps(obj).encode())
+                                     json.dumps(obj).encode(),
+                                     headers=headers)
         return code, json.loads(payload)
 
 
@@ -121,7 +144,8 @@ class _ServeHandler(_ObsHandler):
     # -- helpers -------------------------------------------------------------
     _body_read = False                   # per-request; reset in do_*
 
-    def _json(self, code: int, obj: dict) -> None:
+    def _json(self, code: int, obj: dict,
+              extra_headers: Optional[dict] = None) -> None:
         body = json.dumps(obj, default=str).encode()
         if code >= 400 and not self._body_read:
             # an error sent BEFORE the request body was consumed (e.g.
@@ -135,6 +159,8 @@ class _ServeHandler(_ObsHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         if self.close_connection:
             self.send_header("Connection", "close")
         self.end_headers()
@@ -158,6 +184,7 @@ class _ServeHandler(_ObsHandler):
     def do_GET(self):  # noqa: N802 — http.server API
         self._body_read = True           # GETs carry no body to drain
         path = self.path.split("?", 1)[0]
+        s = self.server_ref
         if path == "/healthz":
             # READINESS, not bare liveness: 200 only once warmup completed
             # (503 while warming), so the fleet router — and any external
@@ -165,7 +192,6 @@ class _ServeHandler(_ObsHandler):
             # rotation instead of routing requests into XLA compiles. The
             # body carries the cheap serving counters the replica manager
             # folds into its cached fleet obs section.
-            s = self.server_ref
             e = s.engine
             b = s.batcher
             ready = e.ready
@@ -183,9 +209,20 @@ class _ServeHandler(_ObsHandler):
                 "errors": b.errors,
                 "reloads": e.reloads,
                 "reload_failures": e.reload_failures,
+                # cumulative SLO totals (latency histogram + score
+                # moments): the fleet manager sums these across replicas
+                # into its SLO engine every health tick
+                "slo": b.slo_totals(),
             })
             return
-        super().do_GET()               # /snapshot, /metrics, 404
+        if path == "/slo":
+            slo = s.slo
+            if slo is None:
+                self._json(404, {"error": "no SLO engine configured"})
+                return
+            self._json(200, slo.evaluate())
+            return
+        super().do_GET()               # /snapshot, /metrics, /trace, 404
 
     def do_POST(self):  # noqa: N802 — http.server API
         self._body_read = False          # fresh request on this connection
@@ -208,8 +245,13 @@ class _ServeHandler(_ObsHandler):
             return
         if path != "/predict":
             self.send_error(404, "unknown path (try /predict, /healthz, "
-                                 "/reload, /snapshot or /metrics)")
+                                 "/reload, /slo, /snapshot or /metrics)")
             return
+        t_req0 = time.monotonic()
+        # request-scoped tracing: honor a client/router-supplied id —
+        # the spans this request touches get tagged with it and the
+        # response echoes it (docs/OBSERVABILITY.md)
+        tid = self.headers.get("x-hivemall-trace")
         try:
             body = self._read_body()
             rows = body.get("rows")
@@ -233,8 +275,11 @@ class _ServeHandler(_ObsHandler):
                 json.JSONDecodeError) as e:
             self._json(400, {"error": str(e)})
             return
+        t_parsed = time.monotonic()
         try:
-            fut = s.batcher.submit(parsed, deadline_ms=deadline_ms)
+            with s.tracer.context(tid):   # tags serve.enqueue
+                fut = s.batcher.submit(parsed, deadline_ms=deadline_ms,
+                                       trace_id=tid)
             res = fut.result(timeout=s.request_timeout)
         except ServeOverload as e:
             self._json(503, {"error": str(e), "shed": True})
@@ -250,9 +295,27 @@ class _ServeHandler(_ObsHandler):
             scores, step = res
         else:                          # zero-row request short-circuit
             scores, step = res, s.engine.model_step
+        # per-hop latency breakdown: parts sum to the replica's measured
+        # wall for THIS request ("other" closes the residual — result
+        # pickup + response build). The router stacks its relay hop on
+        # top; bench_serve and the fleet smoke consume these.
+        hop = getattr(fut, "hop", None) or {}
+        total_ms = (time.monotonic() - t_req0) * 1000.0
+        parse_ms = (t_parsed - t_req0) * 1000.0
+        queue_ms = hop.get("queue_s", 0.0) * 1000.0
+        assemble_ms = hop.get("assemble_s", 0.0) * 1000.0
+        predict_ms = hop.get("predict_s", 0.0) * 1000.0
+        other_ms = max(0.0, total_ms - parse_ms - queue_ms
+                       - assemble_ms - predict_ms)
+        extra = {"x-hivemall-hop":
+                 f"parse={parse_ms:.3f},queue={queue_ms:.3f},"
+                 f"assemble={assemble_ms:.3f},predict={predict_ms:.3f},"
+                 f"other={other_ms:.3f},total={total_ms:.3f}"}
+        if tid:
+            extra["x-hivemall-trace"] = tid
         self._json(200, {"scores": [float(v) for v in scores],
                          "model_step": int(step),
-                         "n": len(scores)})
+                         "n": len(scores)}, extra_headers=extra)
 
 
 class _ThreadedHTTPServer(http.server.ThreadingHTTPServer):
@@ -276,10 +339,14 @@ class PredictServer:
                  max_queue_rows: Optional[int] = None,
                  deadline_ms: float = 0.0,
                  request_timeout: float = 60.0,
-                 watch: bool = True):
+                 watch: bool = True,
+                 slo: "bool | SloEngine" = True,
+                 slo_p99_ms: float = 100.0,
+                 slo_availability: float = 0.999):
         self.engine = engine
         self.request_timeout = float(request_timeout)
         self._watch = bool(watch)
+        self.tracer = get_tracer()
         # the versioned predict fn: each response carries the step of the
         # model version that actually scored it (correct across hot swaps)
         self.batcher = MicroBatcher(
@@ -289,6 +356,19 @@ class PredictServer:
             max_queue_rows=max_queue_rows,
             deadline_ms=deadline_ms)
         engine.attach_batcher(self.batcher)
+        # SLO engine over this server's own batcher totals (the fleet
+        # topology passes slo=False here and samples fleet-wide at the
+        # manager instead — one engine per surface, never two)
+        if isinstance(slo, SloEngine):
+            self.slo: Optional[SloEngine] = slo
+            self._own_slo = False
+        elif slo:
+            self.slo = SloEngine(p99_ms=slo_p99_ms,
+                                 availability=slo_availability)
+            self._own_slo = True
+        else:
+            self.slo = None
+            self._own_slo = False
         handler = type("_BoundServeHandler", (_ServeHandler,),
                        {"server_ref": self})
         self._httpd = _ThreadedHTTPServer((host, port), handler)
@@ -299,6 +379,8 @@ class PredictServer:
     def start(self) -> "PredictServer":
         if self._watch:
             self.engine.start_watch()
+        if self._own_slo and self.slo is not None:
+            self.slo.start(self.batcher.slo_totals)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name=f"serve-http:{self.port}", daemon=True)
@@ -315,5 +397,7 @@ class PredictServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._own_slo and self.slo is not None:
+            self.slo.stop()
         self.batcher.close(drain=drain, timeout=30.0 if drain else 5.0)
         self.engine.close()

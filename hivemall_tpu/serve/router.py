@@ -27,6 +27,19 @@ and one router fronts many replicas:
 - **obs aggregation**: ``/snapshot`` merges every replica's ``serve``
   section plus the router's own counters into one ``fleet`` view;
   ``/metrics`` flattens the same through the shared Prometheus encoder.
+- **request tracing** (docs/OBSERVABILITY.md "Serving traces and
+  SLOs"): when the process tracer is enabled the router samples
+  ``trace_sample`` of requests (and honors every client-supplied
+  ``x-hivemall-trace``), minting an id it forwards to the replica and
+  tagging its own ``router.forward`` span with; ``GET /trace`` merges
+  the router's span ring with every replica's into ONE Chrome-trace
+  JSON (distinct pids) so a traced request renders as a single
+  cross-process flame. Every relayed ``/predict`` response also gains
+  ``x-hivemall-hop-router: relay=,total=`` on top of the replica's
+  ``x-hivemall-hop`` breakdown — relay is the router+network share of
+  the end-to-end wall.
+- ``GET /slo``: the fleet SLO engine's burn rates (wired by ``Fleet``;
+  the replica manager feeds it from its health polls).
 
 Connections to replicas are pooled and kept alive (HTTP/1.1 both sides);
 a connection that errors is dropped, never reused.
@@ -37,6 +50,7 @@ from __future__ import annotations
 import hashlib
 import http.client
 import json
+import random
 import socket
 import threading
 import time
@@ -45,6 +59,7 @@ from typing import Dict, List, Optional
 
 from ..obs.http import to_prometheus
 from ..obs.registry import registry
+from ..obs.trace import get_tracer, mint_trace_id
 
 __all__ = ["RouterServer", "ReplicaHandle"]
 
@@ -235,6 +250,7 @@ class _RouterHTTP:
                     return
                 clen = 0
                 want_close = False
+                trace_id = None
                 while True:
                     h = rf.readline(65537)
                     if not h:
@@ -247,6 +263,13 @@ class _RouterHTTP:
                     elif low.startswith(b"connection:") \
                             and b"close" in low:
                         want_close = True
+                    elif low.startswith(b"x-hivemall-trace:"):
+                        # latin-1 both ways (decode here, re-encode at
+                        # the forward): round-trips ANY header bytes —
+                        # an ascii decode would drop the request on a
+                        # client's utf-8 trace id
+                        trace_id = h.split(b":", 1)[1].strip().decode(
+                            "latin-1")
                 if clen > (64 << 20):
                     sock.sendall(_response(
                         400, b'{"error": "body > 64MB cap"}',
@@ -255,7 +278,8 @@ class _RouterHTTP:
                 body = rf.read(clen) if clen else b""
                 if clen and len(body) != clen:
                     return
-                out = self._dispatch(method, path.split(b"?", 1)[0], body)
+                out = self._dispatch(method, path.split(b"?", 1)[0], body,
+                                     trace_id)
                 sock.sendall(out)
                 if want_close or b"\r\nConnection: close" in out[:512] \
                         or b"\r\nconnection: close" in out[:512].lower():
@@ -268,17 +292,31 @@ class _RouterHTTP:
             except OSError:
                 pass
 
-    def _dispatch(self, method: bytes, path: bytes, body: bytes) -> bytes:
+    def _dispatch(self, method: bytes, path: bytes, body: bytes,
+                  trace_id: Optional[str] = None) -> bytes:
         r = self._router
         if method == b"POST" and path == b"/predict":
-            code, raw, fallback = r.route_predict(body)
+            code, raw, fallback = r.route_predict(body, trace_id)
             if raw is not None:
                 # verbatim relay: replica status line + headers + body
+                # (plus the router's own injected hop/trace headers)
                 return raw
             return _response(code,
                              json.dumps(fallback, default=str).encode(),
                              "application/json", code >= 500)
         try:
+            if path == b"/slo":
+                slo = r.slo
+                if slo is None:
+                    return _response(
+                        404, b'{"error": "no SLO engine configured"}',
+                        "application/json", False)
+                return _response(200, json.dumps(slo.evaluate()).encode(),
+                                 "application/json", False)
+            if path == b"/trace":
+                return _response(200,
+                                 json.dumps(r.merged_trace()).encode(),
+                                 "application/json", False)
             if path == b"/healthz":
                 h = r.fleet_health()
                 return _response(200 if h["ready_replicas"] > 0 else 503,
@@ -317,19 +355,28 @@ class RouterServer:
     def __init__(self, *, host: str = "127.0.0.1", port: int = 0,
                  policy: str = "least_loaded",
                  forward_timeout: float = 60.0,
-                 on_reload_cb=None):
+                 on_reload_cb=None,
+                 trace_sample: float = 0.01,
+                 slo=None):
         if policy not in ("least_loaded", "hash"):
             raise ValueError(f"unknown router policy {policy!r} "
                              f"(least_loaded or hash)")
         self.policy = policy
         self.forward_timeout = float(forward_timeout)
         self._on_reload_cb = on_reload_cb
+        # request tracing: fraction of requests the router mints a trace
+        # id for — ONLY when the process tracer is enabled (untraced
+        # deployments pay one attribute check per request, nothing else)
+        self.trace_sample = float(trace_sample)
+        self.slo = slo                   # SloEngine (wired by Fleet)
+        self._tracer = get_tracer()
         self._lock = threading.Lock()
         self._handles: Dict[str, ReplicaHandle] = {}
         self._ring = _Ring()
         # counters (the router's own part of the fleet obs section)
         self.routed = 0
         self.retries = 0
+        self.traced = 0                  # requests with a trace id
         self.no_replica = 0              # 503s for lack of a ready replica
         self.proxy_errors = 0            # all replicas failed transport
         self._http = _RouterHTTP(self, host, port)
@@ -383,13 +430,25 @@ class RouterServer:
             rid = self._ring.pick(key, {h.rid for h in tied})
             return self._handles.get(rid) if rid else tied[0]
 
-    def route_predict(self, body: bytes):
+    def route_predict(self, body: bytes, trace_id: Optional[str] = None):
         """Forward one /predict body; returns (status, raw_response|None,
-        fallback_json|None) — raw responses relay VERBATIM to the client
-        (status line + headers + body exactly as the replica wrote them;
-        the router never re-serializes on the hot path). Transport
-        failures mark the replica unready and retry on the next one; only
-        when every ready replica fails does the client see 502."""
+        fallback_json|None) — raw responses relay near-VERBATIM to the
+        client (status line + headers + body exactly as the replica
+        wrote them, plus the router's injected ``x-hivemall-hop-router``
+        breakdown header; the router never re-serializes the body on the
+        hot path). A client-supplied trace id is honored and forwarded;
+        with the process tracer enabled the router additionally SAMPLES
+        ``trace_sample`` of untraced requests, minting an id the replica
+        tags its spans with. Transport failures mark the replica unready
+        and retry on the next one; only when every ready replica fails
+        does the client see 502."""
+        tr = self._tracer
+        if trace_id is None and tr.enabled \
+                and random.random() < self.trace_sample:
+            trace_id = mint_trace_id()
+        extra_head = (f"x-hivemall-trace: {trace_id}\r\n".encode("latin-1")
+                      if trace_id else b"")
+        t0 = time.monotonic()
         key = zlib.crc32(body)           # cheap, stable affinity key
         tried: set = set()
         last_err = None
@@ -401,10 +460,17 @@ class RouterServer:
             with h._lock:                # `+=` is read-modify-write, not
                 h.inflight += 1          # atomic — a lost update would
             try:                         # skew least-loaded forever
-                status, _, raw = self._forward(h, "POST", "/predict", body)
+                status, payload, lines = self._forward(
+                    h, "POST", "/predict", body, extra_head=extra_head)
                 h.forwarded += 1
                 self.routed += 1
-                return status, raw, None
+                total_s = time.monotonic() - t0
+                if trace_id:
+                    self.traced += 1
+                    # the router's half of the cross-process flame
+                    tr.add_span("router.forward", total_s, trace=trace_id)
+                return status, self._relay_with_hops(
+                    lines, payload, total_s), None
             except _RETRYABLE as e:
                 h.transport_errors += 1
                 h.ready = False          # immediate gate; the manager's
@@ -420,21 +486,52 @@ class RouterServer:
         self.proxy_errors += 1
         return 502, None, {"error": f"all replicas failed: {last_err}"}
 
+    @staticmethod
+    def _relay_with_hops(lines: List[bytes], payload: bytes,
+                         total_s: float) -> bytes:
+        """Rebuild the relayed response with the router's hop header
+        stacked on the replica's: ``relay`` is the router + network
+        share (total minus the replica-reported total), so the full
+        per-hop decomposition sums to the end-to-end wall the client
+        measured at the router."""
+        total_ms = total_s * 1000.0
+        replica_ms = 0.0
+        for line in lines:
+            if line[:15].lower() == b"x-hivemall-hop:":
+                # replica header ends ...,total=<ms>
+                try:
+                    replica_ms = float(
+                        line.rsplit(b"total=", 1)[1].strip().decode())
+                except (IndexError, ValueError, UnicodeDecodeError):
+                    pass
+                break
+        hdr = (f"x-hivemall-hop-router: "
+               f"relay={max(0.0, total_ms - replica_ms):.3f},"
+               f"total={total_ms:.3f}\r\n").encode("ascii")
+        # lines[-1] is the blank header terminator
+        return b"".join(lines[:-1]) + hdr + lines[-1] + payload
+
     def _forward(self, h: ReplicaHandle, method: str, path: str,
-                 body: bytes, timeout: Optional[float] = None):
+                 body: bytes, timeout: Optional[float] = None,
+                 extra_head: bytes = b""):
         """One raw-HTTP exchange on a pooled connection. Returns
-        ``(status, body_bytes, raw_response_bytes)``; raises a transport
-        error (caller retries) on any socket/framing failure. An explicit
-        ``timeout`` bypasses the pool with a one-shot connection — the
-        obs path uses a short one so a wedged replica can't hold the
-        fleet /snapshot hostage for the full forward timeout."""
+        ``(status, body_bytes, head_lines)`` — ``head_lines`` is the
+        replica's status line + header lines + blank terminator, so the
+        predict path can relay them verbatim (with the router hop header
+        spliced in). Raises a transport error (caller retries) on any
+        socket/framing failure. An explicit ``timeout`` bypasses the
+        pool with a one-shot connection — the obs path uses a short one
+        so a wedged replica can't hold the fleet /snapshot hostage for
+        the full forward timeout. ``extra_head`` carries pre-encoded
+        request header lines (the forwarded trace id)."""
         pooled = timeout is None
         conn = (h.get_conn(self.forward_timeout) if pooled
                 else _RawConn(h.host, h.port, timeout))
         head = (f"{method} {path} HTTP/1.1\r\n"
                 f"Host: {h.host}:{h.port}\r\n"
                 f"Content-Type: application/json\r\n"
-                f"Content-Length: {len(body)}\r\n\r\n").encode("ascii")
+                f"Content-Length: {len(body)}\r\n").encode("ascii") \
+            + extra_head + b"\r\n"
         try:
             conn.sock.sendall(head + body)
             status_line = conn.rfile.readline(65537)
@@ -468,7 +565,7 @@ class RouterServer:
             conn.close()
         else:
             h.put_conn(conn)
-        return status, payload, b"".join(lines) + payload
+        return status, payload, lines
 
     # -- admin / obs ---------------------------------------------------------
     def on_reload(self, body: bytes) -> dict:
@@ -492,12 +589,34 @@ class RouterServer:
             "policy": self.policy,
             "routed": self.routed,
             "retries": self.retries,
+            "traced": self.traced,
+            "trace_sample": self.trace_sample,
             "no_replica_503": self.no_replica,
             "proxy_errors": self.proxy_errors,
             "replicas": len(hs),
             "ready_replicas": sum(1 for h in hs if h.ready),
             "inflight": sum(h.inflight for h in hs),
         }
+
+    def merged_trace(self) -> dict:
+        """ONE Chrome-trace dict for the whole fleet: the router
+        process's span ring plus every replica's ``/trace`` export
+        (2 s one-shot fetches — a wedged replica can't stall the merge),
+        concatenated under their own pids. A request traced end to end
+        renders as a single cross-process flame keyed by its
+        ``args.trace`` id."""
+        out = self._tracer.chrome_dict()
+        for h in self.replicas():
+            try:
+                code, payload, _ = self._forward(h, "GET", "/trace",
+                                                 b"", timeout=2.0)
+                if code == 200:
+                    sub = json.loads(payload)
+                    out["traceEvents"].extend(
+                        sub.get("traceEvents") or [])
+            except Exception:            # noqa: BLE001 — a dead replica
+                pass                     # must not take the merge down
+        return out
 
     def fleet_snapshot(self) -> dict:
         """One merged fleet view: the router's counters, every replica's

@@ -34,7 +34,13 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # predict_proba and fan across both replicas; killing one replica under
 # live traffic costs ZERO failed requests (router retry + manager
 # respawn); a newer checkpoint rolls across the fleet one replica at a
-# time with zero drops, converging every replica to the new step.
+# time with zero drops, converging every replica to the new step; the
+# /slo burn-rate surface reports the traffic; and request tracing
+# propagates END TO END — an x-hivemall-trace id is echoed with a
+# per-hop latency breakdown that sums to the router-measured wall, and
+# appears in spans exported from BOTH the router and the scoring
+# replica processes via the router's merged /trace (the tracing-
+# overhead floor itself stays pinned by the obs smoke above).
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.serve.fleet_smoke || exit $?
 

@@ -258,3 +258,129 @@ def test_fleet_processes_end_to_end(trained):
         assert steps == {t._t}
     finally:
         fleet.stop()
+
+# --- fleet obs under replica failure + trace merge ---------------------------
+
+def _dead_port():
+    """A loopback port with nothing listening."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_fleet_obs_survives_dead_and_wedged_replica(trained):
+    """Satellite: /snapshot and /metrics with one replica DEAD (connection
+    refused) and one WEDGED mid-scrape (accepts, never responds) — the
+    survivor's section is present, the broken ones are flagged with
+    errors, and the 2s one-shot obs fetch bounds the whole scrape (no
+    stall for the 60s forward timeout)."""
+    import socket
+    import time as _time
+    _, ds, ckdir, _ = trained
+    live = _replica(ckdir)
+    wedge = socket.create_server(("127.0.0.1", 0))   # accepts, never reads
+    router = RouterServer(port=0).start()
+    base = f"http://127.0.0.1:{router.port}"
+    try:
+        router.add_replica("live", "127.0.0.1", live.port, ready=True)
+        router.add_replica("dead", "127.0.0.1", _dead_port(), ready=True)
+        router.add_replica("wedged", "127.0.0.1",
+                           wedge.getsockname()[1], ready=True)
+        t0 = _time.monotonic()
+        snap = json.loads(urllib.request.urlopen(
+            base + "/snapshot", timeout=30).read())
+        dt = _time.monotonic() - t0
+        assert dt < 10.0                 # 2s one-shot x broken replicas,
+        per = snap["fleet"]["replicas"]  # never the 60s forward timeout
+        assert set(per) == {"live", "dead", "wedged"}
+        assert "model_step" in per["live"]           # survivor intact
+        assert "error" in per["dead"]                # dead flagged
+        assert "error" in per["wedged"]              # wedged flagged
+        assert "router" in per["dead"]               # handle stats still on
+        # /metrics flattens the same without stalling
+        t0 = _time.monotonic()
+        prom = urllib.request.urlopen(
+            base + "/metrics", timeout=30).read().decode()
+        assert _time.monotonic() - t0 < 10.0
+        assert "hivemall_tpu_fleet_replicas_live_model_step" in prom
+        assert "hivemall_tpu_fleet_router_replicas 3" in prom
+    finally:
+        router.stop()
+        live.stop()
+        wedge.close()
+
+
+def test_router_trace_merge_and_hop_injection(trained):
+    """The router's /trace merges its own tagged spans with the
+    replica's; the relayed response stacks x-hivemall-hop-router on the
+    replica's breakdown with relay + replica total == router total."""
+    from hivemall_tpu.obs.trace import get_tracer
+    from hivemall_tpu.serve.http import KeepAliveClient
+    _, ds, ckdir, _ = trained
+    rep = _replica(ckdir)
+    router = RouterServer(port=0, trace_sample=1.0).start()
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.enable()
+    try:
+        router.add_replica("r0", "127.0.0.1", rep.port, ready=True)
+        cli = KeepAliveClient("127.0.0.1", router.port)
+        rows = _rows_of(ds, 1)
+        code, _ = cli.post_json("/predict", {"rows": rows},
+                                headers={"x-hivemall-trace": "mrk-1"})
+        assert code == 200
+        hdrs = {k.lower(): v for k, v in cli.last_headers.items()}
+        assert hdrs["x-hivemall-trace"] == "mrk-1"
+        rhop = dict(kv.split("=")
+                    for kv in hdrs["x-hivemall-hop-router"].split(","))
+        hop = dict(kv.split("=")
+                   for kv in hdrs["x-hivemall-hop"].split(","))
+        assert float(rhop["relay"]) + float(hop["total"]) == \
+            pytest.approx(float(rhop["total"]), abs=0.02)
+        # sampling path: with trace_sample=1.0 an untraced request gets
+        # a minted id echoed back
+        code, _ = cli.post_json("/predict", {"rows": rows})
+        hdrs = {k.lower(): v for k, v in cli.last_headers.items()}
+        minted = hdrs.get("x-hivemall-trace")
+        assert minted and router.traced >= 2
+        # merged /trace: router.forward + the replica's serve spans all
+        # carry the explicit id (same process here, distinct in a real
+        # fleet — the fleet smoke pins the 2-pid case)
+        trace = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/trace", timeout=10).read())
+        tagged = {e["name"] for e in trace["traceEvents"]
+                  if "mrk-1" in str((e.get("args") or {}).get("trace"))}
+        assert "router.forward" in tagged
+        assert "serve.predict" in tagged
+        cli.close()
+    finally:
+        tracer.disable()
+        tracer.reset()
+        router.stop()
+        rep.stop()
+
+
+def test_router_slo_endpoint_wired_by_fleet_engine(trained):
+    """RouterServer serves /slo off an attached SloEngine (404 without
+    one) — the Fleet wires a shared engine into router + manager."""
+    from hivemall_tpu.obs.slo import SloEngine
+    import urllib.error
+    router = RouterServer(port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/slo", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        router.stop()
+    eng = SloEngine(p99_ms=42.0)
+    router = RouterServer(port=0, slo=eng).start()
+    try:
+        out = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/slo", timeout=10).read())
+        assert out["configured"] and out["targets"]["p99_ms"] == 42.0
+    finally:
+        router.stop()

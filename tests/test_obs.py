@@ -190,11 +190,11 @@ def test_to_prometheus_exposition_format():
     # large counters and epoch timestamps
     assert "hivemall_tpu_train_examples 44776121" in lines
     assert "hivemall_tpu_train_ts 1754180000.123" in lines
-    assert not any("skipped-string" in l or "name" in l for l in lines)
+    assert not any("skipped-string" in l for l in lines)
     # exposition validity: every non-comment line is `name value`
     metric = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]* -?[0-9.eE+-]+$")
     for l in lines:
-        assert l.startswith("# TYPE ") or metric.match(l), l
+        assert l.startswith(("# TYPE ", "# HELP ")) or metric.match(l), l
 
 
 def test_obs_http_server_snapshot_and_metrics():
@@ -465,3 +465,254 @@ def test_obs_cli_renders_stream(tmp_path, capsys):
 def test_obs_cli_missing_file(capsys):
     from hivemall_tpu.cli.main import main
     assert main(["obs", "/nonexistent/x.jsonl"]) == 1
+
+
+# --- Histogram primitive + Prometheus histogram families --------------------
+
+def test_histogram_cumulative_buckets_and_quantile():
+    from hivemall_tpu.obs.histo import Histogram, quantile_from_buckets
+    h = Histogram([0.001, 0.01, 0.1])
+    for v in (0.0005, 0.001, 0.005, 0.05, 5.0):
+        h.observe(v)
+    s = h.snapshot()
+    assert s["_type"] == "histogram"
+    # le semantics: a value exactly on a bound counts into that bucket
+    assert s["buckets"] == [[0.001, 2], [0.01, 3], [0.1, 4], ["+Inf", 5]]
+    assert s["count"] == 5 and abs(s["sum"] - 5.0565) < 1e-9
+    # interpolated quantile stays inside the winning bucket
+    q = quantile_from_buckets(s["buckets"], 0.5)
+    assert 0.001 <= q <= 0.01
+    # +Inf winner clamps to the largest finite bound
+    assert quantile_from_buckets(s["buckets"], 0.999) == 0.1
+    assert quantile_from_buckets([], 0.99) == 0.0
+
+
+def test_histogram_concurrent_observers_lose_nothing():
+    from hivemall_tpu.obs.histo import Histogram
+    h = Histogram([1.0, 10.0])
+    n, threads = 2000, 4
+
+    def work():
+        for i in range(n):
+            h.observe(0.5 if i % 2 else 5.0)
+
+    ts = [threading.Thread(target=work) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    s = h.snapshot()
+    assert s["count"] == n * threads
+    assert s["buckets"][-1][1] == n * threads
+
+
+def _parse_prometheus_strict(text):
+    """Strict text-format 0.0.4 grammar: returns {family: (type, samples)}
+    and asserts every line is a well-formed HELP/TYPE/sample line, HELP
+    and TYPE precede their family's samples exactly once, histogram
+    families carry monotonic _bucket series + _sum/_count."""
+    name_re = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    sample_re = re.compile(
+        rf"^({name_re})(?:\{{le=\"([^\"]+)\"\}})? (-?[0-9.eE+-]+|NaN)$")
+    help_re = re.compile(rf"^# HELP ({name_re}) (.+)$")
+    type_re = re.compile(rf"^# TYPE ({name_re}) (gauge|histogram|counter)$")
+    assert text.endswith("\n")
+    families = {}
+    cur = None
+    for line in text.splitlines():
+        m = help_re.match(line)
+        if m:
+            assert m.group(1) not in families, f"duplicate HELP {line}"
+            families[m.group(1)] = {"type": None, "samples": []}
+            cur = m.group(1)
+            continue
+        m = type_re.match(line)
+        if m:
+            assert m.group(1) == cur, f"TYPE without HELP: {line}"
+            assert families[cur]["type"] is None, f"duplicate TYPE {line}"
+            families[cur]["type"] = m.group(2)
+            continue
+        m = sample_re.match(line)
+        assert m, f"unparsable exposition line: {line!r}"
+        base = m.group(1)
+        fam = base
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[:-len(suffix)] in families \
+                    and families[base[:-len(suffix)]]["type"] == "histogram":
+                fam = base[:-len(suffix)]
+        assert fam in families and families[fam]["type"], \
+            f"sample before its TYPE: {line!r}"
+        float(m.group(3))                # value must parse
+        families[fam]["samples"].append((base, m.group(2), m.group(3)))
+    for fam, rec in families.items():
+        if rec["type"] != "histogram":
+            continue
+        buckets = [(le, float(v)) for n_, le, v in rec["samples"]
+                   if n_ == fam + "_bucket"]
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), f"{fam} buckets not monotonic"
+        assert buckets[-1][0] == "+Inf"
+        total = [float(v) for n_, _, v in rec["samples"]
+                 if n_ == fam + "_count"]
+        assert total and total[0] == counts[-1]
+    return families
+
+
+def test_to_prometheus_strict_grammar_with_histograms():
+    """Satellite: the exposition parses under a strict grammar even with
+    hostile snapshot keys (dots/dashes/leading digits) and histogram
+    leaves; histogram series are monotonic with +Inf == _count."""
+    from hivemall_tpu.obs.histo import Histogram
+    h = Histogram([0.005, 0.05, 0.5])
+    for v in (0.001, 0.01, 0.1, 1.0):
+        h.observe(v)
+    text = to_prometheus({
+        "ts": 1754180000.123,
+        "serve": {"request_latency_seconds": h.snapshot(),
+                  "batch_hist": {"16": 3, "2": 1},
+                  "qps": 12.5, "ready": True, "model_path": "/x.npz"},
+        "9section": {"with.dots": 1, "and-dashes": 2},
+    })
+    fams = _parse_prometheus_strict(text)
+    lat = "hivemall_tpu_serve_request_latency_seconds"
+    assert fams[lat]["type"] == "histogram"
+    assert ('%s_bucket' % lat, "+Inf", "4") in fams[lat]["samples"]
+    # sanitization: dots/dashes -> underscores, leading digit guarded by
+    # the name regex (the section rides behind the prefix)
+    assert "hivemall_tpu_9section_with_dots" in fams
+    assert "hivemall_tpu_9section_and_dashes" in fams
+    assert fams["hivemall_tpu_serve_qps"]["type"] == "gauge"
+    # a name that would START with a digit gets the underscore prefix
+    from hivemall_tpu.obs.http import _metric_name
+    assert _metric_name(["9lives", "x"]) == "_9lives_x"
+
+
+# --- request-scoped tracing -------------------------------------------------
+
+def test_tracer_context_tags_spans_into_chrome_args(tracer):
+    with tracer.span("untagged"):
+        pass
+    with tracer.context("req-42"):
+        with tracer.span("tagged"):
+            pass
+        # nesting restores the outer tag
+        with tracer.context("inner"):
+            with tracer.span("nested"):
+                pass
+        with tracer.span("tagged2"):
+            pass
+    tracer.add_span("explicit", 0.001, trace="req-42")
+    evs = tracer.chrome_dict()["traceEvents"]
+    by_name = {e["name"]: e for e in evs if e.get("ph") == "X"}
+    assert "args" not in by_name["untagged"]
+    assert by_name["tagged"]["args"]["trace"] == "req-42"
+    assert by_name["nested"]["args"]["trace"] == "inner"
+    assert by_name["tagged2"]["args"]["trace"] == "req-42"
+    assert by_name["explicit"]["args"]["trace"] == "req-42"
+    # wall-clock anchoring: ts is epoch microseconds, so independently
+    # recorded processes merge onto one timeline
+    now_us = time.time() * 1e6
+    assert abs(by_name["tagged"]["ts"] - now_us) < 60e6
+    # the export names its process (the merged fleet view's labels)
+    metas = [e for e in evs if e.get("ph") == "M"]
+    assert metas and metas[0]["args"]["name"] == tracer.process_label
+
+
+def test_tracer_context_disabled_is_noop():
+    t = Tracer(enabled=False)
+    ctx = t.context("x")
+    with ctx:
+        with t.span("s"):
+            pass
+    assert t.chrome_dict()["traceEvents"][:-1] == []   # only metadata
+
+
+def test_mint_trace_id_unique():
+    from hivemall_tpu.obs.trace import mint_trace_id
+    ids = {mint_trace_id() for _ in range(100)}
+    assert len(ids) == 100
+
+
+# --- obs --follow under metrics rotation ------------------------------------
+
+def test_follow_tail_survives_rotation(tmp_path):
+    """Satellite: `obs --follow` keeps tailing across a
+    HIVEMALL_TPU_METRICS_MAX_MB rotation — the replaced <path> is
+    reopened from its head and <path>.1 is never replayed. The rotation
+    here is the exact MetricsStream._rotate sequence (os.replace to
+    <path>.1, fresh file continues), driven by hand so every phase is
+    deterministic."""
+    from hivemall_tpu.obs.report import _FollowTail
+
+    def emit(path, event, **fields):
+        with open(path, "a") as f:
+            f.write(json.dumps({"ts": 1.0, "event": event, **fields})
+                    + "\n")
+
+    p = str(tmp_path / "m.jsonl")
+    tail = _FollowTail(p)
+    emit(p, "pre_rotation", i=0)
+    emit(p, "archived_only", i=1)
+    assert tail.tick() is not None
+    assert tail.state.counts == {"pre_rotation": 1, "archived_only": 1}
+    # rotation: current file -> <path>.1, FRESH file continues — while
+    # the follower is mid-tail
+    os.replace(p, p + ".1")
+    emit(p, "post_rotation", i=2)
+    out = tail.tick()                    # inode change -> reopen from 0
+    assert out is not None
+    assert tail.state.counts.get("post_rotation") == 1
+    # no replay: the archived generation's events were folded exactly
+    # once (when they were still in <path>), never re-read from <path>.1
+    assert tail.state.counts["pre_rotation"] == 1
+    assert tail.state.counts["archived_only"] == 1
+    # a tick landing IN the replace window (file briefly absent) retries
+    os.replace(p, p + ".1")
+    assert tail.tick() is None           # no file yet — no crash, no .1
+    emit(p, "second_generation", i=3)
+    tail.tick()
+    assert tail.state.counts.get("second_generation") == 1
+    assert tail.state.counts["post_rotation"] == 1   # still exactly once
+
+
+def test_stream_rotation_under_live_follow(tmp_path, monkeypatch):
+    """The integrated version: a real MetricsStream rotating under the
+    size cap while a follower tails it — post-rotation events are seen,
+    nothing read from <path> is double-counted."""
+    from hivemall_tpu.obs.report import _FollowTail
+    monkeypatch.setenv("HIVEMALL_TPU_METRICS_MAX_MB", "0.0005")  # 500 B
+    p = str(tmp_path / "m.jsonl")
+    s = M.MetricsStream(p)
+    tail = _FollowTail(p)
+    seen = 0
+    for i in range(40):
+        s.emit("ev", i=i, pad="x" * 64)
+        if i % 5 == 0:
+            tail.tick()
+            seen = tail.state.counts.get("ev", 0)
+            assert seen <= i + 1         # never double-counts a line
+    assert s.rotations >= 1
+    s.emit("final", i=99)
+    s.close()
+    tail.tick()
+    assert tail.state.counts.get("final") == 1
+    assert tail.state.counts.get("ev", 0) <= 40
+
+
+def test_render_slo_report():
+    from hivemall_tpu.obs.report import render_slo
+    text = render_slo({
+        "targets": {"p99_ms": 50.0, "availability": 0.999},
+        "samples": 12,
+        "windows": {"5m": {"seconds": 300.0, "qps": 10.0,
+                           "availability": 0.995,
+                           "availability_burn_rate": 5.0,
+                           "p99_ms": 80.0, "frac_over_slo": 0.04,
+                           "latency_burn_rate": 4.0}},
+        "score": {"mean": 0.5, "std": 0.1},
+        "drift": {"latency_events": 2, "score_events": 0,
+                  "recent": [{"series": "latency_ms", "value": 80.0,
+                              "change_score": 9.1, "ts": 1.0}]},
+    }, source="http://x/slo")
+    assert "burn 5x" in text and "80.0ms" in text
+    assert "latency x2" in text and "change 9.1" in text

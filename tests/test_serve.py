@@ -708,3 +708,103 @@ def test_http_deadline_maps_to_504(trained):
     finally:
         gate.set()
         srv.stop()
+
+
+# --- request tracing + per-hop breakdown + histograms ------------------------
+
+def test_http_hop_breakdown_and_trace_echo(trained):
+    """Every /predict response carries x-hivemall-hop whose parts sum to
+    its total; an x-hivemall-trace id is echoed and tags the serve spans
+    in the process tracer's Chrome export."""
+    from hivemall_tpu.obs.trace import get_tracer
+    from hivemall_tpu.serve.http import KeepAliveClient, PredictServer
+    _, ds, ckdir, _ = trained
+    eng = _engine(ckdir)
+    srv = PredictServer(eng, port=0, max_delay_ms=1.0, watch=False,
+                        slo=False).start()
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.enable()
+    try:
+        cli = KeepAliveClient("127.0.0.1", srv.port)
+        rows = _rows_of(ds, 2)
+        code, _ = cli.post_json("/predict", {"rows": rows},
+                                headers={"x-hivemall-trace": "t-9"})
+        assert code == 200
+        hdrs = {k.lower(): v for k, v in cli.last_headers.items()}
+        assert hdrs["x-hivemall-trace"] == "t-9"
+        hop = dict(kv.split("=")
+                   for kv in hdrs["x-hivemall-hop"].split(","))
+        assert set(hop) == {"parse", "queue", "assemble", "predict",
+                            "other", "total"}
+        total = float(hop.pop("total"))
+        parts = sum(float(v) for v in hop.values())
+        # "other" closes the residual, so the decomposition is additive
+        assert parts == pytest.approx(total, abs=0.02)
+        assert float(hop["predict"]) > 0
+        # an UNtraced request still gets the breakdown, no trace echo
+        code, _ = cli.post_json("/predict", {"rows": rows})
+        hdrs = {k.lower(): v for k, v in cli.last_headers.items()}
+        assert "x-hivemall-hop" in hdrs
+        assert "x-hivemall-trace" not in hdrs
+        # the trace id tagged the serve spans
+        evs = tracer.chrome_dict()["traceEvents"]
+        tagged = {e["name"] for e in evs
+                  if (e.get("args") or {}).get("trace") == "t-9"}
+        assert {"serve.enqueue", "serve.batch",
+                "serve.predict"} <= tagged
+        cli.close()
+    finally:
+        tracer.disable()
+        tracer.reset()
+        srv.stop()
+
+
+def test_http_metrics_exports_latency_and_batch_histograms(trained):
+    import urllib.request
+    from hivemall_tpu.serve.http import PredictServer
+    _, ds, ckdir, _ = trained
+    eng = _engine(ckdir)
+    srv = PredictServer(eng, port=0, max_delay_ms=1.0, watch=False,
+                        slo=False).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        for _ in range(3):
+            _post(base + "/predict", {"rows": _rows_of(ds, 2)})
+        prom = urllib.request.urlopen(base + "/metrics",
+                                      timeout=10).read().decode()
+        for fam in ("hivemall_tpu_serve_request_latency_seconds",
+                    "hivemall_tpu_serve_batch_size_rows"):
+            assert f"# TYPE {fam} histogram" in prom
+            assert f'{fam}_bucket{{le="+Inf"}}' in prom
+            assert f"{fam}_sum" in prom and f"{fam}_count" in prom
+        # cumulative consistency: +Inf bucket == _count
+        import re as _re
+        inf = int(_re.search(
+            r'request_latency_seconds_bucket\{le="\+Inf"\} (\d+)',
+            prom).group(1))
+        cnt = int(_re.search(
+            r"request_latency_seconds_count (\d+)", prom).group(1))
+        assert inf == cnt >= 3
+    finally:
+        srv.stop()
+
+
+def test_batcher_score_moments_and_hop_attribute():
+    b = MicroBatcher(lambda rows: np.full(len(rows), 0.25, np.float32),
+                     max_batch=8, max_delay_ms=0.5)
+    try:
+        futs = [b.submit([("r", i)]) for i in range(4)]
+        for f in futs:
+            f.result(5)
+        st = b.stats()
+        assert st["score_mean"] == pytest.approx(0.25)
+        assert st["score_std"] == pytest.approx(0.0, abs=1e-6)
+        assert st["request_latency_seconds"]["count"] == 4
+        hop = futs[0].hop
+        assert hop["queue_s"] >= 0 and hop["predict_s"] >= 0
+        tot = b.slo_totals()
+        assert tot["requests"] == 4 and tot["score_n"] == 4
+        assert tot["latency"]["count"] == 4
+    finally:
+        b.close()

@@ -1,0 +1,404 @@
+"""SLO engine (hivemall_tpu/obs/slo.py, docs/OBSERVABILITY.md "Serving
+traces and SLOs"): windowed error-budget burn rates off cumulative
+serving totals, changefinder drift detection over the latency and
+prediction-score streams, and the serve/fleet wiring (/slo endpoints,
+healthz totals aggregation). Samples carry explicit timestamps so every
+window computation is deterministic — no sleeps."""
+
+import json
+
+import pytest
+
+import hivemall_tpu.utils.metrics as M
+from hivemall_tpu.obs.histo import LATENCY_BUCKETS_S, Histogram
+from hivemall_tpu.obs.slo import SloEngine
+
+
+def _totals(requests, bad, lat_ms_per_req, *, hist=None, score=None):
+    """Build cumulative totals: ``lat_ms_per_req`` is a list of ALL
+    request latencies so far (cumulative, like the live histogram)."""
+    h = Histogram(LATENCY_BUCKETS_S)
+    for ms in lat_ms_per_req:
+        h.observe(ms / 1000.0)
+    t = {"requests": requests, "errors": bad, "shed": 0,
+         "latency": h.snapshot()}
+    if score is not None:
+        n = len(score)
+        t.update(score_sum=float(sum(score)),
+                 score_sumsq=float(sum(x * x for x in score)),
+                 score_n=n)
+    return t
+
+
+def test_slo_engine_window_diffs_and_availability_burn():
+    """Samples every 60s for 10 minutes: the 5m window diffs against the
+    sample AT its far edge and sees only the second half's failures; the
+    1h window (longer than history) covers everything."""
+    e = SloEngine(p99_ms=100.0, availability=0.99)
+    t0 = 1_000_000.0
+    lats = []
+    # first 5 minutes: 20 good requests per tick
+    for i in range(6):                  # t0 .. t0+300
+        lats = [5.0] * (20 * i)
+        e.sample(_totals(20 * i, 0, lats), ts=t0 + 60 * i)
+    # second 5 minutes: 10 requests per tick, 2 of them bad
+    for j in range(1, 6):               # t0+360 .. t0+600
+        lats = [5.0] * (100 + 10 * j)
+        e.sample(_totals(100 + 10 * j, 2 * j, lats), ts=t0 + 300 + 60 * j)
+    out = e.evaluate(now=t0 + 600)
+    w5, w1h = out["windows"]["5m"], out["windows"]["1h"]
+    assert w5["requests"] == 50 and w5["bad"] == 10
+    assert w5["availability"] == pytest.approx(0.8)
+    # bad fraction 0.2 vs budget 0.01 -> 20x burn
+    assert w5["availability_burn_rate"] == pytest.approx(20.0)
+    # the 1h window spans the whole history
+    assert w1h["requests"] == 150 and w1h["bad"] == 10
+    assert w1h["availability_burn_rate"] == pytest.approx(
+        (10 / 150) / 0.01, rel=1e-3)
+    assert w5["qps"] == pytest.approx(50 / 300, abs=0.01)   # rounded 2dp
+
+
+def test_slo_latency_burn_moves_on_injected_regression():
+    """Acceptance: burn rates MOVE when a latency regression is
+    injected. Steady 5ms traffic is inside a 100ms p99 budget; flipping
+    new requests to 400ms pushes the 5m frac-over and burn rate up while
+    the pre-regression window stays clean."""
+    e = SloEngine(p99_ms=100.0, availability=0.999)
+    t0 = 2_000_000.0
+    lats = []
+    n = 0
+    for i in range(5):                  # 5 ticks of healthy traffic
+        lats += [5.0] * 20
+        n += 20
+        e.sample(_totals(n, 0, lats), ts=t0 + i)
+    healthy = e.evaluate(now=t0 + 4)["windows"]["5m"]
+    assert healthy["latency_burn_rate"] == 0.0
+    assert healthy["p99_ms"] is not None and healthy["p99_ms"] < 100.0
+    # inject the regression: every new request takes 400ms
+    for i in range(5, 10):
+        lats += [400.0] * 20
+        n += 20
+        e.sample(_totals(n, 0, lats), ts=t0 + i)
+    bad = e.evaluate(now=t0 + 9)["windows"]["5m"]
+    assert bad["frac_over_slo"] > 0.4
+    assert bad["latency_burn_rate"] > 40.0       # >> 1x: budget burning
+    assert bad["p99_ms"] > 100.0
+
+
+def test_slo_changefinder_flags_drift_into_metrics_stream(tmp_path,
+                                                          monkeypatch):
+    """Acceptance: the changefinder flags the injected regression in the
+    metrics stream — an `slo_drift` record lands in the jsonl next to
+    train/serve telemetry, and the drift counters move."""
+    p = tmp_path / "m.jsonl"
+    monkeypatch.setattr(M, "_stream", M.MetricsStream(str(p)))
+    try:
+        e = SloEngine(p99_ms=100.0, drift_warmup=20, drift_sigma=6.0)
+        t0 = 3_000_000.0
+        lats = []
+        n = 0
+        # long steady phase calibrates the detector's change-score scale
+        for i in range(60):
+            lats += [5.0, 5.2, 4.8, 5.1]
+            n += 4
+            e.sample(_totals(n, 0, lats), ts=t0 + i)
+        assert e.drift_counts["latency_ms"] == 0
+        # step change: sustained 30x latency
+        for i in range(60, 90):
+            lats += [150.0, 151.0, 149.0, 150.5]
+            n += 4
+            e.sample(_totals(n, 0, lats), ts=t0 + i)
+        assert e.drift_counts["latency_ms"] >= 1
+        assert e.drift_events and \
+            e.drift_events[-1]["series"] == "latency_ms"
+        M._stream.close()
+        drift = [json.loads(line) for line in open(p)
+                 if json.loads(line).get("event") == "slo_drift"]
+        assert drift and drift[0]["series"] == "latency_ms"
+        assert drift[0]["change_score"] > 0
+    finally:
+        M._stream = None
+
+
+def test_slo_score_drift_detected():
+    """A prediction-score distribution shift (0.5 -> 0.9 mean) flags the
+    score-series changefinder. The long steady phase lets the SDAR
+    variance converge to the series' real (small) noise floor, so the
+    step registers at full significance — the live sampler ticks every
+    second, so 300 ticks is five minutes of calibration."""
+    import random
+    rng = random.Random(7)
+    e = SloEngine(drift_warmup=20, drift_sigma=6.0)
+    t0 = 4_000_000.0
+    n = 0
+    scores = []
+    for i in range(300):                # stable score distribution
+        scores += [0.5 + rng.uniform(-0.02, 0.02) for _ in range(3)]
+        n += 3
+        e.sample(_totals(n, 0, [5.0] * n, score=scores), ts=t0 + i)
+    assert e.drift_counts["score"] == 0
+    for i in range(300, 330):           # the model starts scoring high
+        scores += [0.9 + rng.uniform(-0.02, 0.02) for _ in range(3)]
+        n += 3
+        e.sample(_totals(n, 0, [5.0] * n, score=scores), ts=t0 + i)
+    assert e.drift_counts["score"] >= 1
+    assert any(ev["series"] == "score" for ev in e.drift_events)
+
+
+def test_slo_counter_reset_clamps_never_negative():
+    """A replica respawn resets its cumulative share — window diffs must
+    clamp at zero, not report negative rates."""
+    e = SloEngine()
+    t0 = 5_000_000.0
+    e.sample(_totals(1000, 5, [5.0] * 100), ts=t0)
+    e.sample(_totals(50, 0, [5.0] * 10), ts=t0 + 10)   # reset mid-window
+    out = e.evaluate(now=t0 + 10)
+    w = out["windows"]["5m"]
+    assert w["requests"] == 0 and w["bad"] == 0
+    assert w["availability_burn_rate"] == 0.0
+
+
+def test_slo_registry_section_and_validation():
+    from hivemall_tpu.obs.registry import registry
+    e = SloEngine(p99_ms=50.0)
+    snap = registry.snapshot()
+    assert snap["slo"]["configured"] is True
+    assert snap["slo"]["target_p99_ms"] == 50.0
+    del e                                # weakly held: falls back to stub
+    import gc
+    gc.collect()
+    assert registry.snapshot()["slo"] == {"configured": False}
+    with pytest.raises(ValueError, match="availability"):
+        SloEngine(availability=1.5)
+
+
+def test_predict_server_slo_endpoint(tmp_path):
+    """/slo on a live PredictServer: sampled from its own batcher."""
+    import os
+    import time
+    import urllib.request
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    from hivemall_tpu.models.linear import GeneralClassifier
+    from hivemall_tpu.serve.engine import PredictEngine
+    from hivemall_tpu.serve.http import KeepAliveClient, PredictServer
+    opts = "-dims 512 -loss logloss -opt adagrad -mini_batch 32"
+    ds, _ = synthetic_classification(60, 32, seed=3)
+    t = GeneralClassifier(opts)
+    t.fit(ds)
+    t.save_bundle(os.path.join(tmp_path, f"{t.NAME}-step{t._t:010d}.npz"))
+    eng = PredictEngine("train_classifier", opts,
+                        checkpoint_dir=str(tmp_path), warmup=False)
+    srv = PredictServer(eng, port=0, max_delay_ms=1.0, watch=False,
+                        slo_p99_ms=250.0).start()
+    # the sampler thread ticks at 1s; sample synchronously instead so
+    # the test stays fast and deterministic
+    srv.slo.stop()
+    try:
+        cli = KeepAliveClient("127.0.0.1", srv.port)
+        rows = [[f"{int(a)}:{float(v)!r}" for a, v in zip(*ds.row(0))]]
+        for _ in range(3):
+            code, _ = cli.post_json("/predict", {"rows": rows})
+            assert code == 200
+        srv.slo.sample(srv.batcher.slo_totals(), ts=time.time())
+        out = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/slo", timeout=10).read())
+        assert out["configured"] and out["targets"]["p99_ms"] == 250.0
+        assert out["windows"]["5m"]["requests"] == 3
+        assert out["score"] is not None
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_fleet_slo_totals_aggregate_across_replicas():
+    """The manager's fleet-wide sum: per-replica /healthz slo sections
+    (histogram buckets, counters, score moments) add exactly."""
+    from hivemall_tpu.serve.fleet import ReplicaManager
+
+    class _R:
+        def __init__(self, rid, slo):
+            self.rid = rid
+            self.last_health = {"slo": slo}
+
+    mgr = ReplicaManager.__new__(ReplicaManager)   # no processes needed
+    mgr._slo_seen = {}
+    a = Histogram(LATENCY_BUCKETS_S)
+    b = Histogram(LATENCY_BUCKETS_S)
+    for ms in (1.0, 2.0):
+        a.observe(ms / 1000.0)
+    b.observe(0.5)
+    reps = [
+        _R("r0", {"requests": 10, "errors": 1, "shed": 2, "expired": 1,
+                  "latency": a.snapshot(),
+                  "score_sum": 5.0, "score_sumsq": 2.6, "score_n": 10}),
+        _R("r1", {"requests": 4, "errors": 0, "shed": 0,
+                  "latency": b.snapshot(),
+                  "score_sum": 2.0, "score_sumsq": 1.1, "score_n": 4}),
+        _R("r2", None),                 # replica not yet probed: skipped
+    ]
+    mgr.replicas = lambda: reps
+    tot = mgr._slo_totals()
+    assert tot["requests"] == 14 and tot["errors"] == 1
+    assert tot["shed"] == 2 and tot["expired"] == 1
+    assert tot["score_n"] == 14
+    assert tot["latency"]["count"] == 3
+    assert tot["latency"]["buckets"][-1][1] == 3   # +Inf sums bucket-wise
+    assert tot["score_sum"] == pytest.approx(7.0)
+    assert tot["reset"] is False
+    # a replica respawning (rid vanishes, replacement starts at 0) flags
+    # the NEXT tick as reset so the drift feed skips the garbage interval
+    reps[0] = _R("r3", {"requests": 0, "errors": 0, "shed": 0,
+                        "latency": b.snapshot(),
+                        "score_sum": 0.0, "score_sumsq": 0.0,
+                        "score_n": 0})
+    tot2 = mgr._slo_totals()
+    assert tot2["reset"] is True
+    assert mgr._slo_totals()["reset"] is False     # steady again
+
+
+def test_slo_expired_requests_burn_availability():
+    """504s are client-visible failures: the expired counter burns the
+    availability budget alongside errors and shed."""
+    e = SloEngine(availability=0.99)
+    t0 = 6_000_000.0
+    t = _totals(100, 0, [5.0] * 100)
+    e.sample(dict(t), ts=t0)
+    t2 = _totals(200, 0, [5.0] * 200)
+    t2["expired"] = 50                   # half the new traffic timed out
+    e.sample(t2, ts=t0 + 10)
+    w = e.evaluate(now=t0 + 10)["windows"]["5m"]
+    assert w["bad"] == 50
+    assert w["availability"] == pytest.approx(0.5)
+
+
+def test_slo_reset_flag_skips_drift_feed():
+    """A totals dict flagged reset=True still folds into the windows but
+    never reaches the changefinder (no garbage interval means)."""
+    e = SloEngine(drift_warmup=0, drift_sigma=0.1)
+    t0 = 7_000_000.0
+    for i in range(10):
+        e.sample(_totals(10 * (i + 1), 0, [5.0] * 10 * (i + 1)),
+                 ts=t0 + i)
+    fed = e._cf_stats[("latency_ms", "outlier")][0]
+    t = _totals(200, 0, [5.0] * 100 + [500.0] * 100)
+    t["reset"] = True
+    e.sample(t, ts=t0 + 10)
+    assert e._cf_stats[("latency_ms", "outlier")][0] == fed   # skipped
+    assert e.evaluate(now=t0 + 10)["windows"]["5m"]["requests"] == 190
+
+
+def test_slo_shed_burns_but_never_negative_availability():
+    """Shed submits never enter the batcher's accepted-requests counter,
+    so availability must divide by OFFERED (accepted + shed) — overload
+    reads as low availability, never as a negative one."""
+    e = SloEngine(availability=0.99)
+    t0 = 8_000_000.0
+    e.sample(_totals(0, 0, []), ts=t0)
+    t = _totals(10, 0, [5.0] * 10)      # 10 accepted...
+    t["shed"] = 90                      # ...90 shed at the door
+    e.sample(t, ts=t0 + 10)
+    w = e.evaluate(now=t0 + 10)["windows"]["5m"]
+    assert w["requests"] == 100         # offered
+    assert w["bad"] == 90
+    assert w["availability"] == pytest.approx(0.1)
+    assert w["availability_burn_rate"] == pytest.approx(90.0)
+
+
+def test_slo_partial_reset_keeps_latency_metrics_in_range():
+    """A partial fleet reset (one replica's histogram history vanishes
+    while survivors keep counting) must not produce a negative over-SLO
+    fraction or an out-of-range p99 — the bucket diff is re-monotonized."""
+    from hivemall_tpu.obs.slo import _diff_buckets
+    # old edge: 500 slow requests (0.25s bucket); new: those vanished,
+    # survivors added 600 fast ones
+    old = [[0.005, 0], [0.25, 500], ["+Inf", 500]]
+    new = [[0.005, 600], [0.25, 600], ["+Inf", 600]]
+    diff = _diff_buckets(new, old)
+    counts = [c for _, c in diff]
+    assert counts == sorted(counts)      # monotone cumulative again
+    assert all(c >= 0 for c in counts)
+    e = SloEngine(p99_ms=100.0)
+    t0 = 9_000_000.0
+    e.sample({"requests": 500, "latency":
+              {"buckets": old, "sum": 100.0, "count": 500}}, ts=t0)
+    e.sample({"requests": 1100, "latency":
+              {"buckets": new, "sum": 103.0, "count": 600}}, ts=t0 + 10)
+    w = e.evaluate(now=t0 + 10)["windows"]["5m"]
+    assert w["frac_over_slo"] >= 0.0
+    assert w["latency_burn_rate"] >= 0.0
+    assert w["p99_ms"] is None or w["p99_ms"] >= 0.0
+
+
+def test_batcher_fallback_rescore_feeds_score_moments():
+    """Requests scored through the error-isolation fallback stay visible
+    to the score-drift detector."""
+    import threading
+    import numpy as np
+    from hivemall_tpu.serve.batcher import MicroBatcher
+    calls = []
+
+    def flaky(rows):
+        calls.append(len(rows))
+        if len(calls) == 2 and len(rows) > 1:
+            raise RuntimeError("batch poisoned")    # coalesced batch dies
+        return np.full(len(rows), 0.5, np.float32)
+
+    gate = threading.Event()
+
+    def gated(rows):
+        if len(calls) == 0:
+            calls.append(len(rows))
+            gate.wait(5)
+            return np.full(len(rows), 0.5, np.float32)
+        return flaky(rows)
+
+    b = MicroBatcher(gated, max_batch=8, max_delay_ms=1.0)
+    try:
+        f0 = b.submit([("w",)])          # occupies the dispatch thread
+        f1 = b.submit([("a",)])          # these two coalesce and the
+        f2 = b.submit([("b",)])          # batch raises -> per-request
+        gate.set()                       # fallback
+        for f in (f0, f1, f2):
+            f.result(5)
+        assert b.score_n == 3            # fallback requests counted
+        assert b.stats()["score_mean"] == pytest.approx(0.5)
+    finally:
+        b.close()
+
+
+def test_slo_partial_reset_availability_never_negative():
+    """Partial reset where the bad delta survives the clamp harder than
+    the offered delta: availability is bounded at >= 0 (bad <= offered)."""
+    e = SloEngine(availability=0.999)
+    t0 = 10_000_000.0
+    # edge: replica A 1000 good + replica B 100 req / 10 bad
+    e.sample({"requests": 1100, "errors": 10}, ts=t0)
+    # A respawned near zero while B shed hard: fleet sums go 1150/60
+    e.sample({"requests": 1150, "errors": 60}, ts=t0 + 10)
+    w = e.evaluate(now=t0 + 10)["windows"]["5m"]
+    assert w["bad"] <= w["requests"]
+    assert 0.0 <= w["availability"] <= 1.0
+    assert w["availability_burn_rate"] >= 0.0
+
+
+def test_slo_window_score_mean_suppressed_on_inconsistent_moments():
+    """Window score moments that fail the consistency check (a partial
+    reset subtracted a dead replica's sumsq) are suppressed, not served
+    as garbage."""
+    e = SloEngine()
+    t0 = 11_000_000.0
+    e.sample({"requests": 100, "score_sum": 80.0, "score_sumsq": 70.0,
+              "score_n": 100}, ts=t0)
+    # partial reset: n grew but the dead replica's sumsq vanished
+    e.sample({"requests": 150, "score_sum": 85.0, "score_sumsq": 20.0,
+              "score_n": 150}, ts=t0 + 10)
+    w = e.evaluate(now=t0 + 10)["windows"]["5m"]
+    assert "score_mean" not in w         # dss < 0: suppressed
+    # healthy moments still report
+    e2 = SloEngine()
+    e2.sample({"requests": 10, "score_sum": 5.0, "score_sumsq": 2.6,
+               "score_n": 10}, ts=t0)
+    e2.sample({"requests": 20, "score_sum": 10.0, "score_sumsq": 5.2,
+               "score_n": 20}, ts=t0 + 10)
+    w2 = e2.evaluate(now=t0 + 10)["windows"]["5m"]
+    assert w2["score_mean"] == pytest.approx(0.5)
