@@ -70,6 +70,13 @@ def _load_input(args, trainer):
 def _cmd_train(args) -> int:
     from ..catalog import lookup
 
+    if getattr(args, "profile", None):
+        # --profile DIR is the HIVEMALL_TPU_PROF env var as a flag: the
+        # first fit captures a jax.profiler trace into DIR, routed
+        # through obs.devprof (a `profile` jsonl event + span record the
+        # capture — docs/OBSERVABILITY.md "Training profiling")
+        import os
+        os.environ["HIVEMALL_TPU_PROF"] = args.profile
     cls = lookup(args.algo).resolve()
     trainer = cls(args.options or "")
     if args.load_bundle or args.save_bundle:      # fail fast, not post-train
@@ -405,6 +412,11 @@ def main(argv=None) -> int:
                         "the trainer's -checkpoint_dir before training "
                         "(shard-directory input resumes mid-stream; file "
                         "input restarts its epoch with restored state)")
+    t.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the first fit "
+                        "into DIR (sets HIVEMALL_TPU_PROF; open with "
+                        "tensorboard/xprof — the capture is recorded as "
+                        "a `profile` event in the metrics stream)")
     t.set_defaults(fn=_cmd_train)
 
     pr = sub.add_parser("predict", help="score a LIBSVM file with a model")

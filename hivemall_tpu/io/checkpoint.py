@@ -275,13 +275,13 @@ class CheckpointManager:
         # provider (LearnerBase._register_obs delegates to obs_section),
         # which re-registers on every trainer construction so a new
         # trainer can never inherit a previous trainer's section.
-        from ..obs.registry import registry
+        from ..obs.registry import CHECKPOINT_STUB, registry
         ref = weakref.ref(self)
 
         def _obs() -> dict:
             m = ref()
             return m.obs_section() if m is not None \
-                else {"configured": False}
+                else dict(CHECKPOINT_STUB)
 
         registry.register("checkpoint", _obs)
 

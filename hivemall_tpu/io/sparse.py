@@ -68,16 +68,23 @@ def score_batches(ds: "SparseDataset", batch_size: int, *,
     n = len(ds)
     if n == 0:
         return
+    # shape-bucket telemetry (obs.devprof): first use of a (B, L) bucket
+    # is the moment the scoring kernel compiles for it — recorded so the
+    # devprof section shows how many distinct compiles bucketing allowed
+    from ..obs.devprof import get_devprof
+    devprof = get_devprof()
     bs = int(batch_size)
     L = pow2_len(ds.max_row_len)
     full_end = (n // bs) * bs
     if full_end:
+        devprof.note_bucket("score_batches", bs, L)
         it = ds.batches(bs, shuffle=False, max_len=L, drop_remainder=True)
         for s, b in zip(range(0, full_end, bs), it):
             yield s, b
     if full_end < n:
         tail = n - full_end
         Bt = bucket_size(tail, lo=min(int(min_rows), bs), hi=bs)
+        devprof.note_bucket("score_batches", Bt, L)
         tb = ds.take(np.arange(full_end, n, dtype=np.int64))
         yield full_end, next(tb.batches(Bt, shuffle=False, max_len=L))
 

@@ -39,6 +39,8 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.devprof import instrument_factory as _instrument
+
 from ..utils.options import OptionSpec
 
 __all__ = ["SDAR1D", "SDAR2D", "ChangeFinder", "ChangeFinder2D",
@@ -450,6 +452,7 @@ def _rolling_mean(s, w: int):
     return (cs - shifted[:T]) / cnt
 
 
+@_instrument("changefinder", "run")
 @lru_cache(maxsize=32)
 def _changefinder_jit(r: float, k: int, T1: int, T2: int, d: int):
     import jax
@@ -597,6 +600,7 @@ def _sst_ika_scores(H_p, H_f, r: int, iters: int = 20):
     return jnp.clip(1.0 - jnp.sqrt(jnp.maximum(smax2, 0.0)), 0.0, 1.0)
 
 
+@_instrument("sst", "ika")
 @lru_cache(maxsize=32)
 def _sst_ika_jit(w: int, n: int, m: int, g: int, r: int, Tpad: int):
     """Module-cached jitted ika runner for one (geometry, bucket) — the

@@ -16,6 +16,7 @@ with reshuffling, the NioStatefulSegment analog.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -24,6 +25,7 @@ from ..io.pipeline import PipelineStats
 from ..io.sparse import (MegaBatch, PackedMegaBatch, SparseBatch,
                          SparseDataset, pow2_len, score_batches,
                          split_feature)
+from ..obs.devprof import get_devprof
 from ..obs.trace import get_tracer
 from ..utils.hashing import mhash
 from ..utils.metrics import Meter, get_stream
@@ -208,8 +210,12 @@ def shared_step(trainer, tag: str, builder):
         # distinct configs must not grow compiled-step memory forever
         if len(_STEP_BUILDER_CACHE) >= 256:
             _STEP_BUILDER_CACHE.pop(next(iter(_STEP_BUILDER_CACHE)))
+        t0 = time.perf_counter()
         fn = builder()
         _STEP_BUILDER_CACHE[key] = fn
+        # the generic peer of the lru_cache factories' build telemetry
+        get_devprof().record_build(type(trainer).__name__, tag,
+                                  time.perf_counter() - t0)
     return fn
 
 
@@ -245,6 +251,7 @@ class LearnerBase:
         self._examples = 0
         self._meter = Meter()                 # rolling examples/sec (§6)
         self._tracer = get_tracer()           # span tracing (obs.trace)
+        self._devprof = get_devprof()         # compile/memory/drift (obs)
         self.pipeline_stats = PipelineStats()  # last fit's ingest metrics
         self._mixer = None
         self._ck_manager = None               # fit_stream's autosaver (obs)
@@ -315,7 +322,7 @@ class LearnerBase:
         are non-blocking — avg_loss reads the host-side folded sum only,
         never syncing the device from a scrape thread."""
         import weakref
-        from ..obs.registry import registry
+        from ..obs.registry import CHECKPOINT_STUB, MIX_STUB, registry
         ref = weakref.ref(self)
 
         def pipeline() -> dict:
@@ -334,7 +341,7 @@ class LearnerBase:
         def mix() -> dict:
             t = ref()
             if t is None or t._mixer is None:
-                return {"active": False}
+                return dict(MIX_STUB)     # inactive form mirrors live keys
             c = dict(t._mixer.counters())
             c["active"] = True
             return c
@@ -343,7 +350,7 @@ class LearnerBase:
             t = ref()
             m = getattr(t, "_ck_manager", None) if t is not None else None
             return m.obs_section() if m is not None \
-                else {"configured": False}
+                else dict(CHECKPOINT_STUB)
 
         # every section registers UNCONDITIONALLY, bound to THIS trainer:
         # a trainer without a mixer/autosaver reports inactive rather than
@@ -353,6 +360,12 @@ class LearnerBase:
         registry.register("train", train)
         registry.register("mix", mix)
         registry.register("checkpoint", checkpoint)
+        # a telemetry cadence or live obs surface means someone is
+        # watching: turn on the devprof drift watches (per-dispatch step
+        # drift, memory-leak drift) for this process. Without either the
+        # watches stay off and note_dispatch is one attribute check.
+        if self._telemetry_every or int(self.opts.get("obs_port") or 0):
+            self._devprof.activate()
         if int(self.opts.get("obs_port") or 0):
             from ..obs.http import ensure_server
             ensure_server(int(self.opts.obs_port))
@@ -380,6 +393,11 @@ class LearnerBase:
                                 step=self._t, stages=self._tracer.rollup())
         every = self._telemetry_every
         if every and self._t % every < window:
+            # refresh the device-memory gauges FIRST so the snapshot about
+            # to be emitted carries this boundary's sample (and the
+            # live-bytes stream feeds the mem-drift detector at exactly
+            # the telemetry cadence)
+            self._devprof.sample_memory()
             stream = get_stream()
             if stream.enabled:
                 from ..obs.registry import registry
@@ -398,6 +416,10 @@ class LearnerBase:
                         avg_loss=round(self.cumulative_loss, 6),
                         telemetry=registry.snapshot())
         self._tracer.maybe_export()
+        # one completed fit = compile warmup over: arm the no-retrace
+        # sentinel so a later same-config trainer that re-compiles (the
+        # word2vec disease) flags itself as `retrace` telemetry
+        self._devprof.note_train_done()
 
     def _emit_checkpoint_event(self, path: str, **fields) -> None:
         """The ONE checkpoint-event emitter (epoch bundles here and in
@@ -475,23 +497,17 @@ class LearnerBase:
         # (-checkpoint_dir option, or the env var the pre-option path used)
         ckdir = self.opts.get("checkpoint_dir") \
             or os.environ.get("HIVEMALL_TPU_CHECKPOINT_DIR")
-        # tracing/profiling (SURVEY.md §6): HIVEMALL_TPU_PROFILE=<dir>
-        # captures a jax.profiler trace of the FIRST fit() in the process —
-        # open with tensorboard/xprof; complements the jsonl metrics stream
-        prof_dir = os.environ.get("HIVEMALL_TPU_PROFILE")
-        tracing = bool(prof_dir) and not getattr(LearnerBase, "_profiled",
-                                                 False)
-        if tracing:
-            import jax
-            LearnerBase._profiled = True
-            jax.profiler.start_trace(prof_dir)
+        # tracing/profiling (SURVEY.md §6): HIVEMALL_TPU_PROF=<dir>
+        # captures a jax.profiler trace of the FIRST fit() in the process
+        # — open with tensorboard/xprof. Routed through obs.devprof so
+        # the capture is discoverable (a `profile.capture` span + a
+        # `profile` jsonl event) instead of an invisible side effect.
+        prof_dir = self._devprof.start_profile_once()
         self.pipeline_stats = PipelineStats()   # fresh counters per fit
         try:
             self._fit_epochs(ds, epochs, bs, shuffle, prefetch, ckdir)
         finally:
-            if tracing:
-                import jax
-                jax.profiler.stop_trace()
+            self._devprof.stop_profile(prof_dir)
         # one train_done per completed fit (the columnar peer of close()/
         # fit_stream), carrying the merged registry snapshot; not emitted
         # on the exception path
@@ -804,6 +820,10 @@ class LearnerBase:
         shuffle seed)."""
         import jax
         self.pipeline_stats = PipelineStats()
+        # HIVEMALL_TPU_PROF covers the streaming path too (the long-running
+        # workloads one most wants to profile); the once-per-process latch
+        # makes the repeated fit_stream calls of multi-epoch wrappers safe
+        prof_dir = self._devprof.start_profile_once()
         if resume and self._stream_pos:
             from ..io.replay_segment import skip_batches
             batches = skip_batches(batches, self._stream_pos)
@@ -849,6 +869,7 @@ class LearnerBase:
         finally:
             for c in reversed(closers):
                 c()
+            self._devprof.stop_profile(prof_dir)
         if autosaver is not None:
             # completed stream: make the final state durable too (cadence
             # saves only land on -checkpoint_every boundaries). No save on
@@ -982,8 +1003,10 @@ class LearnerBase:
         # on CPU, dispatch latency on accelerators (async tails land in
         # the next blocking boundary) — the same semantics as the bench's
         # stage decomposition
+        t0 = time.perf_counter()
         with self._tracer.span("dispatch.step"):
             loss_sum = self._train_batch(batch)
+        self._devprof.note_dispatch(time.perf_counter() - t0, 1)
         self._t += 1
         # keep the per-step loss on device: float() here would block the host
         # on every minibatch and stall the dispatch pipeline. The device
@@ -1010,8 +1033,10 @@ class LearnerBase:
         nv_total = mb.n_examples
         if self.mesh is not None:
             mb = self._shard_megabatch(mb)
+        t0 = time.perf_counter()
         with self._tracer.span("dispatch.megastep"):
             losses = self._train_megabatch(mb)      # [K] device array
+        self._devprof.note_dispatch(time.perf_counter() - t0, K)
         self._t += K
         self._loss_pending = self._loss_pending + losses.sum()
         self._examples += nv_total

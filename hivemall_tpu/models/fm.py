@@ -48,7 +48,10 @@ __all__ = ["FMTrainer", "FFMTrainer", "fm_predict", "ffm_predict"]
 from functools import lru_cache as _lru_cache
 from functools import partial as _partial
 
+from ..obs.devprof import instrument_factory as _instrument
 
+
+@_instrument("fm", "step_fused")
 @_lru_cache(maxsize=64)
 def _fm_step_fused_cached(loss_name, opt, eta_scheme, eta0, total_steps,
                           power_t, lambdas, k):
@@ -59,6 +62,7 @@ def _fm_step_fused_cached(loss_name, opt, eta_scheme, eta0, total_steps,
         lambdas, k)
 
 
+@_instrument("fm", "step_minibatch")
 @_lru_cache(maxsize=64)
 def _fm_step_minibatch_cached(loss_name, opt, eta_scheme, eta0, total_steps,
                               power_t, lambdas, k):
@@ -70,6 +74,7 @@ def _fm_step_minibatch_cached(loss_name, opt, eta_scheme, eta0, total_steps,
         lambdas, k)
 
 
+@_instrument("fm", "step")
 @_lru_cache(maxsize=64)
 def _fm_step_cached(loss_name, opt, eta_scheme, eta0, total_steps,
                     power_t, lambdas):
@@ -80,6 +85,7 @@ def _fm_step_cached(loss_name, opt, eta_scheme, eta0, total_steps,
         lambdas)
 
 
+@_instrument("ffm", "step_fused")
 @_lru_cache(maxsize=64)
 def _ffm_step_fused_cached(loss_name, opt, eta_scheme, eta0, total_steps,
                            power_t, lambdas, F, k, fieldmajor, unit_val):
@@ -90,6 +96,7 @@ def _ffm_step_fused_cached(loss_name, opt, eta_scheme, eta0, total_steps,
         lambdas, F, k, fieldmajor=fieldmajor, unit_val=unit_val)
 
 
+@_instrument("ffm", "step")
 @_lru_cache(maxsize=64)
 def _ffm_step_cached(loss_name, opt, eta_scheme, eta0, total_steps,
                      power_t, lambdas):
@@ -100,6 +107,7 @@ def _ffm_step_cached(loss_name, opt, eta_scheme, eta0, total_steps,
         lambdas)
 
 
+@_instrument("ffm", "parts_step")
 @_lru_cache(maxsize=64)
 def _parts_step_cached(loss_name, eta_scheme, eta0, total_steps, power_t,
                        lambdas, F, k, MRF, unit_val, interpret):
@@ -111,22 +119,26 @@ def _parts_step_cached(loss_name, eta_scheme, eta0, total_steps, power_t,
                            interpret=interpret)
 
 
+@_instrument("ffm", "parts_score")
 @_lru_cache(maxsize=64)
 def _parts_score_cached(F, k, MRF):
     from ..ops.fm_pallas import make_parts_score
     return make_parts_score(F, k, MRF)
 
 
+@_instrument("fm", "score_fused")
 @_lru_cache(maxsize=64)
 def _fm_score_fused_cached(k):
     return make_fm_score_fused(k)
 
 
+@_instrument("ffm", "score_fused")
 @_lru_cache(maxsize=64)
 def _ffm_score_fused_cached(F, k):
     return make_ffm_score_fused(F, k)
 
 
+@_instrument("ffm", "score_fieldmajor")
 @_lru_cache(maxsize=64)
 def _ffm_score_fieldmajor_cached(F, k):
     return make_ffm_score_fieldmajor(F, k)
@@ -149,6 +161,7 @@ def _unpack_on_device(buf, nv, B: int, L: int):
     return idx, label, mask
 
 
+@_instrument("ffm", "packed_megastep", shape_args=(1, 2))
 @_lru_cache(maxsize=128)
 def _packed_megawrap_cached(base_step, B: int, L: int):
     """K-step fused dispatch for the PACKED flagship path
@@ -172,9 +185,14 @@ def _packed_megawrap_cached(base_step, B: int, L: int):
             body, (params, opt_state, t0), {"buf": bufs, "nv": nvs})
         return p, s, losses
 
-    return fn
+    # same devprof dispatch boundary as ops.scan.megastep_for: the packed
+    # flagship path must not be the one fused dispatch whose peak-bytes
+    # tracking silently reads zero
+    from ..ops.scan import _profiled_megastep
+    return _profiled_megastep(fn)
 
 
+@_instrument("ffm", "packed_step", shape_args=(1, 2))
 @_lru_cache(maxsize=128)
 def _packed_wrap_cached(base_step, B: int, L: int):
     """Jitted wrapper (cached per (shared base step, batch shape)) that

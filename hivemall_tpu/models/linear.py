@@ -33,10 +33,16 @@ __all__ = ["GeneralClassifier", "GeneralRegressor", "LogressTrainer",
 
 # config-cached step/optimizer builders (round 4 — see models/fm.py: a
 # fresh jitted closure per trainer instance re-traces/compiles for every
-# identical config; these are pure functions of the keyed options)
+# identical config; these are pure functions of the keyed options).
+# instrument_factory records every cache MISS (a fresh closure actually
+# built) into the obs devprof ledger — docs/OBSERVABILITY.md "Training
+# profiling"
 from functools import lru_cache as _lru_cache
 
+from ..obs.devprof import instrument_factory as _instrument
 
+
+@_instrument("linear", "step")
 @_lru_cache(maxsize=128)
 def _linear_step_cached(loss_name, opt_name, eta_scheme, eta0, total_steps,
                         power_t, reg, lam, l1_ratio):
@@ -46,6 +52,7 @@ def _linear_step_cached(loss_name, opt_name, eta_scheme, eta0, total_steps,
                               total_steps, power_t, reg, lam, l1_ratio))
 
 
+@_instrument("linear", "predict")
 @_lru_cache(maxsize=1)
 def _linear_predict_cached():
     return make_linear_predict()

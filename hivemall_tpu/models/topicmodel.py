@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.sparse import pow2_len
+from ..obs.devprof import instrument_factory as _instrument
 from ..utils.hashing import mhash, mhash_batch
 from ..utils.options import OptionSpec
 
@@ -347,6 +348,7 @@ def plsa_predict(words: Sequence[str], model_rows, topics: int,
     return lda_predict(words, model_rows, topics, alpha, iters)
 
 
+@_instrument("lda", "step")
 @lru_cache(maxsize=32)
 def _lda_step_cached(K: int, V: int, alpha: float, eta: float, inner: int,
                      D: float, tau0: float, kappa: float):
@@ -390,6 +392,7 @@ def _lda_step_cached(K: int, V: int, alpha: float, eta: float, inner: int,
     return step
 
 
+@_instrument("plsa", "step")
 @lru_cache(maxsize=32)
 def _plsa_step_cached(K: int, V: int, alpha: float, inner: int,
                       tau0: float, kappa: float):

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.devprof import instrument_factory as _instrument
 from ..utils.options import OptionSpec
 
 __all__ = ["Word2VecTrainer"]
@@ -620,6 +621,7 @@ class Word2VecTrainer:
                                 + 1e-12))
 
 
+@_instrument("word2vec", "pairgen")
 @lru_cache(maxsize=64)
 def _pairgen_cached(Nc: int, win: int, sep_id: int, policy: str, seed: int,
                     wire_name: str):
@@ -676,6 +678,7 @@ def _pairgen_cached(Nc: int, win: int, sep_id: int, policy: str, seed: int,
     return gen
 
 
+@_instrument("word2vec", "chunk_trainer")
 @lru_cache(maxsize=64)
 def _chunk_trainer_cached(W2: int, Bc: int, n_steps: int, neg: int,
                           pair_pacing: bool, seed: int):

@@ -79,9 +79,35 @@ class Registry:
 #: checkpoint; MixServer: mix_server; MetricsStream: metrics_stream;
 #: Tracer: spans). The defaults below guarantee the acceptance sections
 #: exist in every snapshot even before a subsystem comes up.
+#:
+#: Stub contract (hardened after the PR 7/8 key-drift recurrences, pinned
+#: by tests/test_obs.py::test_stub_sections_match_live_providers): every
+#: stub's KEY SET mirrors its live provider's snapshot exactly — gauges a
+#: dashboard keys on never appear/vanish across subsystem lifecycle. The
+#: inactive forms trainers/managers return when their subsystem is down
+#: reuse these same dicts, so the two can never drift apart.
+
+#: MixClient.counters() + the "active" discriminator
+MIX_STUB = {"active": False, "exchanges": 0, "reconnects": 0,
+            "dropped_exchanges": 0, "transport_errors": 0,
+            "breaker_trips": 0, "breaker_state": "closed",
+            "touched_overflow": 0, "alive": False}
+#: CheckpointManager.obs_section()
+CHECKPOINT_STUB = {"configured": False, "dir": None, "every": 0,
+                   "keep": 0, "last_saved_step": None,
+                   "age_seconds": None, "bundles": 0}
+#: SloEngine.obs_section() in its fresh (no samples) state
+SLO_STUB = {"configured": False, "samples": 0, "target_p99_ms": None,
+            "target_availability": None, "drift_latency_events": 0,
+            "drift_score_events": 0}
+#: serve.fleet.ReplicaManager.obs_section()
+FLEET_STUB = {"replicas": 0, "ready": 0, "respawns": 0, "rolls": 0,
+              "roll_failures": 0, "rejected_bundles": 0,
+              "fleet_step": None, "model_steps": {}}
+
 registry = Registry()
-registry.register("mix", lambda: {"active": False})
-registry.register("checkpoint", lambda: {"configured": False})
+registry.register("mix", lambda: dict(MIX_STUB))
+registry.register("checkpoint", lambda: dict(CHECKPOINT_STUB))
 # io.shard_cache overrides this with its live counters on import (the
 # first cache-aware fit); until then the section reports unconfigured
 # zeros so the acceptance surface is shape-stable in every snapshot
@@ -90,13 +116,12 @@ registry.register("ingest_cache", lambda: {
     "rebuilds": 0, "build_failed": 0, "bytes_mmapped": 0,
     "bytes_written": 0, "canonicalizer": "unresolved"})
 # serve.fleet.ReplicaManager overrides this with its live replica/roll
-# counters when a fleet is running in this process; the stub mirrors the
-# live provider's key set (ReplicaManager.obs_section) so the gauges a
-# dashboard keys on never appear/vanish across manager lifecycle
-registry.register("fleet", lambda: {
-    "replicas": 0, "ready": 0, "respawns": 0, "rolls": 0,
-    "roll_failures": 0, "rejected_bundles": 0, "fleet_step": None,
-    "model_steps": {}})
+# counters when a fleet is running in this process
+registry.register("fleet", lambda: dict(FLEET_STUB))
 # obs.slo.SloEngine overrides this with live burn rates when a serve
-# surface configures an SLO; the stub keeps the section shape-stable
-registry.register("slo", lambda: {"configured": False})
+# surface configures an SLO
+registry.register("slo", lambda: dict(SLO_STUB))
+# obs.devprof.DevProf overrides this with live compile/retrace/memory
+# telemetry on first use (any trainer construction)
+from .devprof import devprof_stub  # noqa: E402 — stub needs the dict shape
+registry.register("devprof", devprof_stub)

@@ -141,6 +141,10 @@ def _render(state: _TailState, path: str = "",
     stages = (roll or {}).get("stages") \
         or ((snap or {}).get("spans") if snap else None)
     if stages:
+        # the spans section carries one scalar beside the stage dicts
+        # (`dropped`, the ring-overflow counter) — filter to real stages
+        stages = {n: s for n, s in stages.items() if isinstance(s, dict)}
+    if stages:
         total = sum(s.get("total_s", 0.0) for s in stages.values()) or 1.0
         out.append("stages (latest rollup):")
         width = max(len(n) for n in stages)
@@ -153,6 +157,37 @@ def _render(state: _TailState, path: str = "",
                 f"p50 {_fmt_s(s.get('p50', 0.0)):>9}  "
                 f"p99 {_fmt_s(s.get('p99', 0.0)):>9}  "
                 f"({100.0 * s.get('total_s', 0.0) / total:4.1f}%)")
+        dropped = ((snap or {}).get("spans") or {}).get("dropped")
+        if isinstance(dropped, int) and dropped > 0:
+            out.append(f"  (span ring overflowed: {dropped} spans dropped)")
+
+    dp = (snap or {}).get("devprof") or {}
+    if dp.get("compiles") or dp.get("active"):
+        line = (f"profile: compiles {dp.get('compiles', 0)} "
+                f"({_fmt_s(dp.get('compile_seconds', 0.0))})"
+                f"  retraces {dp.get('retraces', 0)}")
+        builds = dp.get("builds") or {}
+        if builds:
+            n_builds = sum(b.get("count", 0) for b in builds.values()
+                           if isinstance(b, dict))
+            line += f"  builds {n_builds} ({len(builds)} factories)"
+        if dp.get("shape_buckets"):
+            line += f"  buckets {dp['shape_buckets']}"
+        out.append(line)
+        mem = dp.get("memory") or {}
+        if mem.get("live_bytes") or mem.get("bytes_in_use"):
+            line = (f"memory: live {mem.get('live_bytes', 0) / 1e6:.1f}MB "
+                    f"in {mem.get('live_arrays', 0)} arrays")
+            if mem.get("bytes_in_use"):
+                line += f"  in_use {mem['bytes_in_use'] / 1e6:.1f}MB"
+            if dp.get("peak_dispatch_bytes"):
+                line += (f"  dispatch_peak "
+                         f"{dp['peak_dispatch_bytes'] / 1e6:.1f}MB")
+            out.append(line)
+        drift = dp.get("drift") or {}
+        if drift.get("train_events") or drift.get("mem_events"):
+            out.append(f"drift:  train x{drift.get('train_events', 0)}  "
+                       f"mem x{drift.get('mem_events', 0)}")
 
     if snap:
         mix = snap.get("mix") or {}

@@ -27,14 +27,16 @@ Per window (5 m / 1 h by default):
 
 Drift detection (ROADMAP item 2's "point the changefinder at the
 latency and score streams"): every sample tick feeds the interval's mean
-latency and the fleet's prediction-score mean into two in-tree
-:class:`~hivemall_tpu.models.anomaly.ChangeFinder` instances. A change
-score beyond ``drift_sigma`` standard deviations of the detector's own
-running change-score distribution flags a drift event: counted, kept in
-a bounded recent-events list, and emitted as an ``slo_drift`` record
-into the metrics jsonl stream — the same stream ``hivemall_tpu obs``
-tails, so a latency regression or model-score shift shows up next to
-train/serve telemetry without any external alerting stack.
+latency and the fleet's prediction-score mean into two
+:class:`~hivemall_tpu.obs.devprof.DriftWatch` instances — the shared
+dual-stage in-tree changefinder wrapper the training profiler also uses
+for step-time and memory drift. A score beyond ``drift_sigma`` standard
+deviations of the detector's own running score distribution flags a
+drift event: counted, kept in a bounded recent-events list, and emitted
+as an ``slo_drift`` record into the metrics jsonl stream — the same
+stream ``hivemall_tpu obs`` tails, so a latency regression or
+model-score shift shows up next to train/serve telemetry without any
+external alerting stack.
 """
 
 from __future__ import annotations
@@ -42,7 +44,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 from .histo import quantile_from_buckets
 
@@ -126,22 +128,19 @@ class SloEngine:
         # ring is gap-thinned; evaluation freshness must not be)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        # drift detectors over the per-tick series (in-tree changefinder,
-        # PAPER.md [B]); lazily constructed so importing obs.slo doesn't
-        # pull the anomaly module into every process
-        from ..models.anomaly import ChangeFinder
-        self._cf = {"latency_ms": ChangeFinder(), "score": ChangeFinder()}
-        # Welford stats per (series, stage): BOTH changefinder stages are
-        # watched — the stage-2 change score for gradual drifts, the
-        # stage-1 outlier score for step regressions (a sustained 30x
-        # latency step spikes stage 1 immediately while stage 2's
-        # double-smoothing flattens it); each threshold self-calibrates
-        # to its own score distribution
-        self._cf_stats: Dict[tuple, list] = {
-            (k, s): [0, 0.0, 0.0]       # n, mean, M2
-            for k in self._cf for s in ("outlier", "change")}
+        # drift detectors over the per-tick series: the shared
+        # obs.devprof.DriftWatch (dual-stage in-tree changefinder,
+        # PAPER.md [B] — stage-1 outlier catches step regressions,
+        # stage-2 change catches gradual drifts, Welford-self-calibrated
+        # mu + sigma*std thresholds per score stream). One implementation
+        # for serving latency/score AND training step/memory drift, so a
+        # threshold or clamping fix can never reach one and not the other.
+        from .devprof import DriftWatch
+        self._watch = {k: DriftWatch(k, "slo_drift", sigma=self.drift_sigma,
+                                     warmup=self.drift_warmup)
+                       for k in ("latency_ms", "score")}
         self.drift_events: deque = deque(maxlen=64)
-        self.drift_counts = {k: 0 for k in self._cf}
+        self.drift_counts = {k: 0 for k in self._watch}
         self.samples = 0
         self._register_obs()
 
@@ -243,34 +242,15 @@ class SloEngine:
                 feeds.append(("score",
                               (cur.score_sum - prev.score_sum) / dn))
         for series, x in feeds:
-            outlier, change = self._cf[series].update(x)
-            flagged = None
-            for stage, score in (("outlier", outlier),
-                                 ("change", change)):
-                st = self._cf_stats[(series, stage)]
-                st[0] += 1
-                n = st[0]
-                delta = score - st[1]
-                st[1] += delta / n
-                st[2] += delta * (score - st[1])
-                if n <= self.drift_warmup:
-                    continue
-                std = (st[2] / max(1, n - 1)) ** 0.5
-                if std > 0 and score > st[1] + self.drift_sigma * std:
-                    flagged = flagged or stage
-            if flagged:                   # at most one event per tick
-                ev = {"ts": round(cur.ts, 3), "series": series,
-                      "stage": flagged,
-                      "value": round(float(x), 6),
-                      "outlier_score": round(float(outlier), 4),
-                      "change_score": round(float(change), 4)}
+            # DriftWatch flags at most one event per update (either
+            # stage) and emits the `slo_drift` record into the jsonl
+            # stream itself; the engine keeps its own bounded recent
+            # list + per-series counters for /slo
+            ev = self._watch[series].update(x, ts=round(cur.ts, 3))
+            if ev:
                 with self._lock:          # evaluate() copies the deque
                     self.drift_counts[series] += 1   # from HTTP threads
                     self.drift_events.append(ev)
-                # into the jsonl metrics stream, next to train/serve
-                # telemetry — `hivemall_tpu obs` renders it
-                from ..utils.metrics import get_stream
-                get_stream().emit("slo_drift", **ev)
 
     # -- evaluation ----------------------------------------------------------
     def _window_edge(self, samples: List[_Sample], now: float,
@@ -415,12 +395,12 @@ class SloEngine:
 
     def _register_obs(self) -> None:
         import weakref
-        from .registry import registry
+        from .registry import SLO_STUB, registry
         ref = weakref.ref(self)
 
         def slo() -> dict:
             e = ref()
             return e.obs_section() if e is not None \
-                else {"configured": False}
+                else dict(SLO_STUB)
 
         registry.register("slo", slo)

@@ -156,6 +156,10 @@ class Tracer:
         self._lock = threading.Lock()
         self._stages: Dict[str, _Stage] = {}
         self._events: deque = deque(maxlen=max(1, ring))
+        #: spans evicted from the full ring before export — a silent wrap
+        #: used to make a Chrome export look complete when it wasn't;
+        #: surfaced as ``spans.dropped`` in the registry and /metrics
+        self.dropped = 0
         self._tls = threading.local()
         # paired clocks: spans time with the monotonic perf counter, the
         # export anchors them to the wall clock so independently-recorded
@@ -177,6 +181,7 @@ class Tracer:
         with self._lock:
             self._stages.clear()
             self._events.clear()
+            self.dropped = 0
 
     # -- recording -----------------------------------------------------------
     def span(self, name: str):
@@ -215,6 +220,8 @@ class Tracer:
             st.count += 1
             st.total_s += dur
             st.durs.append(dur)
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1        # ring full: the append below
             self._events.append((name, t0, dur, tid, trace))
 
     # -- reading -------------------------------------------------------------
@@ -295,5 +302,9 @@ def get_tracer() -> Tracer:
             t.export_path = env
         _tracer = t
         from .registry import registry
-        registry.register("spans", t.rollup)
+        # per-stage rollup dicts plus the ring-overflow counter — readers
+        # of the section must tolerate the one int among dict values
+        # (obs.report / obs.smoke skip non-dict entries)
+        registry.register("spans",
+                          lambda: {**t.rollup(), "dropped": t.dropped})
     return _tracer

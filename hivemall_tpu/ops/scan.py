@@ -104,6 +104,26 @@ def make_megastep(core, *, none_val: bool = False):
 _MEGASTEP_CACHE: dict = {}
 
 
+def _profiled_megastep(mega):
+    """Wrap one jitted megastep with the devprof dispatch boundary: after
+    each fused dispatch, track the device allocator's peak-bytes
+    high-water mark (obs.devprof — docs/OBSERVABILITY.md "Training
+    profiling"). One attribute check per dispatch when profiling is
+    inactive; the jitted fn (and its donate_argnums) is untouched."""
+    from functools import wraps
+
+    from ..obs.devprof import get_devprof
+    dp = get_devprof()
+
+    @wraps(mega)
+    def wrapped(*args):
+        out = mega(*args)
+        dp.note_megastep()
+        return out
+
+    return wrapped
+
+
 def megastep_for(step, *, none_val: bool = False):
     """Shared megastep for a (config-cached) trainer step."""
     key = (step, none_val)
@@ -111,6 +131,13 @@ def megastep_for(step, *, none_val: bool = False):
     if fn is None:
         if len(_MEGASTEP_CACHE) >= 128:
             _MEGASTEP_CACHE.pop(next(iter(_MEGASTEP_CACHE)))
-        fn = make_megastep(getattr(step, "core", step), none_val=none_val)
+        import time
+
+        from ..obs.devprof import get_devprof
+        t0 = time.perf_counter()
+        fn = _profiled_megastep(
+            make_megastep(getattr(step, "core", step), none_val=none_val))
         _MEGASTEP_CACHE[key] = fn
+        get_devprof().record_build("scan", "megastep",
+                                   time.perf_counter() - t0)
     return fn

@@ -474,16 +474,13 @@ class ReplicaManager:
 
     def _register_obs(self) -> None:
         import weakref
-        from ..obs.registry import registry
+        from ..obs.registry import FLEET_STUB, registry
         ref = weakref.ref(self)
 
         def fleet() -> dict:
             m = ref()
-            if m is None:              # manager GC'd: same key set as the
-                return {"replicas": 0, "ready": 0, "respawns": 0,
-                        "rolls": 0, "roll_failures": 0,   # registry stub
-                        "rejected_bundles": 0, "fleet_step": None,
-                        "model_steps": {}}
+            if m is None:              # manager GC'd: the shared registry
+                return dict(FLEET_STUB)   # stub, so keys can't drift
             return m.obs_section()
 
         registry.register("fleet", fleet)

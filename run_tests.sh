@@ -70,5 +70,18 @@ fi
 # break bench.py), and the dispatch-fusion microbench enforces its floor —
 # K=8 fused smoke throughput below the K=1 number fails the run (catches
 # accidental defusion of the -steps_per_dispatch path). Same CPU isolation
-# as the tests.
+# as the tests. Two ISSUE-9 guards ride in the same process (no second
+# bench pass):
+#   - no-retrace invariant (docs/OBSERVABILITY.md "Training profiling"):
+#     a warmed FFM e2e epoch must add ZERO post-warmup XLA compiles, and a
+#     deliberately-injected fresh-closure duplicate-config trainer (the
+#     compile factories bypassed) MUST be caught by the devprof sentinel —
+#     retrace counter up + a `retrace` event in the metrics jsonl;
+#   - perf-regression gate: the fresh smoke numbers diff against the
+#     newest committed smoke-shape BENCH_r*.json per benchmark key
+#     (bench.py --compare machinery; HIVEMALL_TPU_BENCH_TOLERANCE
+#     overrides the 70% CI tolerance — the 2-core container's
+#     run-to-run swings reach ~3x, so the always-on gate flags only
+#     the catastrophic class), and the gate self-tests by injecting a
+#     synthetic 10x regression that must flip it.
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python bench.py --smoke

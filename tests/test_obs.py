@@ -162,8 +162,11 @@ def test_new_trainer_resets_mix_and_checkpoint_sections(tmp_path):
         assert snap["checkpoint"]["configured"] is True
         b = GeneralClassifier("-dims 64 -mini_batch 8")   # a stays alive
         snap = registry.snapshot()
-        assert snap["mix"] == {"active": False}
-        assert snap["checkpoint"] == {"configured": False}
+        # the inactive forms are the SHARED registry stubs (full key
+        # mirrors of the live providers, so dashboards keep their keys)
+        from hivemall_tpu.obs.registry import CHECKPOINT_STUB, MIX_STUB
+        assert snap["mix"] == MIX_STUB
+        assert snap["checkpoint"] == CHECKPOINT_STUB
         assert a is not b                                 # keep a referenced
         a._mixer.close_group()
     finally:
@@ -716,3 +719,124 @@ def test_render_slo_report():
     }, source="http://x/slo")
     assert "burn 5x" in text and "80.0ms" in text
     assert "latency x2" in text and "change 9.1" in text
+
+
+# --- stub-vs-live key contract (ISSUE 9 satellite: the drift recurred in
+# PR 7 and PR 8 hardening — now every registered stub is pinned against
+# its live provider's snapshot keys) -----------------------------------------
+
+
+def test_stub_sections_match_live_providers(tmp_path):
+    """Every registry-default stub section's key set must EXACTLY match
+    its live provider's snapshot keys (in the provider's canonical fresh
+    state), for all sections — a dashboard keyed on a gauge must never
+    see it appear/vanish across subsystem lifecycle."""
+    from hivemall_tpu.obs.registry import (CHECKPOINT_STUB, FLEET_STUB,
+                                           MIX_STUB, SLO_STUB)
+
+    # mix: MixClient.counters() + the active discriminator (ctor is lazy,
+    # no connect)
+    from hivemall_tpu.parallel.mix_service import MixClient
+    client = MixClient("127.0.0.1:1", group="stubcheck")
+    live = {"active": True, **client.counters()}
+    assert set(MIX_STUB) == set(live), "mix stub drifted from live keys"
+
+    # checkpoint: CheckpointManager.obs_section()
+    from hivemall_tpu.io.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "ck"), "stubcheck", keep=1,
+                            every=1)
+    assert set(CHECKPOINT_STUB) == set(mgr.obs_section()), \
+        "checkpoint stub drifted from live keys"
+
+    # slo: SloEngine.obs_section() in its fresh (no samples) state
+    from hivemall_tpu.obs.slo import SloEngine
+    eng = SloEngine()
+    assert set(SLO_STUB) == set(eng.obs_section()), \
+        "slo stub drifted from live keys"
+
+    # fleet: ReplicaManager.obs_section() (construction does not spawn)
+    from hivemall_tpu.serve.fleet import ReplicaManager
+    fm = ReplicaManager("train_classifier",
+                        checkpoint_dir=str(tmp_path / "fleet"), replicas=1)
+    assert set(FLEET_STUB) == set(fm.obs_section()), \
+        "fleet stub drifted from live keys"
+
+    # ingest_cache: the shard-cache counters override the registry stub
+    # at import — compare against the stub registered BEFORE that import
+    # by rebuilding its dict from the live as_dict
+    from hivemall_tpu.io.shard_cache import counters as cache_counters
+    stub_keys = {"configured", "hits", "misses", "invalid", "rebuilds",
+                 "build_failed", "bytes_mmapped", "bytes_written",
+                 "canonicalizer"}
+    assert stub_keys == set(cache_counters.as_dict()), \
+        "ingest_cache stub drifted from live keys"
+
+    # devprof: the stub constructor IS the contract
+    from hivemall_tpu.obs.devprof import devprof_stub, get_devprof
+    live_dp = get_devprof().obs_section()
+    assert set(devprof_stub()) == set(live_dp), \
+        "devprof stub drifted from live keys"
+    assert set(devprof_stub()["memory"]) == set(live_dp["memory"])
+    assert set(devprof_stub()["drift"]) == set(live_dp["drift"])
+
+    # trainer-inactive forms reuse the SAME stub dicts (pinned here so a
+    # future inline dict can't drift silently)
+    tr = GeneralClassifier("-dims 64 -mini_batch 8")
+    snap = registry.snapshot()
+    assert snap["mix"] == MIX_STUB
+    assert snap["checkpoint"] == CHECKPOINT_STUB
+    assert tr is not None
+
+
+# --- span-ring overflow accounting (ISSUE 9 satellite) ----------------------
+
+
+def test_span_ring_overflow_counts_dropped():
+    t = Tracer(enabled=True, ring=4)
+    for i in range(10):
+        with t.span(f"s{i % 2}"):
+            pass
+    assert t.dropped == 6                  # 10 recorded into a 4-ring
+    assert len(t.chrome_dict()["traceEvents"]) == 4 + 1   # + metadata
+    t.reset()
+    assert t.dropped == 0
+
+
+def test_spans_dropped_surfaces_in_registry_and_metrics(tracer):
+    with tracer.span("x"):
+        pass
+    snap = registry.snapshot()
+    assert isinstance(snap["spans"]["dropped"], int)
+    text = to_prometheus(snap)
+    assert "hivemall_tpu_spans_dropped" in text
+    # the obs report renders a snapshot whose spans section carries the
+    # scalar beside the stage dicts without tripping over it
+    from hivemall_tpu.obs.report import summarize
+    out = summarize([{"event": "train_done", "ts": 1.0,
+                      "telemetry": snap}])
+    assert "stages" in out
+
+
+# --- histo.quantile_from_buckets edge cases (ISSUE 9 satellite) -------------
+
+
+def test_quantile_from_buckets_edge_cases():
+    from hivemall_tpu.obs.histo import quantile_from_buckets as q
+
+    # empty histogram
+    assert q([], 0.99) == 0.0
+    # zero-total histogram
+    assert q([[0.1, 0], [0.5, 0], ["+Inf", 0]], 0.5) == 0.0
+    # all mass in +Inf: clamps to the largest finite bound
+    assert q([[0.1, 0], [0.5, 0], ["+Inf", 10]], 0.99) == 0.5
+    # single (+Inf-only) bucket: nothing finite to clamp to
+    assert q([["+Inf", 5]], 0.5) == 0.0
+    # single finite bucket: interpolates inside [0, bound]
+    v = q([[0.25, 4], ["+Inf", 4]], 0.5)
+    assert 0.0 < v <= 0.25
+    # zero-width interpolation: the winning bucket is empty (cum ==
+    # prev_cum) — returns the bound instead of dividing by zero
+    assert q([[0.1, 0], [0.2, 5], ["+Inf", 5]], 0.0) == 0.1
+    # monotonicity across the bucket edge
+    assert q([[0.1, 5], [0.2, 10], ["+Inf", 10]], 0.25) <= \
+        q([[0.1, 5], [0.2, 10], ["+Inf", 10]], 0.75)

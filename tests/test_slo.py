@@ -167,7 +167,8 @@ def test_slo_registry_section_and_validation():
     del e                                # weakly held: falls back to stub
     import gc
     gc.collect()
-    assert registry.snapshot()["slo"] == {"configured": False}
+    from hivemall_tpu.obs.registry import SLO_STUB
+    assert registry.snapshot()["slo"] == SLO_STUB
     with pytest.raises(ValueError, match="availability"):
         SloEngine(availability=1.5)
 
@@ -279,11 +280,11 @@ def test_slo_reset_flag_skips_drift_feed():
     for i in range(10):
         e.sample(_totals(10 * (i + 1), 0, [5.0] * 10 * (i + 1)),
                  ts=t0 + i)
-    fed = e._cf_stats[("latency_ms", "outlier")][0]
+    fed = e._watch["latency_ms"].n
     t = _totals(200, 0, [5.0] * 100 + [500.0] * 100)
     t["reset"] = True
     e.sample(t, ts=t0 + 10)
-    assert e._cf_stats[("latency_ms", "outlier")][0] == fed   # skipped
+    assert e._watch["latency_ms"].n == fed                    # skipped
     assert e.evaluate(now=t0 + 10)["windows"]["5m"]["requests"] == 190
 
 
