@@ -79,13 +79,16 @@ def _cmd_train(args) -> int:
         os.environ["HIVEMALL_TPU_PROF"] = args.profile
     cls = lookup(args.algo).resolve()
     trainer = cls(args.options or "")
-    if args.load_bundle or args.save_bundle:      # fail fast, not post-train
+    if args.load_bundle or args.save_bundle \
+            or getattr(args, "promote", False):   # fail fast, not post-train
         # every LearnerBase inherits load_bundle/save_bundle, so hasattr is
-        # vacuous — probe the actual capability (checkpointable state)
+        # vacuous — probe the actual capability (checkpointable state);
+        # --promote gates checkpoint bundles, so it needs the same probe
         try:
             trainer._checkpoint_arrays()
         except (NotImplementedError, AttributeError):
-            flag = "load-bundle" if args.load_bundle else "save-bundle"
+            flag = ("load-bundle" if args.load_bundle
+                    else "save-bundle" if args.save_bundle else "promote")
             print(f"error: {args.algo} does not support checkpoint bundles "
                   f"(--{flag})", file=sys.stderr)
             return 2
@@ -137,6 +140,46 @@ def _cmd_train(args) -> int:
     dt = time.time() - t0
     if args.save_bundle:
         trainer.save_bundle(args.save_bundle)
+    promotion = None
+    if getattr(args, "promote", False):
+        # train → validate → promote in one command: gate the newest
+        # autosaved bundle against the currently-promoted one and flip
+        # the PROMOTED pointer on pass (docs/RELIABILITY.md "Promotion
+        # and rollback"). A failed gate quarantines the candidate; the
+        # training run itself still succeeded (rc 0) — the verdict rides
+        # in the final summary record.
+        ckdir = getattr(trainer, "opts", {}).get("checkpoint_dir") \
+            if hasattr(trainer, "opts") else None
+        if not ckdir:
+            print("error: --promote needs -checkpoint_dir in --options "
+                  "(candidates are gated out of the autosave dir)",
+                  file=sys.stderr)
+            return 2
+        import os
+        holdout = args.holdout or args.input
+        if os.path.isdir(holdout):
+            print("error: --promote needs --holdout <libsvm file> when "
+                  "--input is a shard directory", file=sys.stderr)
+            return 2
+        from ..io.checkpoint import newest_bundle
+        from ..serve.promote import PromotionController, PromotionGate
+        # make sure the FINAL state is a candidate: fit_stream autosaves
+        # land one, but file-input fit() never writes bundles on its own
+        nb = newest_bundle(ckdir, trainer.NAME)
+        if nb is None or nb[0] < int(getattr(trainer, "_t", 0)):
+            os.makedirs(ckdir, exist_ok=True)
+            trainer.save_bundle(os.path.join(
+                ckdir, f"{trainer.NAME}-step{trainer._t:010d}.npz"))
+        gate = PromotionGate(args.algo, args.options or "",
+                             holdout=holdout)
+        # the local reference keeps the controller alive through the
+        # final registry snapshot below — its weakly-held `promotion`
+        # provider would otherwise revert to the stub mid-record
+        controller = PromotionController(ckdir, gate)
+        report = controller.check_once()
+        promotion = report if report is not None else {"candidate": None}
+        print(json.dumps({"promotion": promotion}, default=str),
+              file=sys.stderr)
     if args.model:
         if hasattr(trainer, "save_model"):
             trainer.save_model(args.model)
@@ -151,6 +194,10 @@ def _cmd_train(args) -> int:
                "examples_per_sec": round(n_examples / max(dt, 1e-9), 1)}
     if hasattr(trainer, "cumulative_loss"):
         metrics["cumulative_loss"] = round(trainer.cumulative_loss, 6)
+    if promotion is not None:
+        metrics["promotion"] = {"verdict": promotion.get("verdict"),
+                                "promoted": promotion.get("promoted"),
+                                "bundle": promotion.get("bundle")}
     # the final record IS the obs-registry snapshot (docs/OBSERVABILITY.md):
     # CLI runs and library runs report one schema — the run summary rides
     # in its `run` section next to pipeline/train/mix/checkpoint/spans.
@@ -285,7 +332,8 @@ def _cmd_serve(args) -> int:
             bundle=args.bundle, checkpoint_dir=args.checkpoint_dir,
             max_batch=args.serve_max_batch,
             watch_interval=args.watch_interval,
-            warmup=not args.no_warmup)
+            warmup=not args.no_warmup,
+            follow="promoted" if args.promote else "newest")
     except (FileNotFoundError, ValueError, NotImplementedError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -296,6 +344,20 @@ def _cmd_serve(args) -> int:
         deadline_ms=args.serve_deadline_ms,
         slo_p99_ms=args.slo_p99_ms,
         slo_availability=args.slo_availability).start()
+    ctrl = None
+    if args.promote and args.checkpoint_dir:
+        # single-server promotion: the engine follows the pointer; an
+        # in-process controller gates candidates out of the autosave
+        # dir (shadow-scoring mirrored traffic teed off the batcher)
+        from ..serve.promote import (PromotionController, PromotionGate,
+                                     ShadowBuffer)
+        shadow = ShadowBuffer()
+        srv.batcher.set_tee(shadow.add)
+        gate = PromotionGate(args.algo, args.options or "",
+                             holdout=args.holdout, shadow=shadow)
+        ctrl = PromotionController(args.checkpoint_dir, gate,
+                                   interval=args.watch_interval,
+                                   slo=srv.slo).start()
     print(json.dumps({"host": srv.host, "port": srv.port,
                       "algo": args.algo,
                       "model_step": engine.model_step,
@@ -304,6 +366,8 @@ def _cmd_serve(args) -> int:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        if ctrl is not None:
+            ctrl.stop()
         srv.stop()
     return 0
 
@@ -322,6 +386,10 @@ def _cmd_serve_fleet(args) -> int:
             slo_p99_ms=args.slo_p99_ms,
             slo_availability=args.slo_availability,
             trace_sample=args.trace_sample,
+            promote=args.promote,
+            holdout=args.holdout,
+            canary_fraction=args.canary_fraction,
+            canary_bake_s=args.canary_bake_s,
             serve_kwargs={
                 "max_batch": args.serve_max_batch,
                 "max_delay_ms": args.serve_max_delay_ms,
@@ -351,6 +419,58 @@ def _cmd_serve_fleet(args) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         fleet.stop()
+    return 0
+
+
+def _cmd_promote(args) -> int:
+    """Promotion control plane, one dir at a time (docs/RELIABILITY.md
+    "Promotion and rollback"): gate the newest candidate bundle against
+    the promoted one and flip/quarantine (default), keep watching
+    (--watch), print the pointer manifest (--status), or manually revert
+    to the previous promotion (--rollback)."""
+    from ..io.checkpoint import read_promoted, rollback_promoted
+
+    if args.status:
+        m = read_promoted(args.checkpoint_dir)
+        print(json.dumps({"configured": m is not None, "manifest": m},
+                         default=str))
+        return 0
+    if args.rollback:
+        m = rollback_promoted(args.checkpoint_dir,
+                              args.reason or "manual rollback")
+        if m is None:
+            print("error: no promotion history to roll back to",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps({"rolled_back_to": m["current"],
+                          "rollbacks": m["rollbacks"]}, default=str))
+        return 0
+    from ..serve.promote import PromotionController, PromotionGate
+    gate = PromotionGate(
+        args.algo, args.options or "", holdout=args.holdout,
+        max_logloss_increase=args.max_logloss_increase,
+        max_auc_decrease=args.max_auc_decrease,
+        max_calibration_gap=args.max_calibration_gap)
+    ctrl = PromotionController(
+        args.checkpoint_dir, gate, interval=args.interval,
+        promote_state="canary" if args.canary else "serving")
+    if not args.watch:
+        report = ctrl.check_once()
+        if report is None:
+            print(json.dumps({"candidate": None,
+                              "promoted": read_promoted(
+                                  args.checkpoint_dir) is not None}))
+            return 0
+        print(json.dumps(report, default=str))
+        return 0 if report["verdict"] == "pass" else 1
+    ctrl.start()
+    print(json.dumps({"watching": args.checkpoint_dir,
+                      "interval": args.interval}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        ctrl.stop()
     return 0
 
 
@@ -412,6 +532,14 @@ def main(argv=None) -> int:
                         "the trainer's -checkpoint_dir before training "
                         "(shard-directory input resumes mid-stream; file "
                         "input restarts its epoch with restored state)")
+    t.add_argument("--promote", action="store_true",
+                   help="after training, gate the newest autosaved bundle "
+                        "(-checkpoint_dir) against the promoted one and "
+                        "flip the PROMOTED pointer on pass; a failed gate "
+                        "quarantines it (docs/RELIABILITY.md)")
+    t.add_argument("--holdout", default=None,
+                   help="LIBSVM holdout file for the --promote gate "
+                        "(default: --input when it is a single file)")
     t.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the first fit "
                         "into DIR (sets HIVEMALL_TPU_PROF; open with "
@@ -499,7 +627,61 @@ def main(argv=None) -> int:
                          "router mints an x-hivemall-trace id for when "
                          "HIVEMALL_TPU_TRACE is enabled (client-supplied "
                          "ids are always honored)")
+    sv.add_argument("--promote", action="store_true",
+                    help="gated promotion: serve the PROMOTED pointer "
+                         "instead of the newest bundle; new candidates "
+                         "are gated (holdout + mirrored-traffic shadow "
+                         "scoring) and, in fleet mode, canaried onto "
+                         "--canary-fraction of replicas with auto-"
+                         "rollback (docs/RELIABILITY.md)")
+    sv.add_argument("--holdout", default=None,
+                    help="LIBSVM holdout file the promotion gate scores "
+                         "candidates against (omit to gate on digest + "
+                         "mirrored traffic only)")
+    sv.add_argument("--canary-fraction", type=float, default=0.25,
+                    help="fleet --promote: fraction of replicas a "
+                         "passing candidate bakes on before the full "
+                         "roll (at least 1, at most replicas-1)")
+    sv.add_argument("--canary-bake-s", type=float, default=10.0,
+                    help="fleet --promote: seconds the canary cohort's "
+                         "SLO totals are watched against the stable "
+                         "cohort before completing the roll")
     sv.set_defaults(fn=_cmd_serve)
+
+    pm = sub.add_parser(
+        "promote",
+        help="gate candidate checkpoint bundles and manage the PROMOTED "
+             "pointer (shadow validation, rollback; docs/RELIABILITY.md)")
+    pm.add_argument("--algo", required=True,
+                    help="catalog trainer the bundles were written by")
+    pm.add_argument("--options", default="",
+                    help="trainer options (must match training)")
+    pm.add_argument("--checkpoint-dir", required=True,
+                    help="autosave dir holding candidates + the pointer")
+    pm.add_argument("--holdout", default=None,
+                    help="LIBSVM holdout the gate scores candidates on")
+    pm.add_argument("--watch", action="store_true",
+                    help="keep gating new candidates until Ctrl-C")
+    pm.add_argument("--interval", type=float, default=2.0,
+                    help="--watch poll interval seconds")
+    pm.add_argument("--canary", action="store_true",
+                    help="promote with state=canary so a promote-mode "
+                         "fleet bakes it on a canary cohort first")
+    pm.add_argument("--status", action="store_true",
+                    help="print the PROMOTED pointer manifest and exit")
+    pm.add_argument("--rollback", action="store_true",
+                    help="revert the pointer to the previous promotion")
+    pm.add_argument("--reason", default=None,
+                    help="reason recorded with --rollback")
+    pm.add_argument("--max-logloss-increase", type=float, default=0.05,
+                    help="gate: max absolute holdout logloss increase vs "
+                         "the promoted baseline")
+    pm.add_argument("--max-auc-decrease", type=float, default=0.02,
+                    help="gate: max holdout AUC decrease vs baseline")
+    pm.add_argument("--max-calibration-gap", type=float, default=0.15,
+                    help="gate: max |mean predicted prob - positive "
+                         "rate| on the holdout")
+    pm.set_defaults(fn=_cmd_promote)
 
     o = sub.add_parser(
         "obs", help="summarize a HIVEMALL_TPU_METRICS jsonl stream "

@@ -38,10 +38,21 @@ import numpy as np
 from ..obs.trace import get_tracer
 
 __all__ = ["save_bundle", "load_bundle", "CheckpointManager", "list_bundles",
-           "bundle_step", "newest_bundle", "verify_bundle"]
+           "bundle_step", "newest_bundle", "verify_bundle", "bundle_meta",
+           "read_promoted", "promoted_bundle", "promote_bundle",
+           "finalize_promotion", "rollback_promoted", "reject_bundle",
+           "is_rejected", "rejected_reason", "pinned_bundles"]
 
 _FORMAT = 2          # 2 adds the digest manifest + stream position
 _STEP_RE = re.compile(r"-step(\d+)\.npz$")
+
+#: the promotion pointer file inside a checkpoint dir (docs/RELIABILITY.md
+#: "Promotion and rollback"): serving follows THIS, not the newest step
+_POINTER = "PROMOTED"
+_POINTER_FORMAT = 1
+#: quarantine marker suffix: `<bundle>.rejected` (JSON reason) — a bundle
+#: that failed the promotion gate or was rolled back; watchers never retry
+_REJECTED = ".rejected"
 
 
 def _leaf_digest(arrays: List[np.ndarray]) -> str:
@@ -111,8 +122,12 @@ def _save_bundle(trainer, path: str) -> None:
                 os.remove(tmp)
             except OSError:
                 pass
-    # fsync the directory so the rename itself is durable (best-effort:
-    # not every filesystem supports opening a directory)
+    _fsync_dir(path)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the containing directory so a rename into it is durable
+    (best-effort: not every filesystem supports opening a directory)."""
     try:
         dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
         try:
@@ -121,6 +136,26 @@ def _save_bundle(trainer, path: str) -> None:
             os.close(dfd)
     except OSError:
         pass
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    """Crash-atomic small-file write (the bundle idiom: tmp → fsync →
+    ``os.replace`` → dir fsync) — a reader always sees either the old
+    record or the new one, never a torn file."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    _fsync_dir(path)
 
 
 def _read_validated(z, path: str, name: Optional[str]):
@@ -244,6 +279,178 @@ def newest_bundle(checkpoint_dir: str, name: str
     return None if step is None else (step, paths[0])
 
 
+# ---------------------------------------------------------------------------
+# promotion protocol (docs/RELIABILITY.md "Promotion and rollback")
+#
+# Candidates keep landing in the autosave dir exactly as before, but a
+# gated serving surface follows the atomically-updated `PROMOTED` pointer
+# instead of "newest step wins". The pointer manifest records WHAT is
+# promoted (bundle name, step, leaf digest, the gate report that admitted
+# it) and the promotion history — the head of which is the rollback
+# target. State "canary" marks a promotion still baking on a canary
+# cohort; a fleet manager restarted mid-canary or mid-rollback recovers a
+# consistent fleet from this one file.
+# ---------------------------------------------------------------------------
+
+def bundle_meta(path: str) -> dict:
+    """A bundle's metadata record (step, trainer, leaf digest, ...)
+    WITHOUT reading or validating the leaf arrays — cheap enough to call
+    while building a pointer entry for a bundle the gate just
+    digest-validated via a full load."""
+    with np.load(path, allow_pickle=False) as z:
+        return json.loads(str(z["__meta__"]))
+
+
+def _pointer_path(checkpoint_dir: str) -> str:
+    return os.path.join(checkpoint_dir, _POINTER)
+
+
+def read_promoted(checkpoint_dir: str) -> Optional[dict]:
+    """The `PROMOTED` pointer manifest, or None when the directory has no
+    (readable) pointer. Writes are atomic, so a well-formed file that
+    fails to parse means external corruption — treated as "no pointer"
+    (serving degrades to its fallback) rather than an exception on every
+    poll tick."""
+    try:
+        with open(_pointer_path(checkpoint_dir)) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(m, dict) or not isinstance(m.get("current"), dict):
+        return None
+    return m
+
+
+def promoted_bundle(checkpoint_dir: str,
+                    name: Optional[str] = None) -> Optional[Tuple[int, str]]:
+    """The currently-promoted bundle as ``(step, path)`` — the pointer-
+    following analog of :func:`newest_bundle`. None when there is no
+    pointer, the pointed-at file is gone, or (with ``name``) the entry
+    was written for a different trainer."""
+    m = read_promoted(checkpoint_dir)
+    if m is None:
+        return None
+    cur = m["current"]
+    if name is not None and cur.get("trainer") not in (None, name):
+        return None
+    path = os.path.join(checkpoint_dir, str(cur.get("bundle")))
+    if not os.path.exists(path):
+        return None
+    return int(cur.get("step") or 0), path
+
+
+def promote_bundle(checkpoint_dir: str, path: str, *,
+                   gate: Optional[dict] = None,
+                   state: str = "serving",
+                   keep_history: int = 8) -> dict:
+    """Flip the `PROMOTED` pointer to ``path`` atomically. The previous
+    current entry is pushed onto the history head (= the rollback
+    target). ``state="canary"`` marks the promotion as still baking —
+    :func:`finalize_promotion` flips it to "serving" once the canary
+    cohort passes. Returns the new manifest."""
+    if state not in ("serving", "canary"):
+        raise ValueError(f"unknown promotion state {state!r}")
+    meta = bundle_meta(path)
+    step = meta.get("t")
+    entry = {
+        "bundle": os.path.basename(path),
+        "step": int(step if step is not None
+                    else (bundle_step(path) or 0)),
+        "digest": meta.get("digest"),
+        "trainer": meta.get("trainer"),
+        "promoted_at": round(time.time(), 3),
+    }
+    if gate is not None:
+        entry["gate"] = gate
+    m = read_promoted(checkpoint_dir) or {}
+    hist = list(m.get("history") or [])
+    if isinstance(m.get("current"), dict):
+        hist.insert(0, m["current"])
+    m.update({
+        "format": _POINTER_FORMAT,
+        "current": entry,
+        "state": state,
+        "history": hist[:max(0, int(keep_history))],
+        "rollbacks": int(m.get("rollbacks") or 0),
+    })
+    _atomic_write_json(_pointer_path(checkpoint_dir), m)
+    return m
+
+
+def finalize_promotion(checkpoint_dir: str) -> Optional[dict]:
+    """Mark the current promotion as fully rolled out (state "canary" →
+    "serving"). No-op (returns the manifest unchanged) when already
+    serving; None when there is no pointer."""
+    m = read_promoted(checkpoint_dir)
+    if m is None:
+        return None
+    if m.get("state") != "serving":
+        m["state"] = "serving"
+        _atomic_write_json(_pointer_path(checkpoint_dir), m)
+    return m
+
+
+def rollback_promoted(checkpoint_dir: str, reason: str = "") -> Optional[dict]:
+    """Revert the pointer to the previous promotion (the history head).
+    The reverted-from entry is recorded under ``last_rollback`` (with the
+    reason) rather than back onto the history — a rollback target must
+    never be a bundle that was just rolled back. Returns the new manifest,
+    or None when there is no pointer or no history to roll back to."""
+    m = read_promoted(checkpoint_dir)
+    if m is None or not m.get("history"):
+        return None
+    hist = list(m["history"])
+    bad = m.get("current")
+    m["current"] = hist.pop(0)
+    m["history"] = hist
+    m["state"] = "serving"
+    m["rollbacks"] = int(m.get("rollbacks") or 0) + 1
+    m["last_rollback"] = {"from": bad, "reason": str(reason),
+                          "ts": round(time.time(), 3)}
+    _atomic_write_json(_pointer_path(checkpoint_dir), m)
+    return m
+
+
+def reject_bundle(path: str, reason: str = "") -> str:
+    """Quarantine a bundle: write a ``<bundle>.rejected`` marker (JSON
+    reason + ts) next to it. Gate watchers and the serve engine's
+    newest-bundle scan skip marked bundles permanently — a candidate that
+    failed the gate (or was auto-rolled-back) is never retried. Returns
+    the marker path."""
+    marker = path + _REJECTED
+    _atomic_write_json(marker, {"reason": str(reason),
+                                "ts": round(time.time(), 3)})
+    return marker
+
+
+def is_rejected(path: str) -> bool:
+    return os.path.exists(path + _REJECTED)
+
+
+def rejected_reason(path: str) -> Optional[str]:
+    """The quarantine reason recorded for ``path``, or None."""
+    try:
+        with open(path + _REJECTED) as f:
+            return str(json.load(f).get("reason"))
+    except (OSError, ValueError):
+        return None
+
+
+def pinned_bundles(checkpoint_dir: str) -> set:
+    """Bundle paths retention must NEVER delete: the currently-promoted
+    bundle and the rollback target (history head). Everything else ages
+    out of the last-k window normally."""
+    m = read_promoted(checkpoint_dir)
+    if m is None:
+        return set()
+    pinned = set()
+    entries = [m.get("current")] + list(m.get("history") or [])[:1]
+    for e in entries:
+        if isinstance(e, dict) and e.get("bundle"):
+            pinned.add(os.path.join(checkpoint_dir, str(e["bundle"])))
+    return pinned
+
+
 class CheckpointManager:
     """Autosave cadence + last-k retention over atomic ``save_bundle``.
 
@@ -335,10 +542,23 @@ class CheckpointManager:
     def _prune(self) -> None:
         paths = list_bundles(self.dir, self.name)
         kept = len(paths)
+        # pointer-pinned bundles are EXEMPT from last-k retention: pruning
+        # the currently-promoted bundle would take the serving model's
+        # file out from under the fleet, and pruning the rollback target
+        # would make auto-rollback impossible exactly when a bad canary
+        # needs it (docs/RELIABILITY.md "Promotion and rollback")
+        pinned = pinned_bundles(self.dir)
         for path in paths[self.keep:]:
+            if path in pinned:
+                continue
             try:
                 os.remove(path)
                 kept -= 1
             except OSError:
-                pass
+                continue
+            if os.path.exists(path + _REJECTED):
+                try:                    # quarantine marker dies with its
+                    os.remove(path + _REJECTED)   # bundle, never orphaned
+                except OSError:
+                    pass
         self._bundles = kept

@@ -99,11 +99,22 @@ CHECKPOINT_STUB = {"configured": False, "dir": None, "every": 0,
 #: SloEngine.obs_section() in its fresh (no samples) state
 SLO_STUB = {"configured": False, "samples": 0, "target_p99_ms": None,
             "target_availability": None, "drift_latency_events": 0,
-            "drift_score_events": 0}
+            "drift_score_events": 0, "retrain_wanted": 0}
 #: serve.fleet.ReplicaManager.obs_section()
 FLEET_STUB = {"replicas": 0, "ready": 0, "respawns": 0, "rolls": 0,
               "roll_failures": 0, "rejected_bundles": 0,
               "fleet_step": None, "model_steps": {}}
+#: serve.promote.PromotionController.obs_section() /
+#: serve.fleet.ReplicaManager.promotion_section() in their inactive form
+#: (copy via serve.promote.promotion_stub — the nested canary dict must
+#: not be shared mutable state)
+PROMOTION_STUB = {"configured": False, "promoted_step": None,
+                  "state": None, "candidates": 0, "gate_passes": 0,
+                  "gate_failures": 0, "promotions": 0, "rollbacks": 0,
+                  "quarantined": 0,
+                  "canary": {"active": False, "step": None, "cohort": 0,
+                             "age_seconds": None},
+                  "last_verdict": None, "retrain_wanted": 0}
 
 registry = Registry()
 registry.register("mix", lambda: dict(MIX_STUB))
@@ -121,6 +132,11 @@ registry.register("fleet", lambda: dict(FLEET_STUB))
 # obs.slo.SloEngine overrides this with live burn rates when a serve
 # surface configures an SLO
 registry.register("slo", lambda: dict(SLO_STUB))
+# serve.promote.PromotionController / serve.fleet.ReplicaManager override
+# this with live gate/canary/rollback state when promotion is gated
+registry.register("promotion", lambda: {**PROMOTION_STUB,
+                                        "canary":
+                                        dict(PROMOTION_STUB["canary"])})
 # obs.devprof.DevProf overrides this with live compile/retrace/memory
 # telemetry on first use (any trainer construction)
 from .devprof import devprof_stub  # noqa: E402 — stub needs the dict shape

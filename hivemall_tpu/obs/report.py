@@ -210,6 +210,39 @@ def _render(state: _TailState, path: str = "",
         at = (f"step {ck['step']}" if "step" in ck
               else f"epoch {ck.get('epoch', '?')}")
         out.append(f"ckpt:   last at {at}, {age:.1f}s ago -> {where}")
+
+    # promotion control plane (docs/RELIABILITY.md "Promotion and
+    # rollback"): the registry section when a snapshot carries one, plus
+    # the newest gate/rollback events from the stream itself
+    promo = (snap or {}).get("promotion") or {}
+    has_events = any(state.counts.get(e) for e in
+                     ("promotion", "promotion_gate", "promotion_rollback",
+                      "retrain_wanted"))
+    if promo.get("configured") or has_events:
+        line = (f"promo:  step {promo.get('promoted_step', '?')} "
+                f"[{promo.get('state', '?')}]"
+                f"  gate {promo.get('gate_passes', 0)} pass"
+                f"/{promo.get('gate_failures', 0)} fail"
+                f"  promotions {promo.get('promotions', 0)}"
+                f"  rollbacks {promo.get('rollbacks', 0)}"
+                f"  retrain_wanted {promo.get('retrain_wanted', 0)}")
+        canary = promo.get("canary") or {}
+        if canary.get("active"):
+            line += (f"  [canary step {canary.get('step')} x"
+                     f"{canary.get('cohort')} baking "
+                     f"{canary.get('age_seconds')}s]")
+        out.append(line)
+        g = state.last.get("promotion_gate")
+        if g is not None:
+            line = (f"  gate:  {g.get('verdict', '?')} "
+                    f"{g.get('bundle', '?')} (step {g.get('step')})")
+            if g.get("reasons"):
+                line += f" — {g['reasons'][0]}"
+            out.append(line)
+        rb = state.last.get("promotion_rollback")
+        if rb is not None:
+            out.append(f"  rollback: {rb.get('bundle', '?')} — "
+                       f"{rb.get('reason', '?')}")
     return "\n".join(out)
 
 
@@ -340,7 +373,8 @@ def render_slo(slo: dict, source: str = "") -> str:
         out.append(f"  score: mean {sc.get('mean')}  std {sc.get('std')}")
     dr = slo.get("drift") or {}
     out.append(f"  drift: latency x{dr.get('latency_events', 0)}  "
-               f"score x{dr.get('score_events', 0)}")
+               f"score x{dr.get('score_events', 0)}  "
+               f"retrain_wanted x{dr.get('retrain_wanted', 0)}")
     for ev in (dr.get("recent") or [])[-4:]:
         out.append(f"    [{ev.get('series')}] change "
                    f"{ev.get('change_score')} at value {ev.get('value')} "

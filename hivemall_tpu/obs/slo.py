@@ -141,6 +141,13 @@ class SloEngine:
                        for k in ("latency_ms", "score")}
         self.drift_events: deque = deque(maxlen=64)
         self.drift_counts = {k: 0 for k in self._watch}
+        # drift-driven retrain hook (ROADMAP item 2): every score-stream
+        # drift event is also a `retrain_wanted` vote — the changefinder
+        # watching live prediction scores telling training the serving
+        # model no longer matches the traffic. Counted here, surfaced in
+        # the `slo` AND `promotion` registry sections, emitted into the
+        # metrics jsonl for `hivemall_tpu obs`.
+        self.retrain_wanted = 0
         self.samples = 0
         self._register_obs()
 
@@ -251,6 +258,14 @@ class SloEngine:
                 with self._lock:          # evaluate() copies the deque
                     self.drift_counts[series] += 1   # from HTTP threads
                     self.drift_events.append(ev)
+                if series == "score":
+                    with self._lock:
+                        self.retrain_wanted += 1
+                    from ..utils.metrics import get_stream
+                    get_stream().emit("retrain_wanted", series=series,
+                                      value=ev.get("value"),
+                                      stage=ev.get("stage"),
+                                      ts=ev.get("ts"))
 
     # -- evaluation ----------------------------------------------------------
     def _window_edge(self, samples: List[_Sample], now: float,
@@ -278,6 +293,7 @@ class SloEngine:
             cur = self._last
             drift_recent = list(self.drift_events)[-8:]
             drift_counts = dict(self.drift_counts)
+            retrain_wanted = self.retrain_wanted
         if cur is not None and (not samples or samples[-1] is not cur):
             samples.append(cur)          # freshest raw sample wins
         out: dict = {
@@ -290,6 +306,7 @@ class SloEngine:
             "drift": {
                 "latency_events": drift_counts["latency_ms"],
                 "score_events": drift_counts["score"],
+                "retrain_wanted": retrain_wanted,
                 "recent": drift_recent,
             },
         }
@@ -384,7 +401,8 @@ class SloEngine:
                    "target_p99_ms": self.p99_ms,
                    "target_availability": self.availability,
                    "drift_latency_events": ev["drift"]["latency_events"],
-                   "drift_score_events": ev["drift"]["score_events"]}
+                   "drift_score_events": ev["drift"]["score_events"],
+                   "retrain_wanted": self.retrain_wanted}
         for name, w in ev["windows"].items():
             d[name] = {"qps": w["qps"], "availability": w["availability"],
                        "availability_burn_rate":

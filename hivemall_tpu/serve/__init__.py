@@ -19,17 +19,25 @@ as a live server, in the spirit of Clipper-style prediction serving.
                            fleet /snapshot + /metrics
   fleet.ReplicaManager   — one engine PROCESS per replica/device: spawn,
   fleet.Fleet              health-monitor + respawn, fleet-wide rolling
-                           hot reload (verify once, roll one at a time)
+                           hot reload (verify once, roll one at a time),
+                           gated promotion with canary + auto-rollback
+  promote.PromotionGate  — the train→validate→promote→canary→rollback
+  promote.CanaryBake       control plane (docs/RELIABILITY.md "Promotion
+  promote.Promotion-       and rollback"): shadow validation against the
+          Controller       promoted baseline, the atomic PROMOTED
+                           pointer, canary bake verdicts, quarantine
 
 CLI: ``python -m hivemall_tpu.cli serve --algo ... --checkpoint-dir ...``
-(add ``--replicas N`` for the fleet topology).
+(add ``--replicas N`` for the fleet topology, ``--promote`` for gated
+promotion; ``hivemall_tpu promote`` manages the pointer standalone).
 Imports stay lazy here — ``hivemall_tpu.serve`` must be importable without
 paying for jax/catalog until a server is actually constructed.
 """
 
 __all__ = ["PredictEngine", "MicroBatcher", "PredictServer",
            "ServeOverload", "ServeDeadline", "RouterServer",
-           "ReplicaManager", "Fleet"]
+           "ReplicaManager", "Fleet", "PromotionGate", "CanaryBake",
+           "PromotionController", "ShadowBuffer"]
 
 
 def __getattr__(name):
@@ -48,4 +56,8 @@ def __getattr__(name):
     if name in ("ReplicaManager", "Fleet"):
         from . import fleet
         return getattr(fleet, name)
+    if name in ("PromotionGate", "CanaryBake", "PromotionController",
+                "ShadowBuffer"):
+        from . import promote
+        return getattr(promote, name)
     raise AttributeError(name)

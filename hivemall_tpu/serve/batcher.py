@@ -99,6 +99,11 @@ class MicroBatcher:
         self.score_sum = 0.0
         self.score_sumsq = 0.0
         self.score_n = 0
+        # traffic mirror (serve.promote.ShadowBuffer): called with each
+        # successfully scored batch's rows AFTER the request futures
+        # resolve — a shadow consumer rides the dispatch thread's tail,
+        # never the request path
+        self._tee = None
         self._req_meter = Meter()
         self._row_meter = Meter()
         self._thread = threading.Thread(target=self._run,
@@ -263,6 +268,12 @@ class MicroBatcher:
                              "predict_s": predict_s}
                 r.fut.set_result(part if meta is None else (part, meta))
                 off += r.n
+            tee = self._tee
+            if tee is not None:
+                try:                   # mirror AFTER the futures resolved:
+                    tee(rows)          # zero added request latency
+                except Exception:      # noqa: BLE001 — a shadow consumer
+                    pass               # must never touch the dispatch loop
 
     def _score_individually(self, reqs: List[_Req],
                             t_deq: Optional[float] = None) -> None:
@@ -296,6 +307,13 @@ class MicroBatcher:
             except Exception as e:     # noqa: BLE001 — per-request fate
                 self.errors += 1
                 r.fut.set_exception(e)
+
+    def set_tee(self, fn) -> None:
+        """Install (or clear, with None) a traffic mirror: ``fn(rows)``
+        is called with every successfully scored batch's parsed rows off
+        the dispatch thread's tail — the promotion gate's shadow-scoring
+        input (serve.promote.ShadowBuffer.add)."""
+        self._tee = fn
 
     # -- stats / lifecycle ---------------------------------------------------
     def stats(self) -> dict:

@@ -22,13 +22,25 @@ and scores request rows through SHAPE-BUCKETED padded batches:
   ``(trainer, scorer)`` pair behind one atomic reference — in-flight
   predictions keep the ref they grabbed, so a swap never drops or mixes
   versions mid-batch. A bundle that fails validation is skipped (counted,
-  remembered by mtime so a bad file isn't re-read every poll) and the old
-  model keeps serving. Atomic checkpoint writes + the step-pattern filter
-  mean a live trainer autosaving into the same directory is safe.
+  remembered by (mtime, size) + a cheap head/tail content tag so a bad
+  file isn't re-read every poll but a file REWRITTEN IN PLACE — even
+  with its mtime preserved — is re-examined) and the old model keeps
+  serving. Bundles quarantined with a ``.rejected`` marker (a failed
+  promotion gate, an auto-rollback) are never considered. Atomic
+  checkpoint writes + the step-pattern filter mean a live trainer
+  autosaving into the same directory is safe.
+
+- ``follow="promoted"`` (docs/RELIABILITY.md "Promotion and rollback"):
+  instead of "newest step wins", the engine follows the directory's
+  atomic ``PROMOTED`` pointer — ``poll()`` swaps whenever the pointer
+  names a DIFFERENT bundle than the one serving, including a LOWER step
+  (that is exactly what a rollback is). With no pointer yet (bootstrap,
+  before the first gate pass) it falls back to the newest usable bundle.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
@@ -37,7 +49,8 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..io.checkpoint import bundle_step, list_bundles
+from ..io.checkpoint import (bundle_step, is_rejected, list_bundles,
+                             read_promoted)
 from ..io.sparse import SparseBatch, bucket_size
 from ..obs.trace import get_tracer
 
@@ -72,10 +85,15 @@ class PredictEngine:
                  min_len_bucket: int = 8,
                  watch_interval: float = 2.0,
                  warmup=True,
-                 warmup_len: int = 16):
+                 warmup_len: int = 16,
+                 follow: str = "newest"):
         from ..catalog import lookup
+        if follow not in ("newest", "promoted"):
+            raise ValueError(f"unknown follow mode {follow!r} "
+                             f"(newest or promoted)")
         self.algo = algo
         self.options = options
+        self.follow = follow
         self._cls = lookup(algo).resolve()
         self.max_batch = int(max_batch)
         self.max_row_features = int(max_row_features)
@@ -97,7 +115,13 @@ class PredictEngine:
         self.reloads = 0
         self.reload_failures = 0
         self.last_reload_error: Optional[str] = None
-        self._failed: Dict[str, float] = {}    # bad bundle path -> mtime
+        # known-bad bundle memo: path -> (mtime, size, head/tail sha) —
+        # the identity a skip decision is re-validated against (a file
+        # rewritten in place is re-examined, see _ident_matches)
+        self._failed: Dict[str, tuple] = {}
+        # the pointer identity served under follow="promoted":
+        # (bundle name, digest) — poll() compares, never re-loads blindly
+        self._promoted_key: Optional[tuple] = None
         self._batcher = None
         # initial model: an explicit bundle wins; otherwise the newest
         # usable autosave in the watched directory. The option fallback
@@ -114,7 +138,11 @@ class PredictEngine:
         if bundle:
             self._model = self._load_model(bundle)
         elif ckdir:
-            m = self._load_newest(min_step=-1)
+            m = None
+            if self.follow == "promoted":
+                m = self._load_promoted()
+            if m is None:                # no pointer yet: bootstrap from
+                m = self._load_newest(min_step=-1)   # the newest usable
             if m is None:
                 raise FileNotFoundError(
                     f"no usable {algo} checkpoint bundle in {ckdir!r}")
@@ -180,9 +208,46 @@ class PredictEngine:
         row = trainer._parse_row([])
         return isinstance(row, tuple) and len(row) == 3
 
+    @staticmethod
+    def _content_tag(path: str) -> str:
+        """Cheap content fingerprint — sha256 over the first and last
+        4 KiB. Two 4 KiB reads per KNOWN-BAD bundle per poll (rare, and
+        retention prunes them), vs. hashing whole multi-GB bundles."""
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            h.update(f.read(4096))
+            try:
+                f.seek(-4096, os.SEEK_END)
+            except OSError:
+                f.seek(0)
+            h.update(f.read(4096))
+        return h.hexdigest()
+
+    def _bad_ident(self, path: str) -> Optional[tuple]:
+        try:
+            st = os.stat(path)
+            return (st.st_mtime, st.st_size, self._content_tag(path))
+        except OSError:
+            return None                # pruned between listdir and stat
+
+    def _ident_matches(self, path: str, remembered: tuple) -> bool:
+        """Is ``path`` still the SAME file the failure memo recorded?
+        Keyed by (mtime, size); on a collision — both preserved, e.g. a
+        bundle rewritten in place with its timestamp restored — fall
+        back to the head/tail content tag. A pure-mtime memo silently
+        never re-examined such a rewrite (the regression this fixes)."""
+        try:
+            st = os.stat(path)
+        except OSError:
+            return False
+        if (st.st_mtime, st.st_size) != remembered[:2]:
+            return False
+        return self._content_tag(path) == remembered[2]
+
     def _load_newest(self, min_step: int) -> Optional[_Model]:
-        """Newest loadable bundle with step > min_step, skipping (and
-        remembering) bundles that fail validation."""
+        """Newest loadable bundle with step > min_step, skipping
+        quarantined (``.rejected``) bundles and remembering ones that
+        fail validation."""
         name = self._cls.NAME
         listed = list_bundles(self.checkpoint_dir, name)
         if self._failed:
@@ -196,12 +261,11 @@ class PredictEngine:
             step = bundle_step(path)
             if step is None or step <= min_step:
                 break                  # list is newest-first
-            try:
-                mtime = os.path.getmtime(path)
-            except OSError:
-                continue               # pruned between listdir and stat
-            if self._failed.get(path) == mtime:
-                continue               # known-bad, unchanged since
+            if is_rejected(path):
+                continue               # quarantined: never retried
+            bad = self._failed.get(path)
+            if bad is not None and self._ident_matches(path, bad):
+                continue               # known-bad, content unchanged
             try:
                 return self._load_model(path)
             except Exception as e:     # noqa: BLE001 — a corrupt bundle
@@ -209,8 +273,50 @@ class PredictEngine:
                 # take the server down
                 self.reload_failures += 1
                 self.last_reload_error = f"{path}: {type(e).__name__}: {e}"
-                self._failed[path] = mtime
+                ident = self._bad_ident(path)
+                if ident is not None:
+                    self._failed[path] = ident
         return None
+
+    def _load_promoted(self) -> Optional[_Model]:
+        """The bundle the directory's ``PROMOTED`` pointer says THIS
+        engine should serve, or None when there is no pointer, the
+        pointer is already being served, or the pointed-at bundle fails
+        to load (counted; the old model keeps serving and the next poll
+        retries).
+
+        During state "canary" the pointer's current entry is an UNBAKED
+        candidate — an engine on its own (a fresh boot, a replica the
+        fleet monitor just respawned mid-bake) must serve the prior
+        stable entry (history head) instead: canary membership is an
+        explicit manager-driven /reload, never a side effect of replica
+        churn (a respawned stable replica silently joining the canary
+        cohort would both widen the blast radius and starve the stable
+        cohort the bake compares against)."""
+        m = read_promoted(self.checkpoint_dir)
+        if m is None:
+            return None
+        cur = m["current"]
+        if m.get("state") == "canary" and m.get("history"):
+            cur = m["history"][0]
+        key = (str(cur.get("bundle")), cur.get("digest"))
+        if key == self._promoted_key:
+            return None                # pointer unchanged
+        path = os.path.join(self.checkpoint_dir, key[0])
+        bad = self._failed.get(path)
+        if bad is not None and self._ident_matches(path, bad):
+            return None
+        try:
+            model = self._load_model(path)
+        except Exception as e:         # noqa: BLE001 — same degrade as
+            self.reload_failures += 1  # the newest-bundle scan
+            self.last_reload_error = f"{path}: {type(e).__name__}: {e}"
+            ident = self._bad_ident(path)
+            if ident is not None:
+                self._failed[path] = ident
+            return None
+        self._promoted_key = key
+        return model
 
     # -- hot reload ----------------------------------------------------------
     @property
@@ -244,14 +350,21 @@ class PredictEngine:
         return self._ready.wait(timeout)
 
     def poll(self) -> bool:
-        """Check the watched directory once; swap in the newest usable
-        bundle that is NEWER than the serving model. Returns True when a
-        swap happened. Safe from any thread; in-flight predictions finish
-        on the model version they started with."""
+        """Check the watched directory once; swap to whatever the follow
+        mode says should serve. ``follow="newest"``: the newest usable
+        bundle NEWER than the serving model. ``follow="promoted"``: the
+        bundle the ``PROMOTED`` pointer names, whenever the pointer
+        changed — in EITHER direction (a rollback swaps to a lower
+        step). Returns True when a swap happened. Safe from any thread;
+        in-flight predictions finish on the model version they started
+        with."""
         if not self.checkpoint_dir:
             return False
         with self._reload_lock:
-            m = self._load_newest(min_step=self._model.step)
+            if self.follow == "promoted":
+                m = self._load_promoted()
+            else:
+                m = self._load_newest(min_step=self._model.step)
             if m is None:
                 return False
             self._model = m            # atomic ref swap
@@ -422,6 +535,7 @@ class PredictEngine:
     def obs_section(self) -> dict:
         d = {
             "algo": self.algo,
+            "follow": self.follow,
             "ready": self.ready,
             "model_step": self.model_step,
             "model_age_seconds": self.model_age_seconds,

@@ -28,6 +28,25 @@ Lifecycle, all manager-owned:
   in-replica atomic swap keeps it serving its old model mid-load, and
   sequencing means fleet capacity never drops. A corrupt bundle is
   rejected at the manager: zero replica churn.
+- **gated promotion + canary rollout** (``promote=True`` /
+  ``serve --promote``, docs/RELIABILITY.md "Promotion and rollback"):
+  instead of newest-wins, the fleet follows the checkpoint dir's atomic
+  ``PROMOTED`` pointer. The manager gates each new candidate
+  (serve.promote.PromotionGate: holdout/shadow guardrails), flips the
+  pointer with state "canary" on pass, rolls the candidate onto a
+  ``canary_fraction`` cohort of replicas, and BAKES: each watch tick
+  diffs the canary cohort's SLO totals (error rate, mean latency,
+  score mean — off the same /healthz ``slo`` sections the SLO engine
+  sums) against the stable cohort's (serve.promote.CanaryBake). A
+  clean bake completes the roll and finalizes the pointer; a
+  regression AUTO-ROLLS-BACK — the bundle is quarantined with a
+  ``.rejected`` marker (never retried), the pointer reverts to the
+  prior entry, and the canary cohort reloads the previous model. A
+  manager SIGKILLed mid-canary or mid-rollback recovers a consistent
+  fleet from the pointer manifest alone on restart: state "canary"
+  re-bakes (or completes the rollback when the candidate is already
+  quarantined), state "serving" converges every straggler replica onto
+  the pointer bundle.
 - **graceful stop**: SIGTERM; workers drain their batcher (accepted
   requests complete) before exiting; SIGKILL only after a timeout.
 
@@ -103,7 +122,11 @@ class ReplicaManager:
                  spawn_timeout: float = 180.0,
                  health_interval: float = 0.5,
                  watch_interval: float = 2.0,
-                 slo=None):
+                 slo=None,
+                 gate=None,
+                 promote: bool = False,
+                 canary_fraction: float = 0.25,
+                 bake_opts: Optional[dict] = None):
         if not checkpoint_dir and not bundle:
             raise ValueError("fleet needs checkpoint_dir=... or bundle=...")
         self.algo = algo
@@ -148,6 +171,20 @@ class ReplicaManager:
         # fleet-wide sample — the manager IS the sampler
         self.slo = slo
         self._slo_seen: Dict[str, int] = {}   # rid -> last requests seen
+        # gated promotion (serve.promote): follow the PROMOTED pointer
+        # instead of newest-wins; a gate makes the manager evaluate new
+        # candidates itself, otherwise an external `hivemall_tpu promote`
+        # flips the pointer and this manager only converges/canaries
+        self.promote = bool(promote or gate is not None)
+        self.gate = gate
+        self.canary_fraction = float(canary_fraction)
+        self.bake_opts = dict(bake_opts or {})
+        self._canary: Optional[dict] = None   # {"step","path","bake"}
+        self._bake_inject = None   # test hook: fn(canary_totals)->totals
+        self._last_manifest: Optional[dict] = None   # cached for obs
+        self.promotions = 0
+        self.canary_rollbacks = 0
+        self.quarantined = 0
         self._register_obs()
 
     # -- spawning ------------------------------------------------------------
@@ -155,6 +192,11 @@ class ReplicaManager:
         spec = {"algo": self.algo, "options": self.options,
                 "checkpoint_dir": self.checkpoint_dir,
                 "bundle": self.bundle, "host": "127.0.0.1", "port": 0}
+        if self.promote:
+            # replicas BOOT from the pointer too: a respawn mid-rollback
+            # must come up on the promoted model, not the quarantined
+            # newest step (reload sequencing stays manager-owned)
+            spec["follow"] = "promoted"
         if self.pin_cpus:
             n = os.cpu_count() or 1
             spec["cpu_affinity"] = [slot % n]
@@ -399,11 +441,16 @@ class ReplicaManager:
                 self.last_error = f"watch: {type(e).__name__}: {e}"
 
     def check_and_roll(self) -> bool:
-        """One watch tick: is there a newer verified bundle? Roll it.
-        Returns True when a roll happened."""
+        """One watch tick. Newest-wins mode: is there a newer verified
+        bundle? Roll it. Promote mode: drive the gate → canary → bake →
+        complete/rollback lifecycle off the ``PROMOTED`` pointer instead
+        (:meth:`_promotion_tick`). Returns True when a full fleet roll
+        completed this tick."""
         from ..io.checkpoint import newest_bundle, verify_bundle
         if not self.checkpoint_dir:
             return False
+        if self.promote:
+            return self._promotion_tick()
         nb = newest_bundle(self.checkpoint_dir, self._name)
         if nb is None:
             return False
@@ -424,36 +471,293 @@ class ReplicaManager:
         self.roll(path, step)
         return True
 
+    def _reload_replica(self, r: _Replica, path: str, step: int) -> bool:
+        """One replica /reload to an explicit bundle. The in-replica
+        atomic swap keeps it serving its old model mid-load. Failure is
+        counted and leaves the replica on its old (complete) model."""
+        try:
+            body = json.dumps({"path": path}).encode()
+            req = urllib.request.Request(
+                r.base() + "/reload", body,
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120.0) as resp:
+                out = json.loads(resp.read())
+            if not out.get("reloaded"):
+                raise RuntimeError(
+                    f"replica {r.rid} refused bundle: {out}")
+            r.model_step = out.get("model_step", step)
+            return True
+        except Exception as e:             # noqa: BLE001 — stop the roll,
+            # keep serving: every replica still runs a complete model
+            # (old or new step); the next watch tick retries — by then
+            # the monitor has respawned whatever replica broke it
+            self.roll_failures += 1
+            self.last_error = f"roll {r.rid}: {type(e).__name__}: {e}"
+            return False
+
     def roll(self, path: str, step: int) -> None:
         """Roll one verified bundle across the fleet, one replica at a
-        time. Each replica keeps serving its OLD model while loading (the
-        engine's atomic swap + pre-swap warmup), so rolling is about
-        blast radius — a bundle that loads at the manager's verify but
-        fails in a replica stops the roll at one replica, not N."""
+        time. Sequencing is about blast radius — a bundle that loads at
+        the manager's verify but fails in a replica stops the roll at
+        one replica, not N."""
         for r in self.replicas():
             if self._stop.is_set():
                 return
-            try:
-                body = json.dumps({"path": path}).encode()
-                req = urllib.request.Request(
-                    r.base() + "/reload", body,
-                    {"Content-Type": "application/json"})
-                with urllib.request.urlopen(req, timeout=120.0) as resp:
-                    out = json.loads(resp.read())
-                if not out.get("reloaded"):
-                    raise RuntimeError(
-                        f"replica {r.rid} refused bundle: {out}")
-                r.model_step = out.get("model_step", step)
-            except Exception as e:         # noqa: BLE001 — stop the roll,
-                # keep serving: every replica still runs a complete model
-                # (old or new step). fleet_step stays put, so the next
-                # watch tick retries the roll — by then the monitor has
-                # respawned whatever replica broke it
-                self.roll_failures += 1
-                self.last_error = f"roll {r.rid}: {type(e).__name__}: {e}"
+            if not self._reload_replica(r, path, step):
                 return
         self.fleet_step = step
         self.rolls += 1
+
+    # -- gated promotion: canary rollout + auto-rollback ---------------------
+    def _promotion_tick(self) -> bool:
+        """One promote-mode watch tick, driven ENTIRELY by the pointer
+        manifest + replica steps — which is what makes recovery free: a
+        manager restarted after SIGKILL lands in whichever branch the
+        on-disk state says, with no in-memory carryover needed."""
+        from ..io.checkpoint import is_rejected, read_promoted
+        if self._canary is not None:
+            return self._bake_tick()
+        m = self._last_manifest = read_promoted(self.checkpoint_dir)
+        if m is not None:
+            cur = m["current"]
+            path = os.path.join(self.checkpoint_dir, str(cur["bundle"]))
+            step = int(cur.get("step") or 0)
+            if m.get("state") == "canary":
+                if is_rejected(path):
+                    # a rollback died between the quarantine marker and
+                    # the pointer flip: complete it
+                    return self._finish_rollback(
+                        "recovered: quarantined candidate still "
+                        "pointed at")
+                # mid-canary restart (or an external promote --canary):
+                # (re)start the bake — a fresh window, never a blind
+                # completion of a bake nobody watched
+                self._start_canary(path, step)
+                return False
+            # state "serving": converge stragglers (restart recovery,
+            # an external promote, the tail of a completed rollback)
+            if os.path.exists(path):
+                if self._converge(path, step):
+                    return True
+                if any(r.model_step != step for r in self.replicas()):
+                    # a reload failed mid-converge: finish before gating
+                    # anything new — never canary onto a mixed fleet
+                    return False
+        if self.gate is not None:
+            return self._gate_tick()
+        return False
+
+    def _gate_tick(self) -> bool:
+        """Gate the newest unexamined candidate; on pass flip the pointer
+        and start a canary (or promote outright when there is nothing to
+        compare against); on fail quarantine it."""
+        from ..io.checkpoint import (bundle_step, is_rejected, list_bundles,
+                                     promote_bundle, promoted_bundle,
+                                     reject_bundle)
+        from ..utils.metrics import get_stream
+        from .promote import _gate_summary
+        pb = promoted_bundle(self.checkpoint_dir, self._name)
+        promoted_step = pb[0] if pb else -1
+        cand = None
+        for path in list_bundles(self.checkpoint_dir, self._name):
+            step = bundle_step(path)
+            if step is None or step <= promoted_step:
+                break                     # newest-first list
+            if is_rejected(path):
+                continue
+            cand = (step, path)
+            break
+        if cand is None:
+            return False
+        step, path = cand
+        report = self.gate.evaluate(path, pb[1] if pb else None)
+        if report["verdict"] != "pass":
+            reject_bundle(path, "; ".join(report["reasons"]))
+            self.quarantined += 1
+            return False
+        n = len(self.replicas())
+        if pb is None or n <= 1:
+            # bootstrap (no baseline to canary against) or a one-replica
+            # fleet (the canary WOULD BE the whole fleet): the gate is
+            # the only protection — promote straight to serving
+            self._last_manifest = promote_bundle(
+                self.checkpoint_dir, path, gate=_gate_summary(report),
+                state="serving")
+            get_stream().emit("promotion", bundle=os.path.basename(path),
+                              step=step, state="serving")
+            self.promotions += 1
+            self._converge(path, step)
+            return True
+        self._last_manifest = promote_bundle(
+            self.checkpoint_dir, path, gate=_gate_summary(report),
+            state="canary")
+        get_stream().emit("promotion", bundle=os.path.basename(path),
+                          step=step, state="canary")
+        self._start_canary(path, step)
+        return False
+
+    def _cohorts(self, step: int):
+        """Split replicas by serving step: (canary cohort = on the
+        candidate step, stable cohort = everything else). Membership is
+        derived, not remembered — a canary replica that crashed and
+        respawned from the pointer rejoins its cohort automatically."""
+        canary, stable = [], []
+        for r in self.replicas():
+            (canary if r.model_step == step else stable).append(r)
+        return canary, stable
+
+    def _cohort_totals(self, rs: List[_Replica]) -> dict:
+        """Sum a cohort's cumulative /healthz ``slo`` totals (the
+        CanaryBake input shape)."""
+        agg: dict = {"requests": 0, "errors": 0, "shed": 0, "expired": 0,
+                     "score_sum": 0.0, "score_sumsq": 0.0, "score_n": 0,
+                     "latency": {"sum": 0.0, "count": 0}}
+        for r in rs:
+            t = (r.last_health or {}).get("slo")
+            if not isinstance(t, dict):
+                continue
+            for k in ("requests", "errors", "shed", "expired", "score_n"):
+                agg[k] += int(t.get(k) or 0)
+            for k in ("score_sum", "score_sumsq"):
+                agg[k] += float(t.get(k) or 0.0)
+            lat = t.get("latency") or {}
+            agg["latency"]["sum"] += float(lat.get("sum") or 0.0)
+            agg["latency"]["count"] += int(lat.get("count") or 0)
+        return agg
+
+    def _refresh_cohort_health(self, rs: List[_Replica]) -> None:
+        """Fresh /healthz per cohort member — bake verdicts must compare
+        NOW vs NOW, not whatever the monitor's last tick cached."""
+        for r in rs:
+            h = self._probe(r)
+            if h is not None:
+                r.last_health = h
+
+    def _start_canary(self, path: str, step: int) -> bool:
+        """Roll the candidate onto the canary cohort and open the bake
+        window. Returns True when the bake started (False = a cohort
+        reload failed; the next tick retries from the manifest)."""
+        from .promote import CanaryBake
+        rs = self.replicas()
+        if not rs:
+            return False
+        k = max(1, int(round(self.canary_fraction * len(rs))))
+        if len(rs) > 1:
+            k = min(k, len(rs) - 1)       # keep a stable cohort to
+        need = k - sum(1 for r in rs      # compare against
+                       if r.model_step == step)
+        for r in rs:
+            if need <= 0:
+                break
+            if self._stop.is_set() or r.model_step == step:
+                continue
+            if not self._reload_replica(r, path, step):
+                return False
+            need -= 1
+        canary_rs, stable_rs = self._cohorts(step)
+        self._refresh_cohort_health(canary_rs + stable_rs)
+        bake = CanaryBake(**self.bake_opts)
+        bake.start(self._cohort_totals(canary_rs),
+                   self._cohort_totals(stable_rs))
+        self._canary = {"step": step, "path": path, "bake": bake}
+        return True
+
+    def _bake_tick(self) -> bool:
+        """One bake observation: diff both cohorts' totals since the
+        window opened; complete the roll on pass, auto-rollback on fail."""
+        c = self._canary
+        canary_rs, stable_rs = self._cohorts(c["step"])
+        if not canary_rs:
+            # every canary replica died/reverted: restart from manifest
+            self._canary = None
+            return False
+        self._refresh_cohort_health(canary_rs + stable_rs)
+        ct = self._cohort_totals(canary_rs)
+        if self._bake_inject is not None:   # fault injection (testing/
+            ct = self._bake_inject(ct)      # faults.py): synthetic canary
+        st = self._cohort_totals(stable_rs)  # latency/error regression
+        verdict = c["bake"].update(ct, st)
+        if verdict is None:
+            return False
+        if verdict == "pass":
+            return self._complete_canary()
+        self._rollback(verdict)
+        return False
+
+    def _complete_canary(self) -> bool:
+        """Clean bake: roll the candidate onto the stable cohort and
+        finalize the pointer."""
+        from ..io.checkpoint import finalize_promotion
+        from ..utils.metrics import get_stream
+        c = self._canary
+        for r in self.replicas():
+            if self._stop.is_set():
+                return False
+            if r.model_step == c["step"]:
+                continue
+            if not self._reload_replica(r, c["path"], c["step"]):
+                return False              # _canary stays; next tick retries
+        self._last_manifest = finalize_promotion(self.checkpoint_dir)
+        self.fleet_step = c["step"]
+        self.rolls += 1
+        self.promotions += 1
+        get_stream().emit("promotion", bundle=os.path.basename(c["path"]),
+                          step=c["step"], state="serving")
+        self._canary = None
+        return True
+
+    def _rollback(self, reason: str) -> None:
+        """Failed bake: quarantine the candidate FIRST (a crash between
+        the marker and the pointer flip recovers as a rollback, never as
+        a re-promotion), then revert the pointer and the cohort."""
+        from ..io.checkpoint import reject_bundle
+        c = self._canary
+        reject_bundle(c["path"], reason)
+        self.quarantined += 1
+        self._canary = None
+        self._finish_rollback(reason, bundle=os.path.basename(c["path"]),
+                              step=c["step"])
+
+    def _finish_rollback(self, reason: str, bundle: Optional[str] = None,
+                         step: Optional[int] = None) -> bool:
+        """Revert the pointer to the prior entry and converge every
+        replica still on the quarantined model back onto it."""
+        from ..io.checkpoint import (finalize_promotion, promoted_bundle,
+                                     rollback_promoted)
+        from ..utils.metrics import get_stream
+        m = rollback_promoted(self.checkpoint_dir, reason)
+        if m is None:
+            # nothing older to roll back to (no history) — unreachable
+            # through the normal flow (bootstrap never canaries); keep
+            # serving what we have rather than wedging the watch loop
+            self.last_error = f"rollback with no history: {reason}"
+            self._last_manifest = finalize_promotion(self.checkpoint_dir)
+            return False
+        self._last_manifest = m
+        self.canary_rollbacks += 1
+        get_stream().emit("promotion_rollback", bundle=bundle, step=step,
+                          reason=reason)
+        pb = promoted_bundle(self.checkpoint_dir, self._name)
+        if pb is not None:
+            self._converge(pb[1], pb[0])
+        return True
+
+    def _converge(self, path: str, step: int) -> bool:
+        """Reload every replica NOT serving ``step`` onto ``path`` (one
+        at a time, capacity never drops). Returns True when at least one
+        replica moved and the whole fleet now agrees."""
+        changed = False
+        for r in self.replicas():
+            if self._stop.is_set():
+                return False
+            if r.model_step == step:
+                continue
+            if not self._reload_replica(r, path, step):
+                return False              # next watch tick retries
+            changed = True
+        if self.fleet_step != step:
+            self.fleet_step = step
+        return changed
 
     # -- obs -----------------------------------------------------------------
     def obs_section(self) -> dict:
@@ -472,9 +776,43 @@ class ReplicaManager:
             d["last_error"] = self.last_error
         return d
 
+    def promotion_section(self) -> dict:
+        """The ``promotion`` obs registry section (promote mode): pointer
+        state off the manifest cached by the watch tick (no filesystem
+        access on the scrape path), gate verdict counters, live canary
+        state, rollback count, and the SLO engine's ``retrain_wanted``
+        votes (the changefinder watching the live prediction-score
+        stream asking training for a fresh candidate)."""
+        from .promote import promotion_stub
+        d = promotion_stub()
+        m = self._last_manifest
+        cur = (m or {}).get("current") or {}
+        c = self._canary
+        canary_n = len(self._cohorts(c["step"])[0]) if c else 0
+        baking = c["bake"].started_at if c else None
+        d.update({
+            "configured": True,
+            "promoted_step": cur.get("step"),
+            "state": (m or {}).get("state"),
+            "promotions": self.promotions,
+            "rollbacks": int((m or {}).get("rollbacks") or 0),
+            "quarantined": self.quarantined,
+            "canary": {"active": c is not None,
+                       "step": c["step"] if c else None,
+                       "cohort": canary_n,
+                       "age_seconds": (round(time.time() - baking, 3)
+                                       if baking else None)},
+            "retrain_wanted": int(getattr(self.slo, "retrain_wanted", 0)
+                                  or 0),
+        })
+        if self.gate is not None:
+            d.update(self.gate.counters())
+        return d
+
     def _register_obs(self) -> None:
         import weakref
         from ..obs.registry import FLEET_STUB, registry
+        from .promote import promotion_stub
         ref = weakref.ref(self)
 
         def fleet() -> dict:
@@ -484,6 +822,13 @@ class ReplicaManager:
             return m.obs_section()
 
         registry.register("fleet", fleet)
+        if self.promote:
+            def promotion() -> dict:
+                m = ref()
+                return m.promotion_section() if m is not None \
+                    else promotion_stub()
+
+            registry.register("promotion", promotion)
 
     # -- lifecycle -----------------------------------------------------------
     def stop(self, timeout: float = 15.0) -> None:
@@ -527,7 +872,13 @@ class Fleet:
                  spawn_timeout: float = 180.0,
                  slo_p99_ms: float = 100.0,
                  slo_availability: float = 0.999,
-                 trace_sample: float = 0.01):
+                 trace_sample: float = 0.01,
+                 promote: bool = False,
+                 holdout=None,
+                 gate_opts: Optional[dict] = None,
+                 canary_fraction: float = 0.25,
+                 canary_bake_s: float = 10.0,
+                 bake_opts: Optional[dict] = None):
         from ..obs.slo import SloEngine
         from ..obs.trace import get_tracer
         get_tracer().process_label = "router"   # the merged /trace view
@@ -535,6 +886,13 @@ class Fleet:
         # polls, the router serves it at /slo
         self.slo = SloEngine(p99_ms=slo_p99_ms,
                              availability=slo_availability)
+        gate = None
+        if promote:
+            from .promote import PromotionGate
+            gate = PromotionGate(algo, options, holdout=holdout,
+                                 **(gate_opts or {}))
+        bake = dict(bake_opts or {})
+        bake.setdefault("bake_seconds", canary_bake_s)
         self.router = RouterServer(host=host, port=port, policy=policy,
                                    on_reload_cb=self._on_reload,
                                    trace_sample=trace_sample,
@@ -545,13 +903,33 @@ class Fleet:
             per_replica_env=per_replica_env, serve_kwargs=serve_kwargs,
             pin_cpus=pin_cpus,
             health_interval=health_interval, watch_interval=watch_interval,
-            spawn_timeout=spawn_timeout, slo=self.slo)
+            spawn_timeout=spawn_timeout, slo=self.slo,
+            gate=gate, promote=promote,
+            canary_fraction=canary_fraction, bake_opts=bake)
+        if self.manager.promote:
+            # the router's /promotion admin surface: pointer manifest +
+            # the manager's live section in one payload
+            def _promotion_view() -> dict:
+                from .promote import promotion_manifest_view
+                out = promotion_manifest_view(checkpoint_dir)
+                out["section"] = self.manager.promotion_section()
+                return out
+
+            self.router.promotion_provider = _promotion_view
         self.host = host
         self.port = self.router.port
 
     def _on_reload(self, body: bytes) -> dict:
         obj = json.loads(body or b"{}")
         path = obj.get("path")
+        if path and self.manager.promote:
+            # gated fleet: the PROMOTED pointer is the only way a model
+            # reaches traffic — an explicit-path roll would bypass the
+            # gate and desync from the pointer (the next watch tick
+            # would converge right back)
+            return {"error": "fleet is promotion-gated; flip the pointer "
+                             "with `hivemall_tpu promote` instead of an "
+                             "explicit-path reload"}
         if path:
             # same trust boundary as the single server's /reload: the
             # router is network-reachable and the model directory is the
@@ -635,7 +1013,9 @@ def _worker(spec_json: str) -> int:
         # background: bind + report the port NOW, warm concurrently —
         # the router health-gates on /healthz readiness
         warmup="background",
-        warmup_len=opt("warmup_len", 16, int))
+        warmup_len=opt("warmup_len", 16, int),
+        # promote mode: boot from the PROMOTED pointer, not newest
+        follow=spec.get("follow") or "newest")
     srv = PredictServer(
         engine,
         host=spec.get("host") or "127.0.0.1",

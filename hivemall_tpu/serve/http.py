@@ -19,6 +19,10 @@ exactly what makes concurrent requests coalesce):
   ``{"path": "...npz"}`` to load an explicit bundle).
 - ``GET /slo`` — the SLO engine's windowed burn rates + drift state
   (docs/OBSERVABILITY.md "Serving traces and SLOs").
+- ``GET /promotion`` — the promotion control plane's status: the watched
+  directory's ``PROMOTED`` pointer manifest, the engine's follow mode,
+  and the live ``promotion`` registry section (docs/RELIABILITY.md
+  "Promotion and rollback").
 - ``GET /snapshot`` / ``GET /metrics`` / ``GET /trace`` — the central
   obs registry (the ``serve`` section rides next to
   pipeline/train/mix/checkpoint/spans) and the process span ring,
@@ -221,6 +225,18 @@ class _ServeHandler(_ObsHandler):
                 self._json(404, {"error": "no SLO engine configured"})
                 return
             self._json(200, slo.evaluate())
+            return
+        if path == "/promotion":
+            # promotion status (docs/RELIABILITY.md "Promotion and
+            # rollback"): the watched dir's PROMOTED pointer manifest,
+            # the engine's follow mode, and — when a controller/manager
+            # registered one — the live `promotion` registry section
+            from ..obs.registry import registry
+            from .promote import promotion_manifest_view
+            out = promotion_manifest_view(s.engine.checkpoint_dir)
+            out["follow"] = s.engine.follow
+            out["section"] = registry.snapshot().get("promotion")
+            self._json(200, out)
             return
         super().do_GET()               # /snapshot, /metrics, /trace, 404
 

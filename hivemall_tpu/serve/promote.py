@@ -1,0 +1,661 @@
+"""Gated model promotion — shadow validation, canary rollout, rollback.
+
+ROADMAP item 2's closing move: the train→serve loop's weakest link was
+promotion ("newest step wins" — one diverged learning rate or poisoned
+shard ships straight to 100% of traffic). This module composes the
+pieces PRs 3/7/8/9 built (digest-verified bundles, fleet rolling reload,
+SLO burn rates, the shared changefinder DriftWatch) into a promotion
+control plane (docs/RELIABILITY.md "Promotion and rollback"):
+
+- **pointer, not newest**: candidates land in the autosave dir exactly
+  as before, but gated serving follows the atomic ``PROMOTED`` pointer
+  (io.checkpoint promotion protocol) — flipped only by a passing gate,
+  flipped BACK by auto-rollback.
+- :class:`PromotionGate` shadow-scores each candidate against the
+  currently-promoted bundle on a labeled holdout and/or a mirrored
+  slice of live traffic (:class:`ShadowBuffer`, teed off the
+  micro-batcher dispatch path — never on the request path), and
+  enforces guardrails: logloss/AUC delta bounds, an absolute
+  calibration gap, calibration DRIFT via the shared
+  :class:`~hivemall_tpu.obs.devprof.DriftWatch` changefinder, and
+  score-distribution shift.
+- :class:`CanaryBake` is the pure verdict math of a canary rollout:
+  diff the canary cohort's cumulative SLO totals against the stable
+  cohort's over the bake window; an error-rate, latency or score-mean
+  regression fails the bake (→ the fleet manager auto-rolls-back and
+  quarantines the bundle with a ``.rejected`` marker).
+- :class:`PromotionController` is the single-process watcher (the
+  ``hivemall_tpu promote`` CLI, or a lone PredictServer with
+  ``--promote``): poll the dir for candidates, gate, flip or
+  quarantine. The fleet's ReplicaManager embeds the same gate and adds
+  the canary/rollback lifecycle (serve/fleet.py).
+
+Every verdict is an event in the metrics jsonl (``promotion_gate`` /
+``promotion`` / ``promotion_rollback``) and a counter in the
+``promotion`` obs registry section, so ``hivemall_tpu obs``, /snapshot
+and /metrics all show the same state. The section also surfaces the SLO
+engine's ``retrain_wanted`` count — the in-tree changefinder watching
+the live prediction-score stream voting that the model has drifted and
+training should produce a fresh candidate.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..io.checkpoint import (bundle_step, is_rejected, list_bundles,
+                             promote_bundle, promoted_bundle,
+                             read_promoted, reject_bundle)
+from ..utils.metrics import get_stream
+
+__all__ = ["ShadowBuffer", "PromotionGate", "CanaryBake",
+           "PromotionController", "promotion_stub"]
+
+
+def promotion_stub() -> dict:
+    """A fresh copy of the ``promotion`` registry stub — key-for-key
+    mirror of the live providers (the obs.registry stub contract, pinned
+    by tests/test_obs.py)."""
+    from ..obs.registry import PROMOTION_STUB
+    return {**PROMOTION_STUB, "canary": dict(PROMOTION_STUB["canary"])}
+
+
+class ShadowBuffer:
+    """Bounded mirror of live request rows, teed off the micro-batcher.
+
+    ``MicroBatcher.set_tee(buf.add)`` hands every successfully scored
+    batch's parsed rows here AFTER the request futures resolve — the tee
+    adds zero latency to the request path, and at capacity the buffer
+    ROTATES (oldest rows evicted, eviction counted in ``dropped``) so it
+    always mirrors the newest traffic. The gate drains a snapshot to
+    shadow-score candidate vs promoted on REAL traffic (unlabeled, so
+    the check is score-distribution shift, not loss)."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = int(capacity)
+        self._rows: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.mirrored = 0
+        self.dropped = 0
+
+    def add(self, rows: List[tuple]) -> None:
+        with self._lock:
+            self.mirrored += len(rows)
+            # the deque ROTATES at capacity (oldest rows evicted) so the
+            # mirror always holds the newest traffic — a buffer that
+            # froze on its first fill would shadow-score tonight's
+            # candidate against boot-time traffic forever
+            self.dropped += max(0, len(self._rows) + len(rows)
+                                - self.capacity)
+            self._rows.extend(rows)
+
+    def rows(self, n: Optional[int] = None) -> List[tuple]:
+        """Snapshot (and keep) up to ``n`` mirrored rows, newest-biased."""
+        with self._lock:
+            out = list(self._rows)
+        return out if n is None else out[-int(n):]
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+
+def _score_rows(trainer, rows: List[tuple]) -> np.ndarray:
+    """Output-space scores for parsed request rows through the trainer's
+    OFFLINE path (predict_proba / decision_function — the same kernels
+    the serve engine bit-matches)."""
+    from ..io.sparse import SparseDataset
+    fields = None
+    if rows and isinstance(rows[0], tuple) and len(rows[0]) == 3:
+        fields = [r[2] for r in rows]
+        rows = [(r[0], r[1]) for r in rows]
+    ds = SparseDataset.from_rows(rows, [0.0] * len(rows), fields=fields)
+    return _score_dataset(trainer, ds)
+
+
+def _score_dataset(trainer, ds) -> np.ndarray:
+    classification = getattr(trainer, "classification",
+                             getattr(trainer, "CLASSIFICATION", True))
+    if classification and hasattr(trainer, "predict_proba"):
+        return np.asarray(trainer.predict_proba(ds), np.float64)
+    if not classification and hasattr(trainer, "decision_function"):
+        return np.asarray(trainer.decision_function(ds), np.float64)
+    return np.asarray(trainer.predict(ds), np.float64)
+
+
+class PromotionGate:
+    """Shadow-validate a candidate bundle against the promoted baseline.
+
+    ``evaluate(candidate_path, baseline_path)`` loads both bundles into
+    FRESH trainers (full digest validation — a corrupt candidate fails
+    the gate, never serving), scores the holdout and/or mirrored traffic,
+    and returns a gate report dict::
+
+        {"verdict": "pass"|"fail", "reasons": [...], "checks": {...},
+         "bundle": ..., "step": ..., "ts": ...}
+
+    Guardrails (each opt-out via ``None``/``inf``):
+
+    - ``max_logloss_increase``: candidate holdout logloss may exceed the
+      baseline's by at most this (absolute).
+    - ``max_auc_decrease``: candidate holdout AUC may trail the
+      baseline's by at most this.
+    - ``max_calibration_gap``: |mean predicted probability − positive
+      rate| on the holdout (classification only) — an absolute bound on
+      miscalibration.
+    - calibration DRIFT: the per-candidate calibration gap additionally
+      feeds a shared :class:`~hivemall_tpu.obs.devprof.DriftWatch`
+      (dual-stage in-tree changefinder) — a gap that is individually
+      under the absolute bound but a sharp BREAK from the history of
+      admitted candidates still fails the gate.
+    - ``max_score_shift``: |candidate score mean − baseline score mean|
+      bounded by ``max_score_shift × baseline score std`` (with a small
+      absolute floor), on the holdout and on mirrored live traffic.
+
+    A candidate with no baseline (bootstrap: first promotion) passes on
+    the absolute checks alone. Verdicts are emitted as
+    ``promotion_gate`` events into the metrics jsonl."""
+
+    def __init__(self, algo: str, options: str = "", *,
+                 holdout: Any = None,
+                 shadow: Optional[ShadowBuffer] = None,
+                 max_logloss_increase: Optional[float] = 0.05,
+                 max_auc_decrease: Optional[float] = 0.02,
+                 max_calibration_gap: Optional[float] = 0.15,
+                 max_score_shift: Optional[float] = 4.0,
+                 score_shift_floor: float = 0.05,
+                 min_shadow_rows: int = 32,
+                 drift_sigma: float = 6.0,
+                 drift_warmup: int = 16):
+        from ..catalog import lookup
+        self.algo = algo
+        self.options = options
+        self._cls = lookup(algo).resolve()
+        self._holdout = holdout          # path or SparseDataset (lazy)
+        self._holdout_ds = None
+        self.shadow = shadow
+        self.max_logloss_increase = max_logloss_increase
+        self.max_auc_decrease = max_auc_decrease
+        self.max_calibration_gap = max_calibration_gap
+        self.max_score_shift = max_score_shift
+        self.score_shift_floor = float(score_shift_floor)
+        self.min_shadow_rows = int(min_shadow_rows)
+        # calibration drift across the stream of gated candidates — the
+        # shared dual-stage changefinder wrapper (obs.devprof.DriftWatch,
+        # the same detector behind slo_drift / train_drift / mem_drift)
+        from ..obs.devprof import DriftWatch
+        self.calibration_watch = DriftWatch(
+            "gate_calibration", "promotion_drift",
+            sigma=drift_sigma, warmup=drift_warmup)
+        self.evaluations = 0
+        self.passes = 0
+        self.failures = 0
+        self.last_report: Optional[dict] = None
+
+    # -- inputs --------------------------------------------------------------
+    def _load(self, path: str):
+        t = self._cls(self.options)
+        t.load_bundle(path)              # format/digest/shape validated
+        return t
+
+    def _dataset(self, trainer):
+        if self._holdout is None:
+            return None
+        if self._holdout_ds is None:
+            if isinstance(self._holdout, str):
+                from ..io.libsvm import read_libsvm
+                kw = {}
+                F = getattr(trainer, "F", None)
+                if F is not None and trainer.NAME == "train_ffm":
+                    kw = {"ffm": True, "num_fields": F,
+                          "dims": getattr(trainer, "dims", None)}
+                self._holdout_ds = read_libsvm(self._holdout, **kw)
+            else:
+                self._holdout_ds = self._holdout
+        return self._holdout_ds
+
+    def _calibration_drift(self, gap: float, **extra) -> Optional[dict]:
+        """Feed one candidate's calibration gap into the changefinder;
+        returns the drift event when THIS candidate broke the admitted
+        history's distribution."""
+        return self.calibration_watch.update(float(gap), **extra)
+
+    # -- the gate ------------------------------------------------------------
+    def evaluate(self, candidate_path: str,
+                 baseline_path: Optional[str] = None) -> dict:
+        report: dict = {
+            "bundle": os.path.basename(candidate_path),
+            "step": bundle_step(candidate_path),
+            "baseline": (os.path.basename(baseline_path)
+                         if baseline_path else None),
+            "ts": round(time.time(), 3),
+            "checks": {},
+            "reasons": [],
+        }
+        checks = report["checks"]
+        reasons = report["reasons"]
+        try:
+            cand = self._load(candidate_path)
+            report["step"] = int(getattr(cand, "_t", report["step"] or 0))
+            base = self._load(baseline_path) if baseline_path else None
+            ds = self._dataset(cand)
+            if ds is not None:
+                self._check_holdout(cand, base, ds, checks, reasons)
+            if self.shadow is not None and base is not None:
+                self._check_shadow(cand, base, checks, reasons)
+            if ds is None and self.shadow is None:
+                # no validation input at all: only the load-time digest
+                # check ran — record that the gate was vacuous
+                checks["validated"] = "digest-only"
+            if not reasons and "calibration_gap" in checks:
+                # candidate passed every explicit guardrail: NOW its gap
+                # joins (and is judged against) the admitted history
+                ev = self._calibration_drift(checks["calibration_gap"])
+                if ev is not None:
+                    checks["calibration_drift"] = ev
+                    reasons.append(
+                        f"calibration drift flagged by changefinder "
+                        f"(gap {checks['calibration_gap']:.4f}, "
+                        f"stage {ev.get('stage')})")
+        except Exception as e:           # noqa: BLE001 — a candidate that
+            # cannot even load/score IS the gate's strongest fail signal
+            reasons.append(f"candidate unusable: {type(e).__name__}: {e}")
+        report["verdict"] = "fail" if reasons else "pass"
+        self.evaluations += 1
+        if reasons:
+            self.failures += 1
+        else:
+            self.passes += 1
+        self.last_report = report
+        get_stream().emit("promotion_gate", **report)
+        return report
+
+    def _check_holdout(self, cand, base, ds, checks: dict,
+                       reasons: List[str]) -> None:
+        from ..frame.evaluation import auc, logloss
+        cand_scores = _score_rows_finite(
+            _score_dataset(cand, ds), reasons, "holdout")
+        if cand_scores is None:
+            return
+        classification = getattr(cand, "classification",
+                                 getattr(cand, "CLASSIFICATION", True))
+        base_scores = _score_dataset(base, ds) if base is not None else None
+        if base_scores is not None \
+                and not np.all(np.isfinite(base_scores)):
+            # a NaN-scoring BASELINE would make every delta comparison
+            # vacuously False (NaN > x is False) and pass any candidate
+            # unvalidated — degrade to the absolute-only checks instead,
+            # and say so in the report
+            checks["baseline_nonfinite"] = True
+            base_scores = None
+        if classification:
+            c_ll = float(logloss(ds.labels, cand_scores))
+            c_auc = float(auc(ds.labels, cand_scores))
+            checks["logloss"] = round(c_ll, 6)
+            checks["auc"] = round(c_auc, 6)
+            if base_scores is not None:
+                b_ll = float(logloss(ds.labels, base_scores))
+                b_auc = float(auc(ds.labels, base_scores))
+                checks["baseline_logloss"] = round(b_ll, 6)
+                checks["baseline_auc"] = round(b_auc, 6)
+                if self.max_logloss_increase is not None \
+                        and c_ll > b_ll + self.max_logloss_increase:
+                    reasons.append(
+                        f"holdout logloss regressed {b_ll:.4f} -> "
+                        f"{c_ll:.4f} (> +{self.max_logloss_increase})")
+                if self.max_auc_decrease is not None \
+                        and c_auc < b_auc - self.max_auc_decrease:
+                    reasons.append(
+                        f"holdout AUC regressed {b_auc:.4f} -> "
+                        f"{c_auc:.4f} (> -{self.max_auc_decrease})")
+            # calibration: mean predicted probability vs observed
+            # positive rate — absolute bound + changefinder drift
+            gap = float(abs(cand_scores.mean()
+                            - float((np.asarray(ds.labels) > 0).mean())))
+            checks["calibration_gap"] = round(gap, 6)
+            if self.max_calibration_gap is not None \
+                    and gap > self.max_calibration_gap:
+                reasons.append(
+                    f"calibration gap {gap:.4f} > "
+                    f"{self.max_calibration_gap} (mean prob vs pos rate)")
+            # the changefinder feed happens in evaluate(), AFTER every
+            # other guardrail: the drift baseline must be the history of
+            # ADMITTED candidates — a run of otherwise-rejected
+            # candidates with an anomalous-but-in-bounds gap must not
+            # teach the detector that the anomaly is normal
+        if base_scores is not None:
+            self._score_shift(cand_scores, base_scores, "holdout",
+                              checks, reasons)
+
+    def _check_shadow(self, cand, base, checks: dict,
+                      reasons: List[str]) -> None:
+        rows = self.shadow.rows()
+        checks["shadow_rows"] = len(rows)
+        if len(rows) < self.min_shadow_rows:
+            return                       # not enough mirrored traffic yet
+        cand_scores = _score_rows_finite(
+            _score_rows(cand, rows), reasons, "shadow")
+        if cand_scores is None:
+            return
+        base_scores = _score_rows(base, rows)
+        if not np.all(np.isfinite(base_scores)):
+            checks["shadow_baseline_nonfinite"] = True   # same degrade
+            return                                       # as the holdout
+        self._score_shift(cand_scores, base_scores, "shadow",
+                          checks, reasons)
+
+    def _score_shift(self, cand_scores, base_scores, where: str,
+                     checks: dict, reasons: List[str]) -> None:
+        if self.max_score_shift is None:
+            return
+        shift = float(abs(cand_scores.mean() - base_scores.mean()))
+        bound = max(self.score_shift_floor,
+                    self.max_score_shift * float(base_scores.std()))
+        checks[f"{where}_score_shift"] = round(shift, 6)
+        if shift > bound:
+            reasons.append(
+                f"{where} score distribution shifted: |Δmean| "
+                f"{shift:.4f} > {bound:.4f}")
+
+    # -- obs -----------------------------------------------------------------
+    def counters(self) -> dict:
+        return {"candidates": self.evaluations,
+                "gate_passes": self.passes,
+                "gate_failures": self.failures,
+                "last_verdict": (self.last_report or {}).get("verdict")}
+
+
+def _score_rows_finite(scores: np.ndarray, reasons: List[str],
+                       where: str) -> Optional[np.ndarray]:
+    if not np.all(np.isfinite(scores)):
+        reasons.append(f"{where} scores are not finite "
+                       f"(NaN/Inf in candidate predictions)")
+        return None
+    return scores
+
+
+def _tot(d: Optional[dict]) -> dict:
+    """Normalize one cumulative SLO totals dict (batcher.slo_totals
+    shape) into plain floats the bake math can diff."""
+    d = d or {}
+    lat = d.get("latency") or {}
+    return {
+        "requests": int(d.get("requests") or 0),
+        "bad": (int(d.get("errors") or 0) + int(d.get("shed") or 0)
+                + int(d.get("expired") or 0)),
+        "lat_sum": float(lat.get("sum") or 0.0),
+        "lat_count": int(lat.get("count") or 0),
+        "score_sum": float(d.get("score_sum") or 0.0),
+        "score_sumsq": float(d.get("score_sumsq") or 0.0),
+        "score_n": int(d.get("score_n") or 0),
+    }
+
+
+def _diff(new: dict, old: dict) -> dict:
+    return {k: max(0, new[k] - old[k]) if isinstance(new[k], int)
+            else max(0.0, new[k] - old[k]) for k in new}
+
+
+class CanaryBake:
+    """Pure verdict math of one canary bake window.
+
+    ``start()`` snapshots both cohorts' cumulative SLO totals (the
+    batcher ``slo_totals`` shape the fleet manager already sums off
+    ``/healthz``); each ``update()`` diffs the current totals against the
+    start and compares the canary cohort's interval against the stable
+    cohort's:
+
+    - **bad-fraction**: (errors+shed+expired)/requests — canary may
+      exceed stable by at most ``max_bad_frac_increase``;
+    - **latency**: canary mean request latency may exceed
+      ``max(stable_mean × max_latency_factor, stable_mean +
+      latency_floor_ms)``;
+    - **score mean**: |canary − stable| bounded by ``max_score_shift ×
+      stable_std`` (with ``score_shift_floor`` absolute floor) — the
+      live-traffic version of the gate's distribution check.
+
+    ``update`` returns ``None`` while baking, ``"pass"`` once
+    ``bake_seconds`` elapsed with ≥ ``min_requests`` canary requests and
+    no violation, or a ``"fail: ..."`` reason string the manager turns
+    into an auto-rollback. Verdicts need ``min_requests`` canary
+    requests before a FAIL can fire too — one unlucky request must not
+    roll back a fleet. Timestamps are injected for determinism."""
+
+    def __init__(self, *, bake_seconds: float = 10.0,
+                 min_requests: int = 20,
+                 max_bad_frac_increase: float = 0.05,
+                 max_latency_factor: float = 2.0,
+                 latency_floor_ms: float = 10.0,
+                 max_score_shift: float = 4.0,
+                 score_shift_floor: float = 0.1,
+                 max_bake_seconds: Optional[float] = None):
+        self.bake_seconds = float(bake_seconds)
+        self.min_requests = int(min_requests)
+        self.max_bad_frac_increase = float(max_bad_frac_increase)
+        self.max_latency_factor = float(max_latency_factor)
+        self.latency_floor_ms = float(latency_floor_ms)
+        self.max_score_shift = float(max_score_shift)
+        self.score_shift_floor = float(score_shift_floor)
+        # a canary that never sees min_requests must not bake forever:
+        # after max_bake (default 6x the window) it passes on no-evidence
+        # (an idle fleet has nothing to regress)
+        self.max_bake_seconds = float(max_bake_seconds
+                                      if max_bake_seconds is not None
+                                      else 6.0 * self.bake_seconds)
+        self.resets = 0                  # cohort counter resets observed
+        self._t0: Optional[float] = None
+        self._c0: Optional[dict] = None
+        self._s0: Optional[dict] = None
+
+    def start(self, canary_totals: dict, stable_totals: dict,
+              now: Optional[float] = None) -> None:
+        self._t0 = time.time() if now is None else float(now)
+        self._c0 = _tot(canary_totals)
+        self._s0 = _tot(stable_totals)
+
+    @property
+    def started_at(self) -> Optional[float]:
+        return self._t0
+
+    @staticmethod
+    def _went_backwards(new: dict, old: dict) -> bool:
+        return any(new[k] < old[k]
+                   for k in ("requests", "lat_count", "score_n"))
+
+    def update(self, canary_totals: dict, stable_totals: dict,
+               now: Optional[float] = None) -> Optional[str]:
+        if self._t0 is None:
+            raise RuntimeError("CanaryBake.update before start")
+        now = time.time() if now is None else float(now)
+        ct, st = _tot(canary_totals), _tot(stable_totals)
+        if self._went_backwards(ct, self._c0) \
+                or self._went_backwards(st, self._s0):
+            # a cohort counter went backwards: a replica respawned
+            # (possibly killed BY the candidate) and its cumulative
+            # share vanished. The window's evidence is void — clamping
+            # the diff would read as "idle fleet" and pass on
+            # no-evidence at max_bake. Restart the bake instead.
+            self.resets += 1
+            self.start(canary_totals, stable_totals, now=now)
+            return None
+        c = _diff(ct, self._c0)
+        s = _diff(st, self._s0)
+        if c["requests"] >= self.min_requests:
+            verdict = self._violation(c, s)
+            if verdict is not None:
+                return f"fail: {verdict}"
+            if now - self._t0 >= self.bake_seconds:
+                return "pass"
+        elif now - self._t0 >= self.max_bake_seconds:
+            return "pass"                # idle fleet: nothing to judge
+        return None
+
+    def _violation(self, c: dict, s: dict) -> Optional[str]:
+        c_bad = c["bad"] / max(1, c["requests"])
+        s_bad = s["bad"] / max(1, s["requests"])
+        if c_bad > s_bad + self.max_bad_frac_increase:
+            return (f"canary bad-fraction {c_bad:.4f} vs stable "
+                    f"{s_bad:.4f} (> +{self.max_bad_frac_increase})")
+        if c["lat_count"] > 0 and s["lat_count"] > 0:
+            c_ms = c["lat_sum"] / c["lat_count"] * 1000.0
+            s_ms = s["lat_sum"] / s["lat_count"] * 1000.0
+            bound = max(s_ms * self.max_latency_factor,
+                        s_ms + self.latency_floor_ms)
+            if c_ms > bound:
+                return (f"canary mean latency {c_ms:.1f}ms vs stable "
+                        f"{s_ms:.1f}ms (bound {bound:.1f}ms)")
+        if c["score_n"] > 0 and s["score_n"] > 0:
+            c_m = c["score_sum"] / c["score_n"]
+            s_m = s["score_sum"] / s["score_n"]
+            s_var = max(0.0, s["score_sumsq"] / s["score_n"] - s_m * s_m)
+            bound = max(self.score_shift_floor,
+                        self.max_score_shift * s_var ** 0.5)
+            if abs(c_m - s_m) > bound:
+                return (f"canary score mean {c_m:.4f} vs stable "
+                        f"{s_m:.4f} (bound ±{bound:.4f})")
+        return None
+
+
+class PromotionController:
+    """Single-process promotion watcher: gate new candidates in a
+    checkpoint dir, flip the ``PROMOTED`` pointer on pass, quarantine on
+    fail. The ``hivemall_tpu promote`` CLI surface, and the in-process
+    companion of a lone ``serve --promote`` server (the fleet manager
+    embeds the gate itself and adds canary/rollback — serve/fleet.py).
+
+    Registers the ``promotion`` obs registry section (weakly held)."""
+
+    def __init__(self, checkpoint_dir: str, gate: PromotionGate, *,
+                 interval: float = 2.0,
+                 promote_state: str = "serving",
+                 slo=None):
+        self.checkpoint_dir = checkpoint_dir
+        self.gate = gate
+        self.interval = float(interval)
+        self.promote_state = promote_state
+        self.slo = slo                   # SloEngine: retrain_wanted source
+        self._name = gate._cls.NAME
+        self.promotions = 0
+        self.quarantined = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._register_obs()
+
+    # -- one tick ------------------------------------------------------------
+    def next_candidate(self) -> Optional[str]:
+        """The newest unexamined candidate: a step bundle newer than the
+        promoted step, not quarantined, not the promoted bundle itself."""
+        pb = promoted_bundle(self.checkpoint_dir, self._name)
+        promoted_step = pb[0] if pb else -1
+        for path in list_bundles(self.checkpoint_dir, self._name):
+            step = bundle_step(path)
+            if step is None or step <= promoted_step:
+                break                    # newest-first list
+            if is_rejected(path):
+                continue
+            return path
+        return None
+
+    def check_once(self) -> Optional[dict]:
+        """Gate the newest candidate (if any). Returns the gate report
+        (with ``report["promoted"]`` set when the pointer flipped), or
+        None when there was nothing to examine."""
+        cand = self.next_candidate()
+        if cand is None:
+            return None
+        pb = promoted_bundle(self.checkpoint_dir, self._name)
+        report = self.gate.evaluate(cand, pb[1] if pb else None)
+        if report["verdict"] == "pass":
+            promote_bundle(self.checkpoint_dir, cand,
+                           gate=_gate_summary(report),
+                           state=self.promote_state)
+            self.promotions += 1
+            report["promoted"] = True
+            get_stream().emit("promotion", bundle=report["bundle"],
+                              step=report["step"],
+                              state=self.promote_state)
+        else:
+            reject_bundle(cand, "; ".join(report["reasons"]))
+            self.quarantined += 1
+            report["promoted"] = False
+        return report
+
+    # -- watcher -------------------------------------------------------------
+    def start(self) -> "PromotionController":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.check_once()
+                except Exception:        # noqa: BLE001 — the watcher
+                    pass                 # survives; verdicts carry errors
+
+        self._thread = threading.Thread(target=run, name="promote-watch",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- obs -----------------------------------------------------------------
+    def obs_section(self) -> dict:
+        m = read_promoted(self.checkpoint_dir)
+        cur = (m or {}).get("current") or {}
+        d = promotion_stub()
+        d.update(self.gate.counters())
+        d.update({
+            "configured": True,
+            "promoted_step": cur.get("step"),
+            "state": (m or {}).get("state"),
+            "promotions": self.promotions,
+            "rollbacks": int((m or {}).get("rollbacks") or 0),
+            "quarantined": self.quarantined,
+            "retrain_wanted": int(getattr(self.slo, "retrain_wanted", 0)
+                                  or 0),
+        })
+        return d
+
+    def _register_obs(self) -> None:
+        import weakref
+        from ..obs.registry import registry
+        ref = weakref.ref(self)
+
+        def promotion() -> dict:
+            c = ref()
+            return c.obs_section() if c is not None else promotion_stub()
+
+        registry.register("promotion", promotion)
+
+
+def _gate_summary(report: dict) -> dict:
+    """The compact gate record embedded in a pointer entry (the full
+    report went to the metrics stream)."""
+    return {"verdict": report["verdict"],
+            "checks": report.get("checks") or {},
+            "reasons": report.get("reasons") or [],
+            "ts": report.get("ts")}
+
+
+def promotion_manifest_view(checkpoint_dir: Optional[str]) -> dict:
+    """The ``/promotion`` endpoint payload: the raw pointer manifest plus
+    derived convenience fields. Safe on a dir without a pointer."""
+    m = read_promoted(checkpoint_dir) if checkpoint_dir else None
+    out: dict = {"configured": m is not None,
+                 "checkpoint_dir": checkpoint_dir}
+    if m is not None:
+        out["manifest"] = m
+        out["promoted_step"] = (m.get("current") or {}).get("step")
+        out["state"] = m.get("state")
+    return out

@@ -317,6 +317,16 @@ class _RouterHTTP:
                 return _response(200,
                                  json.dumps(r.merged_trace()).encode(),
                                  "application/json", False)
+            if path == b"/promotion":
+                pp = r.promotion_provider
+                if pp is None:
+                    return _response(
+                        404, b'{"error": "no promotion control plane '
+                             b'configured (serve --promote)"}',
+                        "application/json", False)
+                return _response(200, json.dumps(pp(),
+                                                 default=str).encode(),
+                                 "application/json", False)
             if path == b"/healthz":
                 h = r.fleet_health()
                 return _response(200 if h["ready_replicas"] > 0 else 503,
@@ -369,6 +379,9 @@ class RouterServer:
         # deployments pay one attribute check per request, nothing else)
         self.trace_sample = float(trace_sample)
         self.slo = slo                   # SloEngine (wired by Fleet)
+        # /promotion payload provider (wired by a promotion-gated Fleet:
+        # pointer manifest + the manager's live promotion section)
+        self.promotion_provider = None
         self._tracer = get_tracer()
         self._lock = threading.Lock()
         self._handles: Dict[str, ReplicaHandle] = {}

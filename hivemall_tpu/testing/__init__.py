@@ -1,4 +1,4 @@
-"""Reusable fault-injection harness (docs/RELIABILITY.md §3)."""
+"""Reusable fault-injection harness (docs/RELIABILITY.md §4)."""
 
 __all__ = ["FlakyProxy", "CrashingSource", "crash_on_nth"]
 

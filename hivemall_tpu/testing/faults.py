@@ -2,8 +2,8 @@
 
 The reliability spine (resilient MixClient, atomic checkpoint/resume,
 hardened MixServer) is only trustworthy if its failure paths are DRIVEN,
-not assumed. This module provides the three injectors the tests and the
-``run_tests.sh`` smoke use (docs/RELIABILITY.md §3):
+not assumed. This module provides the injectors the tests and the
+``run_tests.sh`` smokes use (docs/RELIABILITY.md §4):
 
 - :class:`FlakyProxy` — a threaded TCP shim between a client and its
   upstream server. A deterministic schedule maps forwarded client→upstream
@@ -17,6 +17,10 @@ not assumed. This module provides the three injectors the tests and the
 - :func:`crash_on_nth` — wraps an :class:`IngestPipeline` prep function;
   the nth call raises. Thread-pool task starts are FIFO, so the nth call
   is the nth submitted item and the failure is deterministic.
+- :func:`inject_canary_regression` — perturbs the canary cohort's SLO
+  totals as a promote-mode fleet manager reads them during a bake: the
+  deterministic latency/error/score regression that drives the
+  auto-rollback path (docs/RELIABILITY.md §3, the promotion smoke).
 
 Run ``python -m hivemall_tpu.testing.faults --smoke`` for the seconds-scale
 proof: a trainer mixes through a proxy that kills and restarts the mix
@@ -35,7 +39,8 @@ import threading
 import time
 from typing import Dict, Iterable, Iterator, Optional, Tuple, Union
 
-__all__ = ["FlakyProxy", "CrashingSource", "crash_on_nth"]
+__all__ = ["FlakyProxy", "CrashingSource", "crash_on_nth",
+           "inject_canary_regression"]
 
 Fault = Union[str, Tuple[str, float]]
 
@@ -254,6 +259,40 @@ def crash_on_nth(fn, n: int, exc: Optional[BaseException] = None):
         return fn(item)
 
     return wrapped
+
+
+def inject_canary_regression(manager, *, latency_ms: float = 0.0,
+                             extra_errors: int = 0,
+                             score_shift: float = 0.0):
+    """Inject a synthetic regression into a fleet manager's CANARY cohort
+    observations (docs/RELIABILITY.md "Promotion and rollback").
+
+    The canary bake compares the canary cohort's SLO totals against the
+    stable cohort's; this perturbs the canary side as the manager reads
+    it — per-request added latency, a constant error count, a
+    per-prediction score offset — so the auto-rollback path can be
+    driven deterministically without actually degrading a replica (a
+    real latency regression would need the scorer itself to slow down).
+    Used by the promotion smoke in run_tests.sh and tests/test_promote.
+    Returns an ``undo()`` callable."""
+    def perturb(t: dict) -> dict:
+        t = dict(t)
+        lat = dict(t.get("latency") or {})
+        n = int(lat.get("count") or 0)
+        lat["sum"] = float(lat.get("sum") or 0.0) \
+            + n * latency_ms / 1000.0
+        t["latency"] = lat
+        t["errors"] = int(t.get("errors") or 0) + int(extra_errors)
+        t["score_sum"] = float(t.get("score_sum") or 0.0) \
+            + int(t.get("score_n") or 0) * score_shift
+        return t
+
+    manager._bake_inject = perturb
+
+    def undo() -> None:
+        manager._bake_inject = None
+
+    return undo
 
 
 # -- seconds-scale smoke (wired into run_tests.sh) ---------------------------

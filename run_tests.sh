@@ -44,6 +44,19 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.serve.fleet_smoke || exit $?
 
+# promotion smoke (docs/RELIABILITY.md "Promotion and rollback"): gated
+# model promotion over a 2-replica fleet under live traffic — a
+# deliberately-poisoned candidate must be BLOCKED at the gate
+# (quarantined with a .rejected marker, fleet untouched); a good
+# candidate must promote through a 1-replica canary bake with zero
+# failed requests; a synthetic latency regression injected into the
+# canary cohort must AUTO-ROLL-BACK (pointer reverted, bundle
+# quarantined, replicas restored) with zero failed requests; and the
+# `promotion` section must be visible on /snapshot, /metrics,
+# /promotion and the `hivemall_tpu obs` render.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m hivemall_tpu.serve.promote_smoke || exit $?
+
 # shard-cache smoke (docs/PERFORMANCE.md "Shard cache"): a cold fit must
 # build the packed cache, a fresh-trainer warm fit must bit-match its loss
 # trajectory with ZERO live prep, and the Parquet decode cache must keep

@@ -771,6 +771,22 @@ def test_stub_sections_match_live_providers(tmp_path):
     assert stub_keys == set(cache_counters.as_dict()), \
         "ingest_cache stub drifted from live keys"
 
+    # promotion: PromotionController.obs_section() (gate never run) and
+    # the fleet manager's promotion_section() must both mirror the stub
+    from hivemall_tpu.serve.promote import (PromotionController,
+                                            PromotionGate, promotion_stub)
+    gate = PromotionGate("train_classifier", "-dims 64")
+    ctrl = PromotionController(str(tmp_path / "promo"), gate)
+    assert set(promotion_stub()) == set(ctrl.obs_section()), \
+        "promotion stub drifted from controller live keys"
+    pm = ReplicaManager("train_classifier",
+                        checkpoint_dir=str(tmp_path / "promo"),
+                        replicas=1, promote=True)
+    assert set(promotion_stub()) == set(pm.promotion_section()), \
+        "promotion stub drifted from fleet manager live keys"
+    assert set(promotion_stub()["canary"]) \
+        == set(pm.promotion_section()["canary"])
+
     # devprof: the stub constructor IS the contract
     from hivemall_tpu.obs.devprof import devprof_stub, get_devprof
     live_dp = get_devprof().obs_section()

@@ -143,6 +143,11 @@ def test_slo_score_drift_detected():
         e.sample(_totals(n, 0, [5.0] * n, score=scores), ts=t0 + i)
     assert e.drift_counts["score"] >= 1
     assert any(ev["series"] == "score" for ev in e.drift_events)
+    # drift-driven retrain hook (ROADMAP item 2): every score drift is a
+    # retrain_wanted vote, surfaced on /slo and the slo registry section
+    assert e.retrain_wanted == e.drift_counts["score"]
+    assert e.evaluate()["drift"]["retrain_wanted"] == e.retrain_wanted
+    assert e.obs_section()["retrain_wanted"] == e.retrain_wanted
 
 
 def test_slo_counter_reset_clamps_never_negative():
