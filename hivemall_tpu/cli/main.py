@@ -118,7 +118,7 @@ def _cmd_train(args) -> int:
                   "-checkpoint_dir; starting fresh", file=sys.stderr)
     ds, streaming = _load_input(args, trainer)
     n_examples = len(ds)
-    t0 = time.time()
+    t0 = time.monotonic()
     if streaming:
         if not hasattr(trainer, "fit_stream"):
             print(f"error: {args.algo} cannot train from a shard directory "
@@ -137,7 +137,7 @@ def _cmd_train(args) -> int:
         for i in range(len(ds)):
             trainer.process(ds.row(i), float(ds.labels[i]))
         rows = list(trainer.close())
-    dt = time.time() - t0
+    dt = time.monotonic() - t0
     if args.save_bundle:
         trainer.save_bundle(args.save_bundle)
     promotion = None

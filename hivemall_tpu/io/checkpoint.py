@@ -501,7 +501,7 @@ class CheckpointManager:
             "every": self.every,
             "keep": self.keep,
             "last_saved_step": self._last_saved_step,
-            "age_seconds": (round(time.time() - self._last_saved_ts, 3)
+            "age_seconds": (round(time.monotonic() - self._last_saved_ts, 3)
                             if self._last_saved_ts else None),
             "bundles": self._bundles,
         }
@@ -519,7 +519,7 @@ class CheckpointManager:
                             f"{self.name}-step{trainer._t:010d}.npz")
         save_bundle(trainer, path)
         self._last_saved_step = int(trainer._t)
-        self._last_saved_ts = time.time()
+        self._last_saved_ts = time.monotonic()
         self._prune()
         emit = getattr(trainer, "_emit_checkpoint_event", None)
         if emit is not None:            # one emitter for every save site

@@ -205,7 +205,9 @@ def _render(state: _TailState, path: str = "",
 
     ck = state.last.get("checkpoint")
     if ck is not None:
-        age = now - ck.get("ts", now)
+        # event `ts` fields are wall-clock by schema (cross-process jsonl
+        # merge); diffing against wall "now" is the only coherent read
+        age = now - ck.get("ts", now)  # graftcheck: disable=GC02
         where = ck.get("path", "?")
         at = (f"step {ck['step']}" if "step" in ck
               else f"epoch {ck.get('epoch', '?')}")

@@ -55,12 +55,13 @@ WINDOWS = (("5m", 300.0), ("1h", 3600.0))
 
 
 class _Sample:
-    __slots__ = ("ts", "offered", "bad", "buckets", "lat_sum",
+    __slots__ = ("ts", "mono", "offered", "bad", "buckets", "lat_sum",
                  "lat_count", "score_sum", "score_sumsq", "score_n")
 
-    def __init__(self, ts, offered, bad, buckets, lat_sum, lat_count,
-                 score_sum, score_sumsq, score_n):
-        self.ts = ts
+    def __init__(self, ts, mono, offered, bad, buckets, lat_sum,
+                 lat_count, score_sum, score_sumsq, score_n):
+        self.ts = ts                    # wall: event export / human corr
+        self.mono = mono                # monotonic: ALL window math
         self.offered = offered          # accepted + shed: what clients
         self.bad = bad                  # actually attempted
         self.buckets = buckets          # cumulative [le, count] pairs
@@ -164,11 +165,16 @@ class SloEngine:
         the tick's deltas are unreliable — fold the sample into the
         windows (diffs clamp) but skip the drift feed.
         """
+        # split clocks: window durations diff `mono` (an NTP step must
+        # not stretch or fold a burn-rate window), while drift events and
+        # the /slo payload export wall `ts`. An explicit ts drives both —
+        # tests run on one synthetic clock.
+        mono = time.monotonic() if ts is None else float(ts)
         ts = time.time() if ts is None else float(ts)
         lat = totals.get("latency") or {}
         shed = int(totals.get("shed") or 0)
         cur = _Sample(
-            ts,
+            ts, mono,
             # the batcher's `requests` counts ACCEPTED requests (a shed
             # submit raises before the counter) — the availability
             # denominator must be what clients OFFERED, or overload
@@ -190,7 +196,7 @@ class SloEngine:
             # gap-thinned ring: sub-second cadences keep full 1h window
             # coverage instead of evicting the far edge (evaluate() uses
             # self._last for freshness, the ring for window edges)
-            if not self._ring or cur.ts - self._ring[-1].ts \
+            if not self._ring or cur.mono - self._ring[-1].mono \
                     >= self._RING_GAP:
                 self._ring.append(cur)
             self.samples += 1
@@ -276,7 +282,7 @@ class SloEngine:
         lo = now - seconds
         edge = None
         for s in samples:               # oldest -> newest
-            if s.ts <= lo:
+            if s.mono <= lo:
                 edge = s
             else:
                 break
@@ -287,6 +293,7 @@ class SloEngine:
         latency vs target with error-budget burn rates, plus drift
         state. JSON-ready and cheap enough per scrape (one pass over the
         bounded ring per window)."""
+        mono_now = time.monotonic() if now is None else float(now)
         now = time.time() if now is None else float(now)
         with self._lock:
             samples = list(self._ring)
@@ -296,6 +303,14 @@ class SloEngine:
             retrain_wanted = self.retrain_wanted
         if cur is not None and (not samples or samples[-1] is not cur):
             samples.append(cur)          # freshest raw sample wins
+        # clock-mismatch guard: samples fed with an EXPLICIT ts (a test's
+        # synthetic clock, or a wall timestamp from an older caller) live
+        # on a different epoch than this process's monotonic clock — the
+        # gap is years, never honest elapsed time. Anchor the window "now"
+        # to the freshest sample instead of silently degrading every
+        # window to lifetime totals (the far edge would never match).
+        if cur is not None and abs(mono_now - cur.mono) > 1e7:
+            mono_now = cur.mono
         out: dict = {
             "ts": round(now, 3),
             "configured": True,
@@ -313,8 +328,9 @@ class SloEngine:
         if not samples:
             return out
         for name, seconds in WINDOWS:
-            base = self._window_edge(samples, now, seconds)
-            span = max(1e-9, cur.ts - base.ts) if base is not cur else 0.0
+            base = self._window_edge(samples, mono_now, seconds)
+            span = max(1e-9, cur.mono - base.mono) \
+                if base is not cur else 0.0
             d_req = max(0, cur.offered - base.offered) \
                 if base is not cur else cur.offered
             d_bad = max(0, cur.bad - base.bad) \

@@ -69,7 +69,7 @@ class _Model:
     scorer: Any                      # fn(SparseBatch) -> np.float32 [B]
     step: int
     path: Optional[str]
-    loaded_at: float = field(default_factory=time.time)
+    loaded_at: float = field(default_factory=time.monotonic)
     needs_field: bool = False        # FFM-style rows carry field ids
     bundle_mtime: Optional[float] = None   # source file mtime (bundle age)
 
@@ -329,7 +329,7 @@ class PredictEngine:
 
     @property
     def model_age_seconds(self) -> float:
-        return round(time.time() - self._model.loaded_at, 3)
+        return round(time.monotonic() - self._model.loaded_at, 3)
 
     @property
     def bundle_age_seconds(self) -> Optional[float]:
@@ -339,7 +339,9 @@ class PredictEngine:
         router read this off /healthz to spot a fleet stuck on an old
         bundle while training keeps publishing newer ones."""
         mt = self._model.bundle_mtime
-        return None if mt is None else round(time.time() - mt, 3)
+        # file mtimes are wall-clock; only wall "now" can age them
+        return None if mt is None \
+            else round(time.time() - mt, 3)  # graftcheck: disable=GC02
 
     @property
     def ready(self) -> bool:
@@ -414,7 +416,9 @@ class PredictEngine:
                 try:
                     self.poll()
                 except Exception as e:   # noqa: BLE001 — watcher survives
-                    self.last_reload_error = f"{type(e).__name__}: {e}"
+                    with self._reload_lock:  # shared with the warm thread
+                        self.last_reload_error = \
+                            f"{type(e).__name__}: {e}"
 
         self._watch_thread = threading.Thread(
             target=run, name="serve-watch", daemon=True)
@@ -510,7 +514,8 @@ class PredictEngine:
         try:
             self.warmup(warmup_len)
         except Exception as e:           # noqa: BLE001 — degrade to cold
-            self.last_reload_error = f"warmup: {type(e).__name__}: {e}"
+            with self._reload_lock:      # shared with the watch thread
+                self.last_reload_error = f"warmup: {type(e).__name__}: {e}"
 
     def _warm_model(self, m: _Model, warmup_len: int) -> int:
         L = bucket_size(warmup_len, lo=self.min_len_bucket)

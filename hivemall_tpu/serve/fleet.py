@@ -264,9 +264,9 @@ class ReplicaManager:
         try:
             for r in rs:
                 self._wait_ready_line(r, deadline)
-        except Exception:
-            for r in rs:
-                if r.proc.poll() is None:
+        except Exception:  # noqa: BLE001 — cleanup-and-reraise: any boot
+            for r in rs:   # failure must kill the PARTIAL fleet before
+                if r.proc.poll() is None:   # surfacing (no orphans)
                     r.proc.kill()
             raise
         with self._lock:
@@ -800,7 +800,7 @@ class ReplicaManager:
             "canary": {"active": c is not None,
                        "step": c["step"] if c else None,
                        "cohort": canary_n,
-                       "age_seconds": (round(time.time() - baking, 3)
+                       "age_seconds": (round(time.monotonic() - baking, 3)
                                        if baking else None)},
             "retrain_wanted": int(getattr(self.slo, "retrain_wanted", 0)
                                   or 0),

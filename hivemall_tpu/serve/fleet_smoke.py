@@ -97,10 +97,10 @@ def _run(args, tmp: str) -> int:
         serve_kwargs={"max_batch": 64, "max_delay_ms": 3.0,
                       "max_queue_rows": 4096,
                       "warmup_len": max(len(r) for r in rows)})
-    t0 = time.time()
+    t0 = time.monotonic()
     fleet.start(wait_ready=True, timeout=180.0)
     print(f"fleet smoke: {args.replicas} replicas ready in "
-          f"{time.time() - t0:.1f}s on port {fleet.port}", file=sys.stderr)
+          f"{time.monotonic() - t0:.1f}s on port {fleet.port}", file=sys.stderr)
     try:
         return _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient)
     finally:
@@ -192,14 +192,14 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
 
     # -- 2b. end-to-end request tracing + per-hop breakdown ----------------
     tid = "smoke-trace-1"
-    t0 = time.time()
+    t0 = time.monotonic()
     req = urllib.request.Request(
         f"http://{host}:{port}/predict",
         json.dumps({"rows": [rows[0]]}).encode(),
         {"Content-Type": "application/json", "x-hivemall-trace": tid})
     with urllib.request.urlopen(req, timeout=30) as resp:
         resp.read()
-        wall_ms = (time.time() - t0) * 1000.0
+        wall_ms = (time.monotonic() - t0) * 1000.0
         echo = resp.headers.get("x-hivemall-trace")
         hop = resp.headers.get("x-hivemall-hop") or ""
         rhop = resp.headers.get("x-hivemall-hop-router") or ""
@@ -258,8 +258,8 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
     # -- 3. kill one replica mid-traffic: zero failed requests ------------
     victim = fleet.manager.replicas()[0]
     os.kill(victim.proc.pid, signal.SIGKILL)
-    deadline = time.time() + 90
-    while time.time() < deadline and (
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline and (
             fleet.manager.respawns == 0
             or not fleet.manager.wait_ready(args.replicas, timeout=0.1)):
         time.sleep(0.2)
@@ -274,8 +274,8 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
     # -- 4. rolling hot reload mid-traffic: zero drops, steps converge ----
     t2, _ = _train_bundle(
         tmp, "-dims 4096 -loss logloss -opt adagrad -mini_batch 64", ds)
-    deadline = time.time() + 60
-    while time.time() < deadline and fleet.manager.fleet_step != t2._t:
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and fleet.manager.fleet_step != t2._t:
         time.sleep(0.2)
     stop.set()
     for t in tt:

@@ -454,7 +454,9 @@ class CanaryBake:
 
     def start(self, canary_totals: dict, stable_totals: dict,
               now: Optional[float] = None) -> None:
-        self._t0 = time.time() if now is None else float(now)
+        # monotonic: bake age must survive NTP steps mid-bake (explicit
+        # `now` keeps tests on one synthetic clock)
+        self._t0 = time.monotonic() if now is None else float(now)
         self._c0 = _tot(canary_totals)
         self._s0 = _tot(stable_totals)
 
@@ -471,7 +473,7 @@ class CanaryBake:
                now: Optional[float] = None) -> Optional[str]:
         if self._t0 is None:
             raise RuntimeError("CanaryBake.update before start")
-        now = time.time() if now is None else float(now)
+        now = time.monotonic() if now is None else float(now)
         ct, st = _tot(canary_totals), _tot(stable_totals)
         if self._went_backwards(ct, self._c0) \
                 or self._went_backwards(st, self._s0):

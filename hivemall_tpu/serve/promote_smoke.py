@@ -105,10 +105,10 @@ def _run(args, tmp, metrics) -> int:
         serve_kwargs={"max_batch": 64, "max_delay_ms": 3.0,
                       "max_queue_rows": 4096,
                       "warmup_len": max(len(r) for r in rows)})
-    t0 = time.time()
+    t0 = time.monotonic()
     fleet.start(wait_ready=True, timeout=180.0)
     print(f"promote smoke: {args.replicas} replicas ready in "
-          f"{time.time() - t0:.1f}s on port {fleet.port}", file=sys.stderr)
+          f"{time.monotonic() - t0:.1f}s on port {fleet.port}", file=sys.stderr)
     try:
         return _drive(args, tmp, metrics, ds, rows, fleet, trainer, name,
                       opts, ck, KeepAliveClient, inject_canary_regression)
@@ -127,8 +127,8 @@ def _drive(args, tmp, metrics, ds, rows, fleet, trainer, name, opts, ck,
             failures.append(label)
 
     def wait_for(cond, timeout=90.0):
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if cond():
                 return True
             time.sleep(0.2)

@@ -571,9 +571,9 @@ class RouterServer:
             payload = conn.rfile.read(clen) if clen else b""
             if clen and len(payload) != clen:
                 raise ConnectionError("connection closed mid-body")
-        except Exception:
-            conn.close()
-            raise
+        except Exception:  # noqa: BLE001 — cleanup-and-reraise: a conn
+            conn.close()   # that failed mid-exchange must never return
+            raise          # to the keep-alive pool half-read
         if close or not pooled:
             conn.close()
         else:

@@ -182,8 +182,8 @@ def _drive(args, tmp, ds, rows, ref, engine, srv, base) -> int:
     old_step = engine.model_step
     t2, _ = _train_bundle(tmp, "-dims 4096 -loss logloss -opt adagrad "
                                "-mini_batch 64", ds)
-    deadline = time.time() + 20
-    while time.time() < deadline and engine.model_step < t2._t:
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and engine.model_step < t2._t:
         time.sleep(0.1)
     stop.set()
     for t in tt:

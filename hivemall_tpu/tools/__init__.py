@@ -1,0 +1,5 @@
+"""Developer tooling that ships with the package (no runtime imports).
+
+- :mod:`hivemall_tpu.tools.graftcheck` — the project-invariant static
+  analyzer gating CI (docs/STATIC_ANALYSIS.md).
+"""

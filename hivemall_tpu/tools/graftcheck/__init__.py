@@ -1,0 +1,45 @@
+"""graftcheck — project-invariant static analysis (docs/STATIC_ANALYSIS.md).
+
+The codebase's hardest-won invariants were, until PR 11, enforced only at
+runtime: the no-retrace sentinel (obs.devprof) catches a fresh-closure jit
+site only after it has burned a compile, the atomic-write idiom
+(tmp -> fsync -> ``os.replace``) is a convention copied by hand across
+io/, and stub-vs-live registry parity is pinned by a test that must be
+updated per section. graftcheck rejects violations at review time
+instead, from source, with zero new dependencies (stdlib ``ast`` +
+``tokenize`` only).
+
+Rules (each with a fix-hint and a ``# graftcheck: disable=<code>``
+suppression; see docs/STATIC_ANALYSIS.md for the full catalog):
+
+========  ===============================================================
+GC01      retrace-hazard: jit/``lru_cache`` compile factories defined
+          inside functions/loops, or jitted closures created AND called
+          per-call instead of escaping through a module-level factory.
+GC02      clock-discipline: ``time.time()`` in duration arithmetic
+          (subtraction / deadline comparison) where ``time.monotonic()``
+          is required; legitimate wall-clock anchors carry an explicit
+          suppression.
+GC03      atomic-write: bare ``open(..., "w"/"wb")`` in io/ or serve/
+          outside a tmp -> fsync -> ``os.replace`` helper.
+GC04      lock-discipline: instance attributes mutated from more than
+          one thread entry point without the owning lock held, and
+          ``Lock.acquire()`` outside a ``with``.
+GC05      surface-parity: registry stub constants must key-mirror their
+          live provider dict literals; registry section names and stub
+          keys must satisfy the ``to_prometheus`` name grammar.
+GC06      broad-except: ``except Exception:`` in serve/ and obs/ hot
+          paths must name why (a comment on the handler) or be narrowed.
+========  ===============================================================
+
+Run ``python -m hivemall_tpu.tools.graftcheck`` from the repo root; CI
+wires it into run_tests.sh as a hard gate (``--selfcheck`` proves the
+gate catches seeded violations before the real pass).
+"""
+
+from .engine import (Finding, load_baseline, run_paths, scan_file,
+                     write_baseline)
+from .rules import RULES
+
+__all__ = ["Finding", "RULES", "run_paths", "scan_file",
+           "load_baseline", "write_baseline"]

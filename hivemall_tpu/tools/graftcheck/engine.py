@@ -1,0 +1,388 @@
+"""graftcheck engine — file walking, suppressions, baseline, CLI.
+
+Two passes over the scanned tree: pass 1 parses every file and collects
+the cross-file :class:`~.rules.ProjectIndex` (registry stub constants +
+alias functions), pass 2 runs every rule per module. Suppression
+comments (``# graftcheck: disable=GC02`` — trailing on the flagged line,
+or alone on the line above) are honored before the baseline is applied.
+
+Baseline semantics (``--baseline graftcheck_baseline.json``): a JSON
+list of finding fingerprints tolerated for now. The gate fails on any
+NON-baselined finding AND on any stale entry — a fixed finding must
+leave the baseline in the same PR, so the debt list only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .rules import (Finding, ModuleContext, ProjectIndex, RULES,
+                    collect_project, run_rules)
+
+__all__ = ["Finding", "run_paths", "scan_file", "load_baseline",
+           "write_baseline", "main"]
+
+_DIRECTIVE = re.compile(r"graftcheck:\s*disable=([A-Z0-9,\s]+)")
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS
+                                     and not d.startswith("."))
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+def _comment_map(source: str) -> Tuple[Dict[int, str], Set[int]]:
+    """line -> comment text, plus the set of comment-ONLY lines (a
+    directive alone on its own line applies to the next code line)."""
+    comments: Dict[int, str] = {}
+    only: Set[int] = set()
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return comments, only
+    code_lines: Set[int] = set()
+    for tok in toks:
+        if tok.type == tokenize.COMMENT:
+            comments[tok.start[0]] = tok.string
+        elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
+                              tokenize.INDENT, tokenize.DEDENT,
+                              tokenize.ENCODING, tokenize.ENDMARKER):
+            for line in range(tok.start[0], tok.end[0] + 1):
+                code_lines.add(line)
+    only = {ln for ln in comments if ln not in code_lines}
+    return comments, only
+
+
+def _suppressions(comments: Dict[int, str],
+                  comment_only: Set[int]) -> Dict[int, Set[str]]:
+    """Effective per-line suppressed codes: a trailing directive covers
+    its own line; a directive alone on a line covers the next line."""
+    supp: Dict[int, Set[str]] = {}
+    for line, text in comments.items():
+        m = _DIRECTIVE.search(text)
+        if not m:
+            continue
+        codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+        supp.setdefault(line, set()).update(codes)
+        if line in comment_only:
+            supp.setdefault(line + 1, set()).update(codes)
+    return supp
+
+
+def _parse_one(path: str, relpath: str) \
+        -> Tuple[Optional[ModuleContext], Optional[Finding]]:
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return None, Finding("GC00", relpath, e.lineno or 0, 0,
+                             f"syntax error: {e.msg}",
+                             "graftcheck cannot analyze unparseable "
+                             "source", "<module>")
+    comments, only = _comment_map(source)
+    ctx = ModuleContext(relpath, tree, comments)
+    ctx.suppressions = _suppressions(comments, only)  # type: ignore
+    return ctx, None
+
+
+def scan_file(path: str, root: Optional[str] = None,
+              project: Optional[ProjectIndex] = None) -> List[Finding]:
+    """Analyze one file (convenience for tests); cross-file GC05 parity
+    only sees stubs defined in this file unless ``project`` is given."""
+    rel = os.path.relpath(path, root or os.getcwd()).replace(os.sep, "/")
+    ctx, err = _parse_one(path, rel)
+    if err is not None:
+        return [err]
+    assert ctx is not None
+    if project is None:
+        project = collect_project([ctx])
+    return _apply_suppressions(ctx, run_rules(ctx, project))
+
+
+def _apply_suppressions(ctx: ModuleContext,
+                        findings: List[Finding]) -> List[Finding]:
+    supp = getattr(ctx, "suppressions", {})
+    return [f for f in findings if f.code not in supp.get(f.line, set())]
+
+
+def run_paths(paths: Iterable[str], root: Optional[str] = None) \
+        -> List[Finding]:
+    """Scan every .py under ``paths``; returns suppression-filtered
+    findings (baseline is the caller's concern). Paths in findings are
+    relative to ``root`` (default: cwd), '/'-separated — baseline
+    fingerprints stay stable across machines."""
+    root = os.path.abspath(root or os.getcwd())
+    contexts: List[ModuleContext] = []
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), root) \
+            .replace(os.sep, "/")
+        ctx, err = _parse_one(path, rel)
+        if err is not None:
+            findings.append(err)
+            continue
+        assert ctx is not None
+        contexts.append(ctx)
+    project = collect_project(contexts)
+    for ctx in contexts:
+        findings.extend(_apply_suppressions(ctx, run_rules(ctx, project)))
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: str) -> List[str]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("findings", [])
+    if not isinstance(data, list) \
+            or not all(isinstance(x, str) for x in data):
+        raise ValueError(f"{path}: baseline must be a JSON list of "
+                         f"fingerprint strings (or {{'findings': [...]}})")
+    return data
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    data = {"version": 1,
+            "comment": "graftcheck debt list — fixing a finding MUST "
+                       "remove its entry (the gate flags stale entries); "
+                       "see docs/STATIC_ANALYSIS.md",
+            "findings": sorted(f.fingerprint for f in findings)}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+
+
+def gate(findings: List[Finding], baseline: List[str],
+         covered: Optional[List[str]] = None) \
+        -> Tuple[List[Finding], List[str]]:
+    """(new findings not in baseline, stale baseline entries).
+
+    ``covered`` — scan-root prefixes (relpaths, '/'-separated): an entry
+    is judged stale only when its file lies UNDER a scanned root; a
+    partial scan (one file/dir) must not flag the rest of the repo's
+    baseline as stale. ``None`` = the scan covered everything."""
+    prints = {f.fingerprint for f in findings}
+    base = set(baseline)
+    fresh = [f for f in findings if f.fingerprint not in base]
+
+    def in_scope(fp: str) -> bool:
+        if covered is None:
+            return True
+        path = fp.split("::", 1)[0]
+        return any(p in (".", "") or path == p or path.startswith(p + "/")
+                   for p in covered)
+
+    stale = sorted(fp for fp in base - prints if in_scope(fp))
+    return fresh, stale
+
+
+# -- selfcheck --------------------------------------------------------------
+
+_FIXTURES = {
+    # one seeded violation per rule — the gate must catch every one
+    "pkg/models/bad_model.py": (
+        "import jax\n"
+        "from functools import lru_cache\n\n"
+        "def per_call_predict(f, x):\n"
+        "    g = jax.jit(f)\n"
+        "    return g(x)\n\n"
+        "def nested_factory():\n"
+        "    @lru_cache(maxsize=8)\n"
+        "    def build(n):\n"
+        "        return jax.jit(lambda v: v * n)\n"
+        "    return build\n",
+        {"GC01"}),
+    "pkg/io/bad_io.py": (
+        "import time\n\n"
+        "def save_pointer(path, blob):\n"
+        "    with open(path, 'w') as f:\n"
+        "        f.write(blob)\n\n"
+        "def wait(deadline_s):\n"
+        "    deadline = time.time() + deadline_s\n"
+        "    while time.time() < deadline:\n"
+        "        pass\n",
+        {"GC02", "GC03"}),
+    "pkg/serve/bad_serve.py": (
+        "import threading\n\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "        threading.Thread(target=self._a).start()\n"
+        "        threading.Thread(target=self._b).start()\n"
+        "    def _a(self):\n"
+        "        self.count += 1\n"
+        "    def _b(self):\n"
+        "        try:\n"
+        "            self.count -= 1\n"
+        "        except Exception:\n"
+        "            pass\n",
+        {"GC04", "GC06"}),
+    "pkg/obs/registry.py": (
+        "FOO_STUB = {'ok': 0, 'bad-dash': 0}\n\n"
+        "class P:\n"
+        "    def obs_section(self):\n"
+        "        return {'ok': 0, 'extra': 1}\n"
+        "    def _register_obs(self):\n"
+        "        def p():\n"
+        "            return (self.obs_section() if self is not None\n"
+        "                    else dict(FOO_STUB))\n"
+        "        registry.register('bad.name', p)\n",
+        {"GC05"}),
+}
+
+
+def selfcheck() -> int:
+    """Prove the gate in both directions before trusting a clean run:
+    every rule fires on its seeded fixture; a baseline silences them; a
+    fixed finding turns its baseline entry stale (nonzero)."""
+    import shutil
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="graftcheck_selfcheck_")
+    try:
+        for rel, (src, _want) in _FIXTURES.items():
+            p = os.path.join(tmp, rel)
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(src)
+        findings = run_paths([os.path.join(tmp, "pkg")], root=tmp)
+        got = {}
+        for f in findings:
+            got.setdefault(f.path, set()).add(f.code)
+        failures = []
+        for rel, (_src, want) in _FIXTURES.items():
+            missing = want - got.get(rel, set())
+            if missing:
+                failures.append(f"{rel}: rule(s) {sorted(missing)} did "
+                                f"not fire on the seeded violation")
+        if not findings:
+            failures.append("no findings at all on the seeded tree")
+        # direction 2: baseline silences, then goes stale after a "fix"
+        bl = os.path.join(tmp, "baseline.json")
+        write_baseline(bl, findings)
+        fresh, stale = gate(findings, load_baseline(bl))
+        if fresh or stale:
+            failures.append("baselined tree did not gate clean")
+        kept = [f for f in findings if f.code != "GC03"]
+        fresh, stale = gate(kept, load_baseline(bl))
+        if not stale:
+            failures.append("fixed finding did not turn its baseline "
+                            "entry stale")
+        if failures:
+            for msg in failures:
+                print(f"graftcheck --selfcheck FAIL: {msg}",
+                      file=sys.stderr)
+            return 1
+        print(f"graftcheck --selfcheck: {len(findings)} seeded findings "
+              f"caught across {len(_FIXTURES)} fixtures; baseline gate "
+              f"bidirectional (silences fresh, flags stale)")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _default_paths() -> List[str]:
+    """The installed package tree (works from any cwd)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return [pkg]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hivemall_tpu.tools.graftcheck",
+        description="project-invariant static analyzer "
+                    "(docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: the hivemall_tpu "
+                         "package)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: ./graftcheck_baseline"
+                         ".json when present)")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write current findings as the new baseline and "
+                         "exit 0")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="prove every rule fires on seeded violations "
+                         "and the baseline gate works both ways")
+    ap.add_argument("--root", default=None,
+                    help="path-relativity root for fingerprints "
+                         "(default: cwd)")
+    args = ap.parse_args(argv)
+
+    if args.selfcheck:
+        return selfcheck()
+
+    paths = args.paths or _default_paths()
+    root = args.root
+    if root is None and not args.paths:
+        # default scan: relative to the repo root (the package's parent)
+        root = os.path.dirname(_default_paths()[0])
+    findings = run_paths(paths, root=root)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(f"graftcheck: wrote {len(findings)} fingerprint(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists("graftcheck_baseline.json"):
+        baseline_path = "graftcheck_baseline.json"
+    baseline: List[str] = []
+    if baseline_path:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"graftcheck: cannot read baseline: {e}",
+                  file=sys.stderr)
+            return 2
+    abs_root = os.path.abspath(root or os.getcwd())
+    covered = [os.path.relpath(os.path.abspath(p), abs_root)
+               .replace(os.sep, "/") for p in paths]
+    fresh, stale = gate(findings, baseline, covered)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [vars(f) | {"fingerprint": f.fingerprint}
+                         for f in fresh],
+            "baselined": len(findings) - len(fresh),
+            "stale_baseline": stale}, indent=1))
+    else:
+        for f in fresh:
+            print(f.render())
+        for fp in stale:
+            print(f"graftcheck: STALE baseline entry (fixed finding must "
+                  f"leave the baseline): {fp}")
+        n_base = len(findings) - len(fresh)
+        status = "clean" if not (fresh or stale) else "FAIL"
+        print(f"graftcheck: {status} — {len(fresh)} finding(s)"
+              + (f", {n_base} baselined" if n_base else "")
+              + (f", {len(stale)} stale baseline entr"
+                 + ("y" if len(stale) == 1 else "ies") if stale else ""))
+    return 1 if (fresh or stale) else 0

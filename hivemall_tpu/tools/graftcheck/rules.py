@@ -1,0 +1,881 @@
+"""graftcheck rule implementations (stdlib ``ast`` only).
+
+Each rule is a function ``(ctx: ModuleContext, project: ProjectIndex) ->
+List[Finding]``. The engine builds one :class:`ModuleContext` per file
+(parse tree + parent links + comment map) and a :class:`ProjectIndex`
+from a cheap first pass over every scanned file (registry stub constants
+and their alias functions — the only cross-file state any rule needs).
+
+The rules encode PROJECT invariants, not general style: they must pass
+the known-good compile-factory population clean — the ~67 jit/lru_cache
+sites across models/, ops/ and parallel/ (floor 60 pinned by
+tests/test_graftcheck.py) — along with the atomic-write helpers in io/,
+while rejecting the seeded violations in the same test file. When a rule and reality disagree, the
+escape hatch is an explicit ``# graftcheck: disable=<code>`` on the
+flagged line (or alone on the line above) — intent on the record, not a
+silent pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["Finding", "ModuleContext", "ProjectIndex", "RULES",
+           "collect_project", "run_rules"]
+
+
+@dataclass
+class Finding:
+    code: str
+    path: str            # '/'-separated path relative to the scan root
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    symbol: str = "<module>"
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity for the baseline file: stable across
+        unrelated edits above the finding, invalidated when the finding's
+        own symbol or message changes (a fixed finding MUST leave the
+        baseline — the engine flags the stale entry)."""
+        return f"{self.path}::{self.code}::{self.symbol}::{self.message}"
+
+    def render(self) -> str:
+        s = f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+        if self.hint:
+            s += f" [fix: {self.hint}]"
+        return s
+
+
+class ModuleContext:
+    """One parsed file: tree, parent links, raw lines, comment map."""
+
+    def __init__(self, relpath: str, tree: ast.Module,
+                 comments: Dict[int, str]):
+        self.relpath = relpath
+        self.parts = tuple(relpath.split("/"))
+        self.tree = tree
+        self.comments = comments          # line -> comment text
+        self._parent: Dict[int, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parent[id(child)] = parent
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parent.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def qualname(self, node: ast.AST) -> str:
+        names = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(cur.name)
+            cur = self.parent(cur)
+        return ".".join(reversed(names)) or "<module>"
+
+    def enclosing_function(self, node: ast.AST) \
+            -> Optional[ast.AST]:
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+
+@dataclass
+class ProjectIndex:
+    """Cross-file state from the engine's first pass."""
+    #: STUB const name -> (defining relpath, top-level literal keys)
+    stubs: Dict[str, Tuple[str, Tuple[str, ...]]]
+    #: alias function name -> STUB const name (e.g. promotion_stub)
+    stub_aliases: Dict[str, str]
+
+
+FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+LOOPS = (ast.For, ast.AsyncFor, ast.While)
+
+
+def _dec_name(dec: ast.AST) -> str:
+    """The rightmost identifier of a (possibly called) decorator."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    if isinstance(target, ast.Name):
+        return target.id
+    return ""
+
+
+_CACHE_NAMES = {"lru_cache", "_lru_cache", "cache", "cached"}
+_FACTORY_NAMES = {"instrument_factory", "_instrument"}
+
+
+def _is_cache_decorator(dec: ast.AST) -> bool:
+    return _dec_name(dec) in _CACHE_NAMES
+
+
+def _is_memo_decorated(fn: ast.AST) -> bool:
+    """lru_cache / instrument_factory on the def: a memoized compile
+    factory — jit creations inside it happen once per config key."""
+    return any(_dec_name(d) in (_CACHE_NAMES | _FACTORY_NAMES)
+               for d in getattr(fn, "decorator_list", []))
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Name) and node.id == "jit") or \
+        (isinstance(node, ast.Attribute) and node.attr == "jit")
+
+
+def _is_partial(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _dec_name(node) in (
+        "partial", "_partial")
+
+
+def _is_jit_creation(node: ast.AST) -> bool:
+    """A Call producing a jit-compiled callable: ``jax.jit(f)``,
+    ``jit(f)``, or ``partial(jax.jit, ...)(f)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    if _is_jit_name(node.func):
+        return True
+    if isinstance(node.func, ast.Call) and _is_partial(node.func) \
+            and node.func.args and _is_jit_name(node.func.args[0]):
+        return True
+    return False
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if _is_jit_name(dec):
+        return True
+    if _is_partial(dec) and dec.args and _is_jit_name(dec.args[0]):
+        return True
+    if isinstance(dec, ast.Call) and _is_jit_name(dec.func):
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# GC01 — retrace-hazard
+# ---------------------------------------------------------------------------
+
+_GC01_HINT = ("hoist into a module-level factory memoized with lru_cache "
+              "+ obs.devprof.instrument_factory, or return/store the "
+              "closure instead of re-creating it per call")
+
+
+def gc01_retrace_hazard(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    out: List[Finding] = []
+
+    def add(node, msg):
+        out.append(Finding("GC01", ctx.relpath, node.lineno,
+                           node.col_offset, msg, _GC01_HINT,
+                           ctx.qualname(node)))
+
+    def chain_memoized(fn) -> bool:
+        cur = fn
+        while cur is not None:
+            if isinstance(cur, FUNCS) and _is_memo_decorated(cur):
+                return True
+            cur = ctx.parent(cur)
+        return False
+
+    def in_loop_below(node, fn) -> bool:
+        """Is ``node`` inside a loop that is itself inside ``fn`` (or at
+        module level when fn is None)?"""
+        for a in ctx.ancestors(node):
+            if a is fn:
+                return False
+            if isinstance(a, LOOPS):
+                return True
+            if isinstance(a, FUNCS) and a is not fn:
+                return False
+        return False
+
+    def product_escapes(fn, name: str, skip: ast.AST) -> Tuple[bool, bool]:
+        """(called, escapes) for loads of ``name`` in ``fn``'s scope.
+        A load used as anything but a call's func position — returned,
+        stored on self, passed as an argument, put in a container —
+        counts as an escape: the closure outlives this call."""
+        called = escapes = False
+        for n in ast.walk(fn):
+            if not (isinstance(n, ast.Name) and n.id == name
+                    and isinstance(n.ctx, ast.Load)):
+                continue
+            if any(a is skip for a in ctx.ancestors(n)):
+                continue                 # the creating statement itself
+            p = ctx.parent(n)
+            if isinstance(p, ast.Call) and p.func is n:
+                called = True
+            else:
+                escapes = True
+        return called, escapes
+
+    for node in ast.walk(ctx.tree):
+        # nested lru_cache factory: a fresh cache object per enclosing
+        # call — the cache never hits, every call recompiles
+        if isinstance(node, FUNCS) \
+                and any(_is_cache_decorator(d) for d in node.decorator_list):
+            encl = ctx.enclosing_function(node)
+            if encl is not None and not chain_memoized(encl):
+                add(node, f"lru_cache compile factory '{node.name}' defined "
+                          f"inside a function — a fresh cache per call never "
+                          f"hits (retrace hazard)")
+            continue
+
+        # decorator-form jit on a def nested inside an un-memoized fn:
+        # fine when the closure escapes (factory pattern), a hazard when
+        # it is only invoked locally or created in a loop
+        if isinstance(node, FUNCS) \
+                and any(_is_jit_decorator(d) for d in node.decorator_list):
+            encl = ctx.enclosing_function(node)
+            if encl is None or chain_memoized(encl):
+                continue
+            if in_loop_below(node, encl):
+                add(node, f"jit-compiled closure '{node.name}' created "
+                          f"inside a loop (fresh compile per iteration)")
+                continue
+            called, escapes = product_escapes(encl, node.name, node)
+            if called and not escapes:
+                add(node, f"jit-compiled closure '{node.name}' created and "
+                          f"invoked in the same scope without escaping "
+                          f"(fresh compile per call)")
+            continue
+
+        if not _is_jit_creation(node):
+            continue
+        # skip the inner partial(jax.jit,...) of an already-handled
+        # creation, and decorator positions (handled above)
+        p = ctx.parent(node)
+        if isinstance(p, ast.Call) and _is_jit_creation(p):
+            continue
+        if isinstance(p, FUNCS) and node in p.decorator_list:
+            continue
+        encl = ctx.enclosing_function(node)
+        if encl is None or chain_memoized(encl):
+            continue
+        if in_loop_below(node, encl):
+            add(node, "jit-compiled closure created inside a loop "
+                      "(fresh compile per iteration)")
+            continue
+        # immediate invoke: jax.jit(f)(x) — compiled, called, dropped
+        if isinstance(p, ast.Call) and p.func is node:
+            add(node, "jit-compiled closure created and invoked inline "
+                      "(fresh compile per call)")
+            continue
+        # named product: track what happens to it in this scope
+        stmt = node
+        for a in ctx.ancestors(node):
+            if isinstance(a, ast.stmt):
+                stmt = a
+                break
+        if isinstance(stmt, ast.Assign) \
+                and all(isinstance(t, ast.Name) for t in stmt.targets):
+            called, escapes = product_escapes(
+                encl, stmt.targets[0].id, stmt)
+            if called and not escapes:
+                add(node, f"jit-compiled closure "
+                          f"'{stmt.targets[0].id}' created and invoked in "
+                          f"the same scope without escaping (fresh compile "
+                          f"per call)")
+        # Return / self.attr store / argument position: escapes — OK
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC02 — clock-discipline
+# ---------------------------------------------------------------------------
+
+_GC02_HINT = ("use time.monotonic() for durations and deadlines; a "
+              "deliberate wall-clock anchor (chrome-trace ts, bundle "
+              "mtime) must carry # graftcheck: disable=GC02")
+
+
+def _has_bare_time_import(tree: ast.Module) -> bool:
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom) and n.module == "time":
+            if any(a.name == "time" for a in n.names):
+                return True
+    return False
+
+
+def gc02_clock_discipline(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    out: List[Finding] = []
+    bare = _has_bare_time_import(ctx.tree)
+
+    def is_wall_call(n: ast.AST) -> bool:
+        if not isinstance(n, ast.Call):
+            return False
+        f = n.func
+        if isinstance(f, ast.Attribute) and f.attr == "time" \
+                and isinstance(f.value, ast.Name) and f.value.id == "time":
+            return True
+        return bare and isinstance(f, ast.Name) and f.id == "time"
+
+    def contains_wall(n: ast.AST) -> bool:
+        return any(is_wall_call(x) for x in ast.walk(n))
+
+    def contains_tainted(n: ast.AST, tainted: Set[str]) -> bool:
+        return any(isinstance(x, ast.Name) and x.id in tainted
+                   and isinstance(x.ctx, ast.Load) for x in ast.walk(n))
+
+    def scan_scope(scope: ast.AST) -> None:
+        """One function (or the module body): taint names assigned from
+        time.time(), then flag subtraction / ordered comparison involving
+        the wall clock. Nested functions are separate scopes."""
+        tainted: Set[str] = set()
+        body_nodes = []
+        stack = list(scope.body)
+        while stack:
+            n = stack.pop()
+            body_nodes.append(n)
+            if isinstance(n, FUNCS + (ast.Lambda,)):
+                continue                 # separate scope
+            stack.extend(ast.iter_child_nodes(n))
+        for n in body_nodes:
+            if isinstance(n, ast.Assign) and contains_wall(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+            elif isinstance(n, ast.AnnAssign) and n.value is not None \
+                    and contains_wall(n.value) \
+                    and isinstance(n.target, ast.Name):
+                tainted.add(n.target.id)
+        flagged: Set[int] = set()        # one finding per line — a
+        for n in body_nodes:             # deadline compare often wraps
+            sides: List[ast.AST] = []    # the subtraction it contains
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub):
+                sides = [n.left, n.right]
+            elif isinstance(n, ast.Compare) and all(
+                    isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
+                    for op in n.ops):   # ordered = deadline semantics;
+                sides = [n.left] + list(n.comparators)   # `is None` etc.
+            if not sides or n.lineno in flagged:         # are not
+                continue
+            direct = any(contains_wall(s) for s in sides)
+            via_name = any(contains_tainted(s, tainted) for s in sides)
+            if direct or via_name:
+                flagged.add(n.lineno)
+                what = "time.time()" if direct \
+                    else "a value derived from time.time()"
+                kind = "subtraction" if isinstance(n, ast.BinOp) \
+                    else "deadline comparison"
+                out.append(Finding(
+                    "GC02", ctx.relpath, n.lineno, n.col_offset,
+                    f"{what} used in duration {kind} — wall clock is not "
+                    f"monotonic (NTP steps corrupt intervals)",
+                    _GC02_HINT, ctx.qualname(n)))
+
+    scan_scope(ctx.tree)
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, FUNCS):
+            scan_scope(n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC03 — atomic-write
+# ---------------------------------------------------------------------------
+
+_GC03_HINT = ("route through io.checkpoint._atomic_write_json or the "
+              "tmp -> fsync -> os.replace idiom (crash mid-write must "
+              "never leave a torn file)")
+_GC03_DIRS = {"io", "serve"}
+
+
+def _calls_os_replace(fn: Optional[ast.AST], tree: ast.Module) -> bool:
+    scope = fn if fn is not None else tree
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in ("replace", "rename"):
+            v = n.func.value
+            if isinstance(v, ast.Name) and v.id == "os":
+                return True
+    return False
+
+
+def gc03_atomic_write(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    if not (_GC03_DIRS & set(ctx.parts[:-1])):
+        return []
+    out: List[Finding] = []
+    for n in ast.walk(ctx.tree):
+        if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "open"):
+            continue
+        mode = None
+        if len(n.args) >= 2 and isinstance(n.args[1], ast.Constant):
+            mode = n.args[1].value
+        for kw in n.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if not (isinstance(mode, str) and "w" in mode):
+            continue
+        fn = ctx.enclosing_function(n)
+        if _calls_os_replace(fn, ctx.tree):
+            continue                     # the atomic helper itself
+        out.append(Finding(
+            "GC03", ctx.relpath, n.lineno, n.col_offset,
+            f'bare open(..., "{mode}") in {ctx.parts[-2]}/ outside a '
+            f"tmp -> fsync -> os.replace helper (non-atomic write to a "
+            f"checkpoint/cache/pointer path)",
+            _GC03_HINT, ctx.qualname(n)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC04 — lock-discipline
+# ---------------------------------------------------------------------------
+
+_GC04_HINT = ("hold the owning lock (with self._lock:) around the write, "
+              "or annotate the single-writer argument with "
+              "# graftcheck: disable=GC04")
+_LOCKISH = re.compile(r"lock|mutex", re.IGNORECASE)
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    return _dec_name(call) == "Thread"
+
+
+def gc04_lock_discipline(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    out: List[Finding] = []
+
+    # sub-rule: Lock.acquire() outside a with — with-discipline makes
+    # release unconditional across every exit path
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "acquire":
+            try:
+                owner = ast.unparse(n.func.value)
+            except Exception:  # noqa: BLE001 — unparse of odd nodes
+                owner = ""
+            if _LOCKISH.search(owner):
+                out.append(Finding(
+                    "GC04", ctx.relpath, n.lineno, n.col_offset,
+                    f"{owner}.acquire() outside a with-statement — an "
+                    f"exception between acquire and release deadlocks "
+                    f"every other thread",
+                    "use `with <lock>:` so release is unconditional",
+                    ctx.qualname(n)))
+
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        base_names = []
+        for b in cls.bases:
+            try:
+                base_names.append(ast.unparse(b))
+            except Exception:  # noqa: BLE001 — unparse of odd nodes
+                pass
+        # thread entry points: methods handed to Thread(target=...),
+        # run() on Thread subclasses, do_* handlers on HTTP handler
+        # classes — code that executes on a thread other than the
+        # constructing one
+        entries: List[Tuple[str, ast.AST]] = []
+        methods = {m.name: m for m in cls.body if isinstance(m, FUNCS)}
+        for n in ast.walk(cls):
+            if not (isinstance(n, ast.Call) and _is_thread_ctor(n)):
+                continue
+            for kw in n.keywords:
+                if kw.arg != "target":
+                    continue
+                t = kw.value
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" and t.attr in methods:
+                    entries.append((t.attr, methods[t.attr]))
+                elif isinstance(t, ast.Name):
+                    # nested closure target: find its def in the class
+                    for d in ast.walk(cls):
+                        if isinstance(d, FUNCS) and d.name == t.id \
+                                and ctx.enclosing_function(d) is not None:
+                            host = ctx.enclosing_function(d)
+                            entries.append(
+                                (f"{getattr(host, 'name', '?')}.{d.name}",
+                                 d))
+        if any(b.endswith("Thread") for b in base_names) \
+                and "run" in methods:
+            entries.append(("run", methods["run"]))
+        if any("RequestHandler" in b for b in base_names):
+            entries.extend((name, m) for name, m in methods.items()
+                           if name.startswith("do_"))
+        if len(entries) < 2:
+            continue
+        seen = []
+        uniq = []
+        for name, node in entries:
+            if id(node) not in seen:
+                seen.append(id(node))
+                uniq.append((name, node))
+        if len(uniq) < 2:
+            continue
+
+        def under_lock(n: ast.AST, top: ast.AST) -> bool:
+            for a in ctx.ancestors(n):
+                if isinstance(a, ast.With):
+                    for item in a.items:
+                        try:
+                            src = ast.unparse(item.context_expr)
+                        except Exception:  # noqa: BLE001 — odd nodes
+                            src = ""
+                        if _LOCKISH.search(src):
+                            return True
+                if a is top:
+                    break
+            return False
+
+        # attr -> entry-context name -> [(write node, guarded)]
+        writes: Dict[str, Dict[str, List[Tuple[ast.AST, bool]]]] = {}
+        for name, node in uniq:
+            for n in ast.walk(node):
+                tgt = None
+                if isinstance(n, (ast.Assign,)):
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            tgt = t
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign)) \
+                        and isinstance(n.target, ast.Attribute) \
+                        and isinstance(n.target.value, ast.Name) \
+                        and n.target.value.id == "self":
+                    tgt = n.target
+                if tgt is None:
+                    continue
+                writes.setdefault(tgt.attr, {}).setdefault(name, []) \
+                    .append((n, under_lock(n, node)))
+        for attr, by_entry in writes.items():
+            if len(by_entry) < 2:
+                continue
+            for entry_name, sites in by_entry.items():
+                for n, guarded in sites:
+                    if guarded:
+                        continue
+                    others = sorted(e for e in by_entry if e != entry_name)
+                    out.append(Finding(
+                        "GC04", ctx.relpath, n.lineno, n.col_offset,
+                        f"self.{attr} written from thread entry point "
+                        f"'{entry_name}' without the owning lock, and "
+                        f"also written from {', '.join(others)} — "
+                        f"unsynchronized multi-thread mutation",
+                        _GC04_HINT, ctx.qualname(n)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC05 — surface-parity
+# ---------------------------------------------------------------------------
+
+_GC05_NAME_RE = re.compile(r"^[A-Za-z0-9_]+$")
+_GC05_HINT = ("registry section names and stub keys become Prometheus "
+              "metric name parts — [A-Za-z0-9_] only, and stub/live key "
+              "sets must mirror (tests/test_obs.py pins the runtime "
+              "side; this is the source-level gate)")
+
+
+def _stub_defs(tree: ast.Module) -> Dict[str, Tuple[ast.AST,
+                                                    Tuple[str, ...]]]:
+    out = {}
+    for n in tree.body:
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and n.targets[0].id.endswith("_STUB") \
+                and isinstance(n.value, ast.Dict):
+            keys = tuple(k.value for k in n.value.keys
+                         if isinstance(k, ast.Constant)
+                         and isinstance(k.value, str))
+            out[n.targets[0].id] = (n, keys)
+    return out
+
+
+def collect_project(contexts: List[ModuleContext]) -> ProjectIndex:
+    """First pass: stub constants + their alias functions (a module-level
+    def whose body references exactly one ``*_STUB`` name, e.g.
+    ``serve.promote.promotion_stub``)."""
+    stubs: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+    aliases: Dict[str, str] = {}
+    for ctx in contexts:
+        for name, (node, keys) in _stub_defs(ctx.tree).items():
+            stubs[name] = (ctx.relpath, keys)
+        for n in ctx.tree.body:
+            if not isinstance(n, FUNCS):
+                continue
+            refs = {x.id for x in ast.walk(n)
+                    if isinstance(x, ast.Name) and x.id.endswith("_STUB")}
+            if len(refs) == 1:
+                aliases[n.name] = refs.pop()
+    return ProjectIndex(stubs=stubs, stub_aliases=aliases)
+
+
+def _literal_keys_of(fn: ast.AST, ctx: ModuleContext,
+                     project: ProjectIndex, stub_name: str):
+    """(unconditional_keys, all_keys, dynamic, seeded) for the dict the
+    live provider RETURNS: dict literals assigned to a returned name (or
+    returned directly), ``d.update({...})`` calls and constant subscript
+    assigns on it. Dicts bound to other locals (nested per-window
+    payloads etc.) do not count. ``dynamic`` = a non-literal update or
+    non-constant key feeds the dict (key set not statically closed);
+    ``seeded`` = the dict starts as a copy of the stub."""
+    uncond: Set[str] = set()
+    allk: Set[str] = set()
+    dynamic = seeded = False
+
+    def conditional(n: ast.AST) -> bool:
+        for a in ctx.ancestors(n):
+            if a is fn:
+                return False
+            if isinstance(a, (ast.If, ast.Try, ast.IfExp)):
+                return True
+        return False
+
+    def eat_dict(d: ast.Dict, cond: bool) -> None:
+        nonlocal dynamic
+        for k in d.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                allk.add(k.value)
+                if not cond:
+                    uncond.add(k.value)
+            else:
+                dynamic = True           # **spread or computed key
+
+    nodes = []
+    stack = list(fn.body)
+    while stack:
+        x = stack.pop()
+        if isinstance(x, FUNCS + (ast.Lambda,)):
+            continue                     # nested scope builds other dicts
+        nodes.append(x)
+        stack.extend(ast.iter_child_nodes(x))
+
+    returned: Set[str] = set()           # names the provider returns
+    for n in nodes:
+        if isinstance(n, ast.Return) and isinstance(n.value, ast.Name):
+            returned.add(n.value.id)
+
+    def targets_of(n: ast.Assign):
+        return [t.id for t in n.targets if isinstance(t, ast.Name)]
+
+    for n in nodes:
+        if isinstance(n, (ast.Assign, ast.AnnAssign)):
+            v = n.value
+            names = targets_of(n) if isinstance(n, ast.Assign) else (
+                [n.target.id] if isinstance(n.target, ast.Name) else [])
+            if v is not None and returned & set(names):
+                if isinstance(v, ast.Dict):
+                    eat_dict(v, conditional(n))
+                if isinstance(v, ast.Call):
+                    callee = _dec_name(v)
+                    if project.stub_aliases.get(callee) == stub_name:
+                        seeded = True
+                    if callee == "dict" and v.args \
+                            and isinstance(v.args[0], ast.Name) \
+                            and v.args[0].id == stub_name:
+                        seeded = True
+            # d["k"] = v on the returned dict
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in returned:
+                        s = t.slice
+                        if isinstance(s, ast.Constant) \
+                                and isinstance(s.value, str):
+                            allk.add(s.value)
+                            if not conditional(n):
+                                uncond.add(s.value)
+                        else:
+                            dynamic = True
+        elif isinstance(n, ast.Return) and isinstance(n.value, ast.Dict):
+            eat_dict(n.value, conditional(n))
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "update" \
+                and isinstance(n.func.value, ast.Name) \
+                and n.func.value.id in returned:
+            if n.args and isinstance(n.args[0], ast.Dict):
+                eat_dict(n.args[0], conditional(n))
+            else:
+                dynamic = True
+    return uncond, allk, dynamic, seeded
+
+
+def gc05_surface_parity(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    out: List[Finding] = []
+
+    # (b) name grammar: registry.register("<literal>", ...) everywhere,
+    # and stub-dict keys (they all become /metrics name parts)
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "register" \
+                and "registry" in _dec_name(n.func.value).lower():
+            if n.args and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                name = n.args[0].value
+                if not _GC05_NAME_RE.match(name):
+                    out.append(Finding(
+                        "GC05", ctx.relpath, n.lineno, n.col_offset,
+                        f"registry section name {name!r} violates the "
+                        f"to_prometheus name grammar ([A-Za-z0-9_] only)",
+                        _GC05_HINT, ctx.qualname(n)))
+    for stub_name, (node, keys) in _stub_defs(ctx.tree).items():
+        bad = [k for k in keys if not _GC05_NAME_RE.match(k)]
+        # nested dict literal keys feed metric names too
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict) and sub is not getattr(
+                    node, "value", None):
+                bad.extend(k.value for k in sub.keys
+                           if isinstance(k, ast.Constant)
+                           and isinstance(k.value, str)
+                           and not _GC05_NAME_RE.match(k.value))
+        for k in bad:
+            out.append(Finding(
+                "GC05", ctx.relpath, node.lineno, node.col_offset,
+                f"stub {stub_name} key {k!r} violates the to_prometheus "
+                f"name grammar ([A-Za-z0-9_] only)",
+                _GC05_HINT, stub_name))
+
+    # (a) stub-vs-live key parity: find provider closures referencing
+    # exactly one stub and calling exactly one *_section method, then
+    # compare that method's literal key set against the stub
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, FUNCS):
+            continue
+        refs = set()
+        for x in ast.walk(fn):
+            if isinstance(x, ast.Name) and x.id.endswith("_STUB"):
+                refs.add(x.id)
+            elif isinstance(x, ast.Call) \
+                    and _dec_name(x) in project.stub_aliases:
+                refs.add(project.stub_aliases[_dec_name(x)])
+        section_calls = {x.func.attr for x in ast.walk(fn)
+                         if isinstance(x, ast.Call)
+                         and isinstance(x.func, ast.Attribute)
+                         and x.func.attr.endswith("_section")}
+        if len(refs) != 1 or len(section_calls) != 1:
+            continue
+        stub_name = refs.pop()
+        if stub_name not in project.stubs:
+            continue
+        method_name = section_calls.pop()
+        cls = None
+        for a in ctx.ancestors(fn):
+            if isinstance(a, ast.ClassDef):
+                cls = a
+                break
+        if cls is None:
+            continue
+        live = next((m for m in cls.body if isinstance(m, FUNCS)
+                     and m.name == method_name), None)
+        if live is None or live is fn:
+            continue
+        stub_keys = set(project.stubs[stub_name][1])
+        uncond, allk, dynamic, seeded = _literal_keys_of(
+            live, ctx, project, stub_name)
+        for k in sorted(uncond - stub_keys):
+            out.append(Finding(
+                "GC05", ctx.relpath, live.lineno, live.col_offset,
+                f"live provider '{cls.name}.{method_name}' emits key "
+                f"{k!r} absent from {stub_name} — stub/live key drift "
+                f"(gauges appear and vanish across subsystem lifecycle)",
+                _GC05_HINT, f"{cls.name}.{method_name}"))
+        if not (dynamic or seeded):
+            for k in sorted(stub_keys - allk):
+                out.append(Finding(
+                    "GC05", ctx.relpath, live.lineno, live.col_offset,
+                    f"{stub_name} key {k!r} never emitted by live "
+                    f"provider '{cls.name}.{method_name}' — stub/live "
+                    f"key drift",
+                    _GC05_HINT, f"{cls.name}.{method_name}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC06 — broad-except discipline (serve/ and obs/ hot paths)
+# ---------------------------------------------------------------------------
+
+_GC06_DIRS = {"serve", "obs"}
+_GC06_HINT = ("narrow the exception type, or add a trailing comment on "
+              "the handler naming why failure isolation is required "
+              "(obs must never take serving down, etc.)")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [getattr(e, "id", getattr(e, "attr", "")) for e in t.elts]
+    else:
+        names = [getattr(t, "id", getattr(t, "attr", ""))]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def gc06_broad_except(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    if not (_GC06_DIRS & set(ctx.parts[:-1])):
+        return []
+    out: List[Finding] = []
+    for n in ast.walk(ctx.tree):
+        if not (isinstance(n, ast.ExceptHandler) and _is_broad(n)):
+            continue
+        first_body = n.body[0].lineno if n.body else n.lineno
+        annotated = any(line in ctx.comments
+                        for line in range(n.lineno, first_body + 1))
+        if annotated:
+            continue
+        out.append(Finding(
+            "GC06", ctx.relpath, n.lineno, n.col_offset,
+            "broad `except Exception` without a why-comment — silent "
+            "catch-alls in serving/observability hot paths hide real "
+            "failures",
+            _GC06_HINT, ctx.qualname(n)))
+    return out
+
+
+#: rule registry: code -> (function, one-line description)
+RULES = {
+    "GC01": (gc01_retrace_hazard,
+             "retrace-hazard: per-call jit closures / nested compile "
+             "factories"),
+    "GC02": (gc02_clock_discipline,
+             "clock-discipline: time.time() in duration arithmetic"),
+    "GC03": (gc03_atomic_write,
+             "atomic-write: bare write-open in io//serve/ outside the "
+             "tmp->fsync->os.replace idiom"),
+    "GC04": (gc04_lock_discipline,
+             "lock-discipline: unsynchronized multi-thread attribute "
+             "mutation / acquire() without with"),
+    "GC05": (gc05_surface_parity,
+             "surface-parity: stub/live registry key drift + Prometheus "
+             "name grammar"),
+    "GC06": (gc06_broad_except,
+             "broad-except: unannotated `except Exception` in serve//obs/"),
+}
+
+
+def run_rules(ctx: ModuleContext, project: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for code, (fn, _desc) in RULES.items():
+        for f in fn(ctx, project):
+            # nested provider closures can satisfy an associator twice
+            # (the closure AND its enclosing method) — one finding per
+            # (line, code, message) is enough
+            key = (f.code, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                findings.append(f)
+    return findings

@@ -1,4 +1,19 @@
 #!/bin/sh
+# graftcheck gate (docs/STATIC_ANALYSIS.md): project-invariant static
+# analysis, run FIRST because it is the cheapest phase (~15 s, AST-only).
+# --selfcheck proves the gate in both directions before the real scan —
+# every rule must fire on a seeded violation in a scratch tree and the
+# baseline machinery must silence fresh findings / flag stale entries —
+# then the bare run fails on ANY finding (the tree's contract since
+# PR 11 is an EMPTY baseline; a PR that must land with debt commits
+# graftcheck_baseline.json, which the bare run picks up from the repo
+# root, and the gate keeps failing once a baselined finding is fixed
+# but its entry lingers).
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m hivemall_tpu.tools.graftcheck --selfcheck || exit $?
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m hivemall_tpu.tools.graftcheck || exit $?
+
 # Run the test suite on CPU (8 virtual devices), never touching the TPU
 # tunnel: PALLAS_AXON_POOL_IPS triggers a relay dial at interpreter boot via
 # sitecustomize, and the relay is single-client — tests must stay off it.

@@ -1,0 +1,538 @@
+"""graftcheck static-analyzer tests (docs/STATIC_ANALYSIS.md).
+
+Per rule: a seeded violation MUST be caught and the known-good repo
+idiom MUST pass clean. Then the repo-level contracts: the ~67
+compile-factory sites across models/, ops/ and parallel/ pass GC01
+(floor 60 asserted below), the atomic
+write helpers in io/ pass GC03, the whole tree gates clean with an
+EMPTY baseline, the baseline flags stale entries, and graftcheck runs
+clean on its own source (self-lint).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from hivemall_tpu.tools.graftcheck import run_paths
+from hivemall_tpu.tools.graftcheck.engine import (gate, load_baseline,
+                                                  scan_file,
+                                                  write_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "hivemall_tpu")
+
+
+def check_src(tmp_path, src, rel="pkg/mod.py"):
+    """Write one module into a scratch tree and scan it."""
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return run_paths([str(tmp_path)], root=str(tmp_path))
+
+
+def codes(findings):
+    return sorted({f.code for f in findings})
+
+
+# -- GC01 retrace-hazard ----------------------------------------------------
+
+def test_gc01_per_call_jit_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        import jax
+        def predict(f, x):
+            g = jax.jit(f)
+            return g(x)
+    """)
+    assert codes(out) == ["GC01"]
+
+
+def test_gc01_immediate_invoke_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        import jax
+        def predict(f, x):
+            return jax.jit(f)(x)
+    """)
+    assert codes(out) == ["GC01"]
+
+
+def test_gc01_loop_creation_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        import jax
+        def build_all(fns):
+            out = []
+            for f in fns:
+                out.append(jax.jit(f))
+            return out
+    """)
+    assert codes(out) == ["GC01"]
+
+
+def test_gc01_nested_lru_cache_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        from functools import lru_cache
+        def make():
+            @lru_cache(maxsize=8)
+            def factory(n):
+                return n
+            return factory
+    """)
+    assert codes(out) == ["GC01"]
+
+
+def test_gc01_factory_returning_closure_clean(tmp_path):
+    # the repo's _make_step idiom: jit closure escapes via return
+    out = check_src(tmp_path, """
+        import jax
+        class Trainer:
+            def _make_step(self):
+                lam = 0.1
+                @jax.jit
+                def step(w, x):
+                    return w - lam * x
+                return step
+    """)
+    assert out == []
+
+
+def test_gc01_memoized_factory_with_warmup_call_clean(tmp_path):
+    # lru_cache factory may warm the closure before returning it
+    out = check_src(tmp_path, """
+        import jax
+        from functools import lru_cache
+        @lru_cache(maxsize=64)
+        def _step_cached(dims):
+            f = jax.jit(lambda w: w * dims)
+            f(0.0)
+            return f
+    """)
+    assert out == []
+
+
+def test_gc01_self_store_clean(tmp_path):
+    out = check_src(tmp_path, """
+        import jax
+        class Engine:
+            def __init__(self, f):
+                self._scorer = jax.jit(f)
+    """)
+    assert out == []
+
+
+def test_gc01_known_good_compile_factories_pass():
+    """The known-good compile-factory population — every lru_cache/jit
+    site across models/, ops/ and parallel/ — must pass GC01 clean, and
+    the site count proves the assertion is not vacuous."""
+    dirs = [os.path.join(PKG, d) for d in ("models", "ops", "parallel")]
+    out = run_paths(dirs, root=REPO)
+    assert [f for f in out if f.code == "GC01"] == []
+    n_sites = 0
+    for base in dirs:
+        for fname in os.listdir(base):
+            if fname.endswith(".py"):
+                with open(os.path.join(base, fname)) as f:
+                    src = f.read()
+                n_sites += src.count("jax.jit") + src.count("lru_cache(")
+    assert n_sites >= 60, f"factory population shrank? saw {n_sites}"
+
+
+# -- GC02 clock-discipline --------------------------------------------------
+
+def test_gc02_direct_subtraction_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        import time
+        def age(t0):
+            return time.time() - t0
+    """)
+    assert codes(out) == ["GC02"]
+
+
+def test_gc02_deadline_compare_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        import time
+        def wait(seconds):
+            deadline = time.time() + seconds
+            while time.time() < deadline:
+                pass
+    """)
+    assert codes(out) == ["GC02"]
+
+
+def test_gc02_tainted_name_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        import time
+        def span(t_hi):
+            now = time.time()
+            return now - t_hi
+    """)
+    assert codes(out) == ["GC02"]
+
+
+def test_gc02_wall_anchor_export_clean(tmp_path):
+    # plain timestamping (no duration math) is the legitimate use
+    out = check_src(tmp_path, """
+        import time
+        def record():
+            return {"ts": round(time.time(), 3)}
+    """)
+    assert out == []
+
+
+def test_gc02_monotonic_clean(tmp_path):
+    out = check_src(tmp_path, """
+        import time
+        def wait(seconds):
+            deadline = time.monotonic() + seconds
+            while time.monotonic() < deadline:
+                pass
+    """)
+    assert out == []
+
+
+def test_gc02_suppression_trailing_and_line_above(tmp_path):
+    out = check_src(tmp_path, """
+        import time
+        def age(mtime, other):
+            a = time.time() - mtime  # graftcheck: disable=GC02
+            # graftcheck: disable=GC02
+            b = time.time() - other
+            return a + b
+    """)
+    assert out == []
+
+
+# -- GC03 atomic-write ------------------------------------------------------
+
+GC03_BAD = """
+    def save_pointer(path, obj):
+        with open(path, "w") as f:
+            f.write(obj)
+"""
+
+GC03_GOOD = """
+    import os
+    def save_pointer(path, obj):
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(obj)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+"""
+
+
+def test_gc03_bare_write_in_io_flagged(tmp_path):
+    assert codes(check_src(tmp_path, GC03_BAD, "pkg/io/x.py")) == ["GC03"]
+    assert codes(check_src(tmp_path, GC03_BAD, "pkg/serve/x.py")) \
+        == ["GC03"]
+
+
+def test_gc03_atomic_idiom_clean(tmp_path):
+    assert check_src(tmp_path, GC03_GOOD, "pkg/io/x.py") == []
+
+
+def test_gc03_outside_io_serve_not_scanned(tmp_path):
+    assert check_src(tmp_path, GC03_BAD, "pkg/models/x.py") == []
+
+
+def test_gc03_read_open_clean(tmp_path):
+    out = check_src(tmp_path, """
+        def load(path):
+            with open(path) as f:
+                return f.read()
+    """, "pkg/io/x.py")
+    assert out == []
+
+
+def test_gc03_repo_atomic_helpers_pass():
+    for rel in ("io/checkpoint.py", "io/shard_cache.py"):
+        out = scan_file(os.path.join(PKG, rel), root=REPO)
+        assert [f for f in out if f.code == "GC03"] == [], rel
+
+
+# -- GC04 lock-discipline ---------------------------------------------------
+
+GC04_RACY = """
+    import threading
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+            threading.Thread(target=self._a).start()
+            threading.Thread(target=self._b).start()
+        def _a(self):
+            self.n += 1
+        def _b(self):
+            self.n -= 1
+"""
+
+
+def test_gc04_two_entry_unguarded_flagged(tmp_path):
+    out = check_src(tmp_path, GC04_RACY)
+    assert codes(out) == ["GC04"] and len(out) == 2
+
+
+def test_gc04_guarded_writes_clean(tmp_path):
+    out = check_src(tmp_path, GC04_RACY.replace(
+        "self.n += 1", "with self._lock:\n                self.n += 1")
+        .replace("self.n -= 1",
+                 "with self._lock:\n                self.n -= 1"))
+    assert out == []
+
+
+def test_gc04_single_entry_clean(tmp_path):
+    out = check_src(tmp_path, """
+        import threading
+        class W:
+            def __init__(self):
+                threading.Thread(target=self._a).start()
+            def _a(self):
+                self.n = 1
+            def stop(self):
+                self.done = True
+    """)
+    assert out == []
+
+
+def test_gc04_acquire_without_with_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        def f(lock):
+            lock.acquire()
+            try:
+                pass
+            finally:
+                lock.release()
+    """)
+    assert codes(out) == ["GC04"]
+
+
+def test_gc04_with_lock_clean(tmp_path):
+    out = check_src(tmp_path, """
+        def f(lock):
+            with lock:
+                pass
+    """)
+    assert out == []
+
+
+# -- GC05 surface-parity ----------------------------------------------------
+
+def test_gc05_live_extra_key_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        FOO_STUB = {"ok": 0}
+        class P:
+            def obs_section(self):
+                return {"ok": 0, "extra": 1}
+            def _register_obs(self):
+                def p():
+                    return (self.obs_section() if self else
+                            dict(FOO_STUB))
+                registry.register("foo", p)
+    """)
+    assert codes(out) == ["GC05"]
+    assert any("extra" in f.message for f in out)
+
+
+def test_gc05_stub_key_never_emitted_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        FOO_STUB = {"ok": 0, "ghost": 0}
+        class P:
+            def obs_section(self):
+                return {"ok": 0}
+            def _register_obs(self):
+                def p():
+                    return (self.obs_section() if self else
+                            dict(FOO_STUB))
+    """)
+    assert any("ghost" in f.message for f in out if f.code == "GC05")
+
+
+def test_gc05_matching_and_dynamic_clean(tmp_path):
+    out = check_src(tmp_path, """
+        FOO_STUB = {"ok": 0, "n": 0}
+        class P:
+            def gather(self):
+                return {}
+            def obs_section(self):
+                d = {"ok": 1, "n": 2}
+                d.update(self.gather())
+                return d
+            def _register_obs(self):
+                def p():
+                    return (self.obs_section() if self else
+                            dict(FOO_STUB))
+    """)
+    assert out == []
+
+
+def test_gc05_name_grammar_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        BAR_STUB = {"bad-dash": 0}
+        registry.register("bad.name", lambda: {})
+    """)
+    msgs = [f.message for f in out if f.code == "GC05"]
+    assert len(msgs) == 2
+    assert any("bad.name" in m for m in msgs)
+    assert any("bad-dash" in m for m in msgs)
+
+
+def test_gc05_repo_stub_parity_clean():
+    """The real registry stubs vs their live providers, from source."""
+    out = run_paths([PKG], root=REPO)
+    assert [f for f in out if f.code == "GC05"] == []
+
+
+# -- GC06 broad-except ------------------------------------------------------
+
+def test_gc06_unannotated_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        def f():
+            try:
+                pass
+            except Exception:
+                pass
+    """, "pkg/serve/x.py")
+    assert codes(out) == ["GC06"]
+
+
+def test_gc06_annotated_clean(tmp_path):
+    out = check_src(tmp_path, """
+        def f():
+            try:
+                pass
+            except Exception:   # isolation: obs must never kill serving
+                pass
+            try:
+                pass
+            except Exception:
+                pass            # second style: comment on the body line
+    """, "pkg/obs/x.py")
+    assert out == []
+
+
+def test_gc06_outside_hot_dirs_clean(tmp_path):
+    out = check_src(tmp_path, """
+        def f():
+            try:
+                pass
+            except Exception:
+                pass
+    """, "pkg/models/x.py")
+    assert out == []
+
+
+# -- whole-repo gate + baseline + self-lint ---------------------------------
+
+def test_repo_gates_clean_with_empty_baseline():
+    """The acceptance bar: the tree carries ZERO findings — no baseline
+    debt at all (docs/STATIC_ANALYSIS.md records the contract)."""
+    out = run_paths([PKG], root=REPO)
+    assert out == [], "\n".join(f.render() for f in out)
+
+
+def test_self_lint():
+    out = run_paths([os.path.join(PKG, "tools")], root=REPO)
+    assert out == [], "\n".join(f.render() for f in out)
+
+
+def test_baseline_roundtrip_and_stale_detection(tmp_path):
+    findings = check_src(tmp_path, GC03_BAD, "pkg/io/x.py")
+    assert findings
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), findings)
+    fresh, stale = gate(findings, load_baseline(str(bl)))
+    assert fresh == [] and stale == []
+    # the violation gets fixed -> its entry must go stale (gate fails)
+    fresh, stale = gate([], load_baseline(str(bl)))
+    assert fresh == [] and len(stale) == len(findings)
+
+
+def test_baseline_stale_scoped_to_scanned_paths(tmp_path):
+    """A PARTIAL scan must not flag baseline entries for files outside
+    the scanned roots as stale; entries under a scanned root (e.g. a
+    deleted file) still go stale."""
+    findings = check_src(tmp_path, GC03_BAD, "pkg/io/x.py")
+    other = "pkg/serve/other.py::GC03::f::bare open elsewhere"
+    gone = "pkg/io/gone.py::GC03::f::file was deleted"
+    baseline = [f.fingerprint for f in findings] + [other, gone]
+    # scanning only pkg/io: `other` (serve/) is out of scope, `gone`
+    # (io/, no longer present) is stale
+    fresh, stale = gate(findings, baseline, covered=["pkg/io"])
+    assert fresh == [] and stale == [gone]
+    # a full scan judges everything
+    fresh, stale = gate(findings, baseline, covered=["pkg"])
+    assert sorted(stale) == sorted([other, gone])
+
+
+def test_slo_explicit_wall_ts_vs_default_evaluate():
+    """Samples fed with explicit wall-clock ts + evaluate() on the
+    default clock: the epoch-mismatch guard anchors the window to the
+    freshest sample instead of degrading windows to lifetime totals."""
+    import time as _time
+
+    from hivemall_tpu.obs.slo import SloEngine
+    eng = SloEngine(p99_ms=100.0, availability=0.999)
+    t0 = _time.time()                  # wall epoch, ~1.7e9
+    for i in range(6):
+        eng.sample({"requests": 100 * (i + 1)}, ts=t0 + 400.0 * i)
+    out = eng.evaluate()               # default (monotonic) clock
+    w5 = out["windows"]["5m"]
+    # the 5m window must anchor at the newest sample and reach only the
+    # 400s-older neighbor — NOT the 2000s-old first sample
+    assert w5["requests"] == 100, w5
+    assert out["windows"]["1h"]["requests"] == 500
+
+
+def test_baseline_fingerprint_line_insensitive(tmp_path):
+    a = check_src(tmp_path, GC03_BAD, "pkg/io/a.py")
+    b = check_src(tmp_path, "\n\n# moved two lines down\n"
+                  + textwrap.dedent(GC03_BAD), "pkg/io/a.py")
+    assert [f.fingerprint for f in a] == [f.fingerprint for f in b]
+    assert a[0].line != b[0].line
+
+
+@pytest.mark.parametrize("mode", ["violation", "baselined", "stale"])
+def test_cli_exit_codes(tmp_path, mode):
+    tree = tmp_path / "pkg" / "io"
+    tree.mkdir(parents=True)
+    bad = tree / "bad.py"
+    bad.write_text(textwrap.dedent(GC03_BAD))
+    bl = tmp_path / "bl.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "hivemall_tpu.tools.graftcheck",
+             str(tmp_path / "pkg"), "--root", str(tmp_path), *extra],
+            capture_output=True, text=True, cwd=REPO, env=env)
+
+    if mode == "violation":
+        r = run()
+        assert r.returncode == 1 and "GC03" in r.stdout
+    elif mode == "baselined":
+        assert run("--write-baseline", str(bl)).returncode == 0
+        r = run("--baseline", str(bl))
+        assert r.returncode == 0 and "clean" in r.stdout
+    else:
+        assert run("--write-baseline", str(bl)).returncode == 0
+        data = json.loads(bl.read_text())
+        data["findings"].append(
+            "pkg/io/gone.py::GC03::save::already fixed")
+        bl.write_text(json.dumps(data))
+        r = run("--baseline", str(bl))
+        assert r.returncode == 1 and "STALE" in r.stdout
+
+
+@pytest.mark.slow
+def test_cli_selfcheck():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "hivemall_tpu.tools.graftcheck",
+         "--selfcheck"], capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "bidirectional" in r.stdout
