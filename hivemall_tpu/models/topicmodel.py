@@ -240,6 +240,9 @@ class LDATrainer:
         chunk = max(B * 8, 2048)       # bound the flat token buffer
         for s in range(0, len(docs), chunk):
             sub = docs[s:s + chunk]
+            # host-side tokenize/hash over Python token lists — the
+            # np.asarray inside builds HOST arrays, no device sync
+            # graftcheck: disable=GC07
             uids, sums, doc_starts = self._word_ids_flat(sub)
             rl = np.minimum(np.diff(doc_starts),
                             int(self.opts.max_doc_len)).astype(np.int64)

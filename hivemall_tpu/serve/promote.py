@@ -661,3 +661,4 @@ def promotion_manifest_view(checkpoint_dir: Optional[str]) -> dict:
         out["promoted_step"] = (m.get("current") or {}).get("step")
         out["state"] = m.get("state")
     return out
+

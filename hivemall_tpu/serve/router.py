@@ -386,7 +386,12 @@ class RouterServer:
         self._lock = threading.Lock()
         self._handles: Dict[str, ReplicaHandle] = {}
         self._ring = _Ring()
-        # counters (the router's own part of the fleet obs section)
+        # counters (the router's own part of the fleet obs section) —
+        # bumped from CONCURRENT per-connection handler threads, so
+        # every `+=` (read-modify-write, not atomic) takes _stats_lock;
+        # the tsan lockset sanitizer caught the original bare bumps
+        # losing updates under handler-thread interleaving
+        self._stats_lock = threading.Lock()
         self.routed = 0
         self.retries = 0
         self.traced = 0                  # requests with a trace id
@@ -475,28 +480,35 @@ class RouterServer:
             try:                         # skew least-loaded forever
                 status, payload, lines = self._forward(
                     h, "POST", "/predict", body, extra_head=extra_head)
-                h.forwarded += 1
-                self.routed += 1
+                with h._lock:
+                    h.forwarded += 1
                 total_s = time.monotonic() - t0
+                with self._stats_lock:
+                    self.routed += 1
+                    if trace_id:
+                        self.traced += 1
                 if trace_id:
-                    self.traced += 1
                     # the router's half of the cross-process flame
                     tr.add_span("router.forward", total_s, trace=trace_id)
                 return status, self._relay_with_hops(
                     lines, payload, total_s), None
             except _RETRYABLE as e:
-                h.transport_errors += 1
+                with h._lock:
+                    h.transport_errors += 1
                 h.ready = False          # immediate gate; the manager's
                 h.close_pool()           # health poll revives or respawns
                 last_err = f"{h.rid}: {type(e).__name__}: {e}"
-                self.retries += 1
+                with self._stats_lock:
+                    self.retries += 1
             finally:
                 with h._lock:
                     h.inflight -= 1
         if last_err is None:
-            self.no_replica += 1
+            with self._stats_lock:
+                self.no_replica += 1
             return 503, None, {"error": "no ready replica", "shed": True}
-        self.proxy_errors += 1
+        with self._stats_lock:
+            self.proxy_errors += 1
         return 502, None, {"error": f"all replicas failed: {last_err}"}
 
     @staticmethod
@@ -699,3 +711,4 @@ class RouterServer:
         self._http.stop()
         for h in self.replicas():
             h.close_pool()
+

@@ -66,6 +66,15 @@ def main(argv=None) -> int:
     ap.add_argument("--p99-budget-ms", type=float, default=1500.0,
                     help="per-request p99 wall budget (generous: CPU CI)")
     args = ap.parse_args(argv)
+    # lockset race sanitizer (HIVEMALL_TPU_TSAN=1): enable BEFORE any
+    # serve object exists so every lock in the system is born wrapped;
+    # a sanitizer build is never a perf build, so the latency budget
+    # relaxes (correctness checks — bit-match, zero drops — stay hard)
+    from ..testing import tsan
+    if tsan.maybe_enable():
+        args.p99_budget_ms *= 3
+        print(f"serve smoke: tsan sanitizer ON (p99 budget relaxed to "
+              f"{args.p99_budget_ms}ms)", file=sys.stderr)
     tmp = tempfile.mkdtemp(prefix="hivemall_tpu_serve_smoke_")
     try:
         return _run(args, tmp)
@@ -207,6 +216,12 @@ def _drive(args, tmp, ds, rows, ref, engine, srv, base) -> int:
     prom = _get(base + "/metrics").decode()
     check("obs_metrics", "hivemall_tpu_serve_model_step" in prom
           and "hivemall_tpu_serve_qps" in prom)
+
+    # -- lockset sanitizer verdict (only when HIVEMALL_TPU_TSAN=1) --------
+    from ..testing import tsan
+    if tsan.enabled():
+        check("tsan_races",
+              tsan.check_and_report("serve smoke tsan") == 0)
 
     print(f"serve smoke: {len(failures)} failures", file=sys.stderr)
     return len(failures)

@@ -1,0 +1,531 @@
+"""Eraser-style lockset race sanitizer (docs/STATIC_ANALYSIS.md).
+
+The dynamic twin of graftcheck's GC04: where the static rule reasons
+about locks it can SEE in the source, this module watches locks that are
+actually HELD at runtime and reports shared-attribute writes whose
+candidate lockset goes empty — the classic Eraser algorithm
+(Savage et al., SOSP '97), restricted to write/write races on
+**registered classes** (read instrumentation would mean hooking every
+attribute load; writes are where the serving stack's races live — the
+PR 11 ``PredictEngine.last_reload_error`` bug was two writer threads).
+
+How it works, when enabled:
+
+- ``threading.Lock`` / ``threading.RLock`` are replaced with thin
+  wrappers that maintain a per-thread set of held locks (``Condition``
+  and ``Event`` compose on top of them unchanged — the wrappers
+  implement the private ``_release_save``/``_acquire_restore``/
+  ``_is_owned`` hooks so ``Condition.wait`` keeps tracking).
+- every class passed to :func:`register` gets its ``__setattr__``
+  patched to feed each write into a per-``(object, attribute)`` state
+  machine. The serving/obs fleet (batcher, engine, fleet manager, SLO
+  engine, promotion controller, router) is signed up by the sanitizer
+  itself (:data:`_AUTO_REGISTER`, resolved when :func:`enable` runs) —
+  production modules never import test infrastructure:
+
+  ``virgin -> exclusive(T1) -> exclusive2(T2, lockset) -> shared``
+
+  The extra ``exclusive2`` state is the standard refinement for
+  constructor handoff: T1 (the constructing thread) initializes fields,
+  then hands the object to ONE worker thread — ``Thread.start()``
+  establishes the happens-before edge pure Eraser cannot see, so the
+  first ownership transfer never intersects against the constructor's
+  (usually empty) lockset. From the second thread onward the candidate
+  lockset intersects with every write's held set; an EMPTY intersection
+  is a race, reported once per (object, attribute) with both writers'
+  stacks.
+
+Gating: ``HIVEMALL_TPU_TSAN=1`` turns :func:`maybe_enable` on (the
+serve/fleet smokes call it before building anything, so every lock in
+the system is born wrapped); ``HIVEMALL_TPU_TSAN_LOG=<path>`` appends
+each race report as a JSON line — run_tests.sh collects it as a CI
+artifact. Overhead is per-acquire and per-registered-write only; the
+scoring hot path (attribute READS, jit dispatch) is untouched, but
+sanitizer runs still relax latency assertions (a sanitizer build is
+never a perf build).
+
+Known limitations (the static rules and runtime tests remain the
+backstop): write/write only (no read instrumentation); container
+mutation (``self.d[k] = v``) is not an attribute write; locks created
+BEFORE :func:`enable` are invisible (enable first, construct second);
+objects that never see a second writing thread report nothing.
+"""
+
+from __future__ import annotations
+
+import _thread
+import importlib
+import itertools
+import json
+import os
+import sys
+import threading
+import traceback
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["enable", "disable", "enabled", "maybe_enable", "register",
+           "races", "reset", "check_and_report", "selfcheck_race",
+           "ENV_FLAG", "ENV_LOG"]
+
+ENV_FLAG = "HIVEMALL_TPU_TSAN"
+ENV_LOG = "HIVEMALL_TPU_TSAN_LOG"
+
+_MAX_RACES = 100                 # bound memory under a pathological run
+_STACK_LIMIT = 12
+
+# raw (untracked) lock guarding the sanitizer's own state — allocated
+# from _thread directly so it can never recurse into the wrappers
+_state_lock = _thread.allocate_lock()
+_tls = threading.local()
+
+_enabled = False
+_orig_lock = threading.Lock
+_orig_rlock = threading.RLock
+# per-thread identity TOKEN: threading.get_ident() values are REUSED
+# once a thread dies, which would conflate two sequential writer
+# threads into one "owner" and silently miss their race — each thread
+# instead draws a unique monotonic token on first write (count.__next__
+# is atomic under the GIL; the thread-local dies with the thread, the
+# token never comes back)
+_token_counter = itertools.count(1)
+_registered: List[type] = []                 # classes to instrument
+_patched: Dict[type, Any] = {}               # cls -> original __setattr__
+
+#: the serving/obs fleet, instrumented whenever the sanitizer turns on.
+#: The dependency points THIS way on purpose: the sanitizer knows about
+#: the fleet, production modules never import testing/ (a prod image
+#: that prunes the package still serves). Resolved lazily at
+#: :func:`enable` time — already-imported modules are free, the rest
+#: are imported then (after the lock wrappers are in place, so module-
+#: level locks are born tracked).
+_AUTO_REGISTER: Tuple[Tuple[str, str], ...] = (
+    ("hivemall_tpu.serve.engine", "PredictEngine"),
+    ("hivemall_tpu.serve.batcher", "MicroBatcher"),
+    ("hivemall_tpu.serve.router", "RouterServer"),
+    ("hivemall_tpu.serve.fleet", "ReplicaManager"),
+    ("hivemall_tpu.serve.fleet", "Fleet"),
+    ("hivemall_tpu.serve.promote", "PromotionController"),
+    ("hivemall_tpu.obs.slo", "SloEngine"),
+)
+_states: "weakref.WeakKeyDictionary[Any, Dict[str, dict]]" = \
+    weakref.WeakKeyDictionary()
+_races: List[dict] = []
+
+
+def _held() -> Dict[int, int]:
+    d = getattr(_tls, "held", None)
+    if d is None:
+        d = {}
+        _tls.held = d
+    return d
+
+
+def _note_acquire(lock_id: int) -> None:
+    d = _held()
+    d[lock_id] = d.get(lock_id, 0) + 1
+
+
+def _note_release(lock_id: int) -> None:
+    d = _held()
+    n = d.get(lock_id, 0)
+    if n <= 1:
+        d.pop(lock_id, None)
+    else:
+        d[lock_id] = n - 1
+
+
+class _TsanLock:
+    """threading.Lock twin that records held-ness per thread."""
+
+    def __init__(self):
+        self._inner = _thread.allocate_lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _note_acquire(id(self))
+        return got
+
+    def release(self):
+        _note_release(id(self))
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def _at_fork_reinit(self):
+        self._inner = _thread.allocate_lock()
+
+    # Condition(Lock()) uses these when handed a non-reentrant lock
+    def _release_save(self):
+        self.release()
+
+    def _acquire_restore(self, state):
+        self.acquire()
+
+    def _is_owned(self):
+        return id(self) in _held()
+
+
+class _TsanRLock:
+    """threading.RLock twin — tracks recursion depth per thread and
+    implements the Condition protocol hooks with tracking intact."""
+
+    def __init__(self):
+        self._inner = _orig_rlock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _note_acquire(id(self))
+        return got
+
+    def release(self):
+        self._inner.release()            # raises if not owned — then
+        _note_release(id(self))          # the note must not happen
+
+    def locked(self):
+        return self._inner.locked() if hasattr(self._inner, "locked") \
+            else False
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def _at_fork_reinit(self):
+        self._inner = _orig_rlock()
+
+    def _release_save(self):
+        # Condition.wait: drop the lock (all recursion levels) while
+        # waiting — the thread genuinely does NOT hold it in there
+        count = _held().get(id(self), 0)
+        for _ in range(count):
+            _note_release(id(self))
+        return (self._inner._release_save(), count) \
+            if hasattr(self._inner, "_release_save") \
+            else (self._inner.release(), count)
+
+    def _acquire_restore(self, state):
+        inner_state, count = state
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(inner_state)
+        else:
+            self._inner.acquire()
+        for _ in range(max(1, count)):
+            _note_acquire(id(self))
+
+    def _is_owned(self):
+        return id(self) in _held()
+
+
+# -- the Eraser state machine ------------------------------------------------
+
+def _stack() -> str:
+    return "".join(traceback.format_stack(
+        sys._getframe(3), limit=_STACK_LIMIT))
+
+
+def _thread_token() -> int:
+    tok = getattr(_tls, "token", None)
+    if tok is None:
+        tok = next(_token_counter)
+        _tls.token = tok
+    return tok
+
+
+def _record_write(obj: Any, attr: str) -> None:
+    if attr.startswith("_tsan"):
+        return
+    tid = _thread_token()
+    tname = threading.current_thread().name
+    held = frozenset(_held())
+    stack = _stack()
+    with _state_lock:
+        try:
+            per_obj = _states.get(obj)
+            if per_obj is None:
+                per_obj = {}
+                _states[obj] = per_obj
+        except TypeError:
+            return                       # un-weakref-able: skip
+        st = per_obj.get(attr)
+        if st is None:
+            per_obj[attr] = {"state": "exclusive", "tid": tid,
+                             "tname": tname, "lockset": held,
+                             "stack": stack}
+            return
+        if st["state"] == "exclusive":
+            if tid == st["tid"]:
+                st["lockset"] = held
+                st["stack"] = stack
+                return
+            # constructor handoff: first NEW thread takes ownership
+            st.update(state="exclusive2", tid=tid, tname=tname,
+                      lockset=held, stack=stack)
+            return
+        if st["state"] == "exclusive2" and tid == st["tid"]:
+            st["lockset"] = st["lockset"] & held
+            st["stack"] = stack
+            return
+        # a third party (or post-handoff cross-thread write): shared
+        prev_stack, prev_tname = st["stack"], st["tname"]
+        new_set = st["lockset"] & held
+        reported = st.get("reported", False)
+        st.update(state="shared", tid=tid, tname=tname,
+                  lockset=new_set, stack=stack)
+        if new_set or reported:
+            return
+        st["reported"] = True
+        if len(_races) >= _MAX_RACES:
+            return
+        race = {
+            "class": type(obj).__name__,
+            "attr": attr,
+            "threads": [prev_tname, tname],
+            "message": (f"write/write race on "
+                        f"{type(obj).__name__}.{attr}: no common lock "
+                        f"between writer threads "
+                        f"{prev_tname!r} and {tname!r}"),
+            "stack_prev": prev_stack,
+            "stack_cur": stack,
+        }
+        _races.append(race)
+    _emit(race)
+
+
+_emit_lock = _thread.allocate_lock()
+
+
+def _emit(race: dict) -> None:
+    path = os.environ.get(ENV_LOG)
+    if not path:
+        return
+    # one O_APPEND os.write per record: the smoke manager and every
+    # replica subprocess share one log, and a race record (two
+    # formatted stacks) is far bigger than a buffered-IO flush chunk —
+    # a single appending syscall keeps concurrent writers from
+    # interleaving mid-line and corrupting the JSONL artifact
+    data = (json.dumps(race) + "\n").encode("utf-8")
+    try:
+        with _emit_lock:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+            try:
+                os.write(fd, data)
+            finally:
+                os.close(fd)
+    except OSError:
+        pass                             # the log is best-effort
+
+
+# -- instrumentation management ----------------------------------------------
+
+def register(cls: type) -> type:
+    """Mark ``cls`` for write instrumentation (usable as a decorator).
+    A no-op until :func:`enable` runs; safe to call at import time from
+    modules that never see the sanitizer turned on."""
+    if cls not in _registered:
+        _registered.append(cls)
+        if _enabled:
+            _patch_class(cls)
+    return cls
+
+
+def unregister(cls: type) -> None:
+    """Remove ``cls`` from instrumentation and restore its original
+    ``__setattr__`` (test hygiene)."""
+    if cls in _registered:
+        _registered.remove(cls)
+    orig = _patched.pop(cls, None)
+    if orig is not None:
+        cls.__setattr__ = orig
+
+
+def _patch_class(cls: type) -> None:
+    if cls in _patched:
+        return
+    orig = cls.__setattr__
+
+    def _tsan_setattr(self, name, value, _orig=orig):
+        _orig(self, name, value)
+        _record_write(self, name)
+
+    _patched[cls] = orig
+    cls.__setattr__ = _tsan_setattr
+
+
+def enable(auto_register: bool = True) -> None:
+    """Turn instrumentation on: wrap the lock constructors, patch every
+    registered class, and sign up the serving fleet
+    (:data:`_AUTO_REGISTER`; ``auto_register=False`` skips it — unit
+    tests and the selfcheck instrument only their own fixtures). Call
+    BEFORE constructing the system under test — locks created earlier
+    are invisible to lockset tracking."""
+    global _enabled
+    if _enabled:
+        return
+    _enabled = True
+    threading.Lock = _TsanLock           # type: ignore[misc]
+    threading.RLock = _TsanRLock         # type: ignore[misc]
+    for cls in _registered:
+        _patch_class(cls)
+    if auto_register:
+        for modname, clsname in _AUTO_REGISTER:
+            mod = sys.modules.get(modname)
+            if mod is None:
+                mod = importlib.import_module(modname)
+            register(getattr(mod, clsname))
+
+
+def disable() -> None:
+    """Restore the original lock constructors and class setattrs (test
+    hygiene; races already recorded are kept until :func:`reset`)."""
+    global _enabled
+    if not _enabled:
+        return
+    _enabled = False
+    threading.Lock = _orig_lock          # type: ignore[misc]
+    threading.RLock = _orig_rlock        # type: ignore[misc]
+    for cls, orig in _patched.items():
+        cls.__setattr__ = orig
+    _patched.clear()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def maybe_enable() -> bool:
+    """Enable iff the ``HIVEMALL_TPU_TSAN`` env flag is set (the smoke
+    entry points call this first thing). Explicit negatives in any
+    case — ``0``/``false``/``no``/``off`` — stay disabled."""
+    val = os.environ.get(ENV_FLAG, "").strip().lower()
+    if val not in ("", "0", "false", "no", "off"):
+        enable()
+    return _enabled
+
+
+def races() -> List[dict]:
+    with _state_lock:
+        return list(_races)
+
+
+def reset() -> None:
+    with _state_lock:
+        _races.clear()
+        _states.clear()
+
+
+def check_and_report(label: str = "tsan") -> int:
+    """End-of-run gate for the smokes: print every recorded race to
+    stderr and return the count (nonzero fails the smoke)."""
+    rs = races()
+    for r in rs:
+        print(f"{label}: RACE {r['message']}\n"
+              f"--- previous writer ({r['threads'][0]}):\n"
+              f"{r['stack_prev']}"
+              f"--- current writer ({r['threads'][1]}):\n"
+              f"{r['stack_cur']}", file=sys.stderr)
+    print(f"{label}: {len(rs)} race(s) detected "
+          f"({'sanitizer on' if _enabled else 'sanitizer OFF'})",
+          file=sys.stderr)
+    return len(rs)
+
+
+# -- selfcheck: the re-seeded PR 11 race --------------------------------------
+
+def selfcheck_race() -> Tuple[bool, str]:
+    """Non-vacuity proof for the sanitizer, run by ``graftcheck
+    --selfcheck``: re-seed the PR 11 ``PredictEngine.last_reload_error``
+    bug (a watch thread and a warmup thread both writing the attribute
+    with no lock) and demand a race report; then run the FIXED twin
+    (both writers under ``_reload_lock``) and demand silence.
+
+    Runs with its own enable/disable bracket and leaves the global
+    sanitizer state the way it found it."""
+    was_enabled = _enabled
+
+    class _SeededEngine:                 # the PR 11 shape, miniaturized
+        def __init__(self, guarded: bool):
+            self._reload_lock = threading.Lock()
+            self._guarded = guarded
+            self.last_reload_error: Optional[str] = None
+
+        def _watch(self):                # serve-watch thread body
+            for _ in range(50):
+                if self._guarded:
+                    with self._reload_lock:
+                        self.last_reload_error = "watch: stale bundle"
+                else:
+                    self.last_reload_error = "watch: stale bundle"
+
+        def _warm_bg(self):              # serve-warmup thread body
+            for _ in range(50):
+                if self._guarded:
+                    with self._reload_lock:
+                        self.last_reload_error = "warmup: compile fail"
+                else:
+                    self.last_reload_error = "warmup: compile fail"
+
+    def drive(guarded: bool) -> List[dict]:
+        reset()
+        eng = _SeededEngine(guarded)
+        ts = [threading.Thread(target=eng._watch, name="serve-watch"),
+              threading.Thread(target=eng._warm_bg, name="serve-warmup")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return races()
+
+    try:
+        # auto_register would drag the whole serve stack (jax) into a
+        # selfcheck that only needs its own miniature engine
+        enable(auto_register=False)
+        register(_SeededEngine)
+        racy = drive(guarded=False)
+        hit = [r for r in racy if r["attr"] == "last_reload_error"]
+        if not hit:
+            return False, ("seeded last_reload_error race NOT detected "
+                           "(sanitizer is vacuous)")
+        clean = drive(guarded=True)
+        if clean:
+            return False, (f"lock-guarded twin still reported "
+                           f"{len(clean)} race(s) (false positive)")
+        return True, ("seeded last_reload_error race detected; "
+                      "lock-guarded twin clean")
+    finally:
+        reset()                          # drop the selfcheck's noise
+        unregister(_SeededEngine)
+        if not was_enabled:
+            disable()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m hivemall_tpu.testing.tsan",
+        description="lockset race sanitizer (docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="prove the sanitizer detects the seeded "
+                         "last_reload_error race and passes its "
+                         "lock-guarded twin")
+    args = ap.parse_args(argv)
+    if args.selfcheck:
+        ok, detail = selfcheck_race()
+        print(f"tsan --selfcheck: {detail}",
+              file=sys.stderr if not ok else sys.stdout)
+        return 0 if ok else 1
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

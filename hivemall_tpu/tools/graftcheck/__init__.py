@@ -7,34 +7,51 @@ site only after it has burned a compile, the atomic-write idiom
 io/, and stub-vs-live registry parity is pinned by a test that must be
 updated per section. graftcheck rejects violations at review time
 instead, from source, with zero new dependencies (stdlib ``ast`` +
-``tokenize`` only).
+``tokenize`` only). Since PR 12 the analysis is INTERPROCEDURAL: a
+project-wide call graph over per-function summaries (:mod:`.interproc`)
+follows tainted clocks through helper returns, shared-attribute writes
+through methods called from thread targets, and jit-closure factories
+across modules — and pairs with the runtime lockset race sanitizer
+(:mod:`hivemall_tpu.testing.tsan`) the serve/fleet smokes run under.
 
 Rules (each with a fix-hint and a ``# graftcheck: disable=<code>``
 suppression; see docs/STATIC_ANALYSIS.md for the full catalog):
 
 ========  ===============================================================
 GC01      retrace-hazard: jit/``lru_cache`` compile factories defined
-          inside functions/loops, or jitted closures created AND called
-          per-call instead of escaping through a module-level factory.
+          inside functions/loops, jitted closures created AND called
+          per-call instead of escaping through a module-level factory,
+          and calls to fresh-jit factories in loops / immediately
+          invoked (cross-module, via summaries).
 GC02      clock-discipline: ``time.time()`` in duration arithmetic
           (subtraction / deadline comparison) where ``time.monotonic()``
-          is required; legitimate wall-clock anchors carry an explicit
-          suppression.
+          is required — directly, via tainted locals, or via helpers
+          whose summaries prove a wall-derived return; legitimate
+          wall-clock anchors carry an explicit suppression.
 GC03      atomic-write: bare ``open(..., "w"/"wb")`` in io/ or serve/
           outside a tmp -> fsync -> ``os.replace`` helper.
 GC04      lock-discipline: instance attributes mutated from more than
-          one thread entry point without the owning lock held, and
-          ``Lock.acquire()`` outside a ``with``.
+          one thread entry point without the owning lock held —
+          including writes reached through method calls, with
+          locks-held-at-call-site propagation — and ``Lock.acquire()``
+          outside a ``with``.
 GC05      surface-parity: registry stub constants must key-mirror their
           live provider dict literals; registry section names and stub
           keys must satisfy the ``to_prometheus`` name grammar.
 GC06      broad-except: ``except Exception:`` in serve/ and obs/ hot
           paths must name why (a comment on the handler) or be narrowed.
+GC07      transfer-discipline: ``np.asarray``/``device_get``/
+          ``block_until_ready`` inside per-step loops in models//ops/
+          (direct, or one function boundary away).
+GC08      thread-lifecycle: self-stored looping threads whose class
+          provably lacks a join / poison-pill shutdown path.
 ========  ===============================================================
 
 Run ``python -m hivemall_tpu.tools.graftcheck`` from the repo root; CI
-wires it into run_tests.sh as a hard gate (``--selfcheck`` proves the
-gate catches seeded violations before the real pass).
+wires it into run_tests.sh as a hard gate (``--selfcheck`` proves every
+rule fires on seeded violations AND that the tsan sanitizer detects the
+re-seeded PR 11 race before the real pass; ``--fix`` emits mechanical
+diffs, ``--json-out`` the CI artifact; scans are content-hash cached).
 """
 
 from .engine import (Finding, load_baseline, run_paths, scan_file,

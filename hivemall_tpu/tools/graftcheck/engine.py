@@ -1,21 +1,33 @@
-"""graftcheck engine — file walking, suppressions, baseline, CLI.
+"""graftcheck engine — file walking, suppressions, baseline, cache, CLI.
 
 Two passes over the scanned tree: pass 1 parses every file and collects
 the cross-file :class:`~.rules.ProjectIndex` (registry stub constants +
-alias functions), pass 2 runs every rule per module. Suppression
-comments (``# graftcheck: disable=GC02`` — trailing on the flagged line,
-or alone on the line above) are honored before the baseline is applied.
+alias functions, plus the interprocedural summary index), pass 2 runs
+every rule per module. Suppression comments (``# graftcheck:
+disable=GC02`` — trailing on the flagged line, or alone on the line
+above) are honored before the baseline is applied.
 
 Baseline semantics (``--baseline graftcheck_baseline.json``): a JSON
 list of finding fingerprints tolerated for now. The gate fails on any
 NON-baselined finding AND on any stale entry — a fixed finding must
 leave the baseline in the same PR, so the debt list only ever shrinks.
+
+Findings cache (``.graftcheck_cache.json`` under the scan root):
+content-hashed and stamped with :data:`~.rules.RULESTAMP`. Because the
+rules are INTERPROCEDURAL, per-file reuse is unsound — editing one file
+can change another file's findings through the summary index — so
+invalidation is whole-scan: when the rule stamp, the scanned file set
+and every file's sha256 match the cache, the findings are replayed with
+zero parsing (the CI re-run case); any difference re-analyzes
+everything (a few seconds). ``--no-cache`` bypasses both directions.
 """
 
 from __future__ import annotations
 
 import argparse
 import ast
+import difflib
+import hashlib
 import io
 import json
 import os
@@ -25,13 +37,15 @@ import tokenize
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .rules import (Finding, ModuleContext, ProjectIndex, RULES,
-                    collect_project, run_rules)
+                    RULESTAMP, collect_project, run_rules)
 
 __all__ = ["Finding", "run_paths", "scan_file", "load_baseline",
            "write_baseline", "main"]
 
 _DIRECTIVE = re.compile(r"graftcheck:\s*disable=([A-Z0-9,\s]+)")
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+CACHE_NAME = ".graftcheck_cache.json"
 
 
 def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
@@ -106,7 +120,8 @@ def _parse_one(path: str, relpath: str) \
 def scan_file(path: str, root: Optional[str] = None,
               project: Optional[ProjectIndex] = None) -> List[Finding]:
     """Analyze one file (convenience for tests); cross-file GC05 parity
-    only sees stubs defined in this file unless ``project`` is given."""
+    and interprocedural edges only see this file unless ``project`` is
+    given."""
     rel = os.path.relpath(path, root or os.getcwd()).replace(os.sep, "/")
     ctx, err = _parse_one(path, rel)
     if err is not None:
@@ -123,19 +138,98 @@ def _apply_suppressions(ctx: ModuleContext,
     return [f for f in findings if f.code not in supp.get(f.line, set())]
 
 
-def run_paths(paths: Iterable[str], root: Optional[str] = None) \
-        -> List[Finding]:
+# -- findings cache ---------------------------------------------------------
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _finding_from_json(d: dict) -> Finding:
+    return Finding(code=d["code"], path=d["path"], line=d["line"],
+                   col=d["col"], message=d["message"],
+                   hint=d.get("hint", ""),
+                   symbol=d.get("symbol", "<module>"),
+                   fix_kind=d.get("fix_kind"),
+                   fix_lines=tuple(d.get("fix_lines", ())))
+
+
+def _cache_load(cache_path: str, shas: Dict[str, str]) \
+        -> Optional[List[Finding]]:
+    """Replay cached findings iff the rule stamp, the file SET and every
+    file's content hash match — else None (full re-analysis)."""
+    try:
+        with open(cache_path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError, json.JSONDecodeError):
+        return None
+    if data.get("stamp") != RULESTAMP:
+        return None
+    cached = data.get("files")
+    if not isinstance(cached, dict) or set(cached) != set(shas):
+        return None
+    for rel, entry in cached.items():
+        if not isinstance(entry, dict) or entry.get("sha") != shas[rel]:
+            return None                  # mangled entry: just re-scan
+    try:
+        out = [_finding_from_json(d)
+               for entry in cached.values()
+               for d in entry.get("findings", [])]
+    except (KeyError, TypeError):
+        return None
+    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out
+
+
+def _cache_store(cache_path: str, shas: Dict[str, str],
+                 findings: List[Finding]) -> None:
+    by_file: Dict[str, List[dict]] = {rel: [] for rel in shas}
+    for f in findings:
+        by_file.setdefault(f.path, []).append(f.to_json())
+    data = {"stamp": RULESTAMP,
+            "comment": "graftcheck findings cache — whole-scan "
+                       "invalidation (interprocedural rules make "
+                       "per-file reuse unsound); delete freely",
+            "files": {rel: {"sha": sha,
+                            "findings": by_file.get(rel, [])}
+                      for rel, sha in shas.items()}}
+    tmp = cache_path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass                             # a read-only tree just re-scans
+
+
+def run_paths(paths: Iterable[str], root: Optional[str] = None,
+              cache: Optional[str] = None) -> List[Finding]:
     """Scan every .py under ``paths``; returns suppression-filtered
     findings (baseline is the caller's concern). Paths in findings are
     relative to ``root`` (default: cwd), '/'-separated — baseline
-    fingerprints stay stable across machines."""
+    fingerprints stay stable across machines. ``cache``: path of the
+    findings cache to consult/update (None = no caching)."""
     root = os.path.abspath(root or os.getcwd())
+    files: Dict[str, str] = {}           # rel -> abs
+    for path in iter_py_files(paths):
+        ap = os.path.abspath(path)
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        files[rel] = ap
+
+    shas: Optional[Dict[str, str]] = None
+    if cache:
+        shas = {rel: _sha256_file(ap) for rel, ap in files.items()}
+        cached = _cache_load(cache, shas)
+        if cached is not None:
+            return cached
+
     contexts: List[ModuleContext] = []
     findings: List[Finding] = []
-    for path in iter_py_files(paths):
-        rel = os.path.relpath(os.path.abspath(path), root) \
-            .replace(os.sep, "/")
-        ctx, err = _parse_one(path, rel)
+    for rel, ap in files.items():
+        ctx, err = _parse_one(ap, rel)
         if err is not None:
             findings.append(err)
             continue
@@ -145,6 +239,8 @@ def run_paths(paths: Iterable[str], root: Optional[str] = None) \
     for ctx in contexts:
         findings.extend(_apply_suppressions(ctx, run_rules(ctx, project)))
     findings.sort(key=lambda f: (f.path, f.line, f.code))
+    if cache and shas is not None:
+        _cache_store(cache, shas, findings)
     return findings
 
 
@@ -197,10 +293,79 @@ def gate(findings: List[Finding], baseline: List[str],
     return fresh, stale
 
 
+# -- mechanical fixes (--fix) -----------------------------------------------
+
+_GC06_ANNOTATION = ("  # isolation: TODO(graftcheck --fix) name why "
+                    "this catch-all is required")
+
+
+def _apply_fixes(findings: List[Finding], root: str,
+                 write: bool) -> Tuple[str, int]:
+    """Build the mechanical rewrites for fixable findings. Returns
+    (unified diff across all touched files, number of findings fixed);
+    with ``write`` the new contents also land on disk.
+
+    GC02 ``gc02-monotonic``: every literal ``time.time()`` on the
+    finding's fix lines becomes ``time.monotonic()`` (the flagged
+    arithmetic plus the taint-source assignments). GC06
+    ``gc06-annotate``: the bare handler line gains a TODO annotation
+    comment — the rule passes, and the placeholder text keeps a human
+    on the hook for the real why.
+    """
+    per_file: Dict[str, Dict[int, str]] = {}   # rel -> line -> kind
+    for f in findings:
+        if f.fix_kind is None:
+            continue
+        for ln in (f.fix_lines or (f.line,)):
+            per_file.setdefault(f.path, {})[ln] = f.fix_kind
+    chunks: List[str] = []
+    changed: Dict[str, Set[int]] = {}          # rel -> lines rewritten
+    for rel in sorted(per_file):
+        ap = os.path.join(root, rel.replace("/", os.sep))
+        try:
+            with open(ap, "r", encoding="utf-8") as fh:
+                old_lines = fh.readlines()
+        except OSError:
+            continue
+        new_lines = list(old_lines)
+        for ln, kind in per_file[rel].items():
+            i = ln - 1
+            if not (0 <= i < len(new_lines)):
+                continue
+            if kind == "gc02-monotonic":
+                new_lines[i] = new_lines[i].replace(
+                    "time.time()", "time.monotonic()")
+            elif kind == "gc06-annotate":
+                stripped = new_lines[i].rstrip("\n")
+                if "#" not in stripped:
+                    new_lines[i] = stripped + _GC06_ANNOTATION + "\n"
+            if new_lines[i] != old_lines[i]:
+                changed.setdefault(rel, set()).add(ln)
+        if new_lines == old_lines:
+            continue
+        chunks.append("".join(difflib.unified_diff(
+            old_lines, new_lines, fromfile=f"a/{rel}",
+            tofile=f"b/{rel}")))
+        if write:
+            with open(ap, "w", encoding="utf-8") as fh:
+                fh.writelines(new_lines)
+    # a finding counts as fixed only when a line it owns actually
+    # changed — a fixable-flagged finding whose rewrite was a no-op must
+    # not let `--fix --write` report success on an unchanged file
+    fixed = sum(
+        1 for f in findings if f.fix_kind is not None
+        and changed.get(f.path, set())
+        & set(f.fix_lines or (f.line,)))
+    return "".join(chunks), fixed
+
+
 # -- selfcheck --------------------------------------------------------------
 
 _FIXTURES = {
-    # one seeded violation per rule — the gate must catch every one
+    # one seeded violation per rule — the gate must catch every one.
+    # pkg/... fixture modules import each other with absolute names
+    # (pkg.x.y) so the interprocedural resolver links them exactly as it
+    # links real modules.
     "pkg/models/bad_model.py": (
         "import jax\n"
         "from functools import lru_cache\n\n"
@@ -250,13 +415,85 @@ _FIXTURES = {
         "                    else dict(FOO_STUB))\n"
         "        registry.register('bad.name', p)\n",
         {"GC05"}),
+    # GC07: a direct fetch in a per-step loop, and a call to a helper
+    # that fetches (one function boundary away)
+    "pkg/models/bad_hot.py": (
+        "import numpy as np\n\n"
+        "def fetch_loss(x):\n"
+        "    return float(np.asarray(x))\n\n"
+        "def train(step, batches):\n"
+        "    losses = []\n"
+        "    for b in batches:\n"
+        "        out = step(b)\n"
+        "        losses.append(fetch_loss(out))\n"
+        "    return losses\n",
+        {"GC07"}),
+    # GC08: a stored looping thread no shutdown path ever joins/signals
+    "pkg/serve/bad_thread.py": (
+        "import threading\n\n"
+        "class Daemon:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._run,\n"
+        "                                   daemon=True)\n"
+        "        self._t.start()\n"
+        "    def _run(self):\n"
+        "        while True:\n"
+        "            pass\n",
+        {"GC08"}),
+    # interprocedural upgrades: each pair is INVISIBLE to the PR 11
+    # intra-module analysis (tests/test_graftcheck.py pins the
+    # single-module miss); the summaries must connect them
+    "pkg/utils/clockutil.py": (
+        "import time\n\n"
+        "def now_s():\n"
+        "    return time.time()\n",
+        set()),
+    "pkg/io/bad_deadline.py": (
+        "from pkg.utils.clockutil import now_s\n\n"
+        "def wait(seconds):\n"
+        "    deadline = now_s() + seconds\n"
+        "    while now_s() < deadline:\n"
+        "        pass\n",
+        {"GC02"}),
+    "pkg/ops/jit_factory.py": (
+        "import jax\n\n"
+        "def make_step(f):\n"
+        "    return jax.jit(f)\n",
+        set()),
+    "pkg/models/bad_factory_use.py": (
+        "from pkg.ops.jit_factory import make_step\n\n"
+        "def score_all(fns, x):\n"
+        "    return [make_step(f)(x) for f in fns]\n",
+        {"GC01"}),
+    "pkg/serve/attr_helper.py": (
+        "def bump_counter(obj):\n"
+        "    obj.count += 1\n",
+        set()),
+    "pkg/serve/bad_cross_write.py": (
+        "import threading\n"
+        "from pkg.serve.attr_helper import bump_counter\n\n"
+        "class X:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "        threading.Thread(target=self._a).start()\n"
+        "        threading.Thread(target=self._b).start()\n"
+        "    def _a(self):\n"
+        "        bump_counter(self)\n"
+        "    def _b(self):\n"
+        "        with self._lock:\n"
+        "            self.count -= 1\n",
+        {"GC04"}),
 }
 
 
 def selfcheck() -> int:
     """Prove the gate in both directions before trusting a clean run:
-    every rule fires on its seeded fixture; a baseline silences them; a
-    fixed finding turns its baseline entry stale (nonzero)."""
+    every rule (including the interprocedural upgrades and GC07/GC08)
+    fires on its seeded fixture; a baseline silences them; a fixed
+    finding turns its baseline entry stale (nonzero); and the tsan
+    lockset sanitizer detects the re-seeded PR 11
+    ``last_reload_error`` race while passing its lock-guarded twin."""
     import shutil
     import tempfile
     tmp = tempfile.mkdtemp(prefix="graftcheck_selfcheck_")
@@ -289,14 +526,30 @@ def selfcheck() -> int:
         if not stale:
             failures.append("fixed finding did not turn its baseline "
                             "entry stale")
+        # direction 3: the DYNAMIC layer — the lockset sanitizer must
+        # flag the re-seeded PR 11 PredictEngine.last_reload_error race
+        # (two unguarded writer threads) and stay quiet on the guarded
+        # twin; a sanitizer that cannot fail is not a gate
+        try:
+            from ...testing import tsan
+            ok, detail = tsan.selfcheck_race()
+            if not ok:
+                failures.append(f"tsan selfcheck: {detail}")
+            tsan_msg = detail
+        except Exception as e:  # noqa: BLE001 — a broken sanitizer
+            failures.append(f"tsan selfcheck crashed: "
+                            f"{type(e).__name__}: {e}")
+            tsan_msg = "unavailable"
         if failures:
             for msg in failures:
                 print(f"graftcheck --selfcheck FAIL: {msg}",
                       file=sys.stderr)
             return 1
         print(f"graftcheck --selfcheck: {len(findings)} seeded findings "
-              f"caught across {len(_FIXTURES)} fixtures; baseline gate "
-              f"bidirectional (silences fresh, flags stale)")
+              f"caught across {len(_FIXTURES)} fixtures (incl. "
+              f"cross-module GC01/GC02/GC04 + GC07/GC08); baseline gate "
+              f"bidirectional (silences fresh, flags stale); "
+              f"tsan: {tsan_msg}")
         return 0
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -305,10 +558,18 @@ def selfcheck() -> int:
 # -- CLI --------------------------------------------------------------------
 
 def _default_paths() -> List[str]:
-    """The installed package tree (works from any cwd)."""
+    """The full repo surface: the installed package tree plus the repo's
+    out-of-package Python — tests/, bench.py, the graft entry point —
+    so deadline idioms and thread workers in the harness obey the same
+    invariants the package does (works from any cwd; paths that don't
+    exist in an installed-package context are skipped)."""
     pkg = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    return [pkg]
+    repo = os.path.dirname(pkg)
+    extras = [os.path.join(repo, "tests"),
+              os.path.join(repo, "bench.py"),
+              os.path.join(repo, "__graft_entry__.py")]
+    return [pkg] + [p for p in extras if os.path.exists(p)]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -318,7 +579,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "(docs/STATIC_ANALYSIS.md)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to scan (default: the hivemall_tpu "
-                         "package)")
+                         "package + tests/ + bench.py + the graft entry)")
     ap.add_argument("--baseline", default=None,
                     help="baseline JSON (default: ./graftcheck_baseline"
                          ".json when present)")
@@ -327,29 +588,66 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "exit 0")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings on stdout")
+    ap.add_argument("--json-out", metavar="PATH", default=None,
+                    help="also write the full JSON report (all findings "
+                         "+ gate verdict) to PATH — the CI artifact")
     ap.add_argument("--selfcheck", action="store_true",
-                    help="prove every rule fires on seeded violations "
-                         "and the baseline gate works both ways")
+                    help="prove every rule fires on seeded violations, "
+                         "the baseline gate works both ways, and the "
+                         "tsan sanitizer flags the seeded race")
     ap.add_argument("--root", default=None,
                     help="path-relativity root for fingerprints "
                          "(default: cwd)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the content-hash findings cache")
+    ap.add_argument("--fix", action="store_true",
+                    help="emit a unified diff fixing the mechanical "
+                         "rules (GC02 time.time()->time.monotonic(), "
+                         "GC06 annotation insertion)")
+    ap.add_argument("--write", action="store_true",
+                    help="with --fix: rewrite the files in place "
+                         "instead of only printing the diff")
     args = ap.parse_args(argv)
 
     if args.selfcheck:
         return selfcheck()
+    if args.write and not args.fix:
+        print("graftcheck: --write requires --fix", file=sys.stderr)
+        return 2
 
     paths = args.paths or _default_paths()
     root = args.root
     if root is None and not args.paths:
         # default scan: relative to the repo root (the package's parent)
-        root = os.path.dirname(_default_paths()[0])
-    findings = run_paths(paths, root=root)
+        pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        root = os.path.dirname(pkg)
+    abs_root = os.path.abspath(root or os.getcwd())
+    cache = None
+    if not args.no_cache and not args.fix and not args.paths:
+        # the default full scan only: an explicit-path scan would drop
+        # the cache file in the caller's cwd AND evict the whole-tree
+        # cache (the cache is keyed by the scanned file SET)
+        cache = os.path.join(abs_root, CACHE_NAME)
+    findings = run_paths(paths, root=root, cache=cache)
 
     if args.write_baseline:
         write_baseline(args.write_baseline, findings)
         print(f"graftcheck: wrote {len(findings)} fingerprint(s) to "
               f"{args.write_baseline}")
         return 0
+
+    if args.fix:
+        diff, fixed = _apply_fixes(findings, abs_root, args.write)
+        if diff:
+            sys.stdout.write(diff)
+        verb = "rewrote" if args.write else "would fix"
+        print(f"graftcheck --fix: {verb} {fixed} finding(s) "
+              f"({len(findings)} total; non-mechanical findings need "
+              f"human fixes)", file=sys.stderr)
+        if args.write:
+            return 0
+        return 1 if fixed else 0
 
     baseline_path = args.baseline
     if baseline_path is None and os.path.exists("graftcheck_baseline.json"):
@@ -362,17 +660,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"graftcheck: cannot read baseline: {e}",
                   file=sys.stderr)
             return 2
-    abs_root = os.path.abspath(root or os.getcwd())
     covered = [os.path.relpath(os.path.abspath(p), abs_root)
                .replace(os.sep, "/") for p in paths]
     fresh, stale = gate(findings, baseline, covered)
 
+    report = {
+        "findings": [f.to_json() for f in fresh],
+        "baselined": len(findings) - len(fresh),
+        "stale_baseline": stale,
+        "rulestamp": RULESTAMP,
+        "clean": not (fresh or stale),
+    }
+    if args.json_out:
+        try:
+            with open(args.json_out, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=1)
+                f.write("\n")
+        except OSError as e:
+            print(f"graftcheck: cannot write --json-out: {e}",
+                  file=sys.stderr)
     if args.json:
-        print(json.dumps({
-            "findings": [vars(f) | {"fingerprint": f.fingerprint}
-                         for f in fresh],
-            "baselined": len(findings) - len(fresh),
-            "stale_baseline": stale}, indent=1))
+        print(json.dumps(report, indent=1))
     else:
         for f in fresh:
             print(f.render())

@@ -1,0 +1,720 @@
+"""graftcheck interprocedural layer — call graph + function summaries.
+
+PR 11's rules were intra-module and syntactic: GC02 lost a tainted clock
+value the moment it crossed a function boundary, GC04 only saw attribute
+writes lexically inside a thread entry method, GC01 never looked at what
+a factory's *caller* does with the product. This module gives the rules
+a project-wide view without whole-program dataflow: one cheap pass per
+file builds a :class:`FunctionSummary` per ``def`` (what it returns,
+which attributes it writes on which parameter, which functions it calls
+and under which locks, whether it performs a host transfer), a
+name-based call graph links the summaries, and small fixpoint loops
+close the transitive facts (returns-tainted, returns-fresh-jit).
+
+Resolution is deliberately best-effort and NAME-BASED (no type
+inference): ``self.m()`` resolves inside the enclosing class,
+``helper()`` to the module's own top-level def or an imported symbol,
+``mod.f()`` through the module's import map. Anything unresolvable —
+dynamic dispatch, getattr, builtins, third-party — degrades to
+"unknown", never to false certainty: a summary field the analysis
+cannot prove stays at its conservative default.
+
+Shared low-level AST helpers used by both this pass and the rule
+implementations live here (rules.py imports them) so the two layers
+agree on what counts as a jit creation, a lock, a thread constructor.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "FUNCS", "LOOPS", "FunctionSummary", "CallSite", "ModuleInfo",
+    "InterProcIndex", "build_index", "dec_name", "is_cache_decorator",
+    "is_memo_decorated", "is_jit_name", "is_jit_creation",
+    "is_jit_decorator", "is_partial", "is_thread_ctor", "LOCKISH",
+    "under_lock", "is_transfer_call", "module_name_of",
+]
+
+FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+LOOPS = (ast.For, ast.AsyncFor, ast.While)
+
+LOCKISH = re.compile(r"lock|mutex|cond|\b_?cv\b", re.IGNORECASE)
+
+_CACHE_NAMES = {"lru_cache", "_lru_cache", "cache", "cached"}
+_FACTORY_NAMES = {"instrument_factory", "_instrument"}
+
+#: host<->device transfer surface GC07 polices: a fetch forces a device
+#: sync; inside a per-step loop it serializes the pipeline per iteration
+_TRANSFER_ATTRS = {"block_until_ready", "device_get"}
+
+
+def dec_name(dec: ast.AST) -> str:
+    """The rightmost identifier of a (possibly called) decorator/callee."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    if isinstance(target, ast.Name):
+        return target.id
+    return ""
+
+
+def is_cache_decorator(dec: ast.AST) -> bool:
+    return dec_name(dec) in _CACHE_NAMES
+
+
+def is_memo_decorated(fn: ast.AST) -> bool:
+    """lru_cache / instrument_factory on the def: a memoized compile
+    factory — jit creations inside it happen once per config key."""
+    return any(dec_name(d) in (_CACHE_NAMES | _FACTORY_NAMES)
+               for d in getattr(fn, "decorator_list", []))
+
+
+def is_jit_name(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Name) and node.id == "jit") or \
+        (isinstance(node, ast.Attribute) and node.attr == "jit")
+
+
+def is_partial(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dec_name(node) in (
+        "partial", "_partial")
+
+
+def is_jit_creation(node: ast.AST) -> bool:
+    """A Call producing a jit-compiled callable: ``jax.jit(f)``,
+    ``jit(f)``, or ``partial(jax.jit, ...)(f)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    if is_jit_name(node.func):
+        return True
+    if isinstance(node.func, ast.Call) and is_partial(node.func) \
+            and node.func.args and is_jit_name(node.func.args[0]):
+        return True
+    return False
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    if is_jit_name(dec):
+        return True
+    if is_partial(dec) and dec.args and is_jit_name(dec.args[0]):
+        return True
+    if isinstance(dec, ast.Call) and is_jit_name(dec.func):
+        return True
+    return False
+
+
+def is_thread_ctor(call: ast.Call) -> bool:
+    return dec_name(call) == "Thread"
+
+
+def is_transfer_call(node: ast.AST) -> bool:
+    """``np.asarray(...)``, ``jax.device_get(...)``,
+    ``x.block_until_ready()`` — a forced device->host sync."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _TRANSFER_ATTRS:
+            return True
+        if f.attr == "asarray" and isinstance(f.value, ast.Name) \
+                and f.value.id in ("np", "numpy"):
+            return True
+    elif isinstance(f, ast.Name) and f.id in ("device_get",
+                                              "block_until_ready"):
+        return True
+    return False
+
+
+def under_lock(ctx: Any, node: ast.AST, top: Optional[ast.AST]) -> bool:
+    """Is ``node`` lexically inside a ``with <…lock…>:`` block below
+    ``top`` (exclusive)? Shared by GC04 and the summary builder so the
+    static guard test is one definition. The per-With verdict is
+    memoized on the context — this runs for every call site and every
+    attribute write, and unparse is the expensive part."""
+    memo = getattr(ctx, "_lockish_withs", None)
+    if memo is None:
+        memo = {}
+        ctx._lockish_withs = memo
+    for a in ctx.ancestors(node):
+        if isinstance(a, ast.With):
+            verdict = memo.get(id(a))
+            if verdict is None:
+                verdict = False
+                for item in a.items:
+                    try:
+                        src = ast.unparse(item.context_expr)
+                    except Exception:  # noqa: BLE001 — odd nodes
+                        src = ""
+                    if LOCKISH.search(src):
+                        verdict = True
+                        break
+                memo[id(a)] = verdict
+            if verdict:
+                return True
+        if a is top:
+            break
+    return False
+
+
+def module_name_of(relpath: str) -> str:
+    """Dotted module name a scan-root-relative path imports as:
+    ``hivemall_tpu/serve/engine.py`` -> ``hivemall_tpu.serve.engine``,
+    ``bench.py`` -> ``bench``; packages drop the ``__init__``."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = [x for x in p.split("/") if x]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# summaries
+# ---------------------------------------------------------------------------
+
+#: a function's identity across the project: (relpath, dotted qualname)
+FuncId = Tuple[str, str]
+
+
+@dataclass
+class CallSite:
+    """One call expression inside a function body."""
+    line: int
+    callee: Optional[FuncId]          # resolved target, None = unknown
+    under_lock: bool                  # lexically inside `with <lock>:`
+    self_arg_positions: Tuple[int, ...] = ()   # positions passing bare
+    #                                            `self` (GC04 escape)
+    callee_repr: str = ""             # for messages on resolved calls
+
+
+@dataclass
+class FunctionSummary:
+    """What one ``def`` does, as far as name-based analysis can prove.
+
+    Every field defaults to the conservative "nothing proven" value —
+    an exotic construct (decorators we don't know, dynamic dispatch,
+    lambdas) leaves the default in place rather than inventing facts.
+    """
+    fid: FuncId
+    name: str
+    lineno: int
+    class_name: Optional[str] = None  # enclosing class, if a method
+    is_method: bool = False
+    self_name: Optional[str] = None   # first positional arg of a method
+    params: Tuple[str, ...] = ()
+    memoized: bool = False            # lru_cache/instrument_factory'd
+    #: returns an expression derived from time.time() (direct taint)
+    returns_wall_direct: bool = False
+    #: callees whose return value this function returns (taint/jit chains)
+    return_call_targets: List[FuncId] = field(default_factory=list)
+    #: returns a FRESH jit closure per call (False when memoized)
+    returns_fresh_jit_direct: bool = False
+    #: attr writes on `self`: (attr, line, guarded_at_site)
+    self_attr_writes: List[Tuple[str, int, bool]] = field(
+        default_factory=list)
+    #: attr writes on non-self params: param name -> [(attr, line,
+    #: guarded_at_site)] — how a cross-module helper mutates an object
+    #: the caller passed in
+    param_attr_writes: Dict[str, List[Tuple[str, int, bool]]] = field(
+        default_factory=dict)
+    calls: List[CallSite] = field(default_factory=list)
+    #: calls np.asarray/device_get/block_until_ready directly (GC07
+    #: follows exactly ONE function boundary, so no transitive closure)
+    transfer_direct: bool = False
+    has_while_loop: bool = False
+    #: `self.<attr>` event names gating a while loop (`while not
+    #: self._stop.is_set()` / `.wait(t)`) — GC08 poison-pill evidence
+    loop_event_gates: Set[str] = field(default_factory=set)
+    # transitive facts, filled by the fixpoint in build_index()
+    returns_wall: bool = False
+    returns_fresh_jit: bool = False
+
+
+@dataclass
+class ModuleInfo:
+    """Per-module resolution state."""
+    relpath: str
+    modname: str
+    is_package: bool = False             # an __init__.py
+    #: local name -> dotted module it stands for (import x.y as z)
+    import_modules: Dict[str, str] = field(default_factory=dict)
+    #: local name -> (dotted module, symbol)  (from m import f)
+    import_symbols: Dict[str, Tuple[str, str]] = field(
+        default_factory=dict)
+    #: top-level def name -> FuncId
+    toplevel: Dict[str, FuncId] = field(default_factory=dict)
+    #: class name -> {method name -> FuncId}
+    classes: Dict[str, Dict[str, FuncId]] = field(default_factory=dict)
+
+
+class InterProcIndex:
+    """Project-wide function summaries + name-based resolution."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[FuncId, FunctionSummary] = {}
+        self.modules: Dict[str, ModuleInfo] = {}      # modname -> info
+        self.modules_by_path: Dict[str, ModuleInfo] = {}
+
+    # -- resolution -----------------------------------------------------
+    def resolve_symbol(self, modname: str, symbol: str) \
+            -> Optional[FuncId]:
+        """``symbol`` as a top-level def of ``modname`` (following one
+        from-import hop so re-exports resolve)."""
+        mi = self.modules.get(modname)
+        if mi is None:
+            return None
+        fid = mi.toplevel.get(symbol)
+        if fid is not None:
+            return fid
+        hop = mi.import_symbols.get(symbol)
+        if hop is not None:
+            m2, s2 = hop
+            mi2 = self.modules.get(m2)
+            if mi2 is not None:
+                return mi2.toplevel.get(s2)
+        return None
+
+    def resolve_call(self, mi: ModuleInfo, call: ast.Call,
+                     class_name: Optional[str],
+                     self_name: Optional[str]) -> Optional[FuncId]:
+        """Best-effort callee of ``call`` as seen from a function inside
+        class ``class_name`` of module ``mi``. None = unknown."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            fid = mi.toplevel.get(f.id)
+            if fid is not None:
+                return fid
+            hop = mi.import_symbols.get(f.id)
+            if hop is not None:
+                return self.resolve_symbol(*hop)
+            return None
+        if isinstance(f, ast.Attribute):
+            v = f.value
+            if isinstance(v, ast.Name):
+                if self_name is not None and v.id == self_name \
+                        and class_name is not None:
+                    methods = mi.classes.get(class_name, {})
+                    return methods.get(f.attr)
+                target_mod = mi.import_modules.get(v.id)
+                if target_mod is not None:
+                    return self.resolve_symbol(target_mod, f.attr)
+                hop = mi.import_symbols.get(v.id)
+                if hop is not None:
+                    # `from pkg import mod` then `mod.f()`
+                    return self.resolve_symbol(
+                        f"{hop[0]}.{hop[1]}", f.attr)
+            elif isinstance(v, ast.Attribute):
+                # dotted module chain: x.y.f() under `import x.y` or
+                # `import pkg.x as x` — the HEAD name is the local
+                # binding; substituting its target module for it yields
+                # the absolute dotted module the chain names
+                try:
+                    dotted = ast.unparse(v)
+                except Exception:  # noqa: BLE001 — odd nodes
+                    return None
+                head, _, rest = dotted.partition(".")
+                if head in mi.import_modules:
+                    base = mi.import_modules[head]
+                    mod = f"{base}.{rest}" if rest else base
+                    return self.resolve_symbol(mod, f.attr)
+                return self.resolve_symbol(dotted, f.attr)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# per-module extraction
+# ---------------------------------------------------------------------------
+
+def _resolve_relative(modname: str, is_package: bool, level: int,
+                      module: Optional[str]) -> Optional[str]:
+    """Absolute dotted name of a ``from ...x import y`` target.
+    ``is_package`` distinguishes ``a/b/__init__.py`` (where ``from .``
+    means ``a.b`` itself) from ``a/b.py`` (where it means ``a``) —
+    without it, every re-export in an ``__init__.py`` resolved one
+    level too high and package-mediated taint went invisible."""
+    if level == 0:
+        return module
+    parts = modname.split(".")
+    if is_package:
+        parts = parts + ["__init__"]
+    if level > len(parts):
+        return None
+    base = parts[:len(parts) - level]
+    if module:
+        base.append(module)
+    return ".".join(base) if base else None
+
+
+def _collect_imports(mi: ModuleInfo, tree: ast.Module) -> None:
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            for a in n.names:
+                if a.asname:
+                    mi.import_modules[a.asname] = a.name
+                else:
+                    mi.import_modules[a.name.split(".")[0]] = \
+                        a.name.split(".")[0]
+                    mi.import_modules.setdefault(a.name, a.name)
+        elif isinstance(n, ast.ImportFrom):
+            target = _resolve_relative(mi.modname, mi.is_package,
+                                       n.level, n.module)
+            if target is None:
+                continue
+            for a in n.names:
+                local = a.asname or a.name
+                mi.import_symbols[local] = (target, a.name)
+
+
+def _wall_call(n: ast.AST, bare_time: bool) -> bool:
+    if not isinstance(n, ast.Call):
+        return False
+    f = n.func
+    if isinstance(f, ast.Attribute) and f.attr == "time" \
+            and isinstance(f.value, ast.Name) and f.value.id == "time":
+        return True
+    return bare_time and isinstance(f, ast.Name) and f.id == "time"
+
+
+def _has_bare_time_import(tree: ast.Module) -> bool:
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom) and n.module == "time":
+            if any(a.name == "time" for a in n.names):
+                return True
+    return False
+
+
+def _scope_nodes(fn: ast.AST) -> List[ast.AST]:
+    """Nodes of ``fn``'s own scope (nested defs/lambdas excluded)."""
+    out: List[ast.AST] = []
+    stack = list(fn.body)
+    while stack:
+        n = stack.pop()
+        out.append(n)
+        if isinstance(n, FUNCS + (ast.Lambda,)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _event_gates(fn: ast.AST, self_name: Optional[str]) -> Set[str]:
+    """``self.<attr>`` names whose ``.wait()`` / ``.is_set()`` gate a
+    while-loop condition — the poison-pill discipline GC08 credits."""
+    gates: Set[str] = set()
+    if self_name is None:
+        return gates
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.While):
+            continue
+        for c in ast.walk(n.test):
+            if isinstance(c, ast.Call) \
+                    and isinstance(c.func, ast.Attribute) \
+                    and c.func.attr in ("wait", "is_set"):
+                v = c.func.value
+                if isinstance(v, ast.Attribute) \
+                        and isinstance(v.value, ast.Name) \
+                        and v.value.id == self_name:
+                    gates.add(v.attr)
+    return gates
+
+
+def _summarize_function(ctx: Any, mi: ModuleInfo, fn: ast.AST,
+                        class_name: Optional[str], direct_method: bool,
+                        bare_time: bool, resolver) -> FunctionSummary:
+    qual = ctx.qualname(fn)
+    fid: FuncId = (ctx.relpath, qual)
+    args = fn.args
+    params = tuple(a.arg for a in
+                   list(args.posonlyargs) + list(args.args))
+    is_method = direct_method and class_name is not None \
+        and bool(params) \
+        and not any(dec_name(d) == "staticmethod"
+                    for d in fn.decorator_list)
+    # a closure nested under a class method captures the literal `self`
+    # from its enclosing method — its self.<attr> writes and self.m()
+    # calls belong to the class exactly like a method's do
+    self_name = params[0] if is_method else (
+        "self" if class_name is not None and not direct_method else None)
+    s = FunctionSummary(
+        fid=fid, name=fn.name, lineno=fn.lineno, class_name=class_name,
+        is_method=is_method, self_name=self_name, params=params,
+        memoized=is_memo_decorated(fn),
+    )
+
+    nodes = _scope_nodes(fn)
+
+    # local taint: names assigned from time.time()-derived expressions,
+    # names assigned from fresh jit creations, names assigned from calls
+    tainted: Set[str] = set()
+    jit_named: Set[str] = set()
+    for n in nodes:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(n, ast.Assign):
+            targets, value = list(n.targets), n.value
+        elif isinstance(n, ast.AnnAssign) and n.value is not None:
+            targets, value = [n.target], n.value
+        if value is None:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names:
+            continue
+        if any(_wall_call(x, bare_time) for x in ast.walk(value)):
+            tainted.update(names)
+        if is_jit_creation(value):
+            jit_named.update(names)
+
+    def derives_wall(expr: ast.AST) -> bool:
+        for x in ast.walk(expr):
+            if _wall_call(x, bare_time):
+                return True
+            if isinstance(x, ast.Name) and x.id in tainted \
+                    and isinstance(x.ctx, ast.Load):
+                return True
+        return False
+
+    # nested @jit defs whose NAME is returned count as fresh-jit returns
+    jit_defs = {n.name for n in ast.walk(fn)
+                if isinstance(n, FUNCS) and n is not fn
+                and any(is_jit_decorator(d) for d in n.decorator_list)}
+
+    for n in nodes:
+        if isinstance(n, ast.Return) and n.value is not None:
+            v = n.value
+            if derives_wall(v):
+                s.returns_wall_direct = True
+            if is_jit_creation(v) or (
+                    isinstance(v, ast.Name)
+                    and (v.id in jit_named or v.id in jit_defs)):
+                s.returns_fresh_jit_direct = True
+    # return_call_targets are resolved by the caller (_return_targets)
+    # once the whole module table exists
+
+    # attr writes on self / params, call sites, loops, transfers
+    watched = set(params) | ({self_name} if self_name else set())
+    for n in nodes:
+        tgts: List[ast.Attribute] = []
+        if isinstance(n, ast.Assign):
+            tgts = [t for t in n.targets if isinstance(t, ast.Attribute)]
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)) \
+                and isinstance(n.target, ast.Attribute):
+            tgts = [n.target]
+        for t in tgts:
+            if isinstance(t.value, ast.Name) and t.value.id in watched:
+                rec = (t.attr, n.lineno, under_lock(ctx, n, fn))
+                if t.value.id == self_name:
+                    s.self_attr_writes.append(rec)
+                else:
+                    s.param_attr_writes.setdefault(
+                        t.value.id, []).append(rec)
+        if isinstance(n, ast.While):
+            s.has_while_loop = True
+        if is_transfer_call(n):
+            s.transfer_direct = True
+        if isinstance(n, ast.Call):
+            callee = None
+            try:
+                callee = resolver(mi, n, class_name, self_name)
+            except Exception:  # noqa: BLE001 — resolution must never
+                callee = None  # crash pass 1; degrade to unknown
+            self_pos: Tuple[int, ...] = ()
+            if self_name is not None:
+                self_pos = tuple(
+                    i for i, a in enumerate(n.args)
+                    if isinstance(a, ast.Name) and a.id == self_name)
+            try:
+                crepr = ast.unparse(n.func)
+            except Exception:  # noqa: BLE001 — odd nodes
+                crepr = dec_name(n)
+            s.calls.append(CallSite(
+                line=n.lineno, callee=callee,
+                under_lock=under_lock(ctx, n, fn),
+                self_arg_positions=self_pos, callee_repr=crepr))
+
+    s.loop_event_gates = _event_gates(fn, self_name)
+    return s
+
+
+def build_index(contexts: List[Any]) -> InterProcIndex:
+    """Two-phase pass over every parsed module: (1) import maps +
+    top-level def / class-method tables, (2) per-function summaries with
+    call resolution, then the transitive fixpoints."""
+    idx = InterProcIndex()
+
+    # phase 1: names
+    for ctx in contexts:
+        mi = ModuleInfo(ctx.relpath, module_name_of(ctx.relpath),
+                        is_package=ctx.relpath.endswith("__init__.py"))
+        _collect_imports(mi, ctx.tree)
+        for n in ctx.tree.body:
+            if isinstance(n, FUNCS):
+                mi.toplevel[n.name] = (ctx.relpath, n.name)
+            elif isinstance(n, ast.ClassDef):
+                methods = {}
+                for m in n.body:
+                    if isinstance(m, FUNCS):
+                        methods[m.name] = (ctx.relpath,
+                                           f"{n.name}.{m.name}")
+                mi.classes[n.name] = methods
+        idx.modules[mi.modname] = mi
+        idx.modules_by_path[ctx.relpath] = mi
+
+    # phase 2: summaries (imports + toplevel maps are complete, so call
+    # sites resolve against the full project as they are extracted)
+    for ctx in contexts:
+        mi = idx.modules_by_path[ctx.relpath]
+        bare = _has_bare_time_import(ctx.tree)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, FUNCS):
+                continue
+            # NEAREST enclosing class (nested closures inherit it via
+            # the captured `self`); direct methods get param-0 self
+            cls = None
+            for a in ctx.ancestors(fn):
+                if isinstance(a, ast.ClassDef):
+                    cls = a.name
+                    break
+            direct = isinstance(ctx.parent(fn), ast.ClassDef)
+            s = _summarize_function(ctx, mi, fn, cls, direct, bare,
+                                    idx.resolve_call)
+            s.return_call_targets = _return_targets(
+                mi, fn, cls, s.self_name, idx.resolve_call)
+            idx.functions[s.fid] = s
+
+    _fixpoint(idx)
+    return idx
+
+
+def _return_targets(mi: ModuleInfo, fn: ast.AST,
+                    class_name: Optional[str],
+                    self_name: Optional[str], resolver) -> List[FuncId]:
+    """Callees whose return value ``fn`` returns (directly or through
+    one local name) — the taint/jit propagation edges."""
+    out: List[FuncId] = []
+    nodes = _scope_nodes(fn)
+    call_named: Dict[str, ast.Call] = {}
+    for n in nodes:
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    call_named[t.id] = n.value
+    for n in nodes:
+        if not (isinstance(n, ast.Return) and n.value is not None):
+            continue
+        calls: List[ast.Call] = []
+        if isinstance(n.value, ast.Call):
+            calls.append(n.value)
+        elif isinstance(n.value, ast.Name) \
+                and n.value.id in call_named:
+            calls.append(call_named[n.value.id])
+        else:
+            # `return now() - t0` style: every call inside the returned
+            # expression can carry taint into the return value
+            calls.extend(x for x in ast.walk(n.value)
+                         if isinstance(x, ast.Call))
+        for c in calls:
+            try:
+                fid = resolver(mi, c, class_name, self_name)
+            except Exception:  # noqa: BLE001 — degrade to unknown
+                fid = None
+            if fid is not None:
+                out.append(fid)
+    return out
+
+
+def _fixpoint(idx: InterProcIndex) -> None:
+    """Close returns_wall / returns_fresh_jit over
+    the call graph. Monotone boolean lattice -> terminates."""
+    for s in idx.functions.values():
+        s.returns_wall = s.returns_wall_direct
+        # a memoized factory hands back the SAME closure per config key:
+        # calling it per step is a cache hit, not a fresh compile
+        s.returns_fresh_jit = s.returns_fresh_jit_direct \
+            and not s.memoized
+    changed = True
+    while changed:
+        changed = False
+        for s in idx.functions.values():
+            if not s.returns_wall:
+                for t in s.return_call_targets:
+                    ts = idx.functions.get(t)
+                    if ts is not None and ts.returns_wall:
+                        s.returns_wall = True
+                        changed = True
+                        break
+            if not s.returns_fresh_jit and not s.memoized:
+                for t in s.return_call_targets:
+                    ts = idx.functions.get(t)
+                    if ts is not None and ts.returns_fresh_jit:
+                        s.returns_fresh_jit = True
+                        changed = True
+                        break
+
+
+# ---------------------------------------------------------------------------
+# GC04 helper: transitive attr-write collection from a thread entry
+# ---------------------------------------------------------------------------
+
+def collect_entry_writes(idx: InterProcIndex, ctx: Any,
+                         entry_fid: FuncId, max_depth: int = 4) \
+        -> List[Tuple[str, int, bool, str]]:
+    """Every ``self.<attr>`` write reachable from thread entry point
+    ``entry_fid`` by following method calls on self (and helper calls
+    that receive self as an argument), with the lock context each call
+    edge carries: a write is *guarded* when its own site sits under a
+    ``with <lock>:`` OR every call edge leading to it held a lock.
+
+    Returns ``(attr, report_line, guarded, via)`` where ``report_line``
+    is always a line in the ENTRY's module (cross-module writes are
+    reported at the call site that reaches them) and ``via`` names the
+    callee chain for the finding message ("" for direct writes).
+    """
+    out: List[Tuple[str, int, bool, str]] = []
+    seen: Set[Tuple[FuncId, bool]] = set()
+
+    def visit(fid: FuncId, lock_held: bool, depth: int,
+              report_line: Optional[int], via: str) -> None:
+        if depth > max_depth or (fid, lock_held) in seen:
+            return
+        seen.add((fid, lock_held))
+        s = idx.functions.get(fid)
+        if s is None:
+            return
+        for attr, line, guarded in s.self_attr_writes:
+            out.append((attr, report_line if report_line is not None
+                        else line, guarded or lock_held, via))
+        for c in s.calls:
+            if c.callee is None:
+                continue
+            t = idx.functions.get(c.callee)
+            if t is None:
+                continue
+            edge_locked = lock_held or c.under_lock
+            nxt_via = c.callee_repr if not via \
+                else f"{via} -> {c.callee_repr}"
+            # same-class method on self: follow with the callee's own
+            # line numbers when it lives in the same module (precise
+            # report), else pin the report to this call site
+            same_module = c.callee[0] == fid[0]
+            rl = report_line if report_line is not None else (
+                None if same_module else c.line)
+            if t.is_method and t.class_name == s.class_name \
+                    and same_module:
+                visit(c.callee, edge_locked, depth + 1, rl, nxt_via)
+            elif t.param_attr_writes or t.calls:
+                # helper receiving self positionally: its writes to that
+                # param are writes to our object
+                for pos in c.self_arg_positions:
+                    if pos < len(t.params):
+                        pname = t.params[pos]
+                        for attr, line, guarded in \
+                                t.param_attr_writes.get(pname, []):
+                            out.append((
+                                attr,
+                                report_line if report_line is not None
+                                else c.line,
+                                guarded or edge_locked, nxt_via))
+
+    visit(entry_fid, False, 0, None, "")
+    return out

@@ -3,8 +3,13 @@
 Each rule is a function ``(ctx: ModuleContext, project: ProjectIndex) ->
 List[Finding]``. The engine builds one :class:`ModuleContext` per file
 (parse tree + parent links + comment map) and a :class:`ProjectIndex`
-from a cheap first pass over every scanned file (registry stub constants
-and their alias functions — the only cross-file state any rule needs).
+from a first pass over every scanned file: registry stub constants and
+their alias functions, plus the INTERPROCEDURAL summary index
+(:mod:`.interproc`) — a project-wide call graph with per-function
+summaries (returns-tainted, param-escapes, locks-held-at-call) that
+lets GC02 follow a ``time.time()`` value through helper returns, GC04
+follow shared-attribute writes through methods called from thread
+targets, and GC01 track jit-closure factories across modules.
 
 The rules encode PROJECT invariants, not general style: they must pass
 the known-good compile-factory population clean — the ~67 jit/lru_cache
@@ -19,12 +24,25 @@ silent pass.
 from __future__ import annotations
 
 import ast
-import re
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from . import interproc
+from .interproc import (FUNCS, LOOPS, LOCKISH, InterProcIndex,
+                        collect_entry_writes, dec_name,
+                        is_cache_decorator, is_jit_creation,
+                        is_jit_decorator, is_memo_decorated,
+                        is_thread_ctor, is_transfer_call, under_lock)
+
+import re
+
 __all__ = ["Finding", "ModuleContext", "ProjectIndex", "RULES",
-           "collect_project", "run_rules"]
+           "RULESTAMP", "collect_project", "run_rules"]
+
+#: bumped whenever ANY rule's behavior changes — invalidates the
+#: engine's content-hash findings cache wholesale (a stale cache must
+#: never outvote an upgraded rule)
+RULESTAMP = "graftcheck-v2.2"
 
 
 @dataclass
@@ -36,6 +54,11 @@ class Finding:
     message: str
     hint: str = ""
     symbol: str = "<module>"
+    #: mechanical-fix payload (``--fix``): rule-specific. GC02 —
+    #: source lines on which ``time.time()`` must become
+    #: ``time.monotonic()``; GC06 — the handler line to annotate.
+    fix_kind: Optional[str] = None
+    fix_lines: Tuple[int, ...] = ()
 
     @property
     def fingerprint(self) -> str:
@@ -50,6 +73,14 @@ class Finding:
         if self.hint:
             s += f" [fix: {self.hint}]"
         return s
+
+    def to_json(self) -> dict:
+        d = {k: v for k, v in vars(self).items()
+             if k not in ("fix_kind", "fix_lines")}
+        d["fingerprint"] = self.fingerprint
+        d["fix_kind"] = self.fix_kind
+        d["fix_lines"] = list(self.fix_lines)
+        return d
 
 
 class ModuleContext:
@@ -92,6 +123,18 @@ class ModuleContext:
                 return a
         return None
 
+    def enclosing_class_name(self, node: ast.AST) -> Optional[str]:
+        for a in self.ancestors(node):
+            if isinstance(a, ast.ClassDef):
+                return a.name
+        return None
+
+    def is_test_module(self) -> bool:
+        """tests/ and test_*.py files: deliberate ad-hoc compiles there
+        are not production retrace hazards (GC01 skips them)."""
+        return self.parts[0] == "tests" \
+            or self.parts[-1].startswith("test_")
+
 
 @dataclass
 class ProjectIndex:
@@ -100,68 +143,49 @@ class ProjectIndex:
     stubs: Dict[str, Tuple[str, Tuple[str, ...]]]
     #: alias function name -> STUB const name (e.g. promotion_stub)
     stub_aliases: Dict[str, str]
+    #: interprocedural summaries + call graph (None only when the
+    #: summary pass failed — rules degrade to intra-module behavior)
+    interproc: Optional[InterProcIndex] = field(default=None)
+
+    def resolver_for(self, ctx: "ModuleContext"):
+        """``resolve(call_node, class_name, self_name) -> summary|None``
+        bound to ``ctx``'s module, or None without an interproc index."""
+        idx = self.interproc
+        if idx is None:
+            return None
+        mi = idx.modules_by_path.get(ctx.relpath)
+        if mi is None:
+            return None
+
+        def resolve(call, class_name, self_name):
+            try:
+                fid = idx.resolve_call(mi, call, class_name, self_name)
+            except Exception:  # noqa: BLE001 — degrade to unknown
+                return None
+            return idx.functions.get(fid) if fid is not None else None
+
+        return resolve
 
 
-FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
-LOOPS = (ast.For, ast.AsyncFor, ast.While)
-
-
-def _dec_name(dec: ast.AST) -> str:
-    """The rightmost identifier of a (possibly called) decorator."""
-    target = dec.func if isinstance(dec, ast.Call) else dec
-    if isinstance(target, ast.Attribute):
-        return target.attr
-    if isinstance(target, ast.Name):
-        return target.id
-    return ""
-
-
-_CACHE_NAMES = {"lru_cache", "_lru_cache", "cache", "cached"}
-_FACTORY_NAMES = {"instrument_factory", "_instrument"}
-
-
-def _is_cache_decorator(dec: ast.AST) -> bool:
-    return _dec_name(dec) in _CACHE_NAMES
-
-
-def _is_memo_decorated(fn: ast.AST) -> bool:
-    """lru_cache / instrument_factory on the def: a memoized compile
-    factory — jit creations inside it happen once per config key."""
-    return any(_dec_name(d) in (_CACHE_NAMES | _FACTORY_NAMES)
-               for d in getattr(fn, "decorator_list", []))
-
-
-def _is_jit_name(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Name) and node.id == "jit") or \
-        (isinstance(node, ast.Attribute) and node.attr == "jit")
-
-
-def _is_partial(node: ast.AST) -> bool:
-    return isinstance(node, ast.Call) and _dec_name(node) in (
-        "partial", "_partial")
-
-
-def _is_jit_creation(node: ast.AST) -> bool:
-    """A Call producing a jit-compiled callable: ``jax.jit(f)``,
-    ``jit(f)``, or ``partial(jax.jit, ...)(f)``."""
-    if not isinstance(node, ast.Call):
-        return False
-    if _is_jit_name(node.func):
-        return True
-    if isinstance(node.func, ast.Call) and _is_partial(node.func) \
-            and node.func.args and _is_jit_name(node.func.args[0]):
-        return True
-    return False
-
-
-def _is_jit_decorator(dec: ast.AST) -> bool:
-    if _is_jit_name(dec):
-        return True
-    if _is_partial(dec) and dec.args and _is_jit_name(dec.args[0]):
-        return True
-    if isinstance(dec, ast.Call) and _is_jit_name(dec.func):
-        return True
-    return False
+def _scope_identity(ctx: ModuleContext, fn: Optional[ast.AST]) \
+        -> Tuple[Optional[str], Optional[str]]:
+    """(class_name, self_name) for resolving ``self.x()`` calls inside
+    ``fn`` — direct methods use their first arg, closures nested under a
+    class capture the literal ``self``."""
+    if fn is None:
+        return None, None
+    cls = ctx.enclosing_class_name(fn)
+    if cls is None:
+        return None, None
+    parent = ctx.parent(fn)
+    if isinstance(parent, ast.ClassDef):
+        args = fn.args
+        params = list(args.posonlyargs) + list(args.args)
+        if params and not any(dec_name(d) == "staticmethod"
+                              for d in fn.decorator_list):
+            return cls, params[0].arg
+        return cls, None
+    return cls, "self"
 
 
 # ---------------------------------------------------------------------------
@@ -175,7 +199,10 @@ _GC01_HINT = ("hoist into a module-level factory memoized with lru_cache "
 
 def gc01_retrace_hazard(ctx: ModuleContext, project: ProjectIndex) \
         -> List[Finding]:
+    if ctx.is_test_module():
+        return []    # tests compile ad hoc by design
     out: List[Finding] = []
+    resolve = project.resolver_for(ctx)
 
     def add(node, msg):
         out.append(Finding("GC01", ctx.relpath, node.lineno,
@@ -185,7 +212,7 @@ def gc01_retrace_hazard(ctx: ModuleContext, project: ProjectIndex) \
     def chain_memoized(fn) -> bool:
         cur = fn
         while cur is not None:
-            if isinstance(cur, FUNCS) and _is_memo_decorated(cur):
+            if isinstance(cur, FUNCS) and is_memo_decorated(cur):
                 return True
             cur = ctx.parent(cur)
         return False
@@ -225,7 +252,7 @@ def gc01_retrace_hazard(ctx: ModuleContext, project: ProjectIndex) \
         # nested lru_cache factory: a fresh cache object per enclosing
         # call — the cache never hits, every call recompiles
         if isinstance(node, FUNCS) \
-                and any(_is_cache_decorator(d) for d in node.decorator_list):
+                and any(is_cache_decorator(d) for d in node.decorator_list):
             encl = ctx.enclosing_function(node)
             if encl is not None and not chain_memoized(encl):
                 add(node, f"lru_cache compile factory '{node.name}' defined "
@@ -237,7 +264,7 @@ def gc01_retrace_hazard(ctx: ModuleContext, project: ProjectIndex) \
         # fine when the closure escapes (factory pattern), a hazard when
         # it is only invoked locally or created in a loop
         if isinstance(node, FUNCS) \
-                and any(_is_jit_decorator(d) for d in node.decorator_list):
+                and any(is_jit_decorator(d) for d in node.decorator_list):
             encl = ctx.enclosing_function(node)
             if encl is None or chain_memoized(encl):
                 continue
@@ -252,12 +279,37 @@ def gc01_retrace_hazard(ctx: ModuleContext, project: ProjectIndex) \
                           f"(fresh compile per call)")
             continue
 
-        if not _is_jit_creation(node):
+        # interprocedural: a call to a FACTORY whose summary says it
+        # returns a fresh jit closure per call — the per-call compile
+        # hides behind the function boundary (cross-module included)
+        if isinstance(node, ast.Call) and resolve is not None \
+                and not is_jit_creation(node):
+            encl = ctx.enclosing_function(node)
+            cls_name, self_name = _scope_identity(ctx, encl)
+            s = resolve(node, cls_name, self_name)
+            if s is not None and s.returns_fresh_jit \
+                    and not (encl is not None and chain_memoized(encl)) \
+                    and (ctx.relpath, ctx.qualname(encl or node)) != s.fid:
+                p = ctx.parent(node)
+                if in_loop_below(node, encl):
+                    add(node, f"call to jit-closure factory "
+                              f"'{s.name}' inside a loop — a fresh "
+                              f"compile per iteration hides behind the "
+                              f"function boundary")
+                    continue
+                if isinstance(p, ast.Call) and p.func is node:
+                    add(node, f"jit-closure factory '{s.name}' called "
+                              f"and its product invoked inline (fresh "
+                              f"compile per call across the function "
+                              f"boundary)")
+                    continue
+
+        if not is_jit_creation(node):
             continue
         # skip the inner partial(jax.jit,...) of an already-handled
         # creation, and decorator positions (handled above)
         p = ctx.parent(node)
-        if isinstance(p, ast.Call) and _is_jit_creation(p):
+        if isinstance(p, ast.Call) and is_jit_creation(p):
             continue
         if isinstance(p, FUNCS) and node in p.decorator_list:
             continue
@@ -313,6 +365,7 @@ def gc02_clock_discipline(ctx: ModuleContext, project: ProjectIndex) \
         -> List[Finding]:
     out: List[Finding] = []
     bare = _has_bare_time_import(ctx.tree)
+    resolve = project.resolver_for(ctx)
 
     def is_wall_call(n: ast.AST) -> bool:
         if not isinstance(n, ast.Call):
@@ -326,15 +379,35 @@ def gc02_clock_discipline(ctx: ModuleContext, project: ProjectIndex) \
     def contains_wall(n: ast.AST) -> bool:
         return any(is_wall_call(x) for x in ast.walk(n))
 
+    def helper_wall_name(n: ast.AST, cls_name, self_name) \
+            -> Optional[str]:
+        """Name of a called helper whose summary proves it RETURNS a
+        time.time()-derived value (the interprocedural upgrade)."""
+        if resolve is None:
+            return None
+        for x in ast.walk(n):
+            if isinstance(x, ast.Call) and not is_wall_call(x):
+                s = resolve(x, cls_name, self_name)
+                if s is not None and s.returns_wall:
+                    return s.name
+        return None
+
     def contains_tainted(n: ast.AST, tainted: Set[str]) -> bool:
         return any(isinstance(x, ast.Name) and x.id in tainted
                    and isinstance(x.ctx, ast.Load) for x in ast.walk(n))
 
     def scan_scope(scope: ast.AST) -> None:
         """One function (or the module body): taint names assigned from
-        time.time(), then flag subtraction / ordered comparison involving
+        time.time() — directly or via a helper whose summary returns a
+        wall value — then flag subtraction / ordered comparison involving
         the wall clock. Nested functions are separate scopes."""
-        tainted: Set[str] = set()
+        fn = scope if isinstance(scope, FUNCS) else None
+        cls_name, self_name = _scope_identity(ctx, fn)
+        tainted: Set[str] = set()        # names carrying wall taint
+        wall_lines: Dict[str, Set[int]] = {}   # name -> EVERY source
+        #                 line assigning it from a literal wall call (a
+        #                 name can be re-assigned; --fix must rewrite
+        #                 all of them or the rescan still fails)
         body_nodes = []
         stack = list(scope.body)
         while stack:
@@ -344,16 +417,27 @@ def gc02_clock_discipline(ctx: ModuleContext, project: ProjectIndex) \
                 continue                 # separate scope
             stack.extend(ast.iter_child_nodes(n))
         for n in body_nodes:
-            if isinstance(n, ast.Assign) and contains_wall(n.value):
-                for t in n.targets:
-                    if isinstance(t, ast.Name):
-                        tainted.add(t.id)
+            tgt_names: List[str] = []
+            value = None
+            if isinstance(n, ast.Assign):
+                tgt_names = [t.id for t in n.targets
+                             if isinstance(t, ast.Name)]
+                value = n.value
             elif isinstance(n, ast.AnnAssign) and n.value is not None \
-                    and contains_wall(n.value) \
                     and isinstance(n.target, ast.Name):
-                tainted.add(n.target.id)
-        flagged: Set[int] = set()        # one finding per line — a
-        for n in body_nodes:             # deadline compare often wraps
+                tgt_names = [n.target.id]
+                value = n.value
+            if not tgt_names or value is None:
+                continue
+            literal = contains_wall(value)
+            if literal or helper_wall_name(value, cls_name, self_name):
+                for t in tgt_names:
+                    tainted.add(t)
+                    if literal:          # helper-tainted lines carry no
+                        wall_lines.setdefault(t, set()).add(n.lineno)
+                    #                      time.time() literal to rewrite
+        dur_nodes: List[Tuple[ast.AST, List[ast.AST]]] = []
+        for n in body_nodes:             # a deadline compare often wraps
             sides: List[ast.AST] = []    # the subtraction it contains
             if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub):
                 sides = [n.left, n.right]
@@ -361,21 +445,84 @@ def gc02_clock_discipline(ctx: ModuleContext, project: ProjectIndex) \
                     isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
                     for op in n.ops):   # ordered = deadline semantics;
                 sides = [n.left] + list(n.comparators)   # `is None` etc.
-            if not sides or n.lineno in flagged:         # are not
+            if sides:                                    # are not
+                dur_nodes.append((n, sides))
+        # --fix closure analysis: a tainted name is rewritable only when
+        # EVERY Load use of it in this scope sits inside duration
+        # arithmetic — a name that also feeds an export (`ts = start *
+        # 1e6` epoch anchors) keeps wall semantics, and rewriting either
+        # its assignment or arithmetic that mixes it in would corrupt
+        # the anchor / mix clocks. Uses inside nested scopes are opaque:
+        # treated as anchors.
+        in_duration: Set[int] = set()
+        for n, _ in dur_nodes:
+            for x in ast.walk(n):
+                if isinstance(x, ast.Name):
+                    in_duration.add(id(x))
+        anchored: Set[str] = set()       # names used OUTSIDE duration
+        for n in body_nodes:
+            if isinstance(n, FUNCS + (ast.Lambda,)):
+                for x in ast.walk(n):
+                    if isinstance(x, ast.Name) \
+                            and isinstance(x.ctx, ast.Load):
+                        anchored.add(x.id)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and id(n) not in in_duration:
+                anchored.add(n.id)
+        flagged: Set[int] = set()        # one finding per line
+        for n, sides in dur_nodes:
+            if n.lineno in flagged:
                 continue
             direct = any(contains_wall(s) for s in sides)
+            helper = None
+            if not direct:
+                for s in sides:
+                    helper = helper_wall_name(s, cls_name, self_name)
+                    if helper:
+                        break
             via_name = any(contains_tainted(s, tainted) for s in sides)
-            if direct or via_name:
+            if direct or helper or via_name:
                 flagged.add(n.lineno)
-                what = "time.time()" if direct \
-                    else "a value derived from time.time()"
+                if direct:
+                    what = "time.time()"
+                elif helper:
+                    what = (f"{helper}() (a helper returning a "
+                            f"time.time()-derived value)")
+                else:
+                    what = "a value derived from time.time()"
                 kind = "subtraction" if isinstance(n, ast.BinOp) \
                     else "deadline comparison"
+                # --fix payload: only lines holding a LITERAL
+                # time.time() to rewrite — the flagged line when the
+                # wall call sits in the arithmetic, plus taint-source
+                # assignments that contain the literal — and only when
+                # the rewrite set is CLOSED: every tainted name feeding
+                # this arithmetic must have literal source lines AND no
+                # anchor use, or rewriting would mix clocks / corrupt a
+                # wall anchor. Helper-return taint has no local
+                # mechanical fix (the helper is elsewhere): claiming
+                # fixability for it would make `--fix --write` report
+                # success on a no-op rewrite.
+                fix: Set[int] = set()
+                names_involved = {x.id for s in sides
+                                  for x in ast.walk(s)
+                                  if isinstance(x, ast.Name)
+                                  and x.id in tainted}
+                closed = all(name not in anchored
+                             and wall_lines.get(name)
+                             for name in names_involved)
+                if closed:
+                    if direct:
+                        fix.add(n.lineno)
+                    for name in names_involved:
+                        fix |= wall_lines.get(name, set())
                 out.append(Finding(
                     "GC02", ctx.relpath, n.lineno, n.col_offset,
                     f"{what} used in duration {kind} — wall clock is not "
                     f"monotonic (NTP steps corrupt intervals)",
-                    _GC02_HINT, ctx.qualname(n)))
+                    _GC02_HINT, ctx.qualname(n),
+                    fix_kind="gc02-monotonic" if fix else None,
+                    fix_lines=tuple(sorted(fix))))
 
     scan_scope(ctx.tree)
     for n in ast.walk(ctx.tree):
@@ -441,16 +588,63 @@ def gc03_atomic_write(ctx: ModuleContext, project: ProjectIndex) \
 _GC04_HINT = ("hold the owning lock (with self._lock:) around the write, "
               "or annotate the single-writer argument with "
               "# graftcheck: disable=GC04")
-_LOCKISH = re.compile(r"lock|mutex", re.IGNORECASE)
 
 
-def _is_thread_ctor(call: ast.Call) -> bool:
-    return _dec_name(call) == "Thread"
+def _thread_entries(ctx: ModuleContext, cls: ast.ClassDef) \
+        -> List[Tuple[str, ast.AST]]:
+    """Thread entry points of one class: methods handed to
+    ``Thread(target=...)`` (including nested closures and
+    ``target=lambda: self.m()``), ``run()`` on Thread subclasses, and
+    ``do_*`` handlers on HTTP handler classes."""
+    base_names = []
+    for b in cls.bases:
+        try:
+            base_names.append(ast.unparse(b))
+        except Exception:  # noqa: BLE001 — unparse of odd nodes
+            pass
+    entries: List[Tuple[str, ast.AST]] = []
+    methods = {m.name: m for m in cls.body if isinstance(m, FUNCS)}
+    for n in ast.walk(cls):
+        if not (isinstance(n, ast.Call) and is_thread_ctor(n)):
+            continue
+        for kw in n.keywords:
+            if kw.arg != "target":
+                continue
+            t = kw.value
+            if isinstance(t, ast.Lambda) and isinstance(t.body, ast.Call):
+                t = t.body.func          # target=lambda: self.m(...)
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self" and t.attr in methods:
+                entries.append((t.attr, methods[t.attr]))
+            elif isinstance(t, ast.Name):
+                # nested closure target: find its def in the class
+                for d in ast.walk(cls):
+                    if isinstance(d, FUNCS) and d.name == t.id \
+                            and ctx.enclosing_function(d) is not None:
+                        host = ctx.enclosing_function(d)
+                        entries.append(
+                            (f"{getattr(host, 'name', '?')}.{d.name}",
+                             d))
+    if any(b.endswith("Thread") for b in base_names) \
+            and "run" in methods:
+        entries.append(("run", methods["run"]))
+    if any("RequestHandler" in b for b in base_names):
+        entries.extend((name, m) for name, m in methods.items()
+                       if name.startswith("do_"))
+    seen: List[int] = []
+    uniq: List[Tuple[str, ast.AST]] = []
+    for name, node in entries:
+        if id(node) not in seen:
+            seen.append(id(node))
+            uniq.append((name, node))
+    return uniq
 
 
 def gc04_lock_discipline(ctx: ModuleContext, project: ProjectIndex) \
         -> List[Finding]:
     out: List[Finding] = []
+    idx = project.interproc
 
     # sub-rule: Lock.acquire() outside a with — with-discipline makes
     # release unconditional across every exit path
@@ -461,7 +655,7 @@ def gc04_lock_discipline(ctx: ModuleContext, project: ProjectIndex) \
                 owner = ast.unparse(n.func.value)
             except Exception:  # noqa: BLE001 — unparse of odd nodes
                 owner = ""
-            if _LOCKISH.search(owner):
+            if LOCKISH.search(owner):
                 out.append(Finding(
                     "GC04", ctx.relpath, n.lineno, n.col_offset,
                     f"{owner}.acquire() outside a with-statement — an "
@@ -473,73 +667,40 @@ def gc04_lock_discipline(ctx: ModuleContext, project: ProjectIndex) \
     for cls in ast.walk(ctx.tree):
         if not isinstance(cls, ast.ClassDef):
             continue
-        base_names = []
-        for b in cls.bases:
-            try:
-                base_names.append(ast.unparse(b))
-            except Exception:  # noqa: BLE001 — unparse of odd nodes
-                pass
-        # thread entry points: methods handed to Thread(target=...),
-        # run() on Thread subclasses, do_* handlers on HTTP handler
-        # classes — code that executes on a thread other than the
-        # constructing one
-        entries: List[Tuple[str, ast.AST]] = []
-        methods = {m.name: m for m in cls.body if isinstance(m, FUNCS)}
-        for n in ast.walk(cls):
-            if not (isinstance(n, ast.Call) and _is_thread_ctor(n)):
-                continue
-            for kw in n.keywords:
-                if kw.arg != "target":
-                    continue
-                t = kw.value
-                if isinstance(t, ast.Attribute) \
-                        and isinstance(t.value, ast.Name) \
-                        and t.value.id == "self" and t.attr in methods:
-                    entries.append((t.attr, methods[t.attr]))
-                elif isinstance(t, ast.Name):
-                    # nested closure target: find its def in the class
-                    for d in ast.walk(cls):
-                        if isinstance(d, FUNCS) and d.name == t.id \
-                                and ctx.enclosing_function(d) is not None:
-                            host = ctx.enclosing_function(d)
-                            entries.append(
-                                (f"{getattr(host, 'name', '?')}.{d.name}",
-                                 d))
-        if any(b.endswith("Thread") for b in base_names) \
-                and "run" in methods:
-            entries.append(("run", methods["run"]))
-        if any("RequestHandler" in b for b in base_names):
-            entries.extend((name, m) for name, m in methods.items()
-                           if name.startswith("do_"))
-        if len(entries) < 2:
-            continue
-        seen = []
-        uniq = []
-        for name, node in entries:
-            if id(node) not in seen:
-                seen.append(id(node))
-                uniq.append((name, node))
+        uniq = _thread_entries(ctx, cls)
         if len(uniq) < 2:
             continue
 
-        def under_lock(n: ast.AST, top: ast.AST) -> bool:
-            for a in ctx.ancestors(n):
-                if isinstance(a, ast.With):
-                    for item in a.items:
-                        try:
-                            src = ast.unparse(item.context_expr)
-                        except Exception:  # noqa: BLE001 — odd nodes
-                            src = ""
-                        if _LOCKISH.search(src):
-                            return True
-                if a is top:
-                    break
-            return False
+        # attr -> entry name -> [(report line, guarded, via)]
+        writes: Dict[str, Dict[str, List[Tuple[int, bool, str]]]] = {}
 
-        # attr -> entry-context name -> [(write node, guarded)]
-        writes: Dict[str, Dict[str, List[Tuple[ast.AST, bool]]]] = {}
+        def record(attr: str, entry: str, line: int, guarded: bool,
+                   via: str) -> None:
+            sites = writes.setdefault(attr, {}).setdefault(entry, [])
+            if (line, guarded, via) not in sites:
+                sites.append((line, guarded, via))
+
         for name, node in uniq:
-            for n in ast.walk(node):
+            summarized = False
+            if idx is not None:
+                fid = (ctx.relpath, ctx.qualname(node))
+                if fid in idx.functions:
+                    for attr, line, guarded, via in \
+                            collect_entry_writes(idx, ctx, fid):
+                        record(attr, name, line, guarded, via)
+                    summarized = True
+            # walk the entry for direct self-writes: the WHOLE method
+            # when no summary exists (pre-v2 view); with a summary,
+            # only its nested defs — closures are absent from the
+            # function's summary and a bare call to one resolves to
+            # None, so their writes would otherwise vanish from the
+            # index entirely
+            if summarized:
+                scan_roots = [d for d in ast.walk(node)
+                              if isinstance(d, FUNCS) and d is not node]
+            else:
+                scan_roots = [node]
+            for n in (x for root in scan_roots for x in ast.walk(root)):
                 tgt = None
                 if isinstance(n, (ast.Assign,)):
                     for t in n.targets:
@@ -554,23 +715,27 @@ def gc04_lock_discipline(ctx: ModuleContext, project: ProjectIndex) \
                     tgt = n.target
                 if tgt is None:
                     continue
-                writes.setdefault(tgt.attr, {}).setdefault(name, []) \
-                    .append((n, under_lock(n, node)))
+                record(tgt.attr, name, n.lineno,
+                       under_lock(ctx, n, node), "")
+
         for attr, by_entry in writes.items():
             if len(by_entry) < 2:
                 continue
             for entry_name, sites in by_entry.items():
-                for n, guarded in sites:
+                for line, guarded, via in sites:
                     if guarded:
                         continue
-                    others = sorted(e for e in by_entry if e != entry_name)
+                    others = sorted(e for e in by_entry
+                                    if e != entry_name)
+                    through = f" (via {via})" if via else ""
                     out.append(Finding(
-                        "GC04", ctx.relpath, n.lineno, n.col_offset,
+                        "GC04", ctx.relpath, line, 0,
                         f"self.{attr} written from thread entry point "
-                        f"'{entry_name}' without the owning lock, and "
-                        f"also written from {', '.join(others)} — "
-                        f"unsynchronized multi-thread mutation",
-                        _GC04_HINT, ctx.qualname(n)))
+                        f"'{entry_name}'{through} without the owning "
+                        f"lock, and also written from "
+                        f"{', '.join(others)} — unsynchronized "
+                        f"multi-thread mutation",
+                        _GC04_HINT, f"{cls.name}.{entry_name}"))
     return out
 
 
@@ -603,7 +768,9 @@ def _stub_defs(tree: ast.Module) -> Dict[str, Tuple[ast.AST,
 def collect_project(contexts: List[ModuleContext]) -> ProjectIndex:
     """First pass: stub constants + their alias functions (a module-level
     def whose body references exactly one ``*_STUB`` name, e.g.
-    ``serve.promote.promotion_stub``)."""
+    ``serve.promote.promotion_stub``), plus the interprocedural summary
+    index every upgraded rule consumes. A summary-pass failure degrades
+    to ``interproc=None`` (intra-module rule behavior), never a crash."""
     stubs: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
     aliases: Dict[str, str] = {}
     for ctx in contexts:
@@ -616,7 +783,11 @@ def collect_project(contexts: List[ModuleContext]) -> ProjectIndex:
                     if isinstance(x, ast.Name) and x.id.endswith("_STUB")}
             if len(refs) == 1:
                 aliases[n.name] = refs.pop()
-    return ProjectIndex(stubs=stubs, stub_aliases=aliases)
+    try:
+        idx: Optional[InterProcIndex] = interproc.build_index(contexts)
+    except Exception:  # noqa: BLE001 — summaries degrade to "unknown",
+        idx = None     # never take the gate down with an analyzer crash
+    return ProjectIndex(stubs=stubs, stub_aliases=aliases, interproc=idx)
 
 
 def _literal_keys_of(fn: ast.AST, ctx: ModuleContext,
@@ -676,7 +847,7 @@ def _literal_keys_of(fn: ast.AST, ctx: ModuleContext,
                 if isinstance(v, ast.Dict):
                     eat_dict(v, conditional(n))
                 if isinstance(v, ast.Call):
-                    callee = _dec_name(v)
+                    callee = dec_name(v)
                     if project.stub_aliases.get(callee) == stub_name:
                         seeded = True
                     if callee == "dict" and v.args \
@@ -719,7 +890,7 @@ def gc05_surface_parity(ctx: ModuleContext, project: ProjectIndex) \
     for n in ast.walk(ctx.tree):
         if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
                 and n.func.attr == "register" \
-                and "registry" in _dec_name(n.func.value).lower():
+                and "registry" in dec_name(n.func.value).lower():
             if n.args and isinstance(n.args[0], ast.Constant) \
                     and isinstance(n.args[0].value, str):
                 name = n.args[0].value
@@ -757,8 +928,8 @@ def gc05_surface_parity(ctx: ModuleContext, project: ProjectIndex) \
             if isinstance(x, ast.Name) and x.id.endswith("_STUB"):
                 refs.add(x.id)
             elif isinstance(x, ast.Call) \
-                    and _dec_name(x) in project.stub_aliases:
-                refs.add(project.stub_aliases[_dec_name(x)])
+                    and dec_name(x) in project.stub_aliases:
+                refs.add(project.stub_aliases[dec_name(x)])
         section_calls = {x.func.attr for x in ast.walk(fn)
                          if isinstance(x, ast.Call)
                          and isinstance(x.func, ast.Attribute)
@@ -841,7 +1012,249 @@ def gc06_broad_except(ctx: ModuleContext, project: ProjectIndex) \
             "broad `except Exception` without a why-comment — silent "
             "catch-alls in serving/observability hot paths hide real "
             "failures",
-            _GC06_HINT, ctx.qualname(n)))
+            _GC06_HINT, ctx.qualname(n),
+            fix_kind="gc06-annotate", fix_lines=(n.lineno,)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC07 — transfer-discipline (models/ and ops/ hot loops)
+# ---------------------------------------------------------------------------
+
+_GC07_DIRS = {"models", "ops"}
+_GC07_HINT = ("hoist the fetch out of the loop (batch it after the loop, "
+              "or keep the value device-resident); a deliberate per-"
+              "iteration sync (e.g. a measured once-per-epoch fetch) "
+              "takes # graftcheck: disable=GC07 with the argument on "
+              "the line")
+
+
+def gc07_transfer_discipline(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    if not (_GC07_DIRS & set(ctx.parts[:-1])):
+        return []
+    if ctx.is_test_module():
+        return []
+    out: List[Finding] = []
+    resolve = project.resolver_for(ctx)
+    flagged: Set[int] = set()
+
+    comps = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+    for loop in ast.walk(ctx.tree):
+        # the loop BODY runs per iteration; the iterable expression and
+        # the else-clause evaluate once — only the body is hot.
+        # Comprehensions are loops too: the element expression (and
+        # every generator clause past the first's iterable) runs per
+        # element
+        seeds: List[ast.AST]
+        if isinstance(loop, LOOPS):
+            seeds = list(loop.body)
+        elif isinstance(loop, comps):
+            if isinstance(loop, ast.DictComp):
+                seeds = [loop.key, loop.value]
+            else:
+                seeds = [loop.elt]
+            for g in loop.generators:
+                seeds.extend(g.ifs)
+            seeds.extend(g.iter for g in loop.generators[1:])
+        else:
+            continue
+        body_nodes: List[ast.AST] = []
+        stack: List[ast.AST] = list(seeds)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, FUNCS + (ast.Lambda,)):
+                continue                 # defining != executing per iter
+            body_nodes.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        encl = ctx.enclosing_function(loop)
+        cls_name, self_name = _scope_identity(ctx, encl)
+        for n in body_nodes:
+            if not isinstance(n, ast.Call) or n.lineno in flagged:
+                continue
+            if is_transfer_call(n):
+                try:
+                    what = ast.unparse(n.func)
+                except Exception:  # noqa: BLE001 — odd nodes
+                    what = "host transfer"
+                flagged.add(n.lineno)
+                out.append(Finding(
+                    "GC07", ctx.relpath, n.lineno, n.col_offset,
+                    f"{what}() inside a per-step loop — a forced "
+                    f"device->host sync per iteration serializes the "
+                    f"pipeline (hot-loop transfer)",
+                    _GC07_HINT, ctx.qualname(n)))
+            elif resolve is not None:
+                # one function boundary only: a callee that ITSELF
+                # performs the transfer. Deeper chains in this codebase
+                # always cross an intentional architecture boundary
+                # (dispatch, checkpoint save) where the sync is the
+                # point — flagging them would bury the real hazards
+                s = resolve(n, cls_name, self_name)
+                if s is not None and s.transfer_direct:
+                    flagged.add(n.lineno)
+                    out.append(Finding(
+                        "GC07", ctx.relpath, n.lineno, n.col_offset,
+                        f"call to '{s.name}' inside a per-step loop "
+                        f"performs a device->host transfer "
+                        f"(np.asarray/device_get/block_until_ready) — "
+                        f"a forced sync per iteration serializes the "
+                        f"pipeline",
+                        _GC07_HINT, ctx.qualname(n)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC08 — thread-lifecycle (shutdown must join / poison-pill / timeout)
+# ---------------------------------------------------------------------------
+
+_GC08_HINT = ("give the thread a shutdown path: join it (with a timeout) "
+              "in close()/stop(), or gate its loop on an Event the "
+              "shutdown sets (poison pill); a deliberately unmanaged "
+              "daemon takes # graftcheck: disable=GC08 with the argument")
+
+
+def _class_join_credits(ctx: ModuleContext, cls: ast.ClassDef) \
+        -> Set[str]:
+    """Attribute names the class provably joins: ``self.X.join(...)``
+    anywhere, or ``for t in self.X: t.join(...)`` loop-join."""
+    credits: Set[str] = set()
+    # loop variables bound over self.<attr>
+    loop_over: Dict[str, str] = {}       # loop var -> attr
+    for n in ast.walk(cls):
+        if isinstance(n, ast.For) and isinstance(n.target, ast.Name) \
+                and isinstance(n.iter, ast.Attribute) \
+                and isinstance(n.iter.value, ast.Name) \
+                and n.iter.value.id == "self":
+            loop_over[n.target.id] = n.iter.attr
+    for n in ast.walk(cls):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "join"):
+            continue
+        base = n.func.value
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self":
+            credits.add(base.attr)
+        elif isinstance(base, ast.Name) and base.id in loop_over:
+            credits.add(loop_over[base.id])
+    return credits
+
+
+def _class_event_sets(ctx: ModuleContext, cls: ast.ClassDef) -> Set[str]:
+    """``self.<attr>.set()`` calls anywhere in the class — poison-pill
+    senders for GC08's event-gate credit."""
+    out: Set[str] = set()
+    for n in ast.walk(cls):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "set":
+            v = n.func.value
+            if isinstance(v, ast.Attribute) \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self":
+                out.add(v.attr)
+    return out
+
+
+def gc08_thread_lifecycle(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    idx = project.interproc
+    if idx is None:
+        return []                        # needs target summaries
+    out: List[Finding] = []
+
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        joins = _class_join_credits(ctx, cls)
+        event_sets = _class_event_sets(ctx, cls)
+        methods = {m.name: m for m in cls.body if isinstance(m, FUNCS)}
+
+        for n in ast.walk(cls):
+            if not (isinstance(n, ast.Call) and is_thread_ctor(n)):
+                continue
+            # where does the Thread object go? self.<attr> = Thread(...)
+            # directly, or local = Thread(...) later stored/appended on
+            # self — locals that never reach self are out of scope
+            # (anonymous per-task threads, locally-joined workers)
+            stored_attr: Optional[str] = None
+            p = ctx.parent(n)
+            local_name: Optional[str] = None
+            if isinstance(p, ast.Assign):
+                for t in p.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        stored_attr = t.attr
+                    elif isinstance(t, ast.Name):
+                        local_name = t.id
+            if stored_attr is None and local_name is not None:
+                host = ctx.enclosing_function(n)
+                scope = host if host is not None else cls
+                for m in ast.walk(scope):
+                    if isinstance(m, ast.Assign):
+                        for t in m.targets:
+                            if isinstance(t, ast.Attribute) \
+                                    and isinstance(t.value, ast.Name) \
+                                    and t.value.id == "self" \
+                                    and isinstance(m.value, ast.Name) \
+                                    and m.value.id == local_name:
+                                stored_attr = t.attr
+                    elif isinstance(m, ast.Call) \
+                            and isinstance(m.func, ast.Attribute) \
+                            and m.func.attr == "append" \
+                            and m.args \
+                            and isinstance(m.args[0], ast.Name) \
+                            and m.args[0].id == local_name:
+                        v = m.func.value
+                        if isinstance(v, ast.Attribute) \
+                                and isinstance(v.value, ast.Name) \
+                                and v.value.id == "self":
+                            stored_attr = v.attr
+            if stored_attr is None:
+                continue
+
+            # resolve the target's summary; unknown targets degrade
+            target_summary = None
+            for kw in n.keywords:
+                if kw.arg != "target":
+                    continue
+                t = kw.value
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" and t.attr in methods:
+                    target_summary = idx.functions.get(
+                        (ctx.relpath, ctx.qualname(methods[t.attr])))
+                elif isinstance(t, ast.Name):
+                    for d in ast.walk(cls):
+                        if isinstance(d, FUNCS) and d.name == t.id \
+                                and ctx.enclosing_function(d) \
+                                is not None:
+                            target_summary = idx.functions.get(
+                                (ctx.relpath, ctx.qualname(d)))
+            if target_summary is None \
+                    or not target_summary.has_while_loop:
+                continue                 # run-once worker / unknown —
+            #                              no shutdown obligation proven
+            if stored_attr in joins:
+                continue                 # join discipline
+            gates = target_summary.loop_event_gates
+            if gates & event_sets:
+                continue                 # poison-pill discipline
+            gate_note = ""
+            if gates:
+                gate_note = (f" (its loop waits on self."
+                             f"{sorted(gates)[0]}, but nothing in the "
+                             f"class ever set()s it)")
+            out.append(Finding(
+                "GC08", ctx.relpath, n.lineno, n.col_offset,
+                f"long-running thread stored on self.{stored_attr} has "
+                f"no shutdown path: target "
+                f"'{target_summary.name}' loops forever and the class "
+                f"never joins self.{stored_attr} or signals its "
+                f"poison-pill event{gate_note}",
+                _GC08_HINT, f"{cls.name}"))
     return out
 
 
@@ -849,20 +1262,28 @@ def gc06_broad_except(ctx: ModuleContext, project: ProjectIndex) \
 RULES = {
     "GC01": (gc01_retrace_hazard,
              "retrace-hazard: per-call jit closures / nested compile "
-             "factories"),
+             "factories / fresh-jit factory calls across modules"),
     "GC02": (gc02_clock_discipline,
-             "clock-discipline: time.time() in duration arithmetic"),
+             "clock-discipline: time.time() in duration arithmetic, "
+             "including through helper returns"),
     "GC03": (gc03_atomic_write,
              "atomic-write: bare write-open in io//serve/ outside the "
              "tmp->fsync->os.replace idiom"),
     "GC04": (gc04_lock_discipline,
              "lock-discipline: unsynchronized multi-thread attribute "
-             "mutation / acquire() without with"),
+             "mutation (incl. via called methods) / acquire() without "
+             "with"),
     "GC05": (gc05_surface_parity,
              "surface-parity: stub/live registry key drift + Prometheus "
              "name grammar"),
     "GC06": (gc06_broad_except,
              "broad-except: unannotated `except Exception` in serve//obs/"),
+    "GC07": (gc07_transfer_discipline,
+             "transfer-discipline: device->host sync reachable inside "
+             "models//ops/ hot loops"),
+    "GC08": (gc08_thread_lifecycle,
+             "thread-lifecycle: long-running threads whose shutdown "
+             "path lacks join/poison-pill"),
 }
 
 

@@ -1,18 +1,30 @@
 #!/bin/sh
+# CI artifacts (graftcheck JSON report, tsan race log) land here; a
+# fresh run starts from a clean slate so stale races can't confuse a
+# read of the artifacts.
+mkdir -p artifacts
+rm -f artifacts/graftcheck_report.json artifacts/tsan_races.jsonl
+
 # graftcheck gate (docs/STATIC_ANALYSIS.md): project-invariant static
-# analysis, run FIRST because it is the cheapest phase (~15 s, AST-only).
-# --selfcheck proves the gate in both directions before the real scan —
-# every rule must fire on a seeded violation in a scratch tree and the
-# baseline machinery must silence fresh findings / flag stale entries —
-# then the bare run fails on ANY finding (the tree's contract since
-# PR 11 is an EMPTY baseline; a PR that must land with debt commits
-# graftcheck_baseline.json, which the bare run picks up from the repo
-# root, and the gate keeps failing once a baselined finding is fixed
-# but its entry lingers).
+# analysis, run FIRST because it is the cheapest phase (~15 s budget
+# <=30 s, AST-only). --selfcheck proves the gate in three directions
+# before the real scan — every rule (incl. the interprocedural
+# GC01/GC02/GC04 upgrades and GC07/GC08) must fire on a seeded
+# violation in a scratch tree, the baseline machinery must silence
+# fresh findings / flag stale entries, and the tsan lockset sanitizer
+# must detect the re-seeded PR 11 last_reload_error race — then the
+# real scan (package + tests/ + bench.py + graft entry; content-hash
+# cached, whole-scan invalidation on any edit or rule bump) fails on
+# ANY finding (the tree's contract since PR 11 is an EMPTY baseline; a
+# PR that must land with debt commits graftcheck_baseline.json, which
+# the bare run picks up from the repo root, and the gate keeps failing
+# once a baselined finding is fixed but its entry lingers). The full
+# JSON report is emitted as a CI artifact.
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.tools.graftcheck --selfcheck || exit $?
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python -m hivemall_tpu.tools.graftcheck || exit $?
+    python -m hivemall_tpu.tools.graftcheck \
+    --json-out artifacts/graftcheck_report.json || exit $?
 
 # Run the test suite on CPU (8 virtual devices), never touching the TPU
 # tunnel: PALLAS_AXON_POOL_IPS triggers a relay dial at interpreter boot via
@@ -41,7 +53,14 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # coalesce (mean batch > 1), bit-match offline predict_proba on the same
 # rows, stay under the p99 latency budget, and a newer checkpoint written
 # mid-traffic must hot-reload without dropping in-flight requests.
+# HIVEMALL_TPU_TSAN=1 runs it under the Eraser-style lockset race
+# sanitizer (hivemall_tpu.testing.tsan): every registered serve/obs
+# class's attribute writes are lockset-checked across the HTTP handler
+# / dispatch / watch / warmup threads, and ANY write/write race fails
+# the smoke (the latency budget relaxes — a sanitizer build is never a
+# perf build; the un-instrumented budget stays pinned by bench_serve).
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
     python -m hivemall_tpu.serve.smoke || exit $?
 
 # fleet smoke (docs/SERVING.md "Fleet topology"): 2 replica PROCESSES
@@ -56,7 +75,12 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # appears in spans exported from BOTH the router and the scoring
 # replica processes via the router's merged /trace (the tracing-
 # overhead floor itself stays pinned by the obs smoke above).
+# The lockset sanitizer rides along here too: manager-side threads
+# (health monitor, rolling reload, respawn, router accept/handlers,
+# SLO sampler) gate on zero races in-process; replica subprocesses
+# inherit the env and append any races to the shared artifact log.
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
     python -m hivemall_tpu.serve.fleet_smoke || exit $?
 
 # promotion smoke (docs/RELIABILITY.md "Promotion and rollback"): gated

@@ -536,3 +536,769 @@ def test_cli_selfcheck():
          "--selfcheck"], capture_output=True, text=True, cwd=REPO, env=env)
     assert r.returncode == 0, r.stderr
     assert "bidirectional" in r.stdout
+
+
+# ===========================================================================
+# graftcheck v2: interprocedural dataflow, GC07/GC08, cache, --fix
+# ===========================================================================
+
+def check_srcs(tmp_path, files, cache=None):
+    """Write a multi-module scratch tree and scan it (the
+    interprocedural fixtures need more than one file)."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_paths([str(tmp_path)], root=str(tmp_path), cache=cache)
+
+
+# -- interprocedural non-vacuity: each fixture is INVISIBLE to the PR 11
+# intra-module analysis (the single-module scan is pinned clean) and
+# MUST be caught once the summaries connect the modules ------------------
+
+GC02_HELPER = """
+    import time
+    def now_s():
+        return time.time()
+"""
+
+GC02_USER = """
+    from pkg.utils.clockutil import now_s
+    def wait(seconds):
+        deadline = now_s() + seconds
+        while now_s() < deadline:
+            pass
+"""
+
+
+def test_gc02_cross_module_taint_flagged(tmp_path):
+    out = check_srcs(tmp_path, {"pkg/utils/clockutil.py": GC02_HELPER,
+                                "pkg/io/dl.py": GC02_USER})
+    hits = [f for f in out if f.code == "GC02"]
+    assert hits and hits[0].path == "pkg/io/dl.py"
+    assert "now_s" in hits[0].message
+
+
+def test_gc02_cross_module_missed_by_single_module_scan(tmp_path):
+    """The PR 11 miss, pinned: without the helper module in the scan the
+    taint trail dies at the function boundary."""
+    out = check_srcs(tmp_path, {"pkg/io/dl.py": GC02_USER})
+    assert [f for f in out if f.code == "GC02"] == []
+
+
+def test_gc02_transitive_helper_chain(tmp_path):
+    """Taint survives TWO function boundaries (helper returning a
+    helper's return)."""
+    out = check_srcs(tmp_path, {
+        "pkg/utils/clockutil.py": GC02_HELPER,
+        "pkg/utils/indirect.py": """
+            from pkg.utils.clockutil import now_s
+            def stamp():
+                return now_s()
+        """,
+        "pkg/io/dl.py": """
+            from pkg.utils.indirect import stamp
+            def age(t0):
+                return stamp() - t0
+        """})
+    assert [f.path for f in out if f.code == "GC02"] == ["pkg/io/dl.py"]
+
+
+GC01_FACTORY = """
+    import jax
+    def make_step(f):
+        return jax.jit(f)
+"""
+
+
+def test_gc01_cross_module_factory_in_loop(tmp_path):
+    out = check_srcs(tmp_path, {
+        "pkg/ops/fac.py": GC01_FACTORY,
+        "pkg/models/use.py": """
+            from pkg.ops.fac import make_step
+            def score_all(fns, x):
+                return [make_step(f)(x) for f in fns]
+        """})
+    hits = [f for f in out if f.code == "GC01"]
+    assert hits and hits[0].path == "pkg/models/use.py"
+    assert "make_step" in hits[0].message
+
+
+def test_gc01_cross_module_missed_by_single_module_scan(tmp_path):
+    out = check_srcs(tmp_path, {"pkg/models/use.py": """
+        from pkg.ops.fac import make_step
+        def score_all(fns, x):
+            return [make_step(f)(x) for f in fns]
+    """})
+    assert [f for f in out if f.code == "GC01"] == []
+
+
+def test_gc01_factory_product_escapes_clean(tmp_path):
+    """Callers that STORE the factory product (the repo's _make_step
+    idiom) must stay clean — only loop/immediate-invoke calls fire."""
+    out = check_srcs(tmp_path, {
+        "pkg/ops/fac.py": GC01_FACTORY,
+        "pkg/models/use.py": """
+            from pkg.ops.fac import make_step
+            class T:
+                def __init__(self, f):
+                    self._step = make_step(f)
+        """})
+    assert out == []
+
+
+def test_gc01_memoized_factory_calls_clean(tmp_path):
+    """A memoized factory returns the SAME closure per config — calling
+    it per step (even in a loop) is a cache hit, never a recompile."""
+    out = check_srcs(tmp_path, {
+        "pkg/ops/fac.py": """
+            import jax
+            from functools import lru_cache
+            @lru_cache(maxsize=8)
+            def make_step(n):
+                return jax.jit(lambda v: v * n)
+        """,
+        "pkg/models/use.py": """
+            from pkg.ops.fac import make_step
+            def score_all(xs):
+                return [make_step(8)(x) for x in xs]
+        """})
+    assert out == []
+
+
+GC04_CROSS = """
+    import threading
+    from pkg.serve.helper import bump_counter
+    class X:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            threading.Thread(target=self._a).start()
+            threading.Thread(target=self._b).start()
+        def _a(self):
+            bump_counter(self)
+        def _b(self):
+            with self._lock:
+                self.count -= 1
+"""
+
+
+def test_gc04_cross_module_param_write_flagged(tmp_path):
+    out = check_srcs(tmp_path, {
+        "pkg/serve/helper.py": "def bump_counter(obj):\n"
+                               "    obj.count += 1\n",
+        "pkg/serve/w.py": GC04_CROSS})
+    hits = [f for f in out if f.code == "GC04"]
+    assert hits and any("via bump_counter" in f.message for f in hits)
+
+
+def test_gc04_cross_module_missed_by_single_module_scan(tmp_path):
+    out = check_srcs(tmp_path, {"pkg/serve/w.py": GC04_CROSS})
+    assert [f for f in out if f.code == "GC04"] == []
+
+
+def test_gc04_write_via_method_chain_flagged(tmp_path):
+    """A write buried one method call below the thread entry — invisible
+    to the PR 11 entry-local walk."""
+    out = check_srcs(tmp_path, {"pkg/serve/w.py": """
+        import threading
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                threading.Thread(target=self._a).start()
+                threading.Thread(target=self._b).start()
+            def _a(self):
+                self._bump()
+            def _bump(self):
+                self.n += 1
+            def _b(self):
+                self.n -= 1
+    """})
+    hits = [f for f in out if f.code == "GC04"]
+    assert any("via self._bump" in f.message for f in hits)
+
+
+def test_gc04_nested_closure_write_still_flagged(tmp_path):
+    """Writes inside a nested helper closure of a summarized thread
+    entry: the closure is absent from the entry's summary and a bare
+    call to it resolves to None, so the rule must ALSO walk the entry's
+    nested defs (regression — the v2 summary path once replaced the
+    walk entirely and this PR 11-era catch went silent)."""
+    out = check_srcs(tmp_path, {"pkg/serve/w.py": """
+        import threading
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                threading.Thread(target=self._a).start()
+                threading.Thread(target=self._b).start()
+            def _a(self):
+                def bump():
+                    self.count += 1
+                for _ in range(10):
+                    bump()
+            def _b(self):
+                with self._lock:
+                    self.count = 0
+    """})
+    hits = [f for f in out if f.code == "GC04" and "count" in f.message]
+    assert hits and hits[0].symbol == "W._a"
+
+
+def test_gc04_lock_held_at_call_site_propagates(tmp_path):
+    """A write is guarded when the CALL EDGE held the lock, even though
+    the write site itself shows no with-block (the engine.poll() ->
+    _load_newest() shape)."""
+    out = check_srcs(tmp_path, {"pkg/serve/w.py": """
+        import threading
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                threading.Thread(target=self._a).start()
+                threading.Thread(target=self._b).start()
+            def _a(self):
+                with self._lock:
+                    self._bump()
+            def _bump(self):
+                self.n += 1
+            def _b(self):
+                with self._lock:
+                    self.n -= 1
+    """})
+    assert [f for f in out if f.code == "GC04"] == []
+
+
+# -- GC07 transfer-discipline --------------------------------------------
+
+def test_gc07_direct_transfer_in_loop_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        import numpy as np
+        def train(step, batches):
+            losses = []
+            for b in batches:
+                losses.append(float(np.asarray(step(b))))
+            return losses
+    """, "pkg/models/hot.py")
+    assert codes(out) == ["GC07"]
+
+
+def test_gc07_one_hop_helper_flagged(tmp_path):
+    out = check_srcs(tmp_path, {
+        "pkg/ops/fetch.py": "import numpy as np\n"
+                            "def fetch(x):\n"
+                            "    return float(np.asarray(x))\n",
+        "pkg/models/hot.py": """
+            from pkg.ops.fetch import fetch
+            def train(step, batches):
+                return [fetch(step(b)) for b in batches]
+        """})
+    hits = [f for f in out if f.code == "GC07"]
+    assert hits and hits[0].path == "pkg/models/hot.py"
+    assert "fetch" in hits[0].message
+
+
+def test_gc07_transfer_outside_loop_clean(tmp_path):
+    out = check_src(tmp_path, """
+        import numpy as np
+        def train(step, batches):
+            acc = None
+            for b in batches:
+                acc = step(b, acc)
+            return float(np.asarray(acc))
+    """, "pkg/models/hot.py")
+    assert out == []
+
+
+def test_gc07_outside_models_ops_not_scanned(tmp_path):
+    out = check_src(tmp_path, """
+        import numpy as np
+        def drain(batches):
+            return [np.asarray(b) for b in batches]
+    """, "pkg/io/x.py")
+    assert out == []
+
+
+def test_gc07_loop_iter_expression_clean(tmp_path):
+    """The iterable evaluates ONCE — np.asarray in the for-iter position
+    is not a per-iteration sync."""
+    out = check_src(tmp_path, """
+        import numpy as np
+        def walk(xs):
+            total = 0
+            for v in np.asarray(xs):
+                total += v
+            return total
+    """, "pkg/models/x.py")
+    assert out == []
+
+
+def test_gc07_block_until_ready_flagged(tmp_path):
+    out = check_src(tmp_path, """
+        def train(step, batches):
+            for b in batches:
+                step(b).block_until_ready()
+    """, "pkg/ops/x.py")
+    assert codes(out) == ["GC07"]
+
+
+# -- GC08 thread-lifecycle -----------------------------------------------
+
+GC08_LEAKY = """
+    import threading
+    class Daemon:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+        def _run(self):
+            while True:
+                pass
+"""
+
+
+def test_gc08_unjoined_looping_thread_flagged(tmp_path):
+    out = check_src(tmp_path, GC08_LEAKY)
+    assert codes(out) == ["GC08"]
+
+
+def test_gc08_joined_thread_clean(tmp_path):
+    out = check_src(tmp_path, """
+        import threading
+        class Daemon:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+            def _run(self):
+                while True:
+                    pass
+            def close(self):
+                self._t.join(timeout=5)
+    """)
+    assert out == []
+
+
+def test_gc08_poison_pill_event_clean(tmp_path):
+    out = check_src(tmp_path, """
+        import threading
+        class Daemon:
+            def start(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+            def _run(self):
+                while not self._stop.wait(1.0):
+                    pass
+            def close(self):
+                self._stop.set()
+    """)
+    assert out == []
+
+
+def test_gc08_event_gate_never_set_flagged(tmp_path):
+    """A loop gated on an Event nothing ever set()s is NOT a shutdown
+    path — the finding names the dangling gate."""
+    out = check_src(tmp_path, """
+        import threading
+        class Daemon:
+            def start(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+            def _run(self):
+                while not self._stop.wait(1.0):
+                    pass
+    """)
+    assert codes(out) == ["GC08"]
+    assert "_stop" in out[0].message
+
+
+def test_gc08_loop_join_over_thread_list_clean(tmp_path):
+    """The fleet idiom: threads appended to self._threads, joined in a
+    for-loop at stop()."""
+    out = check_src(tmp_path, """
+        import threading
+        class M:
+            def start(self):
+                self._threads = []
+                for name in ("a", "b"):
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+                    self._threads.append(t)
+            def _loop(self):
+                while True:
+                    pass
+            def stop(self):
+                for t in self._threads:
+                    t.join(timeout=5)
+    """)
+    assert out == []
+
+
+def test_gc08_run_once_target_clean(tmp_path):
+    """No loop in the target: the thread ends on its own — no shutdown
+    obligation (the engine's background-warmup shape)."""
+    out = check_src(tmp_path, """
+        import threading
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._work, daemon=True)
+                self._t.start()
+            def _work(self):
+                x = 1 + 1
+                return x
+    """)
+    assert out == []
+
+
+def test_gc08_anonymous_local_thread_out_of_scope(tmp_path):
+    """Fire-and-forget threads never stored on self (per-connection
+    handlers, locally-joined workers) are out of GC08's scope."""
+    out = check_src(tmp_path, """
+        import threading
+        class A:
+            def handle(self, conns):
+                for c in conns:
+                    threading.Thread(target=self._serve,
+                                     args=(c,), daemon=True).start()
+            def _serve(self, c):
+                while c.alive():
+                    pass
+    """)
+    assert out == []
+
+
+# -- pass-1 robustness: exotic constructs degrade, never crash ------------
+
+def test_pass1_decorated_async_lambda_property_no_crash(tmp_path):
+    """Decorated defs, async defs, lambdas as thread targets and
+    properties must all survive pass 1; unresolvable constructs degrade
+    to 'unknown' (no findings invented)."""
+    out = check_srcs(tmp_path, {"pkg/serve/exotic.py": """
+        import threading
+        import functools
+
+        def mystery(fn):
+            @functools.wraps(fn)
+            def inner(*a, **k):
+                return fn(*a, **k)
+        　
+        class E:
+            def __init__(self):
+                self._t = threading.Thread(target=lambda: self._spin())
+                self._t.start()
+
+            @property
+            def size(self):
+                return 1
+
+            @size.setter
+            def size(self, v):
+                self._size = v
+
+            @mystery
+            def decorated(self):
+                return self.size
+
+            async def poll(self):
+                return self.size
+
+            def _spin(self):
+                while True:
+                    pass
+
+            def close(self):
+                self._t.join(timeout=1)
+    """.replace("　", "")})
+    assert [f for f in out if f.code == "GC00"] == []
+    # the lambda target resolves through to _spin or degrades silently;
+    # either way the joined thread must not produce a GC08 finding
+    assert [f for f in out if f.code == "GC08"] == []
+
+
+def test_pass1_lambda_thread_target_degrades_unknown(tmp_path):
+    """A lambda target that cannot be resolved produces NO GC08 finding
+    even without a join — unknown degrades to silence, not certainty."""
+    out = check_src(tmp_path, """
+        import threading
+        class E:
+            def start(self, job):
+                self._t = threading.Thread(target=lambda: job.run())
+                self._t.start()
+    """)
+    assert [f for f in out if f.code == "GC08"] == []
+
+
+def test_summaries_degrade_on_dynamic_dispatch(tmp_path):
+    """getattr dispatch is unresolvable: no GC02 finding is invented for
+    a helper the analysis cannot identify."""
+    out = check_src(tmp_path, """
+        import time
+        def get_clock(name):
+            return getattr(time, name)
+        def wait(seconds):
+            clock = get_clock("monotonic")
+            deadline = clock() + seconds
+            while clock() < deadline:
+                pass
+    """)
+    assert out == []
+
+
+# -- findings cache -------------------------------------------------------
+
+def test_cache_warm_replay_identical(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    files = {"pkg/io/bad.py": GC03_BAD}
+    cold = check_srcs(tmp_path, files, cache=cache)
+    assert cold and os.path.exists(cache)
+    warm = run_paths([str(tmp_path)], root=str(tmp_path), cache=cache)
+    assert [f.fingerprint for f in warm] == [f.fingerprint for f in cold]
+    assert [(f.line, f.col) for f in warm] == [(f.line, f.col)
+                                              for f in cold]
+
+
+def test_cache_invalidated_by_edit(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    check_srcs(tmp_path, {"pkg/io/bad.py": GC03_BAD}, cache=cache)
+    # fix the violation on disk: the cached findings must NOT be replayed
+    (tmp_path / "pkg" / "io" / "bad.py").write_text(
+        textwrap.dedent(GC03_GOOD))
+    out = run_paths([str(tmp_path)], root=str(tmp_path), cache=cache)
+    assert out == []
+
+
+def test_cache_invalidated_by_rulestamp(tmp_path):
+    from hivemall_tpu.tools.graftcheck.engine import _cache_load
+    cache = str(tmp_path / "cache.json")
+    check_srcs(tmp_path, {"pkg/io/bad.py": GC03_BAD}, cache=cache)
+    data = json.loads((tmp_path / "cache.json").read_text())
+    data["stamp"] = "graftcheck-v0-ancient"
+    (tmp_path / "cache.json").write_text(json.dumps(data))
+    shas = {rel: e["sha"] for rel, e in data["files"].items()}
+    assert _cache_load(cache, shas) is None
+
+
+def test_cache_invalidated_by_new_file(tmp_path):
+    """Interprocedural coupling: ADDING a module must invalidate the
+    whole cache (its summaries can change other files' findings)."""
+    cache = str(tmp_path / "cache.json")
+    check_srcs(tmp_path, {"pkg/io/dl.py": GC02_USER}, cache=cache)
+    out = check_srcs(tmp_path, {"pkg/io/dl.py": GC02_USER,
+                                "pkg/utils/clockutil.py": GC02_HELPER},
+                     cache=cache)
+    assert [f for f in out if f.code == "GC02"]
+
+
+# -- --fix ---------------------------------------------------------------
+
+def test_fix_gc02_rewrites_clock_and_taint_sources(tmp_path):
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    bad = tree / "clockbad.py"
+    bad.write_text(textwrap.dedent("""
+        import time
+        def wait(s):
+            deadline = time.time() + s
+            while time.time() < deadline:
+                pass
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "hivemall_tpu.tools.graftcheck",
+             str(tree), "--root", str(tmp_path), *extra],
+            capture_output=True, text=True, cwd=REPO, env=env)
+
+    r = run("--fix")
+    assert r.returncode == 1
+    assert "-    deadline = time.time() + s" in r.stdout
+    assert "+    deadline = time.monotonic() + s" in r.stdout
+    assert bad.read_text().count("time.time()") == 2  # diff only
+    r = run("--fix", "--write")
+    assert r.returncode == 0, r.stderr
+    assert "time.time()" not in bad.read_text()
+    assert run().returncode == 0          # post-fix scan gates clean
+
+
+def test_fix_gc06_inserts_annotation(tmp_path):
+    tree = tmp_path / "pkg" / "serve"
+    tree.mkdir(parents=True)
+    bad = tree / "x.py"
+    bad.write_text(textwrap.dedent("""
+        def f():
+            try:
+                pass
+            except Exception:
+                pass
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "hivemall_tpu.tools.graftcheck",
+         str(tmp_path / "pkg"), "--root", str(tmp_path),
+         "--fix", "--write"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "except Exception:  #" in bad.read_text()
+
+
+# -- repo-level: the EXTENDED default scan gates clean --------------------
+
+def test_extended_repo_surface_gates_clean():
+    """tests/, bench.py and the graft entry obey the same invariants as
+    the package (the PR 12 scan-coverage satellite): the full default
+    surface carries ZERO findings."""
+    paths = [PKG, os.path.join(REPO, "tests"),
+             os.path.join(REPO, "bench.py"),
+             os.path.join(REPO, "__graft_entry__.py")]
+    out = run_paths([p for p in paths if os.path.exists(p)], root=REPO)
+    assert out == [], "\n".join(f.render() for f in out)
+
+
+# -- review-pass regressions ----------------------------------------------
+
+def test_fix_helper_tainted_gc02_not_claimed_fixable(tmp_path):
+    """A GC02 finding whose taint source is a HELPER return carries no
+    literal time.time() to rewrite: --fix --write must not report
+    success on a no-op (the gate would still fail next run)."""
+    files = {"pkg/utils/clockutil.py": GC02_HELPER,
+             "pkg/io/dl.py": """
+                 from pkg.utils.clockutil import now_s
+                 def over(limit):
+                     t0 = now_s()
+                     return limit - t0 > 5
+             """}
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    out = run_paths([str(tmp_path)], root=str(tmp_path))
+    hits = [f for f in out if f.code == "GC02"]
+    assert hits and all(f.fix_kind is None for f in hits)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    before = (tmp_path / "pkg" / "io" / "dl.py").read_text()
+    r = subprocess.run(
+        [sys.executable, "-m", "hivemall_tpu.tools.graftcheck",
+         str(tmp_path / "pkg"), "--root", str(tmp_path),
+         "--fix", "--write"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert "rewrote 0 finding(s)" in r.stderr, r.stderr
+    assert (tmp_path / "pkg" / "io" / "dl.py").read_text() == before
+
+
+def test_dotted_module_alias_resolution(tmp_path):
+    """`import pkg.utils as utils` + `utils.clockutil.now_s()` must
+    resolve through the alias even when the alias equals the target's
+    last component (the review-caught resolution bug)."""
+    out = check_srcs(tmp_path, {
+        "pkg/utils/clockutil.py": GC02_HELPER,
+        "pkg/utils/__init__.py": "",
+        "pkg/__init__.py": "",
+        "pkg/io/dl.py": """
+            import pkg.utils as utils
+            def wait(seconds):
+                deadline = utils.clockutil.now_s() + seconds
+                while utils.clockutil.now_s() < deadline:
+                    pass
+        """})
+    hits = [f for f in out if f.code == "GC02"]
+    assert hits and hits[0].path == "pkg/io/dl.py", \
+        "\n".join(f.render() for f in out)
+
+
+def test_package_reexport_hop_resolves(tmp_path):
+    """`from .clockutil import now_s` inside pkg/utils/__init__.py is a
+    PACKAGE-relative import: consumers importing through the package
+    re-export must still carry the taint (review-caught: packages
+    resolved one level too high and the hop silently went dark)."""
+    out = check_srcs(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/utils/clockutil.py": GC02_HELPER,
+        "pkg/utils/__init__.py": "from .clockutil import now_s\n",
+        "pkg/io/dl.py": """
+            from pkg.utils import now_s
+            def wait(seconds):
+                deadline = now_s() + seconds
+                while now_s() < deadline:
+                    pass
+        """})
+    hits = [f for f in out if f.code == "GC02"]
+    assert hits and hits[0].path == "pkg/io/dl.py", \
+        "\n".join(f.render() for f in out)
+
+
+def test_fix_rewrites_every_taint_source_line(tmp_path):
+    """A name assigned from time.time() on SEVERAL lines: --fix --write
+    must rewrite all of them so the rescan gates clean (review-caught:
+    only the last-seen assignment line was recorded)."""
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    bad = tree / "multi.py"
+    bad.write_text(textwrap.dedent("""
+        import time
+        def span(flag, t1):
+            t0 = time.time()
+            if flag:
+                t0 = time.time()
+            return t0 - t1
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "hivemall_tpu.tools.graftcheck",
+             str(tree), "--root", str(tmp_path), *extra],
+            capture_output=True, text=True, cwd=REPO, env=env)
+
+    assert run("--fix", "--write").returncode == 0
+    assert "time.time()" not in bad.read_text()
+    assert run().returncode == 0, "rescan after --fix --write must gate"
+
+
+def test_fix_gc02_spares_wall_anchor_assignments(tmp_path):
+    """A tainted name that ALSO feeds an epoch export (`ts = start *
+    1e6`, the chrome-trace anchor pattern) must not be claimed fixable:
+    rewriting its assignment would corrupt the anchor, and rewriting
+    just the arithmetic would mix clocks (review-caught — --fix --write
+    silently monotonic-ized wall anchors)."""
+    out = check_srcs(tmp_path, {"pkg/io/dl.py": """
+        import time
+        def dual():
+            start = time.time()
+            ts_epoch_us = start * 1e6
+            dur = time.time() - start
+            return ts_epoch_us, dur
+    """})
+    hits = [f for f in out if f.code == "GC02"]
+    assert hits, "dual-use anchor arithmetic must still be FLAGGED"
+    assert all(f.fix_kind is None and not f.fix_lines for f in hits), \
+        [f.to_json() for f in hits]
+
+
+def test_cache_mangled_entry_rescans(tmp_path):
+    """A cache whose per-file entry is not a dict (hand-edit / merge
+    damage) must degrade to a full re-scan, never crash the gate
+    (review-caught AttributeError)."""
+    from hivemall_tpu.tools.graftcheck.engine import _cache_load
+    from hivemall_tpu.tools.graftcheck.rules import RULESTAMP
+    cache = tmp_path / "cache.json"
+    cache.write_text(json.dumps({"stamp": RULESTAMP,
+                                 "files": {"a.py": "xyz"}}))
+    assert _cache_load(str(cache), {"a.py": "xyz"}) is None
+    # and end-to-end: a scan handed the mangled cache still completes
+    out = check_srcs(tmp_path, {"pkg/io/bad.py": GC03_BAD},
+                     cache=str(cache))
+    assert [f for f in out if f.code == "GC03"]
+
+
+def test_tsan_env_negatives_stay_disabled(monkeypatch):
+    from hivemall_tpu.testing import tsan
+    for v in ("0", "false", "False", "NO", "off", ""):
+        monkeypatch.setenv(tsan.ENV_FLAG, v)
+        if not tsan.enabled():
+            assert tsan.maybe_enable() is False, v

@@ -696,8 +696,8 @@ def test_sigkill_fleet_manager_mid_canary_recovers(trained):
                              "max_bake_seconds": 0.5})
     fleet.start(wait_ready=True)
     try:
-        deadline = time.time() + 60
-        while time.time() < deadline:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
             steps = {r.model_step for r in fleet.manager.replicas()}
             if steps == {stepB} \
                     and ck.read_promoted(ckdir)["state"] == "serving":

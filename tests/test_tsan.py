@@ -1,0 +1,283 @@
+"""Lockset race sanitizer tests (hivemall_tpu.testing.tsan).
+
+The dynamic half of the graftcheck v2 gate: the Eraser-style state
+machine must detect a genuine write/write race (no common lock between
+two writer threads) with both stacks attached, stay SILENT on the
+lock-guarded twin, absorb the constructor->worker ownership handoff
+without a false positive, and keep ``threading.Condition``/``Event``
+working through the lock wrappers. The seeded-race non-vacuity pin
+(the PR 11 ``PredictEngine.last_reload_error`` shape) runs in
+``graftcheck --selfcheck`` too; here it is exercised in-process.
+"""
+
+import threading
+
+import pytest
+
+from hivemall_tpu.testing import tsan
+
+
+@pytest.fixture
+def sanitizer():
+    """enable/disable bracket with full state cleanup."""
+    registered = []
+
+    def reg(cls):
+        registered.append(cls)
+        return tsan.register(cls)
+
+    # auto_register=False: instrument only the test's own fixture
+    # classes, not the whole serving fleet
+    tsan.enable(auto_register=False)
+    tsan.reset()
+    try:
+        yield reg
+    finally:
+        tsan.reset()
+        for cls in registered:
+            tsan.unregister(cls)
+        tsan.disable()
+
+
+def _run_threads(*targets):
+    ts = [threading.Thread(target=t, name=f"w{i}")
+          for i, t in enumerate(targets)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def test_unguarded_two_writer_race_detected(sanitizer):
+    class Obj:
+        def __init__(self):
+            self.x = 0
+
+    sanitizer(Obj)
+    o = Obj()
+    _run_threads(lambda: setattr(o, "x", 1), lambda: setattr(o, "x", 2))
+    rs = tsan.races()
+    assert len(rs) == 1
+    r = rs[0]
+    assert r["class"] == "Obj" and r["attr"] == "x"
+    # both writers' stacks attached, and they are distinct threads
+    assert r["stack_prev"] and r["stack_cur"]
+    assert r["threads"][0] != r["threads"][1]
+
+
+def test_guarded_writers_clean(sanitizer):
+    class Obj:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.x = 0
+
+        def bump(self):
+            with self.lock:
+                self.x += 1
+
+    sanitizer(Obj)
+    o = Obj()
+    _run_threads(o.bump, o.bump)
+    assert tsan.races() == []
+
+
+def test_constructor_handoff_no_false_positive(sanitizer):
+    """init writes on the constructing thread + ONE worker thread
+    writing lock-free is the blessed single-writer pattern
+    (Thread.start() is the happens-before edge) — no race."""
+    class Obj:
+        def __init__(self):
+            self.counter = 0
+
+        def work(self):
+            for _ in range(100):
+                self.counter += 1
+
+    sanitizer(Obj)
+    o = Obj()
+    t = threading.Thread(target=o.work)
+    t.start()
+    t.join()
+    assert tsan.races() == []
+
+
+def test_third_thread_after_handoff_detected(sanitizer):
+    """Ownership hands off ONCE; a second distinct writer thread with no
+    common lock is a race even though each write alone looks benign."""
+    class Obj:
+        def __init__(self):
+            self.y = 0
+
+    sanitizer(Obj)
+    o = Obj()
+    t1 = threading.Thread(target=lambda: setattr(o, "y", 1))
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=lambda: setattr(o, "y", 2))
+    t2.start()
+    t2.join()
+    assert [r["attr"] for r in tsan.races()] == ["y"]
+
+
+def test_distinct_attrs_tracked_independently(sanitizer):
+    class Obj:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.safe = 0
+            self.racy = 0
+
+        def writer(self):
+            with self.lock:
+                self.safe += 1
+            self.racy += 1
+
+    sanitizer(Obj)
+    o = Obj()
+    _run_threads(o.writer, o.writer, o.writer)
+    assert sorted({r["attr"] for r in tsan.races()}) == ["racy"]
+
+
+def test_rlock_and_condition_still_work(sanitizer):
+    """Condition/Event compose over the wrappers: wait/notify and the
+    private _release_save/_acquire_restore hooks keep lockset tracking
+    consistent (writes under the condition lock count as guarded)."""
+    class Q:
+        def __init__(self):
+            self.cv = threading.Condition()
+            self.item = None
+
+        def put(self, v):
+            with self.cv:
+                self.item = v
+                self.cv.notify()
+
+        def take(self):
+            with self.cv:
+                while self.item is None:
+                    self.cv.wait(timeout=5)
+                v, self.item = self.item, None
+                return v
+
+    sanitizer(Q)
+    q = Q()
+    got = []
+    t = threading.Thread(target=lambda: got.append(q.take()))
+    t.start()
+    q.put(42)
+    t.join(timeout=10)
+    assert got == [42]
+    assert tsan.races() == []
+
+
+def test_event_works_under_wrappers(sanitizer):
+    ev = threading.Event()
+    t = threading.Thread(target=ev.set)
+    t.start()
+    assert ev.wait(timeout=5)
+    t.join()
+
+
+def test_disable_restores_lock_constructors():
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    was = tsan.enabled()
+    tsan.enable(auto_register=False)
+    try:
+        assert threading.Lock is not orig_lock
+    finally:
+        if not was:
+            tsan.disable()
+    if not was:
+        assert threading.Lock is orig_lock \
+            and threading.RLock is orig_rlock
+
+
+def test_maybe_enable_respects_env(monkeypatch):
+    monkeypatch.delenv(tsan.ENV_FLAG, raising=False)
+    assert tsan.maybe_enable() is False or tsan.enabled()
+    # (already-enabled state from another test is tolerated; the
+    # assertion is that an unset env never TURNS it on)
+    if not tsan.enabled():
+        monkeypatch.setenv(tsan.ENV_FLAG, "1")
+        try:
+            assert tsan.maybe_enable() is True
+        finally:
+            tsan.disable()
+
+
+def test_race_log_emitted(sanitizer, tmp_path, monkeypatch):
+    log = tmp_path / "races.jsonl"
+    monkeypatch.setenv(tsan.ENV_LOG, str(log))
+
+    class Obj:
+        def __init__(self):
+            self.z = 0
+
+    sanitizer(Obj)
+    o = Obj()
+    _run_threads(lambda: setattr(o, "z", 1), lambda: setattr(o, "z", 2))
+    assert tsan.races()
+    import json
+    lines = [json.loads(x) for x in log.read_text().splitlines()]
+    assert lines and lines[0]["attr"] == "z"
+
+
+def test_auto_register_instruments_fleet_without_prod_imports():
+    """The layering pin: enable() signs the serving fleet up ITSELF
+    (every _AUTO_REGISTER class ends up patched), and no serve/obs
+    production module imports testing.tsan at module level — a prod
+    image that prunes testing/ must still import the serving stack."""
+    import ast
+    import importlib
+    from pathlib import Path
+
+    assert not tsan.enabled()
+    tsan.enable()
+    try:
+        for modname, clsname in tsan._AUTO_REGISTER:
+            cls = getattr(importlib.import_module(modname), clsname)
+            assert cls in tsan._patched, f"{clsname} not instrumented"
+    finally:
+        for modname, clsname in tsan._AUTO_REGISTER:
+            cls = getattr(importlib.import_module(modname), clsname)
+            tsan.unregister(cls)
+        tsan.disable()
+
+    import hivemall_tpu
+    pkg = Path(hivemall_tpu.__file__).parent
+    for sub in ("serve", "obs"):
+        for path in sorted((pkg / sub).glob("*.py")):
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+            for node in tree.body:          # MODULE level only: lazy
+                #                             in-function imports (the
+                #                             smokes' maybe_enable) are
+                #                             the sanctioned gate
+                if isinstance(node, ast.ImportFrom):
+                    assert "testing" not in (node.module or ""), \
+                        f"{path.name} imports testing at module level"
+                elif isinstance(node, ast.Import):
+                    assert not any("testing" in a.name
+                                   for a in node.names), \
+                        f"{path.name} imports testing at module level"
+
+
+def test_selfcheck_race_nonvacuous():
+    """The re-seeded PR 11 last_reload_error race: detected unguarded,
+    silent when both writers take _reload_lock."""
+    ok, detail = tsan.selfcheck_race()
+    assert ok, detail
+    assert "last_reload_error" in detail
+    assert not tsan.enabled()            # bracket restored
+
+
+def test_check_and_report_counts(sanitizer, capsys):
+    class Obj:
+        def __init__(self):
+            self.w = 0
+
+    sanitizer(Obj)
+    o = Obj()
+    _run_threads(lambda: setattr(o, "w", 1), lambda: setattr(o, "w", 2))
+    n = tsan.check_and_report("unit")
+    assert n == 1
+    err = capsys.readouterr().err
+    assert "RACE" in err and "Obj.w" in err
