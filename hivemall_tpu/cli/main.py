@@ -345,19 +345,40 @@ def _cmd_serve(args) -> int:
         slo_p99_ms=args.slo_p99_ms,
         slo_availability=args.slo_availability).start()
     ctrl = None
+    retrain_ctl = None
     if args.promote and args.checkpoint_dir:
         # single-server promotion: the engine follows the pointer; an
         # in-process controller gates candidates out of the autosave
         # dir (shadow-scoring mirrored traffic teed off the batcher)
         from ..serve.promote import (PromotionController, PromotionGate,
                                      ShadowBuffer)
-        shadow = ShadowBuffer()
-        srv.batcher.set_tee(shadow.add)
+        # --retrain additionally captures the RAW request rows the
+        # replay buffer trains on (the label join is a feedback-side
+        # concern — without one, retrains run over --train-input only)
+        shadow = ShadowBuffer(capture_raw=args.retrain)
+        srv.batcher.set_tee(shadow.add, raw=args.retrain)
         gate = PromotionGate(args.algo, args.options or "",
                              holdout=args.holdout, shadow=shadow)
         ctrl = PromotionController(args.checkpoint_dir, gate,
                                    interval=args.watch_interval,
                                    slo=srv.slo).start()
+        if args.retrain:
+            from ..serve.retrain import RetrainController
+            retrain_ctl = RetrainController(
+                args.algo, args.options or "",
+                checkpoint_dir=args.checkpoint_dir,
+                slo=srv.slo, shadow=shadow,
+                train_input=args.train_input,
+                cooldown_s=args.retrain_cooldown_s,
+                min_votes=args.retrain_min_votes,
+                max_retrains_per_window=args.retrain_max_per_window,
+                interval=args.watch_interval).start()
+    elif args.retrain:
+        print("error: --retrain needs --promote and --checkpoint-dir "
+              "(candidates go through the promotion gate)",
+              file=sys.stderr)
+        srv.stop()
+        return 2
     print(json.dumps({"host": srv.host, "port": srv.port,
                       "algo": args.algo,
                       "model_step": engine.model_step,
@@ -366,6 +387,8 @@ def _cmd_serve(args) -> int:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        if retrain_ctl is not None:
+            retrain_ctl.stop()
         if ctrl is not None:
             ctrl.stop()
         srv.stop()
@@ -390,6 +413,13 @@ def _cmd_serve_fleet(args) -> int:
             holdout=args.holdout,
             canary_fraction=args.canary_fraction,
             canary_bake_s=args.canary_bake_s,
+            retrain=args.retrain,
+            train_input=args.train_input,
+            retrain_opts={
+                "cooldown_s": args.retrain_cooldown_s,
+                "min_votes": args.retrain_min_votes,
+                "max_retrains_per_window": args.retrain_max_per_window,
+            } if args.retrain else None,
             serve_kwargs={
                 "max_batch": args.serve_max_batch,
                 "max_delay_ms": args.serve_max_delay_ms,
@@ -471,6 +501,75 @@ def _cmd_promote(args) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         ctrl.stop()
+    return 0
+
+
+def _cmd_retrain(args) -> int:
+    """Drift-driven retrain autopilot (docs/RELIABILITY.md "Autonomous
+    retraining"): consume ``retrain_wanted`` votes (``--slo-url`` polls
+    a serve/router ``/slo``), debounce them through cooldown/budget/flap
+    storm controls, and launch supervised warm-start retrains whose
+    candidates go through the normal promotion gate. ``--once`` forces
+    one retrain now; ``--status`` prints the on-disk state."""
+    from ..serve.retrain import RetrainController
+
+    votes_fn = None
+    if args.slo_url:
+        import urllib.request
+        url = args.slo_url.rstrip("/")
+        if not url.endswith("/slo"):
+            url += "/slo"
+
+        def votes_fn() -> int:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                drift = json.loads(resp.read()).get("drift") or {}
+            return int(drift.get("retrain_wanted") or 0)
+
+    gate = None
+    if args.holdout:
+        from ..serve.promote import PromotionGate
+        gate = PromotionGate(args.algo, args.options or "",
+                             holdout=args.holdout)
+    ctl = RetrainController(
+        args.algo, args.options or "",
+        checkpoint_dir=args.checkpoint_dir,
+        votes_fn=votes_fn, gate=gate,
+        train_input=args.train_input, replay_dir=args.replay_dir,
+        min_votes=args.min_votes, cooldown_s=args.cooldown_s,
+        window_s=args.window_s,
+        max_retrains_per_window=args.max_retrains,
+        backoff_factor=args.backoff_factor,
+        train_timeout_s=args.train_timeout_s,
+        interval=args.interval, batch_size=args.batch_size,
+        epochs=args.epochs)
+    if args.status:
+        print(json.dumps(ctl.status(), default=str))
+        return 0
+    if args.once:
+        # a manual retrain bypasses the vote debounce but still runs
+        # the full train -> gate -> promote/quarantine path
+        if not ctl.trigger("manual retrain (--once)"):
+            print(f"error: {ctl.last_error}", file=sys.stderr)
+            return 2
+        ctl.wait_idle(timeout=args.train_timeout_s
+                      + ctl.gate_timeout_s + 60.0)
+        section = ctl.obs_section()
+        print(json.dumps(section, default=str))
+        return 0 if section["successes"] > 0 else 1
+    if not args.slo_url:
+        print("error: --watch needs --slo-url <serve/router base> as "
+              "the retrain_wanted vote source (or run the controller "
+              "in-process via `serve --retrain`)", file=sys.stderr)
+        return 2
+    ctl.start()
+    print(json.dumps({"watching": args.checkpoint_dir,
+                      "slo_url": args.slo_url,
+                      "interval": args.interval}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        ctl.stop()
     return 0
 
 
@@ -646,7 +745,87 @@ def main(argv=None) -> int:
                     help="fleet --promote: seconds the canary cohort's "
                          "SLO totals are watched against the stable "
                          "cohort before completing the roll")
+    sv.add_argument("--retrain", action="store_true",
+                    help="autonomous drift-driven retraining (needs "
+                         "--promote): consume the SLO engine's "
+                         "retrain_wanted votes, warm-start retrains "
+                         "from the PROMOTED bundle over --train-input "
+                         "+ the live replay buffer, and gate the "
+                         "candidates (docs/RELIABILITY.md)")
+    sv.add_argument("--train-input", default=None,
+                    help="base corpus for --retrain (LIBSVM file or a "
+                         "directory of parquet shards; epochs go "
+                         "through the shard caches when -shard_cache_"
+                         "dir is in --options)")
+    sv.add_argument("--retrain-cooldown-s", type=float, default=300.0,
+                    help="--retrain: per-model cooldown after every "
+                         "attempt (rejections back off exponentially)")
+    sv.add_argument("--retrain-min-votes", type=int, default=2,
+                    help="--retrain: drift votes within the vote "
+                         "window needed to trigger")
+    sv.add_argument("--retrain-max-per-window", type=int, default=4,
+                    help="--retrain: max retrains per hour window")
     sv.set_defaults(fn=_cmd_serve)
+
+    rt = sub.add_parser(
+        "retrain",
+        help="drift-driven retrain controller: turn retrain_wanted "
+             "votes into gated warm-start retrains "
+             "(docs/RELIABILITY.md \"Autonomous retraining\")")
+    rt.add_argument("--algo", required=True,
+                    help="catalog trainer the bundles were written by")
+    rt.add_argument("--options", default="",
+                    help="trainer options (must match training)")
+    rt.add_argument("--checkpoint-dir", required=True,
+                    help="autosave dir holding the PROMOTED pointer, "
+                         "candidates, replay segments and the "
+                         "RETRAIN_STATE stamp")
+    rt.add_argument("--train-input", default=None,
+                    help="base corpus (LIBSVM file or parquet shard "
+                         "dir) retrains run over, in addition to the "
+                         "replay buffer")
+    rt.add_argument("--replay-dir", default=None,
+                    help="replay segment dir (default: <checkpoint-"
+                         "dir>/replay)")
+    rt.add_argument("--holdout", default=None,
+                    help="LIBSVM holdout: gate candidates HERE instead "
+                         "of leaving them to an external promote "
+                         "watcher / fleet manager")
+    rt.add_argument("--slo-url", default=None,
+                    help="serve/router base URL whose /slo drift "
+                         "counters are the retrain_wanted vote source")
+    rt.add_argument("--watch", action="store_true",
+                    help="keep consuming votes until Ctrl-C (default "
+                         "when neither --once nor --status)")
+    rt.add_argument("--once", action="store_true",
+                    help="force one retrain now (bypasses the vote "
+                         "debounce, still gated); rc 0 promoted, 1 "
+                         "rejected/failed")
+    rt.add_argument("--status", action="store_true",
+                    help="print the controller state + on-disk stamp "
+                         "and exit")
+    rt.add_argument("--cooldown-s", type=float, default=300.0,
+                    help="per-model cooldown seconds after every "
+                         "attempt (storm control)")
+    rt.add_argument("--min-votes", type=int, default=2,
+                    help="votes within the vote window needed to "
+                         "trigger")
+    rt.add_argument("--window-s", type=float, default=3600.0,
+                    help="storm-control window seconds")
+    rt.add_argument("--max-retrains", type=int, default=4,
+                    help="max retrains per --window-s (storm control)")
+    rt.add_argument("--backoff-factor", type=float, default=2.0,
+                    help="cooldown multiplier per consecutive gate "
+                         "rejection")
+    rt.add_argument("--train-timeout-s", type=float, default=900.0,
+                    help="kill a retrain child past this wall time")
+    rt.add_argument("--interval", type=float, default=2.0,
+                    help="controller tick interval seconds")
+    rt.add_argument("--batch-size", type=int, default=64,
+                    help="retrain mini-batch rows")
+    rt.add_argument("--epochs", type=int, default=1,
+                    help="epochs over the retrain input")
+    rt.set_defaults(fn=_cmd_retrain)
 
     pm = sub.add_parser(
         "promote",

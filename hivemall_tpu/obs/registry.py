@@ -99,7 +99,8 @@ CHECKPOINT_STUB = {"configured": False, "dir": None, "every": 0,
 #: SloEngine.obs_section() in its fresh (no samples) state
 SLO_STUB = {"configured": False, "samples": 0, "target_p99_ms": None,
             "target_availability": None, "drift_latency_events": 0,
-            "drift_score_events": 0, "retrain_wanted": 0}
+            "drift_score_events": 0, "retrain_wanted": 0,
+            "retrain_acked": 0}
 #: serve.fleet.ReplicaManager.obs_section()
 FLEET_STUB = {"replicas": 0, "ready": 0, "respawns": 0, "rolls": 0,
               "roll_failures": 0, "rejected_bundles": 0,
@@ -114,7 +115,20 @@ PROMOTION_STUB = {"configured": False, "promoted_step": None,
                   "quarantined": 0,
                   "canary": {"active": False, "step": None, "cohort": 0,
                              "age_seconds": None},
-                  "last_verdict": None, "retrain_wanted": 0}
+                  "shadow": {"mirrored": 0, "dropped": 0, "rows": 0},
+                  "last_verdict": None, "retrain_wanted": 0,
+                  "retrain_acked": 0}
+#: serve.retrain.RetrainController.obs_section() in its inactive form
+#: (copy via serve.retrain.retrain_stub — the nested replay dict must
+#: not be shared mutable state)
+RETRAIN_STUB = {"configured": False, "state": "idle", "attempts": 0,
+                "successes": 0, "rejections": 0, "rollbacks": 0,
+                "flaps": 0, "votes_seen": 0, "votes_acked": 0,
+                "cooldown_remaining_s": 0.0, "child_alive": False,
+                "candidate_step": None, "last_trigger_reason": None,
+                "last_error": None,
+                "replay": {"rows": 0, "rows_dropped": 0, "segments": 0,
+                           "pending_rows": 0}}
 
 registry = Registry()
 registry.register("mix", lambda: dict(MIX_STUB))
@@ -136,7 +150,14 @@ registry.register("slo", lambda: dict(SLO_STUB))
 # this with live gate/canary/rollback state when promotion is gated
 registry.register("promotion", lambda: {**PROMOTION_STUB,
                                         "canary":
-                                        dict(PROMOTION_STUB["canary"])})
+                                        dict(PROMOTION_STUB["canary"]),
+                                        "shadow":
+                                        dict(PROMOTION_STUB["shadow"])})
+# serve.retrain.RetrainController overrides this with the live retrain
+# state machine when the autopilot is running
+registry.register("retrain", lambda: {**RETRAIN_STUB,
+                                      "replay":
+                                      dict(RETRAIN_STUB["replay"])})
 # obs.devprof.DevProf overrides this with live compile/retrace/memory
 # telemetry on first use (any trainer construction)
 from .devprof import devprof_stub  # noqa: E402 — stub needs the dict shape

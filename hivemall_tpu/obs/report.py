@@ -227,7 +227,8 @@ def _render(state: _TailState, path: str = "",
                 f"/{promo.get('gate_failures', 0)} fail"
                 f"  promotions {promo.get('promotions', 0)}"
                 f"  rollbacks {promo.get('rollbacks', 0)}"
-                f"  retrain_wanted {promo.get('retrain_wanted', 0)}")
+                f"  retrain_wanted {promo.get('retrain_wanted', 0)}"
+                f"/acked {promo.get('retrain_acked', 0)}")
         canary = promo.get("canary") or {}
         if canary.get("active"):
             line += (f"  [canary step {canary.get('step')} x"
@@ -245,6 +246,29 @@ def _render(state: _TailState, path: str = "",
         if rb is not None:
             out.append(f"  rollback: {rb.get('bundle', '?')} — "
                        f"{rb.get('reason', '?')}")
+
+    # retrain autopilot (docs/RELIABILITY.md "Autonomous retraining"):
+    # the registry section when a snapshot carries one, plus the newest
+    # state-transition event from the stream
+    rt = (snap or {}).get("retrain") or {}
+    if rt.get("configured") or state.counts.get("retrain"):
+        line = (f"retrain: [{rt.get('state', '?')}]"
+                f"  attempts {rt.get('attempts', 0)}"
+                f"  ok {rt.get('successes', 0)}"
+                f"  rejected {rt.get('rejections', 0)}"
+                f"  rollbacks {rt.get('rollbacks', 0)}"
+                f"  flaps {rt.get('flaps', 0)}"
+                f"  votes {rt.get('votes_seen', 0)}"
+                f"/acked {rt.get('votes_acked', 0)}")
+        rp = rt.get("replay") or {}
+        if rp.get("rows"):
+            line += (f"  replay {rp.get('rows', 0)} rows/"
+                     f"{rp.get('segments', 0)} seg")
+        out.append(line)
+        ev = state.last.get("retrain")
+        if ev is not None and (ev.get("reason") or ev.get("outcome")):
+            out.append(f"  last: {ev.get('outcome') or ev.get('state')}"
+                       f" — {ev.get('reason', '?')}")
     return "\n".join(out)
 
 
@@ -376,7 +400,8 @@ def render_slo(slo: dict, source: str = "") -> str:
     dr = slo.get("drift") or {}
     out.append(f"  drift: latency x{dr.get('latency_events', 0)}  "
                f"score x{dr.get('score_events', 0)}  "
-               f"retrain_wanted x{dr.get('retrain_wanted', 0)}")
+               f"retrain_wanted x{dr.get('retrain_wanted', 0)} "
+               f"(acked x{dr.get('retrain_acked', 0)})")
     for ev in (dr.get("recent") or [])[-4:]:
         out.append(f"    [{ev.get('series')}] change "
                    f"{ev.get('change_score')} at value {ev.get('value')} "

@@ -149,8 +149,24 @@ class SloEngine:
         # the `slo` AND `promotion` registry sections, emitted into the
         # metrics jsonl for `hivemall_tpu obs`.
         self.retrain_wanted = 0
+        # votes vs ACTIONS: the retrain controller (serve.retrain) bumps
+        # this as it consumes votes — the obs surface can always show
+        # whether anything is answering the changefinder
+        self.retrain_acked = 0
         self.samples = 0
         self._register_obs()
+
+    def ack_retrain(self, n: int = 1) -> int:
+        """The retrain controller consumed ``n`` votes (a retrain was
+        triggered for them, or they were answered by one completing).
+        Emits a ``retrain_acked`` event so votes-vs-actions read off the
+        same jsonl the votes landed in."""
+        with self._lock:
+            self.retrain_acked += int(n)
+            total = self.retrain_acked
+        from ..utils.metrics import get_stream
+        get_stream().emit("retrain_acked", count=int(n), total=total)
+        return total
 
     # -- sampling ------------------------------------------------------------
     def sample(self, totals: dict, ts: Optional[float] = None) -> None:
@@ -301,6 +317,7 @@ class SloEngine:
             drift_recent = list(self.drift_events)[-8:]
             drift_counts = dict(self.drift_counts)
             retrain_wanted = self.retrain_wanted
+            retrain_acked = self.retrain_acked
         if cur is not None and (not samples or samples[-1] is not cur):
             samples.append(cur)          # freshest raw sample wins
         # clock-mismatch guard: samples fed with an EXPLICIT ts (a test's
@@ -322,6 +339,7 @@ class SloEngine:
                 "latency_events": drift_counts["latency_ms"],
                 "score_events": drift_counts["score"],
                 "retrain_wanted": retrain_wanted,
+                "retrain_acked": retrain_acked,
                 "recent": drift_recent,
             },
         }
@@ -418,7 +436,8 @@ class SloEngine:
                    "target_availability": self.availability,
                    "drift_latency_events": ev["drift"]["latency_events"],
                    "drift_score_events": ev["drift"]["score_events"],
-                   "retrain_wanted": self.retrain_wanted}
+                   "retrain_wanted": self.retrain_wanted,
+                   "retrain_acked": self.retrain_acked}
         for name, w in ev["windows"].items():
             d[name] = {"qps": w["qps"], "availability": w["availability"],
                        "availability_burn_rate":

@@ -58,6 +58,8 @@ class _Req:
     t_enq: float
     t_deadline: Optional[float]
     trace_id: Optional[str] = None
+    raw: Optional[list] = None           # original feature strings (the
+    #                                      raw-capturing tee's input)
 
 
 class MicroBatcher:
@@ -112,7 +114,8 @@ class MicroBatcher:
 
     # -- submit side ---------------------------------------------------------
     def submit(self, rows: list, deadline_ms: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Future:
+               trace_id: Optional[str] = None,
+               raw: Optional[list] = None) -> Future:
         """Enqueue one request (a list of parsed rows). Returns a Future
         resolving to float32 scores [len(rows)] — or, when the predict
         fn returns ``(scores, meta)``, to ``(scores_slice, meta)``.
@@ -143,7 +146,7 @@ class MicroBatcher:
                         f"queue full ({self._queued_rows} rows queued, "
                         f"max {self.max_queue_rows}); request shed")
                 self._q.append(_Req(rows, n, fut, now, t_deadline,
-                                    trace_id))
+                                    trace_id, raw))
                 self._queued_rows += n
                 self.requests += 1
                 self.rows_in += n
@@ -270,8 +273,18 @@ class MicroBatcher:
                 off += r.n
             tee = self._tee
             if tee is not None:
+                fn, want_raw = tee
                 try:                   # mirror AFTER the futures resolved:
-                    tee(rows)          # zero added request latency
+                    if want_raw:       # zero added request latency
+                        # raw strings aligned row-for-row with `rows`;
+                        # requests submitted without raw pad with None
+                        # so a raw-capturing consumer stays aligned
+                        fn(rows, [s for r in live for s in
+                                  (r.raw if r.raw is not None
+                                   and len(r.raw) == r.n
+                                   else [None] * r.n)])
+                    else:
+                        fn(rows)
                 except Exception:      # noqa: BLE001 — a shadow consumer
                     pass               # must never touch the dispatch loop
 
@@ -308,12 +321,15 @@ class MicroBatcher:
                 self.errors += 1
                 r.fut.set_exception(e)
 
-    def set_tee(self, fn) -> None:
+    def set_tee(self, fn, raw: bool = False) -> None:
         """Install (or clear, with None) a traffic mirror: ``fn(rows)``
         is called with every successfully scored batch's parsed rows off
         the dispatch thread's tail — the promotion gate's shadow-scoring
-        input (serve.promote.ShadowBuffer.add)."""
-        self._tee = fn
+        input (serve.promote.ShadowBuffer.add). ``raw=True`` calls
+        ``fn(rows, raws)`` instead, where ``raws`` are the original
+        request feature strings (None-padded for requests submitted
+        without them) — the replay-buffer tee (serve.retrain)."""
+        self._tee = None if fn is None else (fn, bool(raw))
 
     # -- stats / lifecycle ---------------------------------------------------
     def stats(self) -> dict:

@@ -126,7 +126,8 @@ class ReplicaManager:
                  gate=None,
                  promote: bool = False,
                  canary_fraction: float = 0.25,
-                 bake_opts: Optional[dict] = None):
+                 bake_opts: Optional[dict] = None,
+                 retrain=None):
         if not checkpoint_dir and not bundle:
             raise ValueError("fleet needs checkpoint_dir=... or bundle=...")
         self.algo = algo
@@ -181,6 +182,11 @@ class ReplicaManager:
         self.bake_opts = dict(bake_opts or {})
         self._canary: Optional[dict] = None   # {"step","path","bake"}
         self._bake_inject = None   # test hook: fn(canary_totals)->totals
+        # drift-driven retrain autopilot (serve.retrain): the controller
+        # rides THIS manager's watch loop (one tick cadence, no second
+        # daemon) and produces candidates the promotion lifecycle above
+        # gates/canaries/rolls back exactly like any other candidate
+        self.retrain = retrain
         self._last_manifest: Optional[dict] = None   # cached for obs
         self.promotions = 0
         self.canary_rollbacks = 0
@@ -439,6 +445,12 @@ class ReplicaManager:
                 self.check_and_roll()
             except Exception as e:         # noqa: BLE001 — watcher survives
                 self.last_error = f"watch: {type(e).__name__}: {e}"
+            if self.retrain is not None:
+                try:
+                    self.retrain.tick()
+                except Exception as e:     # noqa: BLE001 — the autopilot
+                    self.last_error = \
+                        f"retrain: {type(e).__name__}: {e}"
 
     def check_and_roll(self) -> bool:
         """One watch tick. Newest-wins mode: is there a newer verified
@@ -804,9 +816,13 @@ class ReplicaManager:
                                        if baking else None)},
             "retrain_wanted": int(getattr(self.slo, "retrain_wanted", 0)
                                   or 0),
+            "retrain_acked": int(getattr(self.slo, "retrain_acked", 0)
+                                 or 0),
         })
         if self.gate is not None:
+            from .promote import shadow_counters
             d.update(self.gate.counters())
+            d["shadow"] = shadow_counters(self.gate.shadow)
         return d
 
     def _register_obs(self) -> None:
@@ -878,14 +894,19 @@ class Fleet:
                  gate_opts: Optional[dict] = None,
                  canary_fraction: float = 0.25,
                  canary_bake_s: float = 10.0,
-                 bake_opts: Optional[dict] = None):
+                 bake_opts: Optional[dict] = None,
+                 slo_opts: Optional[dict] = None,
+                 retrain: bool = False,
+                 retrain_opts: Optional[dict] = None,
+                 train_input: Optional[str] = None):
         from ..obs.slo import SloEngine
         from ..obs.trace import get_tracer
         get_tracer().process_label = "router"   # the merged /trace view
         # ONE fleet-wide SLO engine: the manager samples it from health
         # polls, the router serves it at /slo
         self.slo = SloEngine(p99_ms=slo_p99_ms,
-                             availability=slo_availability)
+                             availability=slo_availability,
+                             **(slo_opts or {}))
         gate = None
         if promote:
             from .promote import PromotionGate
@@ -897,6 +918,27 @@ class Fleet:
                                    on_reload_cb=self._on_reload,
                                    trace_sample=trace_sample,
                                    slo=self.slo)
+        # retrain autopilot (serve.retrain, docs/RELIABILITY.md
+        # "Autonomous retraining"): consumes the SLO engine's drift
+        # votes; live traffic reaches its replay buffer through a
+        # router-level tee of /predict bodies (the manager process never
+        # sees parsed rows — the router sees every request)
+        self.retrain = None
+        if retrain:
+            if not (promote and checkpoint_dir):
+                raise ValueError("retrain=True needs promote=True and a "
+                                 "checkpoint_dir (candidates go through "
+                                 "the promotion gate)")
+            from .retrain import RetrainController, RouterTee
+            ropts = dict(retrain_opts or {})
+            tee = None
+            if ropts.get("label_fn") is not None:
+                tee = RouterTee()
+                self.router.predict_tee = tee
+            self.retrain = RetrainController(
+                algo, options, checkpoint_dir=checkpoint_dir,
+                slo=self.slo, router_tee=tee,
+                train_input=train_input, **ropts)
         self.manager = ReplicaManager(
             algo, options, checkpoint_dir=checkpoint_dir, bundle=bundle,
             replicas=replicas, router=self.router, env=env,
@@ -905,7 +947,8 @@ class Fleet:
             health_interval=health_interval, watch_interval=watch_interval,
             spawn_timeout=spawn_timeout, slo=self.slo,
             gate=gate, promote=promote,
-            canary_fraction=canary_fraction, bake_opts=bake)
+            canary_fraction=canary_fraction, bake_opts=bake,
+            retrain=self.retrain)
         if self.manager.promote:
             # the router's /promotion admin surface: pointer manifest +
             # the manager's live section in one payload
@@ -963,6 +1006,8 @@ class Fleet:
 
     def stop(self) -> None:
         self.manager.stop()
+        if self.retrain is not None:
+            self.retrain.stop()          # reaps a still-running child
         self.router.stop()
 
 

@@ -294,8 +294,11 @@ class _ServeHandler(_ObsHandler):
         t_parsed = time.monotonic()
         try:
             with s.tracer.context(tid):   # tags serve.enqueue
+                # `rows` rides along as the raw feature strings so a
+                # raw-capturing tee (the retrain replay buffer) can
+                # mirror what the client actually sent
                 fut = s.batcher.submit(parsed, deadline_ms=deadline_ms,
-                                       trace_id=tid)
+                                       trace_id=tid, raw=rows)
             res = fut.result(timeout=s.request_timeout)
         except ServeOverload as e:
             self._json(503, {"error": str(e), "shed": True})

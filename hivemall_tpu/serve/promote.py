@@ -55,7 +55,7 @@ from ..io.checkpoint import (bundle_step, is_rejected, list_bundles,
 from ..utils.metrics import get_stream
 
 __all__ = ["ShadowBuffer", "PromotionGate", "CanaryBake",
-           "PromotionController", "promotion_stub"]
+           "PromotionController", "promotion_stub", "shadow_counters"]
 
 
 def promotion_stub() -> dict:
@@ -63,7 +63,19 @@ def promotion_stub() -> dict:
     mirror of the live providers (the obs.registry stub contract, pinned
     by tests/test_obs.py)."""
     from ..obs.registry import PROMOTION_STUB
-    return {**PROMOTION_STUB, "canary": dict(PROMOTION_STUB["canary"])}
+    return {**PROMOTION_STUB, "canary": dict(PROMOTION_STUB["canary"]),
+            "shadow": dict(PROMOTION_STUB["shadow"])}
+
+
+def shadow_counters(shadow: Optional["ShadowBuffer"]) -> dict:
+    """The ``shadow`` block of the ``promotion`` registry section —
+    rotation/drop counters that were previously internal-only (a
+    dashboard could not tell a starved mirror from a rotating one)."""
+    if shadow is None:
+        from ..obs.registry import PROMOTION_STUB
+        return dict(PROMOTION_STUB["shadow"])
+    return {"mirrored": shadow.mirrored, "dropped": shadow.dropped,
+            "rows": len(shadow)}
 
 
 class ShadowBuffer:
@@ -75,16 +87,28 @@ class ShadowBuffer:
     ROTATES (oldest rows evicted, eviction counted in ``dropped``) so it
     always mirrors the newest traffic. The gate drains a snapshot to
     shadow-score candidate vs promoted on REAL traffic (unlabeled, so
-    the check is score-distribution shift, not loss)."""
+    the check is score-distribution shift, not loss).
 
-    def __init__(self, capacity: int = 512):
+    ``capture_raw=True`` additionally keeps each mirrored row's RAW
+    request feature strings (the batcher tee passes them alongside the
+    parsed rows) — the input replay-buffer training needs; with a
+    ``label_fn`` (the label join: feedback lookup in production, the
+    known concept in tests) :meth:`drain_labeled` consumes them as
+    ``(rows, labels)`` for the retrain controller (serve.retrain)."""
+
+    def __init__(self, capacity: int = 512, *, capture_raw: bool = False,
+                 label_fn=None):
         self.capacity = int(capacity)
         self._rows: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
+        self.capture_raw = bool(capture_raw)
+        self.label_fn = label_fn
+        self._raw: deque = deque(maxlen=self.capacity)
         self.mirrored = 0
         self.dropped = 0
 
-    def add(self, rows: List[tuple]) -> None:
+    def add(self, rows: List[tuple], raw: Optional[List[list]]
+            = None) -> None:
         with self._lock:
             self.mirrored += len(rows)
             # the deque ROTATES at capacity (oldest rows evicted) so the
@@ -94,6 +118,28 @@ class ShadowBuffer:
             self.dropped += max(0, len(self._rows) + len(rows)
                                 - self.capacity)
             self._rows.extend(rows)
+            if self.capture_raw and raw:
+                self._raw.extend(r for r in raw if r is not None)
+
+    def drain_labeled(self, n: Optional[int] = None):
+        """CONSUME up to ``n`` captured raw rows (oldest first) with
+        labels joined through ``label_fn`` — the replay-buffer feed.
+        Rows the join cannot label (label_fn None/raising) are dropped,
+        never trained as label 0. Returns ``(rows, labels)``."""
+        with self._lock:
+            take = len(self._raw) if n is None else min(n, len(self._raw))
+            raws = [self._raw.popleft() for _ in range(take)]
+        rows, labels = [], []
+        for r in raws:
+            try:
+                y = self.label_fn(r) if self.label_fn is not None else None
+            except Exception:            # noqa: BLE001 — unjoinable row
+                y = None
+            if y is None:
+                continue
+            rows.append(r)
+            labels.append(float(y))
+        return rows, labels
 
     def rows(self, n: Optional[int] = None) -> List[tuple]:
         """Snapshot (and keep) up to ``n`` mirrored rows, newest-biased."""
@@ -626,6 +672,9 @@ class PromotionController:
             "quarantined": self.quarantined,
             "retrain_wanted": int(getattr(self.slo, "retrain_wanted", 0)
                                   or 0),
+            "retrain_acked": int(getattr(self.slo, "retrain_acked", 0)
+                                 or 0),
+            "shadow": shadow_counters(self.gate.shadow),
         })
         return d
 

@@ -1,0 +1,327 @@
+"""Retrain chaos smoke — run by run_tests.sh (docs/RELIABILITY.md
+"Autonomous retraining").
+
+The acceptance surface of the self-healing loop, seconds-scale, on real
+replica PROCESSES under live traffic:
+
+1. **heal**: traffic shifts regime (testing/faults.LabelShiftSource —
+   combined covariate + concept shift). The fleet SLO changefinder
+   votes ``retrain_wanted``; the retrain controller debounces the votes
+   and launches a supervised child retrain WARM-STARTED from the
+   promoted bundle over (base corpus ∪ the replay buffer of live
+   shifted traffic, label-joined through the source); the candidate
+   goes through the NORMAL gate → canary bake → full roll, the
+   ``PROMOTED`` pointer advances, and every replica converges on the
+   healed model — with ZERO failed requests end to end.
+2. **storm control**: the label join is poisoned (inverted labels) and
+   the regime shifted again. The next auto-retrain's candidate REGRESSES
+   on the holdout, the gate quarantines it (``.rejected`` marker), the
+   controller backs off — cooldown honored, NO second retrain fires
+   inside the window despite pending votes — still zero failed
+   requests.
+3. the ``retrain`` section is live on the router's ``/snapshot`` and
+   ``/metrics``, votes-vs-acked are distinguishable on ``/slo``, and
+   ``hivemall_tpu obs`` renders the retrain block from the metrics
+   jsonl the ``retrain``/``retrain_wanted``/``retrain_acked`` events
+   landed in.
+
+``HIVEMALL_TPU_TSAN=1`` (set by run_tests.sh) rides the Eraser-style
+lockset sanitizer over the whole run — controller, replay buffer and
+router tee included. ``--artifact PATH`` writes a JSON summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="hivemall_tpu.serve.retrain_smoke")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--artifact", default=None,
+                    help="write a JSON result summary here")
+    args = ap.parse_args(argv)
+    tmp = tempfile.mkdtemp(prefix="hivemall_tpu_retrain_smoke_")
+    metrics = os.path.join(tmp, "metrics.jsonl")
+    os.environ["HIVEMALL_TPU_METRICS"] = metrics
+    try:
+        return _run(args, tmp, metrics)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _write_libsvm(path, rows, labels):
+    # synthetic test corpus in this smoke's private temp dir — nothing
+    # reads it mid-write, torn-file atomicity buys nothing here
+    with open(path, "w") as f:  # graftcheck: disable=GC03
+        for r, y in zip(rows, labels):
+            f.write(f"{int(y)} " + " ".join(r) + "\n")
+
+
+def _run(args, tmp, metrics) -> int:
+    from ..testing import tsan
+    tsan.maybe_enable()
+    import numpy as np                               # noqa: F401
+    from ..io import checkpoint as ck
+    from ..models.linear import GeneralClassifier
+    from ..serve.fleet import Fleet
+    from ..serve.http import KeepAliveClient
+    from ..serve.promote import PromotionController, PromotionGate
+    from ..testing.faults import LabelShiftSource
+
+    opts = "-dims 4096 -loss logloss -opt adagrad -mini_batch 32"
+    src = LabelShiftSource(seed=11)
+
+    # phase-0 world: base corpus on disk (the retrain child's
+    # shard-cache-path input), a trained + PROMOTED bootstrap model
+    ckdir = os.path.join(tmp, "ck")
+    os.makedirs(ckdir)
+    t = GeneralClassifier(opts)
+    base_rows, base_labels = src.rows(400)
+    base_path = os.path.join(tmp, "base.libsvm")
+    _write_libsvm(base_path, base_rows, base_labels)
+    t.fit(src.dataset(400, t), epochs=4)
+    step0 = int(t._t)
+    t.save_bundle(os.path.join(ckdir, f"{t.NAME}-step{step0:010d}.npz"))
+    name = t.NAME
+    holdout0 = src.dataset(200, t)
+    rep = PromotionController(
+        ckdir, PromotionGate("train_classifier", opts,
+                             holdout=holdout0)).check_once()
+    assert rep and rep["promoted"], rep
+
+    # the gate the FLEET uses judges candidates on a TRUE-labeled
+    # holdout spanning every regime the run will visit (in production: a
+    # fresh labeled feedback slice; the union keeps the baseline
+    # comparable). Phase concepts derive deterministically from the
+    # seed, so a second source replays them without disturbing the
+    # traffic source's rng.
+    hold_src = LabelShiftSource(seed=11)
+    h_rows, h_y = hold_src.rows(80)
+    for n in (150, 120):                 # phases 1 and 2
+        hold_src.shift()
+        r, y = hold_src.rows(n)
+        h_rows += r
+        h_y += y
+    hold_path = os.path.join(tmp, "holdout.libsvm")
+    _write_libsvm(hold_path, h_rows, h_y)
+
+    fleet = Fleet(
+        "train_classifier", opts, checkpoint_dir=ckdir,
+        replicas=args.replicas,
+        watch_interval=0.3, health_interval=0.2,
+        promote=True, holdout=hold_path,
+        # a drift-healing candidate SHOULD shift scores, and calibration
+        # against a holdout that spans regimes the candidate has not
+        # seen yet is structurally loose: the labeled logloss/AUC deltas
+        # are the quality judges here, the distribution checks get
+        # generous bounds so an honest heal is not rejected for
+        # succeeding
+        gate_opts={"max_score_shift": None, "max_calibration_gap": 0.35},
+        canary_fraction=0.5, canary_bake_s=1.5,
+        bake_opts={"min_requests": 3, "score_shift_floor": 10.0},
+        slo_opts={"drift_warmup": 10, "drift_sigma": 3.0},
+        retrain=True, train_input=base_path,
+        retrain_opts={"label_fn": src.label, "min_votes": 2,
+                      "vote_window_s": 120.0, "cooldown_s": 4.0,
+                      "window_s": 60.0, "max_retrains_per_window": 4,
+                      "backoff_factor": 3.0, "batch_size": 32,
+                      "epochs": 2, "train_timeout_s": 300.0,
+                      "replay_segment_rows": 64,
+                      "flap_warmup": 1_000_000},
+        serve_kwargs={"max_batch": 64, "max_delay_ms": 3.0,
+                      "max_queue_rows": 4096,
+                      "warmup_len": 16})
+    # flap_warmup is effectively disabled above: the smoke MUST trigger
+    # on a genuine vote burst; the flap detector's own math is pinned by
+    # tests/test_retrain.py
+    t0 = time.monotonic()
+    fleet.start(wait_ready=True, timeout=180.0)
+    print(f"retrain smoke: {args.replicas} replicas ready in "
+          f"{time.monotonic() - t0:.1f}s on port {fleet.port}",
+          file=sys.stderr)
+    results = {}
+    try:
+        rc = _drive(args, tmp, metrics, src, fleet, ck, name, step0,
+                    KeepAliveClient, results)
+    finally:
+        fleet.stop()
+    if args.artifact:
+        # the CI artifact is read by tooling — atomic, never torn
+        from ..io.checkpoint import _atomic_write_json
+        _atomic_write_json(args.artifact, json.loads(
+            json.dumps(results, default=str)))
+    return rc
+
+
+def _drive(args, tmp, metrics, src, fleet, ck, name, step0,
+           KeepAliveClient, results) -> int:
+    failures = []
+
+    def check(label, ok, detail=""):
+        print(f"retrain smoke {label}: {'OK' if ok else 'FAILED'} "
+              f"{detail}", file=sys.stderr)
+        results[label] = {"ok": bool(ok), "detail": detail}
+        if not ok:
+            failures.append(label)
+
+    def wait_for(cond, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.2)
+        return False
+
+    host, port = "127.0.0.1", fleet.port
+    mgr = fleet.manager
+    ctl = fleet.retrain
+
+    # live traffic for the WHOLE run: every phase must cost zero
+    # failures. Each thread draws fresh rows from the source, so the
+    # traffic follows src.shift() automatically.
+    stop = threading.Event()
+    traffic_errs = []
+    traffic_n = [0]
+    lock = threading.Lock()
+
+    def traffic():
+        cli = KeepAliveClient(host, port)
+        while not stop.is_set():
+            with lock:                   # rng draw serialized
+                row, _y = src.row()
+            try:
+                code, r = cli.post_json("/predict", {"rows": [row]})
+                if code != 200:
+                    traffic_errs.append(f"status {code}: {r}")
+            except Exception as e:       # noqa: BLE001 — collected
+                traffic_errs.append(str(e))
+            traffic_n[0] += 1
+            time.sleep(0.005)            # leave CPU for retrain children
+        cli.close()
+
+    tt = [threading.Thread(target=traffic) for _ in range(3)]
+    for th in tt:
+        th.start()
+
+    # -- 1. heal: shift the regime, watch the loop close ------------------
+    # let the changefinder's self-calibration warm up on stable traffic
+    ok = wait_for(lambda: fleet.slo.samples >= 20, timeout=30.0)
+    check("slo_warmup", ok, f"(samples {fleet.slo.samples})")
+    with lock:
+        src.shift()
+    ok = wait_for(lambda: fleet.slo.retrain_wanted >= 2, timeout=150.0)
+    check("drift_votes", ok,
+          f"(retrain_wanted {fleet.slo.retrain_wanted})")
+    ok = wait_for(lambda: ctl.attempts >= 1, timeout=90.0)
+    check("retrain_triggered", ok,
+          f"(state {ctl.state}, reason {ctl.last_trigger_reason!r})")
+    ok = wait_for(lambda: ctl.successes >= 1
+                  and mgr.fleet_step is not None
+                  and mgr.fleet_step > step0
+                  and all(r.model_step == mgr.fleet_step
+                          for r in mgr.replicas()), timeout=300.0)
+    m = ck.read_promoted(mgr.checkpoint_dir)
+    steps = sorted({r.model_step for r in mgr.replicas()})
+    healed_step = m["current"]["step"]
+    check("healed",
+          ok and healed_step > step0 and m["state"] == "serving"
+          and fleet.slo.retrain_acked >= 2,
+          f"(step {step0} -> {healed_step}, steps {steps}, "
+          f"acked {fleet.slo.retrain_acked}, "
+          f"attempts {ctl.attempts}, err {ctl.last_error!r})")
+    check("heal_no_drops", not traffic_errs,
+          f"({len(traffic_errs)}/{traffic_n[0]}) {traffic_errs[:2]}")
+
+    # -- 2. storm control: poisoned labels -> gate reject -> backoff ------
+    # quiescence first: the heal's own score RECOVERY is itself a mean
+    # shift the changefinder may vote on (an echo retrain over
+    # true-labeled data — harmless, gated like any other); wait out any
+    # in-flight attempt before poisoning the join
+    ok = wait_for(lambda: ctl.state in ("idle", "cooldown")
+                  and ctl._child is None, timeout=120.0)
+    check("quiesced", ok, f"(state {ctl.state})")
+    with lock:
+        src.poison()                     # label join now inverts truth
+        src.shift()                      # and the regime moves again
+    ok = wait_for(lambda: ctl.rejections >= 1, timeout=240.0)
+    rejected = [p for p in ck.list_bundles(mgr.checkpoint_dir, name)
+                if ck.is_rejected(p)]
+    attempts_at_reject = ctl.attempts
+    check("poisoned_rejected",
+          ok and len(rejected) >= 1
+          and ck.read_promoted(mgr.checkpoint_dir)["current"]["step"]
+          == healed_step,
+          f"(rejections {ctl.rejections}, quarantined "
+          f"{[os.path.basename(p) for p in rejected]}, "
+          f"reason {ck.rejected_reason(rejected[0]) if rejected else None!r})")
+    # backoff honored: votes keep arriving, but no new retrain fires
+    # inside the (backed-off) cooldown window
+    sec = ctl.obs_section()
+    time.sleep(3.0)
+    check("backoff_holds",
+          ctl.attempts == attempts_at_reject
+          and sec["cooldown_remaining_s"] > 0
+          and ctl.state == "cooldown",
+          f"(attempts {ctl.attempts}, cooldown_remaining "
+          f"{sec['cooldown_remaining_s']}s, state {ctl.state})")
+    check("storm_no_drops", not traffic_errs,
+          f"({len(traffic_errs)}/{traffic_n[0]}) {traffic_errs[:2]}")
+    stop.set()
+    for th in tt:
+        th.join()
+
+    # -- 3. obs surfaces ---------------------------------------------------
+    snap = json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/snapshot", timeout=10).read())
+    rt = snap.get("retrain") or {}
+    check("obs_snapshot",
+          rt.get("configured") is True and rt.get("attempts", 0) >= 2
+          and rt.get("successes", 0) >= 1
+          and rt.get("rejections", 0) >= 1
+          and (rt.get("replay") or {}).get("rows", 0) > 0,
+          f"({rt})")
+    prom = urllib.request.urlopen(
+        f"http://{host}:{port}/metrics", timeout=10).read().decode()
+    check("obs_metrics",
+          "hivemall_tpu_retrain_attempts" in prom
+          and "hivemall_tpu_retrain_successes" in prom
+          and "hivemall_tpu_promotion_retrain_acked" in prom
+          and "hivemall_tpu_promotion_shadow_mirrored" in prom)
+    slo = json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/slo", timeout=10).read())
+    dr = slo.get("drift") or {}
+    check("slo_votes_vs_acked",
+          dr.get("retrain_wanted", 0) >= 2
+          and dr.get("retrain_acked", 0) >= 2, f"({dr})")
+    from ..obs.report import load_events, summarize
+    events, bad = load_events(metrics)
+    kinds = {e["event"] for e in events}
+    text = summarize(events, bad, path=metrics)
+    check("obs_render",
+          "retrain:" in text
+          and {"retrain_wanted", "retrain_acked", "retrain"} <= kinds,
+          f"(events {sorted(kinds)})")
+
+    # lockset sanitizer verdict: controller/replay/tee writes must be
+    # race-free across the watch, router-handler and stop threads
+    from ..testing import tsan
+    if tsan.enabled():
+        check("tsan_races",
+              tsan.check_and_report("retrain smoke tsan") == 0)
+
+    print(f"retrain smoke: {len(failures)} failures", file=sys.stderr)
+    results["failures"] = failures
+    return len(failures)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

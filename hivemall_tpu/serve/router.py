@@ -297,6 +297,12 @@ class _RouterHTTP:
         r = self._router
         if method == b"POST" and path == b"/predict":
             code, raw, fallback = r.route_predict(body, trace_id)
+            tee = r.predict_tee
+            if tee is not None and raw is not None:
+                try:                     # O(1) bounded append (drop-
+                    tee(body)            # oldest) — never blocks routing
+                except Exception:        # noqa: BLE001 — a tee consumer
+                    pass                 # must never break routing
             if raw is not None:
                 # verbatim relay: replica status line + headers + body
                 # (plus the router's own injected hop/trace headers)
@@ -382,6 +388,12 @@ class RouterServer:
         # /promotion payload provider (wired by a promotion-gated Fleet:
         # pointer manifest + the manager's live promotion section)
         self.promotion_provider = None
+        # traffic tee for the retrain replay buffer (serve.retrain
+        # RouterTee, wired by a retrain-enabled Fleet): successfully
+        # routed /predict bodies are handed over NON-BLOCKING (bounded
+        # ring, drop-oldest) — a stalled consumer can never backpressure
+        # the serving path
+        self.predict_tee = None
         self._tracer = get_tracer()
         self._lock = threading.Lock()
         self._handles: Dict[str, ReplicaHandle] = {}

@@ -40,7 +40,7 @@ import time
 from typing import Dict, Iterable, Iterator, Optional, Tuple, Union
 
 __all__ = ["FlakyProxy", "CrashingSource", "crash_on_nth",
-           "inject_canary_regression"]
+           "inject_canary_regression", "LabelShiftSource"]
 
 Fault = Union[str, Tuple[str, float]]
 
@@ -259,6 +259,125 @@ def crash_on_nth(fn, n: int, exc: Optional[BaseException] = None):
         return fn(item)
 
     return wrapped
+
+
+class LabelShiftSource:
+    """Deterministic labeled-traffic generator whose data regime can be
+    SHIFTED mid-run — the chaos input of the retrain smoke
+    (docs/RELIABILITY.md "Autonomous retraining").
+
+    Each phase ``p`` draws rows over its own disjoint feature-index
+    range (``[p*n_features, (p+1)*n_features)``) with labels from a
+    phase-specific linear concept, so :meth:`shift` is a combined
+    covariate + concept shift: a model trained on phase 0 has no
+    weights on phase 1's indices, its live prediction scores collapse
+    toward the bias — exactly the score-distribution break the SLO
+    changefinder votes ``retrain_wanted`` on — while the TRUE labels
+    now follow a concept only a retrain over the shifted traffic can
+    learn.
+
+    The instance is also the LABEL JOIN for the replay buffer:
+    :meth:`label` recovers a row's ground-truth label from its feature
+    strings (phase inferred from the index range, so late-joined rows
+    from an earlier phase still label correctly). :meth:`poison` makes
+    subsequent joins return INVERTED labels — the bad-data regime that
+    must be caught by the gate and backed off, never retrain-stormed."""
+
+    def __init__(self, *, n_features: int = 100, active: int = 8,
+                 seed: int = 11, concept_bias: float = 1.0):
+        import numpy as np
+        self._np = np
+        self.n_features = int(n_features)
+        self.active = int(active)
+        self.seed = int(seed)
+        # a positive concept bias skews every phase's labels positive,
+        # so a trained model's mean prediction score sits visibly ABOVE
+        # 0.5 — and collapses to the bias when the features shift out
+        # from under it. That collapse is what the SLO score-drift
+        # changefinder (a MEAN-tracking detector) must see; a balanced
+        # concept would shift variance, not mean, and hide the break.
+        self.concept_bias = float(concept_bias)
+        self.phase = 0
+        self.poisoned = False
+        self._rng = np.random.default_rng(seed)
+        self._w: Dict[int, "np.ndarray"] = {}
+
+    def _weights(self, phase: int):
+        w = self._w.get(phase)
+        if w is None:
+            # per-phase deterministic concept, independent of draw order
+            rng = self._np.random.default_rng(self.seed * 1000 + phase)
+            w = rng.standard_normal(self.n_features)
+            self._w[phase] = w
+        return w
+
+    def shift(self) -> int:
+        """Advance to the next (disjoint-feature, new-concept) regime."""
+        self.phase += 1
+        return self.phase
+
+    def poison(self, on: bool = True) -> None:
+        """Invert every subsequent label join — deterministic bad-data
+        injection for the storm-control path."""
+        self.poisoned = bool(on)
+
+    def row(self) -> Tuple[list, float]:
+        """One (feature_strings, true_label) draw from the CURRENT
+        phase (the label ignores :meth:`poison` — poisoning corrupts
+        the JOIN, not the ground truth)."""
+        # +1 offset: id 0 is the conventional padding/bias slot in the
+        # LIBSVM readers — generated rows must round-trip identically
+        # through trainer._parse_row AND read_libsvm
+        base = self.phase * self.n_features + 1
+        idx = self._rng.choice(self.n_features, size=self.active,
+                               replace=False)
+        val = self._rng.uniform(0.2, 1.0, size=self.active)
+        w = self._weights(self.phase)
+        y = 1.0 if float((w[idx] * val).sum()) + self.concept_bias > 0 \
+            else -1.0
+        feats = [f"{int(base + i)}:{float(v):.6f}"
+                 for i, v in zip(idx, val)]
+        return feats, y
+
+    def rows(self, n: int) -> Tuple[list, list]:
+        out_r, out_y = [], []
+        for _ in range(int(n)):
+            r, y = self.row()
+            out_r.append(r)
+            out_y.append(y)
+        return out_r, out_y
+
+    def label(self, features: list) -> Optional[float]:
+        """The label join: ground-truth label for a row's feature
+        strings (or the POISONED inversion), None for an unparseable
+        row — a replay buffer must drop it, not train label 0."""
+        try:
+            idx, val = [], []
+            for f in features:
+                name, v = str(f).split(":", 1)
+                idx.append(int(name))
+                val.append(float(v))
+            if not idx:
+                return None
+            phase = (idx[0] - 1) // self.n_features
+            base = phase * self.n_features + 1
+            w = self._weights(phase)
+            local = [i - base for i in idx]
+            if any(i < 0 or i >= self.n_features for i in local):
+                return None
+            m = sum(w[i] * v for i, v in zip(local, val))
+            y = 1.0 if m + self.concept_bias > 0 else -1.0
+            return -y if self.poisoned else y
+        except (ValueError, IndexError):
+            return None
+
+    def dataset(self, n: int, trainer):
+        """``n`` current-phase rows as a SparseDataset parsed through
+        the trainer's own row parser (holdout / direct-training input)."""
+        from ..io.sparse import SparseDataset
+        rows, labels = self.rows(n)
+        parsed = [trainer._parse_row(r) for r in rows]
+        return SparseDataset.from_rows(parsed, labels)
 
 
 def inject_canary_regression(manager, *, latency_ms: float = 0.0,

@@ -106,6 +106,9 @@ _AUTO_REGISTER: Tuple[Tuple[str, str], ...] = (
     ("hivemall_tpu.serve.fleet", "ReplicaManager"),
     ("hivemall_tpu.serve.fleet", "Fleet"),
     ("hivemall_tpu.serve.promote", "PromotionController"),
+    ("hivemall_tpu.serve.retrain", "RetrainController"),
+    ("hivemall_tpu.serve.retrain", "ReplayBuffer"),
+    ("hivemall_tpu.serve.retrain", "RouterTee"),
     ("hivemall_tpu.obs.slo", "SloEngine"),
 )
 _states: "weakref.WeakKeyDictionary[Any, Dict[str, dict]]" = \

@@ -415,6 +415,20 @@ _FIXTURES = {
         "                    else dict(FOO_STUB))\n"
         "        registry.register('bad.name', p)\n",
         {"GC05"}),
+    # GC05 on the ISSUE-13 `retrain` section specifically: a provider
+    # whose keys drift from RETRAIN_STUB must be caught the same way
+    # (the autopilot's state machine is dashboard-keyed)
+    "pkg/obs/retrain_registry.py": (
+        "RETRAIN_STUB = {'state': 'idle', 'attempts': 0}\n\n"
+        "class R:\n"
+        "    def obs_section(self):\n"
+        "        return {'state': 'idle', 'extra_key': 1}\n"
+        "    def _register_obs(self):\n"
+        "        def p():\n"
+        "            return (self.obs_section() if self is not None\n"
+        "                    else dict(RETRAIN_STUB))\n"
+        "        registry.register('retrain', p)\n",
+        {"GC05"}),
     # GC07: a direct fetch in a per-step loop, and a call to a helper
     # that fetches (one function boundary away)
     "pkg/models/bad_hot.py": (

@@ -3,7 +3,8 @@
 # fresh run starts from a clean slate so stale races can't confuse a
 # read of the artifacts.
 mkdir -p artifacts
-rm -f artifacts/graftcheck_report.json artifacts/tsan_races.jsonl
+rm -f artifacts/graftcheck_report.json artifacts/tsan_races.jsonl \
+      artifacts/retrain_smoke.json
 
 # graftcheck gate (docs/STATIC_ANALYSIS.md): project-invariant static
 # analysis, run FIRST because it is the cheapest phase (~15 s budget
@@ -95,6 +96,22 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # /promotion and the `hivemall_tpu obs` render.
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.serve.promote_smoke || exit $?
+
+# retrain chaos smoke (docs/RELIABILITY.md "Autonomous retraining"):
+# the closed train→validate→promote→rollback loop over a 2-replica
+# fleet under live traffic — an injected label/covariate shift
+# (testing/faults.LabelShiftSource) must drive retrain_wanted votes, a
+# debounced trigger, a warm-start child retrain from the PROMOTED
+# bundle over (base corpus ∪ replay buffer), a gate pass, a canary
+# bake and a full roll (pointer advances, fleet converges) with ZERO
+# failed requests; then a POISONED label join must be quarantined at
+# the gate (.rejected marker) with the backoff cooldown holding — no
+# retrain storm. tsan-enabled like the serve/fleet smokes; the JSON
+# result summary lands in artifacts/.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
+    python -m hivemall_tpu.serve.retrain_smoke \
+    --artifact artifacts/retrain_smoke.json || exit $?
 
 # shard-cache smoke (docs/PERFORMANCE.md "Shard cache"): a cold fit must
 # build the packed cache, a fresh-trainer warm fit must bit-match its loss

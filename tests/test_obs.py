@@ -788,6 +788,17 @@ def test_stub_sections_match_live_providers(tmp_path):
     assert set(promotion_stub()["canary"]) \
         == set(pm.promotion_section()["canary"])
 
+    # retrain: RetrainController.obs_section() (never triggered) must
+    # mirror RETRAIN_STUB key-for-key, nested replay dict included
+    from hivemall_tpu.serve.retrain import (RetrainController,
+                                            retrain_stub)
+    rc = RetrainController("train_classifier", "-dims 64",
+                           checkpoint_dir=str(tmp_path / "retrain"))
+    assert set(retrain_stub()) == set(rc.obs_section()), \
+        "retrain stub drifted from live keys"
+    assert set(retrain_stub()["replay"]) \
+        == set(rc.obs_section()["replay"])
+
     # devprof: the stub constructor IS the contract
     from hivemall_tpu.obs.devprof import devprof_stub, get_devprof
     live_dp = get_devprof().obs_section()

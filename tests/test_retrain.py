@@ -1,0 +1,649 @@
+"""Autopilot retraining (docs/RELIABILITY.md "Autonomous retraining"):
+the ReplayBuffer disk ring, the RouterTee/ShadowBuffer label-join tees,
+warm-start fidelity of the composed retrain stream, the
+RetrainController's storm controls (debounce, cooldown, backoff,
+window budget, single-child budget, flap detector) and its
+crash-recovery-from-disk contract, plus the votes-vs-acked SLO
+surface. The full multi-process heal (drift votes → child retrain →
+gate → canary → fleet convergence under live traffic) is pinned by the
+retrain chaos smoke in run_tests.sh."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.io import checkpoint as ck
+from hivemall_tpu.serve.retrain import (ReplayBuffer, RetrainController,
+                                        RouterTee, build_retrain_stream,
+                                        retrain_stub)
+
+OPTS = "-dims 512 -loss logloss -opt adagrad -mini_batch 16"
+
+
+def _trainer(opts=OPTS):
+    from hivemall_tpu.models.linear import GeneralClassifier
+    return GeneralClassifier(opts)
+
+
+def _raw_rows(ds, n, start=0):
+    rows, labels = [], []
+    for i in range(start, start + n):
+        idx, val = ds.row(i % len(ds))
+        rows.append([f"{int(a)}:{float(v)!r}" for a, v in zip(idx, val)])
+        labels.append(float(ds.labels[i % len(ds)]))
+    return rows, labels
+
+
+@pytest.fixture()
+def promoted_dir(tmp_path):
+    """A checkpoint dir with a trained, PROMOTED bootstrap bundle."""
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    ds, _ = synthetic_classification(128, 48, seed=3)
+    t = _trainer()
+    t.fit(ds)
+    path = os.path.join(tmp_path, f"{t.NAME}-step{t._t:010d}.npz")
+    t.save_bundle(path)
+    ck.promote_bundle(str(tmp_path), path)
+    return str(tmp_path), t, ds, path
+
+
+# --- replay buffer -----------------------------------------------------------
+
+def test_replay_ring_rotation_and_counters(tmp_path):
+    rb = ReplayBuffer(str(tmp_path), segment_rows=4, max_segments=2)
+    rows = [[f"{i + 1}:1.0"] for i in range(10)]
+    labels = [1.0] * 10
+    rb.add(rows, labels)
+    rb.flush()
+    c = rb.counters()
+    assert c["rows"] == 10
+    assert c["segments"] == 2                 # ring evicted the oldest
+    assert c["rows_dropped"] == 4
+    assert c["pending_rows"] == 0
+    # committed content = the NEWEST rows (drop-oldest ring)
+    back = rb.rows()
+    assert len(back) == 6
+    assert back[-1][0] == ["10:1.0"]
+    # no tmp litter from the atomic writes
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+
+def test_replay_seq_recovers_across_instances(tmp_path):
+    rb = ReplayBuffer(str(tmp_path), segment_rows=2, max_segments=10)
+    rb.add([["1:1"], ["2:1"]], [1.0, -1.0])
+    rb2 = ReplayBuffer(str(tmp_path), segment_rows=2, max_segments=10)
+    rb2.add([["3:1"], ["4:1"]], [1.0, -1.0])
+    segs = sorted(os.listdir(tmp_path))
+    assert len(segs) == 2 and segs[0] != segs[1]
+    assert len(rb2.rows()) == 4
+
+
+def test_replay_skips_unlabeled_rows(tmp_path):
+    rb = ReplayBuffer(str(tmp_path), segment_rows=8)
+    n = rb.add([["1:1"], ["2:1"], ["3:1"]], [1.0, None, -1.0])
+    assert n == 2
+    rb.flush()
+    assert [y for _, y in rb.rows()] == [1.0, -1.0]
+
+
+def test_replay_dataset_roundtrip(tmp_path):
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    ds, _ = synthetic_classification(32, 16, seed=5)
+    rows, labels = _raw_rows(ds, 32)
+    rb = ReplayBuffer(str(tmp_path), segment_rows=16)
+    rb.add(rows, labels)
+    rb.flush()
+    t = _trainer()
+    rds = rb.dataset(t)
+    assert len(rds) == 32
+    np.testing.assert_allclose(np.asarray(rds.labels),
+                               np.asarray(labels, np.float32))
+    # parsed through the trainer's own parser: same indices
+    i0, v0 = t._parse_row(rows[0])
+    np.testing.assert_array_equal(rds.row(0)[0], i0)
+
+
+def test_router_tee_bounded_and_parsing():
+    tee = RouterTee(capacity=3)
+    for i in range(5):
+        tee(json.dumps({"rows": [[f"{i + 1}:1.0"]]}).encode())
+    assert tee.teed == 5 and tee.dropped == 2
+    bodies = tee.drain()
+    assert len(bodies) == 3 and tee.drain() == []
+    assert RouterTee.rows_of(bodies[-1]) == [["5:1.0"]]
+    assert RouterTee.rows_of(b'{"features": ["1:1", "2:2"]}') \
+        == [["1:1", "2:2"]]
+    assert RouterTee.rows_of(b"not json") == []
+
+
+# --- shadow-buffer label-join tee -------------------------------------------
+
+def test_shadow_raw_capture_and_drain_labeled():
+    from hivemall_tpu.serve.promote import ShadowBuffer
+
+    def label(row):
+        if row[0].startswith("bad"):
+            return None
+        return 1.0 if row[0].startswith("1") else -1.0
+
+    sh = ShadowBuffer(capacity=8, capture_raw=True, label_fn=label)
+    sh.add([("p1",), ("p2",), ("p3",)],
+           raw=[["1:1"], ["bad:1"], ["2:1"]])
+    rows, labels = sh.drain_labeled()
+    assert rows == [["1:1"], ["2:1"]] and labels == [1.0, -1.0]
+    assert sh.drain_labeled() == ([], [])     # consumed
+    assert sh.mirrored == 3
+    # parsed-row mirror for the gate is unaffected by the raw drain
+    assert len(sh.rows()) == 3
+
+
+def test_batcher_raw_tee_alignment():
+    from hivemall_tpu.serve.batcher import MicroBatcher
+    got = []
+    b = MicroBatcher(lambda rows: np.zeros(len(rows), np.float32),
+                     max_batch=8, max_delay_ms=1.0)
+    b.set_tee(lambda rows, raws: got.append((list(rows), list(raws))),
+              raw=True)
+    f1 = b.submit([("a",), ("b",)], raw=[["1:1"], ["2:1"]])
+    f1.result(timeout=5)
+    f2 = b.submit([("c",)])                   # no raw: None-padded
+    f2.result(timeout=5)
+    b.close()
+    raws = [r for _, rs in got for r in rs]
+    assert [["1:1"], ["2:1"]] == [r for r in raws if r is not None][:2]
+    assert None in raws or len(raws) == 2     # the raw-less request pads
+    rows_seen = [r for rows, _ in got for r in rows]
+    assert rows_seen == [("a",), ("b",), ("c",)]
+
+
+def test_shadow_counters_in_promotion_sections(tmp_path):
+    from hivemall_tpu.serve.promote import (PromotionController,
+                                            PromotionGate, ShadowBuffer,
+                                            shadow_counters)
+    sh = ShadowBuffer(capacity=4)
+    sh.add([("r",)] * 6)
+    gate = PromotionGate("train_classifier", "-dims 64", shadow=sh)
+    ctrl = PromotionController(str(tmp_path), gate)
+    sec = ctrl.obs_section()
+    assert sec["shadow"] == {"mirrored": 6, "dropped": 2, "rows": 4}
+    assert "retrain_acked" in sec
+    assert shadow_counters(None) == {"mirrored": 0, "dropped": 0,
+                                     "rows": 0}
+
+
+# --- votes vs acked (obs/slo.py satellite) ----------------------------------
+
+def test_slo_ack_retrain_counter():
+    from hivemall_tpu.obs.slo import SloEngine
+    eng = SloEngine()
+    assert eng.retrain_acked == 0
+    assert eng.ack_retrain(3) == 3
+    assert eng.obs_section()["retrain_acked"] == 3
+    assert eng.evaluate()["drift"]["retrain_acked"] == 3
+    from hivemall_tpu.obs.report import render_slo
+    assert "acked x3" in render_slo(eng.evaluate())
+
+
+# --- warm-start fidelity (ISSUE 13 satellite) -------------------------------
+
+@pytest.mark.parametrize("k", [1, 8])
+def test_warm_start_fidelity_base_union_replay(tmp_path, k):
+    """A retrain over build_retrain_stream (base file ∪ replay
+    segments) warm-started from the promoted bundle must BIT-MATCH the
+    same continuation run uninterrupted over the equivalent hand-built
+    stream — the controller's data plumbing adds zero numerical drift,
+    at steps_per_dispatch 1 and 8."""
+    import itertools
+
+    from hivemall_tpu.io.libsvm import (read_libsvm,
+                                        synthetic_classification)
+    opts = OPTS + f" -steps_per_dispatch {k}"
+    base_ds, _ = synthetic_classification(96, 24, seed=7)
+    # promoted bootstrap
+    boot = _trainer(opts)
+    boot.fit(base_ds)
+    bpath = os.path.join(tmp_path, f"{boot.NAME}-step{boot._t:010d}.npz")
+    boot.save_bundle(bpath)
+    # base corpus as a file (the CLI/fleet train_input shape)
+    base_path = str(tmp_path / "base.libsvm")
+    with open(base_path, "w") as f:
+        for i in range(len(base_ds)):
+            idx, val = base_ds.row(i)
+            toks = " ".join(f"{int(a)}:{float(v):.6f}"
+                            for a, v in zip(idx, val))
+            f.write(f"{int(base_ds.labels[i])} {toks}\n")
+    # replay segments from 'live traffic'
+    rdir = str(tmp_path / "replay")
+    rb = ReplayBuffer(rdir, segment_rows=16)
+    rows, labels = _raw_rows(base_ds, 40)
+    rb.add(rows, labels)
+    rb.flush()
+
+    warm = _trainer(opts)
+    warm.load_bundle(bpath)
+    stream, n = build_retrain_stream(warm, base=base_path,
+                                     replay_dir=rdir, batch_size=16)
+    assert n == 96 + 40
+    warm.fit_stream(stream)
+
+    ref = _trainer(opts)
+    ref.load_bundle(bpath)
+    manual = itertools.chain(
+        read_libsvm(base_path).batches(16, shuffle=False),
+        ReplayBuffer(rdir).dataset(ref).batches(16, shuffle=False))
+    ref.fit_stream(manual)
+
+    assert warm._t == ref._t > boot._t
+    np.testing.assert_array_equal(np.asarray(warm.w), np.asarray(ref.w))
+
+
+# --- storm controls ----------------------------------------------------------
+
+class _FakeChild:
+    """Popen stand-in: exits immediately with a canned result line."""
+
+    def __init__(self, rc=0):
+        self._rc = rc
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        pass
+
+    kill = terminate
+
+    def wait(self, timeout=None):
+        return self._rc
+
+
+def _fake_launch(result):
+    """RetrainController._launch replacement producing ``result``."""
+    def launch(self, warm_bundle):
+        with self._lock:
+            self._child = _FakeChild()
+            self._child_out = [json.dumps(result)]
+            self._child_since = time.monotonic()
+    return launch
+
+
+def _controller(ckdir, votes, **kw):
+    kw.setdefault("cooldown_s", 30.0)
+    kw.setdefault("min_votes", 2)
+    kw.setdefault("flap_warmup", 10_000)
+    return RetrainController("train_classifier", OPTS,
+                             checkpoint_dir=ckdir,
+                             votes_fn=lambda: votes[0], **kw)
+
+
+def test_debounce_min_votes_and_trigger(promoted_dir, monkeypatch):
+    ckdir, t, ds, path = promoted_dir
+    votes = [0]
+    c = _controller(ckdir, votes, train_input=None)
+    # replay data so a trigger is possible
+    rows, labels = _raw_rows(ds, 8)
+    c.replay.add(rows, labels)
+    monkeypatch.setattr(RetrainController, "_launch",
+                        _fake_launch({"ok": True, "bundle": "x.npz",
+                                      "step": 999}))
+    c.tick()
+    assert c.state == "idle" and c.attempts == 0
+    votes[0] = 1
+    c.tick()
+    assert c.attempts == 0                    # below min_votes
+    votes[0] = 2
+    c.tick()
+    assert c.attempts == 1                    # debounce satisfied
+    assert c.votes_acked == 2
+    assert c.state == "gating"                # fake child already done
+
+
+def test_trigger_requires_promoted_and_data(tmp_path):
+    votes = [10]
+    c = _controller(str(tmp_path), votes)
+    c.tick()
+    assert c.attempts == 0
+    assert "no PROMOTED bundle" in (c.last_error or "")
+
+
+def test_cooldown_and_window_budget(promoted_dir, monkeypatch):
+    ckdir, t, ds, path = promoted_dir
+    votes = [0]
+    c = _controller(ckdir, votes, cooldown_s=1000.0,
+                    max_retrains_per_window=1, window_s=3600.0)
+    rows, labels = _raw_rows(ds, 8)
+    c.replay.add(rows, labels)
+    monkeypatch.setattr(RetrainController, "_launch",
+                        _fake_launch({"ok": True, "bundle": "x.npz",
+                                      "step": 999}))
+    votes[0] = 2
+    c.tick()
+    assert c.attempts == 1
+    # resolve the candidate: reject it on disk -> backoff cooldown
+    cand = c._candidate_path()
+    open(cand, "wb").close()                  # file must exist
+    ck.reject_bundle(cand, "test rejection")
+    c.tick()
+    assert c.state == "cooldown" and c.rejections == 1
+    # more votes: cooldown holds (no second retrain inside the window)
+    votes[0] = 10
+    for _ in range(3):
+        c.tick()
+    assert c.attempts == 1
+    # even past cooldown, the per-window budget would hold
+    c._cooldown_until = 0.0
+    c.tick()
+    assert c.attempts == 1
+    assert "budget exhausted" in (c.last_error or "")
+
+
+def test_rejection_backoff_grows(promoted_dir, monkeypatch):
+    ckdir, t, ds, path = promoted_dir
+    votes = [0]
+    c = _controller(ckdir, votes, cooldown_s=10.0, backoff_factor=3.0,
+                    max_retrains_per_window=100)
+    rows, labels = _raw_rows(ds, 8)
+    c.replay.add(rows, labels)
+    monkeypatch.setattr(RetrainController, "_launch",
+                        _fake_launch({"ok": True, "bundle": "x.npz",
+                                      "step": 999}))
+    votes[0] = 2
+    c.tick()
+    cand = c._candidate_path()
+    open(cand, "wb").close()
+    ck.reject_bundle(cand, "r1")
+    c.tick()
+    rem1 = c.obs_section()["cooldown_remaining_s"]
+    assert 25.0 < rem1 <= 30.0                # 10 * 3^1
+    # second rejection backs off harder
+    c._cooldown_until = 0.0
+    c._set_state("idle", emit=False)
+    votes[0] = 4
+    c.tick()
+    cand = c._candidate_path()
+    open(cand, "wb").close()
+    ck.reject_bundle(cand, "r2")
+    c.tick()
+    rem2 = c.obs_section()["cooldown_remaining_s"]
+    assert 80.0 < rem2 <= 90.0                # 10 * 3^2
+
+
+def test_single_child_budget(promoted_dir, monkeypatch):
+    ckdir, t, ds, path = promoted_dir
+
+    class _Running(_FakeChild):
+        def poll(self):
+            return None                       # never exits
+
+    def launch(self, warm_bundle):
+        with self._lock:
+            self._child = _Running()
+            self._child_out = []
+            self._child_since = time.monotonic()
+
+    votes = [2]
+    c = _controller(ckdir, votes, train_timeout_s=10_000.0)
+    rows, labels = _raw_rows(ds, 8)
+    c.replay.add(rows, labels)
+    monkeypatch.setattr(RetrainController, "_launch", launch)
+    c.tick()
+    assert c.attempts == 1 and c.state == "training"
+    votes[0] = 50
+    for _ in range(3):
+        c.tick()
+    assert c.attempts == 1                    # budget of exactly one
+
+
+def test_child_timeout_fails_attempt(promoted_dir, monkeypatch):
+    ckdir, t, ds, path = promoted_dir
+
+    class _Stuck(_FakeChild):
+        def poll(self):
+            return None
+
+    def launch(self, warm_bundle):
+        with self._lock:
+            self._child = _Stuck()
+            self._child_out = []
+            self._child_since = time.monotonic() - 999.0
+
+    votes = [2]
+    c = _controller(ckdir, votes, train_timeout_s=1.0)
+    rows, labels = _raw_rows(ds, 8)
+    c.replay.add(rows, labels)
+    monkeypatch.setattr(RetrainController, "_launch", launch)
+    c.tick()
+    c.tick()
+    assert c.state == "cooldown"
+    assert "timed out" in (c.last_error or "")
+
+
+def test_flap_detector_counts_and_holds(promoted_dir, monkeypatch):
+    ckdir, t, ds, path = promoted_dir
+    votes = [0]
+    c = _controller(ckdir, votes, min_votes=1, flap_warmup=5,
+                    cooldown_s=60.0)
+    rows, labels = _raw_rows(ds, 8)
+    c.replay.add(rows, labels)
+    monkeypatch.setattr(RetrainController, "_launch",
+                        _fake_launch({"ok": True, "bundle": "x.npz",
+                                      "step": 999}))
+    # calm-but-varying warmup (a constant stream has zero variance and
+    # the self-calibrated threshold never arms; enough ticks that the
+    # storm's own contribution to the Welford std is negligible — the
+    # production regime, one observation per tick), then a vote storm:
+    # the shared DriftWatch must flag and the holdoff must block the
+    # trigger this tick despite pending >= min_votes
+    for i in range(150):
+        votes[0] += i % 2
+        c._observe_votes(time.monotonic())
+    c.votes_acked = c.votes_seen              # consume the warmup votes
+    c._recent_votes.clear()
+    votes[0] += 500
+    c.tick()
+    assert c.flaps >= 1
+    assert c.attempts == 0                    # flap holdoff, not a storm
+    assert c._flap_until > time.monotonic()
+
+
+# --- crash recovery from on-disk state --------------------------------------
+
+def test_recovery_honors_cooldown_stamp(promoted_dir, monkeypatch):
+    ckdir, t, ds, path = promoted_dir
+    votes = [5]
+    a = _controller(ckdir, votes, cooldown_s=500.0)
+    a._enter_cooldown(500.0)
+    # fresh controller over the same dir (the crashed one is gone)
+    b = _controller(ckdir, votes)
+    assert b.state == "cooldown"
+    assert b.obs_section()["cooldown_remaining_s"] > 400.0
+    rows, labels = _raw_rows(ds, 8)
+    b.replay.add(rows, labels)
+    monkeypatch.setattr(RetrainController, "_launch",
+                        _fake_launch({"ok": True, "bundle": "x.npz",
+                                      "step": 999}))
+    b.tick()
+    assert b.attempts == 0                    # stamp holds post-crash
+
+
+def test_recovery_training_without_candidate(promoted_dir, monkeypatch):
+    ckdir, t, ds, path = promoted_dir
+    votes = [2]
+    a = _controller(ckdir, votes, cooldown_s=0.0)
+
+    class _Running(_FakeChild):
+        def poll(self):
+            return None
+
+    def launch(self, warm_bundle):
+        with self._lock:
+            self._child = _Running()
+            self._child_out = []
+            self._child_since = time.monotonic()
+
+    rows, labels = _raw_rows(ds, 8)
+    a.replay.add(rows, labels)
+    monkeypatch.setattr(RetrainController, "_launch", launch)
+    a.tick()
+    assert a.state == "training"
+    # SIGKILL: the child dies with the controller, no candidate landed
+    b = _controller(ckdir, votes)
+    assert b.state == "idle"
+    assert "recovered" in (b.last_error or "")
+    assert b.attempts == 1                    # durable counters survive
+
+
+def test_recovery_gating_resumes_and_resolves(promoted_dir, monkeypatch):
+    ckdir, t, ds, path = promoted_dir
+    votes = [2]
+    a = _controller(ckdir, votes, cooldown_s=1.0)
+    rows, labels = _raw_rows(ds, 8)
+    a.replay.add(rows, labels)
+    # a REAL candidate bundle (promote_bundle reads its meta)
+    t2 = _trainer()
+    t2.load_bundle(path)
+    t2._t += 7
+    cand = os.path.join(ckdir, f"{t2.NAME}-step{t2._t:010d}.npz")
+    t2.save_bundle(cand)
+    monkeypatch.setattr(
+        RetrainController, "_launch",
+        _fake_launch({"ok": True, "bundle": os.path.basename(cand),
+                      "step": int(t2._t)}))
+    a.tick()
+    assert a.state == "gating"
+    # controller dies; a new one resumes watching the SAME candidate
+    b = _controller(ckdir, votes)
+    assert b.state == "gating"
+    assert b._candidate["bundle"] == os.path.basename(cand)
+    # external gate (fleet manager / promote watcher) canaries it...
+    ck.promote_bundle(ckdir, cand, state="canary")
+    b.tick()
+    assert b.state == "canary"
+    # ...another crash mid-canary: recovery lands back in canary
+    c = _controller(ckdir, votes)
+    assert c.state == "canary"
+    # bake completes -> promoted -> success + cooldown
+    ck.finalize_promotion(ckdir)
+    c.tick()
+    assert c.state == "cooldown" and c.successes == 1
+
+
+def test_recovery_canary_rollback_counts(promoted_dir, monkeypatch):
+    ckdir, t, ds, path = promoted_dir
+    votes = [2]
+    a = _controller(ckdir, votes, cooldown_s=1.0)
+    rows, labels = _raw_rows(ds, 8)
+    a.replay.add(rows, labels)
+    t2 = _trainer()
+    t2.load_bundle(path)
+    t2._t += 7
+    cand = os.path.join(ckdir, f"{t2.NAME}-step{t2._t:010d}.npz")
+    t2.save_bundle(cand)
+    monkeypatch.setattr(
+        RetrainController, "_launch",
+        _fake_launch({"ok": True, "bundle": os.path.basename(cand),
+                      "step": int(t2._t)}))
+    a.tick()
+    ck.promote_bundle(ckdir, cand, state="canary")
+    a.tick()
+    assert a.state == "canary"
+    # the bake fails: manager quarantines + rolls back (marker FIRST)
+    ck.reject_bundle(cand, "canary regression")
+    ck.rollback_promoted(ckdir, "canary regression")
+    a.tick()
+    assert a.state == "cooldown"
+    assert a.rollbacks == 1 and a.rejections == 1
+
+
+def test_vote_counter_reset_rebaselines(promoted_dir):
+    ckdir, t, ds, path = promoted_dir
+    votes = [50]
+    c = _controller(ckdir, votes)
+    c.tick()                                  # baseline at 50, no lump
+    assert c.attempts == 0 and c.votes_seen == 50
+    votes[0] = 3                              # serve process restarted
+    c.tick()
+    assert c.votes_seen == 3
+    assert c.votes_acked <= 3                 # ledger clamped, no
+    #                                           phantom pending votes
+
+
+# --- obs / stub / events -----------------------------------------------------
+
+def test_retrain_obs_section_and_stub(promoted_dir):
+    ckdir, t, ds, path = promoted_dir
+    votes = [0]
+    c = _controller(ckdir, votes)
+    sec = c.obs_section()
+    assert set(sec) == set(retrain_stub())
+    assert set(sec["replay"]) == set(retrain_stub()["replay"])
+    assert sec["configured"] is True and sec["state"] == "idle"
+    # registry provider is live (weakly held)
+    from hivemall_tpu.obs.registry import registry
+    assert registry.snapshot()["retrain"]["configured"] is True
+
+
+def test_retrain_events_emitted(promoted_dir, monkeypatch, tmp_path):
+    from hivemall_tpu.utils import metrics as m
+    ckdir, t, ds, path = promoted_dir
+    stream_path = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("HIVEMALL_TPU_METRICS", stream_path)
+    m._stream = None                          # force re-open on new env
+    try:
+        votes = [2]
+        c = _controller(ckdir, votes, cooldown_s=1.0)
+        rows, labels = _raw_rows(ds, 8)
+        c.replay.add(rows, labels)
+        monkeypatch.setattr(RetrainController, "_launch",
+                            _fake_launch({"ok": True, "bundle": "x.npz",
+                                          "step": 999}))
+        c.tick()
+        cand = c._candidate_path()
+        open(cand, "wb").close()
+        ck.reject_bundle(cand, "bad data")
+        c.tick()
+        with open(stream_path) as f:
+            events = [json.loads(ln) for ln in f if ln.strip()]
+        kinds = [e["event"] for e in events]
+        assert "retrain" in kinds
+        rej = [e for e in events if e["event"] == "retrain"
+               and e.get("outcome") == "rejected"]
+        assert rej and "bad data" in rej[0]["reason"]
+    finally:
+        m._stream = None
+
+
+def test_label_shift_source_join_and_poison():
+    from hivemall_tpu.testing.faults import LabelShiftSource
+    src = LabelShiftSource(seed=4)
+    rows, labels = src.rows(32)
+    # the label join recovers exactly the generated ground truth
+    assert [src.label(r) for r in rows] == labels
+    assert 0.5 < np.mean(np.asarray(labels) > 0) < 1.0   # biased concept
+    src.shift()
+    rows2, labels2 = src.rows(8)
+    # disjoint index ranges per phase
+    ids1 = {int(f.split(":")[0]) for r in rows for f in r}
+    ids2 = {int(f.split(":")[0]) for r in rows2 for f in r}
+    assert not (ids1 & ids2)
+    # late-joined phase-0 rows still label correctly after the shift
+    assert [src.label(r) for r in rows] == labels
+    src.poison()
+    assert [src.label(r) for r in rows2] == [-y for y in labels2]
+    assert src.label(["garbage"]) is None
+
+
+def test_cli_retrain_status(promoted_dir, capsys):
+    from hivemall_tpu.cli.main import main
+    ckdir, t, ds, path = promoted_dir
+    rc = main(["retrain", "--algo", "train_classifier",
+               "--options", OPTS, "--checkpoint-dir", ckdir,
+               "--status"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["section"]["configured"] is True
+    assert out["promoted"]["current"]["step"] == ck.read_promoted(
+        ckdir)["current"]["step"]
