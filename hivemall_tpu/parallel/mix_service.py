@@ -507,20 +507,23 @@ class MixClient:
         if self._sock is not None:
             return
         s = socket.create_connection(self.addr, timeout=self.timeout)
-        if s.getsockname() == s.getpeername():
-            # TCP simultaneous-open self-connect: dialing a dead local
-            # port can land on source port == dest port, and the client
-            # would happily read back its own frames as "replies" — a
-            # real hazard for a RETRYING client once the server's
-            # ephemeral port is freed. Treat it as the connection refusal
-            # it morally is.
-            s.close()
-            raise OSError("self-connect detected — no server listening "
-                          f"on {self.addr}")
-        s.settimeout(self.timeout)
-        if self.ssl_context is not None:
-            s = self.ssl_context.wrap_socket(
-                s, server_hostname=self.addr[0])
+        try:
+            if s.getsockname() == s.getpeername():
+                # TCP simultaneous-open self-connect: dialing a dead
+                # local port can land on source port == dest port, and
+                # the client would happily read back its own frames as
+                # "replies" — a real hazard for a RETRYING client once
+                # the server's ephemeral port is freed. Treat it as the
+                # connection refusal it morally is.
+                raise OSError("self-connect detected — no server "
+                              f"listening on {self.addr}")
+            s.settimeout(self.timeout)
+            if self.ssl_context is not None:
+                s = self.ssl_context.wrap_socket(
+                    s, server_hostname=self.addr[0])
+        except OSError:
+            s.close()    # wrap_socket/self-connect failure must not
+            raise        # leak the connected socket (GC12)
         self._sock = s
         if self._ever_connected:
             self.reconnects += 1

@@ -313,6 +313,9 @@ class ReplicaManager:
                 return json.loads(e.read())    # 503 while warming: a real
             except Exception:                  # noqa: BLE001 — health body
                 return None
+            finally:
+                e.close()    # the error owns the probe socket — without
+                #              this every handled 503 leaks one fd (GC12)
         except Exception:                      # noqa: BLE001 — unreachable
             return None
 
@@ -1020,10 +1023,15 @@ def _worker(spec_json: str) -> int:
     ephemeral loopback port. Prints ONE json line (the bound port) on
     stdout, then serves until SIGTERM — on which it drains (accepted
     requests complete) and exits 0."""
-    from ..testing import tsan
+    from ..testing import leaktrack, tsan
     tsan.maybe_enable()                  # inherited HIVEMALL_TPU_TSAN=1:
     #                                      replica-side races land in the
     #                                      shared HIVEMALL_TPU_TSAN_LOG
+    if leaktrack.maybe_enable():         # inherited LEAKTRACK=1: the
+        leaktrack.snapshot()             # replica runs its OWN census on
+        #                                  drain; the summary lands in
+        #                                  the shared artifact where the
+        #                                  smoke-side gate counts it
     spec = json.loads(spec_json)
     aff = spec.get("cpu_affinity")
     if aff and hasattr(os, "sched_setaffinity"):
@@ -1095,6 +1103,14 @@ def _worker(spec_json: str) -> int:
     while not stop.wait(1.0):            # timed wait: signal-interruptible
         pass
     srv.stop(drain=True)
+    if leaktrack.enabled():
+        # the inherited metrics sink closes first — a sink left open
+        # after drain would count as this replica's leak
+        from ..utils.metrics import close_stream
+        close_stream()
+        n = leaktrack.check_and_report(f"replica:{srv.port} leaktrack")
+        return 1 if n else 0     # exit codes wrap mod 256; the true
+        #                          count is in the shared artifact
     return 0
 
 

@@ -38,6 +38,8 @@ import urllib.request
 
 import numpy as np
 
+from ..utils.net import http_get as _http_get
+
 
 def _train_bundle(ckdir: str, opts: str, ds):
     from ..io.checkpoint import newest_bundle
@@ -67,11 +69,30 @@ def main(argv=None) -> int:
     from ..testing import tsan
     if tsan.maybe_enable():
         print("fleet smoke: tsan sanitizer ON", file=sys.stderr)
+    # leak census sanitizer: manager-side fds/sockets/threads must all
+    # be released after the kill/respawn + rolling-reload + drain +
+    # shutdown cycle; replica workers (fleet._worker) run their OWN
+    # census on drain via the inherited env and append summaries to the
+    # shared artifact — counted into this gate below
+    from ..testing import leaktrack
+    log_off = leaktrack.log_offset()
+    if leaktrack.maybe_enable():
+        print("fleet smoke: leaktrack sanitizer ON", file=sys.stderr)
+        leaktrack.snapshot()
     tmp = tempfile.mkdtemp(prefix="hivemall_tpu_fleet_smoke_")
     try:
-        return _run(args, tmp)
+        rc = _run(args, tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+    if leaktrack.enabled():
+        n = leaktrack.check_and_report("fleet smoke leaktrack")
+        n += leaktrack.report_child_leaks(log_off, "fleet smoke leaktrack")
+        print(f"fleet smoke leak_census: {'OK' if n == 0 else 'FAILED'} "
+              f"({n} leaked resource(s) after shutdown)",
+              file=sys.stderr)
+        rc += 1 if n else 0      # counts wrap mod 256 in exit codes —
+        #                          a 256-leak run must not read as 0
+    return rc
 
 
 def _run(args, tmp: str) -> int:
@@ -164,12 +185,10 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
           f"({[(h.rid, h.forwarded) for h in handles]})")
 
     # -- 2. aggregated obs surface ----------------------------------------
-    hz = json.loads(urllib.request.urlopen(
-        f"http://{host}:{port}/healthz", timeout=10).read())
+    hz = json.loads(_http_get(f"http://{host}:{port}/healthz"))
     check("healthz", hz.get("status") == "ok"
           and hz.get("ready_replicas") == args.replicas, f"({hz})")
-    snap = json.loads(urllib.request.urlopen(
-        f"http://{host}:{port}/snapshot", timeout=10).read())
+    snap = json.loads(_http_get(f"http://{host}:{port}/snapshot"))
     fl = snap.get("fleet", {})
     agg = fl.get("aggregate", {})
     per = fl.get("replicas", {})
@@ -180,8 +199,7 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
           and "router" in fl
           and "respawns" in fl.get("manager", {}),
           f"(aggregate {agg}, manager {fl.get('manager')})")
-    prom = urllib.request.urlopen(
-        f"http://{host}:{port}/metrics", timeout=10).read().decode()
+    prom = _http_get(f"http://{host}:{port}/metrics").decode()
     check("obs_metrics",
           "hivemall_tpu_fleet_aggregate_requests" in prom
           and "hivemall_tpu_fleet_router_ready_replicas" in prom
@@ -190,8 +208,7 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
     # /healthz totals since start; burn-rate windows must report the
     # traffic phase 1 pushed through
     time.sleep(0.5)                    # >= one health/sample tick
-    slo = json.loads(urllib.request.urlopen(
-        f"http://{host}:{port}/slo", timeout=10).read())
+    slo = json.loads(_http_get(f"http://{host}:{port}/slo"))
     w5 = (slo.get("windows") or {}).get("5m") or {}
     check("slo_surface", slo.get("configured") is True
           and w5.get("requests", 0) >= len(rows)
@@ -227,8 +244,7 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
           abs(hop_sum - total) <= 0.05 * total + 0.25 and total > 0
           and total <= wall_ms + 1.0,
           f"(hops {hop} | router {rhop} | client wall {wall_ms:.1f}ms)")
-    trace = json.loads(urllib.request.urlopen(
-        f"http://{host}:{port}/trace", timeout=10).read())
+    trace = json.loads(_http_get(f"http://{host}:{port}/trace"))
     tagged = [e for e in trace.get("traceEvents", [])
               if tid in str((e.get("args") or {}).get("trace"))]
     pids = {e["pid"] for e in tagged}

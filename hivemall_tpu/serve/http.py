@@ -75,12 +75,17 @@ class KeepAliveClient:
         if self._conn is None:
             conn = http.client.HTTPConnection(
                 self.host, self.port, timeout=self.timeout)
-            conn.connect()
-            # headers and body go out as separate small sends; without
-            # NODELAY, Nagle holds the second one for the delayed ACK
-            import socket as _socket
-            conn.sock.setsockopt(_socket.IPPROTO_TCP,
-                                 _socket.TCP_NODELAY, 1)
+            try:
+                conn.connect()
+                # headers and body go out as separate small sends;
+                # without NODELAY, Nagle holds the second one for the
+                # delayed ACK
+                import socket as _socket
+                conn.sock.setsockopt(_socket.IPPROTO_TCP,
+                                     _socket.TCP_NODELAY, 1)
+            except OSError:
+                conn.close()   # a half-connected conn must not leak
+                raise          # its socket (GC12)
             self._conn = conn
         return self._conn
 
@@ -340,8 +345,64 @@ class _ServeHandler(_ObsHandler):
 class _ThreadedHTTPServer(http.server.ThreadingHTTPServer):
     daemon_threads = True
 
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._conns: set = set()         # live accepted sockets
+        self._conns_lock = threading.Lock()
+
     def handle_error(self, request, client_address):
         pass                           # client disconnects are routine
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        with self._conns_lock:
+            self._conns.add(sock)
+        return sock, addr
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_connections(self, timeout: float = 5.0) -> None:
+        """Drain surviving keep-alive connections. shutdown() only
+        stops the accept loop — a peer that holds its side open (the
+        fleet router's conn pool) would park each handler thread in
+        readline until the 30s idle reaper, leaving the accepted socket
+        open past teardown (the leaktrack census counts that).
+
+        Graceful by construction: EOF the READ side first, so an idle
+        handler wakes and exits while one mid-request keeps its intact
+        write side and finishes its response (drain=True's promise),
+        then loops into the EOF. Each exiting handler closes its own
+        socket via shutdown_request; only stragglers past ``timeout``
+        get force-closed."""
+        import socket as _socket
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(_socket.SHUT_RD)
+            except OSError:
+                pass
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._conns_lock:
+                if not self._conns:
+                    return
+            time.sleep(0.01)
+        with self._conns_lock:
+            leftovers = list(self._conns)
+            self._conns.clear()
+        for sock in leftovers:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class PredictServer:
@@ -419,4 +480,8 @@ class PredictServer:
         if self._own_slo and self.slo is not None:
             self.slo.stop()
         self.batcher.close(drain=drain, timeout=30.0 if drain else 5.0)
+        # EOF-drain surviving keep-alive conns: in-flight responses
+        # (scores resolved during the batcher drain) still write to
+        # completion; nothing outlives the server
+        self._httpd.close_connections()
         self.engine.close()

@@ -33,7 +33,8 @@ import sys
 import tempfile
 import threading
 import time
-import urllib.request
+
+from ..utils.net import http_get as _http_get
 
 
 def main(argv=None) -> int:
@@ -50,6 +51,8 @@ def main(argv=None) -> int:
     try:
         return _run(args, tmp, metrics)
     finally:
+        from ..utils.metrics import close_stream
+        close_stream()                   # the sink points into tmp
         shutil.rmtree(tmp, ignore_errors=True)
 
 
@@ -217,21 +220,18 @@ def _drive(args, tmp, metrics, ds, rows, fleet, trainer, name, opts, ck,
         t.join()
 
     # -- 4. obs surface ----------------------------------------------------
-    snap = json.loads(urllib.request.urlopen(
-        f"http://{host}:{port}/snapshot", timeout=10).read())
+    snap = json.loads(_http_get(f"http://{host}:{port}/snapshot"))
     promo = snap.get("promotion") or {}
     check("obs_snapshot",
           promo.get("configured") is True
           and promo.get("promoted_step") == stepC
           and promo.get("rollbacks", 0) >= 1
           and promo.get("gate_failures", 0) >= 1, f"({promo})")
-    prom = urllib.request.urlopen(
-        f"http://{host}:{port}/metrics", timeout=10).read().decode()
+    prom = _http_get(f"http://{host}:{port}/metrics").decode()
     check("obs_metrics",
           "hivemall_tpu_promotion_rollbacks" in prom
           and "hivemall_tpu_promotion_gate_failures" in prom)
-    pv = json.loads(urllib.request.urlopen(
-        f"http://{host}:{port}/promotion", timeout=10).read())
+    pv = json.loads(_http_get(f"http://{host}:{port}/promotion"))
     check("promotion_endpoint",
           pv.get("configured") is True
           and pv["manifest"]["current"]["step"] == stepC

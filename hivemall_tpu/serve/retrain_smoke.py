@@ -40,7 +40,8 @@ import sys
 import tempfile
 import threading
 import time
-import urllib.request
+
+from ..utils.net import http_get as _http_get
 
 
 def main(argv=None) -> int:
@@ -49,13 +50,39 @@ def main(argv=None) -> int:
     ap.add_argument("--artifact", default=None,
                     help="write a JSON result summary here")
     args = ap.parse_args(argv)
+    # leak census sanitizer: the controller's replay segments, retrain
+    # child supervision and tee threads must all be released after the
+    # chaos cycle. Fleet replicas run their own census on drain
+    # (fleet._worker, counted below); retrain CHILDREN are one-shot
+    # batch processes the controller supervises and reaps — their
+    # lifetime IS the leak bound, so they stay outside the census.
+    from ..testing import leaktrack
+    log_off = leaktrack.log_offset()
+    if leaktrack.maybe_enable():
+        print("retrain smoke: leaktrack sanitizer ON", file=sys.stderr)
+        leaktrack.snapshot()
     tmp = tempfile.mkdtemp(prefix="hivemall_tpu_retrain_smoke_")
     metrics = os.path.join(tmp, "metrics.jsonl")
     os.environ["HIVEMALL_TPU_METRICS"] = metrics
     try:
-        return _run(args, tmp, metrics)
+        rc = _run(args, tmp, metrics)
     finally:
+        # the process-wide metrics sink points into tmp — close it
+        # before the census (an open sink after shutdown IS a leak)
+        from ..utils.metrics import close_stream
+        close_stream()
         shutil.rmtree(tmp, ignore_errors=True)
+    if leaktrack.enabled():
+        n = leaktrack.check_and_report("retrain smoke leaktrack")
+        n += leaktrack.report_child_leaks(log_off,
+                                          "retrain smoke leaktrack")
+        print(f"retrain smoke leak_census: "
+              f"{'OK' if n == 0 else 'FAILED'} "
+              f"({n} leaked resource(s) after shutdown)",
+              file=sys.stderr)
+        rc += 1 if n else 0      # counts wrap mod 256 in exit codes —
+        #                          a 256-leak run must not read as 0
+    return rc
 
 
 def _write_libsvm(path, rows, labels):
@@ -280,8 +307,7 @@ def _drive(args, tmp, metrics, src, fleet, ck, name, step0,
         th.join()
 
     # -- 3. obs surfaces ---------------------------------------------------
-    snap = json.loads(urllib.request.urlopen(
-        f"http://{host}:{port}/snapshot", timeout=10).read())
+    snap = json.loads(_http_get(f"http://{host}:{port}/snapshot"))
     rt = snap.get("retrain") or {}
     check("obs_snapshot",
           rt.get("configured") is True and rt.get("attempts", 0) >= 2
@@ -289,15 +315,13 @@ def _drive(args, tmp, metrics, src, fleet, ck, name, step0,
           and rt.get("rejections", 0) >= 1
           and (rt.get("replay") or {}).get("rows", 0) > 0,
           f"({rt})")
-    prom = urllib.request.urlopen(
-        f"http://{host}:{port}/metrics", timeout=10).read().decode()
+    prom = _http_get(f"http://{host}:{port}/metrics").decode()
     check("obs_metrics",
           "hivemall_tpu_retrain_attempts" in prom
           and "hivemall_tpu_retrain_successes" in prom
           and "hivemall_tpu_promotion_retrain_acked" in prom
           and "hivemall_tpu_promotion_shadow_mirrored" in prom)
-    slo = json.loads(urllib.request.urlopen(
-        f"http://{host}:{port}/slo", timeout=10).read())
+    slo = json.loads(_http_get(f"http://{host}:{port}/slo"))
     dr = slo.get("drift") or {}
     check("slo_votes_vs_acked",
           dr.get("retrain_wanted", 0) >= 2

@@ -77,10 +77,18 @@ class _RawConn:
 
     def __init__(self, host: str, port: int, timeout: float):
         self.sock = socket.create_connection((host, port), timeout=timeout)
-        # request head and body go out as separate small sends; Nagle +
-        # delayed ACK would stall every kept-alive forward ~40ms
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.rfile = self.sock.makefile("rb")
+        try:
+            # request head and body go out as separate small sends;
+            # Nagle + delayed ACK would stall every kept-alive forward
+            # ~40ms
+            self.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+            self.rfile = self.sock.makefile("rb")
+        except OSError:
+            # a constructor failure drops the half-built object — the
+            # connected socket must not outlive it (GC12)
+            self.sock.close()
+            raise
 
     def close(self) -> None:
         try:
@@ -201,8 +209,12 @@ class _RouterHTTP:
     def __init__(self, router: "RouterServer", host: str, port: int):
         self._router = router
         self._sock = socket.create_server((host, port))
-        self._sock.settimeout(1.0)       # accept loop polls the stop flag
-        self.port = int(self._sock.getsockname()[1])
+        try:
+            self._sock.settimeout(1.0)   # accept loop polls the stop flag
+            self.port = int(self._sock.getsockname()[1])
+        except OSError:
+            self._sock.close()           # constructor failure must not
+            raise                        # leak the listening socket
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -233,6 +245,7 @@ class _RouterHTTP:
                              daemon=True).start()
 
     def _serve_conn(self, sock: socket.socket) -> None:
+        rf = None
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(30.0)        # idle keep-alive reaper
@@ -287,6 +300,13 @@ class _RouterHTTP:
         except (OSError, ValueError):
             pass                         # disconnects are routine
         finally:
+            # close the makefile reader FIRST: it holds an io-ref on the
+            # socket, and sock.close() alone leaves the fd open until GC
+            if rf is not None:
+                try:
+                    rf.close()
+                except OSError:
+                    pass
             try:
                 sock.close()
             except OSError:

@@ -29,17 +29,14 @@ import urllib.request
 
 import numpy as np
 
+from ..utils.net import http_get as _get
+
 
 def _post(url: str, obj: dict, timeout: float = 30.0) -> dict:
     req = urllib.request.Request(
         url, json.dumps(obj).encode(), {"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read())
-
-
-def _get(url: str, timeout: float = 10.0) -> bytes:
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        return r.read()
 
 
 def _train_bundle(ckdir: str, opts: str, ds, epochs: int = 1):
@@ -75,11 +72,27 @@ def main(argv=None) -> int:
         args.p99_budget_ms *= 3
         print(f"serve smoke: tsan sanitizer ON (p99 budget relaxed to "
               f"{args.p99_budget_ms}ms)", file=sys.stderr)
+    # leak census sanitizer (HIVEMALL_TPU_LEAKTRACK=1): snapshot BEFORE
+    # any serve object exists; the census re-runs after the full
+    # traffic + reload + drain + shutdown cycle and any tracked
+    # fd/socket/thread still alive fails the smoke
+    from ..testing import leaktrack
+    if leaktrack.maybe_enable():
+        print("serve smoke: leaktrack sanitizer ON", file=sys.stderr)
+        leaktrack.snapshot()
     tmp = tempfile.mkdtemp(prefix="hivemall_tpu_serve_smoke_")
     try:
-        return _run(args, tmp)
+        rc = _run(args, tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+    if leaktrack.enabled():
+        n = leaktrack.check_and_report("serve smoke leaktrack")
+        print(f"serve smoke leak_census: {'OK' if n == 0 else 'FAILED'} "
+              f"({n} leaked resource(s) after shutdown)",
+              file=sys.stderr)
+        rc += 1 if n else 0      # counts wrap mod 256 in exit codes —
+        #                          a 256-leak run must not read as 0
+    return rc
 
 
 def _run(args, tmp: str) -> int:

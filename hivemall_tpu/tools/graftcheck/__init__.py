@@ -13,6 +13,12 @@ follows tainted clocks through helper returns, shared-attribute writes
 through methods called from thread targets, and jit-closure factories
 across modules — and pairs with the runtime lockset race sanitizer
 (:mod:`hivemall_tpu.testing.tsan`) the serve/fleet smokes run under.
+Since PR 14 a third layer understands the JAX side of the house —
+tracer safety, scan-carry stability, buffer donation (GC09-GC11) —
+plus exception-path resource lifetimes (GC12), with the leak census
+sanitizer (:mod:`hivemall_tpu.testing.leaktrack`) as GC12's dynamic
+twin; the scan itself fans the parse/summary and rule passes across
+worker processes.
 
 Rules (each with a fix-hint and a ``# graftcheck: disable=<code>``
 suppression; see docs/STATIC_ANALYSIS.md for the full catalog):
@@ -45,6 +51,23 @@ GC07      transfer-discipline: ``np.asarray``/``device_get``/
           (direct, or one function boundary away).
 GC08      thread-lifecycle: self-stored looping threads whose class
           provably lacks a join / poison-pill shutdown path.
+GC09      tracer-safety: ``np.*`` calls, ``float()``-family casts,
+          ``.item()``/``.tolist()`` and Python branches on parameters
+          reachable as TRACED values from a jit/pjit/pmap/shard_map or
+          ``lax.scan`` root (worklist closure over call edges; the
+          ``np.<fn>`` subset is ``--fix``-able to ``jnp.<fn>``).
+GC10      carry-stability: ``lax.scan`` bodies whose returned carry can
+          diverge from the input pytree — scalar literals as carry
+          leaves, explicit-dtype ``.astype`` on carry leaves,
+          length-divergent conditional returns.
+GC11      donation-discipline: reads of a ``donate_argnums`` buffer
+          after the donating call (factory returns followed
+          cross-module) + undonated ops/ ``scannable`` step cores.
+GC12      resource-lifecycle: socket/file/mmap/http handles in serve//
+          io//parallel/ that can leak on an exception path — no
+          with/finally/cleanup-and-reraise, no owner release path
+          (helpers RETURNING a fresh resource make their call sites
+          acquisitions).
 ========  ===============================================================
 
 Run ``python -m hivemall_tpu.tools.graftcheck`` from the repo root; CI

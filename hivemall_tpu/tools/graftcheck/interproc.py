@@ -33,10 +33,12 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 __all__ = [
     "FUNCS", "LOOPS", "FunctionSummary", "CallSite", "ModuleInfo",
-    "InterProcIndex", "build_index", "dec_name", "is_cache_decorator",
+    "ModuleFacts", "InterProcIndex", "build_index", "extract_module",
+    "assemble_index", "dec_name", "is_cache_decorator",
     "is_memo_decorated", "is_jit_name", "is_jit_creation",
     "is_jit_decorator", "is_partial", "is_thread_ctor", "LOCKISH",
-    "under_lock", "is_transfer_call", "module_name_of",
+    "under_lock", "is_transfer_call", "module_name_of", "call_key",
+    "is_acquisition", "donated_positions_of",
 ]
 
 FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -50,6 +52,106 @@ _FACTORY_NAMES = {"instrument_factory", "_instrument"}
 #: host<->device transfer surface GC07 polices: a fetch forces a device
 #: sync; inside a per-step loop it serializes the pipeline per iteration
 _TRANSFER_ATTRS = {"block_until_ready", "device_get"}
+
+#: compile-wrapper surface GC09 treats as tracing roots: a function
+#: handed to any of these has TRACER parameters, not arrays
+_TRACE_WRAPPER_NAMES = {"jit", "pjit", "pmap", "shard_map"}
+
+#: attribute reads on a tracer that yield CONCRETE Python values (static
+#: under trace) — they KILL tracer taint
+_CONCRETE_ATTRS = {"shape", "dtype", "ndim", "size", "weak_type",
+                   "sharding", "aval"}
+
+#: numpy module aliases whose calls force host concretization of a
+#: tracer (GC09's np-call hazard; jnp is the traced twin)
+_NP_ALIASES = {"np", "numpy"}
+
+#: builtins that concretize a tracer argument (TracerConversionError
+#: under jit, silent per-trace recompute otherwise)
+_CONCRETIZE_BUILTINS = {"float", "int", "bool", "complex"}
+
+#: method calls that force a device sync + host conversion
+_CONCRETIZE_METHODS = {"item", "tolist"}
+
+#: resource-acquiring expressions GC12 polices (kind tags for messages).
+#: ``open`` is the builtin; the rest are attribute calls on their module
+#: or on a socket object.
+_ACQUIRE_NAME_CALLS = {"open": "file"}
+_ACQUIRE_ATTR_CALLS = {
+    # (base name, attr) -> kind; base None = any base object
+    ("socket", "socket"): "socket",
+    ("socket", "create_connection"): "socket",
+    ("socket", "create_server"): "socket",
+    ("socket", "socketpair"): "socket",
+    ("mmap", "mmap"): "mmap",
+    ("os", "fdopen"): "file",
+    (None, "makefile"): "file",
+    (None, "accept"): "socket",
+    # http-level wrappers that own a socket until .close()
+    (None, "HTTPConnection"): "http-conn",
+    ("request", "urlopen"): "http-response",
+    (None, "urlopen"): "http-response",
+}
+
+
+def is_acquisition(node: ast.AST) -> Optional[str]:
+    """Resource kind acquired by this Call expression, or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Name):
+        return _ACQUIRE_NAME_CALLS.get(f.id)
+    if isinstance(f, ast.Attribute):
+        base = f.value.id if isinstance(f.value, ast.Name) else None
+        kind = _ACQUIRE_ATTR_CALLS.get((base, f.attr))
+        if kind is not None:
+            return kind
+        return _ACQUIRE_ATTR_CALLS.get((None, f.attr))
+    return None
+
+
+def _int_tuple_literal(node: ast.AST) -> Tuple[int, ...]:
+    """(0, 1)-style literal -> ints; anything else -> ()."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return ()
+        return tuple(out)
+    return ()
+
+
+def _jit_call_kwargs(node: ast.AST, kw: str) -> Tuple[int, ...]:
+    """``donate_argnums``/``static_argnums`` literal of a jit creation:
+    ``jax.jit(f, kw=(0,1))``, ``partial(jax.jit, kw=(0,1))(f)`` or the
+    same shapes in decorator position."""
+    calls: List[ast.Call] = []
+    if isinstance(node, ast.Call):
+        calls.append(node)
+        if isinstance(node.func, ast.Call):
+            calls.append(node.func)      # partial(jax.jit, ...)(f)
+    for c in calls:
+        for k in c.keywords:
+            if k.arg == kw:
+                got = _int_tuple_literal(k.value)
+                if got:
+                    return got
+    return ()
+
+
+def donated_positions_of(fn: ast.AST) -> Tuple[int, ...]:
+    """donate_argnums positions a def's jit decorator declares, () when
+    the def is not donation-jitted (or the literal is not static)."""
+    for d in getattr(fn, "decorator_list", []):
+        if is_jit_decorator(d):
+            got = _jit_call_kwargs(d, "donate_argnums")
+            if got:
+                return got
+    return ()
 
 
 def dec_name(dec: ast.AST) -> str:
@@ -187,6 +289,16 @@ class CallSite:
     self_arg_positions: Tuple[int, ...] = ()   # positions passing bare
     #                                            `self` (GC04 escape)
     callee_repr: str = ""             # for messages on resolved calls
+    #: structural callee key (resolved into ``callee`` once the whole
+    #: project's name tables exist — extraction stays per-module pure,
+    #: which is what lets the engine fan the summary pass across cores)
+    key: Optional[Tuple] = None
+    #: positional args carrying param-derived taint: (pos, (param, ...))
+    #: — the GC09 propagation edges (a traced value handed to a callee
+    #: taints the callee's parameter at that position)
+    arg_taints: Tuple[Tuple[int, Tuple[str, ...]], ...] = ()
+    #: same for keyword args: (kwarg name, (param, ...))
+    kw_taints: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
 
 
 @dataclass
@@ -227,9 +339,41 @@ class FunctionSummary:
     #: `self.<attr>` event names gating a while loop (`while not
     #: self._stop.is_set()` / `.wait(t)`) — GC08 poison-pill evidence
     loop_event_gates: Set[str] = field(default_factory=set)
+    # -- v3 facts (GC09-GC12) -----------------------------------------
+    #: params traced when this def is jit/pjit/pmap/shard_map-DECORATED
+    #: (static_argnums positions excluded) — a GC09 tracing root
+    jit_params: Tuple[str, ...] = ()
+    #: donate_argnums positions of this def's jit decorator (GC11)
+    donated_positions: Tuple[int, ...] = ()
+    #: host-concretizing calls on param-derived values: param ->
+    #: [(line, kind, repr)] with kind np|cast|item (np is --fix-able)
+    param_np_calls: Dict[str, List[Tuple[int, str, str]]] = field(
+        default_factory=dict)
+    #: Python control flow (if/while/assert truthiness) on a
+    #: param-derived value: param -> [line, ...]
+    param_branches: Dict[str, List[int]] = field(default_factory=dict)
+    #: functions this body hands to jit/pjit/pmap/shard_map — local
+    #: nested defs resolve at extraction (fids), module/imported names
+    #: resolve later (keys); each with its static_argnums positions
+    jit_root_fids: List[Tuple[FuncId, Tuple[int, ...]]] = field(
+        default_factory=list)
+    jit_root_keys: List[Tuple[Tuple, Tuple[int, ...]]] = field(
+        default_factory=list)
+    #: functions this body hands to lax.scan as the scan BODY (GC10)
+    scan_body_fids: List[FuncId] = field(default_factory=list)
+    scan_body_keys: List[Tuple] = field(default_factory=list)
+    #: return value is a raw acquired resource (socket/file/mmap kind)
+    returns_resource_direct: Optional[str] = None
+    #: returns a donate-jitted closure (direct evidence only)
+    returns_donated_direct: Tuple[int, ...] = ()
+    #: callee keys whose return value this function returns (resolved
+    #: into return_call_targets by assemble_index)
+    return_call_keys: List[Tuple] = field(default_factory=list)
     # transitive facts, filled by the fixpoint in build_index()
     returns_wall: bool = False
     returns_fresh_jit: bool = False
+    returns_resource: Optional[str] = None
+    returns_donated: Tuple[int, ...] = ()
 
 
 @dataclass
@@ -249,6 +393,28 @@ class ModuleInfo:
     classes: Dict[str, Dict[str, FuncId]] = field(default_factory=dict)
 
 
+def call_key(call: ast.Call) -> Optional[Tuple]:
+    """Picklable structural key of a call's callee expression —
+    resolution against the project name tables happens later (and
+    possibly in another process), so extraction never needs the index:
+    ``("n", f)`` bare name, ``("a", base, attr)`` one-level attribute,
+    ``("d", dotted, attr)`` dotted chain, None unresolvable."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ("n", f.id)
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        if isinstance(v, ast.Name):
+            return ("a", v.id, f.attr)
+        if isinstance(v, ast.Attribute):
+            try:
+                dotted = ast.unparse(v)
+            except Exception:  # noqa: BLE001 — odd nodes
+                return None
+            return ("d", dotted, f.attr)
+    return None
+
+
 class InterProcIndex:
     """Project-wide function summaries + name-based resolution."""
 
@@ -256,6 +422,12 @@ class InterProcIndex:
         self.functions: Dict[FuncId, FunctionSummary] = {}
         self.modules: Dict[str, ModuleInfo] = {}      # modname -> info
         self.modules_by_path: Dict[str, ModuleInfo] = {}
+        #: (FuncId, param name) pairs provably reachable as TRACED
+        #: values from a jit/scan/shard_map root (GC09's worklist
+        #: closure over the forwarding edges)
+        self.traced: Set[Tuple[FuncId, str]] = set()
+        #: functions used as a lax.scan BODY anywhere in the project
+        self.scan_bodies: Set[FuncId] = set()
 
     # -- resolution -----------------------------------------------------
     def resolve_symbol(self, modname: str, symbol: str) \
@@ -276,51 +448,57 @@ class InterProcIndex:
                 return mi2.toplevel.get(s2)
         return None
 
+    def resolve_key(self, mi: ModuleInfo, key: Optional[Tuple],
+                    class_name: Optional[str],
+                    self_name: Optional[str]) -> Optional[FuncId]:
+        """Best-effort callee for a :func:`call_key` as seen from a
+        function inside class ``class_name`` of module ``mi``."""
+        if key is None:
+            return None
+        tag = key[0]
+        if tag == "n":
+            fid = mi.toplevel.get(key[1])
+            if fid is not None:
+                return fid
+            hop = mi.import_symbols.get(key[1])
+            if hop is not None:
+                return self.resolve_symbol(*hop)
+            return None
+        if tag == "a":
+            _, base, attr = key
+            if self_name is not None and base == self_name \
+                    and class_name is not None:
+                methods = mi.classes.get(class_name, {})
+                return methods.get(attr)
+            target_mod = mi.import_modules.get(base)
+            if target_mod is not None:
+                return self.resolve_symbol(target_mod, attr)
+            hop = mi.import_symbols.get(base)
+            if hop is not None:
+                # `from pkg import mod` then `mod.f()`
+                return self.resolve_symbol(f"{hop[0]}.{hop[1]}", attr)
+            return None
+        if tag == "d":
+            # dotted module chain: x.y.f() under `import x.y` or
+            # `import pkg.x as x` — the HEAD name is the local
+            # binding; substituting its target module for it yields
+            # the absolute dotted module the chain names
+            _, dotted, attr = key
+            head, _sep, rest = dotted.partition(".")
+            if head in mi.import_modules:
+                base = mi.import_modules[head]
+                mod = f"{base}.{rest}" if rest else base
+                return self.resolve_symbol(mod, attr)
+            return self.resolve_symbol(dotted, attr)
+        return None
+
     def resolve_call(self, mi: ModuleInfo, call: ast.Call,
                      class_name: Optional[str],
                      self_name: Optional[str]) -> Optional[FuncId]:
         """Best-effort callee of ``call`` as seen from a function inside
         class ``class_name`` of module ``mi``. None = unknown."""
-        f = call.func
-        if isinstance(f, ast.Name):
-            fid = mi.toplevel.get(f.id)
-            if fid is not None:
-                return fid
-            hop = mi.import_symbols.get(f.id)
-            if hop is not None:
-                return self.resolve_symbol(*hop)
-            return None
-        if isinstance(f, ast.Attribute):
-            v = f.value
-            if isinstance(v, ast.Name):
-                if self_name is not None and v.id == self_name \
-                        and class_name is not None:
-                    methods = mi.classes.get(class_name, {})
-                    return methods.get(f.attr)
-                target_mod = mi.import_modules.get(v.id)
-                if target_mod is not None:
-                    return self.resolve_symbol(target_mod, f.attr)
-                hop = mi.import_symbols.get(v.id)
-                if hop is not None:
-                    # `from pkg import mod` then `mod.f()`
-                    return self.resolve_symbol(
-                        f"{hop[0]}.{hop[1]}", f.attr)
-            elif isinstance(v, ast.Attribute):
-                # dotted module chain: x.y.f() under `import x.y` or
-                # `import pkg.x as x` — the HEAD name is the local
-                # binding; substituting its target module for it yields
-                # the absolute dotted module the chain names
-                try:
-                    dotted = ast.unparse(v)
-                except Exception:  # noqa: BLE001 — odd nodes
-                    return None
-                head, _, rest = dotted.partition(".")
-                if head in mi.import_modules:
-                    base = mi.import_modules[head]
-                    mod = f"{base}.{rest}" if rest else base
-                    return self.resolve_symbol(mod, f.attr)
-                return self.resolve_symbol(dotted, f.attr)
-        return None
+        return self.resolve_key(mi, call_key(call), class_name,
+                                self_name)
 
 
 # ---------------------------------------------------------------------------
@@ -419,9 +597,139 @@ def _event_gates(fn: ast.AST, self_name: Optional[str]) -> Set[str]:
     return gates
 
 
+#: builtins whose results are CONCRETE even on tracer args (static
+#: under trace) — they kill taint inside branch tests and expressions
+_STATIC_BUILTINS = {"len", "isinstance", "callable", "hasattr",
+                    "getattr", "type", "id", "repr", "str"}
+
+
+def _taint_origins(expr: ast.AST, origins: Dict[str, Set[str]],
+                   branch: bool = False) -> Set[str]:
+    """Root params whose (possibly derived) values feed ``expr``.
+    Concrete-under-trace constructs are skipped: ``x.shape``-style
+    attribute reads, static builtins, nested function definitions.
+    ``branch=True`` additionally skips ``is``/``is not`` comparisons —
+    ``if val is None`` branches on static None-ness, not on a tracer."""
+    out: Set[str] = set()
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Attribute) and n.attr in _CONCRETE_ATTRS:
+            continue
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id in _STATIC_BUILTINS:
+            continue
+        if branch and isinstance(n, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in n.ops):
+            continue
+        if isinstance(n, FUNCS + (ast.Lambda,)):
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out |= origins.get(n.id, set())
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _assign_edges(nodes: List[ast.AST]) \
+        -> List[Tuple[List[str], ast.AST]]:
+    """(target names, value expr) pairs for taint propagation: plain and
+    annotated assignments, augmented assignment, and for-loop bindings
+    (an iterable's taint reaches its loop variable)."""
+    edges: List[Tuple[List[str], ast.AST]] = []
+
+    def names_of(t: ast.AST) -> List[str]:
+        if isinstance(t, ast.Name):
+            return [t.id]
+        if isinstance(t, (ast.Tuple, ast.List)):
+            return [x for e in t.elts for x in names_of(e)]
+        if isinstance(t, ast.Starred):
+            return names_of(t.value)
+        return []
+
+    for n in nodes:
+        if isinstance(n, ast.Assign):
+            tg = [x for t in n.targets for x in names_of(t)]
+            if tg:
+                edges.append((tg, n.value))
+        elif isinstance(n, ast.AnnAssign) and n.value is not None:
+            tg = names_of(n.target)
+            if tg:
+                edges.append((tg, n.value))
+        elif isinstance(n, ast.AugAssign):
+            tg = names_of(n.target)
+            if tg:
+                edges.append((tg, n.value))
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            tg = names_of(n.target)
+            if tg:
+                edges.append((tg, n.iter))
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            tg = names_of(n.optional_vars)
+            if tg:
+                edges.append((tg, n.context_expr))
+    return edges
+
+
+def _propagate_taint(edges, origins: Dict[str, Set[str]]) -> None:
+    """Close name-level taint over the assignment edges (flow-insensitive
+    fixpoint; scopes are small, 2-3 rounds in practice)."""
+    for _ in range(8):
+        changed = False
+        for targets, value in edges:
+            o = _taint_origins(value, origins)
+            if not o:
+                continue
+            for t in targets:
+                cur = origins.setdefault(t, set())
+                if not o <= cur:
+                    cur |= o
+                    changed = True
+        if not changed:
+            return
+
+
+def _is_trace_wrapper_call(n: ast.Call) -> bool:
+    """jit/pjit/pmap/shard_map applied as a CALL: ``jax.jit(f)``,
+    ``shard_map(f, ...)``, ``partial(jax.jit, ...)(f)``."""
+    if is_jit_creation(n):
+        return True
+    return dec_name(n) in _TRACE_WRAPPER_NAMES
+
+
+def _is_scan_call(n: ast.Call) -> bool:
+    f = n.func
+    if isinstance(f, ast.Attribute) and f.attr == "scan":
+        try:
+            base = ast.unparse(f.value)
+        except Exception:  # noqa: BLE001 — odd nodes
+            return False
+        return base.endswith("lax")
+    return False
+
+
+def _is_traced_def(fn: ast.AST) -> bool:
+    """def decorated with any compile wrapper (jit/pjit/pmap/shard_map,
+    bare or through partial) — its params are tracers."""
+    for d in getattr(fn, "decorator_list", []):
+        if is_jit_decorator(d) or dec_name(d) in _TRACE_WRAPPER_NAMES:
+            return True
+    return False
+
+
+def _static_positions_of(fn: ast.AST) -> Tuple[int, ...]:
+    for d in getattr(fn, "decorator_list", []):
+        if is_jit_decorator(d) or dec_name(d) in _TRACE_WRAPPER_NAMES:
+            got = _jit_call_kwargs(d, "static_argnums")
+            if got:
+                return got
+    return ()
+
+
 def _summarize_function(ctx: Any, mi: ModuleInfo, fn: ast.AST,
                         class_name: Optional[str], direct_method: bool,
-                        bare_time: bool, resolver) -> FunctionSummary:
+                        bare_time: bool) -> FunctionSummary:
     qual = ctx.qualname(fn)
     fid: FuncId = (ctx.relpath, qual)
     args = fn.args
@@ -478,6 +786,32 @@ def _summarize_function(ctx: Any, mi: ModuleInfo, fn: ast.AST,
     jit_defs = {n.name for n in ast.walk(fn)
                 if isinstance(n, FUNCS) and n is not fn
                 and any(is_jit_decorator(d) for d in n.decorator_list)}
+    # nested defs by name (jit/scan root targets resolve locally: the
+    # ops/ factories jit a `def core` defined right inside themselves)
+    nested_defs: Dict[str, ast.AST] = {}
+    for d in ast.walk(fn):
+        if isinstance(d, FUNCS) and d is not fn \
+                and d.name not in nested_defs:
+            nested_defs[d.name] = d
+    donated_named: Dict[str, Tuple[int, ...]] = {}
+    donated_defs = {name: donated_positions_of(d)
+                    for name, d in nested_defs.items()
+                    if donated_positions_of(d)}
+    acq_named: Dict[str, str] = {}       # name -> acquired resource kind
+    for n in nodes:
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            tgt_names = [t.id for t in n.targets
+                         if isinstance(t, ast.Name)]
+            if not tgt_names:
+                continue
+            dp = _jit_call_kwargs(n.value, "donate_argnums")
+            if is_jit_creation(n.value) and dp:
+                for t in tgt_names:
+                    donated_named[t] = dp
+            kind = is_acquisition(n.value)
+            if kind is not None:
+                for t in tgt_names:
+                    acq_named[t] = kind
 
     for n in nodes:
         if isinstance(n, ast.Return) and n.value is not None:
@@ -488,8 +822,109 @@ def _summarize_function(ctx: Any, mi: ModuleInfo, fn: ast.AST,
                     isinstance(v, ast.Name)
                     and (v.id in jit_named or v.id in jit_defs)):
                 s.returns_fresh_jit_direct = True
-    # return_call_targets are resolved by the caller (_return_targets)
-    # once the whole module table exists
+            if not s.returns_donated_direct:
+                if isinstance(v, ast.Call):
+                    dp = _jit_call_kwargs(v, "donate_argnums")
+                    if is_jit_creation(v) and dp:
+                        s.returns_donated_direct = dp
+                elif isinstance(v, ast.Name):
+                    s.returns_donated_direct = donated_named.get(
+                        v.id, donated_defs.get(v.id, ()))
+            if s.returns_resource_direct is None:
+                if isinstance(v, ast.Call):
+                    s.returns_resource_direct = is_acquisition(v)
+                elif isinstance(v, ast.Name):
+                    s.returns_resource_direct = acq_named.get(v.id)
+
+    # return-value call edges (taint/jit/resource chains), by key
+    s.return_call_keys = _return_call_keys(nodes)
+
+    # -- v3: tracer-taint origins, hazards, compile roots ---------------
+    # local-shadow guard: a bare-Name callee that is a parameter, a
+    # locally-assigned name or a nested def must NOT resolve against
+    # the module's top-level table (a param named like a module def
+    # would misattribute facts to the wrong function)
+    edges = _assign_edges(nodes)
+    shadowed = set(params) | set(nested_defs)
+    for tg, _v in edges:
+        shadowed.update(tg)
+    origins: Dict[str, Set[str]] = {p: {p} for p in params}
+    _propagate_taint(edges, origins)
+
+    if _is_traced_def(fn):
+        static = set(_static_positions_of(fn))
+        s.jit_params = tuple(p for i, p in enumerate(params)
+                             if i not in static)
+    s.donated_positions = donated_positions_of(fn)
+
+    def root_target(call: ast.Call):
+        """(fid, None) for a local nested def handed to a wrapper,
+        (None, key) for a module-level/imported name, (None, None) for
+        anything opaque (a param, a local variable, a lambda)."""
+        args = call.args
+        # partial(jax.jit, ...)(f): the wrapped fn is the OUTER call's arg
+        if not args:
+            return None, None
+        a = args[0]
+        if is_jit_name(a) or is_partial(a):
+            return None, None            # the partial(jax.jit, ...) form:
+        #                                  handled via the outer call
+        if isinstance(a, ast.Name):
+            d = nested_defs.get(a.id)
+            if d is not None:
+                return (ctx.relpath, ctx.qualname(d)), None
+            if a.id in shadowed:
+                return None, None
+            return None, ("n", a.id)
+        return None, None
+
+    for n in nodes:
+        if not isinstance(n, ast.Call):
+            continue
+        if _is_trace_wrapper_call(n):
+            fid, key = root_target(n)
+            statics = _jit_call_kwargs(n, "static_argnums")
+            if fid is not None:
+                s.jit_root_fids.append((fid, statics))
+            elif key is not None:
+                s.jit_root_keys.append((key, statics))
+        elif _is_scan_call(n):
+            fid, key = root_target(n)
+            if fid is not None:
+                s.scan_body_fids.append(fid)
+            elif key is not None:
+                s.scan_body_keys.append(key)
+        f = n.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in _NP_ALIASES:
+            o: Set[str] = set()
+            for a in list(n.args) + [k.value for k in n.keywords]:
+                o |= _taint_origins(a, origins)
+            for p in o:
+                s.param_np_calls.setdefault(p, []).append(
+                    (n.lineno, "np", f"{f.value.id}.{f.attr}"))
+        elif isinstance(f, ast.Name) and f.id in _CONCRETIZE_BUILTINS \
+                and n.args:
+            for p in _taint_origins(n.args[0], origins):
+                s.param_np_calls.setdefault(p, []).append(
+                    (n.lineno, "cast", f"{f.id}()"))
+        elif isinstance(f, ast.Attribute) \
+                and f.attr in _CONCRETIZE_METHODS:
+            for p in _taint_origins(f.value, origins):
+                s.param_np_calls.setdefault(p, []).append(
+                    (n.lineno, "item", f".{f.attr}()"))
+    for n in nodes:
+        test = None
+        if isinstance(n, (ast.If, ast.While)):
+            test = n.test
+        elif isinstance(n, ast.Assert):
+            test = n.test
+        elif isinstance(n, ast.IfExp):
+            test = n.test
+        if test is None:
+            continue
+        for p in _taint_origins(test, origins, branch=True):
+            s.param_branches.setdefault(p, []).append(n.lineno)
 
     # attr writes on self / params, call sites, loops, transfers
     watched = set(params) | ({self_name} if self_name else set())
@@ -513,11 +948,6 @@ def _summarize_function(ctx: Any, mi: ModuleInfo, fn: ast.AST,
         if is_transfer_call(n):
             s.transfer_direct = True
         if isinstance(n, ast.Call):
-            callee = None
-            try:
-                callee = resolver(mi, n, class_name, self_name)
-            except Exception:  # noqa: BLE001 — resolution must never
-                callee = None  # crash pass 1; degrade to unknown
             self_pos: Tuple[int, ...] = ()
             if self_name is not None:
                 self_pos = tuple(
@@ -527,72 +957,31 @@ def _summarize_function(ctx: Any, mi: ModuleInfo, fn: ast.AST,
                 crepr = ast.unparse(n.func)
             except Exception:  # noqa: BLE001 — odd nodes
                 crepr = dec_name(n)
+            key = call_key(n)
+            if key is not None and key[0] == "n" \
+                    and key[1] in shadowed:
+                key = None               # local-shadow guard (above)
+            at = tuple((i, tuple(sorted(o)))
+                       for i, a in enumerate(n.args)
+                       for o in [_taint_origins(a, origins)] if o)
+            kt = tuple((k.arg, tuple(sorted(o)))
+                       for k in n.keywords if k.arg is not None
+                       for o in [_taint_origins(k.value, origins)] if o)
             s.calls.append(CallSite(
-                line=n.lineno, callee=callee,
+                line=n.lineno, callee=None,
                 under_lock=under_lock(ctx, n, fn),
-                self_arg_positions=self_pos, callee_repr=crepr))
+                self_arg_positions=self_pos, callee_repr=crepr,
+                key=key, arg_taints=at, kw_taints=kt))
 
     s.loop_event_gates = _event_gates(fn, self_name)
     return s
 
 
-def build_index(contexts: List[Any]) -> InterProcIndex:
-    """Two-phase pass over every parsed module: (1) import maps +
-    top-level def / class-method tables, (2) per-function summaries with
-    call resolution, then the transitive fixpoints."""
-    idx = InterProcIndex()
-
-    # phase 1: names
-    for ctx in contexts:
-        mi = ModuleInfo(ctx.relpath, module_name_of(ctx.relpath),
-                        is_package=ctx.relpath.endswith("__init__.py"))
-        _collect_imports(mi, ctx.tree)
-        for n in ctx.tree.body:
-            if isinstance(n, FUNCS):
-                mi.toplevel[n.name] = (ctx.relpath, n.name)
-            elif isinstance(n, ast.ClassDef):
-                methods = {}
-                for m in n.body:
-                    if isinstance(m, FUNCS):
-                        methods[m.name] = (ctx.relpath,
-                                           f"{n.name}.{m.name}")
-                mi.classes[n.name] = methods
-        idx.modules[mi.modname] = mi
-        idx.modules_by_path[ctx.relpath] = mi
-
-    # phase 2: summaries (imports + toplevel maps are complete, so call
-    # sites resolve against the full project as they are extracted)
-    for ctx in contexts:
-        mi = idx.modules_by_path[ctx.relpath]
-        bare = _has_bare_time_import(ctx.tree)
-        for fn in ast.walk(ctx.tree):
-            if not isinstance(fn, FUNCS):
-                continue
-            # NEAREST enclosing class (nested closures inherit it via
-            # the captured `self`); direct methods get param-0 self
-            cls = None
-            for a in ctx.ancestors(fn):
-                if isinstance(a, ast.ClassDef):
-                    cls = a.name
-                    break
-            direct = isinstance(ctx.parent(fn), ast.ClassDef)
-            s = _summarize_function(ctx, mi, fn, cls, direct, bare,
-                                    idx.resolve_call)
-            s.return_call_targets = _return_targets(
-                mi, fn, cls, s.self_name, idx.resolve_call)
-            idx.functions[s.fid] = s
-
-    _fixpoint(idx)
-    return idx
-
-
-def _return_targets(mi: ModuleInfo, fn: ast.AST,
-                    class_name: Optional[str],
-                    self_name: Optional[str], resolver) -> List[FuncId]:
-    """Callees whose return value ``fn`` returns (directly or through
-    one local name) — the taint/jit propagation edges."""
-    out: List[FuncId] = []
-    nodes = _scope_nodes(fn)
+def _return_call_keys(nodes: List[ast.AST]) -> List[Tuple]:
+    """Callee keys whose return value this function returns (directly or
+    through one local name) — the taint/jit/resource chain edges,
+    resolved by :func:`assemble_index` once the name tables exist."""
+    out: List[Tuple] = []
     call_named: Dict[str, ast.Call] = {}
     for n in nodes:
         if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
@@ -614,24 +1003,199 @@ def _return_targets(mi: ModuleInfo, fn: ast.AST,
             calls.extend(x for x in ast.walk(n.value)
                          if isinstance(x, ast.Call))
         for c in calls:
-            try:
-                fid = resolver(mi, c, class_name, self_name)
-            except Exception:  # noqa: BLE001 — degrade to unknown
-                fid = None
-            if fid is not None:
-                out.append(fid)
+            key = call_key(c)
+            if key is not None:
+                out.append(key)
     return out
 
 
+@dataclass
+class ModuleFacts:
+    """Everything one module contributes to the project index, extracted
+    WITHOUT any cross-module resolution — plain picklable data, so the
+    engine can fan this pass across worker processes and ship the facts
+    back (call sites carry structural :func:`call_key` keys that
+    :func:`assemble_index` resolves once every module's name tables
+    exist)."""
+    info: ModuleInfo
+    summaries: List[FunctionSummary] = field(default_factory=list)
+    #: *_STUB const name -> top-level literal keys (GC05 raw material)
+    stubs: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    #: alias function name -> *_STUB const it stands for
+    stub_aliases: Dict[str, str] = field(default_factory=dict)
+
+
+def extract_module(ctx: Any) -> ModuleFacts:
+    """Pure per-module extraction: import maps, def tables, function
+    summaries with UNRESOLVED callee keys. Runs with no project state —
+    safe to execute in a worker process."""
+    mi = ModuleInfo(ctx.relpath, module_name_of(ctx.relpath),
+                    is_package=ctx.relpath.endswith("__init__.py"))
+    _collect_imports(mi, ctx.tree)
+    for n in ctx.tree.body:
+        if isinstance(n, FUNCS):
+            mi.toplevel[n.name] = (ctx.relpath, n.name)
+        elif isinstance(n, ast.ClassDef):
+            methods = {}
+            for m in n.body:
+                if isinstance(m, FUNCS):
+                    methods[m.name] = (ctx.relpath,
+                                       f"{n.name}.{m.name}")
+            mi.classes[n.name] = methods
+    facts = ModuleFacts(info=mi)
+    bare = _has_bare_time_import(ctx.tree)
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, FUNCS):
+            continue
+        # NEAREST enclosing class (nested closures inherit it via
+        # the captured `self`); direct methods get param-0 self
+        cls = None
+        for a in ctx.ancestors(fn):
+            if isinstance(a, ast.ClassDef):
+                cls = a.name
+                break
+        direct = isinstance(ctx.parent(fn), ast.ClassDef)
+        try:
+            facts.summaries.append(
+                _summarize_function(ctx, mi, fn, cls, direct, bare))
+        except Exception:  # noqa: BLE001 — one intractable function
+            pass           # degrades ALONE to "unknown"; the module's
+            #                imports, stubs and sibling summaries (GC05's
+            #                raw material) must survive it
+    # GC05 raw material (rules.collect_project folds these project-wide)
+    for n in ctx.tree.body:
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and n.targets[0].id.endswith("_STUB") \
+                and isinstance(n.value, ast.Dict):
+            facts.stubs[n.targets[0].id] = tuple(
+                k.value for k in n.value.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, str))
+        elif isinstance(n, FUNCS):
+            refs = {x.id for x in ast.walk(n)
+                    if isinstance(x, ast.Name)
+                    and x.id.endswith("_STUB")}
+            if len(refs) == 1:
+                facts.stub_aliases[n.name] = refs.pop()
+    return facts
+
+
+def assemble_index(all_facts: List[Any]) -> InterProcIndex:
+    """Resolve every module's structural keys against the now-complete
+    project name tables, then run the transitive fixpoints (wall-clock
+    taint, fresh-jit, resource, donation) and the traced-parameter
+    worklist closure GC09/GC10 consume."""
+    idx = InterProcIndex()
+    for facts in all_facts:
+        idx.modules[facts.info.modname] = facts.info
+        idx.modules_by_path[facts.info.relpath] = facts.info
+        for s in facts.summaries:
+            idx.functions[s.fid] = s
+    for facts in all_facts:
+        mi = facts.info
+        for s in facts.summaries:
+            for c in s.calls:
+                if c.callee is None and c.key is not None:
+                    c.callee = idx.resolve_key(mi, c.key, s.class_name,
+                                               s.self_name)
+            s.return_call_targets = [
+                fid for key in s.return_call_keys
+                for fid in (idx.resolve_key(mi, key, s.class_name,
+                                            s.self_name),)
+                if fid is not None]
+            for key, statics in s.jit_root_keys:
+                fid = idx.resolve_key(mi, key, s.class_name, s.self_name)
+                if fid is not None:
+                    s.jit_root_fids.append((fid, statics))
+            s.jit_root_keys = []         # resolved — keep idempotent
+            for key in s.scan_body_keys:
+                fid = idx.resolve_key(mi, key, s.class_name, s.self_name)
+                if fid is not None:
+                    s.scan_body_fids.append(fid)
+            s.scan_body_keys = []
+    _fixpoint(idx)
+    _close_traced(idx)
+    return idx
+
+
+def build_index(contexts: List[Any]) -> InterProcIndex:
+    """Serial convenience: extract every module in-process, then
+    assemble (the engine's parallel path runs :func:`extract_module` in
+    worker processes and calls :func:`assemble_index` itself)."""
+    return assemble_index([extract_module(ctx) for ctx in contexts])
+
+
+def _close_traced(idx: InterProcIndex) -> None:
+    """GC09's worklist closure: (function, param) pairs provably reached
+    by TRACED values. Seeds are compile-wrapper surfaces — jit-decorated
+    defs, functions handed to jit/pjit/pmap/shard_map (minus their
+    static_argnums positions), and lax.scan bodies — and taint flows
+    along call edges whose arguments derive from an already-traced
+    parameter."""
+    traced = idx.traced
+    for s in idx.functions.values():
+        for p in s.jit_params:
+            traced.add((s.fid, p))
+        for fid, statics in s.jit_root_fids:
+            t = idx.functions.get(fid)
+            if t is not None:
+                skip = set(statics)
+                for i, p in enumerate(t.params):
+                    if i not in skip:
+                        traced.add((t.fid, p))
+        for fid in s.scan_body_fids:
+            t = idx.functions.get(fid)
+            if t is not None:
+                idx.scan_bodies.add(t.fid)
+                for p in t.params:
+                    traced.add((t.fid, p))
+    work = list(traced)
+    while work:
+        fid, p = work.pop()
+        s = idx.functions.get(fid)
+        if s is None:
+            continue
+        for c in s.calls:
+            if c.callee is None:
+                continue
+            t = idx.functions.get(c.callee)
+            if t is None:
+                continue
+            # `self.m(x)`: positional arg 0 lands on params[1] (self
+            # occupies slot 0 of the method's parameter tuple)
+            off = 1 if (t.is_method and c.key is not None
+                        and c.key[0] == "a"
+                        and c.key[1] == s.self_name) else 0
+            for pos, origins in c.arg_taints:
+                if p in origins and pos + off < len(t.params):
+                    tp = (t.fid, t.params[pos + off])
+                    if tp not in traced:
+                        traced.add(tp)
+                        work.append(tp)
+            for kw, origins in c.kw_taints:
+                if p in origins and kw in t.params:
+                    tp = (t.fid, kw)
+                    if tp not in traced:
+                        traced.add(tp)
+                        work.append(tp)
+
+
 def _fixpoint(idx: InterProcIndex) -> None:
-    """Close returns_wall / returns_fresh_jit over
-    the call graph. Monotone boolean lattice -> terminates."""
+    """Close returns_wall / returns_fresh_jit / returns_resource /
+    returns_donated over the call graph. Monotone lattices (booleans,
+    first-resource-kind-wins, first-donation-tuple-wins) -> terminates."""
     for s in idx.functions.values():
         s.returns_wall = s.returns_wall_direct
         # a memoized factory hands back the SAME closure per config key:
         # calling it per step is a cache hit, not a fresh compile
         s.returns_fresh_jit = s.returns_fresh_jit_direct \
             and not s.memoized
+        s.returns_resource = s.returns_resource_direct
+        # donation is a property of the returned callable's SIGNATURE —
+        # a memoized factory still hands back a donating callable, so
+        # (unlike fresh-jit) memoization does not clear the fact
+        s.returns_donated = s.returns_donated_direct
     changed = True
     while changed:
         changed = False
@@ -648,6 +1212,20 @@ def _fixpoint(idx: InterProcIndex) -> None:
                     ts = idx.functions.get(t)
                     if ts is not None and ts.returns_fresh_jit:
                         s.returns_fresh_jit = True
+                        changed = True
+                        break
+            if s.returns_resource is None:
+                for t in s.return_call_targets:
+                    ts = idx.functions.get(t)
+                    if ts is not None and ts.returns_resource:
+                        s.returns_resource = ts.returns_resource
+                        changed = True
+                        break
+            if not s.returns_donated:
+                for t in s.return_call_targets:
+                    ts = idx.functions.get(t)
+                    if ts is not None and ts.returns_donated:
+                        s.returns_donated = ts.returns_donated
                         changed = True
                         break
 

@@ -9,7 +9,11 @@ their alias functions, plus the INTERPROCEDURAL summary index
 summaries (returns-tainted, param-escapes, locks-held-at-call) that
 lets GC02 follow a ``time.time()`` value through helper returns, GC04
 follow shared-attribute writes through methods called from thread
-targets, and GC01 track jit-closure factories across modules.
+targets, GC01 track jit-closure factories across modules, GC09 close
+tracer taint over call edges from jit/scan roots, GC11 follow
+``donate_argnums`` facts through factory returns, and GC12 treat a
+helper that returns a fresh resource as an acquisition at its call
+sites.
 
 The rules encode PROJECT invariants, not general style: they must pass
 the known-good compile-factory population clean — the ~67 jit/lru_cache
@@ -25,7 +29,7 @@ from __future__ import annotations
 
 import ast
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 from . import interproc
 from .interproc import (FUNCS, LOOPS, LOCKISH, InterProcIndex,
@@ -37,12 +41,13 @@ from .interproc import (FUNCS, LOOPS, LOCKISH, InterProcIndex,
 import re
 
 __all__ = ["Finding", "ModuleContext", "ProjectIndex", "RULES",
-           "RULESTAMP", "collect_project", "run_rules"]
+           "RULESTAMP", "collect_project", "project_from_facts",
+           "run_rules"]
 
 #: bumped whenever ANY rule's behavior changes — invalidates the
 #: engine's content-hash findings cache wholesale (a stale cache must
 #: never outvote an upgraded rule)
-RULESTAMP = "graftcheck-v2.2"
+RULESTAMP = "graftcheck-v3.0"
 
 
 @dataclass
@@ -765,29 +770,37 @@ def _stub_defs(tree: ast.Module) -> Dict[str, Tuple[ast.AST,
     return out
 
 
-def collect_project(contexts: List[ModuleContext]) -> ProjectIndex:
-    """First pass: stub constants + their alias functions (a module-level
-    def whose body references exactly one ``*_STUB`` name, e.g.
-    ``serve.promote.promotion_stub``), plus the interprocedural summary
-    index every upgraded rule consumes. A summary-pass failure degrades
-    to ``interproc=None`` (intra-module rule behavior), never a crash."""
+def project_from_facts(all_facts: List[Any]) -> ProjectIndex:
+    """Assemble the cross-file index from per-module
+    :class:`~.interproc.ModuleFacts` — the join point of the engine's
+    parallel scan (workers extract facts for their shard; the main
+    process assembles ONE project view and broadcasts it back for the
+    rule pass). An assembly failure degrades to ``interproc=None``
+    (intra-module rule behavior), never a crash."""
     stubs: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
     aliases: Dict[str, str] = {}
-    for ctx in contexts:
-        for name, (node, keys) in _stub_defs(ctx.tree).items():
-            stubs[name] = (ctx.relpath, keys)
-        for n in ctx.tree.body:
-            if not isinstance(n, FUNCS):
-                continue
-            refs = {x.id for x in ast.walk(n)
-                    if isinstance(x, ast.Name) and x.id.endswith("_STUB")}
-            if len(refs) == 1:
-                aliases[n.name] = refs.pop()
+    for facts in all_facts:
+        for name, keys in facts.stubs.items():
+            stubs[name] = (facts.info.relpath, keys)
+        aliases.update(facts.stub_aliases)
     try:
-        idx: Optional[InterProcIndex] = interproc.build_index(contexts)
+        idx: Optional[InterProcIndex] = interproc.assemble_index(all_facts)
     except Exception:  # noqa: BLE001 — summaries degrade to "unknown",
         idx = None     # never take the gate down with an analyzer crash
     return ProjectIndex(stubs=stubs, stub_aliases=aliases, interproc=idx)
+
+
+def collect_project(contexts: List[ModuleContext]) -> ProjectIndex:
+    """First pass (serial convenience): extract every module's facts
+    in-process, then assemble. A module whose extraction crashes
+    degrades to absent-from-the-index, never a gate crash."""
+    facts = []
+    for ctx in contexts:
+        try:
+            facts.append(interproc.extract_module(ctx))
+        except Exception:  # noqa: BLE001 — degrade to unknown
+            pass
+    return project_from_facts(facts)
 
 
 def _literal_keys_of(fn: ast.AST, ctx: ModuleContext,
@@ -1258,6 +1271,730 @@ def gc08_thread_lifecycle(ctx: ModuleContext, project: ProjectIndex) \
     return out
 
 
+# ---------------------------------------------------------------------------
+# GC09 — tracer-safety (the XLA compile contract, half 1)
+# ---------------------------------------------------------------------------
+
+_GC09_HINT = ("use the jnp twin (np.<fn> -> jnp.<fn>; --fix rewrites the "
+              "mechanical subset), lax.cond/jnp.where instead of Python "
+              "branches, or mark the argument static_argnums; a deliberate "
+              "host-side site takes # graftcheck: disable=GC09 with the "
+              "argument on the line")
+
+
+def gc09_tracer_safety(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    """Functions reachable as jit/pjit/pmap/shard_map/lax.scan bodies —
+    directly or through helper hops (the interprocedural traced-param
+    closure) — must not concretize a traced parameter: ``np.*`` calls,
+    ``float()``/``int()``/``bool()`` casts, ``.item()``/``.tolist()``,
+    or Python control flow on a tracer. Under jit these raise
+    TracerConversionError at best; at worst they silently re-run host
+    code per trace or force a device sync per call."""
+    if ctx.is_test_module():
+        return []                        # ad-hoc compiles by design
+    idx = project.interproc
+    if idx is None:
+        return []
+    out: List[Finding] = []
+    for s in idx.functions.values():
+        if s.fid[0] != ctx.relpath:
+            continue
+        for p in s.params:
+            if (s.fid, p) not in idx.traced:
+                continue
+            for line, kind, what in s.param_np_calls.get(p, []):
+                if kind == "np":
+                    msg = (f"{what}() on a value derived from traced "
+                           f"parameter '{p}' of '{s.name}' — numpy "
+                           f"concretizes the tracer (host round-trip "
+                           f"per trace inside a jit/scan region)")
+                    fix_kind, fix_lines = "gc09-jnp", (line,)
+                elif kind == "cast":
+                    msg = (f"{what} cast of a value derived from traced "
+                           f"parameter '{p}' of '{s.name}' — "
+                           f"concretizes the tracer "
+                           f"(TracerConversionError under jit)")
+                    fix_kind, fix_lines = None, ()
+                else:
+                    msg = (f"{what} on a value derived from traced "
+                           f"parameter '{p}' of '{s.name}' — forces a "
+                           f"device sync + host conversion inside a "
+                           f"traced region")
+                    fix_kind, fix_lines = None, ()
+                out.append(Finding(
+                    "GC09", ctx.relpath, line, 0, msg, _GC09_HINT,
+                    s.fid[1], fix_kind=fix_kind, fix_lines=fix_lines))
+            for line in s.param_branches.get(p, []):
+                out.append(Finding(
+                    "GC09", ctx.relpath, line, 0,
+                    f"Python control flow on a value derived from traced "
+                    f"parameter '{p}' of '{s.name}' — branching on a "
+                    f"tracer concretizes it (each taken branch is a "
+                    f"separate trace)",
+                    _GC09_HINT, s.fid[1]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC10 — carry-stability (the XLA compile contract, half 2)
+# ---------------------------------------------------------------------------
+
+_GC10_HINT = ("the carry returned by a lax.scan body must match its "
+              "input pytree structure AND dtypes exactly: seed new "
+              "leaves outside the scan, use jnp.asarray(x, dtype) on "
+              "entry, and keep every return's carry the same shape")
+
+
+def _carry_leaves(expr: ast.AST) -> List[ast.AST]:
+    """Leaf expressions of a carry tuple literal (nested tuples/lists
+    flattened); a non-tuple carry is one leaf."""
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: List[ast.AST] = []
+        for e in expr.elts:
+            out.extend(_carry_leaves(e))
+        return out
+    return [expr]
+
+
+def gc10_carry_stability(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    """A ``lax.scan`` body whose returned carry can diverge from its
+    input carry: a Python scalar literal as a carry leaf (a weak-typed
+    scalar never matches the array leaf it replaces — structure/dtype
+    mismatch, at best a retrace), a dtype-changing ``.astype`` on a
+    carry leaf, or returns whose carry tuples differ in length
+    (conditional carry shape)."""
+    if ctx.is_test_module():
+        return []
+    idx = project.interproc
+    if idx is None:
+        return []
+    bodies = [fid for fid in idx.scan_bodies if fid[0] == ctx.relpath]
+    if not bodies:
+        return []
+    by_qual: Dict[str, ast.AST] = {}
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, FUNCS):
+            by_qual.setdefault(ctx.qualname(n), n)
+    out: List[Finding] = []
+    for fid in bodies:
+        fn = by_qual.get(fid[1])
+        if fn is None:
+            continue
+        # body-scope nodes (nested defs are their own scans' business)
+        nodes: List[ast.AST] = []
+        stack = list(fn.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, FUNCS + (ast.Lambda,)):
+                continue
+            nodes.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        # one-hop name resolution: `c = (x, y)` ... `return c, ys`
+        tuple_named: Dict[str, ast.AST] = {}
+        for n in nodes:
+            if isinstance(n, ast.Assign) \
+                    and isinstance(n.value, (ast.Tuple, ast.List)):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        tuple_named[t.id] = n.value
+        carries: List[Tuple[ast.AST, ast.AST, int]] = []
+        for n in nodes:
+            if not (isinstance(n, ast.Return) and n.value is not None):
+                continue
+            v = n.value
+            # scan bodies return (carry, y); anything else is opaque
+            if isinstance(v, ast.Tuple) and len(v.elts) == 2:
+                carry = v.elts[0]
+                if isinstance(carry, ast.Name) \
+                        and carry.id in tuple_named:
+                    carry = tuple_named[carry.id]
+                carries.append((n, carry, n.lineno))
+        for ret, carry, line in carries:
+            for leaf in _carry_leaves(carry):
+                if isinstance(leaf, ast.Constant) \
+                        and isinstance(leaf.value, (int, float, bool)):
+                    out.append(Finding(
+                        "GC10", ctx.relpath, line, ret.col_offset,
+                        f"scan body '{fid[1]}' returns the Python "
+                        f"scalar literal {leaf.value!r} as a carry "
+                        f"leaf — a weak-typed scalar never matches the "
+                        f"incoming array leaf (carry structure/dtype "
+                        f"mismatch => TypeError or retrace)",
+                        _GC10_HINT, fid[1]))
+                for sub in ast.walk(leaf):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr == "astype":
+                        arg = sub.args[0] if sub.args else None
+                        # x.astype(y.dtype) PRESERVES a leaf dtype —
+                        # only a literal/named dtype can change it
+                        if not (isinstance(arg, ast.Attribute)
+                                and arg.attr == "dtype"):
+                            out.append(Finding(
+                                "GC10", ctx.relpath, sub.lineno,
+                                sub.col_offset,
+                                f"scan body '{fid[1]}' applies .astype "
+                                f"with an explicit dtype to a carry "
+                                f"leaf — if it differs from the input "
+                                f"leaf's dtype the carry diverges "
+                                f"(dtype mismatch => TypeError or "
+                                f"retrace)",
+                                _GC10_HINT, fid[1]))
+        lens = {len(_carry_leaves(c)) for _r, c, _l in carries
+                if isinstance(c, (ast.Tuple, ast.List))}
+        if len(lens) > 1:
+            first = carries[0]
+            out.append(Finding(
+                "GC10", ctx.relpath, first[2], first[0].col_offset,
+                f"scan body '{fid[1]}' has returns whose carry tuples "
+                f"differ in length ({sorted(lens)}) — conditional "
+                f"carry STRUCTURE can never match a fixed input carry",
+                _GC10_HINT, fid[1]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC11 — donation-discipline
+# ---------------------------------------------------------------------------
+
+_GC11_HINT = ("donated buffers are dead after the call — rebind the "
+              "result to the same name (state = step(state, ...)) or "
+              "drop the read; hot-path step cores take donate_argnums="
+              "(0, 1) so XLA updates the tables in place")
+
+
+def gc11_donation_discipline(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    """Two halves of the buffer-donation contract. (a) a caller of a
+    ``donate_argnums``-jitted callable must not read the donated
+    argument after the call — the buffer was surrendered to XLA and may
+    alias the output. (b) ``ops/`` scannable step cores must BE donated:
+    an undonated hot-path core copies the full parameter/optimizer
+    tables every minibatch."""
+    if ctx.is_test_module():
+        return []
+    idx = project.interproc
+    if idx is None:
+        return []
+    out: List[Finding] = []
+
+    # (b) scannable(jit(core)) registrations in ops/ must donate
+    if "ops" in ctx.parts[:-1]:
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Call) and dec_name(n) == "scannable" \
+                    and n.args:
+                jc = n.args[0]
+                if is_jit_creation(jc) and not interproc._jit_call_kwargs(
+                        jc, "donate_argnums"):
+                    out.append(Finding(
+                        "GC11", ctx.relpath, n.lineno, n.col_offset,
+                        "scannable step core jitted WITHOUT "
+                        "donate_argnums — every step copies the full "
+                        "weight/optimizer tables instead of updating "
+                        "them in place (O(dims) copy per minibatch)",
+                        _GC11_HINT, ctx.qualname(n)))
+
+    # (a) read-after-donate, interprocedural through factory returns
+    resolve = project.resolver_for(ctx)
+
+    def scope_nodes(scope: ast.AST) -> List[ast.AST]:
+        nodes: List[ast.AST] = []
+        stack = list(scope.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, FUNCS + (ast.Lambda,)):
+                continue
+            nodes.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        return nodes
+
+    scopes: List[ast.AST] = [n for n in ast.walk(ctx.tree)
+                             if isinstance(n, FUNCS)]
+    for fn in scopes:
+        cls_name, self_name = _scope_identity(ctx, fn)
+        nodes = scope_nodes(fn)
+        # names bound to donation-jitted callables, with their donated
+        # positions: direct jit creations and factory-call returns
+        donated: Dict[str, Tuple[int, ...]] = {}
+        for n in nodes:
+            if not (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            tgt = [t.id for t in n.targets if isinstance(t, ast.Name)]
+            if not tgt:
+                continue
+            dp = interproc._jit_call_kwargs(n.value, "donate_argnums")
+            if is_jit_creation(n.value) and dp:
+                for t in tgt:
+                    donated[t] = dp
+            elif resolve is not None:
+                s = resolve(n.value, cls_name, self_name)
+                if s is not None and s.returns_donated:
+                    for t in tgt:
+                        donated[t] = s.returns_donated
+        if not donated:
+            continue
+        # call sites of the donated callables
+        for call in nodes:
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Name)
+                    and call.func.id in donated):
+                continue
+            positions = donated[call.func.id]
+            donated_args = [call.args[i].id for i in positions
+                            if i < len(call.args)
+                            and isinstance(call.args[i], ast.Name)]
+            if not donated_args:
+                continue
+            # result rebinding the donated name kills the hazard: the
+            # old buffer is dead AND unreachable (state = step(state,…))
+            stmt: Optional[ast.AST] = None
+            for a in ctx.ancestors(call):
+                if isinstance(a, ast.stmt):
+                    stmt = a
+                    break
+            rebound: Set[str] = set()
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        rebound.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        rebound.update(e.id for e in t.elts
+                                       if isinstance(e, ast.Name))
+            stmt_ids = {id(x) for x in ast.walk(stmt)} if stmt else set()
+            for name in donated_args:
+                if name in rebound:
+                    continue
+                later = [n for n in nodes
+                         if isinstance(n, ast.Name) and n.id == name
+                         and isinstance(n.ctx, ast.Load)
+                         and id(n) not in stmt_ids
+                         and n.lineno > call.lineno]
+                if later:
+                    hit = min(later, key=lambda n: n.lineno)
+                    out.append(Finding(
+                        "GC11", ctx.relpath, hit.lineno, hit.col_offset,
+                        f"'{name}' read after being DONATED to "
+                        f"'{call.func.id}' on line {call.lineno} — the "
+                        f"buffer was surrendered to XLA at the call and "
+                        f"may alias the output (garbage reads / "
+                        f"use-after-donate)",
+                        _GC11_HINT, ctx.qualname(hit)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC12 — resource-lifecycle (exception-path leak analysis)
+# ---------------------------------------------------------------------------
+
+_GC12_DIRS = {"serve", "io", "parallel"}
+_GC12_HINT = ("own the resource with `with` (or contextlib.closing), "
+              "close it in a finally/cleanup-and-reraise handler, or "
+              "hand it to an owner whose close()/stop() releases it; a "
+              "deliberately process-lifetime resource takes "
+              "# graftcheck: disable=GC12 with the argument on the line")
+
+#: method names that release a resource
+_RELEASE_ATTRS = {"close", "shutdown", "stop", "release", "join",
+                  "close_pool", "terminate"}
+
+#: callees that cannot realistically raise — they don't open the
+#: exception window the risky-call analysis is looking for
+_GC12_SAFE_CALLS = {"Event", "Lock", "RLock", "Condition", "Semaphore",
+                    "deque", "dict", "list", "set", "tuple", "frozenset",
+                    "OrderedDict", "defaultdict", "Counter", "Queue",
+                    "WeakKeyDictionary", "WeakValueDictionary",
+                    "int", "float", "str", "bool", "bytes", "len",
+                    "isinstance", "getattr", "hasattr", "id", "repr",
+                    "monotonic", "time", "perf_counter"}
+
+
+def _release_credits(ctx: ModuleContext, cls: ast.ClassDef) -> Set[str]:
+    """self-attributes the class provably releases somewhere:
+    ``self.X.close()`` (any release verb), loop-release ``for c in
+    self.X: c.close()``, and the swap idiom ``pool, self.X = self.X,
+    []`` followed by a release of the swapped local."""
+    credits: Set[str] = set()
+    aliases: Dict[str, str] = {}         # local name -> self attr
+    for n in ast.walk(cls):
+        if isinstance(n, ast.Assign):
+            # plain alias and the tuple-swap idiom
+            targets = n.targets[0].elts \
+                if (len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Tuple)) \
+                else n.targets
+            values = n.value.elts if isinstance(n.value, ast.Tuple) \
+                else [n.value]
+            if len(targets) == len(values):
+                for t, v in zip(targets, values):
+                    if isinstance(t, ast.Name) \
+                            and isinstance(v, ast.Attribute) \
+                            and isinstance(v.value, ast.Name) \
+                            and v.value.id == "self":
+                        aliases[t.id] = v.attr
+        elif isinstance(n, ast.For) and isinstance(n.target, ast.Name):
+            it = n.iter
+            if isinstance(it, ast.Attribute) \
+                    and isinstance(it.value, ast.Name) \
+                    and it.value.id == "self":
+                aliases[n.target.id] = it.attr
+            elif isinstance(it, ast.Name) and it.id in aliases:
+                aliases[n.target.id] = aliases[it.id]
+    for n in ast.walk(cls):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _RELEASE_ATTRS):
+            continue
+        base = n.func.value
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self":
+            credits.add(base.attr)
+        elif isinstance(base, ast.Name) and base.id in aliases:
+            credits.add(aliases[base.id])
+    return credits
+
+
+def gc12_resource_lifecycle(ctx: ModuleContext, project: ProjectIndex) \
+        -> List[Finding]:
+    """Exception-path leak analysis for socket/file/mmap/http handles in
+    serve//io//parallel/: a resource acquired outside ``with`` must be
+    released on EVERY path — close in a finally (or a cleanup-and-
+    reraise handler), or escape to an owner whose release path covers it
+    (the interprocedural ``returns_resource`` closure makes a helper
+    that returns a fresh resource count as an acquisition at its call
+    sites). Flags: acquire-then-risky-call windows where an exception
+    leaks the handle, straight-line-only closes, owner attributes no
+    release path covers, and dropped acquisition results."""
+    if not (_GC12_DIRS & set(ctx.parts[:-1])):
+        return []
+    if ctx.is_test_module():
+        return []
+    idx = project.interproc
+    resolve = project.resolver_for(ctx)
+    out: List[Finding] = []
+
+    # targeted sub-rule: `except HTTPError as e: e.read()` — the bound
+    # error owns the response socket; reading without closing leaks one
+    # fd per probe (the fleet health-probe one-shot shape)
+    for h in ast.walk(ctx.tree):
+        if not isinstance(h, ast.ExceptHandler) or h.name is None:
+            continue
+        tname = ""
+        if h.type is not None:
+            tname = h.type.attr if isinstance(h.type, ast.Attribute) \
+                else getattr(h.type, "id", "")
+        if not tname.endswith("HTTPError"):
+            continue
+        reads = [n for n in ast.walk(h)
+                 if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Attribute)
+                 and n.func.attr == "read"
+                 and isinstance(n.func.value, ast.Name)
+                 and n.func.value.id == h.name]
+        closes = [n for n in ast.walk(h)
+                  if isinstance(n, ast.Call)
+                  and isinstance(n.func, ast.Attribute)
+                  and n.func.attr in _RELEASE_ATTRS
+                  and isinstance(n.func.value, ast.Name)
+                  and n.func.value.id == h.name]
+        managed = any(
+            isinstance(w, ast.With)
+            and any(h.name in {x.id for x in ast.walk(it.context_expr)
+                               if isinstance(x, ast.Name)}
+                    for it in w.items)
+            for w in ast.walk(h))
+        if reads and not closes and not managed:
+            n = reads[0]
+            out.append(Finding(
+                "GC12", ctx.relpath, n.lineno, n.col_offset,
+                f"HTTPError '{h.name}' body read without closing the "
+                f"response — the error object owns the probe socket; "
+                f"every handled error leaks one fd",
+                _GC12_HINT, ctx.qualname(n)))
+
+    class_credits: Dict[int, Set[str]] = {}
+
+    def credits_for(cls: Optional[ast.AST]) -> Set[str]:
+        if not isinstance(cls, ast.ClassDef):
+            return set()
+        got = class_credits.get(id(cls))
+        if got is None:
+            got = _release_credits(ctx, cls)
+            class_credits[id(cls)] = got
+        return got
+
+    for fn in (n for n in ast.walk(ctx.tree) if isinstance(n, FUNCS)):
+        cls_name, self_name = _scope_identity(ctx, fn)
+        cls_node = None
+        for a in ctx.ancestors(fn):
+            if isinstance(a, ast.ClassDef):
+                cls_node = a
+                break
+        nodes: List[ast.AST] = []
+        stack = list(fn.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, FUNCS + (ast.Lambda,)):
+                continue
+            nodes.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+
+        def acquisition_kind(call: ast.Call) -> Optional[str]:
+            kind = interproc.is_acquisition(call)
+            if kind is not None:
+                return kind
+            if resolve is not None:
+                s = resolve(call, cls_name, self_name)
+                if s is not None and s.returns_resource:
+                    return s.returns_resource
+            return None
+
+        # exception-protection map: statements inside a Try whose
+        # finalbody OR cleanup-and-reraise handler releases name X —
+        # releases on self attributes count as "any" protection (the
+        # __init__ close-and-reraise pattern releases self.<attr>, not
+        # a local)
+        def protected_names(n: ast.AST) -> Set[str]:
+            names: Set[str] = set()
+            for a in ctx.ancestors(n):
+                if a is fn:
+                    break
+                if not isinstance(a, ast.Try):
+                    continue
+                regions = list(a.finalbody)
+                for h in a.handlers:
+                    if any(isinstance(x, ast.Raise)
+                           for x in ast.walk(h)):
+                        regions.extend(h.body)
+                for r in regions:
+                    for c in ast.walk(r):
+                        if isinstance(c, ast.Call) \
+                                and isinstance(c.func, ast.Attribute) \
+                                and c.func.attr in _RELEASE_ATTRS:
+                            base = c.func.value
+                            if isinstance(base, ast.Name):
+                                names.add(base.id)
+                            elif isinstance(base, ast.Attribute) \
+                                    and isinstance(base.value, ast.Name):
+                                names.add(f"{base.value.id}.{base.attr}")
+                                names.add("<any-self-release>")
+            return names
+
+        for call in nodes:
+            if not isinstance(call, ast.Call):
+                continue
+            kind = acquisition_kind(call)
+            if kind is None:
+                continue
+            p = ctx.parent(call)
+            # `with acquire() as x:` / `with closing(acquire()):`
+            if isinstance(p, ast.withitem):
+                continue
+            if isinstance(p, ast.Call) and call in p.args:
+                gp = ctx.parent(p)
+                if isinstance(gp, ast.withitem):
+                    continue             # with closing(acquire()):
+                continue                 # handed straight to a callee
+            if isinstance(p, ast.Return):
+                continue                 # ownership moves to the caller
+            if isinstance(p, ast.Expr):
+                out.append(Finding(
+                    "GC12", ctx.relpath, call.lineno, call.col_offset,
+                    f"{kind} acquired and immediately dropped — the "
+                    f"handle leaks until GC happens to collect it",
+                    _GC12_HINT, ctx.qualname(call)))
+                continue
+            # method chain on a fresh acquisition:
+            # urlopen(...).read() — never closed
+            if isinstance(p, ast.Attribute) and p.value is call:
+                out.append(Finding(
+                    "GC12", ctx.relpath, call.lineno, call.col_offset,
+                    f"{kind} acquired and used in a call chain without "
+                    f"ever being closed — wrap it in `with` "
+                    f"(one leaked handle per call)",
+                    _GC12_HINT, ctx.qualname(call)))
+                continue
+            if not isinstance(p, ast.Assign):
+                continue                 # exotic binding: degrade
+            local: Optional[str] = None
+            attr_store: Optional[str] = None
+            for t in p.targets:
+                if isinstance(t, ast.Name):
+                    local = t.id
+                elif isinstance(t, ast.Tuple):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name) \
+                                and not e.id.startswith("_"):
+                            local = e.id
+                            break
+                elif isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in (self_name, "self"):
+                    attr_store = t.attr
+
+            risky_after = [
+                n for n in nodes
+                if isinstance(n, ast.Call) and n.lineno > call.lineno
+                and dec_name(n) not in _GC12_SAFE_CALLS
+                and not (isinstance(n.func, ast.Attribute)
+                         and isinstance(n.func.value, ast.Name)
+                         and n.func.value.id == local
+                         and n.func.attr in _RELEASE_ATTRS)]
+
+            if attr_store is not None and local is None:
+                # self.X = acquire(): in __init__ a later raising call
+                # drops the partially-built object WITH the live handle
+                # (the constructor's caller never gets a reference to
+                # close); elsewhere the owner holds it — check the class
+                # has a release path for the attribute at all
+                if fn.name == "__init__":
+                    unprot = [n for n in risky_after
+                              if not ({f"self.{attr_store}",
+                                       "<any-self-release>"}
+                                      & protected_names(n))]
+                    if unprot:
+                        hit = min(unprot, key=lambda n: n.lineno)
+                        out.append(Finding(
+                            "GC12", ctx.relpath, call.lineno,
+                            call.col_offset,
+                            f"{kind} stored on self.{attr_store} in "
+                            f"__init__ with raising-capable calls after "
+                            f"it (line {hit.lineno}) — an exception "
+                            f"mid-constructor drops the object and "
+                            f"leaks the handle (close-and-reraise "
+                            f"needed)",
+                            _GC12_HINT, ctx.qualname(call)))
+                elif attr_store not in credits_for(cls_node):
+                    out.append(Finding(
+                        "GC12", ctx.relpath, call.lineno,
+                        call.col_offset,
+                        f"{kind} stored on self.{attr_store} but no "
+                        f"method of the class ever releases it "
+                        f"(no self.{attr_store}.close()/stop()/"
+                        f"loop-release found)",
+                        _GC12_HINT, ctx.qualname(call)))
+                continue
+            if local is None:
+                continue
+
+            # local-bound resource: classify every later use
+            with_managed = any(
+                isinstance(w, ast.With)
+                and any((isinstance(it.context_expr, ast.Name)
+                         and it.context_expr.id == local)
+                        or any(isinstance(x, ast.Name) and x.id == local
+                               for x in ast.walk(it.context_expr))
+                        for it in w.items)
+                for w in nodes if isinstance(w, ast.With))
+            if with_managed:
+                continue
+            exception_protected = False
+            plain_close: Optional[int] = None
+            for n in nodes:
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in _RELEASE_ATTRS \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == local:
+                    for a in ctx.ancestors(n):
+                        if a is fn:
+                            break
+                        if isinstance(a, ast.Try) and (
+                                any(n in ast.walk(x)
+                                    for x in a.finalbody)
+                                or any(n in ast.walk(h) and
+                                       any(isinstance(x, ast.Raise)
+                                           for x in ast.walk(h))
+                                       for h in a.handlers)):
+                            exception_protected = True
+                            break
+                    if plain_close is None or n.lineno < plain_close:
+                        plain_close = n.lineno
+            if exception_protected:
+                continue
+            escape_line: Optional[int] = None
+            escapes_self: Optional[str] = None
+            for n in nodes:
+                if isinstance(n, ast.Assign):
+                    stores = [t for t in n.targets
+                              if isinstance(t, ast.Attribute)]
+                    srcs = {x.id for x in ast.walk(n.value)
+                            if isinstance(x, ast.Name)}
+                    if local in srcs and stores:
+                        escape_line = n.lineno if escape_line is None \
+                            else min(escape_line, n.lineno)
+                        t0 = stores[0]
+                        if isinstance(t0.value, ast.Name) \
+                                and t0.value.id in (self_name, "self"):
+                            escapes_self = t0.attr
+                elif isinstance(n, (ast.Return, ast.Yield)):
+                    # ownership transfers only when the HANDLE itself is
+                    # returned (bare, or as a tuple/list element) —
+                    # `return c.recv(4)` is a use, not a transfer
+                    v = getattr(n, "value", None)
+                    elems = [v] + (list(v.elts) if isinstance(
+                        v, (ast.Tuple, ast.List)) else [])
+                    if any(isinstance(e, ast.Name) and e.id == local
+                           for e in elems):
+                        escape_line = n.lineno if escape_line is None \
+                            else min(escape_line, n.lineno)
+                elif isinstance(n, ast.Call) and n.lineno > call.lineno:
+                    f = n.func
+                    own_method = (isinstance(f, ast.Attribute)
+                                  and isinstance(f.value, ast.Name)
+                                  and f.value.id == local)
+                    args_all = list(n.args) + [k.value
+                                               for k in n.keywords]
+                    if not own_method and any(
+                            isinstance(x, ast.Name) and x.id == local
+                            for a in args_all for x in ast.walk(a)):
+                        escape_line = n.lineno if escape_line is None \
+                            else min(escape_line, n.lineno)
+            if escape_line is not None:
+                # ownership transfers at the escape — but every
+                # raising-capable call BETWEEN acquire and escape runs
+                # while this frame is the only owner
+                window = [n for n in risky_after
+                          if n.lineno < escape_line
+                          and local not in protected_names(n)]
+                if window:
+                    hit = min(window, key=lambda n: n.lineno)
+                    out.append(Finding(
+                        "GC12", ctx.relpath, call.lineno,
+                        call.col_offset,
+                        f"{kind} '{local}' escapes on line "
+                        f"{escape_line} but raising-capable calls run "
+                        f"before the handoff (line {hit.lineno}) — an "
+                        f"exception in the window leaks the handle "
+                        f"(close-and-reraise needed)",
+                        _GC12_HINT, ctx.qualname(call)))
+                continue
+            if plain_close is not None:
+                window = [n for n in risky_after
+                          if n.lineno < plain_close
+                          and local not in protected_names(n)]
+                if window:
+                    hit = min(window, key=lambda n: n.lineno)
+                    out.append(Finding(
+                        "GC12", ctx.relpath, call.lineno,
+                        call.col_offset,
+                        f"{kind} '{local}' closed only on the straight-"
+                        f"line path (line {plain_close}) — an exception "
+                        f"in a call before it (line {hit.lineno}) "
+                        f"leaks the handle (use try/finally or with)",
+                        _GC12_HINT, ctx.qualname(call)))
+                continue
+            out.append(Finding(
+                "GC12", ctx.relpath, call.lineno, call.col_offset,
+                f"{kind} '{local}' acquired but never closed, escaped "
+                f"to an owner, or managed by with/finally on any path",
+                _GC12_HINT, ctx.qualname(call)))
+    return out
+
+
 #: rule registry: code -> (function, one-line description)
 RULES = {
     "GC01": (gc01_retrace_hazard,
@@ -1284,14 +2021,37 @@ RULES = {
     "GC08": (gc08_thread_lifecycle,
              "thread-lifecycle: long-running threads whose shutdown "
              "path lacks join/poison-pill"),
+    "GC09": (gc09_tracer_safety,
+             "tracer-safety: np/cast/item/branch concretization of "
+             "parameters reachable from a jit/scan/shard_map root"),
+    "GC10": (gc10_carry_stability,
+             "carry-stability: lax.scan bodies whose returned carry "
+             "can diverge from the input pytree structure/dtype"),
+    "GC11": (gc11_donation_discipline,
+             "donation-discipline: reads of donated buffers after the "
+             "call + undonated ops/ scannable step cores"),
+    "GC12": (gc12_resource_lifecycle,
+             "resource-lifecycle: socket/file/mmap/http handles that "
+             "leak on exception paths in serve//io//parallel/"),
 }
 
 
-def run_rules(ctx: ModuleContext, project: ProjectIndex) -> List[Finding]:
+def run_rules(ctx: ModuleContext, project: ProjectIndex,
+              rule_wall: Optional[Dict[str, float]] = None) \
+        -> List[Finding]:
+    """Run every rule on one module. ``rule_wall`` accumulates per-rule
+    wall seconds across calls (the --json-out CI breakdown that keeps
+    the <=30 s budget honest as rules are added)."""
+    import time as _time
     findings: List[Finding] = []
     seen: Set[Tuple[str, int, str]] = set()
     for code, (fn, _desc) in RULES.items():
-        for f in fn(ctx, project):
+        t0 = _time.perf_counter() if rule_wall is not None else 0.0
+        got = fn(ctx, project)
+        if rule_wall is not None:
+            rule_wall[code] = rule_wall.get(code, 0.0) \
+                + (_time.perf_counter() - t0)
+        for f in got:
             # nested provider closures can satisfy an associator twice
             # (the closure AND its enclosing method) — one finding per
             # (line, code, message) is enough

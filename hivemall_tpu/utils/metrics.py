@@ -198,6 +198,16 @@ def get_stream() -> MetricsStream:
     return _stream
 
 
+def close_stream() -> None:
+    """Close and unbind the process-wide stream (smoke/driver teardown —
+    the leaktrack census counts a still-open sink as a leak once its
+    run is over). The next :func:`get_stream` re-binds from the env."""
+    global _stream
+    if _stream is not None:
+        _stream.close()
+        _stream = None
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: Optional[str] = None):
     """jax.profiler trace context; no-op when log_dir is falsy."""

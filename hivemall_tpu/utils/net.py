@@ -1,0 +1,20 @@
+"""Stdlib-only network probe helpers.
+
+Lives OUTSIDE serve/ on purpose: the smokes import this at module level,
+and anything imported before ``tsan.maybe_enable()`` /
+``leaktrack.maybe_enable()`` run must not construct locks or other
+sanitizer-visible state (the serve/obs import chain does).
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+__all__ = ["http_get"]
+
+
+def http_get(url: str, timeout: float = 10.0) -> bytes:
+    """One-shot GET that closes its response socket on every path
+    (GC12) — the shared probe helper for the serve smokes."""
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()

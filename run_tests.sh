@@ -1,26 +1,31 @@
 #!/bin/sh
-# CI artifacts (graftcheck JSON report, tsan race log) land here; a
-# fresh run starts from a clean slate so stale races can't confuse a
-# read of the artifacts.
+# CI artifacts (graftcheck JSON report, tsan race log, leaktrack census
+# log) land here; a fresh run starts from a clean slate so stale
+# records can't confuse a read of the artifacts.
 mkdir -p artifacts
 rm -f artifacts/graftcheck_report.json artifacts/tsan_races.jsonl \
-      artifacts/retrain_smoke.json
+      artifacts/leaktrack_census.jsonl artifacts/retrain_smoke.json
 
 # graftcheck gate (docs/STATIC_ANALYSIS.md): project-invariant static
-# analysis, run FIRST because it is the cheapest phase (~15 s budget
-# <=30 s, AST-only). --selfcheck proves the gate in three directions
-# before the real scan — every rule (incl. the interprocedural
-# GC01/GC02/GC04 upgrades and GC07/GC08) must fire on a seeded
-# violation in a scratch tree, the baseline machinery must silence
-# fresh findings / flag stale entries, and the tsan lockset sanitizer
-# must detect the re-seeded PR 11 last_reload_error race — then the
-# real scan (package + tests/ + bench.py + graft entry; content-hash
-# cached, whole-scan invalidation on any edit or rule bump) fails on
-# ANY finding (the tree's contract since PR 11 is an EMPTY baseline; a
-# PR that must land with debt commits graftcheck_baseline.json, which
-# the bare run picks up from the repo root, and the gate keeps failing
-# once a baselined finding is fixed but its entry lingers). The full
-# JSON report is emitted as a CI artifact.
+# analysis, run FIRST because it is the cheapest phase (~17 s cold /
+# <2 s cached, budget <=30 s — the parse/summary AND rule passes fan
+# across cores, 2-CPU container floor; per-rule wall breakdown lands
+# in the JSON artifact). --selfcheck proves the gate in four
+# directions before the real scan — every rule (incl. the
+# interprocedural GC01/GC02/GC04 upgrades, GC07/GC08, and the v3 XLA
+# compile-contract + resource-lifecycle rules GC09-GC12) must fire on
+# a seeded violation in a scratch tree, the baseline machinery must
+# silence fresh findings / flag stale entries, the tsan lockset
+# sanitizer must detect the re-seeded PR 11 last_reload_error race,
+# and the leaktrack census sanitizer must catch a seeded fd leak —
+# then the real scan (package + tests/ + bench.py + graft entry;
+# content-hash cached, whole-scan invalidation on any edit or rule
+# bump) fails on ANY finding (the tree's contract since PR 11 is an
+# EMPTY baseline; a PR that must land with debt commits
+# graftcheck_baseline.json, which the bare run picks up from the repo
+# root, and the gate keeps failing once a baselined finding is fixed
+# but its entry lingers). The full JSON report is emitted as a CI
+# artifact.
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.tools.graftcheck --selfcheck || exit $?
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -60,8 +65,17 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # / dispatch / watch / warmup threads, and ANY write/write race fails
 # the smoke (the latency budget relaxes — a sanitizer build is never a
 # perf build; the un-instrumented budget stays pinned by bench_serve).
+# HIVEMALL_TPU_LEAKTRACK=1 additionally runs the FD/socket/thread leak
+# census (hivemall_tpu.testing.leaktrack): a snapshot at smoke start
+# must match the census after the full traffic+reload+drain+shutdown
+# cycle — any tracked resource still alive fails the smoke with its
+# creation stack appended to the JSONL artifact. The bench timed legs
+# below never enable either sanitizer (a sanitizer build is never a
+# perf build).
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
+    HIVEMALL_TPU_LEAKTRACK=1 \
+    HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
     python -m hivemall_tpu.serve.smoke || exit $?
 
 # fleet smoke (docs/SERVING.md "Fleet topology"): 2 replica PROCESSES
@@ -82,6 +96,8 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # inherit the env and append any races to the shared artifact log.
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
+    HIVEMALL_TPU_LEAKTRACK=1 \
+    HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
     python -m hivemall_tpu.serve.fleet_smoke || exit $?
 
 # promotion smoke (docs/RELIABILITY.md "Promotion and rollback"): gated
@@ -110,6 +126,8 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # result summary lands in artifacts/.
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
+    HIVEMALL_TPU_LEAKTRACK=1 \
+    HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
     python -m hivemall_tpu.serve.retrain_smoke \
     --artifact artifacts/retrain_smoke.json || exit $?
 

@@ -1,0 +1,246 @@
+"""leaktrack FD/socket/thread census sanitizer tests
+(docs/STATIC_ANALYSIS.md — GC12's dynamic twin).
+
+Same contract as the tsan tests: the sanitizer must CATCH a seeded
+leak (with the creation stack attributed), stay SILENT on the closed
+twin, restore the creation surface on disable, and emit the JSONL
+artifact records the smokes collect.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from hivemall_tpu.testing import leaktrack
+
+
+@pytest.fixture()
+def sanitizer():
+    """Enable around the test, restore and reset afterwards."""
+    was = leaktrack.enabled()
+    leaktrack.enable()
+    leaktrack.snapshot()
+    try:
+        yield leaktrack
+    finally:
+        leaktrack.reset()
+        if not was:
+            leaktrack.disable()
+
+
+def test_seeded_socket_leak_caught_with_stack(sanitizer):
+    a, b = socket.socketpair()
+    try:
+        got = leaktrack.leaks(grace_s=0.0)
+        socks = [r for r in got["tracked"] if r["kind"] == "socket"]
+        assert len(socks) == 2
+        # attribution: the creation stack names THIS test
+        assert "test_seeded_socket_leak_caught_with_stack" \
+            in socks[0]["stack"]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_closed_twin_clean(sanitizer):
+    a, b = socket.socketpair()
+    a.close()
+    b.close()
+    got = leaktrack.leaks(grace_s=0.0)
+    assert got["tracked"] == []
+
+
+def test_seeded_file_leak_caught(sanitizer, tmp_path):
+    p = tmp_path / "leak.txt"
+    p.write_text("x")                    # closed by write_text: clean
+    f = open(p)                          # noqa: SIM115 — the seeded leak
+    try:
+        got = leaktrack.leaks(grace_s=0.0)
+        files = [r for r in got["tracked"] if r["kind"] == "file"
+                 and "leak.txt" in r["repr"]]
+        assert len(files) == 1
+    finally:
+        f.close()
+    assert [r for r in leaktrack.leaks(grace_s=0.0)["tracked"]
+            if "leak.txt" in r["repr"]] == []
+
+
+def test_dropped_handle_is_gc_lag_not_leak(sanitizer, tmp_path):
+    """A handle DROPPED without close is collected by the census's own
+    gc sweep — GC lag must not read as a leak."""
+    p = tmp_path / "dropped.txt"
+    p.write_text("x")
+    open(p)                              # noqa: SIM115 — ref dropped
+    got = leaktrack.leaks(grace_s=0.0)
+    assert [r for r in got["tracked"] if "dropped.txt" in r["repr"]] == []
+
+
+def test_thread_leak_caught_and_joined_clean(sanitizer):
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="leaky-worker",
+                         daemon=True)
+    t.start()
+    try:
+        got = leaktrack.leaks(grace_s=0.0)
+        names = [r["name"] for r in got["threads"]]
+        assert "leaky-worker" in names
+        rec = next(r for r in got["threads"]
+                   if r["name"] == "leaky-worker")
+        assert "test_thread_leak" in rec["stack"]   # attribution
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    got = leaktrack.leaks(grace_s=0.0)
+    assert [r for r in got["threads"]
+            if r["name"] == "leaky-worker"] == []
+
+
+def test_thread_grace_absorbs_late_join(sanitizer):
+    """A worker still draining when the census starts must pass once it
+    exits within the grace window."""
+    t = threading.Thread(target=lambda: time.sleep(0.3),
+                         name="late-join", daemon=True)
+    t.start()
+    got = leaktrack.leaks(grace_s=3.0)
+    assert [r for r in got["threads"] if r["name"] == "late-join"] == []
+    t.join(timeout=5)
+
+
+def test_pre_snapshot_resources_exempt():
+    was = leaktrack.enabled()
+    leaktrack.enable()
+    try:
+        a, b = socket.socketpair()       # born BEFORE the snapshot
+        try:
+            leaktrack.snapshot()
+            got = leaktrack.leaks(grace_s=0.0)
+            assert got["tracked"] == []
+        finally:
+            a.close()
+            b.close()
+    finally:
+        leaktrack.reset()
+        if not was:
+            leaktrack.disable()
+
+
+def test_check_and_report_emits_jsonl(sanitizer, tmp_path, monkeypatch):
+    log = tmp_path / "census.jsonl"
+    monkeypatch.setenv(leaktrack.ENV_LOG, str(log))
+    a, b = socket.socketpair()
+    try:
+        n = leaktrack.check_and_report("unit-test")
+        assert n == 2
+        records = [json.loads(line)
+                   for line in log.read_text().splitlines()]
+        kinds = [r["kind"] for r in records]
+        assert kinds.count("socket") == 2
+        summary = next(r for r in records if r["kind"] == "summary")
+        assert summary["leaks"] == 2 and summary["label"] == "unit-test"
+    finally:
+        a.close()
+        b.close()
+    assert leaktrack.check_and_report("unit-test-clean") == 0
+
+
+def test_report_child_leaks_counts_replica_summaries(tmp_path,
+                                                     monkeypatch):
+    """The parent smoke's gate folds in replica-worker censuses: only
+    ``replica:`` summary records appended AFTER the recorded offset
+    count; the parent's own summary and pre-offset records do not."""
+    log = tmp_path / "census.jsonl"
+    monkeypatch.setenv(leaktrack.ENV_LOG, str(log))
+    stale = {"label": "replica:1 leaktrack", "kind": "summary",
+             "leaks": 9, "fd_delta": 9, "new_fds": []}
+    log.write_text(json.dumps(stale) + "\n")     # an earlier CI leg
+    off = leaktrack.log_offset()
+    assert off == len(log.read_bytes())
+    with log.open("a") as fh:
+        fh.write(json.dumps({"label": "replica:2 leaktrack",
+                             "kind": "summary", "leaks": 2,
+                             "fd_delta": 2, "new_fds": []}) + "\n")
+        fh.write(json.dumps({"label": "replica:3 leaktrack",
+                             "kind": "summary", "leaks": 0,
+                             "fd_delta": 0, "new_fds": []}) + "\n")
+        fh.write(json.dumps({"label": "fleet smoke leaktrack",
+                             "kind": "summary", "leaks": 5,
+                             "fd_delta": 5, "new_fds": []}) + "\n")
+        fh.write(json.dumps({"label": "replica:4 leaktrack",
+                             "kind": "socket", "fd": 7,
+                             "stack": "..."}) + "\n")
+    assert leaktrack.report_child_leaks(off) == 2
+    assert leaktrack.report_child_leaks(0) == 11  # stale leg included
+    monkeypatch.delenv(leaktrack.ENV_LOG)
+    assert leaktrack.log_offset() == 0
+    assert leaktrack.report_child_leaks(0) == 0
+
+
+def test_selfcheck_preserves_live_census(sanitizer):
+    """An in-process selfcheck run hands back the caller's census: the
+    snapshot object and already-tracked leaks survive it (a reset would
+    both drop real leaks and false-positive on pre-existing threads at
+    the caller's own check_and_report)."""
+    snap_before = leaktrack._snap
+    a, b = socket.socketpair()
+    try:
+        ok, detail = leaktrack.selfcheck_leak()
+        assert ok, detail
+        assert leaktrack._snap is snap_before
+        got = leaktrack.leaks(grace_s=0.0)
+        socks = [r for r in got["tracked"] if r["kind"] == "socket"]
+        assert len(socks) == 2           # the caller's leak still seen
+    finally:
+        a.close()
+        b.close()
+
+
+def test_env_negatives_stay_disabled(monkeypatch):
+    for v in ("0", "false", "False", "NO", "off", ""):
+        monkeypatch.setenv(leaktrack.ENV_FLAG, v)
+        if not leaktrack.enabled():
+            assert leaktrack.maybe_enable() is False, v
+
+
+def test_disable_restores_creation_surface():
+    was = leaktrack.enabled()
+    if was:
+        pytest.skip("sanitizer enabled by the environment")
+    orig_socket = socket.socket
+    orig_open = open
+    leaktrack.enable()
+    try:
+        assert socket.socket is not orig_socket
+    finally:
+        leaktrack.disable()
+        leaktrack.reset()
+    assert socket.socket is orig_socket
+    assert open is orig_open             # builtins restored
+
+
+def test_accept_and_create_connection_are_attributed(sanitizer):
+    """create_server/create_connection/accept all construct through the
+    module-level class — every wire socket is born tracked."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    cli = socket.create_connection(("127.0.0.1", port))
+    conn, _ = srv.accept()
+    try:
+        got = leaktrack.leaks(grace_s=0.0)
+        socks = [r for r in got["tracked"] if r["kind"] == "socket"]
+        assert len(socks) >= 3           # server + client + accepted
+    finally:
+        conn.close()
+        cli.close()
+        srv.close()
+    assert [r for r in leaktrack.leaks(grace_s=0.0)["tracked"]
+            if r["kind"] == "socket"] == []
+
+
+def test_selfcheck_leak_bidirectional():
+    ok, detail = leaktrack.selfcheck_leak()
+    assert ok, detail
+    assert "detected" in detail and "clean" in detail
