@@ -333,7 +333,9 @@ def _cmd_serve(args) -> int:
             max_batch=args.serve_max_batch,
             watch_interval=args.watch_interval,
             warmup=not args.no_warmup,
-            follow="promoted" if args.promote else "newest")
+            follow="promoted" if args.promote else "newest",
+            arena=args.serve_arena,
+            precision=args.serve_precision)
     except (FileNotFoundError, ValueError, NotImplementedError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -358,7 +360,8 @@ def _cmd_serve(args) -> int:
         shadow = ShadowBuffer(capture_raw=args.retrain)
         srv.batcher.set_tee(shadow.add, raw=args.retrain)
         gate = PromotionGate(args.algo, args.options or "",
-                             holdout=args.holdout, shadow=shadow)
+                             holdout=args.holdout, shadow=shadow,
+                             precision=args.serve_precision)
         ctrl = PromotionController(args.checkpoint_dir, gate,
                                    interval=args.watch_interval,
                                    slo=srv.slo).start()
@@ -420,11 +423,15 @@ def _cmd_serve_fleet(args) -> int:
                 "min_votes": args.retrain_min_votes,
                 "max_retrains_per_window": args.retrain_max_per_window,
             } if args.retrain else None,
+            result_cache_entries=args.router_cache,
+            result_cache_bytes=int(args.router_cache_mb * (1 << 20)),
             serve_kwargs={
                 "max_batch": args.serve_max_batch,
                 "max_delay_ms": args.serve_max_delay_ms,
                 "max_queue_rows": args.serve_max_queue,
                 "deadline_ms": args.serve_deadline_ms,
+                "precision": args.serve_precision,
+                "arena": args.serve_arena,
             }).start(wait_ready=True)
     except (FileNotFoundError, ValueError, RuntimeError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -480,7 +487,8 @@ def _cmd_promote(args) -> int:
         args.algo, args.options or "", holdout=args.holdout,
         max_logloss_increase=args.max_logloss_increase,
         max_auc_decrease=args.max_auc_decrease,
-        max_calibration_gap=args.max_calibration_gap)
+        max_calibration_gap=args.max_calibration_gap,
+        precision=args.precision)
     ctrl = PromotionController(
         args.checkpoint_dir, gate, interval=args.interval,
         promote_state="canary" if args.canary else "serving")
@@ -501,6 +509,45 @@ def _cmd_promote(args) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         ctrl.stop()
+    return 0
+
+
+def _cmd_arena(args) -> int:
+    """Publish (or inspect) a bundle's weight-arena sidecar — the
+    operator path for fleets that don't run the promotion gate (which
+    publishes automatically on every admitted candidate)."""
+    from ..catalog import lookup
+    from ..io.weight_arena import (ArenaUnsupported, arena_path,
+                                   open_arena, publish_arena)
+    ap = arena_path(args.bundle)
+    if args.status:
+        try:
+            a = open_arena(ap)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        h = dict(a.header)
+        h.pop("arrays", None)            # per-array offsets: noise here
+        print(json.dumps({"arena": ap, "mapped_bytes": a.mapped_bytes,
+                          "matches_bundle": a.matches_bundle(args.bundle),
+                          "header": h}, default=str, indent=1))
+        return 0
+    try:
+        cls = lookup(args.algo).resolve()
+        trainer = cls(args.options or "")
+        trainer.load_bundle(args.bundle)
+        path = publish_arena(args.bundle, trainer)
+    except ArenaUnsupported as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError, KeyError, FileNotFoundError) as e:
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    a = open_arena(path)
+    print(json.dumps({"published": path, "family": a.family,
+                      "precisions": list(a.precisions),
+                      "mapped_bytes": a.mapped_bytes,
+                      "step": a.step}))
     return 0
 
 
@@ -703,6 +750,29 @@ def main(argv=None) -> int:
     sv.add_argument("--no-warmup", action="store_true",
                     help="skip pre-compiling the batch-size buckets at "
                          "startup")
+    sv.add_argument("--serve-precision", default="f32",
+                    choices=("f32", "bf16", "int8"),
+                    help="scoring precision tier (docs/PERFORMANCE.md "
+                         "'Weight arena + quantized scoring'): f32 = "
+                         "the bit-exact jitted path; bf16/int8 score "
+                         "from the mmap'd weight arena's quantized "
+                         "tables (bounded score error, ~2x+ qps on CPU "
+                         "hosts, shared weight pages across replicas)")
+    sv.add_argument("--serve-arena", default="auto",
+                    choices=("auto", "off", "force"),
+                    help="weight-arena policy: auto (quantized tiers "
+                         "map the arena, f32 keeps the jitted scorer), "
+                         "off (bundle path only), force (f32 also "
+                         "scores zero-copy from the arena — ulp-level "
+                         "deviation from the jitted path)")
+    sv.add_argument("--router-cache", type=int, default=0,
+                    help="fleet mode: router-level LRU result cache "
+                         "entries for idempotent hot /predict bodies "
+                         "(0 = off); invalidated on every reload/"
+                         "promotion/rollback, bypassed during canary "
+                         "bakes")
+    sv.add_argument("--router-cache-mb", type=float, default=8.0,
+                    help="fleet mode: result-cache byte bound in MiB")
     sv.add_argument("--replicas", type=int, default=0,
                     help="fleet mode: spawn N replica processes (one "
                          "engine each) behind a health-gated router with "
@@ -860,7 +930,29 @@ def main(argv=None) -> int:
     pm.add_argument("--max-calibration-gap", type=float, default=0.15,
                     help="gate: max |mean predicted prob - positive "
                          "rate| on the holdout")
+    pm.add_argument("--precision", default="f32",
+                    choices=("f32", "bf16", "int8"),
+                    help="gate candidates at this scoring precision — "
+                         "quantized fleets must gate on the quantized "
+                         "scores they actually serve")
     pm.set_defaults(fn=_cmd_promote)
+
+    ar = sub.add_parser(
+        "arena",
+        help="publish or inspect a bundle's mmap'd weight arena "
+             "(zero-copy multi-precision serving weights; "
+             "docs/PERFORMANCE.md 'Weight arena + quantized scoring')")
+    ar.add_argument("--algo", required=True,
+                    help="catalog trainer the bundle was written by")
+    ar.add_argument("--options", default="",
+                    help="trainer options (must match training)")
+    ar.add_argument("--bundle", required=True,
+                    help="checkpoint bundle (.npz) to publish/inspect "
+                         "the arena for")
+    ar.add_argument("--status", action="store_true",
+                    help="print the existing arena's header instead of "
+                         "publishing")
+    ar.set_defaults(fn=_cmd_arena)
 
     o = sub.add_parser(
         "obs", help="summarize a HIVEMALL_TPU_METRICS jsonl stream "

@@ -556,9 +556,16 @@ class CheckpointManager:
                 kept -= 1
             except OSError:
                 continue
-            if os.path.exists(path + _REJECTED):
-                try:                    # quarantine marker dies with its
-                    os.remove(path + _REJECTED)   # bundle, never orphaned
-                except OSError:
-                    pass
+            # sidecars die with their bundle, never orphaned: the
+            # quarantine marker and the mmap'd serving arena (pinned
+            # bundles above keep theirs, so the promoted model's arena
+            # and the rollback target's survive retention). Lazy import:
+            # weight_arena imports back into io at call time only
+            from .weight_arena import ARENA_SUFFIX
+            for suffix in (_REJECTED, ARENA_SUFFIX):
+                if os.path.exists(path + suffix):
+                    try:
+                        os.remove(path + suffix)
+                    except OSError:
+                        pass
         self._bundles = kept

@@ -299,6 +299,27 @@ class LearnerBase:
         self._telemetry_every = int(self.opts.get("telemetry_every") or 0)
         self._register_obs()
 
+    @classmethod
+    def make_parser(cls, options: str = "") -> "LearnerBase":
+        """A PARSE-ONLY instance: option grammar + feature hashing
+        (`_parse_row`), with ``_init_state`` skipped — no device tables,
+        no optimizer state. The serve engine's arena path uses this so a
+        replica that scores from the mmap'd weight arena never allocates
+        a dims-sized trainer just to hash request rows (the whole point
+        of zero-copy serving). Only parsing methods are usable on the
+        result; training/scoring surfaces raise AttributeError."""
+        self = object.__new__(cls)
+        self.opts = cls.spec().parse(options)
+        self.dims = int(self.opts.dims)
+        self._names = {}
+        self.mesh = None
+        self._init_parser()
+        return self
+
+    def _init_parser(self) -> None:
+        """Hook for subclasses whose ``_parse_row`` needs extra state
+        (FFM's field count). Default: nothing beyond make_parser's."""
+
     # -- subclass surface ----------------------------------------------------
     def _init_state(self) -> None:
         raise NotImplementedError

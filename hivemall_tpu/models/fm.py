@@ -480,6 +480,18 @@ class FMTrainer(LearnerBase):
                 np.float32)
         return lambda b: np.asarray(margin(b), np.float32)
 
+    def serving_tables(self):
+        """Arena extraction (io.weight_arena): the canonical (w, V)
+        split-layout f32 tables — _wv_tables already normalizes the
+        fused packed layout, so one arena family serves both."""
+        w, V = self._wv_tables()
+        meta = {"family": "fm", "k": self.k,
+                "w0": float(np.asarray(self.params["w0"],
+                                       np.float32)),
+                "classification": bool(self.classification)}
+        return meta, {"w": np.ascontiguousarray(w, np.float32),
+                      "V": np.ascontiguousarray(V, np.float32)}
+
     def _fused_rows(self):
         """Per-feature [>=dims, Wf] view of the packed fused table (device).
         Row i = feature i's [V(K) | w | pad] block — the [Np, P*Wf]
@@ -1466,6 +1478,39 @@ class FFMTrainer(FMTrainer):
                 jnp.asarray(batch.val), jnp.asarray(batch.field)))
         return np.asarray(ffm_score(p["w0"], p["w"], p["V"],
                                     batch.idx, batch.val, batch.field))
+
+    def _init_parser(self) -> None:
+        # make_parser support: FFM's _parse_row hashes field names mod F
+        self.F = int(self.opts.fields)
+
+    def serving_tables(self):
+        """Arena extraction (io.weight_arena): joint keeps the fused
+        row-hashed table (V block + the linear-weight column, pad lanes
+        dropped); dense flattens the field cube to the pair-flat [N*F, K]
+        the general scorer gathers. The ``parts`` layout's kernel-grid
+        geometry has no host-gather mapping — unsupported (the engine
+        keeps the bundle path; docs/PERFORMANCE.md "when NOT to
+        quantize")."""
+        from ..io.weight_arena import ArenaUnsupported
+        p = self.params
+        cls = bool(self.classification)
+        w0 = float(np.asarray(p["w0"], np.float32))
+        if self.layout == "joint":
+            T = np.asarray(p["T"].astype(jnp.float32))
+            return ({"family": "ffm_joint", "F": self.F, "k": self.k,
+                     "Mr": int(T.shape[0]), "w0": w0,
+                     "classification": cls},
+                    {"T": np.ascontiguousarray(
+                        T[:, :self.F * self.k + 1])})
+        if self.layout == "dense":
+            V = np.asarray(p["V"].astype(jnp.float32))
+            return ({"family": "ffm_dense", "F": self.F, "k": self.k,
+                     "w0": w0, "classification": cls},
+                    {"w": np.asarray(p["w"].astype(jnp.float32)),
+                     "V2": np.ascontiguousarray(
+                         V.reshape(-1, self.k))})
+        raise ArenaUnsupported(
+            f"-ffm_table {self.layout} has no weight-arena mapping")
 
     def _wants_fit_ds(self) -> bool:
         # emission needs observed pairs

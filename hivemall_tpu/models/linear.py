@@ -131,6 +131,15 @@ class _LinearLearner(LearnerBase):
     def predict_proba(self, ds: SparseDataset) -> np.ndarray:
         return _sigmoid(self.decision_function(ds))
 
+    def serving_tables(self):
+        """Arena extraction (io.weight_arena): the ONE finalized f32
+        inference table — optimizer finalization (RDA truncation etc.)
+        baked in, exactly what _make_margin_fn captures."""
+        meta = {"family": "linear", "w0": 0.0,
+                "classification": bool(self.CLASSIFICATION)}
+        return meta, {"w": np.asarray(self._finalized_weights(),
+                                      np.float32)}
+
 
 class GeneralClassifier(_LinearLearner):
     """SQL: train_classifier — reference hivemall.classifier.GeneralClassifierUDTF."""

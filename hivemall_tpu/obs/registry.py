@@ -104,14 +104,16 @@ SLO_STUB = {"configured": False, "samples": 0, "target_p99_ms": None,
 #: serve.fleet.ReplicaManager.obs_section()
 FLEET_STUB = {"replicas": 0, "ready": 0, "respawns": 0, "rolls": 0,
               "roll_failures": 0, "rejected_bundles": 0,
-              "fleet_step": None, "model_steps": {}}
+              "fleet_step": None, "model_steps": {},
+              "replica_rss_bytes": {}, "arena_mapped_bytes": {}}
 #: serve.promote.PromotionController.obs_section() /
 #: serve.fleet.ReplicaManager.promotion_section() in their inactive form
 #: (copy via serve.promote.promotion_stub — the nested canary dict must
 #: not be shared mutable state)
 PROMOTION_STUB = {"configured": False, "promoted_step": None,
                   "state": None, "candidates": 0, "gate_passes": 0,
-                  "gate_failures": 0, "promotions": 0, "rollbacks": 0,
+                  "gate_failures": 0, "arena_published": 0,
+                  "promotions": 0, "rollbacks": 0,
                   "quarantined": 0,
                   "canary": {"active": False, "step": None, "cohort": 0,
                              "age_seconds": None},

@@ -7,7 +7,11 @@ as a live server, in the spirit of Clipper-style prediction serving.
 
   engine.PredictEngine   — model lifecycle: load a checkpoint bundle,
                            bucketed jitted predict (bounded recompiles,
-                           warmup), hot-reload on newer autosaved bundles
+                           warmup), hot-reload on newer autosaved bundles;
+                           zero-copy quantized tiers (precision=bf16|int8)
+                           score from the mmap'd io.weight_arena sidecar —
+                           N replicas share one set of weight pages
+                           (docs/PERFORMANCE.md "Weight arena")
   batcher.MicroBatcher   — dynamic micro-batching: coalesce concurrent
                            requests, per-request deadlines, fail-fast
                            load shedding on a bounded queue

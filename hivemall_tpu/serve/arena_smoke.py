@@ -1,0 +1,284 @@
+"""Weight-arena smoke — run by run_tests.sh (docs/PERFORMANCE.md
+"Weight arena + quantized scoring").
+
+The acceptance surface of zero-copy quantized serving, seconds-scale, on
+real replica PROCESSES under live traffic:
+
+1. promotion PUBLISHES the arena: the bootstrap gate pass writes
+   ``<bundle>.npz.arena`` next to the candidate before any replica
+   boots;
+2. both replicas of an int8 fleet serve off that arena WITHOUT
+   publishing their own (``arena.publishes == 0`` per replica) and map
+   THE SAME INODE — verified host-side via ``/proc/<pid>/maps`` — with
+   per-replica ``host_rss_bytes``/``arena_mapped_bytes`` gauges live on
+   ``/healthz`` and the fleet snapshot;
+3. quantized scores stay within the documented int8 bound of the
+   offline f32 scores;
+4. the router result cache: an identical repeated body is served from
+   the cache (hit counter + ``x-hivemall-cache: hit``), and a
+   promotion-driven rolling reload INVALIDATES it — the repeat after
+   the roll carries the NEW model step;
+5. the roll itself (gate → canary → full fleet) converges onto the new
+   arena with ZERO failed requests, and graftcheck/leaktrack stay clean
+   (run_tests.sh wires the sanitizer env like the other serve smokes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+from ..utils.net import http_get as _http_get
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="hivemall_tpu.serve.arena_smoke")
+    ap.add_argument("--rows", type=int, default=300)
+    ap.add_argument("--replicas", type=int, default=2)
+    args = ap.parse_args(argv)
+    tmp = tempfile.mkdtemp(prefix="hivemall_tpu_arena_smoke_")
+    try:
+        return _run(args, tmp)
+    finally:
+        from ..utils.metrics import close_stream
+        close_stream()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _train_candidate(ckdir, opts, ds, bump=0):
+    from ..io.checkpoint import promoted_bundle
+    from ..models.linear import GeneralClassifier
+    t = GeneralClassifier(opts)
+    pb = promoted_bundle(ckdir, t.NAME)
+    if pb is not None:
+        t.load_bundle(pb[1])
+    t.fit(ds)
+    t._t += bump
+    path = os.path.join(ckdir, f"{t.NAME}-step{t._t:010d}.npz")
+    t.save_bundle(path)
+    return t, path
+
+
+def _mapped_inode(pid: int, arena_file: str):
+    """The ``dev:inode`` a process's maps show for ``arena_file``, or
+    None — the host-side proof that replicas share ONE mapping."""
+    try:
+        with open(f"/proc/{pid}/maps") as f:
+            for line in f:
+                if line.rstrip().endswith(arena_file):
+                    parts = line.split()
+                    return (parts[3], parts[4])   # dev, inode
+    except OSError:
+        pass
+    return None
+
+
+def _run(args, tmp) -> int:
+    from ..io import checkpoint as ck
+    from ..io.libsvm import synthetic_classification
+    from ..io.weight_arena import arena_path, open_arena
+    from ..serve.fleet import Fleet
+    from ..serve.http import KeepAliveClient
+    from ..serve.promote import PromotionController, PromotionGate
+
+    failures = []
+
+    def check(label, ok, detail=""):
+        print(f"arena smoke {label}: {'OK' if ok else 'FAILED'} "
+              f"{detail}", file=sys.stderr)
+        if not ok:
+            failures.append(label)
+
+    def wait_for(cond, timeout=90.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.2)
+        return False
+
+    opts = "-dims 4096 -loss logloss -opt adagrad -mini_batch 64"
+    ds, _ = synthetic_classification(args.rows, 200, seed=11)
+
+    # -- 1. promotion publishes the arena ---------------------------------
+    trainer, pA = _train_candidate(tmp, opts, ds)
+    gate0 = PromotionGate("train_classifier", opts, holdout=ds,
+                          precision="int8")
+    report = PromotionController(tmp, gate0).check_once()
+    apA = arena_path(pA)
+    check("promotion_publishes_arena",
+          bool(report and report["promoted"]) and os.path.exists(apA)
+          and open_arena(apA).matches_bundle(pA)
+          and gate0.arena_published >= 1,
+          f"(arena {os.path.basename(apA)}, "
+          f"published {gate0.arena_published})")
+    stepA = trainer._t
+    name = trainer.NAME
+
+    rows = []
+    for i in range(64):
+        idx, val = ds.row(i % args.rows)
+        rows.append([f"{int(a)}:{float(v)!r}" for a, v in zip(idx, val)])
+
+    fleet = Fleet(
+        "train_classifier", opts, checkpoint_dir=tmp,
+        replicas=args.replicas,
+        watch_interval=0.3, health_interval=0.2,
+        promote=True, holdout=ds,
+        canary_fraction=0.5, canary_bake_s=1.5,
+        bake_opts={"min_requests": 3},
+        result_cache_entries=256,
+        serve_kwargs={"max_batch": 64, "max_delay_ms": 3.0,
+                      "max_queue_rows": 4096,
+                      "warmup_len": max(len(r) for r in rows),
+                      "precision": "int8"})
+    t0 = time.monotonic()
+    fleet.start(wait_ready=True, timeout=180.0)
+    print(f"arena smoke: {args.replicas} int8 replicas ready in "
+          f"{time.monotonic() - t0:.1f}s on port {fleet.port}",
+          file=sys.stderr)
+    try:
+        return _drive(args, tmp, ds, rows, fleet, stepA, name, opts,
+                      ck, KeepAliveClient, check, wait_for, failures,
+                      arena_path)
+    finally:
+        fleet.stop()
+
+
+def _drive(args, tmp, ds, rows, fleet, stepA, name, opts, ck,
+           KeepAliveClient, check, wait_for, failures,
+           arena_path) -> int:
+    host, port = "127.0.0.1", fleet.port
+    mgr = fleet.manager
+    import numpy as np
+
+    # live traffic for the WHOLE run: every phase must cost zero failures
+    stop = threading.Event()
+    traffic_errs = []
+    traffic_n = [0]
+
+    def traffic():
+        cli = KeepAliveClient(host, port)
+        i = 0
+        while not stop.is_set():
+            try:
+                code, r = cli.post_json(
+                    "/predict", {"rows": [rows[i % len(rows)]]})
+                if code != 200:
+                    traffic_errs.append(f"status {code}: {r}")
+            except Exception as e:     # noqa: BLE001 — collected
+                traffic_errs.append(str(e))
+            i += 1
+            traffic_n[0] += 1
+        cli.close()
+
+    tt = [threading.Thread(target=traffic) for _ in range(3)]
+    for t in tt:
+        t.start()
+    time.sleep(0.3)
+
+    # -- 2. both replicas map the SAME arena inode, zero self-publishes ---
+    pb = ck.promoted_bundle(tmp, name)
+    arena_file = arena_path(pb[1])
+    inodes = {r.rid: _mapped_inode(r.proc.pid, arena_file)
+              for r in mgr.replicas()}
+    vals = set(inodes.values())
+    check("replicas_map_same_inode",
+          len(inodes) == args.replicas and None not in vals
+          and len(vals) == 1, f"({inodes})")
+    snap = json.loads(_http_get(f"http://{host}:{port}/snapshot"))
+    per = snap["fleet"]["replicas"]
+    arena_secs = [sec.get("arena") or {} for sec in per.values()]
+    mapped = {a.get("mapped_bytes") for a in arena_secs}
+    check("arena_gauges_live",
+          len(per) == args.replicas
+          and all(a.get("active") for a in arena_secs)
+          and len(mapped) == 1 and 0 not in mapped
+          and all(a.get("publishes") == 0 for a in arena_secs)
+          and all((sec.get("host_rss_bytes") or 0) > 0
+                  for sec in per.values()),
+          f"(mapped {mapped}, publishes "
+          f"{[a.get('publishes') for a in arena_secs]})")
+    agg = snap["fleet"]["aggregate"]
+    check("aggregate_gauges",
+          agg.get("arena_mapped_bytes_unique", 0) > 0
+          and agg.get("arena_mapped_bytes", 0)
+          == args.replicas * agg["arena_mapped_bytes_unique"]
+          and agg.get("host_rss_bytes", 0) > 0,
+          f"(agg mapped {agg.get('arena_mapped_bytes')}, unique "
+          f"{agg.get('arena_mapped_bytes_unique')})")
+    fl = snap["fleet"]["manager"]
+    check("fleet_section_gauges",
+          len(fl.get("arena_mapped_bytes") or {}) == args.replicas
+          and all(v for v in fl["arena_mapped_bytes"].values())
+          and all(v for v in (fl.get("replica_rss_bytes")
+                              or {}).values()),
+          f"({fl.get('arena_mapped_bytes')})")
+
+    # -- 3. quantized scores within the documented bound ------------------
+    from ..models.linear import GeneralClassifier
+    ref_t = GeneralClassifier(opts)
+    ref_t.load_bundle(pb[1])
+    ref = np.asarray(ref_t.predict_proba(ds)[:8], np.float64)
+    cli = KeepAliveClient(host, port)
+    code, resp = cli.post_json("/predict", {"rows": rows[:8]})
+    got = np.asarray(resp["scores"], np.float64)
+    # int8 probability error <= margin bound / 4 (sigmoid Lipschitz);
+    # at this table scale a loose absolute 0.05 covers every row
+    check("int8_scores_in_bound",
+          code == 200 and resp["model_step"] == stepA
+          and np.abs(got - ref).max() < 0.05,
+          f"(max err {np.abs(got - ref).max():.5f})")
+
+    # -- 4a. result cache: identical body served from cache ---------------
+    body = {"rows": [rows[0]]}
+    code1, r1 = cli.post_json("/predict", body)
+    code2, r2 = cli.post_json("/predict", body)
+    hdrs = dict(cli.last_headers)
+    cache = fleet.router.result_cache
+    check("result_cache_hit",
+          code1 == code2 == 200 and r1["scores"] == r2["scores"]
+          and cache.stats()["hits"] >= 1
+          and hdrs.get("x-hivemall-cache") == "hit",
+          f"({cache.stats()})")
+
+    # -- 5. rolling reload: gate -> canary -> converge, arena swapped -----
+    tB, pB_new = _train_candidate(tmp, opts, ds, bump=10)
+    stepB = tB._t
+    ok = wait_for(lambda: mgr.promotions >= 1 and mgr.fleet_step == stepB)
+    steps = sorted({r.model_step for r in mgr.replicas()})
+    check("rolling_reload_converges",
+          ok and steps == [stepB]
+          and os.path.exists(arena_path(pB_new)), f"(steps {steps})")
+    inodes_b = {r.rid: _mapped_inode(r.proc.pid, arena_path(pB_new))
+                for r in mgr.replicas()}
+    vals_b = set(inodes_b.values())
+    check("new_arena_mapped_same_inode",
+          None not in vals_b and len(vals_b) == 1, f"({inodes_b})")
+    check("roll_no_drops", not traffic_errs,
+          f"({len(traffic_errs)}/{traffic_n[0]}) {traffic_errs[:2]}")
+
+    # -- 4b. the roll invalidated the cache: repeat gets the NEW step -----
+    st = cache.stats()
+    code3, r3 = cli.post_json("/predict", body)
+    check("result_cache_invalidated",
+          st["invalidations"] >= 1 and code3 == 200
+          and r3["model_step"] == stepB and st["bypass"] is False,
+          f"(stats {st}, step {r3.get('model_step')})")
+    cli.close()
+    stop.set()
+    for t in tt:
+        t.join()
+
+    print(f"arena smoke: {len(failures)} failures", file=sys.stderr)
+    return len(failures)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

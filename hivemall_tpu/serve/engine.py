@@ -72,6 +72,12 @@ class _Model:
     loaded_at: float = field(default_factory=time.monotonic)
     needs_field: bool = False        # FFM-style rows carry field ids
     bundle_mtime: Optional[float] = None   # source file mtime (bundle age)
+    # zero-copy serving (io.weight_arena): the mmap'd arena this version
+    # scores from, or None for the classic trainer-scorer path. When set,
+    # ``trainer`` is a parse-only facade (LearnerBase.make_parser) — no
+    # dims-sized tables were allocated for this version
+    arena: Any = None
+    precision: str = "f32"
 
 
 class PredictEngine:
@@ -86,14 +92,36 @@ class PredictEngine:
                  watch_interval: float = 2.0,
                  warmup=True,
                  warmup_len: int = 16,
-                 follow: str = "newest"):
+                 follow: str = "newest",
+                 arena: str = "auto",
+                 precision: str = "f32"):
         from ..catalog import lookup
+        from ..io.weight_arena import PRECISIONS
         if follow not in ("newest", "promoted"):
             raise ValueError(f"unknown follow mode {follow!r} "
                              f"(newest or promoted)")
+        if arena not in ("auto", "off", "force"):
+            raise ValueError(f"unknown arena mode {arena!r} "
+                             f"(auto, off or force)")
+        if precision not in PRECISIONS:
+            raise ValueError(f"unknown serve precision {precision!r} "
+                             f"(one of {PRECISIONS})")
+        if precision != "f32" and arena == "off":
+            raise ValueError(f"precision {precision!r} needs the weight "
+                             f"arena (arena='off' only serves f32)")
         self.algo = algo
         self.options = options
         self.follow = follow
+        # zero-copy serving policy (docs/PERFORMANCE.md "Weight arena +
+        # quantized scoring"): quantized precisions ALWAYS score from the
+        # mmap'd arena; f32 keeps the trainer's jitted scorer — the
+        # numpy arena kernels are numerically equivalent but not
+        # bit-identical to XLA, and "quantization off" must bit-match
+        # the pre-arena path. arena="force" opts f32 into arena scoring
+        # too (zero-copy replicas at ulp-level score deviation).
+        self.arena_mode = arena
+        self.precision = precision
+        self._arena_scoring = (precision != "f32" or arena == "force")
         self._cls = lookup(algo).resolve()
         self.max_batch = int(max_batch)
         self.max_row_features = int(max_row_features)
@@ -114,6 +142,9 @@ class PredictEngine:
         # counters (obs `serve` section)
         self.reloads = 0
         self.reload_failures = 0
+        self.arena_loads = 0         # versions served straight off an arena
+        self.arena_publishes = 0     # arenas this engine had to publish
+        self.arena_fallbacks = 0     # arena wanted but bundle path used
         self.last_reload_error: Optional[str] = None
         # known-bad bundle memo: path -> (mtime, size, head/tail sha) —
         # the identity a skip decision is re-validated against (a file
@@ -166,23 +197,105 @@ class PredictEngine:
         return self._cls(self.options)
 
     def _load_model(self, path: str) -> _Model:
-        t = self._fresh_trainer()
-        t.load_bundle(path)            # validates format/digest/shapes
-        step = int(getattr(t, "_t", 0))
-        try:
-            mtime = os.path.getmtime(path)
-        except OSError:
-            mtime = None
-        m = _Model(t, self._wrap_scorer(t, t.make_scorer()), step, path,
-                   needs_field=self._needs_field(t), bundle_mtime=mtime)
+        if self._arena_scoring:
+            m = self._load_model_arena(path)
+        else:
+            m = self._load_model_bundle(path)
         if self._warmed_len is not None:
             # a previously warmed engine never swaps in a cold scorer: the
             # new version pre-compiles its batch buckets BEFORE the atomic
             # ref swap, so a rolling hot reload cannot spike p99 with XLA
             # compiles on the dispatch thread (usually a cache hit — the
-            # jitted predict kernels are config-cached across trainers)
+            # jitted predict kernels are config-cached across trainers;
+            # arena models have nothing to compile, the pass just touches
+            # the mapped pages)
             self._warm_model(m, self._warmed_len)
         return m
+
+    def _load_model_bundle(self, path: str) -> _Model:
+        """The classic path: deserialize the bundle into a fresh trainer
+        and score through its (jitted) scorer."""
+        t = self._fresh_trainer()
+        t.load_bundle(path)            # validates format/digest/shapes
+        step = int(getattr(t, "_t", 0))
+        m = _Model(t, self._wrap_scorer(t, t.make_scorer()), step, path,
+                   needs_field=self._needs_field(t),
+                   bundle_mtime=self._mtime(path))
+        return m
+
+    @staticmethod
+    def _mtime(path: str) -> Optional[float]:
+        try:
+            return os.path.getmtime(path)
+        except OSError:
+            return None
+
+    def _load_model_arena(self, path: str) -> _Model:
+        """The zero-copy path: mmap the digest-verified ``<bundle>.arena``
+        sidecar (published by promotion, or by this engine on first use)
+        and score through the precision tier's numpy kernels. The trainer
+        slot holds a parse-only facade — no dims-sized allocation, no
+        bundle deserialize; N replicas share ONE set of weight pages
+        through the page cache."""
+        from ..io.weight_arena import (ArenaUnsupported, arena_path,
+                                       open_arena, publish_arena)
+        ap = arena_path(path)
+        arena = None
+        if os.path.exists(ap):
+            try:
+                a = open_arena(ap)
+                # the requested tier must actually be IN the sidecar: a
+                # partial-precision arena (publish_arena's precisions
+                # kwarg) that merely digest-matches would pass here and
+                # then KeyError on every reload poll forever — treating
+                # it as a miss routes into the republish-all-tiers path
+                if a.matches_bundle(path) \
+                        and a.trainer_name == self._cls.NAME \
+                        and self.precision in a.precisions:
+                    arena = a
+            except (ValueError, OSError, KeyError):
+                pass            # stale/torn sidecar: self-healed by the
+                #                 republish below — recording it as a
+                #                 reload error would leave a standing
+                #                 false alarm on a healthy replica
+        if arena is None:
+            # no (valid) sidecar: pay the one-time bundle load HERE,
+            # publish the arena, and still serve zero-copy — a
+            # standalone quantized engine must not need a promotion
+            # pipeline to exist first
+            t = self._fresh_trainer()
+            t.load_bundle(path)
+            try:
+                arena = open_arena(publish_arena(path, t))
+                self.arena_publishes += 1
+            except (ArenaUnsupported, OSError, ValueError, KeyError) as e:
+                # quantized serving NEEDS the arena — surface the
+                # failure; force-mode f32 holds a fully loaded, servable
+                # trainer, so an unsupported family OR a publish failure
+                # (read-only model dir, disk full) degrades to the
+                # bundle path instead of killing the replica
+                if self.precision != "f32":
+                    raise
+                self.arena_fallbacks += 1
+                self.last_reload_error = \
+                    f"arena publish: {type(e).__name__}: {e}"
+                step = int(getattr(t, "_t", 0))
+                return _Model(t, self._wrap_scorer(t, t.make_scorer()),
+                              step, path, needs_field=self._needs_field(t),
+                              bundle_mtime=self._mtime(path))
+        # (no bundle-leaf validation on this path on purpose: the arena
+        # payload is sha256-verified by open_arena, and matches_bundle
+        # ties it to THIS bundle's recorded leaf digest — the bundle's
+        # own leaves are never read, which is exactly the reload-I/O win)
+        parser = self._cls.make_parser(self.options)
+        scorer = arena.scorer(self.precision)
+        self.arena_loads += 1
+        return _Model(parser,
+                      lambda b: np.asarray(scorer(b), np.float32),
+                      arena.step, path,
+                      needs_field=self._needs_field(parser),
+                      bundle_mtime=self._mtime(path),
+                      arena=arena, precision=self.precision)
 
     def _wrap_scorer(self, trainer, scorer):
         """GSPMD seam: when the trainer carries a device mesh (`-mesh
@@ -321,15 +434,19 @@ class PredictEngine:
     # -- hot reload ----------------------------------------------------------
     @property
     def model_step(self) -> int:
-        return self._model.step
+        m = self._model
+        return m.step if m is not None else -1
 
     @property
     def model_path(self) -> Optional[str]:
-        return self._model.path
+        m = self._model
+        return m.path if m is not None else None
 
     @property
-    def model_age_seconds(self) -> float:
-        return round(time.monotonic() - self._model.loaded_at, 3)
+    def model_age_seconds(self) -> Optional[float]:
+        m = self._model
+        return round(time.monotonic() - m.loaded_at, 3) \
+            if m is not None else None
 
     @property
     def bundle_age_seconds(self) -> Optional[float]:
@@ -338,10 +455,21 @@ class PredictEngine:
         long ago this process loaded it). External LBs and the fleet
         router read this off /healthz to spot a fleet stuck on an old
         bundle while training keeps publishing newer ones."""
-        mt = self._model.bundle_mtime
+        m = self._model
+        mt = m.bundle_mtime if m is not None else None
         # file mtimes are wall-clock; only wall "now" can age them
         return None if mt is None \
             else round(time.time() - mt, 3)  # graftcheck: disable=GC02
+
+    @property
+    def arena_mapped_bytes(self) -> int:
+        """Payload bytes of the mmap'd arena the serving model scores
+        from (0 on the bundle path). N replicas of one model report the
+        SAME number while sharing one set of physical pages — the
+        per-replica gauge behind the fleet's ≥4× memory-headroom claim."""
+        m = self._model
+        a = m.arena if m is not None else None
+        return int(a.mapped_bytes) if a is not None else 0
 
     @property
     def ready(self) -> bool:
@@ -429,6 +557,15 @@ class PredictEngine:
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=5)
             self._watch_thread = None
+        # release the serving model: an arena version holds mmap views
+        # of the shared weight file — a drained replica must unmap them
+        # (GC on the dropped refs) so the leaktrack census reads clean.
+        # Scoring after close() is a caller bug and raises.
+        with self._reload_lock:
+            m = self._model
+            self._model = None
+        if m is not None and m.arena is not None:
+            m.arena.release()
 
     # -- predict -------------------------------------------------------------
     def parse(self, features: Sequence[str]) -> tuple:
@@ -538,6 +675,8 @@ class PredictEngine:
         self._batcher = batcher
 
     def obs_section(self) -> dict:
+        from ..io.weight_arena import host_rss_bytes
+        m = self._model
         d = {
             "algo": self.algo,
             "follow": self.follow,
@@ -549,8 +688,22 @@ class PredictEngine:
             "reloads": self.reloads,
             "reload_failures": self.reload_failures,
             "watching": bool(self._watch_thread is not None),
+            # zero-copy serving gauges (docs/PERFORMANCE.md "Weight
+            # arena + quantized scoring"): host RSS next to the arena
+            # bytes is what makes the N-replicas-1x-weights claim
+            # measurable instead of asserted
+            "host_rss_bytes": host_rss_bytes(),
+            "precision": self.precision,
+            "arena": {
+                "active": bool(m is not None and m.arena is not None),
+                "mode": self.arena_mode,
+                "mapped_bytes": self.arena_mapped_bytes,
+                "loads": self.arena_loads,
+                "publishes": self.arena_publishes,
+                "fallbacks": self.arena_fallbacks,
+            },
         }
-        mesh = getattr(self._model.trainer, "mesh", None)
+        mesh = getattr(m.trainer, "mesh", None) if m is not None else None
         if mesh is not None:
             d["mesh"] = "dp={dp},tp={tp}".format(**dict(mesh.shape))
         if self.last_reload_error:

@@ -501,6 +501,12 @@ class ReplicaManager:
                 raise RuntimeError(
                     f"replica {r.rid} refused bundle: {out}")
             r.model_step = out.get("model_step", step)
+            # ANY replica model change invalidates the router's result
+            # cache — a cached score must never outlive the model that
+            # produced it (mid-roll the fleet is intentionally mixed;
+            # per-reload invalidation keeps the cache honest throughout)
+            if self.router is not None:
+                self.router.invalidate_result_cache()
             return True
         except Exception as e:             # noqa: BLE001 — stop the roll,
             # keep serving: every replica still runs a complete model
@@ -675,6 +681,11 @@ class ReplicaManager:
         bake.start(self._cohort_totals(canary_rs),
                    self._cohort_totals(stable_rs))
         self._canary = {"step": step, "path": path, "bake": bake}
+        if self.router is not None:
+            # a result-cache hit skips replica placement entirely — it
+            # would starve the canary cohort of the comparable traffic
+            # the bake diffs, so the cache sits out the bake
+            self.router.set_result_cache_bypass(True)
         return True
 
     def _bake_tick(self) -> bool:
@@ -685,6 +696,8 @@ class ReplicaManager:
         if not canary_rs:
             # every canary replica died/reverted: restart from manifest
             self._canary = None
+            if self.router is not None:
+                self.router.set_result_cache_bypass(False)
             return False
         self._refresh_cohort_health(canary_rs + stable_rs)
         ct = self._cohort_totals(canary_rs)
@@ -719,6 +732,8 @@ class ReplicaManager:
         get_stream().emit("promotion", bundle=os.path.basename(c["path"]),
                           step=c["step"], state="serving")
         self._canary = None
+        if self.router is not None:
+            self.router.set_result_cache_bypass(False)
         return True
 
     def _rollback(self, reason: str) -> None:
@@ -730,6 +745,8 @@ class ReplicaManager:
         reject_bundle(c["path"], reason)
         self.quarantined += 1
         self._canary = None
+        if self.router is not None:
+            self.router.set_result_cache_bypass(False)
         self._finish_rollback(reason, bundle=os.path.basename(c["path"]),
                               step=c["step"])
 
@@ -786,6 +803,16 @@ class ReplicaManager:
             "rejected_bundles": self.rejected_bundles,
             "fleet_step": self.fleet_step,
             "model_steps": {r.rid: r.model_step for r in rs},
+            # per-replica memory gauges off the cached health polls
+            # (docs/PERFORMANCE.md "Weight arena + quantized scoring"):
+            # N replicas each reporting the same arena_mapped_bytes
+            # while host RSS stays flat is the shared-pages evidence
+            "replica_rss_bytes": {
+                r.rid: (r.last_health or {}).get("host_rss_bytes")
+                for r in rs},
+            "arena_mapped_bytes": {
+                r.rid: (r.last_health or {}).get("arena_mapped_bytes")
+                for r in rs},
         }
         if self.last_error:
             d["last_error"] = self.last_error
@@ -892,6 +919,8 @@ class Fleet:
                  slo_p99_ms: float = 100.0,
                  slo_availability: float = 0.999,
                  trace_sample: float = 0.01,
+                 result_cache_entries: int = 0,
+                 result_cache_bytes: int = 8 << 20,
                  promote: bool = False,
                  holdout=None,
                  gate_opts: Optional[dict] = None,
@@ -913,14 +942,23 @@ class Fleet:
         gate = None
         if promote:
             from .promote import PromotionGate
-            gate = PromotionGate(algo, options, holdout=holdout,
-                                 **(gate_opts or {}))
+            gopts = dict(gate_opts or {})
+            # gate candidates the way the fleet will SERVE them: a
+            # quantized fleet must pass the logloss/AUC/calibration
+            # deltas on its quantized scores, not the f32 ones the
+            # replicas never serve (the quantized-candidate guardrail)
+            gopts.setdefault("precision",
+                             (serve_kwargs or {}).get("precision")
+                             or "f32")
+            gate = PromotionGate(algo, options, holdout=holdout, **gopts)
         bake = dict(bake_opts or {})
         bake.setdefault("bake_seconds", canary_bake_s)
         self.router = RouterServer(host=host, port=port, policy=policy,
                                    on_reload_cb=self._on_reload,
                                    trace_sample=trace_sample,
-                                   slo=self.slo)
+                                   slo=self.slo,
+                                   result_cache_entries=result_cache_entries,
+                                   result_cache_bytes=result_cache_bytes)
         # retrain autopilot (serve.retrain, docs/RELIABILITY.md
         # "Autonomous retraining"): consumes the SLO engine's drift
         # votes; live traffic reaches its replay buffer through a
@@ -1072,7 +1110,12 @@ def _worker(spec_json: str) -> int:
         warmup="background",
         warmup_len=opt("warmup_len", 16, int),
         # promote mode: boot from the PROMOTED pointer, not newest
-        follow=spec.get("follow") or "newest")
+        follow=spec.get("follow") or "newest",
+        # zero-copy serving (docs/PERFORMANCE.md "Weight arena"): every
+        # replica mmaps the shared arena instead of deserializing its
+        # own bundle copy; precision picks the scoring tier
+        arena=spec.get("arena") or "auto",
+        precision=spec.get("precision") or "f32")
     srv = PredictServer(
         engine,
         host=spec.get("host") or "127.0.0.1",

@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Optional
 
+from ..io.weight_arena import host_rss_bytes as _host_rss
 from ..obs.http import _Handler as _ObsHandler
 from ..obs.slo import SloEngine
 from ..obs.trace import get_tracer
@@ -218,6 +219,13 @@ class _ServeHandler(_ObsHandler):
                 "errors": b.errors,
                 "reloads": e.reloads,
                 "reload_failures": e.reload_failures,
+                # zero-copy serving gauges: the fleet manager folds
+                # these into the `fleet` registry section and the
+                # router's aggregated snapshot (host RSS + mapped arena
+                # bytes per replica = the memory-headroom evidence)
+                "host_rss_bytes": _host_rss(),
+                "arena_mapped_bytes": e.arena_mapped_bytes,
+                "precision": e.precision,
                 # cumulative SLO totals (latency histogram + score
                 # moments): the fleet manager sums these across replicas
                 # into its SLO engine every health tick

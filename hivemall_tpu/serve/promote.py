@@ -151,17 +151,17 @@ class ShadowBuffer:
         return len(self._rows)
 
 
-def _score_rows(trainer, rows: List[tuple]) -> np.ndarray:
-    """Output-space scores for parsed request rows through the trainer's
-    OFFLINE path (predict_proba / decision_function — the same kernels
-    the serve engine bit-matches)."""
+def _rows_dataset(rows: List[tuple]):
+    """Parsed request rows as a zero-label SparseDataset — the shadow
+    slice's scoring container (scored through _score_model: the
+    trainer's offline kernels at f32, the arena's quantized scorer
+    otherwise)."""
     from ..io.sparse import SparseDataset
     fields = None
     if rows and isinstance(rows[0], tuple) and len(rows[0]) == 3:
         fields = [r[2] for r in rows]
         rows = [(r[0], r[1]) for r in rows]
-    ds = SparseDataset.from_rows(rows, [0.0] * len(rows), fields=fields)
-    return _score_dataset(trainer, ds)
+    return SparseDataset.from_rows(rows, [0.0] * len(rows), fields=fields)
 
 
 def _score_dataset(trainer, ds) -> np.ndarray:
@@ -217,11 +217,33 @@ class PromotionGate:
                  score_shift_floor: float = 0.05,
                  min_shadow_rows: int = 32,
                  drift_sigma: float = 6.0,
-                 drift_warmup: int = 16):
+                 drift_warmup: int = 16,
+                 precision: str = "f32",
+                 publish_arena: bool = True):
         from ..catalog import lookup
+        from ..io.weight_arena import PRECISIONS
+        if precision not in PRECISIONS:
+            raise ValueError(f"unknown gate precision {precision!r} "
+                             f"(one of {PRECISIONS})")
         self.algo = algo
         self.options = options
         self._cls = lookup(algo).resolve()
+        # the quantized-candidate guardrail (docs/PERFORMANCE.md "Weight
+        # arena + quantized scoring"): when the fleet serves a quantized
+        # tier, the gate scores candidate AND baseline through the SAME
+        # quantized arena scorers the replicas will run — an over-error
+        # quantized candidate fails the ordinary logloss/AUC/calibration
+        # deltas and is quarantined like any other bad model
+        self.precision = precision
+        # promotion publishes the arena sidecar for every ADMITTED
+        # candidate, so replicas find it next to the bundle the instant
+        # the pointer flips (rollback repoints atomically for free: the
+        # rollback target's arena was published at ITS promotion)
+        self.publish_arena = bool(publish_arena)
+        self.arena_published = 0
+        # opened-arena memo keyed by (path, mtime_ns, size) — see
+        # _ensure_arena (one full-payload sha256 per arena, not four)
+        self._arena_memo: dict = {}
         self._holdout = holdout          # path or SparseDataset (lazy)
         self._holdout_ds = None
         self.shadow = shadow
@@ -271,6 +293,57 @@ class PromotionGate:
         history's distribution."""
         return self.calibration_watch.update(float(gap), **extra)
 
+    # -- arena + quantized scoring -------------------------------------------
+    def _ensure_arena(self, trainer, path: str):
+        """The bundle's arena sidecar, published from ``trainer`` when
+        missing or stale. Raises ArenaUnsupported for families without
+        an arena mapping — which, under a quantized gate, IS a candidate
+        failure (the fleet could not serve it at this precision).
+
+        Memoized per (arena path, mtime_ns, size): one evaluate() needs
+        the candidate's arena up to four times (existence check, holdout
+        scoring, shadow scoring, publish-on-pass) and the BASELINE's on
+        every watch tick — each open_arena is a full-payload sha256, so
+        an unmemoized gate re-hashed multi-MB arenas for nothing."""
+        from ..io.weight_arena import (arena_path, open_arena,
+                                       publish_arena)
+        ap = arena_path(path)
+        if os.path.exists(ap):
+            try:
+                st = os.stat(ap)
+                key = (ap, st.st_mtime_ns, st.st_size)
+                memo = self._arena_memo.get(ap)
+                if memo is not None and memo[0] == key:
+                    return memo[1]
+                a = open_arena(ap)
+                if a.matches_bundle(path):
+                    self._arena_memo[ap] = (key, a)
+                    return a
+            except (ValueError, OSError, KeyError):
+                pass                  # stale/corrupt: republish below
+        a = open_arena(publish_arena(path, trainer))
+        self.arena_published += 1
+        try:
+            st = os.stat(a.path)
+            self._arena_memo[ap] = ((ap, st.st_mtime_ns, st.st_size), a)
+        except OSError:
+            pass
+        return a
+
+    def _score_model(self, trainer, path: Optional[str], ds) -> np.ndarray:
+        """Output-space scores for ``ds`` the way serving will compute
+        them: the trainer's offline path at f32, the arena's quantized
+        scorer otherwise."""
+        if self.precision == "f32" or path is None:
+            return _score_dataset(trainer, ds)
+        from ..io.sparse import score_batches
+        scorer = self._ensure_arena(trainer, path).scorer(self.precision)
+        out = np.empty(len(ds), np.float64)
+        for s, b in score_batches(ds, 256):
+            nv = b.n_valid or b.batch_size
+            out[s:s + nv] = np.asarray(scorer(b), np.float64)[:nv]
+        return out
+
     # -- the gate ------------------------------------------------------------
     def evaluate(self, candidate_path: str,
                  baseline_path: Optional[str] = None) -> dict:
@@ -290,10 +363,33 @@ class PromotionGate:
             report["step"] = int(getattr(cand, "_t", report["step"] or 0))
             base = self._load(baseline_path) if baseline_path else None
             ds = self._dataset(cand)
+            if self.precision != "f32":
+                checks["precision"] = self.precision
+                # the serving tier must EXIST for this candidate even
+                # when the gate has no validation data at all (no
+                # holdout, no baseline, no shadow): an unsupported
+                # family would otherwise pass digest-only and wedge
+                # every quantized replica on reload — ArenaUnsupported
+                # raises into the candidate-unusable fail path here
+                self._ensure_arena(cand, candidate_path)
             if ds is not None:
-                self._check_holdout(cand, base, ds, checks, reasons)
+                self._check_holdout(cand, candidate_path, base,
+                                    baseline_path, ds, checks, reasons)
             if self.shadow is not None and base is not None:
-                self._check_shadow(cand, base, checks, reasons)
+                self._check_shadow(cand, candidate_path, base,
+                                   baseline_path, checks, reasons)
+            if not reasons and self.publish_arena:
+                # admitted: publish the zero-copy sidecar BEFORE the
+                # pointer can flip, so every replica's reload finds it.
+                # Families without an arena mapping skip (the engine
+                # falls back to the bundle path); under a quantized
+                # gate _score_model already required the arena, so a
+                # pass can't reach here unsupported
+                from ..io.weight_arena import ArenaUnsupported
+                try:
+                    self._ensure_arena(cand, candidate_path)
+                except ArenaUnsupported as e:
+                    checks["arena"] = f"unsupported: {e}"
             if ds is None and self.shadow is None:
                 # no validation input at all: only the load-time digest
                 # check ran — record that the gate was vacuous
@@ -321,16 +417,17 @@ class PromotionGate:
         get_stream().emit("promotion_gate", **report)
         return report
 
-    def _check_holdout(self, cand, base, ds, checks: dict,
-                       reasons: List[str]) -> None:
+    def _check_holdout(self, cand, cand_path, base, base_path, ds,
+                       checks: dict, reasons: List[str]) -> None:
         from ..frame.evaluation import auc, logloss
         cand_scores = _score_rows_finite(
-            _score_dataset(cand, ds), reasons, "holdout")
+            self._score_model(cand, cand_path, ds), reasons, "holdout")
         if cand_scores is None:
             return
         classification = getattr(cand, "classification",
                                  getattr(cand, "CLASSIFICATION", True))
-        base_scores = _score_dataset(base, ds) if base is not None else None
+        base_scores = self._score_model(base, base_path, ds) \
+            if base is not None else None
         if base_scores is not None \
                 and not np.all(np.isfinite(base_scores)):
             # a NaN-scoring BASELINE would make every delta comparison
@@ -378,17 +475,18 @@ class PromotionGate:
             self._score_shift(cand_scores, base_scores, "holdout",
                               checks, reasons)
 
-    def _check_shadow(self, cand, base, checks: dict,
-                      reasons: List[str]) -> None:
+    def _check_shadow(self, cand, cand_path, base, base_path,
+                      checks: dict, reasons: List[str]) -> None:
         rows = self.shadow.rows()
         checks["shadow_rows"] = len(rows)
         if len(rows) < self.min_shadow_rows:
             return                       # not enough mirrored traffic yet
+        ds = _rows_dataset(rows)
         cand_scores = _score_rows_finite(
-            _score_rows(cand, rows), reasons, "shadow")
+            self._score_model(cand, cand_path, ds), reasons, "shadow")
         if cand_scores is None:
             return
-        base_scores = _score_rows(base, rows)
+        base_scores = self._score_model(base, base_path, ds)
         if not np.all(np.isfinite(base_scores)):
             checks["shadow_baseline_nonfinite"] = True   # same degrade
             return                                       # as the holdout
@@ -413,6 +511,7 @@ class PromotionGate:
         return {"candidates": self.evaluations,
                 "gate_passes": self.passes,
                 "gate_failures": self.failures,
+                "arena_published": self.arena_published,
                 "last_verdict": (self.last_report or {}).get("verdict")}
 
 

@@ -141,6 +141,120 @@ class ReplicaHandle:
                 "transport_errors": self.transport_errors}
 
 
+class ResultCache:
+    """Bounded LRU of relayed ``/predict`` responses for idempotent hot
+    keys (scoring is pure: same body + same model ⇒ same bytes).
+
+    Keyed on the sha256 of the CANONICAL request body (the router is a
+    byte proxy — two serializations of "the same" request are different
+    keys, which is safe: a miss only costs the normal forward). Entries
+    hold the relayed head+payload; a hit replays them with an
+    ``x-hivemall-cache: hit`` marker spliced in, skipping the replica
+    round-trip entirely.
+
+    Invalidation is by VERSION TAG: the fleet manager bumps the tag on
+    every successful replica reload, promotion, or rollback (any event
+    that can change what a body scores to), which atomically empties the
+    cache — a stale score can never outlive the model that produced it.
+    During a canary bake the manager additionally BYPASSES the cache:
+    a hit would starve the canary cohort of exactly the traffic the
+    bake needs to compare cohorts on."""
+
+    #: bodies/payloads above these never cache (the LRU is for hot KEYS,
+    #: not a general response store)
+    MAX_BODY = 64 << 10
+    MAX_PAYLOAD = 1 << 20
+
+    def __init__(self, max_entries: int = 1024,
+                 max_bytes: int = 8 << 20):
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        from collections import OrderedDict
+        self._od: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+        self.version = 0               # bumped by invalidate()
+        self.bypass = False            # True while a canary bake runs
+
+    @staticmethod
+    def key(body: bytes) -> bytes:
+        return hashlib.sha256(body).digest()
+
+    def get(self, body: bytes) -> Optional[bytes]:
+        if self.bypass or len(body) > self.MAX_BODY:
+            return None
+        k = self.key(body)
+        with self._lock:
+            ent = self._od.get(k)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._od.move_to_end(k)
+            head, payload = ent
+            self.hits += 1
+        return head + b"x-hivemall-cache: hit\r\n\r\n" + payload
+
+    #: per-REQUEST headers never stored: a hit must not replay another
+    #: request's trace id or the original forward's hop timing breakdown
+    #: (x-hivemall-hop covers -hop and -hop-router)
+    _STRIP = (b"x-hivemall-trace:", b"x-hivemall-hop")
+
+    def put(self, body: bytes, head: bytes, payload: bytes,
+            version: Optional[int] = None) -> None:
+        """Store one relayed response. ``version`` is the cache version
+        the caller read BEFORE forwarding — a forward that was in flight
+        across an invalidate() carries the PRE-reload model's scores,
+        and storing it after the clear would serve them stale until the
+        next model change (the review-caught race); a version mismatch
+        drops the entry instead."""
+        if self.bypass or len(body) > self.MAX_BODY \
+                or len(payload) > self.MAX_PAYLOAD:
+            return
+        head = b"".join(
+            line + b"\r\n" for line in head.split(b"\r\n")
+            if line and not line.lower().startswith(self._STRIP))
+        k = self.key(body)
+        sz = len(head) + len(payload)
+        with self._lock:
+            if version is not None and version != self.version:
+                return               # model changed mid-forward: stale
+            old = self._od.pop(k, None)
+            if old is not None:
+                self._bytes -= len(old[0]) + len(old[1])
+            self._od[k] = (head, payload)
+            self._bytes += sz
+            while self._od and (len(self._od) > self.max_entries
+                                or self._bytes > self.max_bytes):
+                _, (h, p) = self._od.popitem(last=False)
+                self._bytes -= len(h) + len(p)
+
+    def invalidate(self) -> None:
+        """Model changed somewhere in the fleet: drop everything."""
+        with self._lock:
+            self._od.clear()
+            self._bytes = 0
+            self.invalidations += 1
+            self.version += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"enabled": True, "entries": len(self._od),
+                    "bytes": self._bytes, "hits": self.hits,
+                    "misses": self.misses,
+                    "invalidations": self.invalidations,
+                    "version": self.version, "bypass": self.bypass}
+
+
+#: the result_cache stats block when no cache is configured — key-for-key
+#: with ResultCache.stats() so the fleet surface is shape-stable
+_CACHE_STUB = {"enabled": False, "entries": 0, "bytes": 0, "hits": 0,
+               "misses": 0, "invalidations": 0, "version": 0,
+               "bypass": False}
+
+
 class _Ring:
     """Consistent-hash ring over replica ids (64 virtual nodes each):
     adding/removing one replica remaps only ~1/N of the key space, so a
@@ -393,11 +507,18 @@ class RouterServer:
                  forward_timeout: float = 60.0,
                  on_reload_cb=None,
                  trace_sample: float = 0.01,
-                 slo=None):
+                 slo=None,
+                 result_cache_entries: int = 0,
+                 result_cache_bytes: int = 8 << 20):
         if policy not in ("least_loaded", "hash"):
             raise ValueError(f"unknown router policy {policy!r} "
                              f"(least_loaded or hash)")
         self.policy = policy
+        # bounded LRU over relayed /predict responses (0 entries = off);
+        # the replica manager invalidates it on every model change
+        self.result_cache: Optional[ResultCache] = (
+            ResultCache(result_cache_entries, result_cache_bytes)
+            if int(result_cache_entries) > 0 else None)
         self.forward_timeout = float(forward_timeout)
         self._on_reload_cb = on_reload_cb
         # request tracing: fraction of requests the router mints a trace
@@ -492,6 +613,23 @@ class RouterServer:
         tags its spans with. Transport failures mark the replica unready
         and retry on the next one; only when every ready replica fails
         does the client see 502."""
+        cache = self.result_cache
+        cache_version = None
+        if cache is not None:
+            with self._lock:
+                fleet_up = any(h.ready for h in self._handles.values())
+            # a hit is only served while the fleet can actually serve:
+            # with zero ready replicas the cache would mask a total
+            # outage behind 200s (clients/LBs must see the 503s)
+            hit = cache.get(body) if fleet_up else None
+            if hit is not None:
+                with self._stats_lock:
+                    self.routed += 1
+                return 200, hit, None
+            # snapshot the version BEFORE placing: an invalidate() that
+            # lands while this forward is in flight must make put() a
+            # no-op (the response was computed by the pre-reload model)
+            cache_version = cache.version
         tr = self._tracer
         if trace_id is None and tr.enabled \
                 and random.random() < self.trace_sample:
@@ -522,8 +660,11 @@ class RouterServer:
                 if trace_id:
                     # the router's half of the cross-process flame
                     tr.add_span("router.forward", total_s, trace=trace_id)
-                return status, self._relay_with_hops(
-                    lines, payload, total_s), None
+                head, raw = self._relay_with_hops(lines, payload, total_s)
+                if cache is not None and status == 200:
+                    cache.put(body, head, payload,
+                              version=cache_version)
+                return status, raw, None
             except _RETRYABLE as e:
                 with h._lock:
                     h.transport_errors += 1
@@ -545,12 +686,14 @@ class RouterServer:
 
     @staticmethod
     def _relay_with_hops(lines: List[bytes], payload: bytes,
-                         total_s: float) -> bytes:
+                         total_s: float) -> tuple:
         """Rebuild the relayed response with the router's hop header
         stacked on the replica's: ``relay`` is the router + network
         share (total minus the replica-reported total), so the full
         per-hop decomposition sums to the end-to-end wall the client
-        measured at the router."""
+        measured at the router. Returns ``(head, raw)`` — ``head`` is
+        everything before the blank header terminator (what the result
+        cache stores so a hit can splice its marker in)."""
         total_ms = total_s * 1000.0
         replica_ms = 0.0
         for line in lines:
@@ -566,7 +709,8 @@ class RouterServer:
                f"relay={max(0.0, total_ms - replica_ms):.3f},"
                f"total={total_ms:.3f}\r\n").encode("ascii")
         # lines[-1] is the blank header terminator
-        return b"".join(lines[:-1]) + hdr + lines[-1] + payload
+        head = b"".join(lines[:-1]) + hdr
+        return head, head + lines[-1] + payload
 
     def _forward(self, h: ReplicaHandle, method: str, path: str,
                  body: bytes, timeout: Optional[float] = None,
@@ -640,6 +784,23 @@ class RouterServer:
             "policy": self.policy,
         }
 
+    def invalidate_result_cache(self) -> None:
+        """Drop every cached /predict response (no-op when the cache is
+        off). The replica manager calls this on ANY model change —
+        reload, promotion, rollback — so a cached score can never
+        outlive the model that produced it."""
+        if self.result_cache is not None:
+            self.result_cache.invalidate()
+
+    def set_result_cache_bypass(self, bypass: bool) -> None:
+        """Canary-bake guard: a cache hit bypasses replica placement,
+        which would starve the canary cohort of comparable traffic —
+        the manager bypasses (and empties) the cache for the bake."""
+        if self.result_cache is not None:
+            self.result_cache.bypass = bool(bypass)
+            if bypass:
+                self.result_cache.invalidate()
+
     def stats(self) -> dict:
         hs = self.replicas()
         return {
@@ -653,6 +814,9 @@ class RouterServer:
             "replicas": len(hs),
             "ready_replicas": sum(1 for h in hs if h.ready),
             "inflight": sum(h.inflight for h in hs),
+            "result_cache": (self.result_cache.stats()
+                             if self.result_cache is not None
+                             else dict(_CACHE_STUB)),
         }
 
     def merged_trace(self) -> dict:
@@ -697,19 +861,38 @@ class RouterServer:
                               "router": h.stats()}
         agg: dict = {"qps": 0.0, "rows_per_sec": 0.0, "requests": 0,
                      "rows": 0, "batches": 0, "batch_rows": 0, "shed": 0,
-                     "expired": 0, "errors": 0, "queue_depth": 0}
+                     "expired": 0, "errors": 0, "queue_depth": 0,
+                     # fleet memory view (docs/PERFORMANCE.md "Weight
+                     # arena + quantized scoring"): summed host RSS vs
+                     # summed MAPPED arena bytes — with the arena, N
+                     # replicas report N x mapped bytes here while the
+                     # page cache holds ~1x physical copy, and
+                     # arena_mapped_bytes_unique counts each distinct
+                     # arena once (the actual physical weight footprint)
+                     "host_rss_bytes": 0, "arena_mapped_bytes": 0}
         steps = []
+        arena_by_step: Dict = {}
         for sec in per.values():
             for k in ("requests", "rows", "batches", "shed", "expired",
                       "errors", "queue_depth"):
                 agg[k] += int(sec.get(k) or 0)
             agg["qps"] += float(sec.get("qps") or 0.0)
             agg["rows_per_sec"] += float(sec.get("rows_per_sec") or 0.0)
+            agg["host_rss_bytes"] += int(sec.get("host_rss_bytes") or 0)
+            a = sec.get("arena") or {}
+            mapped = int(a.get("mapped_bytes") or 0)
+            agg["arena_mapped_bytes"] += mapped
+            if mapped:
+                # replicas on one model step share ONE arena mapping;
+                # mid-roll/canary the fleet holds one arena PER distinct
+                # step — unique = sum of one size per step, not max
+                arena_by_step[sec.get("model_step")] = mapped
             agg["batch_rows"] += int(
                 round(float(sec.get("mean_batch_rows") or 0.0)
                       * int(sec.get("batches") or 0)))
             if sec.get("model_step") is not None:
                 steps.append(int(sec["model_step"]))
+        agg["arena_mapped_bytes_unique"] = sum(arena_by_step.values())
         agg["qps"] = round(agg["qps"], 1)
         agg["rows_per_sec"] = round(agg["rows_per_sec"], 1)
         agg["mean_batch_rows"] = round(

@@ -113,6 +113,25 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.serve.promote_smoke || exit $?
 
+# weight-arena smoke (docs/PERFORMANCE.md "Weight arena + quantized
+# scoring"): zero-copy quantized serving end to end — the bootstrap
+# promotion must PUBLISH the arena sidecar, a 2-replica int8 fleet must
+# serve off it with zero per-replica publishes while mapping the SAME
+# inode (verified via /proc/<pid>/maps), per-replica host-RSS +
+# arena-mapped-bytes gauges must be live on /healthz, /snapshot and the
+# fleet section, quantized scores must stay inside the documented int8
+# bound of offline f32, the router result cache must hit on a repeated
+# body and be invalidated by the promotion-driven rolling reload, and
+# the roll must converge both replicas onto the NEW arena with zero
+# failed requests. tsan + leaktrack enabled like the other serve smokes
+# (the mmap'd arena views must be released on replica drain — a leaked
+# mapping fails the census).
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
+    HIVEMALL_TPU_LEAKTRACK=1 \
+    HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
+    python -m hivemall_tpu.serve.arena_smoke || exit $?
+
 # retrain chaos smoke (docs/RELIABILITY.md "Autonomous retraining"):
 # the closed train→validate→promote→rollback loop over a 2-replica
 # fleet under live traffic — an injected label/covariate shift

@@ -120,7 +120,10 @@ def test_trainer_fit_and_score():
     lab = np.where(idx[:, 0] % 2 == 0, 1.0, -1.0).astype(np.float32)
     fld = np.tile(np.arange(L, dtype=np.int32) % F, (n, 1))
     losses = []
-    for e in range(6):
+    # 3 epochs, not 6: the planted signal converges fully inside epoch 1
+    # (loss ratio ~0.0, acc 1.0 measured) — the extra epochs were ~40s of
+    # pure wall against the 870s tier-1 cap on the 2-core container
+    for e in range(3):
         for st in range(0, n, B):
             sl = slice(st, st + B)
             batch = SparseBatch(idx[sl], (idx[sl] != 0).astype(np.float32),

@@ -266,7 +266,8 @@ def test_gate_blocks_injected_logloss_regression(trained):
     report = gate.evaluate(pGood, pA)
     assert report["verdict"] == "pass" and not report["reasons"]
     assert gate.counters() == {"candidates": 2, "gate_passes": 1,
-                               "gate_failures": 1, "last_verdict": "pass"}
+                               "gate_failures": 1, "arena_published": 1,
+                               "last_verdict": "pass"}
 
 
 def test_gate_corrupt_candidate_fails(trained):
