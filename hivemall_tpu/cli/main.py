@@ -339,7 +339,11 @@ def _cmd_serve(args) -> int:
     except (FileNotFoundError, ValueError, NotImplementedError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    srv = PredictServer(
+    if args.serve_plane == "evloop":
+        from ..serve.evloop import EvloopPredictServer as _ServerCls
+    else:
+        _ServerCls = PredictServer
+    srv = _ServerCls(
         engine, host=args.host, port=args.port,
         max_delay_ms=args.serve_max_delay_ms,
         max_queue_rows=args.serve_max_queue,
@@ -407,7 +411,7 @@ def _cmd_serve_fleet(args) -> int:
             args.algo, args.options or "",
             checkpoint_dir=args.checkpoint_dir, bundle=args.bundle,
             replicas=args.replicas, host=args.host, port=args.port,
-            policy=args.router_policy,
+            policy=args.router_policy, plane=args.serve_plane,
             watch_interval=args.watch_interval,
             slo_p99_ms=args.slo_p99_ms,
             slo_availability=args.slo_availability,
@@ -441,6 +445,7 @@ def _cmd_serve_fleet(args) -> int:
                       "algo": args.algo, "replicas": args.replicas,
                       "ready_replicas": ready,
                       "policy": args.router_policy,
+                      "plane": args.serve_plane,
                       "fleet_step": fleet.manager.fleet_step}), flush=True)
     # SIGTERM (systemd stop, docker stop, kill <pid>) must tear the fleet
     # down like Ctrl-C does — the default handler would kill this process
@@ -758,6 +763,15 @@ def main(argv=None) -> int:
                          "from the mmap'd weight arena's quantized "
                          "tables (bounded score error, ~2x+ qps on CPU "
                          "hosts, shared weight pages across replicas)")
+    sv.add_argument("--serve-plane", default="threaded",
+                    choices=("threaded", "evloop"),
+                    help="serving plane (docs/SERVING.md 'Serving "
+                         "planes'): threaded = thread-per-connection + "
+                         "MicroBatcher (default), evloop = epoll event "
+                         "loop with inline batch assembly — same "
+                         "contracts, lower per-request overhead; in "
+                         "fleet mode evloop replicas also expose a UDS "
+                         "fast path the co-located router prefers")
     sv.add_argument("--serve-arena", default="auto",
                     choices=("auto", "off", "force"),
                     help="weight-arena policy: auto (quantized tiers "

@@ -19,6 +19,13 @@ batch slot on an answer nobody is waiting for.
 Requests are never split across batches (a request's rows score
 together, on one model version); a single request larger than
 ``max_batch`` rows is admitted alone as an oversized batch.
+
+:class:`BatchPlane` is the stats/SLO/tee surface shared by BOTH serving
+planes — this threaded ``MicroBatcher`` and the event-loop inline
+assembler (``serve.evloop.InlineAssembler``).  Everything downstream
+(the obs ``serve`` section, the SLO engine's totals, the promotion
+shadow tee, the retrain replay tee) programs against the base class, so
+the planes cannot drift apart on observability or the tee contracts.
 """
 
 from __future__ import annotations
@@ -37,7 +44,7 @@ from ..obs.histo import BATCH_SIZE_BUCKETS, LATENCY_BUCKETS_S, Histogram
 from ..obs.trace import get_tracer
 from ..utils.metrics import Meter
 
-__all__ = ["MicroBatcher", "ServeOverload", "ServeDeadline"]
+__all__ = ["BatchPlane", "MicroBatcher", "ServeOverload", "ServeDeadline"]
 
 
 class ServeOverload(RuntimeError):
@@ -62,14 +69,16 @@ class _Req:
     #                                      raw-capturing tee's input)
 
 
-class MicroBatcher:
-    """Coalesce concurrent predict requests into bounded batches."""
+class BatchPlane:
+    """Counters, histograms, score moments, SLO totals and the traffic
+    tee — the plane-independent half of request batching.  Subclasses
+    own the actual coalescing machinery (queue + dispatch thread here;
+    inline assembly on the event loop in serve.evloop) and call the
+    ``_note_*`` helpers as batches score."""
 
-    def __init__(self, predict_fn, *, max_batch: int = 256,
-                 max_delay_ms: float = 2.0,
-                 max_queue_rows: Optional[int] = None,
-                 deadline_ms: float = 0.0):
-        self._predict = predict_fn
+    def _init_plane(self, max_batch: int, max_delay_ms: float,
+                    max_queue_rows: Optional[int],
+                    deadline_ms: float) -> None:
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1000.0
         self.max_queue_rows = int(max_queue_rows
@@ -77,10 +86,7 @@ class MicroBatcher:
                                   else 8 * self.max_batch)
         self.deadline_ms = float(deadline_ms)
         self._tracer = get_tracer()
-        self._cv = threading.Condition()
-        self._q: deque = deque()
         self._queued_rows = 0
-        self._closed = False
         # counters (merged into the obs `serve` section by the engine)
         self.requests = 0
         self.rows_in = 0
@@ -102,12 +108,129 @@ class MicroBatcher:
         self.score_sumsq = 0.0
         self.score_n = 0
         # traffic mirror (serve.promote.ShadowBuffer): called with each
-        # successfully scored batch's rows AFTER the request futures
-        # resolve — a shadow consumer rides the dispatch thread's tail,
-        # never the request path
+        # successfully scored batch's rows AFTER the request completions
+        # resolve — a shadow consumer rides the scoring tail, never the
+        # request path
         self._tee = None
         self._req_meter = Meter()
         self._row_meter = Meter()
+
+    @property
+    def queue_depth(self) -> int:
+        return 0
+
+    # -- scoring-side bookkeeping (called by the owning plane) ---------------
+    def _note_batch(self, n_rows: int, n_reqs: int, scores) -> None:
+        """One successfully scored batch of ``n_rows`` rows coalesced
+        from ``n_reqs`` requests."""
+        self.batches += 1
+        self.batch_rows_sum += n_rows
+        self.coalesced_sum += n_reqs
+        b = pow2_len(n_rows)
+        self.batch_hist[b] = self.batch_hist.get(b, 0) + 1
+        self.batch_size_hist.observe(n_rows)
+        self._row_meter.add(n_rows)
+        self._note_scores(scores, n_rows)
+
+    def _note_scores(self, scores, n: int) -> None:
+        sc = np.asarray(scores[:n], np.float64)
+        self.score_sum += float(sc.sum())
+        self.score_sumsq += float((sc * sc).sum())
+        self.score_n += n
+
+    def _tee_batch(self, rows: list, reqs: list) -> None:
+        """Mirror one scored batch to the installed tee. ``reqs`` need
+        ``.n`` and ``.raw`` (both planes' request records carry them)."""
+        tee = self._tee
+        if tee is None:
+            return
+        fn, want_raw = tee
+        try:                       # mirror AFTER the completions resolved:
+            if want_raw:           # zero added request latency
+                # raw strings aligned row-for-row with `rows`; requests
+                # submitted without raw pad with None so a raw-capturing
+                # consumer stays aligned
+                fn(rows, [s for r in reqs for s in
+                          (r.raw if r.raw is not None
+                           and len(r.raw) == r.n
+                           else [None] * r.n)])
+            else:
+                fn(rows)
+        except Exception:          # noqa: BLE001 — a shadow consumer
+            pass                   # must never touch the scoring path
+
+    def set_tee(self, fn, raw: bool = False) -> None:
+        """Install (or clear, with None) a traffic mirror: ``fn(rows)``
+        is called with every successfully scored batch's parsed rows off
+        the scoring tail — the promotion gate's shadow-scoring input
+        (serve.promote.ShadowBuffer.add). ``raw=True`` calls
+        ``fn(rows, raws)`` instead, where ``raws`` are the original
+        request feature strings (None-padded for requests submitted
+        without them) — the replay-buffer tee (serve.retrain)."""
+        self._tee = None if fn is None else (fn, bool(raw))
+
+    # -- stats surface -------------------------------------------------------
+    def stats(self) -> dict:
+        """JSON-ready counters for the obs ``serve`` section."""
+        return {
+            "qps": round(self._req_meter.rate, 1),
+            "rows_per_sec": round(self._row_meter.rate, 1),
+            "queue_depth": self.queue_depth,
+            "queued_rows": self._queued_rows,
+            "requests": self.requests,
+            "rows": self.rows_in,
+            "batches": self.batches,
+            "mean_batch_rows": round(
+                self.batch_rows_sum / max(1, self.batches), 2),
+            "mean_coalesced": round(
+                self.coalesced_sum / max(1, self.batches), 2),
+            "batch_hist": {str(k): v
+                           for k, v in sorted(self.batch_hist.items())},
+            "shed": self.shed,
+            "expired": self.expired,
+            "errors": self.errors,
+            # real Prometheus histogram families on /metrics
+            # (hivemall_tpu_serve_request_latency_seconds_bucket, ...)
+            "request_latency_seconds": self.latency_hist.snapshot(),
+            "batch_size_rows": self.batch_size_hist.snapshot(),
+            "score_mean": round(self.score_sum / self.score_n, 6)
+            if self.score_n else None,
+            "score_std": round(max(
+                0.0, self.score_sumsq / self.score_n
+                - (self.score_sum / self.score_n) ** 2) ** 0.5, 6)
+            if self.score_n else None,
+        }
+
+    def slo_totals(self) -> dict:
+        """Cumulative totals for the SLO engine (obs.slo): counters, the
+        latency histogram snapshot, and raw score moments — all
+        monotonic and summable across a fleet's replicas (the manager
+        aggregates each replica's copy off ``/healthz``)."""
+        return {
+            "requests": self.requests,
+            "errors": self.errors,
+            "shed": self.shed,
+            "expired": self.expired,
+            "latency": self.latency_hist.snapshot(),
+            "score_sum": round(self.score_sum, 6),
+            "score_sumsq": round(self.score_sumsq, 6),
+            "score_n": self.score_n,
+        }
+
+
+class MicroBatcher(BatchPlane):
+    """Coalesce concurrent predict requests into bounded batches."""
+
+    def __init__(self, predict_fn, *, max_batch: int = 256,
+                 max_delay_ms: float = 2.0,
+                 max_queue_rows: Optional[int] = None,
+                 deadline_ms: float = 0.0):
+        self._predict = predict_fn
+        self._init_plane(max_batch, max_delay_ms, max_queue_rows,
+                         deadline_ms)
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._closed = False
         self._thread = threading.Thread(target=self._run,
                                         name="serve-batcher", daemon=True)
         self._thread.start()
@@ -246,17 +369,7 @@ class MicroBatcher:
             scores = out
             if isinstance(out, tuple):
                 scores, meta = out
-            self.batches += 1
-            self.batch_rows_sum += len(rows)
-            self.coalesced_sum += len(live)
-            b = pow2_len(len(rows))
-            self.batch_hist[b] = self.batch_hist.get(b, 0) + 1
-            self.batch_size_hist.observe(len(rows))
-            self._row_meter.add(len(rows))
-            sc = np.asarray(scores[:len(rows)], np.float64)
-            self.score_sum += float(sc.sum())
-            self.score_sumsq += float((sc * sc).sum())
-            self.score_n += len(rows)
+            self._note_batch(len(rows), len(live), scores)
             # per-hop decomposition, shared by the batch: assembly =
             # expiry filter + row flatten, predict = the scorer call
             assemble_s = t_p0 - t_deq
@@ -271,22 +384,7 @@ class MicroBatcher:
                              "predict_s": predict_s}
                 r.fut.set_result(part if meta is None else (part, meta))
                 off += r.n
-            tee = self._tee
-            if tee is not None:
-                fn, want_raw = tee
-                try:                   # mirror AFTER the futures resolved:
-                    if want_raw:       # zero added request latency
-                        # raw strings aligned row-for-row with `rows`;
-                        # requests submitted without raw pad with None
-                        # so a raw-capturing consumer stays aligned
-                        fn(rows, [s for r in live for s in
-                                  (r.raw if r.raw is not None
-                                   and len(r.raw) == r.n
-                                   else [None] * r.n)])
-                    else:
-                        fn(rows)
-                except Exception:      # noqa: BLE001 — a shadow consumer
-                    pass               # must never touch the dispatch loop
+            self._tee_batch(rows, live)
 
     def _score_individually(self, reqs: List[_Req],
                             t_deq: Optional[float] = None) -> None:
@@ -308,10 +406,7 @@ class MicroBatcher:
                 # the fallback's requests must stay visible to the
                 # score-drift detector — a model shift coinciding with
                 # batch failures would otherwise be diluted
-                sc = np.asarray(part, np.float64)
-                self.score_sum += float(sc.sum())
-                self.score_sumsq += float((sc * sc).sum())
-                self.score_n += r.n
+                self._note_scores(part, r.n)
                 r.fut.hop = {"queue_s": (t_deq if t_deq is not None
                                          else t_p0) - r.t_enq,
                              "assemble_s": 0.0,
@@ -321,64 +416,7 @@ class MicroBatcher:
                 self.errors += 1
                 r.fut.set_exception(e)
 
-    def set_tee(self, fn, raw: bool = False) -> None:
-        """Install (or clear, with None) a traffic mirror: ``fn(rows)``
-        is called with every successfully scored batch's parsed rows off
-        the dispatch thread's tail — the promotion gate's shadow-scoring
-        input (serve.promote.ShadowBuffer.add). ``raw=True`` calls
-        ``fn(rows, raws)`` instead, where ``raws`` are the original
-        request feature strings (None-padded for requests submitted
-        without them) — the replay-buffer tee (serve.retrain)."""
-        self._tee = None if fn is None else (fn, bool(raw))
-
-    # -- stats / lifecycle ---------------------------------------------------
-    def stats(self) -> dict:
-        """JSON-ready counters for the obs ``serve`` section."""
-        return {
-            "qps": round(self._req_meter.rate, 1),
-            "rows_per_sec": round(self._row_meter.rate, 1),
-            "queue_depth": len(self._q),
-            "queued_rows": self._queued_rows,
-            "requests": self.requests,
-            "rows": self.rows_in,
-            "batches": self.batches,
-            "mean_batch_rows": round(
-                self.batch_rows_sum / max(1, self.batches), 2),
-            "mean_coalesced": round(
-                self.coalesced_sum / max(1, self.batches), 2),
-            "batch_hist": {str(k): v
-                           for k, v in sorted(self.batch_hist.items())},
-            "shed": self.shed,
-            "expired": self.expired,
-            "errors": self.errors,
-            # real Prometheus histogram families on /metrics
-            # (hivemall_tpu_serve_request_latency_seconds_bucket, ...)
-            "request_latency_seconds": self.latency_hist.snapshot(),
-            "batch_size_rows": self.batch_size_hist.snapshot(),
-            "score_mean": round(self.score_sum / self.score_n, 6)
-            if self.score_n else None,
-            "score_std": round(max(
-                0.0, self.score_sumsq / self.score_n
-                - (self.score_sum / self.score_n) ** 2) ** 0.5, 6)
-            if self.score_n else None,
-        }
-
-    def slo_totals(self) -> dict:
-        """Cumulative totals for the SLO engine (obs.slo): counters, the
-        latency histogram snapshot, and raw score moments — all
-        monotonic and summable across a fleet's replicas (the manager
-        aggregates each replica's copy off ``/healthz``)."""
-        return {
-            "requests": self.requests,
-            "errors": self.errors,
-            "shed": self.shed,
-            "expired": self.expired,
-            "latency": self.latency_hist.snapshot(),
-            "score_sum": round(self.score_sum, 6),
-            "score_sumsq": round(self.score_sumsq, 6),
-            "score_n": self.score_n,
-        }
-
+    # -- lifecycle -----------------------------------------------------------
     def close(self, drain: bool = False, timeout: float = 5.0) -> None:
         """Stop the dispatch thread. New submits fail immediately with a
         closed error in either mode; what happens to requests ALREADY
@@ -406,4 +444,3 @@ class MicroBatcher:
         for r in pending:
             r.fut.set_exception(RuntimeError("batcher closed"))
         self._thread.join(timeout=timeout)
-

@@ -1,0 +1,1506 @@
+"""Event-driven serving plane — epoll front end + inline batch assembly.
+
+The threaded plane (serve.http / serve.router ``_RouterHTTP``) spends
+~150-300µs/request on thread machinery at CI-container concurrency:
+a connection thread parses, a bounded queue + condition variable hands
+rows to the dispatch thread, a per-request Future wakes the connection
+thread back up, and the GIL arbitrates every hop.  With the int8 scorer
+at 75µs/call that machinery IS the serving ceiling (docs/PERFORMANCE.md
+"Weight arena + quantized scoring" ceiling math).
+
+This module rebuilds the request path as a single-threaded
+``selectors``/epoll event loop:
+
+- one non-blocking HTTP/1.1 state machine per connection, reusing the
+  proven method/path/Content-Length-only parse of ``_RouterHTTP``;
+- batch assembly INLINE on the loop (:class:`InlineAssembler`): ready
+  rows coalesce directly into the next scoring batch with a completion
+  callback per request — no queue handoff, no Future, no wakeup.  The
+  assembler subclasses :class:`~.batcher.BatchPlane`, so every
+  MicroBatcher contract carries over: never-split requests, deadline
+  expiry, overload shedding, per-request rescore isolation, the
+  latency/batch histograms and the shadow/replay tees;
+- the binary frame protocol (serve.wire) negotiated per-request next to
+  JSON string bodies, which bit-match;
+- an optional unix-domain-socket listener per replica so the co-located
+  router skips TCP entirely (:class:`EvRouterFrontend` prefers a
+  replica's UDS path and falls back to TCP for remote members).
+
+Threading model (the tsan lockset sanitizer gates this in CI): ALL
+per-connection and per-request state is written by the loop thread
+only.  Other threads talk to the loop exclusively through deques + a
+socketpair wakeup (cross-thread message passing, not shared mutation);
+blocking admin work (/snapshot aggregation, /reload) runs on one
+offload worker whose results post back to the loop the same way.
+
+Both planes run side by side behind ``--serve-plane threaded|evloop``;
+see docs/SERVING.md "Serving planes".
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import selectors
+import socket
+import threading
+import time
+import zlib
+from collections import deque
+from queue import SimpleQueue
+from typing import Deque, Dict, Optional, Set
+
+import numpy as np
+
+from ..obs.http import to_prometheus
+from ..obs.registry import registry
+from ..obs.slo import SloEngine
+from ..obs.trace import get_tracer, mint_trace_id
+from .batcher import BatchPlane, ServeDeadline, ServeOverload
+from .wire import CONTENT_TYPE_FRAME, WireError, decode_frame
+
+__all__ = ["InlineAssembler", "EvloopPredictServer", "EvRouterFrontend"]
+
+_MAX_HEAD = 65536
+_MAX_BODY = 64 << 20
+_RECV = 262144
+
+_STATUS_TEXT = {200: "OK", 400: "Bad Request", 403: "Forbidden",
+                404: "Not Found", 500: "Internal Server Error",
+                502: "Bad Gateway", 503: "Service Unavailable",
+                504: "Gateway Timeout"}
+
+
+def _resp(code: int, body: bytes, ctype: str = "application/json",
+          close: bool = False, extra: bytes = b"") -> bytes:
+    """One full HTTP/1.1 response. ``extra`` is pre-encoded header
+    lines (the hop/trace headers) spliced in before the terminator."""
+    return ((f"HTTP/1.1 {code} {_STATUS_TEXT.get(code, 'Status')}\r\n"
+             f"Content-Type: {ctype}\r\n"
+             f"Content-Length: {len(body)}\r\n").encode("latin-1")
+            + extra
+            + (b"Connection: close\r\n" if close else b"")
+            + b"\r\n" + body)
+
+
+class _Pend:
+    """One request waiting for assembly — the evloop twin of the
+    MicroBatcher's ``_Req``, with a completion callback instead of a
+    Future.  ``done(scores, meta, hop, exc)`` fires on the loop thread
+    when the request's batch scores (or it expires/fails)."""
+
+    __slots__ = ("rows", "n", "done", "t_enq", "t_deadline", "trace_id",
+                 "raw")
+
+    def __init__(self, rows, n, done, t_enq, t_deadline, trace_id, raw):
+        self.rows = rows
+        self.n = n
+        self.done = done
+        self.t_enq = t_enq
+        self.t_deadline = t_deadline
+        self.trace_id = trace_id
+        self.raw = raw
+
+
+class InlineAssembler(BatchPlane):
+    """Batch assembly ON the event loop — no queue, no dispatch thread.
+
+    Requests append to a pending deque; the loop calls :meth:`pump`
+    every tick and :meth:`next_wakeup` to bound its select timeout, so
+    a coalescing window closes exactly when the MicroBatcher's would
+    (``max_delay_ms`` past the FIRST pending request, early once
+    ``max_batch`` rows wait) — but the close, the predict call and the
+    completions all happen inline, saving two thread handoffs and a
+    Future wakeup per request.
+
+    Single-threaded by construction: submit/pump/close all run on the
+    loop thread (the tsan sanitizer verifies nothing else writes here).
+    Every :class:`~.batcher.BatchPlane` contract holds — see the class
+    docstring there.
+    """
+
+    def __init__(self, predict_fn, *, max_batch: int = 256,
+                 max_delay_ms: float = 2.0,
+                 max_queue_rows: Optional[int] = None,
+                 deadline_ms: float = 0.0):
+        self._predict = predict_fn
+        self._init_plane(max_batch, max_delay_ms, max_queue_rows,
+                         deadline_ms)
+        self._pending: Deque[_Pend] = deque()
+        self._closed = False
+
+    # -- submit side (loop thread) ------------------------------------------
+    def submit(self, rows: list, done, deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None,
+               raw: Optional[list] = None) -> None:
+        """Enqueue one request for the next batch. ``done(scores, meta,
+        hop, exc)`` fires when it completes — scores is the request's
+        float32 slice (None on error), meta the predict fn's metadata
+        (the scoring model step), hop the queue/assemble/predict second
+        decomposition, exc the failure if any.  Raises ServeOverload
+        synchronously when the bounded queue is full (same shed rule as
+        MicroBatcher: one oversized request against an EMPTY queue is
+        admitted alone)."""
+        n = len(rows)
+        if n == 0:
+            done(np.zeros(0, np.float32), None, {}, None)
+            return
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        if self._queued_rows + n > self.max_queue_rows and self._pending:
+            self.shed += 1
+            raise ServeOverload(
+                f"queue full ({self._queued_rows} rows queued, "
+                f"max {self.max_queue_rows}); request shed")
+        dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        now = time.monotonic()
+        t_deadline = now + dl / 1000.0 if dl > 0 else None
+        with self._tracer.span("serve.enqueue"):
+            self._pending.append(_Pend(rows, n, done, now, t_deadline,
+                                       trace_id, raw))
+            self._queued_rows += n
+            self.requests += 1
+            self.rows_in += n
+            self._req_meter.add(1)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    # -- assembly side (loop thread) ----------------------------------------
+    def next_wakeup(self) -> Optional[float]:
+        """Absolute monotonic time the loop must pump by — None when
+        nothing is pending, the head request's window close otherwise
+        (0.0 = a full batch is already waiting: pump now)."""
+        if not self._pending:
+            return None
+        if self._queued_rows >= self.max_batch:
+            return 0.0
+        return self._pending[0].t_enq + self.max_delay
+
+    def pump(self, now: Optional[float] = None) -> None:
+        """Close every coalescing window that is due and score it."""
+        while self._pending:
+            if now is None:
+                now = time.monotonic()
+            head = self._pending[0]
+            if not (self._queued_rows >= self.max_batch
+                    or now >= head.t_enq + self.max_delay):
+                return
+            self._score_batch(self._pop_batch(), time.monotonic())
+            now = None                 # re-read the clock per window
+
+    def _pop_batch(self) -> list:
+        batch: list = []
+        nrows = 0
+        while self._pending:
+            p = self._pending[0]
+            if batch and nrows + p.n > self.max_batch:
+                break                  # never split a request
+            self._pending.popleft()
+            self._queued_rows -= p.n
+            batch.append(p)
+            nrows += p.n
+        return batch
+
+    def _complete(self, p: _Pend, scores, meta, hop, exc) -> None:
+        try:
+            p.done(scores, meta, hop, exc)
+        except Exception:   # noqa: BLE001 — a completion callback (the
+            pass            # HTTP response write) must never break the
+            #                 scoring loop for the other requests
+
+    def _score_batch(self, batch: list, t_deq: float) -> None:
+        live: list = []
+        for p in batch:
+            if p.t_deadline is not None and t_deq > p.t_deadline:
+                self.expired += 1
+                # time-in-queue at expiry enters the latency histogram
+                # (lower bound of the would-be latency) — same rationale
+                # as MicroBatcher._run
+                self.latency_hist.observe(t_deq - p.t_enq)
+                self._complete(
+                    p, None, None,
+                    {"queue_s": t_deq - p.t_enq, "assemble_s": 0.0,
+                     "predict_s": 0.0},
+                    ServeDeadline(f"deadline expired after "
+                                  f"{(t_deq - p.t_enq) * 1000:.1f}ms "
+                                  f"in queue"))
+            else:
+                live.append(p)
+        if not live:
+            return
+        rows = [row for p in live for row in p.rows]
+        tids = [p.trace_id for p in live if p.trace_id]
+        ctx = self._tracer.context(",".join(tids) if tids else None)
+        with ctx:
+            with self._tracer.span("serve.batch"):
+                t_p0 = time.monotonic()
+                try:
+                    out = self._predict(rows)
+                except Exception as e:   # noqa: BLE001 — score-time
+                    # failure: isolate per request so one bad client's
+                    # rows cannot 500 the requests coalesced with them
+                    if len(live) == 1:
+                        self.errors += 1
+                        self._complete(
+                            live[0], None, None,
+                            {"queue_s": t_deq - live[0].t_enq,
+                             "assemble_s": 0.0, "predict_s": 0.0}, e)
+                    else:
+                        self._score_individually(live, t_deq)
+                    return
+                t_p1 = time.monotonic()
+        meta = None
+        scores = out
+        if isinstance(out, tuple):
+            scores, meta = out
+        self._note_batch(len(rows), len(live), scores)
+        assemble_s = t_p0 - t_deq
+        predict_s = t_p1 - t_p0
+        t_done = time.monotonic()
+        off = 0
+        for p in live:
+            part = np.asarray(scores[off:off + p.n], np.float32)
+            self.latency_hist.observe(t_done - p.t_enq)
+            self._complete(p, part, meta,
+                           {"queue_s": t_deq - p.t_enq,
+                            "assemble_s": assemble_s,
+                            "predict_s": predict_s}, None)
+            off += p.n
+        self._tee_batch(rows, live)
+
+    def _score_individually(self, reqs: list, t_deq: float) -> None:
+        """Fallback after a coalesced batch raised: re-score each
+        request alone, failing only the one(s) whose rows raise."""
+        for p in reqs:
+            try:
+                t_p0 = time.monotonic()
+                with self._tracer.context(p.trace_id):
+                    out = self._predict(p.rows)
+                t_p1 = time.monotonic()
+                scores, meta = (out if isinstance(out, tuple)
+                                else (out, None))
+                part = np.asarray(scores[:p.n], np.float32)
+                self.latency_hist.observe(t_p1 - p.t_enq)
+                self._note_scores(part, p.n)
+                self._complete(p, part, meta,
+                               {"queue_s": t_deq - p.t_enq,
+                                "assemble_s": 0.0,
+                                "predict_s": t_p1 - t_p0}, None)
+            except Exception as e:     # noqa: BLE001 — per-request fate
+                self.errors += 1
+                self._complete(p, None, None,
+                               {"queue_s": t_deq - p.t_enq,
+                                "assemble_s": 0.0, "predict_s": 0.0}, e)
+
+    # -- lifecycle (loop thread) --------------------------------------------
+    def close(self, drain: bool = False, timeout: float = 5.0) -> None:
+        """Stop accepting. ``drain=True`` scores everything pending
+        (the graceful path — every accepted request completes);
+        otherwise pending requests fail with the closed error.
+        ``timeout`` is accepted for MicroBatcher API parity (there is
+        no dispatch thread to join here)."""
+        self._closed = True
+        if drain:
+            while self._pending:
+                self._score_batch(self._pop_batch(), time.monotonic())
+            return
+        pending = list(self._pending)
+        self._pending.clear()
+        self._queued_rows = 0
+        for p in pending:
+            self._complete(p, None, None, {},
+                           RuntimeError("batcher closed"))
+
+
+class _Conn:
+    """One accepted client connection's state machine (loop thread
+    only): receive buffer, pending output, and whether a request is in
+    flight (responses come back asynchronously from the assembler or
+    the offload worker, so the parser holds off pipelined requests
+    until the current one answers — responses stay ordered)."""
+
+    __slots__ = ("sock", "buf", "out", "inflight", "close_after",
+                 "closed", "t_last")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = bytearray()
+        self.out = bytearray()
+        self.inflight = False
+        self.close_after = False
+        self.closed = False
+        self.t_last = time.monotonic()
+
+
+class _Request:
+    """One parsed request (method/path as bytes, the _RouterHTTP
+    idiom): everything the route handlers need, nothing else."""
+
+    __slots__ = ("method", "path", "body", "ctype", "trace_id")
+
+    def __init__(self, method, path, body, ctype, trace_id):
+        self.method = method
+        self.path = path
+        self.body = body
+        self.ctype = ctype
+        self.trace_id = trace_id
+
+
+class _EvLoopServer:
+    """Shared epoll machinery for both evloop front ends: listeners
+    (TCP + optional UDS), the selector loop, per-connection HTTP/1.1
+    parse, buffered non-blocking writes, a socketpair-wakeup message
+    deque for cross-thread posts, one offload worker for blocking admin
+    work, and an idle keep-alive reaper.
+
+    Subclass hooks (all called on the loop thread):
+    ``_handle_request(conn, req, t_wake)`` routes one parsed request;
+    ``_handle_event(data, mask, t_wake)`` handles non-connection
+    selector entries (the router's replica forwards); ``_tick(now)``
+    runs once per loop iteration; ``_loop_timeout(now)`` returns the
+    next absolute wakeup the subclass needs (or None);
+    ``_on_teardown(drain)`` runs first at shutdown, still on the loop.
+    """
+
+    IDLE_TIMEOUT_S = 30.0
+    _SWEEP_EVERY_S = 5.0
+
+    def __init__(self, host: str, port: int, *,
+                 uds_path: Optional[str] = None, name: str = "evloop"):
+        self._name = name
+        # every non-socket attribute initializes BEFORE any socket
+        # exists: a failure past the first bind must only have sockets
+        # to clean up (GC12)
+        self._msgs: Deque[tuple] = deque()
+        self._conns: Set[_Conn] = set()
+        self._offload_q: "SimpleQueue" = SimpleQueue()
+        self._next_sweep = time.monotonic() + self._SWEEP_EVERY_S
+        self._torn_down = False
+        self._thread: Optional[threading.Thread] = None
+        self._offload_thread: Optional[threading.Thread] = None
+        self._sel = selectors.DefaultSelector()
+        self._listener: Optional[socket.socket] = None
+        self._uds_listener: Optional[socket.socket] = None
+        self._wake_r: Optional[socket.socket] = None
+        self._wake_w: Optional[socket.socket] = None
+        try:
+            self._listener = socket.create_server((host, port))
+            self._listener.setblocking(False)
+            self.host = host
+            self.port = int(self._listener.getsockname()[1])
+            self.uds_path = uds_path
+            if uds_path:
+                import os
+                try:                     # a stale socket file from a
+                    os.unlink(uds_path)  # killed predecessor blocks bind
+                except OSError:
+                    pass
+                u = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                self._uds_listener = u
+                u.bind(uds_path)
+                u.listen(128)
+                u.setblocking(False)
+            self._wake_r, self._wake_w = socket.socketpair()
+            self._wake_r.setblocking(False)
+            self._wake_w.setblocking(False)
+            self._sel.register(self._listener, selectors.EVENT_READ,
+                               "accept")
+            if self._uds_listener is not None:
+                self._sel.register(self._uds_listener,
+                                   selectors.EVENT_READ, "accept")
+            self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        except OSError:
+            # constructor failure must not leak the sockets already
+            # created (GC12) — close everything and re-raise
+            for s in (self._listener, self._uds_listener,
+                      self._wake_r, self._wake_w):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self._sel.close()
+            raise
+
+    # -- cross-thread posting -------------------------------------------------
+    def _post(self, msg: tuple) -> None:
+        """Hand one message to the loop thread: deque append (atomic)
+        plus a socketpair byte so a sleeping select() wakes."""
+        self._msgs.append(msg)
+        try:
+            self._wake_w.send(b"\x00")
+        except (BlockingIOError, OSError):
+            pass                       # pipe full = loop already awake;
+            #                            closed = loop already stopped
+
+    def _offload(self, conn: _Conn, fn) -> None:
+        """Run blocking admin work off-loop; its (code, body, ctype)
+        result posts back as the connection's response."""
+        self._offload_q.put((conn, fn))
+
+    def _offload_run(self) -> None:
+        while True:
+            item = self._offload_q.get()
+            if item is None:
+                return
+            conn, fn = item
+            try:
+                code, body, ctype = fn()
+            except Exception as e:     # noqa: BLE001 — admin surface:
+                # any failure is a 500 on THIS request, never a worker
+                # crash (mirrors the threaded _dispatch guard)
+                code = 500
+                body = json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode()
+                ctype = "application/json"
+            self._post(("resp", conn, code, body, ctype))
+
+    # -- lifecycle ------------------------------------------------------------
+    def _start_threads(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{self._name}:{self.port}",
+            daemon=True)
+        self._offload_thread = threading.Thread(
+            target=self._offload_run, name=f"{self._name}-offload",
+            daemon=True)
+        self._offload_thread.start()
+        self._thread.start()
+
+    def _stop_loop(self, drain: bool = False) -> None:
+        """Control-thread shutdown: ask the loop to tear itself down
+        (all socket state is loop-thread-owned), join both workers,
+        then close what is left (the wake pair; everything else when
+        the loop never ran)."""
+        if self._thread is not None and self._thread.is_alive():
+            self._post(("stop", drain))
+            self._thread.join(timeout=10)
+        self._thread = None
+        self._offload_q.put(None)
+        if self._offload_thread is not None:
+            self._offload_thread.join(timeout=5)
+            self._offload_thread = None
+        if not self._torn_down:
+            self._teardown(False)      # loop never started/already dead
+        for s in (self._wake_r, self._wake_w):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._wake_r = self._wake_w = None
+        self._sel.close()
+
+    # -- the loop -------------------------------------------------------------
+    def _timeout_hint(self) -> float:
+        now = time.monotonic()
+        t = min(1.0, max(0.0, self._next_sweep - now))
+        nxt = self._loop_timeout(now)
+        if nxt is not None:
+            t = min(t, max(0.0, nxt - now))
+        return t
+
+    def _loop(self) -> None:
+        while True:
+            events = self._sel.select(self._timeout_hint())
+            t_wake = time.monotonic()
+            stop = None
+            while self._msgs:
+                msg = self._msgs.popleft()
+                if msg[0] == "stop":
+                    stop = msg[1]
+                elif msg[0] == "resp":
+                    _, conn, code, body, ctype = msg
+                    if not conn.closed:
+                        self._respond(conn, code, body, ctype=ctype)
+                        self._parse_conn(conn, t_wake)
+            if stop is not None:
+                self._teardown(stop)
+                return
+            for key, mask in events:
+                data = key.data
+                if data == "accept":
+                    self._accept(key.fileobj)
+                elif data == "wake":
+                    self._drain_wake()
+                elif isinstance(data, _Conn):
+                    if mask & selectors.EVENT_WRITE:
+                        self._on_writable(data)
+                    if mask & selectors.EVENT_READ and not data.closed:
+                        self._on_readable(data, t_wake)
+                else:
+                    self._handle_event(data, mask, t_wake)
+            self._tick(time.monotonic())
+            if t_wake >= self._next_sweep:
+                self._sweep_idle(t_wake)
+
+    def _drain_wake(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    def _accept(self, listener) -> None:
+        while True:
+            try:
+                sock, _ = listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            # hand the socket to its owning _Conn IMMEDIATELY — from
+            # here any setup failure releases it through the tracked
+            # connection set, never a bare leak (GC12)
+            conn = _Conn(sock)
+            self._conns.add(conn)
+            try:
+                sock.setblocking(False)
+                if sock.family != socket.AF_UNIX:
+                    # responses are single sends, but the hop headers
+                    # make them two-segment occasionally — NODELAY
+                    # keeps keep-alive turnaround sub-ms
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                self._sel.register(sock, selectors.EVENT_READ, conn)
+            except OSError:
+                self._conns.discard(conn)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _sweep_idle(self, now: float) -> None:
+        self._next_sweep = now + self._SWEEP_EVERY_S
+        for conn in [c for c in self._conns
+                     if not c.inflight and not c.out
+                     and now - c.t_last > self.IDLE_TIMEOUT_S]:
+            self._close_conn(conn)
+
+    # -- connection I/O -------------------------------------------------------
+    def _close_conn(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self._conns.discard(conn)
+
+    def _on_readable(self, conn: _Conn, t_wake: float) -> None:
+        conn.t_last = t_wake
+        try:
+            while True:
+                chunk = conn.sock.recv(_RECV)
+                if not chunk:
+                    self._close_conn(conn)   # peer EOF
+                    return
+                conn.buf += chunk
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close_conn(conn)
+            return
+        self._parse_conn(conn, t_wake)
+
+    def _send(self, conn: _Conn, data: bytes) -> None:
+        if conn.closed:
+            return
+        if conn.out:
+            conn.out += data
+            return
+        sent = 0
+        try:
+            sent = conn.sock.send(data)
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close_conn(conn)
+            return
+        if sent < len(data):
+            conn.out += data[sent:]
+            self._sel.modify(conn.sock,
+                             selectors.EVENT_READ | selectors.EVENT_WRITE,
+                             conn)
+        elif conn.close_after and not conn.inflight:
+            self._close_conn(conn)
+
+    def _on_writable(self, conn: _Conn) -> None:
+        if conn.closed or not conn.out:
+            return
+        try:
+            sent = conn.sock.send(conn.out)
+            del conn.out[:sent]
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._close_conn(conn)
+            return
+        if not conn.out:
+            self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
+            if conn.close_after and not conn.inflight:
+                self._close_conn(conn)
+
+    # -- HTTP/1.1 parse (the _RouterHTTP subset: method + path +
+    # Content-Length + the few headers the planes care about) ---------------
+    def _parse_conn(self, conn: _Conn, t_wake: float) -> None:
+        while not conn.closed and not conn.inflight:
+            buf = conn.buf
+            idx = buf.find(b"\r\n\r\n")
+            if idx < 0:
+                if len(buf) > _MAX_HEAD:
+                    self._bad_request(conn, "headers > 64KB cap")
+                return
+            lines = bytes(buf[:idx]).split(b"\r\n")
+            try:
+                method, path, _ = lines[0].split(None, 2)
+            except ValueError:
+                self._bad_request(conn, "bad request line")
+                return
+            clen = 0
+            want_close = False
+            trace_id = None
+            ctype = "application/json"
+            try:
+                for h in lines[1:]:
+                    low = h.lower()
+                    if low.startswith(b"content-length:"):
+                        clen = int(h.split(b":", 1)[1])
+                    elif low.startswith(b"content-type:"):
+                        # latin-1 round-trips any header bytes (the
+                        # _RouterHTTP trace-id rationale)
+                        ctype = h.split(b":", 1)[1].strip().decode(
+                            "latin-1").lower()
+                    elif low.startswith(b"connection:") \
+                            and b"close" in low:
+                        want_close = True
+                    elif low.startswith(b"x-hivemall-trace:"):
+                        trace_id = h.split(b":", 1)[1].strip().decode(
+                            "latin-1")
+            except ValueError:
+                self._bad_request(conn, "bad header")
+                return
+            if clen > _MAX_BODY:
+                self._bad_request(conn, "body > 64MB cap")
+                return
+            total = idx + 4 + clen
+            if len(buf) < total:
+                return                 # body still in flight
+            body = bytes(buf[idx + 4:total])
+            del buf[:total]
+            conn.close_after = conn.close_after or want_close
+            conn.inflight = True
+            req = _Request(bytes(method), bytes(path).split(b"?", 1)[0],
+                           body, ctype, trace_id)
+            self._handle_request(conn, req, t_wake)
+            # a synchronous response cleared inflight — loop on for
+            # pipelined requests already buffered
+
+    def _bad_request(self, conn: _Conn, msg: str) -> None:
+        self._respond(conn, 400, json.dumps({"error": msg}).encode(),
+                      close=True)
+
+    def _respond(self, conn: _Conn, code: int, body: bytes,
+                 ctype: str = "application/json", extra: bytes = b"",
+                 close: bool = False) -> None:
+        if conn.closed:
+            return
+        conn.inflight = False
+        if close:
+            conn.close_after = True
+        self._send(conn, _resp(code, body, ctype, conn.close_after, extra))
+
+    # -- teardown (loop thread) -----------------------------------------------
+    def _teardown(self, drain: bool) -> None:
+        self._torn_down = True
+        try:
+            self._on_teardown(drain)
+        except Exception:   # noqa: BLE001 — teardown must reach the
+            pass            # socket-closing floor no matter what
+        # best-effort blocking flush of buffered responses (the drain
+        # path just queued the last scores into conn.out)
+        for conn in list(self._conns):
+            if conn.out and not conn.closed:
+                try:
+                    conn.sock.setblocking(True)
+                    conn.sock.settimeout(2.0)
+                    conn.sock.sendall(bytes(conn.out))
+                except OSError:
+                    pass
+            self._close_conn(conn)
+        for s in (self._listener, self._uds_listener):
+            if s is not None:
+                try:
+                    self._sel.unregister(s)
+                except (KeyError, ValueError):
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._listener = self._uds_listener = None
+        if self.uds_path:
+            import os
+            try:
+                os.unlink(self.uds_path)
+            except OSError:
+                pass
+
+    # -- subclass hooks -------------------------------------------------------
+    def _handle_request(self, conn: _Conn, req: _Request,
+                        t_wake: float) -> None:
+        raise NotImplementedError
+
+    def _handle_event(self, data, mask, t_wake: float) -> None:
+        pass
+
+    def _tick(self, now: float) -> None:
+        pass
+
+    def _loop_timeout(self, now: float) -> Optional[float]:
+        return None
+
+    def _on_teardown(self, drain: bool) -> None:
+        pass
+
+
+class EvloopPredictServer(_EvLoopServer):
+    """Event-loop replica server — the evloop twin of
+    :class:`~.http.PredictServer`, same constructor surface plus
+    ``uds_path`` (a unix socket the co-located router prefers).
+
+    ``/predict`` parses (JSON strings or binary frames), submits to the
+    :class:`InlineAssembler` and answers from the completion callback;
+    ``/healthz`` and ``/slo`` answer inline (cheap, loop-safe); the
+    blocking admin surface (/snapshot /metrics /trace /promotion
+    /reload) runs on the offload worker.  Responses carry the same
+    ``x-hivemall-hop`` decomposition as the threaded plane with one new
+    leading component: ``loop`` — event-loop dwell between the select
+    wakeup that completed the request and its handler running."""
+
+    def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
+                 max_batch: Optional[int] = None,
+                 max_delay_ms: float = 2.0,
+                 max_queue_rows: Optional[int] = None,
+                 deadline_ms: float = 0.0,
+                 request_timeout: float = 60.0,
+                 watch: bool = True,
+                 slo: "bool | SloEngine" = True,
+                 slo_p99_ms: float = 100.0,
+                 slo_availability: float = 0.999,
+                 uds_path: Optional[str] = None):
+        super().__init__(host, port, uds_path=uds_path,
+                         name="serve-evloop")
+        self.engine = engine
+        self.request_timeout = float(request_timeout)   # API parity;
+        #   the loop never blocks on a result, so nothing consumes it
+        self._watch = bool(watch)
+        self.tracer = get_tracer()
+        self.batcher = InlineAssembler(
+            engine.predict_rows_versioned,
+            max_batch=int(max_batch or engine.max_batch),
+            max_delay_ms=max_delay_ms,
+            max_queue_rows=max_queue_rows,
+            deadline_ms=deadline_ms)
+        engine.attach_batcher(self.batcher)
+        if isinstance(slo, SloEngine):
+            self.slo: Optional[SloEngine] = slo
+            self._own_slo = False
+        elif slo:
+            self.slo = SloEngine(p99_ms=slo_p99_ms,
+                                 availability=slo_availability)
+            self._own_slo = True
+        else:
+            self.slo = None
+            self._own_slo = False
+
+    def start(self) -> "EvloopPredictServer":
+        if self._watch:
+            self.engine.start_watch()
+        if self._own_slo and self.slo is not None:
+            self.slo.start(self.batcher.slo_totals)
+        self._start_threads()
+        return self
+
+    def stop(self, drain: bool = False) -> None:
+        """``drain=True`` is the graceful path: the loop scores every
+        accepted request (the assembler closes ON the loop thread, so
+        the last completions land in connection buffers) and flushes
+        before sockets close."""
+        self._stop_loop(drain)
+        if self._own_slo and self.slo is not None:
+            self.slo.stop()
+        self.engine.close()
+
+    # -- loop hooks -----------------------------------------------------------
+    def _loop_timeout(self, now: float) -> Optional[float]:
+        return self.batcher.next_wakeup()
+
+    def _tick(self, now: float) -> None:
+        self.batcher.pump(now)
+
+    def _on_teardown(self, drain: bool) -> None:
+        self.batcher.close(drain=drain)
+
+    # -- routing --------------------------------------------------------------
+    def _handle_request(self, conn: _Conn, req: _Request,
+                        t_wake: float) -> None:
+        if req.method == b"POST" and req.path == b"/predict":
+            self._predict(conn, req, t_wake)
+            return
+        if req.path == b"/healthz":
+            from .http import health_payload
+            ready, payload = health_payload(self.engine, self.batcher)
+            self._respond(conn, 200 if ready else 503,
+                          json.dumps(payload, default=str).encode())
+            return
+        if req.path == b"/slo":
+            if self.slo is None:
+                self._respond(conn, 404, json.dumps(
+                    {"error": "no SLO engine configured"}).encode())
+                return
+            self._respond(conn, 200,
+                          json.dumps(self.slo.evaluate()).encode())
+            return
+        if req.method == b"POST" and req.path == b"/reload":
+            self._offload(conn, lambda: self._do_reload(req.body))
+            return
+        if req.path == b"/snapshot":
+            self._offload(conn, lambda: (
+                200, json.dumps(registry.snapshot(),
+                                default=str).encode(),
+                "application/json"))
+            return
+        if req.path == b"/metrics":
+            self._offload(conn, lambda: (
+                200, to_prometheus(registry.snapshot()).encode(),
+                "text/plain; version=0.0.4; charset=utf-8"))
+            return
+        if req.path == b"/trace":
+            self._offload(conn, lambda: (
+                200, json.dumps(get_tracer().chrome_dict()).encode(),
+                "application/json"))
+            return
+        if req.path == b"/promotion":
+            self._offload(conn, self._do_promotion)
+            return
+        self._respond(conn, 404, json.dumps(
+            {"error": "unknown path (try /predict, /healthz, /reload, "
+                      "/slo, /snapshot or /metrics)"}).encode(),
+            close=True)
+
+    # -- offloaded admin (worker thread; payloads mirror the threaded
+    # handler byte-for-byte so the planes stay surface-compatible) ----------
+    def _do_reload(self, body: bytes):
+        try:
+            obj = json.loads(body or b"{}")
+            if not isinstance(obj, dict):
+                raise ValueError("request body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            return 400, json.dumps({"error": str(e)}).encode(), \
+                "application/json"
+        try:
+            swapped = self.engine.reload(obj.get("path"))
+        except ValueError as e:        # out-of-tree path: the model dir
+            return 403, json.dumps(    # is the trust boundary
+                {"error": str(e)}).encode(), "application/json"
+        return 200, json.dumps(
+            {"reloaded": swapped,
+             "model_step": self.engine.model_step,
+             "reload_failures": self.engine.reload_failures}).encode(), \
+            "application/json"
+
+    def _do_promotion(self):
+        from .promote import promotion_manifest_view
+        out = promotion_manifest_view(self.engine.checkpoint_dir)
+        out["follow"] = self.engine.follow
+        out["section"] = registry.snapshot().get("promotion")
+        return 200, json.dumps(out, default=str).encode(), \
+            "application/json"
+
+    # -- the predict path -----------------------------------------------------
+    def _predict(self, conn: _Conn, req: _Request, t_wake: float) -> None:
+        t_handle = time.monotonic()
+        tid = req.trace_id
+        deadline_ms = None
+        raw_rows = None
+        try:
+            if req.ctype.startswith(CONTENT_TYPE_FRAME):
+                rows, deadline_ms = decode_frame(
+                    req.body, self.engine.max_row_features)
+                parsed = [self.engine.parse(r) for r in rows]
+            else:
+                obj = json.loads(req.body or b"{}")
+                if not isinstance(obj, dict):
+                    raise ValueError("request body must be a JSON object")
+                rows = obj.get("rows")
+                if rows is None:
+                    feats = obj.get("features")
+                    if feats is None:
+                        raise ValueError('body needs "rows" or "features"')
+                    rows = [feats]
+                if not isinstance(rows, list) \
+                        or not all(isinstance(r, list) for r in rows):
+                    raise ValueError(
+                        '"rows" must be a list of feature-string lists '
+                        '(a bare string would be read as per-character '
+                        'rows)')
+                deadline_ms = obj.get("deadline_ms")
+                if deadline_ms is not None:
+                    deadline_ms = float(deadline_ms)   # malformed -> 400
+                parsed = [self.engine.parse(r) for r in rows]
+                raw_rows = rows
+        except WireError as e:
+            # a desynced binary stream cannot be resynchronized
+            # mid-connection: 400 AND close (the JSON 400 keeps alive)
+            self._respond(conn, 400,
+                          json.dumps({"error": str(e)}).encode(),
+                          close=True)
+            return
+        except (ValueError, TypeError, KeyError,
+                json.JSONDecodeError) as e:
+            self._respond(conn, 400,
+                          json.dumps({"error": str(e)}).encode())
+            return
+        t_parsed = time.monotonic()
+
+        def done(scores, meta, hop, exc):
+            self._finish_predict(conn, tid, t_wake, t_handle, t_parsed,
+                                 scores, meta, hop, exc)
+
+        try:
+            with self.tracer.context(tid):
+                self.batcher.submit(parsed, done, deadline_ms=deadline_ms,
+                                    trace_id=tid, raw=raw_rows)
+        except ServeOverload as e:
+            self._respond(conn, 503, json.dumps(
+                {"error": str(e), "shed": True}).encode())
+        except RuntimeError as e:      # closed: the loop is shutting down
+            self._respond(conn, 503,
+                          json.dumps({"error": str(e)}).encode(),
+                          close=True)
+
+    def _finish_predict(self, conn: _Conn, tid, t_wake: float,
+                        t_handle: float, t_parsed: float,
+                        scores, meta, hop, exc) -> None:
+        if conn.closed:
+            return
+        now = time.monotonic()
+        if exc is not None:
+            if isinstance(exc, ServeDeadline):
+                code, obj = 504, {"error": str(exc), "expired": True}
+            elif isinstance(exc, ServeOverload):
+                code, obj = 503, {"error": str(exc), "shed": True}
+            else:
+                code, obj = 500, {"error": f"{type(exc).__name__}: {exc}"}
+            extra = (f"x-hivemall-trace: {tid}\r\n".encode("latin-1")
+                     if tid else b"")
+            self._respond(conn, code, json.dumps(obj).encode(),
+                          extra=extra)
+            self._parse_conn(conn, now)
+            return
+        step = meta if meta is not None else self.engine.model_step
+        # per-hop decomposition summing to this request's measured wall
+        # (from the select wakeup that read it): the threaded header
+        # plus the evloop-only leading `loop` component
+        total_ms = (now - t_wake) * 1000.0
+        loop_ms = (t_handle - t_wake) * 1000.0
+        parse_ms = (t_parsed - t_handle) * 1000.0
+        queue_ms = (hop or {}).get("queue_s", 0.0) * 1000.0
+        assemble_ms = (hop or {}).get("assemble_s", 0.0) * 1000.0
+        predict_ms = (hop or {}).get("predict_s", 0.0) * 1000.0
+        other_ms = max(0.0, total_ms - loop_ms - parse_ms - queue_ms
+                       - assemble_ms - predict_ms)
+        extra = (f"x-hivemall-hop: loop={loop_ms:.3f},"
+                 f"parse={parse_ms:.3f},queue={queue_ms:.3f},"
+                 f"assemble={assemble_ms:.3f},predict={predict_ms:.3f},"
+                 f"other={other_ms:.3f},total={total_ms:.3f}\r\n"
+                 ).encode("ascii")
+        if tid:
+            extra += f"x-hivemall-trace: {tid}\r\n".encode("latin-1")
+        body = json.dumps({"scores": [float(v) for v in scores],
+                           "model_step": int(step),
+                           "n": len(scores)}).encode()
+        self._respond(conn, 200, body, extra=extra)
+        self._parse_conn(conn, now)    # resume pipelined requests
+
+
+class _Fwd:
+    """One in-flight router→replica forward (loop thread only): the
+    client connection waiting on it, the request bytes, the retry
+    state (mirroring ``RouterServer.route_predict``), and the
+    non-blocking response parse buffer."""
+
+    __slots__ = ("client", "body", "ctype", "trace_id", "extra_head",
+                 "key", "tried", "t0", "deadline", "cache_version", "h",
+                 "sock", "out", "buf", "last_err", "registered")
+
+    def __init__(self, client, body, ctype, trace_id, extra_head,
+                 cache_version):
+        self.client = client
+        self.body = body
+        self.ctype = ctype
+        self.trace_id = trace_id
+        self.extra_head = extra_head
+        self.key = zlib.crc32(body)    # cheap, stable affinity key
+        self.tried: set = set()
+        self.t0 = time.monotonic()
+        self.deadline = 0.0            # per-attempt; set by try_next
+        self.cache_version = cache_version
+        self.h = None
+        self.sock: Optional[socket.socket] = None
+        self.out = bytearray()
+        self.buf = bytearray()
+        self.last_err: Optional[str] = None
+        self.registered = False
+
+
+class EvRouterFrontend(_EvLoopServer):
+    """Event-loop front door for :class:`~.router.RouterServer` — same
+    ``start/stop/port`` surface as ``_RouterHTTP``, selected with
+    ``RouterServer(plane="evloop")``.
+
+    ``/predict`` forwards are a non-blocking state machine per request:
+    the replica socket registers in the same selector as client
+    connections, so one loop thread relays every in-flight forward
+    concurrently.  Placement, retry, counters, tracing, the result
+    cache and the replay tee all reuse the RouterServer's own logic and
+    locks — the two front ends cannot drift on routing semantics.
+    Replica connects prefer a handle's UDS path (co-located evloop
+    replicas) and fall back to TCP; the connect itself is blocking but
+    bounded at 1s — a deliberate tradeoff: loopback/UDS connects
+    complete in microseconds and a dead local port refuses instantly,
+    so an EINPROGRESS connect FSM would buy nothing here.
+
+    The blocking admin surface (/snapshot aggregation walks every
+    replica) runs on the offload worker over the handles' own pooled
+    blocking connections, exactly as the threaded plane does."""
+
+    #: forward-side pooled connections kept per replica
+    _POOL_MAX = 32
+
+    def __init__(self, router, host: str, port: int):
+        super().__init__(host, port, name="router-evloop")
+        self._router = router
+        # (handle, deque-of-sockets) per rid; keyed on the handle
+        # OBJECT too, so a respawned replica (same rid, fresh handle)
+        # never inherits its predecessor's dead sockets
+        self._fwd_pools: Dict[str, tuple] = {}
+        self._fwds: Set[_Fwd] = set()
+
+    def start(self) -> None:
+        self._start_threads()
+
+    def stop(self) -> None:
+        self._stop_loop(False)
+
+    # -- loop hooks -----------------------------------------------------------
+    def _loop_timeout(self, now: float) -> Optional[float]:
+        if not self._fwds:
+            return None
+        return min(f.deadline for f in self._fwds)
+
+    def _tick(self, now: float) -> None:
+        if not self._fwds:
+            return
+        for fwd in [f for f in self._fwds if now > f.deadline]:
+            self._fwd_transport_error(fwd, socket.timeout(
+                f"forward timed out after "
+                f"{self._router.forward_timeout}s"))
+            self._fwd_try_next(fwd)
+
+    def _on_teardown(self, drain: bool) -> None:
+        r = self._router
+        for fwd in list(self._fwds):
+            if fwd.sock is not None:
+                if fwd.registered:
+                    try:
+                        self._sel.unregister(fwd.sock)
+                    except (KeyError, ValueError):
+                        pass
+                try:
+                    fwd.sock.close()
+                except OSError:
+                    pass
+                fwd.sock = None
+            if fwd.h is not None:
+                with fwd.h._lock:
+                    fwd.h.inflight -= 1
+        self._fwds.clear()
+        for rid in list(self._fwd_pools):
+            self._close_fwd_pool(rid)
+        del r
+
+    # -- routing --------------------------------------------------------------
+    def _handle_request(self, conn: _Conn, req: _Request,
+                        t_wake: float) -> None:
+        r = self._router
+        if req.method == b"POST" and req.path == b"/predict":
+            self._start_forward(conn, req)
+            return
+        if req.path == b"/healthz":
+            h = r.fleet_health()
+            self._respond(conn, 200 if h["ready_replicas"] > 0 else 503,
+                          json.dumps(h).encode())
+            return
+        if req.path == b"/slo":
+            if r.slo is None:
+                self._respond(conn, 404, json.dumps(
+                    {"error": "no SLO engine configured"}).encode())
+                return
+            self._respond(conn, 200,
+                          json.dumps(r.slo.evaluate()).encode())
+            return
+        if req.path == b"/trace":
+            self._offload(conn, lambda: (
+                200, json.dumps(r.merged_trace()).encode(),
+                "application/json"))
+            return
+        if req.path == b"/promotion":
+            if r.promotion_provider is None:
+                self._respond(conn, 404, json.dumps(
+                    {"error": "no promotion control plane configured "
+                              "(serve --promote)"}).encode())
+                return
+            self._offload(conn, lambda: (
+                200, json.dumps(r.promotion_provider(),
+                                default=str).encode(),
+                "application/json"))
+            return
+        if req.path == b"/snapshot":
+            self._offload(conn, lambda: (
+                200, json.dumps(r.fleet_snapshot(),
+                                default=str).encode(),
+                "application/json"))
+            return
+        if req.path == b"/metrics":
+            self._offload(conn, lambda: (
+                200, to_prometheus(r.fleet_snapshot()).encode(),
+                "text/plain; version=0.0.4; charset=utf-8"))
+            return
+        if req.method == b"POST" and req.path == b"/reload":
+            self._offload(conn, lambda: (
+                200, json.dumps(r.on_reload(req.body),
+                                default=str).encode(),
+                "application/json"))
+            return
+        self._respond(conn, 404, json.dumps(
+            {"error": "unknown path (try /predict, /healthz, /snapshot "
+                      "or /metrics)"}).encode(), close=True)
+
+    def _relay(self, conn: _Conn, raw: bytes) -> None:
+        """Relay pre-built response bytes (a cache hit or a replica's
+        verbatim answer) to the client and resume its parser."""
+        if conn.closed:
+            return
+        conn.inflight = False
+        if b"\r\nconnection: close" in raw[:512].lower():
+            conn.close_after = True
+        self._send(conn, raw)
+        self._parse_conn(conn, time.monotonic())
+
+    # -- the forward state machine -------------------------------------------
+    def _start_forward(self, conn: _Conn, req: _Request) -> None:
+        r = self._router
+        body = req.body
+        cache = r.result_cache
+        cache_version = None
+        if cache is not None:
+            with r._lock:
+                fleet_up = any(h.ready for h in r._handles.values())
+            # a hit is only served while the fleet can actually serve
+            # (route_predict's outage-masking rationale)
+            hit = cache.get(body) if fleet_up else None
+            if hit is not None:
+                with r._stats_lock:
+                    r.routed += 1
+                self._tee(body)
+                self._relay(conn, hit)
+                return
+            cache_version = cache.version
+        tid = req.trace_id
+        if tid is None and r._tracer.enabled \
+                and random.random() < r.trace_sample:
+            tid = mint_trace_id()
+        extra_head = (f"x-hivemall-trace: {tid}\r\n".encode("latin-1")
+                      if tid else b"")
+        fwd = _Fwd(conn, body, req.ctype or "application/json", tid,
+                   extra_head, cache_version)
+        self._fwds.add(fwd)
+        self._fwd_try_next(fwd)
+
+    def _tee(self, body: bytes) -> None:
+        tee = self._router.predict_tee
+        if tee is not None:
+            try:                       # O(1) bounded append (drop-
+                tee(body)              # oldest) — never blocks routing
+            except Exception:          # noqa: BLE001 — a tee consumer
+                pass                   # must never break routing
+
+    def _fwd_pool(self, h) -> Deque[socket.socket]:
+        ent = self._fwd_pools.get(h.rid)
+        if ent is None or ent[0] is not h:
+            if ent is not None:        # respawned replica: same rid,
+                self._close_fwd_pool(h.rid)   # fresh handle — the old
+            ent = (h, deque())         # pool's sockets are dead
+            self._fwd_pools[h.rid] = ent
+        return ent[1]
+
+    def _close_fwd_pool(self, rid: str) -> None:
+        ent = self._fwd_pools.pop(rid, None)
+        if ent is None:
+            return
+        for s in ent[1]:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _fwd_conn(self, h) -> socket.socket:
+        """One non-blocking socket to a replica — pooled, UDS-first."""
+        pool = self._fwd_pool(h)
+        if pool:
+            return pool.pop()
+        uds = h.uds
+        if uds:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                sock.settimeout(1.0)
+                sock.connect(uds)
+                sock.setblocking(False)
+                return sock
+            except OSError:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                h.uds = None           # fall back to TCP for good; a
+                #                        respawn re-sets the path
+        sock = socket.create_connection((h.host, h.port), timeout=1.0)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.setblocking(False)
+        except OSError:
+            sock.close()               # GC12: no half-built socket
+            raise
+        return sock
+
+    def _fwd_try_next(self, fwd: _Fwd) -> None:
+        """Place (or re-place after a transport failure) one forward —
+        the non-blocking mirror of route_predict's retry loop."""
+        r = self._router
+        while True:
+            h = r._pick(fwd.key, fwd.tried)
+            if h is None:
+                self._fwd_finish_error(fwd)
+                return
+            fwd.h = h
+            fwd.tried.add(h.rid)
+            with h._lock:              # `+=` is read-modify-write, not
+                h.inflight += 1        # atomic (route_predict rationale)
+            fwd.deadline = time.monotonic() + r.forward_timeout
+            try:
+                # assign straight onto fwd: its teardown owns the socket
+                # from the instant it exists (no leak window, GC12)
+                fwd.sock = self._fwd_conn(h)
+            except OSError as e:
+                self._fwd_transport_error(fwd, e)
+                continue
+            sock = fwd.sock
+            head = (f"POST /predict HTTP/1.1\r\n"
+                    f"Host: {h.host}:{h.port}\r\n"
+                    f"Content-Type: {fwd.ctype}\r\n"
+                    f"Content-Length: {len(fwd.body)}\r\n"
+                    ).encode("latin-1") + fwd.extra_head + b"\r\n"
+            fwd.out = bytearray(head + fwd.body)
+            fwd.buf = bytearray()
+            try:
+                sent = sock.send(fwd.out)
+                del fwd.out[:sent]
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError as e:
+                self._fwd_transport_error(fwd, e)
+                continue
+            mask = selectors.EVENT_READ
+            if fwd.out:
+                mask |= selectors.EVENT_WRITE
+            self._sel.register(sock, mask, fwd)
+            fwd.registered = True
+            return
+
+    def _fwd_transport_error(self, fwd: _Fwd, e: Exception) -> None:
+        """One replica attempt failed in transport: mirror
+        route_predict's bookkeeping (mark unready, drop pools, count a
+        retry). The caller decides whether to re-place."""
+        h = fwd.h
+        if fwd.registered:
+            try:
+                self._sel.unregister(fwd.sock)
+            except (KeyError, ValueError):
+                pass
+            fwd.registered = False
+        if fwd.sock is not None:
+            try:
+                fwd.sock.close()
+            except OSError:
+                pass
+            fwd.sock = None
+        with h._lock:
+            h.transport_errors += 1
+            h.inflight -= 1
+        h.ready = False                # immediate gate; the manager's
+        h.close_pool()                 # health poll revives or respawns
+        self._close_fwd_pool(h.rid)
+        fwd.last_err = f"{h.rid}: {type(e).__name__}: {e}"
+        with self._router._stats_lock:
+            self._router.retries += 1
+
+    def _fwd_finish_error(self, fwd: _Fwd) -> None:
+        """No replica left to try: answer the client with the
+        route_predict fallback JSON."""
+        self._fwds.discard(fwd)
+        r = self._router
+        if fwd.last_err is None:
+            with r._stats_lock:
+                r.no_replica += 1
+            code = 503
+            obj = {"error": "no ready replica", "shed": True}
+        else:
+            with r._stats_lock:
+                r.proxy_errors += 1
+            code = 502
+            obj = {"error": f"all replicas failed: {fwd.last_err}"}
+        conn = fwd.client
+        if conn.closed:
+            return
+        self._respond(conn, code, json.dumps(obj, default=str).encode(),
+                      close=code >= 500)
+        self._parse_conn(conn, time.monotonic())
+
+    def _handle_event(self, fwd, mask, t_wake: float) -> None:
+        """Selector activity on a forward's replica socket."""
+        if not isinstance(fwd, _Fwd) or fwd.sock is None:
+            return
+        if mask & selectors.EVENT_WRITE and fwd.out:
+            try:
+                sent = fwd.sock.send(fwd.out)
+                del fwd.out[:sent]
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError as e:
+                self._fwd_transport_error(fwd, e)
+                self._fwd_try_next(fwd)
+                return
+            if not fwd.out:
+                self._sel.modify(fwd.sock, selectors.EVENT_READ, fwd)
+        if not (mask & selectors.EVENT_READ):
+            return
+        eof = False
+        try:
+            while True:
+                chunk = fwd.sock.recv(_RECV)
+                if not chunk:
+                    eof = True
+                    break
+                fwd.buf += chunk
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError as e:
+            self._fwd_transport_error(fwd, e)
+            self._fwd_try_next(fwd)
+            return
+        try:
+            done = self._fwd_parse(fwd)
+        except (ConnectionError, ValueError) as e:
+            self._fwd_transport_error(fwd, e)
+            self._fwd_try_next(fwd)
+            return
+        if done:
+            return
+        if eof:
+            self._fwd_transport_error(
+                fwd, ConnectionError("connection closed mid-response"))
+            self._fwd_try_next(fwd)
+
+    def _fwd_parse(self, fwd: _Fwd) -> bool:
+        """Incremental response parse; True once complete (and
+        relayed). Raises ConnectionError/ValueError on framing garbage
+        (the caller treats it as a transport failure)."""
+        idx = fwd.buf.find(b"\r\n\r\n")
+        if idx < 0:
+            if len(fwd.buf) > _MAX_HEAD:
+                raise ConnectionError("replica headers > 64KB cap")
+            return False
+        parts = bytes(fwd.buf[:idx + 4]).split(b"\r\n")
+        # parts[:-2] = status + headers; parts[-2:] = two empty strings
+        sl = parts[0].split(None, 2)
+        if len(sl) < 2 or not sl[0].startswith(b"HTTP/"):
+            raise ConnectionError(f"bad status line {parts[0][:80]!r}")
+        status = int(sl[1])
+        clen = 0
+        close = False
+        for p in parts[1:-2]:
+            low = p.lower()
+            if low.startswith(b"content-length:"):
+                clen = int(p.split(b":", 1)[1])
+            elif low.startswith(b"connection:") and b"close" in low:
+                close = True
+        if clen > _MAX_BODY:
+            raise ConnectionError(f"replica body {clen} bytes > cap")
+        if len(fwd.buf) < idx + 4 + clen:
+            return False
+        payload = bytes(fwd.buf[idx + 4:idx + 4 + clen])
+        # bytes past the response are a framing desync — never pool
+        desync = len(fwd.buf) > idx + 4 + clen
+        lines = [p + b"\r\n" for p in parts[:-2]] + [b"\r\n"]
+        self._fwd_complete(fwd, status, lines, payload,
+                           close or desync)
+        return True
+
+    def _fwd_complete(self, fwd: _Fwd, status: int, lines: list,
+                      payload: bytes, conn_close: bool) -> None:
+        r = self._router
+        h = fwd.h
+        self._fwds.discard(fwd)
+        if fwd.registered:
+            try:
+                self._sel.unregister(fwd.sock)
+            except (KeyError, ValueError):
+                pass
+            fwd.registered = False
+        total_s = time.monotonic() - fwd.t0
+        with h._lock:
+            h.forwarded += 1
+            h.inflight -= 1
+        with r._stats_lock:
+            r.routed += 1
+            if fwd.trace_id:
+                r.traced += 1
+        if fwd.trace_id:
+            # the router's half of the cross-process flame
+            r._tracer.add_span("router.forward", total_s,
+                               trace=fwd.trace_id)
+        head, raw = r._relay_with_hops(lines, payload, total_s)
+        cache = r.result_cache
+        if cache is not None and status == 200:
+            cache.put(fwd.body, head, payload,
+                      version=fwd.cache_version)
+        if conn_close:
+            try:
+                fwd.sock.close()
+            except OSError:
+                pass
+        else:
+            pool = self._fwd_pool(h)
+            if len(pool) < self._POOL_MAX:
+                pool.append(fwd.sock)
+            else:
+                fwd.sock.close()
+        fwd.sock = None
+        self._tee(fwd.body)
+        self._relay(fwd.client, raw)

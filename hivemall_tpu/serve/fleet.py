@@ -59,9 +59,11 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import signal
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 import urllib.error
@@ -99,6 +101,7 @@ class _Replica:
         self.proc = proc
         self.slot = slot               # resource slot (core/device pin) —
         self.port: Optional[int] = None   # a respawn must inherit it
+        self.uds: Optional[str] = None    # unix socket (evloop fast path)
         self.model_step: Optional[int] = None
         self.ready = False
         self.last_health: dict = {}
@@ -119,6 +122,8 @@ class ReplicaManager:
                  per_replica_env: Optional[List[dict]] = None,
                  serve_kwargs: Optional[dict] = None,
                  pin_cpus: bool = False,
+                 plane: str = "threaded",
+                 uds: Optional[bool] = None,
                  spawn_timeout: float = 180.0,
                  health_interval: float = 0.5,
                  watch_interval: float = 2.0,
@@ -147,6 +152,19 @@ class ReplicaManager:
         # scale across N cores instead of every replica's XLA pool
         # thrashing all of them
         self.pin_cpus = bool(pin_cpus)
+        # serving plane (docs/SERVING.md "Serving planes"): threaded =
+        # thread-per-connection + MicroBatcher; evloop = epoll front end
+        # + inline assembly. Replicas AND router front end must agree.
+        if plane not in ("threaded", "evloop"):
+            raise ValueError(f"unknown serve plane {plane!r}")
+        self.plane = plane
+        # UDS fast path: evloop replicas also listen on a unix socket
+        # the co-located router prefers over TCP (default on for evloop;
+        # explicit uds=False keeps it TCP-only, e.g. a remote router)
+        self.uds = (plane == "evloop") if uds is None else bool(uds)
+        self._uds_dir: Optional[str] = (
+            tempfile.mkdtemp(prefix="hmt-uds-")
+            if self.uds and self.plane == "evloop" else None)
         self.serve_kwargs = dict(serve_kwargs or {})
         self.spawn_timeout = float(spawn_timeout)
         self.health_interval = float(health_interval)
@@ -206,6 +224,12 @@ class ReplicaManager:
         if self.pin_cpus:
             n = os.cpu_count() or 1
             spec["cpu_affinity"] = [slot % n]
+        if self.plane != "threaded":
+            spec["plane"] = self.plane
+        if self._uds_dir:
+            # per-SLOT socket path: a respawn inherits its predecessor's
+            # path (the server unlinks the stale file before bind)
+            spec["uds"] = os.path.join(self._uds_dir, f"s{slot}.sock")
         spec.update(self.serve_kwargs)
         return spec
 
@@ -251,6 +275,7 @@ class ReplicaManager:
                                f"within the spawn timeout")
         msg = json.loads(got[0])
         r.port = int(msg["port"])
+        r.uds = msg.get("uds")
         r.model_step = msg.get("model_step")
         # keep draining worker stdout so a chatty replica can't fill the
         # pipe and wedge itself
@@ -279,7 +304,8 @@ class ReplicaManager:
             for r in rs:
                 self._replicas[r.rid] = r
                 if self.router is not None:
-                    self.router.add_replica(r.rid, "127.0.0.1", r.port)
+                    self.router.add_replica(r.rid, "127.0.0.1", r.port,
+                                            uds=r.uds)
         for target, name in ((self._monitor, "fleet-health"),
                              (self._watch, "fleet-watch")):
             t = threading.Thread(target=target, name=name, daemon=True)
@@ -434,7 +460,8 @@ class ReplicaManager:
                         return
                     self._replicas[r.rid] = r
                 if self.router is not None:
-                    self.router.add_replica(r.rid, "127.0.0.1", r.port)
+                    self.router.add_replica(r.rid, "127.0.0.1", r.port,
+                                            uds=r.uds)
                 return
         finally:
             self._respawning.discard(slot)
@@ -896,6 +923,8 @@ class ReplicaManager:
                 self.router.remove_replica(r.rid)
         with self._lock:
             self._replicas.clear()
+        if self._uds_dir:
+            shutil.rmtree(self._uds_dir, ignore_errors=True)
 
 
 class Fleet:
@@ -913,6 +942,8 @@ class Fleet:
                  per_replica_env: Optional[List[dict]] = None,
                  serve_kwargs: Optional[dict] = None,
                  pin_cpus: bool = False,
+                 plane: str = "threaded",
+                 uds: Optional[bool] = None,
                  health_interval: float = 0.5,
                  watch_interval: float = 2.0,
                  spawn_timeout: float = 180.0,
@@ -958,7 +989,8 @@ class Fleet:
                                    trace_sample=trace_sample,
                                    slo=self.slo,
                                    result_cache_entries=result_cache_entries,
-                                   result_cache_bytes=result_cache_bytes)
+                                   result_cache_bytes=result_cache_bytes,
+                                   plane=plane)
         # retrain autopilot (serve.retrain, docs/RELIABILITY.md
         # "Autonomous retraining"): consumes the SLO engine's drift
         # votes; live traffic reaches its replay buffer through a
@@ -984,7 +1016,7 @@ class Fleet:
             algo, options, checkpoint_dir=checkpoint_dir, bundle=bundle,
             replicas=replicas, router=self.router, env=env,
             per_replica_env=per_replica_env, serve_kwargs=serve_kwargs,
-            pin_cpus=pin_cpus,
+            pin_cpus=pin_cpus, plane=plane, uds=uds,
             health_interval=health_interval, watch_interval=watch_interval,
             spawn_timeout=spawn_timeout, slo=self.slo,
             gate=gate, promote=promote,
@@ -1002,6 +1034,7 @@ class Fleet:
             self.router.promotion_provider = _promotion_view
         self.host = host
         self.port = self.router.port
+        self.plane = plane
 
     def _on_reload(self, body: bytes) -> dict:
         obj = json.loads(body or b"{}")
@@ -1089,7 +1122,6 @@ def _worker(spec_json: str) -> int:
 
     from ..obs.trace import get_tracer
     from .engine import PredictEngine
-    from .http import PredictServer
 
     def opt(key, default, conv):
         # explicit None check: `or default` would silently override a
@@ -1116,8 +1148,7 @@ def _worker(spec_json: str) -> int:
         # own bundle copy; precision picks the scoring tier
         arena=spec.get("arena") or "auto",
         precision=spec.get("precision") or "f32")
-    srv = PredictServer(
-        engine,
+    srv_kwargs = dict(
         host=spec.get("host") or "127.0.0.1",
         port=opt("port", 0, int),
         max_delay_ms=opt("max_delay_ms", 2.0, float),
@@ -1129,7 +1160,14 @@ def _worker(spec_json: str) -> int:
         # likewise the manager owns the fleet SLO engine (it sums the
         # replicas' cumulative /healthz totals); a per-replica sampler
         # would just burn a thread per process
-        slo=False).start()
+        slo=False)
+    if (spec.get("plane") or "threaded") == "evloop":
+        from .evloop import EvloopPredictServer
+        srv = EvloopPredictServer(engine, uds_path=spec.get("uds"),
+                                  **srv_kwargs).start()
+    else:
+        from .http import PredictServer
+        srv = PredictServer(engine, **srv_kwargs).start()
     # label this process's span export so the router-merged /trace
     # reads replica:<port> instead of a bare pid
     get_tracer().process_label = f"replica:{srv.port}"
@@ -1141,8 +1179,11 @@ def _worker(spec_json: str) -> int:
 
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)
-    print(json.dumps({"ready": True, "port": srv.port, "pid": os.getpid(),
-                      "model_step": engine.model_step}), flush=True)
+    line = {"ready": True, "port": srv.port, "pid": os.getpid(),
+            "model_step": engine.model_step}
+    if getattr(srv, "uds_path", None):
+        line["uds"] = srv.uds_path
+    print(json.dumps(line), flush=True)
     while not stop.wait(1.0):            # timed wait: signal-interruptible
         pass
     srv.stop(drain=True)

@@ -60,6 +60,11 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--threads", type=int, default=6)
     ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--plane", default="threaded",
+                    choices=("threaded", "evloop"),
+                    help="serving plane under test (docs/SERVING.md "
+                         "'Serving planes'); evloop also exercises the "
+                         "router->replica UDS fast path")
     args = ap.parse_args(argv)
     # lockset race sanitizer (HIVEMALL_TPU_TSAN=1): the manager-side
     # threads (health monitor, watch, respawn, router accept/handlers,
@@ -120,7 +125,7 @@ def _run(args, tmp: str) -> int:
     get_tracer().enable()
     fleet = Fleet(
         "train_classifier", opts, checkpoint_dir=tmp,
-        replicas=args.replicas,
+        replicas=args.replicas, plane=args.plane,
         watch_interval=0.3, health_interval=0.2,
         env={"HIVEMALL_TPU_TRACE": "1"},
         serve_kwargs={"max_batch": 64, "max_delay_ms": 3.0,
@@ -183,6 +188,11 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
     check("fan_out", len(handles) == args.replicas
           and all(h.forwarded > 0 for h in handles),
           f"({[(h.rid, h.forwarded) for h in handles]})")
+    if fleet.plane == "evloop":
+        # the UDS fast path held: no replica fell back to TCP (a
+        # fallback permanently clears the handle's uds)
+        check("uds_fast_path", all(h.uds for h in handles),
+              f"({[(h.rid, bool(h.uds)) for h in handles]})")
 
     # -- 2. aggregated obs surface ----------------------------------------
     hz = json.loads(_http_get(f"http://{host}:{port}/healthz"))

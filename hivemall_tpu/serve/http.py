@@ -40,7 +40,6 @@ router extends with its own relay hop.
 
 from __future__ import annotations
 
-import http.client
 import http.server
 import json
 import threading
@@ -52,81 +51,51 @@ from ..obs.http import _Handler as _ObsHandler
 from ..obs.slo import SloEngine
 from ..obs.trace import get_tracer
 from .batcher import MicroBatcher, ServeDeadline, ServeOverload
+from .client import RawHTTPClient
+from .wire import CONTENT_TYPE_FRAME, WireError, decode_frame
 
-__all__ = ["PredictServer", "KeepAliveClient"]
+__all__ = ["PredictServer", "KeepAliveClient", "health_payload"]
 
 
-class KeepAliveClient:
-    """Minimal keep-alive HTTP client for ONE endpoint, one per thread.
+class KeepAliveClient(RawHTTPClient):
+    """Historical name for the shared raw keep-alive client
+    (serve.client.RawHTTPClient) — the bench/smoke drivers and a pile
+    of tests construct this. One endpoint, one per thread; see the
+    shared module for the wire details (binary frames, UDS)."""
 
-    The serving stack talks HTTP/1.1 end to end (client -> router ->
-    replica); per-request TCP setup was measurable overhead in
-    bench_serve at high concurrency, so the bench/smoke drivers hold one
-    persistent connection per client thread instead of urllib's
-    connection-per-request. Reconnects transparently once when the server
-    side closed an idle connection (their 10s reaper, an error response's
-    Connection: close). NOT thread-safe — by design, one per thread."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self.host, self.port, self.timeout = host, int(port), timeout
-        self.last_headers: dict = {}
-        self._conn: Optional[http.client.HTTPConnection] = None
-
-    def _connect(self) -> http.client.HTTPConnection:
-        if self._conn is None:
-            conn = http.client.HTTPConnection(
-                self.host, self.port, timeout=self.timeout)
-            try:
-                conn.connect()
-                # headers and body go out as separate small sends;
-                # without NODELAY, Nagle holds the second one for the
-                # delayed ACK
-                import socket as _socket
-                conn.sock.setsockopt(_socket.IPPROTO_TCP,
-                                     _socket.TCP_NODELAY, 1)
-            except OSError:
-                conn.close()   # a half-connected conn must not leak
-                raise          # its socket (GC12)
-            self._conn = conn
-        return self._conn
-
-    def close(self) -> None:
-        if self._conn is not None:
-            self._conn.close()
-            self._conn = None
-
-    def request(self, method: str, path: str, body: Optional[bytes] = None,
-                headers: Optional[dict] = None):
-        """Returns (status, payload bytes). Retries once on a dead kept-
-        alive connection; a server actively refusing still raises. The
-        last response's headers stay readable on ``self.last_headers``
-        (the trace/hop breakdown assertions in the smokes read them)."""
-        for attempt in (0, 1):
-            conn = self._connect()
-            try:
-                hdrs = dict(headers or {})
-                if body is not None:
-                    hdrs.setdefault("Content-Type", "application/json")
-                conn.request(method, path, body, hdrs)
-                resp = conn.getresponse()
-                payload = resp.read()
-                self.last_headers = dict(resp.headers)
-                if resp.will_close:
-                    self.close()
-                return resp.status, payload
-            except (http.client.HTTPException, ConnectionError, OSError):
-                self.close()
-                if attempt:
-                    raise
-        raise AssertionError("unreachable")
-
-    def post_json(self, path: str, obj: dict,
-                  headers: Optional[dict] = None):
-        """Returns (status, parsed json)."""
-        code, payload = self.request("POST", path,
-                                     json.dumps(obj).encode(),
-                                     headers=headers)
-        return code, json.loads(payload)
+def health_payload(engine, batcher) -> "tuple[bool, dict]":
+    """The ``/healthz`` READINESS payload, shared verbatim by both
+    serving planes (the fleet manager parses it on every health tick —
+    the planes must not drift on a single key). Returns ``(ready,
+    payload)``; serve 200 when ready, 503 while warming."""
+    ready = engine.ready
+    return ready, {
+        "status": "ok" if ready else "warming",
+        "ready": ready,
+        "algo": engine.algo,
+        "model_step": engine.model_step,
+        "model_age_seconds": engine.model_age_seconds,
+        "bundle_age_seconds": engine.bundle_age_seconds,
+        "queue_depth": batcher.queue_depth,
+        "requests": batcher.requests,
+        "shed": batcher.shed,
+        "expired": batcher.expired,
+        "errors": batcher.errors,
+        "reloads": engine.reloads,
+        "reload_failures": engine.reload_failures,
+        # zero-copy serving gauges: the fleet manager folds these into
+        # the `fleet` registry section and the router's aggregated
+        # snapshot (host RSS + mapped arena bytes per replica = the
+        # memory-headroom evidence)
+        "host_rss_bytes": _host_rss(),
+        "arena_mapped_bytes": engine.arena_mapped_bytes,
+        "precision": engine.precision,
+        # cumulative SLO totals (latency histogram + score moments):
+        # the fleet manager sums these across replicas into its SLO
+        # engine every health tick
+        "slo": batcher.slo_totals(),
+    }
 
 
 class _ServeHandler(_ObsHandler):
@@ -200,37 +169,9 @@ class _ServeHandler(_ObsHandler):
             # (503 while warming), so the fleet router — and any external
             # LB probing this port — can gate cold/warming replicas out of
             # rotation instead of routing requests into XLA compiles. The
-            # body carries the cheap serving counters the replica manager
-            # folds into its cached fleet obs section.
-            e = s.engine
-            b = s.batcher
-            ready = e.ready
-            self._json(200 if ready else 503, {
-                "status": "ok" if ready else "warming",
-                "ready": ready,
-                "algo": e.algo,
-                "model_step": e.model_step,
-                "model_age_seconds": e.model_age_seconds,
-                "bundle_age_seconds": e.bundle_age_seconds,
-                "queue_depth": b.queue_depth,
-                "requests": b.requests,
-                "shed": b.shed,
-                "expired": b.expired,
-                "errors": b.errors,
-                "reloads": e.reloads,
-                "reload_failures": e.reload_failures,
-                # zero-copy serving gauges: the fleet manager folds
-                # these into the `fleet` registry section and the
-                # router's aggregated snapshot (host RSS + mapped arena
-                # bytes per replica = the memory-headroom evidence)
-                "host_rss_bytes": _host_rss(),
-                "arena_mapped_bytes": e.arena_mapped_bytes,
-                "precision": e.precision,
-                # cumulative SLO totals (latency histogram + score
-                # moments): the fleet manager sums these across replicas
-                # into its SLO engine every health tick
-                "slo": b.slo_totals(),
-            })
+            # payload is shared with the evloop plane (health_payload).
+            ready, payload = health_payload(s.engine, s.batcher)
+            self._json(200 if ready else 503, payload)
             return
         if path == "/slo":
             slo = s.slo
@@ -281,25 +222,48 @@ class _ServeHandler(_ObsHandler):
         # the spans this request touches get tagged with it and the
         # response echoes it (docs/OBSERVABILITY.md)
         tid = self.headers.get("x-hivemall-trace")
+        ctype = (self.headers.get("Content-Type") or "").lower()
         try:
-            body = self._read_body()
-            rows = body.get("rows")
-            if rows is None:
-                feats = body.get("features")
-                if feats is None:
-                    raise ValueError('body needs "rows" or "features"')
-                rows = [feats]
-            if not isinstance(rows, list) \
-                    or not all(isinstance(r, list) for r in rows):
-                raise ValueError('"rows" must be a list of feature-string '
-                                 'lists (a bare string would be read as '
-                                 'per-character rows)')
-            deadline_ms = body.get("deadline_ms")
-            if deadline_ms is not None:
-                deadline_ms = float(deadline_ms)   # malformed -> 400
-            # hashing/parsing on THIS connection thread — concurrent
-            # requests parse in parallel, only scoring serializes
-            parsed = [s.engine.parse(r) for r in rows]
+            if ctype.startswith(CONTENT_TYPE_FRAME):
+                # binary frame protocol (serve.wire): pre-hashed rows,
+                # no libsvm string parse; bit-matches the JSON path
+                ln = int(self.headers.get("Content-Length") or 0)
+                if ln > (64 << 20):
+                    raise ValueError(
+                        f"request body {ln} bytes > 64MB cap")
+                raw_body = self.rfile.read(ln) if ln > 0 else b""
+                self._body_read = True
+                frame_rows, deadline_ms = decode_frame(
+                    raw_body, s.engine.max_row_features)
+                parsed = [s.engine.parse(r) for r in frame_rows]
+                rows = None            # no raw strings to tee
+            else:
+                body = self._read_body()
+                rows = body.get("rows")
+                if rows is None:
+                    feats = body.get("features")
+                    if feats is None:
+                        raise ValueError(
+                            'body needs "rows" or "features"')
+                    rows = [feats]
+                if not isinstance(rows, list) \
+                        or not all(isinstance(r, list) for r in rows):
+                    raise ValueError(
+                        '"rows" must be a list of feature-string '
+                        'lists (a bare string would be read as '
+                        'per-character rows)')
+                deadline_ms = body.get("deadline_ms")
+                if deadline_ms is not None:
+                    deadline_ms = float(deadline_ms)   # malformed -> 400
+                # hashing/parsing on THIS connection thread — concurrent
+                # requests parse in parallel, only scoring serializes
+                parsed = [s.engine.parse(r) for r in rows]
+        except WireError as e:
+            # a desynced binary stream cannot be resynchronized
+            # mid-connection: 400 AND close (JSON 400s keep alive)
+            self.close_connection = True
+            self._json(400, {"error": str(e)})
+            return
         except (ValueError, TypeError, KeyError,
                 json.JSONDecodeError) as e:
             self._json(400, {"error": str(e)})

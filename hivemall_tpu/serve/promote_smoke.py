@@ -41,6 +41,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="hivemall_tpu.serve.promote_smoke")
     ap.add_argument("--rows", type=int, default=300)
     ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--plane", default="threaded",
+                    choices=("threaded", "evloop"),
+                    help="serving plane under test (docs/SERVING.md "
+                         "'Serving planes')")
     args = ap.parse_args(argv)
     tmp = tempfile.mkdtemp(prefix="hivemall_tpu_promote_smoke_")
     # the metrics stream must be live BEFORE the first get_stream() call
@@ -102,7 +106,7 @@ def _run(args, tmp, metrics) -> int:
         "train_classifier", opts, checkpoint_dir=tmp,
         replicas=args.replicas,
         watch_interval=0.3, health_interval=0.2,
-        promote=True, holdout=ds,
+        promote=True, holdout=ds, plane=args.plane,
         canary_fraction=0.5, canary_bake_s=1.5,
         bake_opts={"min_requests": 3},
         serve_kwargs={"max_batch": 64, "max_delay_ms": 3.0,

@@ -60,6 +60,7 @@ from typing import Dict, List, Optional
 from ..obs.http import to_prometheus
 from ..obs.registry import registry
 from ..obs.trace import get_tracer, mint_trace_id
+from .client import RawConn as _RawConn
 
 __all__ = ["RouterServer", "ReplicaHandle"]
 
@@ -69,45 +70,18 @@ _RETRYABLE = (ConnectionError, BrokenPipeError, socket.timeout,
               http.client.HTTPException, OSError)
 
 
-class _RawConn:
-    """One kept-alive raw socket to a replica. The router forwards at the
-    BYTE level — hand-built request head, minimal response parse — which
-    measures ~5x cheaper per request than http.client and is what lets
-    one Python router front many replicas."""
-
-    def __init__(self, host: str, port: int, timeout: float):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        try:
-            # request head and body go out as separate small sends;
-            # Nagle + delayed ACK would stall every kept-alive forward
-            # ~40ms
-            self.sock.setsockopt(socket.IPPROTO_TCP,
-                                 socket.TCP_NODELAY, 1)
-            self.rfile = self.sock.makefile("rb")
-        except OSError:
-            # a constructor failure drops the half-built object — the
-            # connected socket must not outlive it (GC12)
-            self.sock.close()
-            raise
-
-    def close(self) -> None:
-        try:
-            self.rfile.close()
-        except OSError:
-            pass
-        try:
-            self.sock.close()
-        except OSError:
-            pass
-
-
 class ReplicaHandle:
-    """Router-side view of one replica: address, readiness, load."""
+    """Router-side view of one replica: address, readiness, load.
+    ``uds`` optionally names the replica's unix-domain listener (evloop
+    replicas co-located with the router); connections prefer it and
+    fall back to TCP for good when it errors (a respawn re-sets it)."""
 
-    def __init__(self, rid: str, host: str, port: int):
+    def __init__(self, rid: str, host: str, port: int,
+                 uds: Optional[str] = None):
         self.rid = str(rid)
         self.host = host
         self.port = int(port)
+        self.uds = uds
         self.ready = False             # flipped by the manager's health poll
         self.inflight = 0              # router-side concurrent forwards
         self.forwarded = 0
@@ -120,6 +94,13 @@ class ReplicaHandle:
         with self._lock:
             if self._pool:
                 return self._pool.pop()
+        uds = self.uds
+        if uds:
+            try:
+                return _RawConn(self.host, self.port, timeout, uds=uds)
+            except OSError:
+                self.uds = None        # TCP from here on; a respawned
+                #                        replica re-sets the path
         return _RawConn(self.host, self.port, timeout)
 
     def put_conn(self, conn: _RawConn) -> None:
@@ -137,6 +118,7 @@ class ReplicaHandle:
 
     def stats(self) -> dict:
         return {"host": self.host, "port": self.port, "ready": self.ready,
+                "uds": bool(self.uds),
                 "inflight": self.inflight, "forwarded": self.forwarded,
                 "transport_errors": self.transport_errors}
 
@@ -378,6 +360,7 @@ class _RouterHTTP:
                 clen = 0
                 want_close = False
                 trace_id = None
+                ctype = "application/json"
                 while True:
                     h = rf.readline(65537)
                     if not h:
@@ -387,6 +370,11 @@ class _RouterHTTP:
                     low = h.lower()
                     if low.startswith(b"content-length:"):
                         clen = int(h.split(b":", 1)[1])
+                    elif low.startswith(b"content-type:"):
+                        # relayed verbatim to the replica: the binary
+                        # frame protocol negotiates on this header
+                        ctype = h.split(b":", 1)[1].strip().decode(
+                            "latin-1")
                     elif low.startswith(b"connection:") \
                             and b"close" in low:
                         want_close = True
@@ -406,7 +394,7 @@ class _RouterHTTP:
                 if clen and len(body) != clen:
                     return
                 out = self._dispatch(method, path.split(b"?", 1)[0], body,
-                                     trace_id)
+                                     trace_id, ctype)
                 sock.sendall(out)
                 if want_close or b"\r\nConnection: close" in out[:512] \
                         or b"\r\nconnection: close" in out[:512].lower():
@@ -427,10 +415,12 @@ class _RouterHTTP:
                 pass
 
     def _dispatch(self, method: bytes, path: bytes, body: bytes,
-                  trace_id: Optional[str] = None) -> bytes:
+                  trace_id: Optional[str] = None,
+                  ctype: str = "application/json") -> bytes:
         r = self._router
         if method == b"POST" and path == b"/predict":
-            code, raw, fallback = r.route_predict(body, trace_id)
+            code, raw, fallback = r.route_predict(body, trace_id,
+                                                  ctype=ctype)
             tee = r.predict_tee
             if tee is not None and raw is not None:
                 try:                     # O(1) bounded append (drop-
@@ -509,11 +499,16 @@ class RouterServer:
                  trace_sample: float = 0.01,
                  slo=None,
                  result_cache_entries: int = 0,
-                 result_cache_bytes: int = 8 << 20):
+                 result_cache_bytes: int = 8 << 20,
+                 plane: str = "threaded"):
         if policy not in ("least_loaded", "hash"):
             raise ValueError(f"unknown router policy {policy!r} "
                              f"(least_loaded or hash)")
+        if plane not in ("threaded", "evloop"):
+            raise ValueError(f"unknown serve plane {plane!r} "
+                             f"(threaded or evloop)")
         self.policy = policy
+        self.plane = plane
         # bounded LRU over relayed /predict responses (0 entries = off);
         # the replica manager invalidates it on every model change
         self.result_cache: Optional[ResultCache] = (
@@ -550,14 +545,20 @@ class RouterServer:
         self.traced = 0                  # requests with a trace id
         self.no_replica = 0              # 503s for lack of a ready replica
         self.proxy_errors = 0            # all replicas failed transport
-        self._http = _RouterHTTP(self, host, port)
+        if plane == "evloop":
+            # lazy import: the evloop module programs against this one
+            from .evloop import EvRouterFrontend
+            self._http = EvRouterFrontend(self, host, port)
+        else:
+            self._http = _RouterHTTP(self, host, port)
         self.host = host
         self.port = self._http.port
 
     # -- membership (driven by the replica manager) --------------------------
     def add_replica(self, rid: str, host: str, port: int,
-                    ready: bool = False) -> ReplicaHandle:
-        h = ReplicaHandle(rid, host, port)
+                    ready: bool = False,
+                    uds: Optional[str] = None) -> ReplicaHandle:
+        h = ReplicaHandle(rid, host, port, uds=uds)
         h.ready = bool(ready)
         with self._lock:
             self._handles[h.rid] = h
@@ -601,7 +602,8 @@ class RouterServer:
             rid = self._ring.pick(key, {h.rid for h in tied})
             return self._handles.get(rid) if rid else tied[0]
 
-    def route_predict(self, body: bytes, trace_id: Optional[str] = None):
+    def route_predict(self, body: bytes, trace_id: Optional[str] = None,
+                      ctype: str = "application/json"):
         """Forward one /predict body; returns (status, raw_response|None,
         fallback_json|None) — raw responses relay near-VERBATIM to the
         client (status line + headers + body exactly as the replica
@@ -649,7 +651,8 @@ class RouterServer:
                 h.inflight += 1          # atomic — a lost update would
             try:                         # skew least-loaded forever
                 status, payload, lines = self._forward(
-                    h, "POST", "/predict", body, extra_head=extra_head)
+                    h, "POST", "/predict", body, extra_head=extra_head,
+                    ctype=ctype)
                 with h._lock:
                     h.forwarded += 1
                 total_s = time.monotonic() - t0
@@ -714,7 +717,8 @@ class RouterServer:
 
     def _forward(self, h: ReplicaHandle, method: str, path: str,
                  body: bytes, timeout: Optional[float] = None,
-                 extra_head: bytes = b""):
+                 extra_head: bytes = b"",
+                 ctype: str = "application/json"):
         """One raw-HTTP exchange on a pooled connection. Returns
         ``(status, body_bytes, head_lines)`` — ``head_lines`` is the
         replica's status line + header lines + blank terminator, so the
@@ -730,8 +734,8 @@ class RouterServer:
                 else _RawConn(h.host, h.port, timeout))
         head = (f"{method} {path} HTTP/1.1\r\n"
                 f"Host: {h.host}:{h.port}\r\n"
-                f"Content-Type: application/json\r\n"
-                f"Content-Length: {len(body)}\r\n").encode("ascii") \
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n").encode("latin-1") \
             + extra_head + b"\r\n"
         try:
             conn.sock.sendall(head + body)

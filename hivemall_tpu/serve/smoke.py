@@ -62,6 +62,10 @@ def main(argv=None) -> int:
     ap.add_argument("--threads", type=int, default=8)
     ap.add_argument("--p99-budget-ms", type=float, default=1500.0,
                     help="per-request p99 wall budget (generous: CPU CI)")
+    ap.add_argument("--plane", default="threaded",
+                    choices=("threaded", "evloop"),
+                    help="serving plane under test (docs/SERVING.md "
+                         "'Serving planes')")
     args = ap.parse_args(argv)
     # lockset race sanitizer (HIVEMALL_TPU_TSAN=1): enable BEFORE any
     # serve object exists so every lock in the system is born wrapped;
@@ -120,7 +124,11 @@ def _run(args, tmp: str) -> int:
     engine = PredictEngine("train_classifier", opts, checkpoint_dir=tmp,
                            watch_interval=0.2,
                            warmup_len=max(len(r) for r in rows))
-    srv = PredictServer(engine, port=0, max_delay_ms=10.0).start()
+    if args.plane == "evloop":
+        from ..serve.evloop import EvloopPredictServer as _ServerCls
+    else:
+        _ServerCls = PredictServer
+    srv = _ServerCls(engine, port=0, max_delay_ms=10.0).start()
     base = f"http://127.0.0.1:{srv.port}"
     try:
         return _drive(args, tmp, ds, rows, ref, engine, srv, base)

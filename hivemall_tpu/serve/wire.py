@@ -1,0 +1,130 @@
+"""Compact pre-tokenized wire format for ``/predict`` bodies.
+
+The JSON protocol ships feature STRINGS (``"123:0.5"``) and pays a
+libsvm string parse + feature hash per row on the serving hot path.
+Clients that already hold hashed ids (anything that ran the trainer's
+parser once — offline featurizers, the router's replay tee, bench
+drivers) can skip that entirely by POSTing a fixed binary frame
+instead, negotiated per-request via ``Content-Type:
+application/x-hivemall-frame``.  JSON string bodies remain fully
+supported on the same listener and bit-match frame scores (same hashed
+ids -> same kernels -> same bits); see docs/SERVING.md "Serving
+planes".
+
+Frame layout (all little-endian, no alignment padding)::
+
+    magic    4s   b"HMF1"
+    flags    u8   bit0: per-request deadline_ms present; rest reserved 0
+    n_rows   u16
+    deadline f32  milliseconds (present iff flags bit0)
+    per row:
+        n_feat u16
+        idx    i32 * n_feat   hashed feature ids (trainer hash space)
+        val    f32 * n_feat
+
+Decoded rows are exactly the trainer's pre-parsed shape —
+``(int32[n], float32[n])`` tuples — which ``Trainer._parse_row``
+passes through untouched, so a frame predict shares every byte of the
+scoring path after parse.  Malformed or truncated frames raise
+:class:`WireError`; servers answer 400 and close the connection
+(a desynced binary stream cannot be resynchronized mid-connection).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"HMF1"
+#: Content-Type negotiating the binary frame protocol on /predict.
+CONTENT_TYPE_FRAME = "application/x-hivemall-frame"
+
+_FLAG_DEADLINE = 0x01
+_HEAD = struct.Struct("<4sBH")          # magic, flags, n_rows
+_DEADLINE = struct.Struct("<f")
+_NFEAT = struct.Struct("<H")
+
+#: Hard cap on rows per frame (u16 field; also bounds a hostile frame).
+MAX_ROWS = 0xFFFF
+
+
+class WireError(ValueError):
+    """Malformed or truncated binary frame."""
+
+
+def encode_frame(rows, deadline_ms: Optional[float] = None) -> bytes:
+    """Encode pre-parsed ``(idx, val)`` rows into one binary frame.
+
+    ``rows`` is a sequence of ``(int32-array-like, float32-array-like)``
+    tuples in the trainer's hashed id space (e.g. straight from
+    ``Trainer._parse_row`` or a decoded frame).
+    """
+    if len(rows) > MAX_ROWS:
+        raise WireError(f"frame rows {len(rows)} > {MAX_ROWS}")
+    flags = _FLAG_DEADLINE if deadline_ms is not None else 0
+    out = [_HEAD.pack(MAGIC, flags, len(rows))]
+    if deadline_ms is not None:
+        out.append(_DEADLINE.pack(float(deadline_ms)))
+    for idx, val in rows:
+        i = np.ascontiguousarray(np.asarray(idx, np.dtype("<i4")))
+        v = np.ascontiguousarray(np.asarray(val, np.dtype("<f4")))
+        if i.ndim != 1 or i.shape != v.shape:
+            raise WireError(f"row shape mismatch: idx {i.shape} "
+                            f"val {v.shape}")
+        if len(i) > 0xFFFF:
+            raise WireError(f"row features {len(i)} > 65535")
+        out.append(_NFEAT.pack(len(i)))
+        out.append(i.tobytes())
+        out.append(v.tobytes())
+    return b"".join(out)
+
+
+def decode_frame(body: bytes, max_row_features: int = 0,
+                 ) -> Tuple[List[Tuple[np.ndarray, np.ndarray]],
+                            Optional[float]]:
+    """Decode one binary frame into ``(rows, deadline_ms)``.
+
+    Rows come back as ``(int32[n], float32[n])`` tuples.  A positive
+    ``max_row_features`` bounds each row (the engine's per-row cap,
+    enforced here so a hostile frame fails before allocation).
+    Raises :class:`WireError` on any structural problem, including
+    trailing garbage after the last row.
+    """
+    if len(body) < _HEAD.size:
+        raise WireError(f"frame truncated: {len(body)} bytes < header")
+    magic, flags, n_rows = _HEAD.unpack_from(body, 0)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if flags & ~_FLAG_DEADLINE:
+        raise WireError(f"unknown flags 0x{flags:02x}")
+    off = _HEAD.size
+    deadline_ms: Optional[float] = None
+    if flags & _FLAG_DEADLINE:
+        if len(body) < off + _DEADLINE.size:
+            raise WireError("frame truncated in deadline")
+        deadline_ms = float(_DEADLINE.unpack_from(body, off)[0])
+        off += _DEADLINE.size
+    rows: List[Tuple[np.ndarray, np.ndarray]] = []
+    for r in range(n_rows):
+        if len(body) < off + _NFEAT.size:
+            raise WireError(f"frame truncated at row {r} length")
+        (n_feat,) = _NFEAT.unpack_from(body, off)
+        off += _NFEAT.size
+        if max_row_features and n_feat > max_row_features:
+            raise WireError(f"row {r}: {n_feat} features > cap "
+                            f"{max_row_features}")
+        need = n_feat * 8                # i32 + f32 per feature
+        if len(body) < off + need:
+            raise WireError(f"frame truncated in row {r} payload")
+        idx = np.frombuffer(body, np.dtype("<i4"), n_feat, off)
+        off += n_feat * 4
+        val = np.frombuffer(body, np.dtype("<f4"), n_feat, off)
+        off += n_feat * 4
+        # frombuffer views are read-only and may be unaligned; copy to
+        # native-order owned arrays (the padding kernels slice these)
+        rows.append((idx.astype(np.int32), val.astype(np.float32)))
+    if off != len(body):
+        raise WireError(f"{len(body) - off} trailing bytes after frame")
+    return rows, deadline_ms

@@ -102,6 +102,8 @@ _patched: Dict[type, Any] = {}               # cls -> original __setattr__
 _AUTO_REGISTER: Tuple[Tuple[str, str], ...] = (
     ("hivemall_tpu.serve.engine", "PredictEngine"),
     ("hivemall_tpu.serve.batcher", "MicroBatcher"),
+    ("hivemall_tpu.serve.evloop", "InlineAssembler"),
+    ("hivemall_tpu.serve.evloop", "EvloopPredictServer"),
     ("hivemall_tpu.serve.router", "RouterServer"),
     ("hivemall_tpu.serve.fleet", "ReplicaManager"),
     ("hivemall_tpu.serve.fleet", "Fleet"),

@@ -78,6 +78,19 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
     python -m hivemall_tpu.serve.smoke || exit $?
 
+# evloop serve smoke (docs/SERVING.md "Serving planes"): the SAME
+# acceptance surface on the epoll event-loop plane — selectors front
+# end + inline batch assembly (serve/evloop.py) must coalesce,
+# bit-match, hot-reload with zero drops, and pass the identical tsan
+# lockset + leaktrack census gates (the loop thread owns all per-
+# connection and assembler state; everything crossing threads goes
+# through message queues, so ANY write/write race here is a real bug).
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
+    HIVEMALL_TPU_LEAKTRACK=1 \
+    HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
+    python -m hivemall_tpu.serve.smoke --plane evloop || exit $?
+
 # fleet smoke (docs/SERVING.md "Fleet topology"): 2 replica PROCESSES
 # behind the front-end router — concurrent routed predicts bit-match
 # predict_proba and fan across both replicas; killing one replica under
@@ -99,6 +112,19 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     HIVEMALL_TPU_LEAKTRACK=1 \
     HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
     python -m hivemall_tpu.serve.fleet_smoke || exit $?
+
+# evloop fleet smoke: the same fleet acceptance surface with evloop
+# replicas behind the evloop router front end — including the
+# router->replica UDS fast path (every forward must stay on the unix
+# socket; a TCP fallback fails the uds_fast_path check), the kill/
+# respawn zero-drop guarantee and the rolling reload, under the same
+# tsan + leaktrack gates (replica workers census their own sockets,
+# including the UDS listener, on drain).
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
+    HIVEMALL_TPU_LEAKTRACK=1 \
+    HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
+    python -m hivemall_tpu.serve.fleet_smoke --plane evloop || exit $?
 
 # promotion smoke (docs/RELIABILITY.md "Promotion and rollback"): gated
 # model promotion over a 2-replica fleet under live traffic — a
