@@ -434,6 +434,134 @@ def bench_shard_cache(n_rows: int = 131072, smoke: bool = False) -> dict:
     }
 
 
+def bench_bulk_score(n_rows: int = 131072, smoke: bool = False) -> dict:
+    """Warehouse bulk scoring (round 12, `hivemall_tpu predict --input
+    <dir>`): rows/s through the offline scorer along the axes the bulk
+    path claims — cold vs warm shard-decode cache, jitted kernel vs the
+    mmap'd arena twins (f32/int8), and 1 vs 2 worker processes — plus a
+    row-at-a-time predict_proba reference so the batch headroom (the
+    reason a bulk plane exists at all) is its own number. HEADLINE is
+    the warm-cache single-worker kernel rate: the per-worker engine
+    speed that multiplies across a scoring fleet, and the only point
+    stable enough to gate on this container (the 2-worker point pays
+    two fresh JAX process spawns per job, which only amortizes at
+    warehouse row counts — recorded, machine-bound-flagged, not the
+    headline)."""
+    import os
+    import shutil
+    import tempfile
+    import numpy as np
+    from hivemall_tpu.catalog import lookup
+    from hivemall_tpu.io.arrow import write_parquet_shards
+    from hivemall_tpu.io.bulk import _synth, bulk_predict
+    from hivemall_tpu.io.sparse import SparseDataset
+
+    if smoke:
+        n_rows = min(n_rows, 4096)
+    dims = 4096 if smoke else 1 << 20
+    max_len = 16
+    opts = f"-dims {dims} -mini_batch 256"
+    ncpu = os.cpu_count() or 1
+    machine_bound = ncpu < 4            # master + 2 workers need cores
+
+    tmp = tempfile.mkdtemp(prefix="bench_bulk_score_")
+    try:
+        cls = lookup("train_classifier").resolve()
+        trainer = cls(opts)
+        trainer.fit(_synth(1024 if smoke else 8192, dims, max_len, seed=5))
+        _sync(trainer)
+        ckdir = os.path.join(tmp, "ck")
+        os.makedirs(ckdir)
+        trainer.save_bundle(os.path.join(
+            ckdir, f"{cls.NAME}-step{int(trainer._t):010d}.npz"))
+
+        test = _synth(n_rows, dims, max_len, seed=6)
+        in_dir = os.path.join(tmp, "in")
+        write_parquet_shards(test, in_dir,
+                             rows_per_shard=max(256, n_rows // 16))
+        cache_dir = os.path.join(tmp, "cache")
+        last = {}
+
+        def job(tag, backend, precision, workers, fresh_cache=False):
+            def go():
+                if fresh_cache:
+                    shutil.rmtree(cache_dir, ignore_errors=True)
+                out = os.path.join(tmp, f"out_{tag}")
+                shutil.rmtree(out, ignore_errors=True)
+                last[tag] = bulk_predict(
+                    "train_classifier", in_dir, out, options=opts,
+                    checkpoint_dir=ckdir, backend=backend,
+                    precision=precision, workers=workers,
+                    cache_dir=cache_dir)
+            return go
+
+        job("warmup", "kernel", "f32", 1, fresh_cache=True)()  # jit warm
+        cold_best, cold_med, _ = _repeat(
+            job("cold", "kernel", "f32", 1, fresh_cache=True), 2)
+        warm_best, warm_med, _ = _repeat(job("warm", "kernel", "f32", 1), 3)
+        af32_best, _, _ = _repeat(job("af32", "arena", "f32", 1), 2)
+        int8_best, int8_med, _ = _repeat(job("int8", "arena", "int8", 1), 3)
+        multi_best, multi_med, _ = _repeat(
+            job("multi", "kernel", "f32", 2), 1 if smoke else 2)
+        assert last["warm"]["rows"] == n_rows, last["warm"]
+
+        # row-at-a-time reference: one predict_proba dispatch per row,
+        # the serve-style cost a bulk job amortizes away
+        k = 64 if smoke else 256
+        rows = []
+        for i in range(k):
+            s, e = int(test.indptr[i]), int(test.indptr[i + 1])
+            rows.append(SparseDataset(
+                test.indices[s:e], np.asarray([0, e - s], np.int64),
+                test.values[s:e], test.labels[i:i + 1]))
+        trainer.predict_proba(rows[0])
+        t1 = time.perf_counter()
+        for r in rows:
+            trainer.predict_proba(r)
+        single_rate = k / (time.perf_counter() - t1)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    value = round(n_rows / warm_best, 1)
+    return {
+        "metric": "bulk_score_rows_per_sec",
+        "value": value,
+        "value_median": round(n_rows / warm_med, 1), "unit": "rows/sec",
+        "seconds": round(warm_best, 3),
+        "cold_single_rows_per_sec": round(n_rows / cold_best, 1),
+        "cold_single_median_rows_per_sec": round(n_rows / cold_med, 1),
+        "warm_multi_rows_per_sec": round(n_rows / multi_best, 1),
+        "warm_vs_cold": round(cold_best / warm_best, 3),
+        "warm_multi_vs_cold_single": round(cold_best / multi_best, 3),
+        "workers_curve": {"1": round(n_rows / warm_best, 1),
+                          "2": round(n_rows / multi_best, 1)},
+        "arena_f32_rows_per_sec": round(n_rows / af32_best, 1),
+        "arena_int8_rows_per_sec": round(n_rows / int8_best, 1),
+        "int8_vs_kernel": round(warm_best / int8_best, 3),
+        "single_row_rows_per_sec": round(single_rate, 1),
+        "batch_headroom": round(value / single_rate, 1),
+        "worker_utilization": last["multi"]["worker_utilization"],
+        "metrics": last["warm"]["metrics"],
+        "bundle_source": last["warm"]["bundle_source"],
+        "bulk_machine_bound": machine_bound,
+        "cpu_count": ncpu,
+        "extra_results": {"bulk_score_int8": [
+            round(n_rows / int8_best, 1), round(n_rows / int8_med, 1)]},
+        "note": "value = warm-cache 1-worker kernel f32 end-to-end "
+                "(decode-from-cache + score + scored-parquet write + "
+                "eval UDAFs); cold = fresh cache dir each rep (decode + "
+                "cache-build tee); warm_multi = 2 spawned worker "
+                "processes, pays 2x JAX process start per job so it only "
+                "amortizes at warehouse row counts — bulk_machine_bound "
+                "means too few cores for master+2 workers and the point "
+                "measures the machine ceiling, like fleet scaling; "
+                "arena_* = mmap'd weight-arena twins (device-free "
+                "scoring, int8 gated via extra_results bulk_score_int8); "
+                "single_row = one predict_proba dispatch per row, "
+                "batch_headroom = value/single_row (the --smoke "
+                "no-collapse floor)",
+    }
+
+
 def bench_ingest(n_rows: int = 200000) -> dict:
     """Host ingest: LIBSVM text bytes -> parsed SparseDataset (the L0 path).
     Reported in rows/sec; runs the native C++ parser when built."""
@@ -1561,7 +1689,8 @@ def bench_topk_knn() -> dict:
 
 _BENCHES = ("bench_linear", "bench_ffm_kernel", "bench_ffm_e2e",
             "bench_ffm_parquet_stream", "bench_shard_cache", "bench_ingest",
-            "bench_dispatch_fusion", "bench_serve", "bench_fm",
+            "bench_dispatch_fusion", "bench_serve", "bench_bulk_score",
+            "bench_fm",
             "bench_mf", "bench_word2vec", "bench_trees", "bench_gbt",
             "bench_seq_exact", "bench_mix", "bench_lda",
             "bench_changefinder", "bench_topk_knn")
@@ -2043,6 +2172,7 @@ _SMOKE = (
     ("bench_shard_cache", {"n_rows": 8192, "smoke": True}),
     ("bench_dispatch_fusion", {"n_batches": 24, "smoke": True}),
     ("bench_serve", {"smoke": True}),
+    ("bench_bulk_score", {"n_rows": 4096, "smoke": True}),
 )
 
 # bench_ffm_e2e stage-metric keys the smoke run requires (the acceptance
@@ -2189,6 +2319,39 @@ def main_smoke() -> int:
                     (f"2-replica fleet scaling {s2} below floor {floor} "
                      f"(machine_bound={rec['fleet_machine_bound']}, "
                      f"{rec['cpu_count']} cpus): {curve}")
+            if name == "bench_bulk_score":
+                # the bulk no-collapse floor (ISSUE 17): batched offline
+                # scoring must clear row-at-a-time predict_proba dispatch
+                # by the batch headroom — losing it means the bulk plane
+                # degenerated into the serve path with extra steps
+                assert rec["batch_headroom"] >= 2.0, \
+                    (f"bulk scoring ({rec['value']} rows/s) lost its "
+                     f"batch headroom vs row-at-a-time dispatch "
+                     f"({rec['single_row_rows_per_sec']} rows/s)")
+                # warm decode cache must not lose to cold + cache-build;
+                # scoring/write dominate bulk wall (unlike the pure-decode
+                # epochs bench_shard_cache pins at >= 1.0) so the warm win
+                # is small here and gets a noise margin
+                assert rec["warm_vs_cold"] >= 0.9, \
+                    (f"warm-cache bulk run ({rec['value']} rows/s) "
+                     f"regressed below the cold cache-build run "
+                     f"({rec['cold_single_rows_per_sec']} rows/s)")
+                # the arena twins must score, and int8 must be recorded
+                # as its own gated key
+                assert rec["arena_f32_rows_per_sec"] > 0 \
+                    and rec["extra_results"]["bulk_score_int8"][0] > 0, rec
+                assert rec["metrics"].get("logloss", 0) > 0, rec["metrics"]
+                # 2-worker scaling: >= 2x cold-single where the cores
+                # exist (the acceptance criterion); on a core-starved CI
+                # host the point pays two serialized JAX spawns against
+                # one core and measures the machine ceiling — flagged,
+                # not gated (same escape as fleet scaling)
+                if not rec["bulk_machine_bound"]:
+                    assert rec["warm_multi_vs_cold_single"] >= 2.0, \
+                        (f"2-worker bulk scaling "
+                         f"{rec['warm_multi_vs_cold_single']} below 2.0 "
+                         f"({rec['cpu_count']} cpus)")
+                assert rec["warm_multi_rows_per_sec"] > 0, rec
             if name == "bench_shard_cache":
                 # the cache floor (round 6): a warm mmap epoch must never
                 # run slower than the cold build epoch, and its prep legs

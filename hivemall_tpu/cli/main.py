@@ -214,10 +214,43 @@ def _cmd_train(args) -> int:
     return 0
 
 
+def _cmd_bulk_predict(args) -> int:
+    """The warehouse path: Parquet shard dir (or one file) scored from a
+    checkpoint bundle through io.bulk — packed shard caches, process
+    fan-out, kernel/arena backend pick, scored Parquet + logloss/AUC in
+    one pass, optional fused score→top-k (docs/PERFORMANCE.md "Bulk
+    scoring"). The final record embeds the obs snapshot like train runs,
+    so the `bulk` section rides next to ingest_cache/devprof."""
+    import os
+    from ..io.bulk import bulk_predict
+    from ..obs.registry import registry
+
+    result = bulk_predict(
+        args.algo, args.input, args.output,
+        options=args.options or "",
+        bundle=args.bundle, checkpoint_dir=args.checkpoint_dir,
+        backend=args.backend, precision=args.precision,
+        workers=args.workers, batch_size=args.batch_size or None,
+        cache_dir=args.cache_dir, top_k=args.top_k,
+        group_col=args.group_col, feature_col=args.feature_col,
+        label_col=args.label_col)
+    result["snapshot"] = registry.snapshot()
+    print(json.dumps(result, default=str))
+    return 0
+
+
 def _cmd_predict(args) -> int:
+    import os
     from ..catalog import lookup
     from ..frame.evaluation import auc, logloss, rmse
 
+    if args.bundle or args.checkpoint_dir or os.path.isdir(args.input):
+        return _cmd_bulk_predict(args)
+    if not args.model:
+        print("error: --model (model TSV) is required unless bulk "
+              "scoring via --bundle/--checkpoint-dir or a Parquet "
+              "directory --input", file=sys.stderr)
+        return 2
     cls = lookup(args.algo).resolve()
     trainer = cls((args.options or "")
                   + f" -loadmodel {shlex.quote(args.model)}")
@@ -698,14 +731,49 @@ def main(argv=None) -> int:
                         "a `profile` event in the metrics stream)")
     t.set_defaults(fn=_cmd_train)
 
-    pr = sub.add_parser("predict", help="score a LIBSVM file with a model")
+    pr = sub.add_parser(
+        "predict",
+        help="score a LIBSVM file with a model table, or bulk-score a "
+             "Parquet shard dir / file from a checkpoint bundle")
     pr.add_argument("--algo", required=True)
-    pr.add_argument("--model", required=True)
+    pr.add_argument("--model", default=None,
+                    help="model TSV (-loadmodel) for the single-file path")
     pr.add_argument("--input", required=True)
-    pr.add_argument("--output", default=None)
+    pr.add_argument("--output", default=None,
+                    help="scores TSV (single-file path) or scored-Parquet "
+                         "output dir (bulk path)")
     pr.add_argument("--options", default="")
     pr.add_argument("--metric", default=None,
                     choices=[None, "auc", "logloss", "rmse"])
+    # bulk path (docs/PERFORMANCE.md "Bulk scoring"): any of
+    # --bundle/--checkpoint-dir, or a directory --input, routes here
+    pr.add_argument("--bundle", default=None,
+                    help="bulk: score with this checkpoint bundle")
+    pr.add_argument("--checkpoint-dir", default=None,
+                    help="bulk: resolve the model from this dir's PROMOTED "
+                         "pointer (newest bundle if nothing promoted)")
+    pr.add_argument("--backend", default="auto",
+                    choices=("auto", "kernel", "arena"),
+                    help="bulk: jitted kernels, mmap'd arena twins, or "
+                         "probe-and-pick (default)")
+    pr.add_argument("--precision", default="f32",
+                    choices=("f32", "bf16", "int8"),
+                    help="bulk: arena scoring tier (non-f32 implies "
+                         "--backend arena)")
+    pr.add_argument("--workers", type=int, default=1,
+                    help="bulk: worker processes (1 = in-process)")
+    pr.add_argument("--batch-size", type=int, default=0,
+                    help="bulk: override the scoring batch size")
+    pr.add_argument("--cache-dir", default=None,
+                    help="bulk: shard decode cache dir (share it with "
+                         "training's -shard_cache_dir for warm scans)")
+    pr.add_argument("--top-k", type=int, default=0,
+                    help="bulk: per-group top-k over scored rows "
+                         "(each_top_k; negative = bottom-k)")
+    pr.add_argument("--group-col", default=None,
+                    help="bulk: Parquet group column for --top-k")
+    pr.add_argument("--feature-col", default="features")
+    pr.add_argument("--label-col", default="label")
     pr.set_defaults(fn=_cmd_predict)
 
     m = sub.add_parser("mixserv", help="run a standalone mix server")

@@ -53,7 +53,7 @@ __all__ = [
     # sampling
     "reservoir_sample",
     # top-level
-    "generate_series", "each_top_k",
+    "generate_series", "each_top_k", "TopKAccumulator",
 ]
 
 
@@ -611,3 +611,57 @@ def each_top_k(k: int, group_col: Sequence, score_col: Sequence[float],
                     tuple(c[i] for c in value_cols)))
     if buf:
         yield from flush(buf)
+
+
+class TopKAccumulator:
+    """Streaming per-group top-k over UNGROUPED row arrival — the bulk
+    scoring side of :func:`each_top_k`.
+
+    ``each_top_k`` needs CLUSTER BY order (consecutive same-group rows); a
+    sharded bulk scan delivers groups interleaved across shards. This
+    accumulator keeps a k-bounded heap per group (memory is O(groups * k),
+    never O(rows)), then :meth:`result` replays each group's survivors —
+    restored to arrival order — through ``each_top_k`` itself, so ranking
+    and tie semantics (stable sort on score, earliest arrival wins ties)
+    are byte-for-byte the reference UDTF's. Negative k = bottom-k, matching
+    ``each_top_k``. Retaining the k best per group is exact: a row outside
+    its group's k best can never appear in the group's final top-k."""
+
+    def __init__(self, k: int):
+        import heapq
+        self._heapq = heapq
+        self.k = int(k)
+        self._kk = abs(self.k)
+        self._groups: Dict = {}
+        self._n = 0
+
+    def add(self, group, score, *values) -> None:
+        if self._kk == 0:
+            return
+        self._n += 1
+        s = float(score)
+        # min-heap on the KEEP preference: evict the lowest score (top-k)
+        # or highest (bottom-k); among equal scores evict the LATEST
+        # arrival (-n), because the stable flush ranks earliest first
+        key = (s, -self._n) if self.k > 0 else (-s, -self._n)
+        entry = (key, self._n, s, values)
+        h = self._groups.setdefault(group, [])
+        if len(h) < self._kk:
+            self._heapq.heappush(h, entry)
+        elif key > h[0][0]:
+            self._heapq.heapreplace(h, entry)
+
+    def add_many(self, groups: Sequence, scores: Sequence[float],
+                 *value_cols: Sequence) -> None:
+        for i in range(len(groups)):
+            self.add(groups[i], scores[i], *(c[i] for c in value_cols))
+
+    def result(self) -> Iterator[Tuple]:
+        """``(group, rank, score, *values)`` rows, groups in first-seen
+        order, ranks from ``each_top_k`` over the retained candidates."""
+        for g, h in self._groups.items():
+            rows = sorted(h, key=lambda e: e[1])       # arrival order
+            cols = list(zip(*(e[3] for e in rows))) if rows else []
+            for out in each_top_k(self.k, [g] * len(rows),
+                                  [e[2] for e in rows], *cols):
+                yield (g,) + tuple(out)

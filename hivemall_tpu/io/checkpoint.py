@@ -24,13 +24,15 @@ the ``-checkpoint_dir`` / ``-checkpoint_every`` trainer options.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
 import re
+import threading
 import time
 import weakref
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -41,7 +43,8 @@ __all__ = ["save_bundle", "load_bundle", "CheckpointManager", "list_bundles",
            "bundle_step", "newest_bundle", "verify_bundle", "bundle_meta",
            "read_promoted", "promoted_bundle", "promote_bundle",
            "finalize_promotion", "rollback_promoted", "reject_bundle",
-           "is_rejected", "rejected_reason", "pinned_bundles"]
+           "is_rejected", "rejected_reason", "pinned_bundles",
+           "pin_bundle", "unpin_bundle", "hold_bundle", "in_use_bundles"]
 
 _FORMAT = 2          # 2 adds the digest manifest + stream position
 _STEP_RE = re.compile(r"-step(\d+)\.npz$")
@@ -451,6 +454,95 @@ def pinned_bundles(checkpoint_dir: str) -> set:
     return pinned
 
 
+#: in-use marker suffix: `<bundle>.pin.<pid>` — a long-running reader (bulk
+#: scoring job, gate evaluation) holds the bundle open; retention must not
+#: GC it mid-run even when it has aged out of the last-k window
+_PIN_SUFFIX = ".pin"
+_PIN_RE = re.compile(r"\.pin\.(\d+)$")
+_pin_lock = threading.Lock()
+_pin_refs: Dict[str, int] = {}
+
+
+def _pin_file(path: str) -> str:
+    return f"{path}{_PIN_SUFFIX}.{os.getpid()}"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+def pin_bundle(path: str) -> None:
+    """Mark ``path`` in use by this process: an on-disk ``.pin.<pid>``
+    sidecar (atomic write, same discipline as every other marker) that
+    :meth:`CheckpointManager._prune` treats exactly like a pointer pin.
+    Refcounted per process — nested holds write one sidecar."""
+    with _pin_lock:
+        n = _pin_refs.get(path, 0)
+        if n == 0:
+            _atomic_write_json(_pin_file(path),
+                               {"pid": os.getpid(),
+                                "ts": round(time.time(), 3)})
+        _pin_refs[path] = n + 1
+
+
+def unpin_bundle(path: str) -> None:
+    """Drop one hold on ``path``; the sidecar is removed when the last
+    in-process hold releases. Safe to call for a never-pinned path."""
+    with _pin_lock:
+        n = _pin_refs.get(path, 0)
+        if n > 1:
+            _pin_refs[path] = n - 1
+            return
+        _pin_refs.pop(path, None)
+        try:
+            os.remove(_pin_file(path))
+        except OSError:
+            pass
+
+
+@contextlib.contextmanager
+def hold_bundle(path: str) -> Iterator[str]:
+    """Context-managed :func:`pin_bundle`/:func:`unpin_bundle` pair — the
+    way a bulk job keeps its model bundle alive for the whole run."""
+    pin_bundle(path)
+    try:
+        yield path
+    finally:
+        unpin_bundle(path)
+
+
+def in_use_bundles(checkpoint_dir: str) -> set:
+    """Bundle paths pinned by a LIVE process (``.pin.<pid>`` sidecars).
+    Stale pins left by a crashed/killed holder are removed here — a dead
+    pid must not leak retention forever."""
+    out: set = set()
+    try:
+        names = os.listdir(checkpoint_dir)
+    except OSError:
+        return out
+    for name in names:
+        m = _PIN_RE.search(name)
+        if not m:
+            continue
+        full = os.path.join(checkpoint_dir, name)
+        if _pid_alive(int(m.group(1))):
+            out.add(os.path.join(checkpoint_dir, name[: m.start()]))
+        else:
+            try:
+                os.remove(full)
+            except OSError:
+                pass
+    return out
+
+
 class CheckpointManager:
     """Autosave cadence + last-k retention over atomic ``save_bundle``.
 
@@ -547,7 +639,10 @@ class CheckpointManager:
         # file out from under the fleet, and pruning the rollback target
         # would make auto-rollback impossible exactly when a bad canary
         # needs it (docs/RELIABILITY.md "Promotion and rollback")
-        pinned = pinned_bundles(self.dir)
+        # ... and so are bundles a live reader holds open (.pin.<pid>
+        # sidecars): a bulk scoring job that resolved its model at launch
+        # must not have the file GC'd out from under it mid-run
+        pinned = pinned_bundles(self.dir) | in_use_bundles(self.dir)
         for path in paths[self.keep:]:
             if path in pinned:
                 continue

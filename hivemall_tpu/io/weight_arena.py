@@ -55,7 +55,7 @@ from .shard_cache import (CacheInvalid, read_cache_file, write_cache_file)
 from .sparse import SparseBatch
 
 __all__ = ["ArenaUnsupported", "WeightArena", "arena_path",
-           "publish_arena", "open_arena", "quantize_int8",
+           "publish_arena", "open_arena", "try_open_arena", "quantize_int8",
            "score_error_bound", "host_rss_bytes", "PRECISIONS"]
 
 ARENA_SUFFIX = ".arena"
@@ -459,3 +459,29 @@ def open_arena(path: str) -> WeightArena:
         raise CacheInvalid(f"{path}: not a weight arena "
                            f"(kind={header.get('kind')!r})")
     return WeightArena(path, header, views)
+
+
+def try_open_arena(bundle_path: str, *, trainer_name: Optional[str] = None,
+                   precision: Optional[str] = None
+                   ) -> Optional[WeightArena]:
+    """Open ``<bundle>.arena`` IFF it is valid FOR THIS BUNDLE, else None.
+
+    The shared open-or-miss step of the serve engine's arena load and the
+    bulk scorer's arena backend: a missing, torn, stale (digest mismatch
+    after an in-place republish), foreign-trainer, or partial-precision
+    sidecar is a MISS — callers route into publish_arena — never an
+    exception. A mismatched arena that did open is released before
+    returning so the probe itself can never leak an mmap."""
+    ap = arena_path(bundle_path)
+    if not os.path.exists(ap):
+        return None
+    try:
+        a = open_arena(ap)
+    except (ValueError, OSError, KeyError):
+        return None
+    if a.matches_bundle(bundle_path) \
+            and (trainer_name is None or a.trainer_name == trainer_name) \
+            and (precision is None or precision in a.precisions):
+        return a
+    a.release()
+    return None

@@ -1185,6 +1185,20 @@ class LearnerBase:
             out[s:s + nv] = np.asarray(margin(b))[:nv]
         return out
 
+    def score_dataset(self, ds: SparseDataset,
+                      batch_size: Optional[int] = None) -> np.ndarray:
+        """Output-space scores for a whole dataset — the bulk peer of
+        :meth:`make_scorer`: probabilities for classification trainers
+        (sigmoid over the margin, exactly the ``predict_proba`` space),
+        raw margins otherwise. Same shape-bucketed iterator as
+        ``_score_dataset``, so the bulk scoring path's jitted kernel
+        backend reuses the offline compile buckets."""
+        m = self._score_dataset(ds, batch_size)
+        if getattr(self, "classification",
+                   getattr(self, "CLASSIFICATION", False)):
+            return sigmoid_np(m)
+        return m
+
     # -- model emission (the close()-time forward of (feature, weight)) -----
     def model_rows(self) -> Iterator[Tuple[str, float]]:
         w = np.asarray(self._finalized_weights())

@@ -131,6 +131,13 @@ RETRAIN_STUB = {"configured": False, "state": "idle", "attempts": 0,
                 "last_error": None,
                 "replay": {"rows": 0, "rows_dropped": 0, "segments": 0,
                            "pending_rows": 0}}
+#: io.bulk.BulkProgress.obs_section() before any bulk job ran — the
+#: offline scoring plane's section, key-for-key the live provider's shape
+BULK_STUB = {"active": False, "input": None, "output": None,
+             "backend": None, "precision": None, "workers": 0,
+             "shards_total": 0, "shards_done": 0, "rows_scored": 0,
+             "rows_per_sec": 0.0, "worker_utilization": 0.0,
+             "elapsed_seconds": 0.0, "model_step": None, "bundle": None}
 
 registry = Registry()
 registry.register("mix", lambda: dict(MIX_STUB))
@@ -160,6 +167,9 @@ registry.register("promotion", lambda: {**PROMOTION_STUB,
 registry.register("retrain", lambda: {**RETRAIN_STUB,
                                       "replay":
                                       dict(RETRAIN_STUB["replay"])})
+# io.bulk.bulk_predict overrides this with live shard/rows-per-sec
+# progress while a bulk scoring job runs in this process
+registry.register("bulk", lambda: dict(BULK_STUB))
 # obs.devprof.DevProf overrides this with live compile/retrace/memory
 # telemetry on first use (any trainer construction)
 from .devprof import devprof_stub  # noqa: E402 — stub needs the dict shape

@@ -269,6 +269,24 @@ def _render(state: _TailState, path: str = "",
         if ev is not None and (ev.get("reason") or ev.get("outcome")):
             out.append(f"  last: {ev.get('outcome') or ev.get('state')}"
                        f" — {ev.get('reason', '?')}")
+
+    # bulk offline scoring (docs/PERFORMANCE.md "Bulk scoring"): a live
+    # job's registry section when a snapshot carries one; otherwise the
+    # newest `bulk` stream event (the job emits its section per shard)
+    bk = (snap or {}).get("bulk") or {}
+    if not (bk.get("active") or bk.get("rows_scored")):
+        bk = state.last.get("bulk") or bk
+    if bk.get("active") or bk.get("rows_scored"):
+        out.append(
+            f"bulk:   [{'scoring' if bk.get('active') else 'done'}]"
+            f"  shards {bk.get('shards_done', 0)}"
+            f"/{bk.get('shards_total', 0)}"
+            f"  rows {bk.get('rows_scored', 0)}"
+            f"  rate {bk.get('rows_per_sec', 0)}/s"
+            f"  backend {bk.get('backend') or '?'}"
+            f"/{bk.get('precision') or '?'}"
+            f"  workers {bk.get('workers', 0)}"
+            f" util {bk.get('worker_utilization', 0)}")
     return "\n".join(out)
 
 

@@ -237,27 +237,15 @@ class PredictEngine:
         slot holds a parse-only facade — no dims-sized allocation, no
         bundle deserialize; N replicas share ONE set of weight pages
         through the page cache."""
-        from ..io.weight_arena import (ArenaUnsupported, arena_path,
-                                       open_arena, publish_arena)
-        ap = arena_path(path)
-        arena = None
-        if os.path.exists(ap):
-            try:
-                a = open_arena(ap)
-                # the requested tier must actually be IN the sidecar: a
-                # partial-precision arena (publish_arena's precisions
-                # kwarg) that merely digest-matches would pass here and
-                # then KeyError on every reload poll forever — treating
-                # it as a miss routes into the republish-all-tiers path
-                if a.matches_bundle(path) \
-                        and a.trainer_name == self._cls.NAME \
-                        and self.precision in a.precisions:
-                    arena = a
-            except (ValueError, OSError, KeyError):
-                pass            # stale/torn sidecar: self-healed by the
-                #                 republish below — recording it as a
-                #                 reload error would leave a standing
-                #                 false alarm on a healthy replica
+        from ..io.weight_arena import (ArenaUnsupported, open_arena,
+                                       publish_arena, try_open_arena)
+        # a stale/torn/partial-precision sidecar is a MISS (try_open_arena's
+        # contract), self-healed by the republish below — recording it as a
+        # reload error would leave a standing false alarm on a healthy
+        # replica. The same open-or-miss step backs the bulk scorer's arena
+        # backend (io/bulk.py), so both planes validate sidecars identically.
+        arena = try_open_arena(path, trainer_name=self._cls.NAME,
+                               precision=self.precision)
         if arena is None:
             # no (valid) sidecar: pay the one-time bundle load HERE,
             # publish the arena, and still serve zero-copy — a

@@ -185,6 +185,18 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m hivemall_tpu.io.shard_cache --smoke || exit $?
 
+# bulk-predict smoke (ISSUE 17, docs/PERFORMANCE.md "Bulk scoring"): a
+# 2-worker-process bulk job over a multi-shard Parquet dir (ragged tail +
+# an empty shard) must BIT-match the offline predict_proba path at f32,
+# stay inside score_error_bound()/4 on the int8 arena twin, and — under
+# tsan + the leaktrack census — leave ZERO leaked fds/threads/mmaps after
+# the worker pool drains.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
+    HIVEMALL_TPU_LEAKTRACK=1 \
+    HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
+    python -m hivemall_tpu.io.bulk --smoke || exit $?
+
 # native-canonicalizer CI guard: the C++ canonicalizer is the DEFAULT in
 # every prep path (fit / fit_stream / serve-side scoring), with the numpy
 # twin as the fallback — when _native.so exists, the bit-equality parity

@@ -166,6 +166,51 @@ def test_checkpoint_manager_retention(tmp_path):
     assert r._t == tr._t                             # newest == final state
 
 
+def test_prune_spares_in_use_bundles(tmp_path):
+    """Last-k retention must not GC a bundle a live reader holds open
+    (the ``.pin.<pid>`` sidecar a bulk scoring job writes via
+    hold_bundle): the held bundle survives pruning past the keep window,
+    ages out normally once the hold releases, and a stale pin left by a
+    dead holder is swept instead of leaking retention forever."""
+    import os
+    import subprocess
+    import sys
+    from hivemall_tpu.io.checkpoint import (CheckpointManager, hold_bundle,
+                                            in_use_bundles)
+
+    feats, y = _rows(64)
+    tr = GeneralClassifier(OPTS)
+    mgr = CheckpointManager(str(tmp_path), tr.NAME, keep=1)
+
+    def advance_and_save(lo, hi):
+        for f, lab in zip(feats[lo:hi], y[lo:hi]):
+            tr.process(f, lab)
+        tr._flush()
+        return mgr.save(tr)
+
+    p1 = advance_and_save(0, 16)
+    with hold_bundle(p1):
+        assert os.path.exists(p1 + f".pin.{os.getpid()}")
+        assert in_use_bundles(str(tmp_path)) == {p1}
+        p2 = advance_and_save(16, 32)         # prune: p1 pinned, survives
+        assert os.path.exists(p1)
+        p3 = advance_and_save(32, 48)         # p2 has no pin: pruned
+        assert os.path.exists(p1) and os.path.exists(p3)
+        assert not os.path.exists(p2)
+    assert not os.path.exists(p1 + f".pin.{os.getpid()}")
+    p4 = advance_and_save(48, 64)             # hold released: p1 ages out
+    assert os.path.exists(p4) and not os.path.exists(p1)
+
+    # a pin whose holder died must be swept, not honored forever
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait()
+    stale = p4 + f".pin.{child.pid}"
+    with open(stale, "w") as f:
+        f.write('{"pid": %d}' % child.pid)
+    assert in_use_bundles(str(tmp_path)) == set()
+    assert not os.path.exists(stale)
+
+
 def test_bundle_rejects_mismatch(tmp_path):
     feats, y = _rows(16)
     tr = GeneralClassifier(OPTS)

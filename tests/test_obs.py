@@ -799,6 +799,13 @@ def test_stub_sections_match_live_providers(tmp_path):
     assert set(retrain_stub()["replay"]) \
         == set(rc.obs_section()["replay"])
 
+    # bulk: BulkProgress.obs_section() (no job run) must mirror
+    # BULK_STUB key-for-key — the offline-scoring plane's section
+    from hivemall_tpu.io.bulk import BulkProgress
+    from hivemall_tpu.obs.registry import BULK_STUB
+    assert set(BULK_STUB) == set(BulkProgress().obs_section()), \
+        "bulk stub drifted from live keys"
+
     # devprof: the stub constructor IS the contract
     from hivemall_tpu.obs.devprof import devprof_stub, get_devprof
     live_dp = get_devprof().obs_section()
