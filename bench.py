@@ -1687,13 +1687,103 @@ def bench_topk_knn() -> dict:
             "knn_cosine_1000x128_seconds": round(dt_knn, 4)}
 
 
+def bench_flight(n_events: int = 200_000, smoke: bool = False) -> dict:
+    """Flight-recorder overhead (docs/OBSERVABILITY.md "Flight recorder"):
+    disabled vs enabled per-event cost, plus the implied tax on the
+    evloop qps ceiling.  Three numbers:
+
+    - disabled_ns_per_check: the guarded seam with the recorder dark —
+      one attribute check, no string built (the contract every request
+      pays when flight is off);
+    - enabled line fast path events/sec (primary metric) and the kwargs
+      form — what the serving seams actually emit;
+    - evloop_tax_pct: (1 + 1/B) line events per request (one req.admit,
+      one batch.done amortized over a B-row batch) priced against
+      BENCH_r11's serve_evloop_int8_qps per-request budget.  This is the
+      noise-free form of the "within 3% of the r11 evloop ceiling"
+      guard: an end-to-end on/off serve pair swings +-20% with process
+      scheduling on this host (measured), so the gate derives the tax
+      from the per-event cost instead, and the recorded run's own
+      serve_evloop_int8_qps is already an enabled-recorder number (the
+      serve bench's fleet has a checkpoint dir, so flight is on by
+      default under <checkpoint_dir>/flight).
+    """
+    import os
+    import shutil
+    import tempfile
+    from hivemall_tpu.obs.flight import FS, FlightRecorder, read_ring
+
+    n = 20_000 if smoke else int(n_events)
+    d = tempfile.mkdtemp(prefix="hivemall_tpu_flight_bench_")
+    try:
+        dark = FlightRecorder()
+
+        def run_disabled():
+            fl = dark
+            for i in range(n):
+                if fl.enabled:
+                    fl.record("req.admit", f"req={i}{FS}rows=2")
+
+        dis_best, dis_med, _ = _repeat(run_disabled, 3)
+
+        fr = FlightRecorder().open(os.path.join(d, "bench.ring"),
+                                   label="bench")
+
+        def run_line():
+            for i in range(n):
+                fr.record("req.admit", f"req={i}{FS}rows=2{FS}depth=0")
+
+        line_best, line_med, _ = _repeat(run_line, 3)
+
+        def run_kwargs():
+            for i in range(n):
+                fr.record("req.admit", req=i, rows=2, depth=0)
+
+        kw_best, _, _ = _repeat(run_kwargs, 3)
+        events = fr.events
+        fr.close()
+        assert events == 6 * n, events  # every record landed in the ring
+        ring = read_ring(os.path.join(d, "bench.ring"))
+        assert ring["torn"] == 0 and ring["events"], ring["torn"]
+
+        line_us = line_best / n * 1e6
+        out = {"metric": "flight_record_events_per_sec",
+               "value": round(n / line_best, 1),
+               "value_median": round(n / line_med, 1),
+               "unit": "events/sec",
+               "seconds": round(line_best, 4),
+               "enabled_line_us_per_event": round(line_us, 3),
+               "enabled_kwargs_us_per_event": round(kw_best / n * 1e6, 3),
+               "disabled_ns_per_check": round(dis_best / n * 1e9, 1),
+               "disabled_ns_per_check_median": round(dis_med / n * 1e9, 1)}
+        ref = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_r11.json")
+        try:
+            with open(ref, encoding="utf-8") as f:
+                r11_qps = float(json.load(f)["results"]
+                                ["serve_evloop_int8_qps"][0])
+        except (OSError, KeyError, ValueError, IndexError):
+            r11_qps = 0.0
+        if r11_qps > 0:
+            budget_us = 1e6 / r11_qps
+            out["r11_evloop_qps_ref"] = round(r11_qps, 1)
+            # admit is per-request; batch.done amortizes across the batch
+            out["evloop_tax_pct_batch1"] = round(
+                2.0 * line_us / budget_us * 100.0, 2)
+            out["evloop_tax_pct"] = round(
+                (1.0 + 1.0 / 8.0) * line_us / budget_us * 100.0, 2)
+        return out
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 _BENCHES = ("bench_linear", "bench_ffm_kernel", "bench_ffm_e2e",
             "bench_ffm_parquet_stream", "bench_shard_cache", "bench_ingest",
             "bench_dispatch_fusion", "bench_serve", "bench_bulk_score",
             "bench_fm",
             "bench_mf", "bench_word2vec", "bench_trees", "bench_gbt",
             "bench_seq_exact", "bench_mix", "bench_lda",
-            "bench_changefinder", "bench_topk_knn")
+            "bench_changefinder", "bench_topk_knn", "bench_flight")
 
 
 def _short_key(metric: str) -> str:
@@ -2173,6 +2263,7 @@ _SMOKE = (
     ("bench_dispatch_fusion", {"n_batches": 24, "smoke": True}),
     ("bench_serve", {"smoke": True}),
     ("bench_bulk_score", {"n_rows": 4096, "smoke": True}),
+    ("bench_flight", {"smoke": True}),
 )
 
 # bench_ffm_e2e stage-metric keys the smoke run requires (the acceptance
@@ -2374,6 +2465,25 @@ def main_smoke() -> int:
                     (f"K=8 fused dispatch ({rec['k8_steps_per_sec']} "
                      f"steps/s) regressed below K=1 "
                      f"({rec['k1_steps_per_sec']} steps/s) — defusion?")
+            if name == "bench_flight":
+                # the no-collapse floor (PR 19): the flight recorder can
+                # never silently tax the evloop qps ceiling.  Enabled
+                # record rate stays far above serving scale (>= 100k
+                # events/s vs ~11k qps needing ~1.1 events/req), the
+                # dark seam stays an attribute check (<= 1us, typically
+                # ~50ns), and the derived per-request tax at 8-row
+                # batches stays inside the 3% acceptance vs BENCH_r11's
+                # evloop ceiling
+                assert rec["value"] >= 100_000, \
+                    (f"enabled flight record rate collapsed: "
+                     f"{rec['value']} events/s < 100k")
+                assert rec["disabled_ns_per_check"] <= 1000, \
+                    (f"disabled flight seam no longer one attribute "
+                     f"check: {rec['disabled_ns_per_check']}ns")
+                if "evloop_tax_pct" in rec:
+                    assert rec["evloop_tax_pct"] <= 3.0, \
+                        (f"flight tax on the r11 evloop ceiling "
+                         f"{rec['evloop_tax_pct']}% > 3%")
             print(f"smoke {name}: OK ({rec['value']} {rec['unit']})",
                   file=sys.stderr)
         except Exception:

@@ -664,14 +664,34 @@ def _cmd_obs(args) -> int:
     checkpoint age. ``--follow`` re-renders as the file grows. ``--slo``
     instead renders a serving SLO report (burn rates, windowed p99,
     drift events) from a serve/router ``/slo`` endpoint or a saved JSON
-    file."""
+    file. ``obs postmortem <dir>`` merges every flight ring under
+    ``<dir>`` into one wall-clock-ordered timeline (docs/OBSERVABILITY.md
+    "Flight recorder"): death gaps flagged per ring, each victim's
+    admitted-but-never-completed request ids, the last ``--tail``
+    events. ``--since`` (shared with the jsonl summary) filters to
+    seconds-ago (< 1e9) or an absolute epoch."""
+    from ..obs.report import parse_since
+    since = parse_since(args.since)
+    if args.file == "postmortem":
+        if not args.target:
+            print("obs postmortem: needs a flight-ring directory "
+                  "(e.g. <checkpoint_dir>/flight)", file=sys.stderr)
+            return 2
+        from ..obs.flight import merge_dir, render_postmortem
+        merged = merge_dir(args.target, since=since)
+        print(render_postmortem(merged, tail=args.tail), end="")
+        if not merged["rings"]:
+            print(f"obs postmortem: no *.ring files under {args.target}",
+                  file=sys.stderr)
+            return 1
+        return 0
     if args.slo:
         from ..obs.report import render_slo_source
         return render_slo_source(args.file, follow=args.follow,
                                  interval=args.interval)
     from ..obs.report import render_file
     return render_file(args.file, follow=args.follow,
-                       interval=args.interval)
+                       interval=args.interval, since=since)
 
 
 def _cmd_define_all(args) -> int:
@@ -1039,13 +1059,25 @@ def main(argv=None) -> int:
     o = sub.add_parser(
         "obs", help="summarize a HIVEMALL_TPU_METRICS jsonl stream "
                     "(rates, stage breakdown, breaker state, checkpoint "
-                    "age)")
+                    "age); `obs postmortem <dir>` merges flight-recorder "
+                    "rings into one post-mortem timeline")
     o.add_argument("file", help="metrics jsonl path (or, with --slo, a "
-                                "serve/router base URL or /slo JSON file)")
+                                "serve/router base URL or /slo JSON "
+                                "file); or the literal word `postmortem`")
+    o.add_argument("target", nargs="?", default=None,
+                   help="with `postmortem`: the flight-ring directory "
+                        "(e.g. <checkpoint_dir>/flight)")
     o.add_argument("--follow", action="store_true",
                    help="keep watching; re-render when the file grows")
     o.add_argument("--interval", type=float, default=2.0,
                    help="--follow poll interval seconds")
+    o.add_argument("--since", default=None, metavar="SECS",
+                   help="only events in the window: seconds-ago when "
+                        "< 1e9 (--since 300 = last 5 minutes) or an "
+                        "absolute epoch timestamp; shared by the jsonl "
+                        "summary and `obs postmortem`")
+    o.add_argument("--tail", type=int, default=200,
+                   help="postmortem: show the last N merged events")
     o.add_argument("--slo", action="store_true",
                    help="render a serving SLO report instead: FILE is a "
                         "http(s)://host:port serve/router base (its /slo "

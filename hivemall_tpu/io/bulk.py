@@ -51,6 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs.flight import FS, get_flight
 from .sparse import SparseDataset, score_batches
 
 __all__ = ["bulk_predict", "BulkProgress", "resolve_model_bundle",
@@ -219,11 +220,23 @@ def _score_shard_task(cfg: Dict[str, Any], kind: str, path: str,
     evaluation UDAFs; top-k returns only the per-group k best — a row
     outside its shard's per-group k best can never rank globally)."""
     t0 = time.perf_counter()
+    # shard lifecycle to the flight ring: a pool worker SIGKILLed (OOM)
+    # mid-shard leaves a start with no done — the post-mortem names the
+    # exact shard that killed it. Workers inherit $HIVEMALL_TPU_FLIGHT
+    # through the spawn env; unset, this is one attribute check.
+    fl = get_flight()
+    if fl.enabled:
+        fl.record("bulk.shard.start",
+                  f"i={index}{FS}file={os.path.basename(path)[:48]}")
     st = _get_state(cfg)
     ds = st.decode(kind, path)
     t1 = time.perf_counter()
     scores = st.score(ds)
     t2 = time.perf_counter()
+    if fl.enabled:
+        fl.record("bulk.shard.done",
+                  f"i={index}{FS}rows={len(ds)}{FS}"
+                  f"d={(t1 - t0) * 1e3:.1f}{FS}s={(t2 - t1) * 1e3:.1f}")
 
     out_path = None
     group = None
@@ -548,6 +561,11 @@ def bulk_predict(algo: str, input_path: str,
             _ensure_arena_published(cls, cfg)
         if stream.enabled:
             stream.emit("bulk", phase="start", **prog.obs_section())
+        fl = get_flight()
+        if fl.enabled:
+            fl.record("bulk.start",
+                      f"shards={len(files)}{FS}workers={workers}{FS}"
+                      f"backend={backend}{FS}pool={pool}")
 
         ev = _EvalAccum(classification)
         topk_by_shard: Dict[int, list] = {}
@@ -620,6 +638,9 @@ def bulk_predict(algo: str, input_path: str,
     registry.register("bulk", lambda s=dict(section): dict(s))
     if stream.enabled:
         stream.emit("bulk", phase="done", **section)
+    if fl.enabled:
+        fl.record("bulk.done",
+                  f"rows={prog.rows_scored}{FS}shards={len(files)}")
     result: Dict[str, Any] = {
         "rows": prog.rows_scored, "shards": len(files),
         "backend": backend, "precision": precision,

@@ -26,6 +26,7 @@ from ..io.sparse import (MegaBatch, PackedMegaBatch, SparseBatch,
                          SparseDataset, pow2_len, score_batches,
                          split_feature)
 from ..obs.devprof import get_devprof
+from ..obs.flight import FS, get_flight
 from ..obs.trace import get_tracer
 from ..utils.hashing import mhash
 from ..utils.metrics import Meter, get_stream
@@ -251,6 +252,7 @@ class LearnerBase:
         self._examples = 0
         self._meter = Meter()                 # rolling examples/sec (§6)
         self._tracer = get_tracer()           # span tracing (obs.trace)
+        self._flight = get_flight()           # black box (obs.flight)
         self._devprof = get_devprof()         # compile/memory/drift (obs)
         self.pipeline_stats = PipelineStats()  # last fit's ingest metrics
         self._mixer = None
@@ -402,6 +404,14 @@ class LearnerBase:
         additionally emit the full registry snapshot."""
         if self._t % 256 < window:
             self._fold_loss()
+            fl = self._flight
+            if fl.enabled:
+                # the trainer's heartbeat in the black box: a fit that
+                # dies (OOM'd retrain child, SIGKILLed worker) leaves its
+                # last step/loss on disk for the post-mortem
+                fl.record("fit.step",
+                          f"step={self._t}{FS}ex={self._examples}{FS}"
+                          f"loss={self._loss_sum / max(1, self._examples):.6f}")
             stream = get_stream()
             if stream.enabled:
                 stream.emit("train_step", trainer=self.NAME, step=self._t,
@@ -436,6 +446,10 @@ class LearnerBase:
                         examples=self._examples,
                         avg_loss=round(self.cumulative_loss, 6),
                         telemetry=registry.snapshot())
+        fl = self._flight
+        if fl.enabled:
+            fl.record("fit.done",
+                      f"step={self._t}{FS}ex={self._examples}")
         self._tracer.maybe_export()
         # one completed fit = compile warmup over: arm the no-retrace
         # sentinel so a later same-config trainer that re-compiles (the

@@ -1,0 +1,541 @@
+"""Black-box flight recorder — crash-safe wide events (docs/OBSERVABILITY.md).
+
+The rest of the obs stack (spans, /metrics, SLO burn rates) is opt-in
+and in-memory: when a replica is SIGKILLed nothing survives to explain
+the death. This module is the aircraft-style black box: every process
+appends compact "wide events" (request admitted/completed with trace id
+and hop decomposition, engine reloads, promotion/rollback transitions,
+retrain state edges, bulk-shard lifecycle, fault-injection hits) into a
+fixed-size ring of slots inside an **mmap'd file**. Durability is by
+construction — a store into a shared file mapping lands in the kernel
+page cache immediately, so a process killed with ``kill -9`` leaves its
+last events already on disk; no flush, no signal handler, no atexit.
+
+Writer contract (mirrors ``obs.trace``):
+
+- lock-free on the hot path: slot reservation is one ``next()`` on an
+  ``itertools.count`` (atomic under the GIL — the same trick the trace
+  id mint uses), then plain stores into the mapping; no lock, ever;
+- when disabled, :meth:`FlightRecorder.record` is ONE attribute check
+  and a return — and hot call sites additionally guard with
+  ``if fl.enabled:`` so even the kwargs dict is never built (pinned by
+  ``tests/test_flight.py::test_disabled_record_is_one_attribute_check``);
+- a record() can never raise into the request path: a closed/failed
+  ring degrades to dropping the event.
+
+Torn-write detection: each slot carries its sequence number at the head
+AND the tail; the writer stores head, payload, then tail, so a reader
+(running post-mortem against a dead process's ring) accepts a slot only
+when both match — a write interrupted mid-slot by SIGKILL fails the
+check and is skipped instead of decoding garbage.
+
+On-disk layout (little-endian, version 1)::
+
+    header (256 bytes): magic "HMTPUFR1", version u32, slot_size u32,
+        nslots u32, pid u32, anchor_wall f64, anchor_mono f64,
+        label 64 bytes (utf-8, NUL padded)
+    slot i (slot_size bytes, at 256 + i*slot_size):
+        seq u64 | ts_wall f64 | payload_len u32 | payload bytes ...
+        ... | seq & 0xFFFFFFFF as u32 in the slot's last 4 bytes
+
+Payload = ``kind\\x1fkey=value\\x1f...`` utf-8, truncated to the slot.
+Events carry wall-clock timestamps directly (one ``time.time()`` per
+event), so independently-recorded rings — router, every replica, bulk
+workers — merge onto ONE timeline by sort, the same wall-clock anchoring
+the Chrome trace export uses for its cross-process merge.
+
+Activation: ``HIVEMALL_TPU_FLIGHT=<dir>`` opens a per-process ring
+``<dir>/<label>-<pid>.ring`` on first :func:`get_flight` use (label from
+``HIVEMALL_TPU_FLIGHT_LABEL``, default ``pid<pid>``); the fleet manager
+sets both for every replica it spawns and records each ring's path with
+its respawn decisions. ``hivemall_tpu obs postmortem <dir>`` (backed by
+:func:`merge_dir`) merges every ring under a run directory into one
+ordered timeline, flags the recording gap around each death, and lists
+each ring's admitted-but-never-completed request ids — the victim's
+final seconds. The registry's ``flight`` section (events written,
+overwrites, utilization) lets the recorder observe itself.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import mmap as _mmap_mod
+import os
+import re
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["FlightRecorder", "get_flight", "configure_flight",
+           "read_ring", "merge_dir", "render_postmortem",
+           "emit_postmortem", "flight_stub", "FS", "pack_ids",
+           "unpack_ids"]
+
+MAGIC = b"HMTPUFR1"
+VERSION = 1
+HEADER_SIZE = 256
+DEFAULT_SLOT = 192          # bytes per event slot (head 20 + tail 4 + payload)
+DEFAULT_NSLOTS = 4096       # ~last 4k events per process survive a crash
+
+_HDR = struct.Struct("<8sIIIIdd64s")
+_HEAD = struct.Struct("<QdI")            # seq, ts_wall, payload_len
+_TAIL = struct.Struct("<I")              # seq & 0xFFFFFFFF
+#: field separator inside a payload — callers building a pre-formatted
+#: ``line`` join their ``k=v`` pairs with this
+FS = _FIELD_SEP = "\x1f"
+_LABEL_RE = re.compile(r"[^A-Za-z0-9_.-]")
+
+ENV_DIR = "HIVEMALL_TPU_FLIGHT"
+ENV_LABEL = "HIVEMALL_TPU_FLIGHT_LABEL"
+ENV_SLOTS = "HIVEMALL_TPU_FLIGHT_SLOTS"
+
+
+class FlightRecorder:
+    """One process's ring. Disabled (a dark no-op) until :meth:`open`."""
+
+    def __init__(self):
+        self.enabled = False
+        self.path: Optional[str] = None
+        self.label: Optional[str] = None
+        self.truncated = 0               # payloads clipped to the slot
+        self._mm = None
+        self._f = None
+        self._slot = DEFAULT_SLOT
+        self._nslots = DEFAULT_NSLOTS
+        self._cap = DEFAULT_SLOT - _HEAD.size - _TAIL.size
+        self._seq = itertools.count(1)
+        self._last_seq = 0               # last reserved seq (~= events)
+
+    # -- lifecycle -----------------------------------------------------------
+    def open(self, path: str, *, label: str = "",
+             slot_size: int = DEFAULT_SLOT,
+             nslots: int = DEFAULT_NSLOTS) -> "FlightRecorder":
+        """Create (truncating) the ring file and map it. The file is
+        fully sized up front so every later write is a pure store into
+        the mapping — nothing on the hot path can block on allocation."""
+        self.close()
+        slot_size = max(64, int(slot_size))
+        nslots = max(8, int(nslots))
+        total = HEADER_SIZE + slot_size * nslots
+        f = open(path, "w+b")
+        try:
+            f.truncate(total)
+            mm = _mmap_mod.mmap(f.fileno(), total)
+        except (OSError, ValueError):
+            f.close()
+            raise
+        mm[:_HDR.size] = _HDR.pack(
+            MAGIC, VERSION, slot_size, nslots, os.getpid(),
+            time.time(), time.perf_counter(),
+            (label or "").encode("utf-8", "replace")[:64])
+        self._f, self._mm = f, mm
+        self._slot, self._nslots = slot_size, nslots
+        self._cap = slot_size - _HEAD.size - _TAIL.size
+        self._seq = itertools.count(1)
+        self._last_seq = 0
+        self.truncated = 0
+        self.path = path
+        self.label = label or None
+        self.enabled = True
+        return self
+
+    def open_dir(self, directory: str, *, label: str = "",
+                 slot_size: int = DEFAULT_SLOT,
+                 nslots: int = DEFAULT_NSLOTS) -> "FlightRecorder":
+        """Open the ring as ``<dir>/<label>-<pid>.ring`` — pid in the
+        name so a respawned replica writes a FRESH file and its dead
+        predecessor's ring survives for the post-mortem."""
+        os.makedirs(directory, exist_ok=True)
+        safe = _LABEL_RE.sub("_", label) or f"pid{os.getpid()}"
+        path = os.path.join(directory, f"{safe}-{os.getpid()}.ring")
+        return self.open(path, label=label or safe,
+                         slot_size=slot_size, nslots=nslots)
+
+    def close(self) -> None:
+        """Unmap and close (leaktrack hygiene — a drained replica must
+        census clean). The file itself stays on disk: it IS the record."""
+        self.enabled = False
+        mm, f = self._mm, self._f
+        self._mm = self._f = None
+        if mm is not None:
+            try:
+                mm.close()
+            except (OSError, ValueError):
+                pass
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    # -- the hot path --------------------------------------------------------
+    def record(self, kind: str, line: Optional[str] = None,
+               **fields) -> None:
+        """Append one wide event. Lock-free; safe from any thread; never
+        raises. Disabled cost at THIS level is one attribute check —
+        hot call sites guard with ``if fl.enabled:`` so the arguments
+        are not even built when the recorder is dark.
+
+        ``fields`` spell the event as keywords; the serving hot path
+        passes ``line`` instead — a single pre-built
+        ``"k=v\\x1fk=v"`` f-string, which skips the kwargs dict and the
+        per-field format calls (~2x cheaper per event)."""
+        if not self.enabled:
+            return
+        if line is not None:
+            payload = (kind + _FIELD_SEP + line).encode("utf-8", "replace")
+        elif fields:
+            payload = (kind + _FIELD_SEP + _FIELD_SEP.join(
+                f"{k}={v}" for k, v in fields.items())).encode(
+                    "utf-8", "replace")
+        else:
+            payload = kind.encode("utf-8", "replace")
+        n = len(payload)
+        if n > self._cap:
+            payload = payload[:self._cap]
+            n = self._cap
+            self.truncated += 1
+        try:
+            i = next(self._seq)          # GIL-atomic slot reservation
+            off = HEADER_SIZE + ((i - 1) % self._nslots) * self._slot
+            mm = self._mm
+            _HEAD.pack_into(mm, off, i, time.time(), n)
+            mm[off + _HEAD.size:off + _HEAD.size + n] = payload
+            _TAIL.pack_into(mm, off + self._slot - _TAIL.size,
+                            i & 0xFFFFFFFF)
+            self._last_seq = i
+        except (OSError, ValueError, TypeError, AttributeError):
+            pass                         # closed/raced ring: drop, never raise
+
+    # -- self-observation ----------------------------------------------------
+    @property
+    def events(self) -> int:
+        return self._last_seq
+
+    @property
+    def dropped(self) -> int:
+        """Events overwritten by the ring wrapping (the honest name for
+        what a fixed ring does to history)."""
+        return max(0, self._last_seq - self._nslots)
+
+    def obs_section(self) -> dict:
+        n = self._last_seq
+        return {
+            "enabled": self.enabled,
+            "path": self.path,
+            "label": self.label,
+            "events": n,
+            "dropped": self.dropped,
+            "truncated": self.truncated,
+            "ring_slots": self._nslots if self.enabled else 0,
+            "slot_bytes": self._slot if self.enabled else 0,
+            "utilization": round(min(1.0, n / self._nslots), 4)
+            if self.enabled else 0.0,
+        }
+
+
+def flight_stub() -> dict:
+    """The registry's ``flight`` section before any recorder opened —
+    key-for-key the live :meth:`FlightRecorder.obs_section` shape."""
+    return {"enabled": False, "path": None, "label": None, "events": 0,
+            "dropped": 0, "truncated": 0, "ring_slots": 0,
+            "slot_bytes": 0, "utilization": 0.0}
+
+
+_flight: Optional[FlightRecorder] = None
+_flight_lock = threading.Lock()
+
+
+def get_flight() -> FlightRecorder:
+    """The process-wide recorder, bound to ``$HIVEMALL_TPU_FLIGHT`` on
+    first use and registered as the obs registry's ``flight`` section.
+    An open failure leaves the recorder dark — the black box must never
+    take the process down."""
+    global _flight
+    if _flight is None:
+        with _flight_lock:
+            if _flight is None:
+                fr = FlightRecorder()
+                d = os.environ.get(ENV_DIR, "")
+                if d and d != "0":
+                    label = os.environ.get(ENV_LABEL, "") \
+                        or f"pid{os.getpid()}"
+                    try:
+                        nslots = int(os.environ.get(ENV_SLOTS, "")
+                                     or DEFAULT_NSLOTS)
+                        fr.open_dir(d, label=label, nslots=nslots)
+                    except (OSError, ValueError):
+                        pass
+                from .registry import registry
+                registry.register("flight", fr.obs_section)
+                _flight = fr
+    return _flight
+
+
+def configure_flight(directory: Optional[str], *, label: str = "",
+                     slot_size: int = DEFAULT_SLOT,
+                     nslots: int = DEFAULT_NSLOTS) -> FlightRecorder:
+    """Explicitly (re)bind the process recorder: open a fresh ring under
+    ``directory`` (``None`` closes and leaves it dark). The fleet uses
+    this to label the router's ring before traffic starts."""
+    fr = get_flight()
+    fr.close()
+    if directory:
+        try:
+            fr.open_dir(directory, label=label, slot_size=slot_size,
+                        nslots=nslots)
+        except OSError:
+            pass
+    return fr
+
+
+# -- reading / post-mortem ----------------------------------------------------
+
+def _coerce(v: str):
+    try:
+        return int(v)
+    except ValueError:
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+
+def read_ring(path: str) -> dict:
+    """Parse one ring file — tolerant by design (the interesting rings
+    belong to dead processes): torn slots (head/tail seq mismatch) are
+    counted and skipped, payloads decode with replacement. Returns
+    ``{path, pid, label, ..., events: [...], torn}`` with events sorted
+    in write order (by seq)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    if len(buf) < HEADER_SIZE:
+        raise ValueError(f"{path}: truncated flight ring "
+                         f"({len(buf)} bytes)")
+    magic, version, slot_size, nslots, pid, wall0, mono0, label_b = \
+        _HDR.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise ValueError(f"{path}: not a flight ring (bad magic)")
+    label = label_b.rstrip(b"\x00").decode("utf-8", "replace")
+    events: List[dict] = []
+    torn = 0
+    cap = slot_size - _HEAD.size - _TAIL.size
+    for s in range(nslots):
+        off = HEADER_SIZE + s * slot_size
+        if off + slot_size > len(buf):
+            break
+        seq, ts, n = _HEAD.unpack_from(buf, off)
+        if seq == 0:
+            continue                     # never written
+        (tail,) = _TAIL.unpack_from(buf, off + slot_size - _TAIL.size)
+        if tail != (seq & 0xFFFFFFFF) or n > cap:
+            torn += 1                    # SIGKILL mid-write: skip
+            continue
+        raw = buf[off + _HEAD.size:off + _HEAD.size + n]
+        parts = raw.decode("utf-8", "replace").split(_FIELD_SEP)
+        ev = {"seq": seq, "ts": ts, "kind": parts[0], "fields": {}}
+        for p in parts[1:]:
+            k, _, v = p.partition("=")
+            ev["fields"][k] = _coerce(v)
+        events.append(ev)
+    events.sort(key=lambda e: e["seq"])
+    return {"path": path, "pid": pid, "label": label or f"pid{pid}",
+            "version": version, "slot_bytes": slot_size,
+            "ring_slots": nslots, "anchor_wall": wall0,
+            "events": events, "torn": torn}
+
+
+def pack_ids(ids) -> str:
+    """Compact ``"5-36,40"`` run-length encoding of (mostly ascending)
+    int ids — how ``batch.done`` names every request it completed in ONE
+    event, so per-request completion cost amortizes across the batch and
+    a 256-request batch still fits a slot."""
+    out: List[str] = []
+    start = prev = None
+    for i in ids:
+        if start is None:
+            start = prev = i
+            continue
+        if i == prev + 1:
+            prev = i
+            continue
+        out.append(f"{start}-{prev}" if prev > start else f"{start}")
+        start = prev = i
+    if start is not None:
+        out.append(f"{start}-{prev}" if prev > start else f"{start}")
+    return ",".join(out)
+
+
+def unpack_ids(s) -> List[int]:
+    """Inverse of :func:`pack_ids`; tolerant of garbage tokens (the
+    payload may have been truncated to its slot mid-token)."""
+    out: List[int] = []
+    for tok in str(s).split(","):
+        a, _, b = tok.partition("-")
+        try:
+            if b:
+                out.extend(range(int(a), int(b) + 1))
+            else:
+                out.append(int(a))
+        except ValueError:
+            continue
+    return out
+
+
+#: request-lifecycle kinds the uncompleted-scan correlates on
+_ADMIT_KIND = "req.admit"
+_DONE_KINDS = ("req.done", "req.err", "req.expired")
+_BATCH_DONE_KIND = "batch.done"
+
+
+def _uncompleted(events: List[dict], keep: int = 64) -> List[dict]:
+    """Admitted-but-never-completed requests in one ring — the dead
+    process's in-flight work. An admit whose matching done was simply
+    overwritten by the wrap can only be older than every surviving done,
+    so only the TAIL of the open set is meaningful; keep the last
+    ``keep``."""
+    open_reqs: Dict[int, dict] = {}
+    for ev in events:
+        if ev["kind"] == _BATCH_DONE_KIND:
+            for rq in unpack_ids(ev["fields"].get("reqs", "")):
+                open_reqs.pop(rq, None)
+            continue
+        rq = ev["fields"].get("req")
+        if rq is None:
+            continue
+        if ev["kind"] == _ADMIT_KIND:
+            open_reqs[rq] = ev
+        elif ev["kind"] in _DONE_KINDS:
+            open_reqs.pop(rq, None)
+    tail = sorted(open_reqs.values(), key=lambda e: e["seq"])[-keep:]
+    return [{"req": e["fields"].get("req"), "ts": e["ts"],
+             "trace": e["fields"].get("trace"),
+             "rows": e["fields"].get("rows")} for e in tail]
+
+
+def merge_dir(directory: str, *, since: Optional[float] = None,
+              gap_s: float = 1.0) -> dict:
+    """The fleet-wide post-mortem: read every ``*.ring`` under
+    ``directory`` (recursively — a run dir may nest per-replica dirs),
+    merge all events onto one wall-clock timeline, flag each ring whose
+    recording stops more than ``gap_s`` before the fleet's last event
+    (the death gap), and list each ring's admitted-but-uncompleted
+    request ids. ``since`` (epoch seconds) filters the merged timeline;
+    gap/uncompleted analysis always runs on the full rings."""
+    paths: List[str] = []
+    for root, _dirs, files in os.walk(directory):
+        paths.extend(os.path.join(root, fn) for fn in files
+                     if fn.endswith(".ring"))
+    rings: List[dict] = []
+    unreadable: List[dict] = []
+    for p in sorted(paths):
+        try:
+            rings.append(read_ring(p))
+        except (OSError, ValueError) as e:
+            unreadable.append({"path": p, "error": str(e)})
+    merged: List[dict] = []
+    end_ts = 0.0
+    for r in rings:
+        name = f"{r['label']}-{r['pid']}"
+        r["name"] = name
+        r["last_ts"] = r["events"][-1]["ts"] if r["events"] else None
+        r["uncompleted"] = _uncompleted(r["events"])
+        if r["last_ts"]:
+            end_ts = max(end_ts, r["last_ts"])
+        for ev in r["events"]:
+            if since is not None and ev["ts"] < since:
+                continue
+            merged.append({"ring": name, **ev})
+    merged.sort(key=lambda e: (e["ts"], e["seq"]))
+    gaps = []
+    for r in rings:
+        if r["last_ts"] is None:
+            continue
+        gap = end_ts - r["last_ts"]
+        if gap > gap_s:
+            # this ring went silent while the rest of the fleet kept
+            # recording — the signature of a death (or a wedged process)
+            gaps.append({"ring": r["name"], "last_ts": r["last_ts"],
+                         "gap_s": round(gap, 3),
+                         "uncompleted": len(r["uncompleted"])})
+    return {
+        "dir": directory,
+        "rings": [{k: r[k] for k in ("name", "path", "pid", "label",
+                                     "last_ts", "torn", "uncompleted")}
+                  | {"events": len(r["events"])} for r in rings],
+        "unreadable": unreadable,
+        "events": merged,
+        "gaps": gaps,
+        "since": since,
+        "end_ts": end_ts or None,
+    }
+
+
+def _fmt_ts(ts: float) -> str:
+    frac = f"{ts % 1.0:.3f}"[1:]
+    return time.strftime("%H:%M:%S", time.localtime(ts)) + frac
+
+
+def render_postmortem(merged: dict, tail: int = 200) -> str:
+    """Human-readable timeline of :func:`merge_dir` output: the ring
+    roster with death gaps, each dead ring's final uncompleted request
+    ids, then the last ``tail`` merged events."""
+    lines: List[str] = []
+    events = merged["events"]
+    n_rings = len(merged["rings"])
+    span = ""
+    if events:
+        span = f", {_fmt_ts(events[0]['ts'])} .. {_fmt_ts(events[-1]['ts'])}"
+    lines.append(f"flight postmortem: {n_rings} ring(s), "
+                 f"{len(events)} event(s){span}")
+    if merged.get("since"):
+        lines.append(f"  (since {_fmt_ts(merged['since'])})")
+    gap_by_ring = {g["ring"]: g for g in merged["gaps"]}
+    for r in merged["rings"]:
+        mark = ""
+        g = gap_by_ring.get(r["name"])
+        if g:
+            mark = (f"  ** DEATH GAP: silent for {g['gap_s']}s before "
+                    f"the fleet's last event **")
+        torn = f", {r['torn']} torn slot(s)" if r["torn"] else ""
+        lines.append(f"  {r['name']}: {r['events']} event(s){torn}{mark}")
+        if g and r["uncompleted"]:
+            ids = ", ".join(
+                str(u["req"]) + (f" trace={u['trace']}"
+                                 if u.get("trace") else "")
+                for u in r["uncompleted"][-8:])
+            lines.append(f"    admitted but never completed "
+                         f"({len(r['uncompleted'])}): {ids}")
+    for u in merged["unreadable"]:
+        lines.append(f"  UNREADABLE {u['path']}: {u['error']}")
+    show = events[-tail:] if tail and len(events) > tail else events
+    if len(show) < len(events):
+        lines.append(f"  ... {len(events) - len(show)} earlier event(s) "
+                     f"elided (--tail {tail})")
+    for ev in show:
+        fields = " ".join(f"{k}={v}" for k, v in ev["fields"].items())
+        lines.append(f"{_fmt_ts(ev['ts'])} [{ev['ring']}] {ev['kind']}"
+                     + (f" {fields}" if fields else ""))
+    return "\n".join(lines) + "\n"
+
+
+def emit_postmortem(directory: str, out_path: Optional[str] = None,
+                    tail: int = 200) -> Optional[str]:
+    """Write the merged timeline next to the rings (JSON + the rendered
+    text) — the fleet manager calls this when it detects an unexpected
+    replica exit, so the post-mortem exists even if nobody runs the CLI.
+    Never raises; returns the text path or None."""
+    try:
+        merged = merge_dir(directory)
+        out = out_path or os.path.join(directory, "postmortem.txt")
+        with open(out + ".json.tmp", "w") as f:
+            json.dump(merged, f, default=str)
+        os.replace(out + ".json.tmp", out + ".json")
+        with open(out + ".tmp", "w") as f:
+            f.write(render_postmortem(merged, tail=tail))
+        os.replace(out + ".tmp", out)
+        return out
+    except (OSError, ValueError):
+        return None

@@ -69,6 +69,29 @@ def to_prometheus(snapshot: dict, prefix: str = "hivemall_tpu") -> str:
     top-level ``ts`` is exported as ``<prefix>_snapshot_ts``.
     """
     lines = []
+    # sanitization is lossy ("a.b" and "a_b" both become "a_b"), and two
+    # families under one name is invalid exposition — scrapers merge or
+    # reject them silently. Disambiguate the LATER arrival with a _dup<N>
+    # suffix (its # HELP still carries the true dot-path) and count the
+    # events in a <prefix>_name_collisions gauge so the hazard is
+    # visible on the scrape itself instead of corrupting dashboards.
+    seen: dict = {}                      # emitted name -> snapshot dot-path
+    collisions = 0
+
+    def uniq(parts):
+        nonlocal collisions
+        name = _metric_name(parts)
+        path = ".".join(parts[1:])
+        if name not in seen:
+            seen[name] = path
+            return name
+        collisions += 1
+        n = 2
+        while f"{name}_dup{n}" in seen:
+            n += 1
+        name = f"{name}_dup{n}"
+        seen[name] = path
+        return name
 
     def walk(parts, val):
         if isinstance(val, bool):
@@ -88,12 +111,12 @@ def to_prometheus(snapshot: dict, prefix: str = "hivemall_tpu") -> str:
         lines.append(f"# TYPE {name} {mtype}")
 
     def emit(parts, val):
-        name = _metric_name(parts)
+        name = uniq(parts)
         head(name, parts, "gauge")
         lines.append(f"{name} {_fmt_value(val)}")
 
     def emit_histogram(parts, hist):
-        name = _metric_name(parts)
+        name = uniq(parts)
         head(name, parts, "histogram")
         for bound, cum in hist.get("buckets") or []:
             le = "+Inf" if bound == "+Inf" else _fmt_value(bound)
@@ -106,6 +129,12 @@ def to_prometheus(snapshot: dict, prefix: str = "hivemall_tpu") -> str:
             walk([prefix, "snapshot", "ts"], snapshot[section])
         else:
             walk([prefix, section], snapshot[section])
+    if collisions:
+        name = f"{prefix}_name_collisions"
+        lines.append(f"# HELP {name} sanitized metric names that collided "
+                     f"(later arrivals renamed with a _dup suffix)")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {collisions}")
     return "\n".join(lines) + "\n"
 
 

@@ -174,3 +174,7 @@ registry.register("bulk", lambda: dict(BULK_STUB))
 # telemetry on first use (any trainer construction)
 from .devprof import devprof_stub  # noqa: E402 — stub needs the dict shape
 registry.register("devprof", devprof_stub)
+# obs.flight.get_flight overrides this with the live ring's self-census
+# (events written, overwrites, utilization) on first use
+from .flight import flight_stub  # noqa: E402 — stub needs the dict shape
+registry.register("flight", flight_stub)

@@ -25,7 +25,19 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["load_events", "summarize", "render_file", "render_slo",
-           "render_slo_source"]
+           "render_slo_source", "parse_since"]
+
+
+def parse_since(v) -> Optional[float]:
+    """The shared ``--since`` grammar (``obs`` and ``obs postmortem``):
+    values under 1e9 are "seconds ago" (``--since 300`` = the last five
+    minutes), larger values are an absolute epoch timestamp."""
+    if v is None:
+        return None
+    s = float(v)
+    # event `ts` fields are wall-clock epoch by schema; a relative
+    # --since can only anchor against wall "now"
+    return time.time() - s if s < 1e9 else s  # graftcheck: disable=GC02
 
 
 class _TailState:
@@ -34,7 +46,7 @@ class _TailState:
     ts range, and the unparsable-line count. Everything the renderer
     needs, in O(1) memory."""
 
-    def __init__(self):
+    def __init__(self, since: Optional[float] = None):
         self.counts: Dict[str, int] = {}
         self.last: Dict[str, dict] = {}
         self.snapshot: Optional[dict] = None
@@ -42,9 +54,14 @@ class _TailState:
         self.t_hi: Optional[float] = None
         self.bad = 0
         self.total = 0
+        self.since = since
 
     def add(self, rec: dict) -> None:
         name = rec["event"]
+        ts0 = rec.get("ts")
+        if self.since is not None and isinstance(ts0, (int, float)) \
+                and ts0 < self.since:
+            return                       # --since: before the window
         self.total += 1
         self.counts[name] = self.counts.get(name, 0) + 1
         self.last[name] = rec
@@ -287,13 +304,26 @@ def _render(state: _TailState, path: str = "",
             f"/{bk.get('precision') or '?'}"
             f"  workers {bk.get('workers', 0)}"
             f" util {bk.get('worker_utilization', 0)}")
+
+    # black-box flight recorder (docs/OBSERVABILITY.md "Flight
+    # recorder"): the ring's self-census when a snapshot carries one
+    fli = (snap or {}).get("flight") or {}
+    if fli.get("enabled") or fli.get("events"):
+        out.append(
+            f"flight: [{'recording' if fli.get('enabled') else 'closed'}]"
+            f"  events {fli.get('events', 0)}"
+            f"  dropped {fli.get('dropped', 0)}"
+            f"  truncated {fli.get('truncated', 0)}"
+            f"  util {fli.get('utilization', 0.0)}"
+            f"  ring {fli.get('path') or '?'}")
     return "\n".join(out)
 
 
 def summarize(events: List[dict], bad: int = 0, path: str = "",
-              now: Optional[float] = None) -> str:
+              now: Optional[float] = None,
+              since: Optional[float] = None) -> str:
     """Render the summary text for one loaded event list."""
-    state = _TailState()
+    state = _TailState(since=since)
     for rec in events:
         state.add(rec)
     state.bad = bad
@@ -319,9 +349,9 @@ class _FollowTail:
     or open that lands in the replace window (file briefly absent)
     retries next tick."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, since: Optional[float] = None):
         self.path = path
-        self.state = _TailState()
+        self.state = _TailState(since=since)
         self._offset = 0
         self._ino: Optional[int] = None
 
@@ -359,7 +389,8 @@ class _FollowTail:
 
 
 def render_file(path: str, follow: bool = False,
-                interval: float = 2.0) -> int:
+                interval: float = 2.0,
+                since: Optional[float] = None) -> int:
     """Print the summary for ``path``; with ``follow`` re-render whenever
     the file grows (Ctrl-C exits). Returns a process exit code.
 
@@ -374,9 +405,9 @@ def render_file(path: str, follow: bool = False,
         return 1
     if not follow:
         events, bad = load_events(path)
-        print(summarize(events, bad, path=path))
+        print(summarize(events, bad, path=path, since=since))
         return 0
-    tail = _FollowTail(path)
+    tail = _FollowTail(path, since=since)
     try:
         while True:
             out = tail.tick()

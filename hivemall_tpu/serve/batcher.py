@@ -40,6 +40,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..io.sparse import pow2_len
+from ..obs.flight import FS, get_flight, pack_ids
 from ..obs.histo import BATCH_SIZE_BUCKETS, LATENCY_BUCKETS_S, Histogram
 from ..obs.trace import get_tracer
 from ..utils.metrics import Meter
@@ -67,6 +68,9 @@ class _Req:
     trace_id: Optional[str] = None
     raw: Optional[list] = None           # original feature strings (the
     #                                      raw-capturing tee's input)
+    req_no: int = 0                      # plane-local admission number —
+    #                                      the flight recorder's
+    #                                      admit/complete correlation key
 
 
 class BatchPlane:
@@ -86,6 +90,12 @@ class BatchPlane:
                                   else 8 * self.max_batch)
         self.deadline_ms = float(deadline_ms)
         self._tracer = get_tracer()
+        # black-box flight recorder (obs.flight): BOTH planes record
+        # admit/complete/shed wide events through this shared base, so
+        # the crash-safe story cannot drift between them. Every hot site
+        # guards with `if fl.enabled:` — the disabled plane pays one
+        # attribute check per seam, nothing more.
+        self._flight = get_flight()
         self._queued_rows = 0
         # counters (merged into the obs `serve` section by the engine)
         self.requests = 0
@@ -137,6 +147,20 @@ class BatchPlane:
         self.score_sum += float(sc.sum())
         self.score_sumsq += float((sc * sc).sum())
         self.score_n += n
+
+    def _flight_batch_done(self, live: list, n_rows: int,
+                           assemble_s: float, predict_s: float,
+                           meta) -> None:
+        """One ``batch.done`` wide event naming every request this batch
+        completed (packed id ranges) — per-request completion cost in the
+        ring amortizes across the batch. Callers guard on
+        ``self._flight.enabled`` so the disabled path never gets here."""
+        line = (f"reqs={pack_ids([r.req_no for r in live])}{FS}"
+                f"rows={n_rows}{FS}a={assemble_s * 1e3:.2f}{FS}"
+                f"p={predict_s * 1e3:.2f}")
+        if meta is not None:
+            line += f"{FS}step={meta}"
+        self._flight.record("batch.done", line)
 
     def _tee_batch(self, rows: list, reqs: list) -> None:
         """Mirror one scored batch to the installed tee. ``reqs`` need
@@ -265,16 +289,33 @@ class MicroBatcher(BatchPlane):
                 # queue, which is admitted alone (it could never fit)
                 if self._queued_rows + n > self.max_queue_rows and self._q:
                     self.shed += 1
+                    fl = self._flight
+                    if fl.enabled:       # shed is the black box's best
+                        # overload evidence — worth the (rare) event
+                        fl.record("req.shed",
+                                  f"rows={n}{FS}depth={self._queued_rows}")
                     raise ServeOverload(
                         f"queue full ({self._queued_rows} rows queued, "
                         f"max {self.max_queue_rows}); request shed")
+                rq = self.requests + 1
                 self._q.append(_Req(rows, n, fut, now, t_deadline,
-                                    trace_id, raw))
+                                    trace_id, raw, rq))
                 self._queued_rows += n
-                self.requests += 1
+                depth = self._queued_rows
+                self.requests = rq
                 self.rows_in += n
                 self._req_meter.add(1)
                 self._cv.notify()
+        fl = self._flight
+        if fl.enabled:                   # admitted: the crash-safe record
+            # of in-flight work (post-mortem correlates these against
+            # batch.done to list a victim's final uncompleted requests)
+            if trace_id:
+                fl.record("req.admit", f"req={rq}{FS}rows={n}{FS}"
+                                       f"depth={depth}{FS}trace={trace_id}")
+            else:
+                fl.record("req.admit",
+                          f"req={rq}{FS}rows={n}{FS}depth={depth}")
         return fut
 
     @property
@@ -320,6 +361,9 @@ class MicroBatcher(BatchPlane):
             for r in batch:
                 if r.t_deadline is not None and now > r.t_deadline:
                     self.expired += 1
+                    fl = self._flight
+                    if fl.enabled:
+                        fl.record("req.expired", f"req={r.req_no}")
                     # the request's time-in-queue at expiry enters the
                     # latency histogram (a lower bound of its would-be
                     # latency) — otherwise the SLO latency window reads
@@ -357,6 +401,11 @@ class MicroBatcher(BatchPlane):
                         # loop survives
                         if len(live) == 1:
                             self.errors += 1
+                            fl = self._flight
+                            if fl.enabled:
+                                fl.record("req.err",
+                                          f"req={live[0].req_no}{FS}"
+                                          f"err={type(e).__name__}")
                             live[0].fut.set_exception(e)
                         else:
                             self._score_individually(live, t_deq)
@@ -384,6 +433,10 @@ class MicroBatcher(BatchPlane):
                              "predict_s": predict_s}
                 r.fut.set_result(part if meta is None else (part, meta))
                 off += r.n
+            fl = self._flight
+            if fl.enabled:
+                self._flight_batch_done(live, len(rows), assemble_s,
+                                        predict_s, meta)
             self._tee_batch(rows, live)
 
     def _score_individually(self, reqs: List[_Req],
@@ -412,8 +465,16 @@ class MicroBatcher(BatchPlane):
                              "assemble_s": 0.0,
                              "predict_s": t_p1 - t_p0}
                 r.fut.set_result(part if meta is None else (part, meta))
+                fl = self._flight
+                if fl.enabled:
+                    self._flight_batch_done([r], r.n, 0.0, t_p1 - t_p0,
+                                            meta)
             except Exception as e:     # noqa: BLE001 — per-request fate
                 self.errors += 1
+                fl = self._flight
+                if fl.enabled:
+                    fl.record("req.err", f"req={r.req_no}{FS}"
+                                         f"err={type(e).__name__}")
                 r.fut.set_exception(e)
 
     # -- lifecycle -----------------------------------------------------------

@@ -52,6 +52,7 @@ import numpy as np
 from ..io.checkpoint import (bundle_step, is_rejected, list_bundles,
                              read_promoted)
 from ..io.sparse import SparseBatch, bucket_size
+from ..obs.flight import FS, get_flight
 from ..obs.trace import get_tracer
 
 __all__ = ["PredictEngine"]
@@ -128,6 +129,10 @@ class PredictEngine:
         self.min_len_bucket = int(min_len_bucket)
         self.watch_interval = float(watch_interval)
         self._tracer = get_tracer()
+        # flight recorder: model swaps are exactly the events a
+        # post-mortem needs to anchor "which version was serving when it
+        # died" — record every reload edge (success AND failure)
+        self._flight = get_flight()
         self._reload_lock = threading.Lock()   # serializes poll()/reload()
         self._watch_thread: Optional[threading.Thread] = None
         self._watch_stop = threading.Event()
@@ -374,6 +379,11 @@ class PredictEngine:
                 # take the server down
                 self.reload_failures += 1
                 self.last_reload_error = f"{path}: {type(e).__name__}: {e}"
+                fl = self._flight
+                if fl.enabled:
+                    fl.record("engine.reload",
+                              f"ok=0{FS}bundle={os.path.basename(path)}"
+                              f"{FS}err={type(e).__name__}")
                 ident = self._bad_ident(path)
                 if ident is not None:
                     self._failed[path] = ident
@@ -412,6 +422,11 @@ class PredictEngine:
         except Exception as e:         # noqa: BLE001 — same degrade as
             self.reload_failures += 1  # the newest-bundle scan
             self.last_reload_error = f"{path}: {type(e).__name__}: {e}"
+            fl = self._flight
+            if fl.enabled:
+                fl.record("engine.reload",
+                          f"ok=0{FS}bundle={os.path.basename(path)}"
+                          f"{FS}err={type(e).__name__}")
             ident = self._bad_ident(path)
             if ident is not None:
                 self._failed[path] = ident
@@ -485,8 +500,14 @@ class PredictEngine:
                 m = self._load_newest(min_step=self._model.step)
             if m is None:
                 return False
+            old_step = self._model.step
             self._model = m            # atomic ref swap
             self.reloads += 1
+            fl = self._flight
+            if fl.enabled:
+                fl.record("engine.reload",
+                          f"ok=1{FS}from={old_step}{FS}to={m.step}{FS}"
+                          f"bundle={os.path.basename(m.path or '')}")
             return True
 
     def reload(self, path: Optional[str] = None) -> bool:
@@ -515,9 +536,20 @@ class PredictEngine:
             except Exception as e:     # noqa: BLE001 — same degrade
                 self.reload_failures += 1
                 self.last_reload_error = f"{path}: {type(e).__name__}: {e}"
+                fl = self._flight
+                if fl.enabled:
+                    fl.record("engine.reload",
+                              f"ok=0{FS}bundle={os.path.basename(path)}"
+                              f"{FS}err={type(e).__name__}")
                 return False
+            old_step = self._model.step if self._model is not None else -1
             self._model = m
             self.reloads += 1
+            fl = self._flight
+            if fl.enabled:
+                fl.record("engine.reload",
+                          f"ok=1{FS}from={old_step}{FS}to={m.step}{FS}"
+                          f"bundle={os.path.basename(m.path or '')}")
             return True
 
     def start_watch(self) -> None:

@@ -52,6 +52,7 @@ from typing import Deque, Dict, Optional, Set
 
 import numpy as np
 
+from ..obs.flight import FS
 from ..obs.http import to_prometheus
 from ..obs.registry import registry
 from ..obs.slo import SloEngine
@@ -90,9 +91,10 @@ class _Pend:
     when the request's batch scores (or it expires/fails)."""
 
     __slots__ = ("rows", "n", "done", "t_enq", "t_deadline", "trace_id",
-                 "raw")
+                 "raw", "req_no")
 
-    def __init__(self, rows, n, done, t_enq, t_deadline, trace_id, raw):
+    def __init__(self, rows, n, done, t_enq, t_deadline, trace_id, raw,
+                 req_no=0):
         self.rows = rows
         self.n = n
         self.done = done
@@ -100,6 +102,9 @@ class _Pend:
         self.t_deadline = t_deadline
         self.trace_id = trace_id
         self.raw = raw
+        # plane-local admission number — the flight recorder's
+        # admit/complete correlation key (obs.flight)
+        self.req_no = req_no
 
 
 class InlineAssembler(BatchPlane):
@@ -149,19 +154,35 @@ class InlineAssembler(BatchPlane):
             raise RuntimeError("batcher is closed")
         if self._queued_rows + n > self.max_queue_rows and self._pending:
             self.shed += 1
+            fl = self._flight
+            if fl.enabled:
+                fl.record("req.shed",
+                          f"rows={n}{FS}depth={self._queued_rows}")
             raise ServeOverload(
                 f"queue full ({self._queued_rows} rows queued, "
                 f"max {self.max_queue_rows}); request shed")
         dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
         now = time.monotonic()
         t_deadline = now + dl / 1000.0 if dl > 0 else None
+        rq = self.requests + 1
         with self._tracer.span("serve.enqueue"):
             self._pending.append(_Pend(rows, n, done, now, t_deadline,
-                                       trace_id, raw))
+                                       trace_id, raw, rq))
             self._queued_rows += n
-            self.requests += 1
+            self.requests = rq
             self.rows_in += n
             self._req_meter.add(1)
+        fl = self._flight
+        if fl.enabled:                   # admitted: the crash-safe record
+            # of in-flight work (the post-mortem's uncompleted scan keys
+            # on these against batch.done)
+            if trace_id:
+                fl.record("req.admit",
+                          f"req={rq}{FS}rows={n}{FS}"
+                          f"depth={self._queued_rows}{FS}trace={trace_id}")
+            else:
+                fl.record("req.admit", f"req={rq}{FS}rows={n}{FS}"
+                                       f"depth={self._queued_rows}")
 
     @property
     def queue_depth(self) -> int:
@@ -215,6 +236,9 @@ class InlineAssembler(BatchPlane):
         for p in batch:
             if p.t_deadline is not None and t_deq > p.t_deadline:
                 self.expired += 1
+                fl = self._flight
+                if fl.enabled:
+                    fl.record("req.expired", f"req={p.req_no}")
                 # time-in-queue at expiry enters the latency histogram
                 # (lower bound of the would-be latency) — same rationale
                 # as MicroBatcher._run
@@ -243,6 +267,11 @@ class InlineAssembler(BatchPlane):
                     # rows cannot 500 the requests coalesced with them
                     if len(live) == 1:
                         self.errors += 1
+                        fl = self._flight
+                        if fl.enabled:
+                            fl.record("req.err",
+                                      f"req={live[0].req_no}{FS}"
+                                      f"err={type(e).__name__}")
                         self._complete(
                             live[0], None, None,
                             {"queue_s": t_deq - live[0].t_enq,
@@ -268,6 +297,10 @@ class InlineAssembler(BatchPlane):
                             "assemble_s": assemble_s,
                             "predict_s": predict_s}, None)
             off += p.n
+        fl = self._flight
+        if fl.enabled:
+            self._flight_batch_done(live, len(rows), assemble_s,
+                                    predict_s, meta)
         self._tee_batch(rows, live)
 
     def _score_individually(self, reqs: list, t_deq: float) -> None:
@@ -288,8 +321,16 @@ class InlineAssembler(BatchPlane):
                                {"queue_s": t_deq - p.t_enq,
                                 "assemble_s": 0.0,
                                 "predict_s": t_p1 - t_p0}, None)
+                fl = self._flight
+                if fl.enabled:
+                    self._flight_batch_done([p], p.n, 0.0, t_p1 - t_p0,
+                                            meta)
             except Exception as e:     # noqa: BLE001 — per-request fate
                 self.errors += 1
+                fl = self._flight
+                if fl.enabled:
+                    fl.record("req.err", f"req={p.req_no}{FS}"
+                                         f"err={type(e).__name__}")
                 self._complete(p, None, None,
                                {"queue_s": t_deq - p.t_enq,
                                 "assemble_s": 0.0, "predict_s": 0.0}, e)
@@ -1218,6 +1259,9 @@ class EvRouterFrontend(_EvLoopServer):
             if hit is not None:
                 with r._stats_lock:
                     r.routed += 1
+                fl = r._flight
+                if fl.enabled:
+                    fl.record("route.hit")
                 self._tee(body)
                 self._relay(conn, hit)
                 return
@@ -1359,20 +1403,30 @@ class EvRouterFrontend(_EvLoopServer):
         fwd.last_err = f"{h.rid}: {type(e).__name__}: {e}"
         with self._router._stats_lock:
             self._router.retries += 1
+        fl = self._router._flight
+        if fl.enabled:                 # a transport failure is exactly
+            # the moment the black box exists for
+            fl.record("route.retry",
+                      f"rid={h.rid}{FS}err={type(e).__name__}")
 
     def _fwd_finish_error(self, fwd: _Fwd) -> None:
         """No replica left to try: answer the client with the
         route_predict fallback JSON."""
         self._fwds.discard(fwd)
         r = self._router
+        fl = r._flight
         if fwd.last_err is None:
             with r._stats_lock:
                 r.no_replica += 1
+            if fl.enabled:
+                fl.record("route.none")
             code = 503
             obj = {"error": "no ready replica", "shed": True}
         else:
             with r._stats_lock:
                 r.proxy_errors += 1
+            if fl.enabled:
+                fl.record("route.fail", f"err={fwd.last_err[:80]}")
             code = 502
             obj = {"error": f"all replicas failed: {fwd.last_err}"}
         conn = fwd.client
@@ -1485,6 +1539,14 @@ class EvRouterFrontend(_EvLoopServer):
             # the router's half of the cross-process flame
             r._tracer.add_span("router.forward", total_s,
                                trace=fwd.trace_id)
+        fl = r._flight
+        if fl.enabled:                 # the fleet timeline's spine:
+            # which replica answered, how fast, on which trace
+            line = (f"rid={h.rid}{FS}status={status}{FS}"
+                    f"ms={total_s * 1e3:.2f}")
+            if fwd.trace_id:
+                line += f"{FS}trace={fwd.trace_id}"
+            fl.record("route", line)
         head, raw = r._relay_with_hops(lines, payload, total_s)
         cache = r.result_cache
         if cache is not None and status == 200:

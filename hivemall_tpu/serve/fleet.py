@@ -70,6 +70,9 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
+from ..obs.flight import (ENV_DIR as _FLIGHT_DIR_ENV,
+                          ENV_LABEL as _FLIGHT_LABEL_ENV,
+                          FS, configure_flight, emit_postmortem, get_flight)
 from .router import RouterServer
 
 __all__ = ["ReplicaManager", "Fleet"]
@@ -132,7 +135,8 @@ class ReplicaManager:
                  promote: bool = False,
                  canary_fraction: float = 0.25,
                  bake_opts: Optional[dict] = None,
-                 retrain=None):
+                 retrain=None,
+                 flight_dir: Optional[str] = None):
         if not checkpoint_dir and not bundle:
             raise ValueError("fleet needs checkpoint_dir=... or bundle=...")
         self.algo = algo
@@ -209,6 +213,22 @@ class ReplicaManager:
         self.promotions = 0
         self.canary_rollbacks = 0
         self.quarantined = 0
+        # black-box flight recorder (obs.flight): ALWAYS on for a
+        # checkpoint-dir fleet — the whole point is recording the run
+        # nobody expected to crash. Explicit flight_dir wins, then the
+        # env (an operator recording a whole pipeline into one dir),
+        # then <checkpoint_dir>/flight; a pinned-bundle fleet with no
+        # env stays dark. Every replica spawn inherits the dir plus a
+        # per-SLOT label, so a respawn writes a fresh ring (pid in the
+        # name) and the victim's ring survives for the post-mortem.
+        fd = flight_dir
+        if fd is None:
+            fd = os.environ.get(_FLIGHT_DIR_ENV) or None
+            if (fd is None or fd == "0") and checkpoint_dir:
+                fd = os.path.join(checkpoint_dir, "flight")
+        self.flight_dir = fd if fd and fd != "0" else None
+        self._flight = (configure_flight(self.flight_dir, label="router")
+                        if self.flight_dir else get_flight())
         self._register_obs()
 
     # -- spawning ------------------------------------------------------------
@@ -240,6 +260,11 @@ class ReplicaManager:
         env = dict(self.env or {})
         if slot < len(self.per_replica_env):
             env.update(self.per_replica_env[slot])
+        if self.flight_dir:
+            # per-slot label: a respawned slot records under the same
+            # label with a new pid — the dead ring stays readable
+            env.setdefault(_FLIGHT_DIR_ENV, self.flight_dir)
+            env.setdefault(_FLIGHT_LABEL_ENV, f"replica-s{slot}")
         proc = subprocess.Popen(
             [sys.executable, "-m", "hivemall_tpu.serve.fleet", "--worker",
              json.dumps(self._spec(slot))],
@@ -430,6 +455,20 @@ class ReplicaManager:
         if self.router is not None:
             self.router.remove_replica(dead.rid)
         self.respawns += 1
+        fl = self._flight
+        if fl.enabled:
+            fl.record("fleet.respawn",
+                      f"slot={slot}{FS}rid={dead.rid}{FS}"
+                      f"pid={dead.proc.pid}{FS}rc={dead.proc.returncode}")
+        if self.flight_dir:
+            # the victim's ring (pid in its name) is already durable on
+            # disk; merge the fleet's rings into postmortem.txt NOW so
+            # the death's timeline exists even if nobody ever runs
+            # `hivemall_tpu obs postmortem` — off-thread, the monitor
+            # must keep polling survivors while the merge reads files
+            threading.Thread(target=emit_postmortem,
+                             args=(self.flight_dir,),
+                             name="fleet-postmortem", daemon=True).start()
         threading.Thread(target=self._respawn_slot, args=(slot,),
                          name=f"fleet-respawn-{slot}", daemon=True).start()
 
@@ -555,6 +594,10 @@ class ReplicaManager:
                 return
         self.fleet_step = step
         self.rolls += 1
+        fl = self._flight
+        if fl.enabled:
+            fl.record("fleet.roll", f"step={step}{FS}"
+                      f"bundle={os.path.basename(path)}")
 
     # -- gated promotion: canary rollout + auto-rollback ---------------------
     def _promotion_tick(self) -> bool:
@@ -622,6 +665,9 @@ class ReplicaManager:
         if report["verdict"] != "pass":
             reject_bundle(path, "; ".join(report["reasons"]))
             self.quarantined += 1
+            fl = self._flight
+            if fl.enabled:
+                fl.record("promote.quarantine", f"step={step}")
             return False
         n = len(self.replicas())
         if pb is None or n <= 1:
@@ -634,6 +680,9 @@ class ReplicaManager:
             get_stream().emit("promotion", bundle=os.path.basename(path),
                               step=step, state="serving")
             self.promotions += 1
+            fl = self._flight
+            if fl.enabled:
+                fl.record("promote.serving", f"step={step}")
             self._converge(path, step)
             return True
         self._last_manifest = promote_bundle(
@@ -708,6 +757,10 @@ class ReplicaManager:
         bake.start(self._cohort_totals(canary_rs),
                    self._cohort_totals(stable_rs))
         self._canary = {"step": step, "path": path, "bake": bake}
+        fl = self._flight
+        if fl.enabled:
+            fl.record("promote.canary",
+                      f"step={step}{FS}cohort={len(canary_rs)}")
         if self.router is not None:
             # a result-cache hit skips replica placement entirely — it
             # would starve the canary cohort of the comparable traffic
@@ -758,6 +811,9 @@ class ReplicaManager:
         self.promotions += 1
         get_stream().emit("promotion", bundle=os.path.basename(c["path"]),
                           step=c["step"], state="serving")
+        fl = self._flight
+        if fl.enabled:
+            fl.record("promote.serving", f"step={c['step']}")
         self._canary = None
         if self.router is not None:
             self.router.set_result_cache_bypass(False)
@@ -796,6 +852,10 @@ class ReplicaManager:
         self.canary_rollbacks += 1
         get_stream().emit("promotion_rollback", bundle=bundle, step=step,
                           reason=reason)
+        fl = self._flight
+        if fl.enabled:
+            fl.record("promote.rollback",
+                      f"step={step}{FS}reason={reason[:60]}")
         pb = promoted_bundle(self.checkpoint_dir, self._name)
         if pb is not None:
             self._converge(pb[1], pb[0])
@@ -925,6 +985,10 @@ class ReplicaManager:
             self._replicas.clear()
         if self._uds_dir:
             shutil.rmtree(self._uds_dir, ignore_errors=True)
+        if self.flight_dir:
+            # unmap the router ring (leaktrack hygiene); the file stays —
+            # it IS the record of this run
+            self._flight.close()
 
 
 class Fleet:
@@ -961,7 +1025,8 @@ class Fleet:
                  slo_opts: Optional[dict] = None,
                  retrain: bool = False,
                  retrain_opts: Optional[dict] = None,
-                 train_input: Optional[str] = None):
+                 train_input: Optional[str] = None,
+                 flight_dir: Optional[str] = None):
         from ..obs.slo import SloEngine
         from ..obs.trace import get_tracer
         get_tracer().process_label = "router"   # the merged /trace view
@@ -1021,7 +1086,7 @@ class Fleet:
             spawn_timeout=spawn_timeout, slo=self.slo,
             gate=gate, promote=promote,
             canary_fraction=canary_fraction, bake_opts=bake,
-            retrain=self.retrain)
+            retrain=self.retrain, flight_dir=flight_dir)
         if self.manager.promote:
             # the router's /promotion admin surface: pointer manifest +
             # the manager's live section in one payload
@@ -1187,6 +1252,9 @@ def _worker(spec_json: str) -> int:
     while not stop.wait(1.0):            # timed wait: signal-interruptible
         pass
     srv.stop(drain=True)
+    # unmap this replica's flight ring AFTER drain (the last batch.done
+    # events must land) — census hygiene; the file itself stays on disk
+    get_flight().close()
     if leaktrack.enabled():
         # the inherited metrics sink closes first — a sink left open
         # after drain would count as this replica's leak

@@ -11,7 +11,10 @@ replica PROCESSES:
    + the cross-replica aggregate, ``/metrics`` exports fleet gauges;
 3. KILLING one replica under live traffic costs ZERO failed requests
    (router retries transport failures on the survivor) and the manager
-   respawns back to full strength;
+   respawns back to full strength; the victim's mmap'd flight ring
+   survives the SIGKILL — the merged post-mortem (obs.flight) flags its
+   death gap, replays its final admitted request ids, and the manager's
+   auto-emitted ``postmortem.txt`` carries the dead ring;
 4. a newer checkpoint written mid-traffic ROLLS across the fleet (the
    manager verifies once, rolls one replica at a time) with zero dropped
    requests, converging every replica to the new step;
@@ -304,6 +307,49 @@ def _drive(args, tmp, ds, rows, ref, fleet, KeepAliveClient) -> int:
     check("kill_no_drops", not traffic_errs,
           f"({len(traffic_errs)} failed during kill, "
           f"{traffic_n[0]} total) {traffic_errs[:2]}")
+
+    # -- 3b. black-box flight recorder: the victim's final seconds ---------
+    # the SIGKILLed replica never got to flush anything — its mmap'd
+    # ring (pid in the name, so the respawn wrote a FRESH file) must
+    # still replay its admitted requests, and the merged post-mortem
+    # must flag its recording gap (docs/OBSERVABILITY.md "Flight
+    # recorder")
+    from ..obs.flight import merge_dir, read_ring, render_postmortem
+    fdir = fleet.manager.flight_dir
+    vname = f"replica-s{victim.slot}-{victim.proc.pid}"
+    vadmits, verr = [], ""
+    try:
+        vr = read_ring(os.path.join(fdir, f"{vname}.ring"))
+        vadmits = [e["fields"].get("req") for e in vr["events"]
+                   if e["kind"] == "req.admit"]
+    except (OSError, ValueError) as e:
+        verr = str(e)
+    check("victim_ring", bool(vadmits),
+          f"({len(vadmits)} admits survive the SIGKILL) {verr}")
+    merged = merge_dir(fdir)
+    gap_rings = {g["ring"] for g in merged["gaps"]}
+    replayed = {e["fields"].get("req") for e in merged["events"]
+                if e["ring"] == vname and e["kind"] == "req.admit"}
+    pm_text = render_postmortem(merged, tail=50)
+    check("postmortem",
+          vname in gap_rings                      # death gap flagged
+          and set(vadmits[-5:]) <= replayed       # final admits replayed
+          and "DEATH GAP" in pm_text,
+          f"(gaps {sorted(gap_rings)}, victim admits "
+          f"{len(vadmits)}/{len(replayed)} in merge)")
+    # the manager auto-emits the merged timeline on the respawn decision
+    # (written ~0.2s after the kill — the survivor may not be a full
+    # gap_s ahead yet, so assert the victim's ring made the roster, not
+    # the gap flag the later merge above already proved)
+    pm_path = os.path.join(fdir, "postmortem.txt")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not os.path.exists(pm_path):
+        time.sleep(0.2)
+    pm_ok = False
+    if os.path.exists(pm_path):
+        with open(pm_path) as f:
+            pm_ok = vname in f.read()
+    check("postmortem_autoemit", pm_ok, f"({pm_path})")
 
     # -- 4. rolling hot reload mid-traffic: zero drops, steps converge ----
     t2, _ = _train_bundle(

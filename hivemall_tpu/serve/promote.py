@@ -717,6 +717,8 @@ class PromotionController:
             return None
         pb = promoted_bundle(self.checkpoint_dir, self._name)
         report = self.gate.evaluate(cand, pb[1] if pb else None)
+        from ..obs.flight import get_flight
+        fl = get_flight()
         if report["verdict"] == "pass":
             promote_bundle(self.checkpoint_dir, cand,
                            gate=_gate_summary(report),
@@ -726,10 +728,15 @@ class PromotionController:
             get_stream().emit("promotion", bundle=report["bundle"],
                               step=report["step"],
                               state=self.promote_state)
+            if fl.enabled:
+                fl.record("promote.serving", step=report["step"],
+                          state=self.promote_state)
         else:
             reject_bundle(cand, "; ".join(report["reasons"]))
             self.quarantined += 1
             report["promoted"] = False
+            if fl.enabled:
+                fl.record("promote.quarantine", step=report["step"])
         return report
 
     # -- watcher -------------------------------------------------------------

@@ -619,6 +619,13 @@ class RetrainController:
             prev, self.state = self.state, state
             self._phase_since = time.monotonic()
         self._save_state()
+        # retrain state edges go to the flight ring too: "the autopilot
+        # was mid-<state> when the manager died" is exactly what a
+        # post-mortem of a wedged retrain needs
+        from ..obs.flight import get_flight
+        fl = get_flight()
+        if fl.enabled:
+            fl.record("retrain.state", state=state, prev=prev)
         if event.pop("emit", True):
             get_stream().emit("retrain", state=state, prev=prev, **event)
 

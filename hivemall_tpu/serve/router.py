@@ -57,6 +57,7 @@ import time
 import zlib
 from typing import Dict, List, Optional
 
+from ..obs.flight import FS, get_flight
 from ..obs.http import to_prometheus
 from ..obs.registry import registry
 from ..obs.trace import get_tracer, mint_trace_id
@@ -531,6 +532,12 @@ class RouterServer:
         # the serving path
         self.predict_tee = None
         self._tracer = get_tracer()
+        # black-box flight recorder (obs.flight): the router's ring is
+        # the fleet timeline's spine — every forward/retry/failover
+        # lands here, so a post-mortem can line a victim's last admitted
+        # requests up against what the router saw. Hot sites guard with
+        # `if fl.enabled:` (one attribute check when dark).
+        self._flight = get_flight()
         self._lock = threading.Lock()
         self._handles: Dict[str, ReplicaHandle] = {}
         self._ring = _Ring()
@@ -627,6 +634,9 @@ class RouterServer:
             if hit is not None:
                 with self._stats_lock:
                     self.routed += 1
+                fl = self._flight
+                if fl.enabled:
+                    fl.record("route.hit")
                 return 200, hit, None
             # snapshot the version BEFORE placing: an invalidate() that
             # lands while this forward is in flight must make put() a
@@ -667,6 +677,14 @@ class RouterServer:
                 if cache is not None and status == 200:
                     cache.put(body, head, payload,
                               version=cache_version)
+                fl = self._flight
+                if fl.enabled:           # the fleet timeline's spine:
+                    # which replica answered, how fast, on which trace
+                    line = (f"rid={h.rid}{FS}status={status}{FS}"
+                            f"ms={total_s * 1e3:.2f}")
+                    if trace_id:
+                        line += f"{FS}trace={trace_id}"
+                    fl.record("route", line)
                 return status, raw, None
             except _RETRYABLE as e:
                 with h._lock:
@@ -676,15 +694,25 @@ class RouterServer:
                 last_err = f"{h.rid}: {type(e).__name__}: {e}"
                 with self._stats_lock:
                     self.retries += 1
+                fl = self._flight
+                if fl.enabled:           # a transport failure is exactly
+                    # the moment the black box exists for
+                    fl.record("route.retry",
+                              f"rid={h.rid}{FS}err={type(e).__name__}")
             finally:
                 with h._lock:
                     h.inflight -= 1
+        fl = self._flight
         if last_err is None:
             with self._stats_lock:
                 self.no_replica += 1
+            if fl.enabled:
+                fl.record("route.none")
             return 503, None, {"error": "no ready replica", "shed": True}
         with self._stats_lock:
             self.proxy_errors += 1
+        if fl.enabled:
+            fl.record("route.fail", f"err={last_err[:80]}")
         return 502, None, {"error": f"all replicas failed: {last_err}"}
 
     @staticmethod

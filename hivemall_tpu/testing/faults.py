@@ -173,7 +173,15 @@ class FlakyProxy:
             fault = self.schedule.get(ordinal)
             if fault is not None:
                 self.faults_applied += 1
-            return fault
+        if fault is not None:
+            # fault hits land in the flight ring: a post-mortem of a
+            # fault-injection run shows WHICH injected failure preceded
+            # the request errors around it
+            from ..obs.flight import get_flight
+            fl = get_flight()
+            if fl.enabled:
+                fl.record("fault.hit", at=ordinal, fault=fault)
+        return fault
 
     def _pump_up(self, c: socket.socket, u: socket.socket) -> None:
         """Client→upstream leg: where the fault schedule applies."""
@@ -407,6 +415,11 @@ def inject_canary_regression(manager, *, latency_ms: float = 0.0,
         return t
 
     manager._bake_inject = perturb
+    from ..obs.flight import get_flight
+    fl = get_flight()
+    if fl.enabled:
+        fl.record("fault.canary_inject", latency_ms=latency_ms,
+                  extra_errors=extra_errors, score_shift=score_shift)
 
     def undo() -> None:
         manager._bake_inject = None

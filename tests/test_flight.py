@@ -1,0 +1,412 @@
+"""Black-box flight recorder tests (docs/OBSERVABILITY.md "Flight
+recorder"): ring round-trip, torn-slot tolerance after a simulated
+mid-write kill, the disabled one-attribute-check contract, the id
+run-length codec, the fleet-wide merge (death gaps + uncompleted
+requests), the auto-emitted post-mortem artifact, and the serving
+seams' admit/complete correlation through a live ring."""
+
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import hivemall_tpu.obs.flight as flight_mod
+from hivemall_tpu.obs.flight import (DEFAULT_SLOT, FS, HEADER_SIZE,
+                                     FlightRecorder, configure_flight,
+                                     emit_postmortem, flight_stub,
+                                     get_flight, merge_dir, pack_ids,
+                                     read_ring, render_postmortem,
+                                     unpack_ids)
+
+
+@pytest.fixture
+def live(tmp_path):
+    """The process recorder bound to a tmp ring for one test, always
+    left dark afterwards (it is process-global)."""
+    fr = configure_flight(str(tmp_path), label="t")
+    assert fr.enabled
+    yield fr, str(tmp_path)
+    configure_flight(None)
+
+
+def _only_ring(d):
+    rings = [os.path.join(d, f) for f in os.listdir(d)
+             if f.endswith(".ring")]
+    assert len(rings) == 1
+    return rings[0]
+
+
+# --- writer contract ---------------------------------------------------------
+
+def test_disabled_record_is_one_attribute_check():
+    fr = FlightRecorder()
+    assert fr.enabled is False
+    # no mapping exists; record must be a pure attribute check + return
+    fr.record("req.admit", req=1, rows=4)
+    fr.record("req.admit", f"req=1{FS}rows=4")
+    fr.record("bare")
+    assert fr.events == 0 and fr.truncated == 0
+    assert fr.obs_section() == flight_stub()
+
+
+def test_record_never_raises_after_close(tmp_path):
+    fr = FlightRecorder().open(str(tmp_path / "a.ring"))
+    fr.record("x", a=1)
+    fr.close()
+    fr.record("x", a=2)                  # dropped, not raised
+    # racing close: enabled flipped back but the mapping is gone
+    fr.enabled = True
+    fr.record("x", a=3)
+    fr.enabled = False
+
+
+def test_ring_round_trip(tmp_path):
+    path = str(tmp_path / "rt.ring")
+    fr = FlightRecorder().open(path, label="unit")
+    fr.record("req.admit", req=1, rows=4, depth=0)
+    fr.record("req.admit", f"req=2{FS}rows=8{FS}trace=zz11")
+    fr.record("batch.done",
+              f"reqs={pack_ids([1, 2])}{FS}rows=12{FS}p=1.25")
+    fr.record("engine.reload", ok=1, to=512)
+    fr.close()
+
+    r = read_ring(path)
+    assert r["label"] == "unit" and r["pid"] == os.getpid()
+    assert r["torn"] == 0
+    kinds = [e["kind"] for e in r["events"]]
+    assert kinds == ["req.admit", "req.admit", "batch.done",
+                     "engine.reload"]
+    e1, e2, bd, rl = r["events"]
+    assert e1["fields"] == {"req": 1, "rows": 4, "depth": 0}
+    assert e2["fields"]["trace"] == "zz11"        # strings survive
+    assert bd["fields"]["p"] == 1.25              # floats coerce
+    assert unpack_ids(bd["fields"]["reqs"]) == [1, 2]
+    assert rl["fields"] == {"ok": 1, "to": 512}
+    # timestamps are wall-clock and ordered
+    ts = [e["ts"] for e in r["events"]]
+    # wall-clock anchor: ring timestamps ARE wall time by design
+    assert ts == sorted(ts) and abs(ts[0] - time.time()) < 60  # graftcheck: disable=GC02
+
+
+def test_ring_wraps_and_counts_dropped(tmp_path):
+    path = str(tmp_path / "wrap.ring")
+    fr = FlightRecorder().open(path, nslots=8)
+    for i in range(20):
+        fr.record("tick", i=i)
+    assert fr.events == 20 and fr.dropped == 12
+    assert fr.obs_section()["utilization"] == 1.0
+    fr.close()
+    r = read_ring(path)
+    assert [e["fields"]["i"] for e in r["events"]] == list(range(12, 20))
+
+
+def test_torn_slot_detected_and_skipped(tmp_path):
+    path = str(tmp_path / "torn.ring")
+    fr = FlightRecorder().open(path, nslots=8)
+    for i in range(5):
+        fr.record("tick", i=i)
+    fr.close()
+    # simulate SIGKILL mid-write of slot 2: head stamped, tail stale
+    with open(path, "r+b") as f:
+        off = HEADER_SIZE + 2 * DEFAULT_SLOT
+        f.seek(off + DEFAULT_SLOT - 4)
+        f.write(struct.pack("<I", 0xDEAD))
+    r = read_ring(path)
+    assert r["torn"] == 1
+    assert [e["fields"]["i"] for e in r["events"]] == [0, 1, 3, 4]
+
+
+def test_oversized_payload_truncated_not_lost(tmp_path):
+    path = str(tmp_path / "big.ring")
+    fr = FlightRecorder().open(path)
+    fr.record("huge", blob="x" * 10_000)
+    assert fr.truncated == 1
+    fr.close()
+    r = read_ring(path)
+    assert r["torn"] == 0 and len(r["events"]) == 1
+    assert r["events"][0]["kind"] == "huge"
+
+
+def test_not_a_ring_rejected(tmp_path):
+    p = tmp_path / "nope.ring"
+    p.write_bytes(b"\x00" * 1024)
+    with pytest.raises(ValueError, match="bad magic"):
+        read_ring(str(p))
+    p2 = tmp_path / "short.ring"
+    p2.write_bytes(b"hi")
+    with pytest.raises(ValueError, match="truncated"):
+        read_ring(str(p2))
+
+
+def test_record_is_thread_safe(tmp_path):
+    path = str(tmp_path / "mt.ring")
+    fr = FlightRecorder().open(path, nslots=4096)
+
+    def work():
+        for i in range(300):
+            fr.record("tick", i=i)
+
+    ts = [threading.Thread(target=work) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # _last_seq is a benign-race plain store: some reserved seq, not
+    # necessarily the max — the ring itself is the real guarantee
+    assert 1 <= fr.events <= 1200
+    fr.close()
+    r = read_ring(path)
+    assert r["torn"] == 0 and len(r["events"]) == 1200
+    assert [e["seq"] for e in r["events"]] == list(range(1, 1201))
+
+
+# --- id codec ---------------------------------------------------------------
+
+def test_pack_unpack_ids_round_trip():
+    for ids in ([], [7], [1, 2, 3], [5, 6, 7, 40], [3, 1, 2],
+                list(range(1, 257))):
+        assert unpack_ids(pack_ids(ids)) == ids
+    assert pack_ids(range(1, 257)) == "1-256"     # a full batch fits
+    # truncation tolerance: garbage tokens are skipped, a clipped
+    # trailing range degrades to its start
+    assert unpack_ids("1-3,5,9x") == [1, 2, 3, 5]
+    assert unpack_ids("1-3,5,9-") == [1, 2, 3, 5, 9]
+    assert unpack_ids("") == []
+
+
+# --- merge + post-mortem -----------------------------------------------------
+
+def _write_fleet(tmp_path):
+    """A router ring, a victim ring that goes silent mid-flight, and a
+    survivor that keeps serving — the SIGKILL post-mortem shape."""
+    d = str(tmp_path)
+    router = FlightRecorder().open(os.path.join(d, "router.ring"),
+                                   label="router")
+    victim = FlightRecorder().open(os.path.join(d, "replica-s0.ring"),
+                                   label="replica-s0")
+    survivor = FlightRecorder().open(os.path.join(d, "replica-s1.ring"),
+                                     label="replica-s1")
+    t0 = time.time() - 10.0   # wall-clock anchor # graftcheck: disable=GC02
+
+    def stamp(fr, ts, kind, **fields):
+        fr.record(kind, **fields)
+        # rewrite the slot's wall clock so the scenario spans real time
+        off = HEADER_SIZE + (fr.events - 1) % fr._nslots * fr._slot
+        head = struct.Struct("<QdI")
+        seq, _, n = head.unpack_from(fr._mm, off)
+        head.pack_into(fr._mm, off, seq, ts, n)
+
+    stamp(victim, t0 + 0.0, "req.admit", req=1, rows=4)
+    stamp(victim, t0 + 0.1, "batch.done", reqs=pack_ids([1]), rows=4)
+    stamp(victim, t0 + 0.2, "req.admit", req=2, rows=4, trace="zz11")
+    stamp(victim, t0 + 0.3, "req.admit", req=3, rows=4)
+    # victim dies here: 2 and 3 admitted, never completed
+    for i in range(4, 10):
+        stamp(survivor, t0 + i, "req.admit", req=i, rows=2)
+        stamp(survivor, t0 + i + 0.05, "batch.done",
+              reqs=pack_ids([i]), rows=2)
+    stamp(router, t0 + 5.0, "fleet.respawn", slot=0, pid=200)
+    stamp(router, t0 + 9.5, "route", rid="r2", status=200)
+    for fr in (router, victim, survivor):
+        fr.close()
+    pid = os.getpid()
+    return d, t0, {"router": f"router-{pid}",
+                   "victim": f"replica-s0-{pid}",
+                   "survivor": f"replica-s1-{pid}"}
+
+
+def test_merge_dir_flags_death_gap_and_uncompleted(tmp_path):
+    d, t0, names = _write_fleet(tmp_path)
+    m = merge_dir(d)
+    assert {r["name"] for r in m["rings"]} == set(names.values())
+    assert not m["unreadable"]
+    # events from all rings merge onto one ordered timeline
+    ts = [e["ts"] for e in m["events"]]
+    assert len(ts) == 18 and ts == sorted(ts)
+    gaps = {g["ring"]: g for g in m["gaps"]}
+    assert names["victim"] in gaps        # silent ~9.2s before the end
+    assert gaps[names["victim"]]["gap_s"] > 5.0
+    assert names["survivor"] not in gaps  # kept recording near the end
+    dead = next(r for r in m["rings"] if r["name"] == names["victim"])
+    assert [u["req"] for u in dead["uncompleted"]] == [2, 3]
+    assert dead["uncompleted"][0]["trace"] == "zz11"
+    # --since filters the merged timeline, not the gap analysis
+    m2 = merge_dir(d, since=t0 + 4.0)
+    assert m2["events"] and all(e["ts"] >= t0 + 4.0 for e in m2["events"])
+    assert {g["ring"] for g in m2["gaps"]} == {g["ring"] for g in m["gaps"]}
+
+
+def test_render_and_emit_postmortem(tmp_path):
+    d, _t0, _names = _write_fleet(tmp_path)
+    text = render_postmortem(merge_dir(d), tail=50)
+    assert "DEATH GAP" in text
+    assert "admitted but never completed (2): 2 trace=zz11, 3" in text
+    assert "fleet.respawn" in text
+    out = emit_postmortem(d)
+    assert out and os.path.exists(out) and os.path.exists(out + ".json")
+    with open(out) as f:
+        assert "DEATH GAP" in f.read()
+    # never raises, even pointed at a non-directory
+    assert emit_postmortem(os.path.join(d, "router.ring")) is None
+
+
+def test_obs_postmortem_cli(tmp_path, capsys):
+    from hivemall_tpu.cli.main import main
+    d, t0, _names = _write_fleet(tmp_path)
+    assert main(["obs", "postmortem", d, "--tail", "30"]) == 0
+    out = capsys.readouterr().out
+    assert "flight postmortem: 3 ring(s)" in out and "DEATH GAP" in out
+    # --since: absolute epoch narrows the timeline
+    assert main(["obs", "postmortem", d, "--since", f"{t0 + 8.0}"]) == 0
+    assert "route rid=r2" in capsys.readouterr().out
+    empty = str(tmp_path / "void")
+    os.makedirs(empty)
+    assert main(["obs", "postmortem", empty]) == 1
+    assert main(["obs", "postmortem"]) == 2
+
+
+def test_parse_since_grammar():
+    from hivemall_tpu.obs.report import parse_since
+    assert parse_since(None) is None
+    now = time.time()         # wall-clock anchor # graftcheck: disable=GC02
+    rel = parse_since("300")              # seconds-ago form
+    assert now - 301 < rel < now - 299    # graftcheck: disable=GC02
+    assert parse_since("1754180000.5") == 1754180000.5
+
+
+# --- process singleton -------------------------------------------------------
+
+def test_get_flight_env_binding(tmp_path, monkeypatch):
+    from hivemall_tpu.obs.registry import registry
+    orig = get_flight()                   # the real process singleton
+    monkeypatch.setattr(flight_mod, "_flight", None)
+    monkeypatch.setenv(flight_mod.ENV_DIR, str(tmp_path))
+    monkeypatch.setenv(flight_mod.ENV_LABEL, "envtest")
+    monkeypatch.setenv(flight_mod.ENV_SLOTS, "64")
+    fr = get_flight()
+    try:
+        assert fr is not orig and fr.enabled
+        assert fr.label == "envtest"
+        assert fr.obs_section()["ring_slots"] == 64
+        assert os.path.basename(fr.path) == f"envtest-{os.getpid()}.ring"
+        assert get_flight() is fr
+    finally:
+        fr.close()
+        # get_flight re-registered the temp recorder as the `flight`
+        # section; point the registry back at the real singleton
+        # (monkeypatch teardown restores flight_mod._flight itself)
+        registry.register("flight", orig.obs_section)
+
+
+def test_configure_flight_rebinds_and_registers(tmp_path):
+    from hivemall_tpu.obs.registry import registry
+    fr = configure_flight(str(tmp_path / "a"), label="one")
+    try:
+        assert fr is get_flight() and fr.enabled
+        fr.record("x")
+        sec = registry.snapshot()["flight"]
+        assert sec["enabled"] and sec["events"] == 1
+        assert set(sec) == set(flight_stub())     # stub parity, live side
+        # rebind closes the old ring and opens a fresh one
+        p1 = fr.path
+        configure_flight(str(tmp_path / "b"), label="two")
+        assert fr.path != p1 and fr.events == 0
+    finally:
+        configure_flight(None)
+        assert registry.snapshot()["flight"]["enabled"] is False
+
+
+# --- serving-plane correlation ----------------------------------------------
+
+def test_batcher_events_correlate_through_ring(live):
+    from hivemall_tpu.serve.batcher import MicroBatcher
+    fr, d = live
+
+    def predict(rows):
+        return np.zeros(len(rows), np.float32)
+
+    b = MicroBatcher(predict, max_batch=8, max_delay_ms=0.0)
+    try:
+        for _ in range(6):
+            b.submit([("a",), ("b",)]).result(5)
+    finally:
+        b.close()
+    fr.close()
+    r = read_ring(_only_ring(d))
+    admits = [e for e in r["events"] if e["kind"] == "req.admit"]
+    assert [e["fields"]["req"] for e in admits] == list(range(1, 7))
+    assert all(e["fields"]["rows"] == 2 for e in admits)
+    done = set()
+    for e in r["events"]:
+        if e["kind"] == "batch.done":
+            done.update(unpack_ids(e["fields"]["reqs"]))
+    assert done == set(range(1, 7))       # every admit completed
+    assert flight_mod._uncompleted(r["events"]) == []
+
+
+def test_batcher_shed_reaches_ring(live):
+    from hivemall_tpu.serve.batcher import MicroBatcher, ServeOverload
+    fr, d = live
+    started, gate = threading.Event(), threading.Event()
+
+    def predict(rows):
+        started.set()
+        assert gate.wait(10)
+        return np.zeros(len(rows), np.float32)
+
+    b = MicroBatcher(predict, max_batch=8, max_delay_ms=0.0,
+                     max_queue_rows=2)
+    try:
+        first = b.submit([("a",)])        # occupies the worker
+        assert started.wait(5)
+        queued = b.submit([("b",), ("c",)])
+        with pytest.raises(ServeOverload):
+            b.submit([("d",)])            # 2 rows queued + 1 > max 2
+        gate.set()
+        first.result(5)
+        queued.result(5)
+    finally:
+        gate.set()
+        b.close()
+    fr.close()
+    evs = read_ring(_only_ring(d))["events"]
+    shed = [e for e in evs if e["kind"] == "req.shed"]
+    assert len(shed) == 1
+    assert shed[0]["fields"] == {"rows": 1, "depth": 2}
+    # the shed request was never admitted: only reqs 1 and 2 exist
+    admits = [e["fields"]["req"] for e in evs if e["kind"] == "req.admit"]
+    assert admits == [1, 2]
+    assert flight_mod._uncompleted(evs) == []
+
+
+def test_engine_reload_edges_reach_ring(live, tmp_path):
+    from hivemall_tpu.io.libsvm import synthetic_classification
+    from hivemall_tpu.models.linear import GeneralClassifier
+    from hivemall_tpu.serve.engine import PredictEngine
+    fr, d = live
+    opts = "-dims 256 -loss logloss -mini_batch 32"
+    ds, _ = synthetic_classification(64, 32, seed=3)
+    t = GeneralClassifier(opts)
+    t.fit(ds)
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    t.save_bundle(str(ck / f"{t.NAME}-step{t._t:010d}.npz"))
+    eng = PredictEngine("train_classifier", opts,
+                        checkpoint_dir=str(ck), warmup=False)
+    step0 = eng.model_step
+    bad = ck / f"{t.NAME}-step{step0 + 999:010d}.npz"
+    bad.write_bytes(b"not a bundle")
+    assert eng.poll() is False            # corrupt: failure edge
+    t.fit(ds)
+    t.save_bundle(str(ck / f"{t.NAME}-step{t._t:010d}.npz"))
+    assert eng.poll() is True             # newer valid: success edge
+    fr.close()
+    evs = [e for e in read_ring(_only_ring(d))["events"]
+           if e["kind"] == "engine.reload"]
+    assert [e["fields"]["ok"] for e in evs] == [0, 1]
+    assert evs[0]["fields"]["err"]        # failure carries the exc type
+    assert evs[1]["fields"]["from"] == step0
+    assert evs[1]["fields"]["to"] == eng.model_step

@@ -200,6 +200,89 @@ def test_to_prometheus_exposition_format():
         assert l.startswith(("# TYPE ", "# HELP ")) or metric.match(l), l
 
 
+def test_to_prometheus_name_collision_disambiguated():
+    """Sanitization is lossy ('a.b' and 'a_b' flatten to one name): two
+    families under one name is invalid exposition, so the later arrival
+    must be renamed with a _dup suffix and the event surfaced as a
+    name_collisions gauge."""
+    text = to_prometheus({"sec": {"a.b": 1, "a_b": 2,
+                                  "a-b": 3}})     # three-way collision
+    lines = text.splitlines()
+    # keys walk in sorted order: 'a-b' arrives first and keeps the name
+    assert "hivemall_tpu_sec_a_b 3" in lines
+    assert "hivemall_tpu_sec_a_b_dup2 1" in lines
+    assert "hivemall_tpu_sec_a_b_dup3 2" in lines
+    assert "hivemall_tpu_name_collisions 2" in lines
+    # HELP carries each family's TRUE dot-path, so the rename is
+    # recoverable from the scrape itself
+    assert "# HELP hivemall_tpu_sec_a_b_dup2 sec.a.b" in lines
+    # emitted names are unique — the invalid-exposition hazard is gone
+    names = [l.split()[0] for l in lines if not l.startswith("#")]
+    assert len(names) == len(set(names))
+    # still grammar-valid exposition
+    metric = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]* -?[0-9.eE+-]+$")
+    for l in lines:
+        assert l.startswith(("# TYPE ", "# HELP ")) or metric.match(l), l
+
+
+def test_to_prometheus_no_false_collision():
+    """Distinct dot-paths that sanitize to distinct names must NOT pay
+    the _dup rename, and the collisions gauge must stay absent."""
+    text = to_prometheus({"pipeline": {"batches": 1},
+                          "train": {"batches": 2}})
+    assert "hivemall_tpu_pipeline_batches 1" in text
+    assert "hivemall_tpu_train_batches 2" in text
+    assert "_dup" not in text and "name_collisions" not in text
+
+
+def test_to_prometheus_empty_histogram_and_nonfinite():
+    """An empty histogram (no observations yet) exports sum/count only;
+    NaN/inf gauge values export as Prometheus' case-insensitive
+    'nan'/'inf' literals instead of corrupting the exposition."""
+    text = to_prometheus({
+        "serve": {"lat": {"_type": "histogram", "buckets": [],
+                          "sum": 0.0, "count": 0},
+                  "bad": float("nan"),
+                  "hot": float("inf"),
+                  "cold": float("-inf")}})
+    lines = text.splitlines()
+    assert "hivemall_tpu_serve_lat_sum 0.0" in lines
+    assert "hivemall_tpu_serve_lat_count 0" in lines
+    assert not any("_bucket" in l for l in lines)
+    assert "hivemall_tpu_serve_bad nan" in lines
+    assert "hivemall_tpu_serve_hot inf" in lines
+    assert "hivemall_tpu_serve_cold -inf" in lines
+
+
+def test_flight_section_round_trips_through_obs_server(tmp_path):
+    """The flight recorder's self-census scrapes end to end: /snapshot
+    carries the section (path included), /metrics its numeric gauges."""
+    from hivemall_tpu.obs.flight import configure_flight
+    from hivemall_tpu.obs.registry import registry as process_registry
+    fr = configure_flight(str(tmp_path), label="scrape")
+    srv = ObsServer(0, obs_registry=process_registry).start()
+    try:
+        fr.record("req.admit", req=1, rows=2)
+        fr.record("req.admit", req=2, rows=2)
+        base = f"http://127.0.0.1:{srv.port}"
+        snap = json.loads(urllib.request.urlopen(f"{base}/snapshot",
+                                                 timeout=5).read())
+        assert snap["flight"]["enabled"] is True
+        assert snap["flight"]["events"] == 2
+        assert snap["flight"]["label"] == "scrape"
+        assert snap["flight"]["path"] == fr.path
+        text = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=5).read().decode()
+        lines = text.splitlines()
+        assert "hivemall_tpu_flight_enabled 1" in lines
+        assert "hivemall_tpu_flight_events 2" in lines
+        assert "hivemall_tpu_flight_dropped 0" in lines
+        assert "hivemall_tpu_flight_ring_slots 4096" in lines
+    finally:
+        srv.stop()
+        configure_flight(None)
+
+
 def test_obs_http_server_snapshot_and_metrics():
     r = Registry()
     r.register("unit", lambda: {"value": 42})
@@ -813,6 +896,20 @@ def test_stub_sections_match_live_providers(tmp_path):
         "devprof stub drifted from live keys"
     assert set(devprof_stub()["memory"]) == set(live_dp["memory"])
     assert set(devprof_stub()["drift"]) == set(live_dp["drift"])
+
+    # flight: FlightRecorder.obs_section() — dark AND recording forms
+    # must both mirror the stub (the checkpoint-dir ReplicaManagers
+    # above flipped the process recorder on; leave it dark again)
+    from hivemall_tpu.obs.flight import (FlightRecorder, configure_flight,
+                                         flight_stub)
+    assert flight_stub() == FlightRecorder().obs_section(), \
+        "flight stub drifted from live keys"
+    lfr = FlightRecorder().open(str(tmp_path / "parity.ring"))
+    lfr.record("x")
+    assert set(flight_stub()) == set(lfr.obs_section()), \
+        "flight stub drifted from recording-state live keys"
+    lfr.close()
+    configure_flight(None)
 
     # trainer-inactive forms reuse the SAME stub dicts (pinned here so a
     # future inline dict can't drift silently)
