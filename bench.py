@@ -1777,13 +1777,183 @@ def bench_flight(n_events: int = 200_000, smoke: bool = False) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_retrieval(n_queries: int = 2000, concurrency: int = 8,
+                    smoke: bool = False) -> dict:
+    """Retrieval-plane bench (docs/SERVING.md "Retrieval plane"): the
+    in-process RetrievalEngine + MicroBatcher driven to saturation by
+    ``concurrency`` client threads on each candidate tier —
+
+    - exact full-scan top-k qps (the bit-exact each_top_k-equal tier);
+    - SRP-LSH candidate tier qps (candidates + exact rescore);
+    - the recall@10-vs-table-count curve against exact search (the
+      deterministic metric — seeded factors, seeded index — that the
+      --compare gate pins; qps keys are volatile on shared CI hosts).
+
+    The acceptance shape wants lsh_qps >= 2x exact_qps at saturation;
+    hosts where the python per-query overhead dominates the scan (tiny
+    catalogs, busy CI) record ``retrieval_machine_bound`` instead, same
+    idiom as the fleet scaling floor."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import numpy as np
+    from hivemall_tpu.knn.ann import (SrpIndex, exact_top_ids,
+                                      mips_augment, mips_query,
+                                      recall_at_k)
+    from hivemall_tpu.models.mf import MFTrainer
+    from hivemall_tpu.serve.batcher import MicroBatcher
+    from hivemall_tpu.serve.retrieve import RetrievalEngine
+
+    if smoke:
+        n_queries, concurrency = 600, 4
+    users, items, factors = (512, 8192, 16) if smoke \
+        else (4096, 65536, 32)
+    opts = (f"-factors {factors} -users {users} -items {items} "
+            f"-mini_batch 1024 -iters 1")
+    tmp = tempfile.mkdtemp(prefix="hivemall_tpu_bench_retrieval_")
+    try:
+        # planted low-rank structure: ratings come from ground-truth
+        # rank-8 factors + noise, so the trained factor geometry is
+        # MEANINGFUL and recall@k measures the index, not noise.  (Pure
+        # iid-noise ratings make the "true" top-k arbitrary — no angular
+        # structure for LSH to exploit, recall floors near the candidate
+        # fraction.)
+        rng = np.random.default_rng(11)
+        gp = rng.standard_normal((users, 8)).astype(np.float32)
+        gq = rng.standard_normal((items, 8)).astype(np.float32)
+        n_obs = 200_000 if smoke else 800_000
+        uu = rng.integers(0, users, n_obs)
+        ii = rng.integers(0, items, n_obs)
+        y = ((gp[uu] * gq[ii]).sum(-1) + 3.0
+             + 0.1 * rng.standard_normal(n_obs)).astype(np.float32)
+        t = MFTrainer(opts)
+        t.fit(uu, ii, y, epochs=3)
+        path = os.path.join(tmp,
+                            f"train_mf_sgd-step{int(t._t):010d}.npz")
+        t.save_bundle(path)
+        eng = RetrievalEngine("train_mf_sgd", opts, bundle=path,
+                              rescore="numpy", max_batch=256)
+        try:
+            sample = rng.integers(0, users, 256)
+
+            def timed_round(tier: str) -> float:
+                """One independent saturation round on a fresh batcher;
+                returns qps."""
+                qs = [eng.parse_query({"user": int(u), "k": 10,
+                                       "tier": tier}) for u in sample]
+                batcher = MicroBatcher(eng.retrieve_rows_versioned,
+                                       max_batch=256, max_delay_ms=0.0)
+                nxt = iter(range(n_queries))
+                lock = threading.Lock()
+
+                def client():
+                    while True:
+                        with lock:
+                            i = next(nxt, None)
+                        if i is None:
+                            return
+                        batcher.submit([qs[i % len(qs)]]).result(30)
+
+                batcher.submit([qs[0]]).result(30)      # warm
+                t0 = time.perf_counter()
+                threads = [threading.Thread(target=client)
+                           for _ in range(concurrency)]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                dt = time.perf_counter() - t0
+                batcher.close()
+                return n_queries / dt
+
+            ex_rounds = sorted(timed_round("exact") for _ in range(3))
+            lsh_rounds = sorted(timed_round("lsh") for _ in range(3))
+            exact_qps, exact_med = ex_rounds[-1], ex_rounds[1]
+            lsh_qps, lsh_med = lsh_rounds[-1], lsh_rounds[1]
+            idx_stats = eng.obs_section()["index"]
+
+            # recall@10-vs-table-count curve — deterministic (seeded
+            # factors + seeded hyperplanes), computed over the SAME
+            # MIPS-augmented geometry and seed the serving tier hashes,
+            # so curve["12"] IS the served tier's recall.  cand_frac is
+            # the other axis of the trade-off: the fraction of the
+            # catalog the second stage rescans.
+            _meta, tabs = t.serving_tables()
+            P = np.asarray(tabs["P"], np.float32)
+            Q = np.asarray(tabs["Q"], np.float32)
+            bi = tabs.get("bi")
+            aug, _m = mips_augment(Q, bi)
+            qsample = rng.choice(users, size=64, replace=False)
+            curve, cand_frac = {}, {}
+            for n_tables in (2, 4, 8, 12):
+                idx = SrpIndex(aug, n_tables=n_tables)
+                recs, fracs = [], []
+                for u in qsample:
+                    scores = Q @ P[u]
+                    if bi is not None:
+                        scores = scores + np.asarray(bi, np.float32)
+                    ex = exact_top_ids(scores, 10)
+                    cands = idx.candidates(
+                        mips_query(P[u], has_bias=bi is not None))
+                    fracs.append(len(cands) / len(Q))
+                    if not len(cands):
+                        recs.append(0.0)
+                        continue
+                    ap = cands[exact_top_ids(scores[cands], 10)]
+                    recs.append(recall_at_k(ap, ex))
+                curve[str(n_tables)] = round(float(np.mean(recs)), 4)
+                cand_frac[str(n_tables)] = round(float(np.mean(fracs)), 4)
+
+            speedup = lsh_qps / exact_qps if exact_qps > 0 else 0.0
+            out = {"metric": "retrieval_exact_qps",
+                   "value": round(exact_qps, 1),
+                   "value_median": round(exact_med, 1),
+                   "unit": "queries/sec",
+                   "seconds": round(n_queries / max(exact_qps, 1e-9), 4),
+                   "extra_results": {
+                       "retrieval_lsh_qps": [round(lsh_qps, 1),
+                                             round(lsh_med, 1)],
+                       # recall is in [0,1]; x1000 survives the record
+                       # round(...,1) with 3 significant digits intact
+                       "retrieval_recall12_x1000": [
+                           round(curve["12"] * 1000, 1)] * 2},
+                   "lsh_speedup": round(speedup, 2),
+                   "recall_curve": curve,
+                   "candidate_fraction": cand_frac,
+                   "index": idx_stats,
+                   "shape": {"users": users, "items": items,
+                             "factors": factors,
+                             "n_queries": n_queries,
+                             "concurrency": concurrency}}
+            if speedup < 2.0:
+                out["retrieval_machine_bound"] = True
+            if smoke:
+                assert exact_qps > 0 and lsh_qps > 0, out
+                # more tables can only widen the candidate union, so the
+                # curve must rise table-over-table (determinism sanity —
+                # the absolute level is shape-dependent and pinned by the
+                # --compare gate instead)
+                assert curve["12"] >= curve["2"] > 0.0, \
+                    f"recall curve not rising with tables: {curve}"
+                assert cand_frac["12"] < 0.25, \
+                    (f"LSH candidate set no longer sub-linear: "
+                     f"{cand_frac}")
+            return out
+        finally:
+            eng.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 _BENCHES = ("bench_linear", "bench_ffm_kernel", "bench_ffm_e2e",
             "bench_ffm_parquet_stream", "bench_shard_cache", "bench_ingest",
             "bench_dispatch_fusion", "bench_serve", "bench_bulk_score",
             "bench_fm",
             "bench_mf", "bench_word2vec", "bench_trees", "bench_gbt",
             "bench_seq_exact", "bench_mix", "bench_lda",
-            "bench_changefinder", "bench_topk_knn", "bench_flight")
+            "bench_changefinder", "bench_topk_knn", "bench_flight",
+            "bench_retrieval")
 
 
 def _short_key(metric: str) -> str:
@@ -1889,7 +2059,8 @@ _RECORD_SCHEMA = "hivemall_tpu_bench_compare_v1"
 
 #: keys never gated: dominated by process-spawn/scheduler noise on shared
 #: CI hosts, still reported for the record
-_COMPARE_VOLATILE = frozenset({"serve_qps", "serve_evloop_int8_qps"})
+_COMPARE_VOLATILE = frozenset({"serve_qps", "serve_evloop_int8_qps",
+                               "retrieval_exact_qps", "retrieval_lsh_qps"})
 
 
 def _results_from_configs(configs) -> dict:
@@ -2264,6 +2435,7 @@ _SMOKE = (
     ("bench_serve", {"smoke": True}),
     ("bench_bulk_score", {"n_rows": 4096, "smoke": True}),
     ("bench_flight", {"smoke": True}),
+    ("bench_retrieval", {"smoke": True}),
 )
 
 # bench_ffm_e2e stage-metric keys the smoke run requires (the acceptance
@@ -2466,7 +2638,7 @@ def main_smoke() -> int:
                      f"steps/s) regressed below K=1 "
                      f"({rec['k1_steps_per_sec']} steps/s) — defusion?")
             if name == "bench_flight":
-                # the no-collapse floor (PR 19): the flight recorder can
+                # the no-collapse floor (PR 18): the flight recorder can
                 # never silently tax the evloop qps ceiling.  Enabled
                 # record rate stays far above serving scale (>= 100k
                 # events/s vs ~11k qps needing ~1.1 events/req), the

@@ -286,6 +286,55 @@ def _cmd_predict(args) -> int:
     return 0
 
 
+def _cmd_retrieve(args) -> int:
+    """Offline top-k retrieval over a factor bundle (docs/SERVING.md
+    "Retrieval plane"): load MF/BPR/word2vec factors through the weight
+    arena, answer ``user→top-k items`` / ``item→k neighbors`` queries
+    from the command line, and print one JSON object. The serving twin
+    is ``serve --retrieval``."""
+    from ..serve.retrieve import RetrievalEngine
+
+    try:
+        eng = RetrievalEngine(
+            args.algo, args.options or "",
+            bundle=args.bundle, checkpoint_dir=args.checkpoint_dir,
+            precision=args.precision, k_default=args.k,
+            tier=args.tier, rescore=args.rescore)
+    except (FileNotFoundError, ValueError, NotImplementedError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        queries = []
+        for tok in (args.user.split(",") if args.user else []):
+            queries.append({"user": int(tok)})
+        for tok in (args.item.split(",") if args.item else []):
+            queries.append({"item": int(tok)})
+        if not queries:
+            print("error: give at least one --user or --item id",
+                  file=sys.stderr)
+            return 2
+        rows = [eng.parse_query(q) for q in queries]
+        packed, step = eng.retrieve_rows_versioned(rows)
+        results = []
+        for i, q in enumerate(queries):
+            ids = packed[i, :, 0]
+            valid = ids >= 0
+            ids = ids[valid].astype(int)
+            row = {**q, "ids": [int(v) for v in ids],
+                   "scores": [round(float(v), 6)
+                              for v in packed[i, valid, 1]]}
+            words = eng.labels(ids)
+            if words is not None:
+                row["words"] = words
+            results.append(row)
+        print(json.dumps({"results": results, "model_step": int(step),
+                          "tier": args.tier,
+                          "model_path": eng.model_path}, default=str))
+        return 0
+    finally:
+        eng.close()
+
+
 def _cmd_mixserv(args) -> int:
     """The bin/run_mixserv.sh analog: a standalone mix server.
 
@@ -355,10 +404,30 @@ def _cmd_serve(args) -> int:
     "Fleet topology"): N engine processes behind a health-gated router,
     with manager-coordinated rolling hot reload and crash respawn."""
     if args.replicas > 0:
+        if args.retrieval:
+            print("error: --retrieval is a single-server surface "
+                  "(fleet retrieval is not wired yet)", file=sys.stderr)
+            return 2
         return _cmd_serve_fleet(args)
     from ..serve.engine import PredictEngine
     from ..serve.http import PredictServer
 
+    retrieval = None
+    if args.retrieval:
+        from ..serve.retrieve import RetrievalEngine
+        try:
+            retrieval = RetrievalEngine(
+                args.algo, args.options or "",
+                bundle=args.bundle, checkpoint_dir=args.checkpoint_dir,
+                follow="promoted" if args.promote else "newest",
+                precision=args.serve_precision,
+                max_batch=args.serve_max_batch,
+                k_default=args.retrieval_k,
+                tier=args.retrieval_tier,
+                watch_interval=args.watch_interval)
+        except (FileNotFoundError, ValueError, NotImplementedError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     try:
         engine = PredictEngine(
             args.algo, args.options or "",
@@ -369,9 +438,15 @@ def _cmd_serve(args) -> int:
             follow="promoted" if args.promote else "newest",
             arena=args.serve_arena,
             precision=args.serve_precision)
-    except (FileNotFoundError, ValueError, NotImplementedError) as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 2
+    except (FileNotFoundError, ValueError, NotImplementedError,
+            AttributeError) as e:
+        # AttributeError = no make_scorer: pure factor families
+        # (MF/BPR/word2vec) have no row-predict surface
+        if retrieval is None:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        # --retrieval serves them retrieval-only (/predict 404s)
+        engine = None
     if args.serve_plane == "evloop":
         from ..serve.evloop import EvloopPredictServer as _ServerCls
     else:
@@ -382,7 +457,8 @@ def _cmd_serve(args) -> int:
         max_queue_rows=args.serve_max_queue,
         deadline_ms=args.serve_deadline_ms,
         slo_p99_ms=args.slo_p99_ms,
-        slo_availability=args.slo_availability).start()
+        slo_availability=args.slo_availability,
+        retrieval=retrieval).start()
     ctrl = None
     retrain_ctl = None
     if args.promote and args.checkpoint_dir:
@@ -394,14 +470,21 @@ def _cmd_serve(args) -> int:
         # --retrain additionally captures the RAW request rows the
         # replay buffer trains on (the label join is a feedback-side
         # concern — without one, retrains run over --train-input only)
-        shadow = ShadowBuffer(capture_raw=args.retrain)
-        srv.batcher.set_tee(shadow.add, raw=args.retrain)
+        shadow = ShadowBuffer(capture_raw=args.retrain) \
+            if engine is not None else None
+        if engine is not None:
+            srv.batcher.set_tee(shadow.add, raw=args.retrain)
         gate = PromotionGate(args.algo, args.options or "",
                              holdout=args.holdout, shadow=shadow,
                              precision=args.serve_precision)
         ctrl = PromotionController(args.checkpoint_dir, gate,
                                    interval=args.watch_interval,
                                    slo=srv.slo).start()
+        if args.retrain and engine is None:
+            print("error: --retrain needs a predict surface (the replay "
+                  "buffer mirrors /predict traffic)", file=sys.stderr)
+            srv.stop()
+            return 2
         if args.retrain:
             from ..serve.retrain import RetrainController
             retrain_ctl = RetrainController(
@@ -419,10 +502,12 @@ def _cmd_serve(args) -> int:
               file=sys.stderr)
         srv.stop()
         return 2
+    eng = engine if engine is not None else retrieval
     print(json.dumps({"host": srv.host, "port": srv.port,
                       "algo": args.algo,
-                      "model_step": engine.model_step,
-                      "model_path": engine.model_path}), flush=True)
+                      "model_step": eng.model_step,
+                      "model_path": eng.model_path,
+                      "retrieval": retrieval is not None}), flush=True)
     try:
         while True:
             time.sleep(3600)
@@ -937,7 +1022,55 @@ def main(argv=None) -> int:
                          "window needed to trigger")
     sv.add_argument("--retrain-max-per-window", type=int, default=4,
                     help="--retrain: max retrains per hour window")
+    sv.add_argument("--retrieval", action="store_true",
+                    help="also serve /retrieve top-k over the factor "
+                         "tables (MF/BPR/word2vec; docs/SERVING.md "
+                         "'Retrieval plane'): user→top-k items and "
+                         "item→k neighbors, own batcher, hot reload "
+                         "shared with /predict")
+    sv.add_argument("--retrieval-tier", default="exact",
+                    choices=("exact", "lsh"),
+                    help="default candidate tier for /retrieve: exact "
+                         "full scan (bit-matches each_top_k) or SRP-LSH "
+                         "candidates + exact rescore (per-query "
+                         "override via the 'tier' field)")
+    sv.add_argument("--retrieval-k", type=int, default=10,
+                    help="default k for /retrieve queries that omit it")
     sv.set_defaults(fn=_cmd_serve)
+
+    rv = sub.add_parser(
+        "retrieve",
+        help="offline top-k retrieval over a factor bundle (user→items "
+             "/ item→neighbors; the one-shot twin of serve "
+             "--retrieval)")
+    rv.add_argument("--algo", required=True,
+                    help="factor trainer the bundle was written by "
+                         "(train_mf_sgd, train_bprmf, train_word2vec)")
+    rv.add_argument("--options", default="",
+                    help="trainer options (must match the training "
+                         "config — table shapes are validated at load)")
+    rv.add_argument("--bundle", default=None,
+                    help="explicit bundle (.npz) to query")
+    rv.add_argument("--checkpoint-dir", default=None,
+                    help="resolve the model from this dir (PROMOTED "
+                         "pointer first, else newest bundle)")
+    rv.add_argument("--user", default=None,
+                    help="comma-separated user ids → top-k items each")
+    rv.add_argument("--item", default=None,
+                    help="comma-separated item ids → k neighbors each")
+    rv.add_argument("-k", type=int, default=10,
+                    help="results per query")
+    rv.add_argument("--tier", default="exact", choices=("exact", "lsh"),
+                    help="exact full scan or LSH candidates + exact "
+                         "rescore")
+    rv.add_argument("--precision", default="f32",
+                    choices=("f32", "bf16", "int8"),
+                    help="arena scoring tier for the rescore")
+    rv.add_argument("--rescore", default="auto",
+                    choices=("auto", "numpy", "kernel"),
+                    help="rescore backend: numpy arena twins, jitted "
+                         "kernels, or probe-and-pick (default)")
+    rv.set_defaults(fn=_cmd_retrieve)
 
     rt = sub.add_parser(
         "retrain",

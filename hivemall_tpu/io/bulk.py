@@ -278,6 +278,67 @@ def _score_shard_task(cfg: Dict[str, Any], kind: str, path: str,
             "scores": scores}
 
 
+def _group_components(files: List[str], group_col: str) -> List[List[int]]:
+    """Union-find shards into group-closed components: two shards land
+    in one component iff they share >=1 ``group_col`` value (directly or
+    transitively). Fused per-group top-k then routes ONE pooled task per
+    component, so no group's candidate set is ever split across workers
+    — each worker returns final per-group k-bests and the master merge
+    degenerates to concatenation of disjoint group sets. Reads only the
+    group column of each shard. Components come back as ascending shard
+    indices, ordered by first member."""
+    import pyarrow.parquet as pq
+    parent = list(range(len(files)))
+
+    def find(i: int) -> int:
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    owner: Dict[Any, int] = {}
+    for i, f in enumerate(files):
+        vals = pq.read_table(f, columns=[group_col]) \
+            .column(group_col).to_numpy(zero_copy_only=False)
+        for v in np.unique(vals).tolist():
+            j = owner.setdefault(v, i)
+            if j != i:
+                ra, rb = find(i), find(j)
+                if ra != rb:
+                    parent[max(ra, rb)] = min(ra, rb)
+    comps: Dict[int, List[int]] = {}
+    for i in range(len(files)):
+        comps.setdefault(find(i), []).append(i)
+    return [sorted(m) for _, m in
+            sorted(comps.items(), key=lambda kv: min(kv[1]))]
+
+
+def _score_component_task(cfg: Dict[str, Any], kind: str,
+                          paths: List[str],
+                          indices: List[int]) -> List[Dict[str, Any]]:
+    """Score one group-closed shard component in a single worker: each
+    member shard scores exactly as `_score_shard_task` would, but the
+    members' per-group top-k survivors merge HERE (ascending shard
+    order, preserving the arrival-order tie semantics the master merge
+    would have applied) and ride back once on the first member."""
+    from ..frame.tools import TopKAccumulator
+    results = []
+    acc = None
+    for path, idx in zip(paths, indices):
+        res = _score_shard_task(cfg, kind, path, idx)
+        if res["topk"] is not None:
+            if acc is None:
+                acc = TopKAccumulator(cfg["top_k"])
+            for g, s, v in res["topk"]:
+                acc.add(g, s, v)
+            res["topk"] = None
+        results.append(res)
+    if acc is not None:
+        results[0]["topk"] = [(g, s, v)
+                              for g, _rank, s, v in acc.result()]
+    return results
+
+
 # --------------------------------------------------------------------------
 # streaming evaluation UDAFs
 
@@ -572,6 +633,17 @@ def bulk_predict(algo: str, input_path: str,
         scored_files: List[Optional[str]] = [None] * len(files)
         busy = 0.0
 
+        # group-aware shard routing (ROADMAP item 5 follow-up): with a
+        # fused per-group top-k, shards sharing group values union into
+        # one pooled task so no group's candidates split across workers
+        components = None
+        if top_k and group_col and kind == "parquet" and len(files) > 1:
+            components = _group_components(files, group_col)
+            if fl.enabled:
+                fl.record("bulk.route",
+                          f"components={len(components)}{FS}"
+                          f"largest={max(len(c) for c in components)}")
+
         def _fold(res: Dict[str, Any]) -> None:
             nonlocal busy
             ev.add(res.pop("labels"), res.pop("scores"))
@@ -587,8 +659,14 @@ def bulk_predict(algo: str, input_path: str,
 
         try:
             if pool == "inline":
-                for i, f in enumerate(files):
-                    _fold(_score_shard_task(cfg, kind, f, i))
+                if components is None:
+                    for i, f in enumerate(files):
+                        _fold(_score_shard_task(cfg, kind, f, i))
+                else:
+                    for comp in components:
+                        for res in _score_component_task(
+                                cfg, kind, [files[i] for i in comp], comp):
+                            _fold(res)
             else:
                 import concurrent.futures as cf
                 if pool == "process":
@@ -600,10 +678,20 @@ def bulk_predict(algo: str, input_path: str,
                     ex = cf.ThreadPoolExecutor(
                         max_workers=workers, thread_name_prefix="bulk")
                 try:
-                    futs = [ex.submit(_score_shard_task, cfg, kind, f, i)
-                            for i, f in enumerate(files)]
-                    for fut in cf.as_completed(futs):
-                        _fold(fut.result())
+                    if components is None:
+                        futs = [ex.submit(_score_shard_task, cfg, kind,
+                                          f, i)
+                                for i, f in enumerate(files)]
+                        for fut in cf.as_completed(futs):
+                            _fold(fut.result())
+                    else:
+                        futs = [ex.submit(_score_component_task, cfg,
+                                          kind, [files[i] for i in comp],
+                                          comp)
+                                for comp in components]
+                        for fut in cf.as_completed(futs):
+                            for res in fut.result():
+                                _fold(res)
                 finally:
                     ex.shutdown(wait=True)
         finally:
@@ -659,6 +747,8 @@ def bulk_predict(algo: str, input_path: str,
     if top_k and group_col:
         result["topk_file"] = topk_file
         result["topk_rows"] = topk_rows
+        if components is not None:
+            result["group_components"] = len(components)
     return result
 
 

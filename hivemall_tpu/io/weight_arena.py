@@ -56,7 +56,8 @@ from .sparse import SparseBatch
 
 __all__ = ["ArenaUnsupported", "WeightArena", "arena_path",
            "publish_arena", "open_arena", "try_open_arena", "quantize_int8",
-           "score_error_bound", "host_rss_bytes", "PRECISIONS"]
+           "score_error_bound", "factor_score_error_bound",
+           "host_rss_bytes", "PRECISIONS"]
 
 ARENA_SUFFIX = ".arena"
 ARENA_KIND = "weight_arena"
@@ -299,6 +300,22 @@ class WeightArena:
                 f"(published precisions: {self.precisions})")
         return v
 
+    def table(self, name: str, precision: str = "f32") -> np.ndarray:
+        """The FULL table at a precision tier, as float32 values. The f32
+        tier returns the mmap'd view itself (read-only, zero-copy — the
+        retrieval plane's full-scan scoring and index builds read pages
+        shared with every other replica); quantized tiers dequantize once
+        into an owned array (bounded: one table per model version)."""
+        if precision == "f32":
+            return self._view(name, "f32")
+        if precision == "bf16":
+            return _bf16_bits_to_f32(np.asarray(self._view(name, "bf16")))
+        if precision == "int8":
+            return np.asarray(self._view(name, "int8"), np.float32) \
+                * np.float32(self._scales.get(name, 1.0))
+        raise ValueError(f"unknown precision {precision!r} "
+                         f"(one of {PRECISIONS})")
+
     def gather(self, name: str, precision: str) -> Callable:
         """``fn(index_array) -> float32 gathered values`` at the given
         precision tier — dequantization runs on the gathered slab only
@@ -347,7 +364,38 @@ class WeightArena:
             gV2 = self.gather("V2", precision)
             F = int(self.header["F"])
             return lambda b: _ffm_dense_margin(w0, gw, gV2, F, b)
+        if self.family == "factor":
+            raise ArenaUnsupported(
+                "factor arenas score (user, item) PAIRS, not SparseBatch "
+                "rows — use factor_scorer() / the retrieval plane "
+                "(serve.retrieve)")
         raise ArenaUnsupported(f"unknown arena family {self.family!r}")
+
+    def factor_scorer(self, precision: str = "f32") -> Callable:
+        """``fn(user_ids, item_ids) -> float32 scores`` for the factor
+        family: ``mu + P[u].Q[i] (+ bu[u] + bi[i])`` over the mapped
+        tables. Broadcasts like the gathers do — a scalar user against an
+        item id array is the retrieval plane's candidate-rescore shape."""
+        if self.family != "factor":
+            raise ArenaUnsupported(
+                f"factor_scorer on family {self.family!r}")
+        mu = np.float32(self.header.get("mu") or 0.0)
+        gP = self.gather("P", precision)
+        gQ = self.gather("Q", precision)
+        gbu = self.gather("bu", precision) \
+            if self.header.get("user_bias") else None
+        gbi = self.gather("bi", precision) \
+            if self.header.get("item_bias") else None
+
+        def score(users, items):
+            out = mu + (gP(users) * gQ(items)).sum(-1)
+            if gbu is not None:
+                out = out + gbu(users)
+            if gbi is not None:
+                out = out + gbi(items)
+            return np.asarray(out, np.float32)
+
+        return score
 
     def scorer(self, precision: str = "f32") -> Callable:
         """Output-space scorer (probabilities for classification) —
@@ -448,6 +496,38 @@ def score_error_bound(arena: WeightArena, precision: str,
         iu = np.triu(np.ones((L, L), np.float32), k=1)
         return ew + (d_pair * xx * iu[None]).sum((1, 2))
     raise ArenaUnsupported(f"no error bound for family {fam!r}")
+
+
+def factor_score_error_bound(arena: WeightArena, precision: str,
+                             users, items) -> np.ndarray:
+    """Per-pair upper bound on |quantized factor score − f32 score| for
+    ``score = mu + P[u].Q[i] (+ bu[u] + bi[i])`` — the factor family's
+    instance of :func:`score_error_bound`'s derivation, propagating the
+    tier's per-weight error through the exact score polynomial:
+
+        |Δ(p.q)| ≤ Σ_k |p_k|εq_k + |q_k|εp_k + εp_k εq_k
+
+    (triangle inequality on (p+εp).(q+εq) − p.q) plus the bias tables'
+    per-weight bounds. ``users``/``items`` broadcast like the gathers, so
+    a scalar user against a candidate id array yields the candidate-set
+    bound the retrieval plane's ranking guardrail needs: an LSH-tier
+    top-k over an int8 arena can reorder two items only where their f32
+    score gap is below the summed pair bounds."""
+    if arena.family != "factor":
+        raise ArenaUnsupported(
+            f"factor_score_error_bound on family {arena.family!r}")
+    u = np.asarray(users)
+    i = np.asarray(items)
+    pu = arena.gather("P", "f32")(u)
+    qi = arena.gather("Q", "f32")(i)
+    ep = arena._weight_err("P", precision)(u)
+    eq = arena._weight_err("Q", precision)(i)
+    bound = (np.abs(pu) * eq + np.abs(qi) * ep + ep * eq).sum(-1)
+    if arena.header.get("user_bias"):
+        bound = bound + arena._weight_err("bu", precision)(u)
+    if arena.header.get("item_bias"):
+        bound = bound + arena._weight_err("bi", precision)(i)
+    return np.asarray(bound, np.float32)
 
 
 def open_arena(path: str) -> WeightArena:

@@ -4,4 +4,6 @@ from .distance import (angular_distance, cosine_distance,  # noqa: F401
 from .similarity import (angular_similarity, cosine_similarity,  # noqa: F401
                          dimsum_mapper, distance2similarity,
                          euclid_similarity, jaccard_similarity)
+from .ann import (SrpIndex, exact_top_ids, mips_augment,  # noqa: F401
+                  mips_query, recall_at_k)
 from .lsh import bbit_minhash, minhash, minhashes  # noqa: F401

@@ -1,0 +1,168 @@
+"""knn.ann — two-stage approximate nearest neighbor over factor tables.
+
+The retrieval plane's candidate tier (docs/SERVING.md "Retrieval
+plane"): signed-random-projection LSH grown out of the minhash banding
+idiom in ``knn/lsh.py`` — where minhash bands collide sets by Jaccard
+similarity, SRP bands collide VECTORS by angle.  Each of ``n_tables``
+hash tables projects every row onto ``n_bits`` random hyperplanes and
+packs the signs into one integer bucket code; two vectors land in the
+same bucket of one table with probability ``(1 - θ/π)^n_bits`` (θ the
+angle between them), so the union of bucket matches across tables is a
+high-recall candidate set for the true angular top-k at a fraction of
+the scan cost.  Stage two rescans ONLY the candidates exactly.
+
+Dot-product ranking (MF's ``user→top-k items``) is not angular — a
+long item vector can out-rank a well-aligned short one — so item
+tables go through the Neyshabur–Srebro MIPS reduction first
+(:func:`mips_augment`): append the item bias as a coordinate, then one
+more coordinate ``sqrt(M² − ‖x‖²)`` so every row has norm M and the
+query's inner-product order equals the augmented cosine order.  After
+the transform SRP's angular guarantee IS a dot-product guarantee.
+
+Everything here is plain NumPy over whatever array the caller maps in
+(the mmap'd arena f32 view serves directly); index build is one
+``[N,d]·[d, n_tables·n_bits]`` matmul plus a sort — rebuilt per model
+reload, never incrementally mutated, so a hot swap can never serve a
+half-updated index.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["SrpIndex", "mips_augment", "mips_query", "exact_top_ids",
+           "recall_at_k"]
+
+
+def mips_augment(vectors: np.ndarray, bias: Optional[np.ndarray] = None
+                 ) -> Tuple[np.ndarray, float]:
+    """MIPS→cosine reduction (Neyshabur & Srebro 2015) over row vectors.
+
+    Appends ``bias`` as an extra coordinate when given (folding
+    ``p.q + b_i`` into one inner product against a query whose bias slot
+    is 1), then the norm-completion coordinate ``sqrt(M² − ‖x‖²)`` with
+    ``M = max row norm`` — every augmented row has norm M, so cosine
+    order against a :func:`mips_query` equals inner-product order.
+    Returns ``(augmented [N, d(+1)+1], M)``.
+    """
+    X = np.asarray(vectors, np.float32)
+    if bias is not None:
+        X = np.concatenate(
+            [X, np.asarray(bias, np.float32)[:, None]], axis=1)
+    sq = (X * X).sum(-1)
+    M2 = float(sq.max()) if len(sq) else 0.0
+    fill = np.sqrt(np.maximum(M2 - sq, 0.0), dtype=np.float32)
+    return np.concatenate([X, fill[:, None]], axis=1), float(np.sqrt(M2))
+
+
+def mips_query(q: np.ndarray, *, has_bias: bool) -> np.ndarray:
+    """A query vector in the :func:`mips_augment` space: bias slot 1
+    (score picks up ``b_i``), norm-completion slot 0 (the fill
+    coordinate never contributes to the inner product)."""
+    q = np.asarray(q, np.float32)
+    tail = [np.ones(1, np.float32)] if has_bias else []
+    return np.concatenate([q] + tail + [np.zeros(1, np.float32)])
+
+
+class SrpIndex:
+    """Signed-random-projection LSH index over row vectors.
+
+    ``n_tables`` independent hash tables, each bucketing rows by the
+    sign pattern of ``n_bits`` random projections.  ``candidates()``
+    returns the union of the query's buckets across tables, sorted
+    ascending — the deterministic arrival order the exact rescore's
+    each_top_k tie semantics pin against.
+    """
+
+    def __init__(self, vectors: np.ndarray, *, n_tables: int = 12,
+                 n_bits: int = 10, seed: int = 0x5EED):
+        V = np.asarray(vectors, np.float32)
+        if V.ndim != 2:
+            raise ValueError(f"SrpIndex wants [N, d] vectors, got "
+                             f"shape {V.shape}")
+        self.rows = int(V.shape[0])
+        self.dim = int(V.shape[1])
+        self.n_tables = int(n_tables)
+        self.n_bits = int(n_bits)
+        if not (0 < self.n_bits <= 30):
+            raise ValueError(f"n_bits {n_bits} out of range (1..30)")
+        # clamp code width to the catalog: b bits carve 2^b buckets per
+        # table, and once buckets go near-singleton (2^b >> N) every
+        # table returns ~1 candidate and recall collapses.  Cap so the
+        # EXPECTED bucket holds ~4 rows (2^b ≈ N/4) — a 200-item smoke
+        # catalog hashes at 5 bits while a 1M-item table keeps all 10+,
+        # and the requested width is only ever reduced, never raised.
+        if self.rows > 1:
+            cap = max(2, int(np.log2(self.rows)) - 2)
+            self.n_bits = min(self.n_bits, cap)
+        rng = np.random.default_rng(seed)
+        # [T, d, b] hyperplane normals — one matmul per table at build,
+        # one [d]·[d,b] matvec per table at query
+        self._planes = rng.standard_normal(
+            (self.n_tables, self.dim, self.n_bits)).astype(np.float32)
+        self._weights = (np.uint32(1) << np.arange(self.n_bits,
+                                                   dtype=np.uint32))
+        # per table: bucket code -> ascending int32 row ids. Built by
+        # one stable argsort over codes instead of N dict appends.
+        self._buckets: Tuple[Dict[int, np.ndarray], ...] = tuple(
+            self._bucketize(self._codes(V, t))
+            for t in range(self.n_tables))
+
+    def _codes(self, V: np.ndarray, table: int) -> np.ndarray:
+        bits = (V @ self._planes[table]) > 0           # [N, b] signs
+        return bits.astype(np.uint32) @ self._weights  # packed codes [N]
+
+    @staticmethod
+    def _bucketize(codes: np.ndarray) -> Dict[int, np.ndarray]:
+        order = np.argsort(codes, kind="stable").astype(np.int32)
+        sc = codes[order]
+        starts = np.flatnonzero(np.r_[True, sc[1:] != sc[:-1]])
+        ends = np.r_[starts[1:], len(sc)]
+        return {int(sc[s]): order[s:e] for s, e in zip(starts, ends)}
+
+    def candidates(self, q: np.ndarray) -> np.ndarray:
+        """Ascending unique row ids sharing ≥1 bucket with ``q``."""
+        q = np.asarray(q, np.float32)
+        hits = []
+        for t in range(self.n_tables):
+            code = int(((q @ self._planes[t]) > 0).astype(np.uint32)
+                       @ self._weights)
+            rows = self._buckets[t].get(code)
+            if rows is not None:
+                hits.append(rows)
+        if not hits:
+            return np.zeros(0, np.int32)
+        if len(hits) == 1:
+            return hits[0]             # already ascending within a bucket
+        return np.unique(np.concatenate(hits))
+
+    def stats(self) -> dict:
+        """Bucket occupancy gauges for the obs ``retrieval`` section."""
+        sizes = [len(v) for d in self._buckets for v in d.values()]
+        n = len(sizes)
+        return {"tables": self.n_tables, "bits": self.n_bits,
+                "rows": self.rows, "buckets": n,
+                "max_bucket": max(sizes) if sizes else 0,
+                "mean_bucket": round(sum(sizes) / n, 2) if n else 0.0}
+
+
+def exact_top_ids(scores: np.ndarray, k: int) -> np.ndarray:
+    """Top-k row ids of ``scores`` under ``frame.tools.each_top_k``
+    semantics: descending score, ties broken by arrival (ascending id —
+    a stable sort on the negated scores is exactly sorted(reverse=True)'s
+    stability). Pinned against the real each_top_k by tests/test_ann.py.
+    """
+    s = np.asarray(scores)
+    return np.argsort(-s, kind="stable")[:max(0, int(k))]
+
+
+def recall_at_k(approx_ids, exact_ids, k: Optional[int] = None) -> float:
+    """|approx ∩ exact| / |exact| over the first ``k`` of each list —
+    the promotion gate's retrieval guardrail metric."""
+    a = list(approx_ids)[:k] if k is not None else list(approx_ids)
+    e = list(exact_ids)[:k] if k is not None else list(exact_ids)
+    if not e:
+        return 1.0
+    return len(set(map(int, a)) & set(map(int, e))) / len(e)

@@ -336,6 +336,27 @@ class MFTrainer:
             out = out + np.asarray(p["bu"])[u] + np.asarray(p["bi"])[i]
         return out.astype(np.float32)
 
+    # -- weight-arena publishing (io.weight_arena "factor" family) -----------
+    def serving_tables(self) -> Tuple[dict, Dict[str, np.ndarray]]:
+        """Arena serving surface: the finalized f32 factor tables and the
+        score recipe ``mu + P[u].Q[i] (+ bu[u] + bi[i])``, consumed by the
+        retrieval plane (serve/retrieve.py) rather than the SparseBatch
+        margin kernels — factor scoring gathers TWO embedding rows per
+        pair instead of one weight row per feature."""
+        self._flush()                  # buffered rows train before export
+        p = self.params
+        use_bias = not self.opts.disable_bias
+        meta = {"family": "factor", "k": self.k,
+                "mu": float(self.opts.mu),
+                "user_bias": use_bias, "item_bias": use_bias,
+                "classification": False}
+        tables = {"P": np.asarray(p["P"].astype(jnp.float32)),
+                  "Q": np.asarray(p["Q"].astype(jnp.float32))}
+        if use_bias:
+            tables["bu"] = np.asarray(p["bu"], np.float32)
+            tables["bi"] = np.asarray(p["bi"], np.float32)
+        return meta, tables
+
     def model_rows(self) -> Iterator[Tuple]:
         """(idx, Pu|None, Qi|None, bu, bi) rows, users then items, only
         touched ids (nonzero factors)."""
@@ -421,6 +442,19 @@ class BPRMFTrainer(MFTrainer):
         pu = np.asarray(p["P"].astype(jnp.float32))[u]
         qi = np.asarray(p["Q"].astype(jnp.float32))[i]
         return ((pu * qi).sum(-1) + np.asarray(p["bi"])[i]).astype(np.float32)
+
+    def serving_tables(self) -> Tuple[dict, Dict[str, np.ndarray]]:
+        """BPR's score has no global mean and no user bias — the pairwise
+        ranking loss cancels both; only the item bias survives."""
+        self._flush()
+        p = self.params
+        meta = {"family": "factor", "k": self.k, "mu": 0.0,
+                "user_bias": False, "item_bias": True,
+                "classification": False}
+        tables = {"P": np.asarray(p["P"].astype(jnp.float32)),
+                  "Q": np.asarray(p["Q"].astype(jnp.float32)),
+                  "bi": np.asarray(p["bi"], np.float32)}
+        return meta, tables
 
 
 # --- predict UDFs (join-side reassembly, SURVEY.md §3.7 row 5) -------------

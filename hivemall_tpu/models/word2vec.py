@@ -621,6 +621,24 @@ class Word2VecTrainer:
         return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb)
                                 + 1e-12))
 
+    def serving_tables(self) -> Tuple[dict, Dict[str, np.ndarray]]:
+        """Arena "factor" family (io.weight_arena): both the query table
+        and the candidate table are the input embeddings — word2vec's
+        retrieval shape is word→nearest-words over ONE vector space, so
+        ``P is Q`` and cosine neighbor queries are the meaningful tier.
+        Only the trained vocab rows export (the table may be padded to a
+        tp mesh axis); the vocab itself rides in the header so the
+        retrieval plane can translate ids back to words."""
+        if self.in_emb is None:
+            raise ValueError("serving_tables() before train(): "
+                             "no embeddings yet")
+        V = len(self.vocab)
+        emb = np.asarray(self.in_emb, np.float32)[:V]
+        meta = {"family": "factor", "k": int(emb.shape[1]), "mu": 0.0,
+                "user_bias": False, "item_bias": False,
+                "classification": False, "vocab": list(self.inv_vocab)}
+        return meta, {"P": emb, "Q": emb}
+
 
 @_instrument("word2vec", "pairgen")
 @lru_cache(maxsize=64)

@@ -131,6 +131,25 @@ RETRAIN_STUB = {"configured": False, "state": "idle", "attempts": 0,
                 "last_error": None,
                 "replay": {"rows": 0, "rows_dropped": 0, "segments": 0,
                            "pending_rows": 0}}
+#: serve.retrieve.RetrievalEngine.obs_section() in its inactive form
+#: (copy via serve.retrieve.retrieval_stub — the nested index/arena
+#: dicts must not be shared mutable state)
+RETRIEVAL_STUB = {"configured": False, "algo": None, "follow": None,
+                  "ready": False, "model_step": None,
+                  "model_age_seconds": None, "bundle_age_seconds": None,
+                  "model_path": None, "reloads": 0, "reload_failures": 0,
+                  "watching": False, "precision": None, "tier": None,
+                  "max_k": 0, "rescore_backend": None,
+                  "queries_user": 0, "queries_item": 0,
+                  "queries_lsh": 0, "queries_exact": 0,
+                  "empty_candidates": 0, "last_reload_error": None,
+                  "index": {"tables": 0, "bits": 0, "rows": 0,
+                            "buckets": 0, "max_bucket": 0,
+                            "mean_bucket": 0.0, "build_seconds": 0.0,
+                            "recall_at_k": 0.0},
+                  "arena": {"active": False, "mapped_bytes": 0,
+                            "loads": 0, "publishes": 0},
+                  "plane": None}
 #: io.bulk.BulkProgress.obs_section() before any bulk job ran — the
 #: offline scoring plane's section, key-for-key the live provider's shape
 BULK_STUB = {"active": False, "input": None, "output": None,
@@ -167,6 +186,11 @@ registry.register("promotion", lambda: {**PROMOTION_STUB,
 registry.register("retrain", lambda: {**RETRAIN_STUB,
                                       "replay":
                                       dict(RETRAIN_STUB["replay"])})
+# serve.retrieve.RetrievalEngine overrides this with the live factor
+# index/query counters when a retrieval plane is serving in this process
+registry.register("retrieval", lambda: {
+    **RETRIEVAL_STUB, "index": dict(RETRIEVAL_STUB["index"]),
+    "arena": dict(RETRIEVAL_STUB["arena"])})
 # io.bulk.bulk_predict overrides this with live shard/rows-per-sec
 # progress while a bulk scoring job runs in this process
 registry.register("bulk", lambda: dict(BULK_STUB))

@@ -199,16 +199,47 @@ class RawHTTPClient:
         return code, json.loads(payload)
 
     def post_frame(self, path: str, rows, deadline_ms=None,
-                   headers: Optional[dict] = None):
+                   headers: Optional[dict] = None, accept_frame: bool = False):
         """POST pre-parsed rows as one binary frame (serve/wire.py).
-        Returns ``(status, parsed json)`` — responses are JSON on both
-        protocols."""
+        Returns ``(status, parsed json)`` by default; with
+        ``accept_frame`` the request negotiates an HMR1 response frame
+        (``Accept:`` header) and a 200 comes back as the decoded tuple
+        ``(scores_rows, ids_rows, model_step)`` — errors stay JSON on
+        both protocols."""
         from .wire import CONTENT_TYPE_FRAME, encode_frame
         hdrs = dict(headers or {})
         hdrs["Content-Type"] = CONTENT_TYPE_FRAME
+        if accept_frame:
+            hdrs["Accept"] = CONTENT_TYPE_FRAME
         code, payload = self.request(
             "POST", path, encode_frame(rows, deadline_ms), headers=hdrs)
-        return code, json.loads(payload)
+        return code, self._decode_payload(code, payload)
+
+    def post_json_frame(self, path: str, obj: dict,
+                        headers: Optional[dict] = None):
+        """POST JSON but negotiate an HMR1 response frame — the
+        retrieval plane's cheap-response path (queries are tiny, result
+        rows are the bulk). A 200 returns the decoded ``(scores_rows,
+        ids_rows, model_step)`` tuple; errors stay ``(status, json)``."""
+        from .wire import CONTENT_TYPE_FRAME
+        hdrs = dict(headers or {})
+        hdrs["Accept"] = CONTENT_TYPE_FRAME
+        code, payload = self.request("POST", path,
+                                     json.dumps(obj).encode(), headers=hdrs)
+        return code, self._decode_payload(code, payload)
+
+    def _decode_payload(self, code: int, payload: bytes):
+        """Dispatch one response body on the Content-Type the server
+        chose: HMR1 frames decode to ``(scores_rows, ids_rows, step)``,
+        everything else parses as JSON."""
+        from .wire import CONTENT_TYPE_FRAME, decode_response_frame
+        ctype = ""
+        for k, v in self.last_headers.items():
+            if k.lower() == "content-type":
+                ctype = v.lower()
+        if code == 200 and CONTENT_TYPE_FRAME in ctype:
+            return decode_response_frame(payload)
+        return json.loads(payload)
 
     # -- prebuilt-request fast path (bench harness) ------------------------
     @staticmethod

@@ -58,7 +58,8 @@ from ..obs.registry import registry
 from ..obs.slo import SloEngine
 from ..obs.trace import get_tracer, mint_trace_id
 from .batcher import BatchPlane, ServeDeadline, ServeOverload
-from .wire import CONTENT_TYPE_FRAME, WireError, decode_frame
+from .wire import (CONTENT_TYPE_FRAME, WireError, decode_frame,
+                   encode_response_frame)
 
 __all__ = ["InlineAssembler", "EvloopPredictServer", "EvRouterFrontend"]
 
@@ -379,14 +380,16 @@ class _Request:
     """One parsed request (method/path as bytes, the _RouterHTTP
     idiom): everything the route handlers need, nothing else."""
 
-    __slots__ = ("method", "path", "body", "ctype", "trace_id")
+    __slots__ = ("method", "path", "body", "ctype", "trace_id", "accept")
 
-    def __init__(self, method, path, body, ctype, trace_id):
+    def __init__(self, method, path, body, ctype, trace_id, accept=""):
         self.method = method
         self.path = path
         self.body = body
         self.ctype = ctype
         self.trace_id = trace_id
+        # lowercased Accept header — HMR1 binary response negotiation
+        self.accept = accept
 
 
 class _EvLoopServer:
@@ -706,6 +709,7 @@ class _EvLoopServer:
             want_close = False
             trace_id = None
             ctype = "application/json"
+            accept = ""
             try:
                 for h in lines[1:]:
                     low = h.lower()
@@ -715,6 +719,9 @@ class _EvLoopServer:
                         # latin-1 round-trips any header bytes (the
                         # _RouterHTTP trace-id rationale)
                         ctype = h.split(b":", 1)[1].strip().decode(
+                            "latin-1").lower()
+                    elif low.startswith(b"accept:"):
+                        accept = h.split(b":", 1)[1].strip().decode(
                             "latin-1").lower()
                     elif low.startswith(b"connection:") \
                             and b"close" in low:
@@ -736,7 +743,7 @@ class _EvLoopServer:
             conn.close_after = conn.close_after or want_close
             conn.inflight = True
             req = _Request(bytes(method), bytes(path).split(b"?", 1)[0],
-                           body, ctype, trace_id)
+                           body, ctype, trace_id, accept)
             self._handle_request(conn, req, t_wake)
             # a synchronous response cleared inflight — loop on for
             # pipelined requests already buffered
@@ -821,9 +828,16 @@ class EvloopPredictServer(_EvLoopServer):
     /reload) runs on the offload worker.  Responses carry the same
     ``x-hivemall-hop`` decomposition as the threaded plane with one new
     leading component: ``loop`` — event-loop dwell between the select
-    wakeup that completed the request and its handler running."""
+    wakeup that completed the request and its handler running.
 
-    def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
+    ``retrieval=`` mounts a serve.retrieve.RetrievalEngine on
+    ``POST /retrieve`` behind its OWN InlineAssembler (the two planes
+    coalesce independently, same as the threaded server's second
+    MicroBatcher); ``engine=None`` with a retrieval engine is a
+    retrieval-only server."""
+
+    def __init__(self, engine=None, *, host: str = "127.0.0.1",
+                 port: int = 0,
                  max_batch: Optional[int] = None,
                  max_delay_ms: float = 2.0,
                  max_queue_rows: Optional[int] = None,
@@ -833,21 +847,37 @@ class EvloopPredictServer(_EvLoopServer):
                  slo: "bool | SloEngine" = True,
                  slo_p99_ms: float = 100.0,
                  slo_availability: float = 0.999,
-                 uds_path: Optional[str] = None):
+                 uds_path: Optional[str] = None,
+                 retrieval=None):
+        if engine is None and retrieval is None:
+            raise ValueError("EvloopPredictServer needs an engine, a "
+                             "retrieval engine, or both")
         super().__init__(host, port, uds_path=uds_path,
                          name="serve-evloop")
         self.engine = engine
+        self.retrieval = retrieval
         self.request_timeout = float(request_timeout)   # API parity;
         #   the loop never blocks on a result, so nothing consumes it
         self._watch = bool(watch)
         self.tracer = get_tracer()
-        self.batcher = InlineAssembler(
-            engine.predict_rows_versioned,
-            max_batch=int(max_batch or engine.max_batch),
-            max_delay_ms=max_delay_ms,
-            max_queue_rows=max_queue_rows,
-            deadline_ms=deadline_ms)
-        engine.attach_batcher(self.batcher)
+        self.batcher: Optional[InlineAssembler] = None
+        if engine is not None:
+            self.batcher = InlineAssembler(
+                engine.predict_rows_versioned,
+                max_batch=int(max_batch or engine.max_batch),
+                max_delay_ms=max_delay_ms,
+                max_queue_rows=max_queue_rows,
+                deadline_ms=deadline_ms)
+            engine.attach_batcher(self.batcher)
+        self.rbatcher: Optional[InlineAssembler] = None
+        if retrieval is not None:
+            self.rbatcher = InlineAssembler(
+                retrieval.retrieve_rows_versioned,
+                max_batch=int(retrieval.max_batch),
+                max_delay_ms=max_delay_ms,
+                max_queue_rows=max_queue_rows,
+                deadline_ms=deadline_ms)
+            retrieval.attach_batcher(self.rbatcher)
         if isinstance(slo, SloEngine):
             self.slo: Optional[SloEngine] = slo
             self._own_slo = False
@@ -861,9 +891,14 @@ class EvloopPredictServer(_EvLoopServer):
 
     def start(self) -> "EvloopPredictServer":
         if self._watch:
-            self.engine.start_watch()
+            if self.engine is not None:
+                self.engine.start_watch()
+            if self.retrieval is not None:
+                self.retrieval.start_watch()
         if self._own_slo and self.slo is not None:
-            self.slo.start(self.batcher.slo_totals)
+            bat = self.batcher if self.batcher is not None \
+                else self.rbatcher
+            self.slo.start(bat.slo_totals)
         self._start_threads()
         return self
 
@@ -875,27 +910,63 @@ class EvloopPredictServer(_EvLoopServer):
         self._stop_loop(drain)
         if self._own_slo and self.slo is not None:
             self.slo.stop()
-        self.engine.close()
+        if self.engine is not None:
+            self.engine.close()
+        if self.retrieval is not None:
+            self.retrieval.close()
 
     # -- loop hooks -----------------------------------------------------------
     def _loop_timeout(self, now: float) -> Optional[float]:
-        return self.batcher.next_wakeup()
+        nxt = None
+        for b in (self.batcher, self.rbatcher):
+            if b is None:
+                continue
+            w = b.next_wakeup()
+            if w is not None:
+                nxt = w if nxt is None else min(nxt, w)
+        return nxt
 
     def _tick(self, now: float) -> None:
-        self.batcher.pump(now)
+        if self.batcher is not None:
+            self.batcher.pump(now)
+        if self.rbatcher is not None:
+            self.rbatcher.pump(now)
 
     def _on_teardown(self, drain: bool) -> None:
-        self.batcher.close(drain=drain)
+        if self.batcher is not None:
+            self.batcher.close(drain=drain)
+        if self.rbatcher is not None:
+            self.rbatcher.close(drain=drain)
 
     # -- routing --------------------------------------------------------------
     def _handle_request(self, conn: _Conn, req: _Request,
                         t_wake: float) -> None:
         if req.method == b"POST" and req.path == b"/predict":
+            if self.engine is None:
+                self._respond(conn, 404, json.dumps(
+                    {"error": "no predict engine on this server "
+                              "(retrieval-only; try /retrieve)"}).encode(),
+                    close=True)
+                return
             self._predict(conn, req, t_wake)
+            return
+        if req.method == b"POST" and req.path == b"/retrieve":
+            self._retrieve(conn, req, t_wake)
             return
         if req.path == b"/healthz":
             from .http import health_payload
-            ready, payload = health_payload(self.engine, self.batcher)
+            eng = self.engine if self.engine is not None \
+                else self.retrieval
+            bat = self.batcher if self.batcher is not None \
+                else self.rbatcher
+            ready, payload = health_payload(eng, bat)
+            if self.retrieval is not None and self.engine is not None:
+                # both planes up: readiness is the AND (threaded-plane
+                # parity — see _ServeHandler /healthz)
+                ready = ready and self.retrieval.ready
+                payload["ready"] = ready
+                if payload["status"] == "ok" and not ready:
+                    payload["status"] = "warming"
             self._respond(conn, 200 if ready else 503,
                           json.dumps(payload, default=str).encode())
             return
@@ -930,8 +1001,8 @@ class EvloopPredictServer(_EvLoopServer):
             self._offload(conn, self._do_promotion)
             return
         self._respond(conn, 404, json.dumps(
-            {"error": "unknown path (try /predict, /healthz, /reload, "
-                      "/slo, /snapshot or /metrics)"}).encode(),
+            {"error": "unknown path (try /predict, /retrieve, /healthz, "
+                      "/reload, /slo, /snapshot or /metrics)"}).encode(),
             close=True)
 
     # -- offloaded admin (worker thread; payloads mirror the threaded
@@ -944,21 +1015,28 @@ class EvloopPredictServer(_EvLoopServer):
         except (ValueError, json.JSONDecodeError) as e:
             return 400, json.dumps({"error": str(e)}).encode(), \
                 "application/json"
+        # one /reload ticks every engine on this server (threaded-plane
+        # parity: predicts and top-k must never serve different steps)
+        eng = self.engine if self.engine is not None else self.retrieval
         try:
-            swapped = self.engine.reload(obj.get("path"))
+            swapped = eng.reload(obj.get("path"))
+            if self.retrieval is not None and eng is not self.retrieval:
+                swapped = self.retrieval.reload(obj.get("path")) \
+                    or swapped
         except ValueError as e:        # out-of-tree path: the model dir
             return 403, json.dumps(    # is the trust boundary
                 {"error": str(e)}).encode(), "application/json"
         return 200, json.dumps(
             {"reloaded": swapped,
-             "model_step": self.engine.model_step,
-             "reload_failures": self.engine.reload_failures}).encode(), \
+             "model_step": eng.model_step,
+             "reload_failures": eng.reload_failures}).encode(), \
             "application/json"
 
     def _do_promotion(self):
         from .promote import promotion_manifest_view
-        out = promotion_manifest_view(self.engine.checkpoint_dir)
-        out["follow"] = self.engine.follow
+        eng = self.engine if self.engine is not None else self.retrieval
+        out = promotion_manifest_view(eng.checkpoint_dir)
+        out["follow"] = eng.follow
         out["section"] = registry.snapshot().get("promotion")
         return 200, json.dumps(out, default=str).encode(), \
             "application/json"
@@ -1068,6 +1146,127 @@ class EvloopPredictServer(_EvLoopServer):
                            "n": len(scores)}).encode()
         self._respond(conn, 200, body, extra=extra)
         self._parse_conn(conn, now)    # resume pipelined requests
+
+    # -- the retrieval path ---------------------------------------------------
+    def _retrieve(self, conn: _Conn, req: _Request,
+                  t_wake: float) -> None:
+        """POST /retrieve — the evloop twin of the threaded handler's
+        _do_retrieve: parse inline, submit to the retrieval plane's own
+        assembler, answer from the completion callback."""
+        r = self.retrieval
+        if r is None:
+            self._respond(conn, 404, json.dumps(
+                {"error": "no retrieval engine on this server "
+                          "(serve --retrieval)"}).encode(), close=True)
+            return
+        t_handle = time.monotonic()
+        tid = req.trace_id
+        wants_frame = CONTENT_TYPE_FRAME in req.accept
+        try:
+            obj = json.loads(req.body or b"{}")
+            if not isinstance(obj, dict):
+                raise ValueError("request body must be a JSON object")
+            queries = obj.get("queries")
+            if queries is None:
+                queries = [obj] if ("user" in obj or "item" in obj) \
+                    else None
+            if not isinstance(queries, list) or not queries:
+                raise ValueError('body needs "queries": [{"user": id} | '
+                                 '{"item": id}, ...]')
+            deadline_ms = obj.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+            parsed = [r.parse_query(q) for q in queries]
+        except (ValueError, TypeError, KeyError,
+                json.JSONDecodeError) as e:
+            self._respond(conn, 400,
+                          json.dumps({"error": str(e)}).encode())
+            return
+        t_parsed = time.monotonic()
+        nq = len(parsed)
+
+        def done(packed, meta, hop, exc):
+            self._finish_retrieve(conn, tid, wants_frame, nq, t_wake,
+                                  t_handle, t_parsed, packed, meta, hop,
+                                  exc)
+
+        try:
+            with self.tracer.context(tid):
+                self.rbatcher.submit(parsed, done,
+                                     deadline_ms=deadline_ms,
+                                     trace_id=tid)
+        except ServeOverload as e:
+            self._respond(conn, 503, json.dumps(
+                {"error": str(e), "shed": True}).encode())
+        except RuntimeError as e:      # closed: the loop is shutting down
+            self._respond(conn, 503,
+                          json.dumps({"error": str(e)}).encode(),
+                          close=True)
+
+    def _finish_retrieve(self, conn: _Conn, tid, wants_frame: bool,
+                         nq: int, t_wake: float, t_handle: float,
+                         t_parsed: float, packed, meta, hop,
+                         exc) -> None:
+        if conn.closed:
+            return
+        now = time.monotonic()
+        if exc is not None:
+            if isinstance(exc, ServeDeadline):
+                code, obj = 504, {"error": str(exc), "expired": True}
+            elif isinstance(exc, ServeOverload):
+                code, obj = 503, {"error": str(exc), "shed": True}
+            else:
+                code, obj = 500, {"error": f"{type(exc).__name__}: {exc}"}
+            extra = (f"x-hivemall-trace: {tid}\r\n".encode("latin-1")
+                     if tid else b"")
+            self._respond(conn, code, json.dumps(obj).encode(),
+                          extra=extra)
+            self._parse_conn(conn, now)
+            return
+        r = self.retrieval
+        step = meta if meta is not None else r.model_step
+        # unpack [n, max_k, 2] (ids|-1 pad, scores) into ragged lists
+        ids_rows, scores_rows = [], []
+        for i in range(nq):
+            ids = packed[i, :, 0]
+            valid = ids >= 0
+            ids_rows.append(ids[valid].astype(np.int32))
+            scores_rows.append(
+                np.asarray(packed[i, valid, 1], np.float32))
+        total_ms = (now - t_wake) * 1000.0
+        loop_ms = (t_handle - t_wake) * 1000.0
+        parse_ms = (t_parsed - t_handle) * 1000.0
+        queue_ms = (hop or {}).get("queue_s", 0.0) * 1000.0
+        assemble_ms = (hop or {}).get("assemble_s", 0.0) * 1000.0
+        predict_ms = (hop or {}).get("predict_s", 0.0) * 1000.0
+        other_ms = max(0.0, total_ms - loop_ms - parse_ms - queue_ms
+                       - assemble_ms - predict_ms)
+        extra = (f"x-hivemall-hop: loop={loop_ms:.3f},"
+                 f"parse={parse_ms:.3f},queue={queue_ms:.3f},"
+                 f"assemble={assemble_ms:.3f},predict={predict_ms:.3f},"
+                 f"other={other_ms:.3f},total={total_ms:.3f}\r\n"
+                 ).encode("ascii")
+        if tid:
+            extra += f"x-hivemall-trace: {tid}\r\n".encode("latin-1")
+        if wants_frame:
+            body = encode_response_frame(scores_rows, ids_rows,
+                                         model_step=int(step))
+            self._respond(conn, 200, body, ctype=CONTENT_TYPE_FRAME,
+                          extra=extra)
+            self._parse_conn(conn, now)
+            return
+        results = []
+        for ids, sc in zip(ids_rows, scores_rows):
+            row = {"ids": [int(v) for v in ids],
+                   "scores": [float(v) for v in sc]}
+            words = r.labels(ids)
+            if words is not None:
+                row["words"] = words
+            results.append(row)
+        body = json.dumps({"results": results, "model_step": int(step),
+                           "n": len(results)}).encode()
+        self._respond(conn, 200, body, extra=extra)
+        self._parse_conn(conn, now)
 
 
 class _Fwd:

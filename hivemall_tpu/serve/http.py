@@ -15,8 +15,21 @@ exactly what makes concurrent requests coalesce):
   warming (so the fleet router / an external LB can gate cold replicas),
   with model step, model/bundle age, queue depth and the cheap serving
   counters.
+- ``POST /retrieve`` — the retrieval plane (docs/SERVING.md "Retrieval
+  plane"): body ``{"queries": [{"user": 3, "k": 10}, {"item": 7,
+  "tier": "lsh"}, ...]}`` (or one bare query object), optional
+  ``"deadline_ms"``. Response ``{"results": [{"ids": [...], "scores":
+  [...]}], "model_step": N, "n": N}`` (+ per-row ``"words"`` when the
+  factor table carries a vocab). 404 unless the server was built with
+  a retrieval engine; queries coalesce through their OWN MicroBatcher
+  so ranking never queues behind predict scoring.
 - ``POST /reload`` — force a hot-reload check (body optionally
   ``{"path": "...npz"}`` to load an explicit bundle).
+
+Clients sending ``Accept: application/x-hivemall-frame`` get
+``/predict`` and ``/retrieve`` responses as compact HMR1 binary frames
+(serve.wire) instead of JSON — top-k responses are dominated by JSON
+float encode at high k.
 - ``GET /slo`` — the SLO engine's windowed burn rates + drift state
   (docs/OBSERVABILITY.md "Serving traces and SLOs").
 - ``GET /promotion`` — the promotion control plane's status: the watched
@@ -46,13 +59,16 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 from ..io.weight_arena import host_rss_bytes as _host_rss
 from ..obs.http import _Handler as _ObsHandler
 from ..obs.slo import SloEngine
 from ..obs.trace import get_tracer
 from .batcher import MicroBatcher, ServeDeadline, ServeOverload
 from .client import RawHTTPClient
-from .wire import CONTENT_TYPE_FRAME, WireError, decode_frame
+from .wire import (CONTENT_TYPE_FRAME, WireError, decode_frame,
+                   encode_response_frame)
 
 __all__ = ["PredictServer", "KeepAliveClient", "health_payload"]
 
@@ -123,6 +139,25 @@ class _ServeHandler(_ObsHandler):
     # -- helpers -------------------------------------------------------------
     _body_read = False                   # per-request; reset in do_*
 
+    def _wants_frame(self) -> bool:
+        """Did the client negotiate an HMR1 binary response?"""
+        accept = (self.headers.get("Accept") or "").lower()
+        return CONTENT_TYPE_FRAME in accept
+
+    def _frame(self, body: bytes,
+               extra_headers: Optional[dict] = None) -> None:
+        """A 200 with a binary HMR1 body (success paths only — errors
+        stay JSON on every protocol so clients always parse them)."""
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE_FRAME)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        if self.close_connection:
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+
     def _json(self, code: int, obj: dict,
               extra_headers: Optional[dict] = None) -> None:
         body = json.dumps(obj, default=str).encode()
@@ -170,7 +205,18 @@ class _ServeHandler(_ObsHandler):
             # LB probing this port — can gate cold/warming replicas out of
             # rotation instead of routing requests into XLA compiles. The
             # payload is shared with the evloop plane (health_payload).
-            ready, payload = health_payload(s.engine, s.batcher)
+            # A retrieval-only server reports its retrieval engine here
+            # (same keys — the fleet manager must not see plane drift).
+            eng = s.engine if s.engine is not None else s.retrieval
+            bat = s.batcher if s.batcher is not None else s.rbatcher
+            ready, payload = health_payload(eng, bat)
+            if s.retrieval is not None and s.engine is not None:
+                # both planes up: readiness is the AND (a predict-ready
+                # replica with a cold factor table must not take top-k)
+                ready = ready and s.retrieval.ready
+                payload["ready"] = ready
+                if payload["status"] == "ok" and not ready:
+                    payload["status"] = "warming"
             self._json(200 if ready else 503, payload)
             return
         if path == "/slo":
@@ -187,8 +233,9 @@ class _ServeHandler(_ObsHandler):
             # registered one — the live `promotion` registry section
             from ..obs.registry import registry
             from .promote import promotion_manifest_view
-            out = promotion_manifest_view(s.engine.checkpoint_dir)
-            out["follow"] = s.engine.follow
+            eng = s.engine if s.engine is not None else s.retrieval
+            out = promotion_manifest_view(eng.checkpoint_dir)
+            out["follow"] = eng.follow
             out["section"] = registry.snapshot().get("promotion")
             self._json(200, out)
             return
@@ -204,18 +251,34 @@ class _ServeHandler(_ObsHandler):
             except (ValueError, json.JSONDecodeError) as e:
                 self._json(400, {"error": str(e)})
                 return
+            # both planes follow the same checkpoint dir: one /reload
+            # ticks whichever engines exist so a promoted bundle can
+            # never serve predicts at step N and top-k at step N-1
+            eng = s.engine if s.engine is not None else s.retrieval
             try:
-                swapped = s.engine.reload(body.get("path"))
+                swapped = eng.reload(body.get("path"))
+                if s.retrieval is not None and eng is not s.retrieval:
+                    swapped = s.retrieval.reload(body.get("path")) \
+                        or swapped
             except ValueError as e:    # out-of-tree path: the model dir
                 self._json(403, {"error": str(e)})   # is the trust boundary
                 return
             self._json(200, {"reloaded": swapped,
-                             "model_step": s.engine.model_step,
-                             "reload_failures": s.engine.reload_failures})
+                             "model_step": eng.model_step,
+                             "reload_failures": eng.reload_failures})
+            return
+        if path == "/retrieve":
+            self._do_retrieve()
             return
         if path != "/predict":
-            self.send_error(404, "unknown path (try /predict, /healthz, "
-                                 "/reload, /slo, /snapshot or /metrics)")
+            self.send_error(404, "unknown path (try /predict, /retrieve, "
+                                 "/healthz, /reload, /slo, /snapshot or "
+                                 "/metrics)")
+            return
+        if s.engine is None:
+            # body unread -> _json closes the connection (wire hygiene)
+            self._json(404, {"error": "no predict engine on this server "
+                                      "(retrieval-only; try /retrieve)"})
             return
         t_req0 = time.monotonic()
         # request-scoped tracing: honor a client/router-supplied id —
@@ -309,9 +372,103 @@ class _ServeHandler(_ObsHandler):
                  f"other={other_ms:.3f},total={total_ms:.3f}"}
         if tid:
             extra["x-hivemall-trace"] = tid
+        if self._wants_frame():
+            # HMR1: all scores as one frame row (scores-only layout) —
+            # skips the per-float JSON encode on the response hot path
+            self._frame(encode_response_frame([scores],
+                                              model_step=int(step)),
+                        extra_headers=extra)
+            return
         self._json(200, {"scores": [float(v) for v in scores],
                          "model_step": int(step),
                          "n": len(scores)}, extra_headers=extra)
+
+    def _do_retrieve(self) -> None:
+        """POST /retrieve — top-k queries through the retrieval plane's
+        own MicroBatcher (docs/SERVING.md "Retrieval plane")."""
+        s = self.server_ref
+        r = s.retrieval
+        if r is None:
+            self._json(404, {"error": "no retrieval engine on this "
+                                      "server (serve --retrieval)"})
+            return
+        t_req0 = time.monotonic()
+        tid = self.headers.get("x-hivemall-trace")
+        try:
+            body = self._read_body()
+            queries = body.get("queries")
+            if queries is None:
+                # one bare query object rides at the top level
+                queries = [body] if ("user" in body or "item" in body) \
+                    else None
+            if not isinstance(queries, list) or not queries:
+                raise ValueError('body needs "queries": [{"user": id} | '
+                                 '{"item": id}, ...]')
+            deadline_ms = body.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+            parsed = [r.parse_query(q) for q in queries]
+        except (ValueError, TypeError, KeyError,
+                json.JSONDecodeError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        t_parsed = time.monotonic()
+        try:
+            with s.tracer.context(tid):
+                fut = s.rbatcher.submit(parsed, deadline_ms=deadline_ms,
+                                        trace_id=tid)
+            res = fut.result(timeout=s.request_timeout)
+        except ServeOverload as e:
+            self._json(503, {"error": str(e), "shed": True})
+            return
+        except ServeDeadline as e:
+            self._json(504, {"error": str(e), "expired": True})
+            return
+        except Exception as e:         # noqa: BLE001 — ranking failure is
+            # a 500 on THIS request, never a handler crash
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        if isinstance(res, tuple):
+            packed, step = res
+        else:                          # zero-query short-circuit
+            packed, step = res, r.model_step
+        # unpack [n, max_k, 2] (ids|-1 pad, scores) into ragged lists
+        ids_rows, scores_rows = [], []
+        for i in range(len(parsed)):
+            ids = packed[i, :, 0]
+            valid = ids >= 0
+            ids_rows.append(ids[valid].astype(np.int32))
+            scores_rows.append(
+                np.asarray(packed[i, valid, 1], np.float32))
+        hop = getattr(fut, "hop", None) or {}
+        total_ms = (time.monotonic() - t_req0) * 1000.0
+        parse_ms = (t_parsed - t_req0) * 1000.0
+        queue_ms = hop.get("queue_s", 0.0) * 1000.0
+        assemble_ms = hop.get("assemble_s", 0.0) * 1000.0
+        predict_ms = hop.get("predict_s", 0.0) * 1000.0
+        other_ms = max(0.0, total_ms - parse_ms - queue_ms
+                       - assemble_ms - predict_ms)
+        extra = {"x-hivemall-hop":
+                 f"parse={parse_ms:.3f},queue={queue_ms:.3f},"
+                 f"assemble={assemble_ms:.3f},predict={predict_ms:.3f},"
+                 f"other={other_ms:.3f},total={total_ms:.3f}"}
+        if tid:
+            extra["x-hivemall-trace"] = tid
+        if self._wants_frame():
+            self._frame(encode_response_frame(scores_rows, ids_rows,
+                                              model_step=int(step)),
+                        extra_headers=extra)
+            return
+        results = []
+        for ids, sc in zip(ids_rows, scores_rows):
+            row = {"ids": [int(v) for v in ids],
+                   "scores": [float(v) for v in sc]}
+            words = r.labels(ids)
+            if words is not None:
+                row["words"] = words
+            results.append(row)
+        self._json(200, {"results": results, "model_step": int(step),
+                         "n": len(results)}, extra_headers=extra)
 
 
 class _ThreadedHTTPServer(http.server.ThreadingHTTPServer):
@@ -383,9 +540,17 @@ class PredictServer:
     ``port=0`` binds an ephemeral port (read ``self.port``). Loopback-only
     by default; bind ``host="0.0.0.0"`` explicitly to serve a fleet.
     Starting the server also starts the engine's checkpoint watcher when a
-    watch directory is configured (the train+serve shared-dir recipe)."""
+    watch directory is configured (the train+serve shared-dir recipe).
 
-    def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
+    ``retrieval=`` mounts a serve.retrieve.RetrievalEngine on
+    ``POST /retrieve`` behind its OWN MicroBatcher (top-k ranking must
+    not queue behind predict scoring and vice versa — the two planes
+    coalesce independently). ``engine=None`` with a retrieval engine is
+    a retrieval-only server: /predict 404s, health/SLO ride the
+    retrieval plane."""
+
+    def __init__(self, engine=None, *, host: str = "127.0.0.1",
+                 port: int = 0,
                  max_batch: Optional[int] = None,
                  max_delay_ms: float = 2.0,
                  max_queue_rows: Optional[int] = None,
@@ -394,20 +559,36 @@ class PredictServer:
                  watch: bool = True,
                  slo: "bool | SloEngine" = True,
                  slo_p99_ms: float = 100.0,
-                 slo_availability: float = 0.999):
+                 slo_availability: float = 0.999,
+                 retrieval=None):
+        if engine is None and retrieval is None:
+            raise ValueError("PredictServer needs an engine, a retrieval "
+                             "engine, or both")
         self.engine = engine
+        self.retrieval = retrieval
         self.request_timeout = float(request_timeout)
         self._watch = bool(watch)
         self.tracer = get_tracer()
         # the versioned predict fn: each response carries the step of the
         # model version that actually scored it (correct across hot swaps)
-        self.batcher = MicroBatcher(
-            engine.predict_rows_versioned,
-            max_batch=int(max_batch or engine.max_batch),
-            max_delay_ms=max_delay_ms,
-            max_queue_rows=max_queue_rows,
-            deadline_ms=deadline_ms)
-        engine.attach_batcher(self.batcher)
+        self.batcher: Optional[MicroBatcher] = None
+        if engine is not None:
+            self.batcher = MicroBatcher(
+                engine.predict_rows_versioned,
+                max_batch=int(max_batch or engine.max_batch),
+                max_delay_ms=max_delay_ms,
+                max_queue_rows=max_queue_rows,
+                deadline_ms=deadline_ms)
+            engine.attach_batcher(self.batcher)
+        self.rbatcher: Optional[MicroBatcher] = None
+        if retrieval is not None:
+            self.rbatcher = MicroBatcher(
+                retrieval.retrieve_rows_versioned,
+                max_batch=int(retrieval.max_batch),
+                max_delay_ms=max_delay_ms,
+                max_queue_rows=max_queue_rows,
+                deadline_ms=deadline_ms)
+            retrieval.attach_batcher(self.rbatcher)
         # SLO engine over this server's own batcher totals (the fleet
         # topology passes slo=False here and samples fleet-wide at the
         # manager instead — one engine per surface, never two)
@@ -430,9 +611,14 @@ class PredictServer:
 
     def start(self) -> "PredictServer":
         if self._watch:
-            self.engine.start_watch()
+            if self.engine is not None:
+                self.engine.start_watch()
+            if self.retrieval is not None:
+                self.retrieval.start_watch()
         if self._own_slo and self.slo is not None:
-            self.slo.start(self.batcher.slo_totals)
+            bat = self.batcher if self.batcher is not None \
+                else self.rbatcher
+            self.slo.start(bat.slo_totals)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name=f"serve-http:{self.port}", daemon=True)
@@ -451,9 +637,16 @@ class PredictServer:
             self._thread = None
         if self._own_slo and self.slo is not None:
             self.slo.stop()
-        self.batcher.close(drain=drain, timeout=30.0 if drain else 5.0)
+        if self.batcher is not None:
+            self.batcher.close(drain=drain, timeout=30.0 if drain else 5.0)
+        if self.rbatcher is not None:
+            self.rbatcher.close(drain=drain,
+                                timeout=30.0 if drain else 5.0)
         # EOF-drain surviving keep-alive conns: in-flight responses
         # (scores resolved during the batcher drain) still write to
         # completion; nothing outlives the server
         self._httpd.close_connections()
-        self.engine.close()
+        if self.engine is not None:
+            self.engine.close()
+        if self.retrieval is not None:
+            self.retrieval.close()

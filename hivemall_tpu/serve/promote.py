@@ -202,6 +202,16 @@ class PromotionGate:
     - ``max_score_shift``: |candidate score mean − baseline score mean|
       bounded by ``max_score_shift × baseline score std`` (with a small
       absolute floor), on the holdout and on mirrored live traffic.
+    - ``min_recall_at_k``: factor-family candidates (MF/BPR/word2vec —
+      ``serving_tables()`` reports ``family == "factor"``) are checked
+      for RETRIEVAL health: build the LSH candidate tier the retrieval
+      plane will serve (knn.ann SrpIndex over the MIPS-augmented item
+      table, same seed) and measure recall@k of LSH+rescore against
+      exact search over a deterministic sample of user factors. A
+      candidate whose factor geometry collapses the hash buckets (e.g.
+      a diverged run driving all items to one orthant) fails the gate
+      and is quarantined exactly like a logloss regression — BEFORE the
+      ``PROMOTED`` pointer flips and every replica's top-k goes blind.
 
     A candidate with no baseline (bootstrap: first promotion) passes on
     the absolute checks alone. Verdicts are emitted as
@@ -219,7 +229,12 @@ class PromotionGate:
                  drift_sigma: float = 6.0,
                  drift_warmup: int = 16,
                  precision: str = "f32",
-                 publish_arena: bool = True):
+                 publish_arena: bool = True,
+                 min_recall_at_k: Optional[float] = 0.95,
+                 recall_k: int = 10,
+                 recall_queries: int = 32,
+                 recall_lsh_tables: int = 12,
+                 recall_lsh_bits: int = 10):
         from ..catalog import lookup
         from ..io.weight_arena import PRECISIONS
         if precision not in PRECISIONS:
@@ -253,6 +268,12 @@ class PromotionGate:
         self.max_score_shift = max_score_shift
         self.score_shift_floor = float(score_shift_floor)
         self.min_shadow_rows = int(min_shadow_rows)
+        # retrieval guardrail (factor families only; None disables)
+        self.min_recall_at_k = min_recall_at_k
+        self.recall_k = int(recall_k)
+        self.recall_queries = int(recall_queries)
+        self.recall_lsh_tables = int(recall_lsh_tables)
+        self.recall_lsh_bits = int(recall_lsh_bits)
         # calibration drift across the stream of gated candidates — the
         # shared dual-stage changefinder wrapper (obs.devprof.DriftWatch,
         # the same detector behind slo_drift / train_drift / mem_drift)
@@ -378,6 +399,9 @@ class PromotionGate:
             if self.shadow is not None and base is not None:
                 self._check_shadow(cand, candidate_path, base,
                                    baseline_path, checks, reasons)
+            if self.min_recall_at_k is not None \
+                    and hasattr(cand, "serving_tables"):
+                self._check_retrieval(cand, checks, reasons)
             if not reasons and self.publish_arena:
                 # admitted: publish the zero-copy sidecar BEFORE the
                 # pointer can flip, so every replica's reload finds it.
@@ -390,7 +414,8 @@ class PromotionGate:
                     self._ensure_arena(cand, candidate_path)
                 except ArenaUnsupported as e:
                     checks["arena"] = f"unsupported: {e}"
-            if ds is None and self.shadow is None:
+            if ds is None and self.shadow is None \
+                    and "recall_at_k" not in checks:
                 # no validation input at all: only the load-time digest
                 # check ran — record that the gate was vacuous
                 checks["validated"] = "digest-only"
@@ -492,6 +517,54 @@ class PromotionGate:
             return                                       # as the holdout
         self._score_shift(cand_scores, base_scores, "shadow",
                           checks, reasons)
+
+    def _check_retrieval(self, cand, checks: dict,
+                         reasons: List[str]) -> None:
+        """The retrieval-plane guardrail: recall@k of the LSH candidate
+        tier (the exact index the serving plane builds — same reduction,
+        same seed) vs exact search over the candidate's own factor
+        tables. Non-factor families return untouched."""
+        meta, tables = cand.serving_tables()
+        if meta.get("family") != "factor":
+            return
+        from ..knn.ann import (SrpIndex, exact_top_ids, mips_augment,
+                               mips_query, recall_at_k)
+        P = np.asarray(tables["P"], np.float32)
+        Q = np.asarray(tables["Q"], np.float32)
+        bi = np.asarray(tables["bi"], np.float32) \
+            if meta.get("item_bias") and "bi" in tables else None
+        k = min(self.recall_k, len(Q))
+        if len(P) == 0 or k < 1:
+            return                       # nothing rankable to judge
+        aug, _m = mips_augment(Q, bi)
+        idx = SrpIndex(aug, n_tables=self.recall_lsh_tables,
+                       n_bits=self.recall_lsh_bits, seed=0x5EED)
+        # deterministic query sample: the gate must be reproducible
+        # run-to-run on the same candidate (no wall-clock, no RNG state)
+        rng = np.random.default_rng(0xC0FFEE)
+        nq = min(self.recall_queries, len(P))
+        users = rng.choice(len(P), size=nq, replace=False)
+        recs = []
+        for u in users:
+            scores = Q @ P[u]
+            if bi is not None:
+                scores = scores + bi
+            exact = exact_top_ids(scores, k)
+            cands = idx.candidates(
+                mips_query(P[u], has_bias=bi is not None))
+            if len(cands) == 0:
+                recs.append(0.0)
+                continue
+            approx = cands[exact_top_ids(scores[cands], k)]
+            recs.append(recall_at_k(approx, exact))
+        rec = float(np.mean(recs))
+        checks["recall_at_k"] = round(rec, 4)
+        checks["recall_k"] = int(k)
+        if rec < self.min_recall_at_k:
+            reasons.append(
+                f"retrieval recall@{k} {rec:.3f} < "
+                f"{self.min_recall_at_k} (LSH candidate tier would "
+                f"mis-rank this factor geometry)")
 
     def _score_shift(self, cand_scores, base_scores, where: str,
                      checks: dict, reasons: List[str]) -> None:

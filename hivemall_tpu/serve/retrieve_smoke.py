@@ -1,0 +1,294 @@
+"""Retrieval smoke — run by run_tests.sh (docs/SERVING.md "Retrieval
+plane"). The acceptance surface of the top-k retrieval subsystem,
+seconds-scale, on either serving plane:
+
+1. concurrent ``/retrieve`` top-k over the EXACT tier bit-matches the
+   ``each_top_k`` oracle replayed over ``engine.exact_scores`` (ids
+   exactly — descending score, ties by arrival);
+2. the LSH candidate tier holds recall@k >= the floor vs exact search
+   at the smoke catalog shape (the same metric the promotion gate
+   guards);
+3. a newly PROMOTED factor bundle hot-reloads mid-traffic with ZERO
+   failed requests and the served model step advances;
+4. an HMR1 binary response frame (Accept-negotiated) decodes to the
+   same ids as the JSON response;
+5. the ``retrieval`` obs section rides the server's own /snapshot and
+   /metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from ..utils.net import http_get as _get
+
+
+def _post(url: str, obj: dict, timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(
+        url, json.dumps(obj).encode(), {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+OPTS = "-factors 8 -users 50 -items 200 -mini_batch 256 -iters 1"
+
+
+def _train_bundle(ckdir: str, trainer=None, epochs: int = 2):
+    """Train (or continue training) the smoke's MF model and drop a
+    step-named bundle into the checkpoint dir, returning (trainer,
+    path). Continuation reuses the SAME trainer so the second bundle is
+    a genuinely newer step of the same factors."""
+    from ..models.mf import MFTrainer
+    if trainer is None:
+        trainer = MFTrainer(OPTS)
+    rng = np.random.default_rng(7)
+    u = rng.integers(0, 50, 4000)
+    i = rng.integers(0, 200, 4000)
+    y = rng.normal(3.0, 1.0, 4000).astype(np.float32)
+    trainer.fit(u, i, y, epochs=epochs)
+    step = int(getattr(trainer, "_t", 0) or 0)
+    path = os.path.join(ckdir, f"train_mf_sgd-step{step:010d}.npz")
+    trainer.save_bundle(path)
+    return trainer, path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="hivemall_tpu.serve.retrieve_smoke")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("-k", type=int, default=10)
+    ap.add_argument("--recall-floor", type=float, default=0.95)
+    ap.add_argument("--plane", default="threaded",
+                    choices=("threaded", "evloop"),
+                    help="serving plane under test (docs/SERVING.md "
+                         "'Serving planes')")
+    args = ap.parse_args(argv)
+    # sanitizers: enable BEFORE any serve object exists (same discipline
+    # as serve/smoke.py — locks born wrapped, census from a clean floor)
+    from ..testing import tsan
+    if tsan.maybe_enable():
+        print("retrieve smoke: tsan sanitizer ON", file=sys.stderr)
+    from ..testing import leaktrack
+    if leaktrack.maybe_enable():
+        print("retrieve smoke: leaktrack sanitizer ON", file=sys.stderr)
+        leaktrack.snapshot()
+    tmp = tempfile.mkdtemp(prefix="hivemall_tpu_retrieve_smoke_")
+    try:
+        rc = _run(args, tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if leaktrack.enabled():
+        n = leaktrack.check_and_report("retrieve smoke leaktrack")
+        print(f"retrieve smoke leak_census: {'OK' if n == 0 else 'FAILED'} "
+              f"({n} leaked resource(s) after shutdown)", file=sys.stderr)
+        rc += 1 if n else 0
+    return rc
+
+
+def _run(args, tmp: str) -> int:
+    from ..io.checkpoint import promote_bundle
+    from ..serve.http import PredictServer
+    from ..serve.retrieve import RetrievalEngine
+
+    trainer, bundle = _train_bundle(tmp)
+    promote_bundle(tmp, bundle)
+
+    # rescore="numpy" pins the deterministic arena-twin path — the smoke
+    # asserts BIT-match against a numpy oracle, so the backend must not
+    # depend on what the probe picks on this host
+    engine = RetrievalEngine("train_mf_sgd", OPTS, checkpoint_dir=tmp,
+                             follow="promoted", rescore="numpy",
+                             k_default=args.k, watch_interval=0.2)
+    if args.plane == "evloop":
+        from ..serve.evloop import EvloopPredictServer as _ServerCls
+    else:
+        _ServerCls = PredictServer
+    srv = _ServerCls(None, port=0, max_delay_ms=10.0,
+                     retrieval=engine).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        return _drive(args, tmp, trainer, engine, srv, base)
+    finally:
+        srv.stop()
+
+
+def _oracle_ids(engine, kind: int, qid: int, k: int):
+    """Top-k ids under each_top_k semantics over the engine's own exact
+    scores — the independent in-memory reference the served exact tier
+    must bit-match."""
+    from ..frame.tools import each_top_k
+    s = engine.exact_scores(kind, qid)
+    return [int(v) for _rank, _s, v in
+            each_top_k(k, [qid] * len(s), [float(x) for x in s],
+                       list(range(len(s))))]
+
+
+def _drive(args, tmp, trainer, engine, srv, base) -> int:
+    from ..serve.client import RawHTTPClient
+    from ..serve.retrieve import KIND_ITEM_NEIGHBORS, KIND_USER_ITEMS
+
+    failures = []
+
+    def check(name, ok, detail=""):
+        print(f"retrieve smoke {name}: {'OK' if ok else 'FAILED'} "
+              f"{detail}", file=sys.stderr)
+        if not ok:
+            failures.append(name)
+
+    n_users = 50
+    n_items = 200
+    queries = []
+    for i in range(args.requests):
+        if i % 4 == 3:
+            queries.append(("item", i % n_items))
+        else:
+            queries.append(("user", i % n_users))
+
+    # -- concurrent exact top-k: coalescing + oracle bit-match ------------
+    served = [None] * len(queries)
+    errs = []
+    pos = iter(range(len(queries)))
+    lock = threading.Lock()
+
+    def worker():
+        cli = RawHTTPClient("127.0.0.1", srv.port)
+        while True:
+            with lock:
+                i = next(pos, None)
+            if i is None:
+                cli.close()
+                return
+            field, qid = queries[i]
+            try:
+                code, r = cli.post_json(
+                    "/retrieve", {"queries": [{field: qid, "k": args.k}]})
+                assert code == 200, (code, r)
+                served[i] = r["results"][0]["ids"]
+            except Exception as e:      # noqa: BLE001 — collected
+                errs.append(f"req {i}: {e}")
+
+    ts = [threading.Thread(target=worker) for _ in range(args.threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    check("requests", not errs,
+          f"({len(queries)} requests, {len(errs)} errors) {errs[:2]}")
+
+    mismatches = 0
+    for i, (field, qid) in enumerate(queries):
+        kind = KIND_USER_ITEMS if field == "user" else KIND_ITEM_NEIGHBORS
+        if served[i] != _oracle_ids(engine, kind, qid, args.k):
+            mismatches += 1
+    check("exact_bit_match", mismatches == 0,
+          f"({mismatches}/{len(queries)} queries diverged from the "
+          f"each_top_k oracle)")
+    st = srv.rbatcher.stats()
+    check("coalescing", st["mean_batch_rows"] > 1.0,
+          f"(mean batch {st['mean_batch_rows']}, "
+          f"{st['batches']} batches / {st['requests']} requests)")
+
+    # -- LSH tier recall@k vs exact --------------------------------------
+    r = _post(base + "/retrieve",
+              {"queries": [{"user": u, "k": args.k, "tier": "lsh"}
+                           for u in range(n_users)]})
+    recalls = []
+    for u in range(n_users):
+        exact = set(_oracle_ids(engine, KIND_USER_ITEMS, u, args.k))
+        got = set(int(v) for v in r["results"][u]["ids"])
+        recalls.append(len(got & exact) / max(1, len(exact)))
+    rec = float(np.mean(recalls))
+    check("lsh_recall", rec >= args.recall_floor,
+          f"(recall@{args.k} {rec:.3f} vs floor {args.recall_floor})")
+
+    # -- HMR1 response frame decodes to the JSON ids ----------------------
+    cli = RawHTTPClient("127.0.0.1", srv.port)
+    code, dec = cli.post_json_frame(
+        "/retrieve", {"queries": [{"user": 0, "k": args.k}]})
+    ok = code == 200 and isinstance(dec, tuple)
+    if ok:
+        _scores_rows, ids_rows, step = dec
+        ok = ([int(v) for v in ids_rows[0]]
+              == _oracle_ids(engine, KIND_USER_ITEMS, 0, args.k)
+              and step == engine.model_step)
+    cli.close()
+    check("response_frame", ok, f"(code {code})")
+
+    # -- PROMOTED hot reload mid-traffic ----------------------------------
+    from ..io.checkpoint import promote_bundle
+    stop = threading.Event()
+    traffic_errs = []
+
+    def traffic():
+        i = 0
+        while not stop.is_set():
+            try:
+                _post(base + "/retrieve", {"user": i % n_users})
+            except Exception as e:      # noqa: BLE001 — collected
+                traffic_errs.append(str(e))
+            i += 1
+
+    tt = [threading.Thread(target=traffic) for _ in range(4)]
+    for t in tt:
+        t.start()
+    old_step = engine.model_step
+    t2, newer = _train_bundle(tmp, trainer=trainer)
+    promote_bundle(tmp, newer)
+    new_step = int(getattr(t2, "_t", 0) or 0)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and engine.model_step < new_step:
+        time.sleep(0.1)
+    stop.set()
+    for t in tt:
+        t.join()
+    check("hot_reload", engine.model_step == new_step,
+          f"(step {old_step} -> {engine.model_step}, expected "
+          f"{new_step}, reloads {engine.reloads})")
+    check("reload_no_drops", not traffic_errs,
+          f"({len(traffic_errs)} failed during reload) {traffic_errs[:2]}")
+    hz = json.loads(_get(base + "/healthz"))
+    check("healthz", hz.get("status") == "ok"
+          and hz.get("model_step") == engine.model_step, f"({hz})")
+
+    # -- obs surface ------------------------------------------------------
+    snap = json.loads(_get(base + "/snapshot"))
+    rv = snap.get("retrieval", {})
+    need = ("queries_user", "queries_item", "queries_lsh", "queries_exact",
+            "model_step", "reloads", "index")
+    missing = [k for k in need if k not in rv]
+    check("obs_snapshot", not missing and rv.get("queries_user", 0) > 0
+          and rv.get("queries_lsh", 0) > 0,
+          f"(missing {missing}, section {bool(rv)})")
+    # the served index's build-time recall@k self-check rides /snapshot
+    # AND /metrics — dashboards see a mistuned index, not just slow p99s
+    idx_rec = rv.get("index", {}).get("recall_at_k")
+    check("obs_recall", isinstance(idx_rec, float)
+          and idx_rec >= args.recall_floor,
+          f"(index.recall_at_k {idx_rec} vs floor {args.recall_floor})")
+    prom = _get(base + "/metrics").decode()
+    check("obs_metrics", "hivemall_tpu_retrieval_queries_user" in prom
+          and "hivemall_tpu_retrieval_model_step" in prom
+          and "hivemall_tpu_retrieval_index_recall_at_k" in prom)
+
+    # -- lockset sanitizer verdict (only when HIVEMALL_TPU_TSAN=1) --------
+    from ..testing import tsan
+    if tsan.enabled():
+        check("tsan_races",
+              tsan.check_and_report("retrieve smoke tsan") == 0)
+
+    print(f"retrieve smoke: {len(failures)} failures", file=sys.stderr)
+    return len(failures)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,26 @@ passes through untouched, so a frame predict shares every byte of the
 scoring path after parse.  Malformed or truncated frames raise
 :class:`WireError`; servers answer 400 and close the connection
 (a desynced binary stream cannot be resynchronized mid-connection).
+
+Response frame (``HMR1``): a client that sends ``Accept:
+application/x-hivemall-frame`` gets its scores (and, on ``/retrieve``,
+its ranked id lists) back as a binary frame instead of JSON — top-k
+retrieval responses are dominated by JSON float encode at high k, and
+the predict fast path saves the ``json.dumps`` on every hop.  Layout::
+
+    magic    4s   b"HMR1"
+    flags    u8   bit0: model_step present; bit1: per-row ids present
+    n_rows   u16
+    step     i64  model step (present iff flags bit0)
+    per row:
+        n      u16
+        ids    i32 * n   ranked ids (present iff flags bit1)
+        scores f32 * n
+
+A scores-only response (``/predict``) sets n to the row's score count
+with no ids; a retrieval response carries ids+scores pairs already
+trimmed of padding.  Decode errors raise :class:`WireError` exactly
+like the request side.
 """
 
 from __future__ import annotations
@@ -38,13 +58,18 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 MAGIC = b"HMF1"
-#: Content-Type negotiating the binary frame protocol on /predict.
+RESPONSE_MAGIC = b"HMR1"
+#: Content-Type negotiating the binary frame protocol on /predict
+#: (request body) and, via the Accept header, binary responses.
 CONTENT_TYPE_FRAME = "application/x-hivemall-frame"
 
 _FLAG_DEADLINE = 0x01
+_RFLAG_STEP = 0x01
+_RFLAG_IDS = 0x02
 _HEAD = struct.Struct("<4sBH")          # magic, flags, n_rows
 _DEADLINE = struct.Struct("<f")
 _NFEAT = struct.Struct("<H")
+_STEP = struct.Struct("<q")
 
 #: Hard cap on rows per frame (u16 field; also bounds a hostile frame).
 MAX_ROWS = 0xFFFF
@@ -128,3 +153,89 @@ def decode_frame(body: bytes, max_row_features: int = 0,
     if off != len(body):
         raise WireError(f"{len(body) - off} trailing bytes after frame")
     return rows, deadline_ms
+
+
+def encode_response_frame(scores_rows,
+                          ids_rows=None,
+                          model_step: Optional[int] = None) -> bytes:
+    """Encode per-row scores (and optional ranked ids) into one HMR1
+    response frame.
+
+    ``scores_rows`` is a sequence of float sequences; ``ids_rows``
+    (when given) pairs each with an int sequence of equal length —
+    ranked ids for the retrieval plane, already trimmed of -1 padding.
+    """
+    if len(scores_rows) > MAX_ROWS:
+        raise WireError(f"frame rows {len(scores_rows)} > {MAX_ROWS}")
+    flags = 0
+    if model_step is not None:
+        flags |= _RFLAG_STEP
+    if ids_rows is not None:
+        flags |= _RFLAG_IDS
+        if len(ids_rows) != len(scores_rows):
+            raise WireError(f"ids rows {len(ids_rows)} != scores rows "
+                            f"{len(scores_rows)}")
+    out = [_HEAD.pack(RESPONSE_MAGIC, flags, len(scores_rows))]
+    if model_step is not None:
+        out.append(_STEP.pack(int(model_step)))
+    for r, srow in enumerate(scores_rows):
+        s = np.ascontiguousarray(np.asarray(srow, np.dtype("<f4")))
+        if s.ndim != 1:
+            raise WireError(f"row {r}: scores must be 1-d")
+        if len(s) > 0xFFFF:
+            raise WireError(f"row {r}: {len(s)} scores > 65535")
+        out.append(_NFEAT.pack(len(s)))
+        if ids_rows is not None:
+            i = np.ascontiguousarray(
+                np.asarray(ids_rows[r], np.dtype("<i4")))
+            if i.shape != s.shape:
+                raise WireError(f"row {r}: ids {i.shape} != scores "
+                                f"{s.shape}")
+            out.append(i.tobytes())
+        out.append(s.tobytes())
+    return b"".join(out)
+
+
+def decode_response_frame(body: bytes
+                          ) -> Tuple[List[np.ndarray],
+                                     Optional[List[np.ndarray]],
+                                     Optional[int]]:
+    """Decode one HMR1 frame into ``(scores_rows, ids_rows, step)``.
+    ``ids_rows`` is None for a scores-only (predict) response; ``step``
+    is None when the server did not stamp a model version."""
+    if len(body) < _HEAD.size:
+        raise WireError(f"response truncated: {len(body)} bytes < header")
+    magic, flags, n_rows = _HEAD.unpack_from(body, 0)
+    if magic != RESPONSE_MAGIC:
+        raise WireError(f"bad response magic {magic!r}")
+    if flags & ~(_RFLAG_STEP | _RFLAG_IDS):
+        raise WireError(f"unknown response flags 0x{flags:02x}")
+    off = _HEAD.size
+    step: Optional[int] = None
+    if flags & _RFLAG_STEP:
+        if len(body) < off + _STEP.size:
+            raise WireError("response truncated in step")
+        step = int(_STEP.unpack_from(body, off)[0])
+        off += _STEP.size
+    has_ids = bool(flags & _RFLAG_IDS)
+    scores_rows: List[np.ndarray] = []
+    ids_rows: Optional[List[np.ndarray]] = [] if has_ids else None
+    for r in range(n_rows):
+        if len(body) < off + _NFEAT.size:
+            raise WireError(f"response truncated at row {r} length")
+        (n,) = _NFEAT.unpack_from(body, off)
+        off += _NFEAT.size
+        need = n * (8 if has_ids else 4)
+        if len(body) < off + need:
+            raise WireError(f"response truncated in row {r} payload")
+        if has_ids:
+            ids = np.frombuffer(body, np.dtype("<i4"), n, off)
+            off += n * 4
+            ids_rows.append(ids.astype(np.int32))
+        s = np.frombuffer(body, np.dtype("<f4"), n, off)
+        off += n * 4
+        scores_rows.append(s.astype(np.float32))
+    if off != len(body):
+        raise WireError(f"{len(body) - off} trailing bytes after "
+                        "response frame")
+    return scores_rows, ids_rows, step

@@ -91,6 +91,26 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
     python -m hivemall_tpu.serve.smoke --plane evloop || exit $?
 
+# retrieval smoke (docs/SERVING.md "Retrieval plane"): an MF factor
+# bundle published through the weight arena serves /retrieve on BOTH
+# planes — concurrent exact-tier top-k bit-matches the each_top_k
+# oracle over the engine's own exact scores, the SRP-LSH candidate
+# tier holds recall@10 >= 0.95 vs exact at the smoke catalog shape,
+# a newly PROMOTED factor bundle hot-reloads mid-traffic with zero
+# failed requests, HMR1 response frames decode to the JSON ids, and
+# the retrieval obs section rides /snapshot + /metrics. Same tsan
+# lockset + leaktrack census gates as the other serve smokes.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
+    HIVEMALL_TPU_LEAKTRACK=1 \
+    HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
+    python -m hivemall_tpu.serve.retrieve_smoke || exit $?
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    HIVEMALL_TPU_TSAN=1 HIVEMALL_TPU_TSAN_LOG=artifacts/tsan_races.jsonl \
+    HIVEMALL_TPU_LEAKTRACK=1 \
+    HIVEMALL_TPU_LEAKTRACK_LOG=artifacts/leaktrack_census.jsonl \
+    python -m hivemall_tpu.serve.retrieve_smoke --plane evloop || exit $?
+
 # fleet smoke (docs/SERVING.md "Fleet topology"): 2 replica PROCESSES
 # behind the front-end router — concurrent routed predicts bit-match
 # predict_proba and fan across both replicas; killing one replica under

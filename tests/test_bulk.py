@@ -149,6 +149,59 @@ def test_bulk_topk_composes_with_each_top_k(tmp_path):
             assert np.isclose(s, want[gi], rtol=1e-4), (g, rank)
 
 
+def test_group_aware_shard_routing(tmp_path):
+    """Group-aware routing (ROADMAP item 5 follow-up): shards sharing
+    group values union into one pooled component so per-group top-k
+    never splits a group across workers; disjoint shards stay separate
+    tasks; routed results are identical to the unrouted single-worker
+    scan."""
+    from hivemall_tpu.io.bulk import _group_components
+    tr, bundle = _trained(str(tmp_path / "ck"), n=128, seed=7)
+    n = 256
+    test = _synth(n, DIMS, 8, seed=8)
+    in_dir = str(tmp_path / "in")
+    write_parquet_shards(test, in_dir, rows_per_shard=64)  # 4 shards
+    files = _parquet_files(in_dir)
+    assert len(files) == 4
+    # shards 0+1 share groups {0..3}, shards 2+3 share {10..13}: two
+    # components, each spanning two shards, mutually disjoint
+    rng = np.random.default_rng(9)
+    for si, f in enumerate(files):
+        t = pq.read_table(f)
+        lo = 0 if si < 2 else 10
+        g = rng.integers(lo, lo + 4, t.num_rows).astype(np.int64)
+        pq.write_table(t.append_column("user", pa.array(g)), f)
+
+    comps = _group_components(files, "user")
+    assert comps == [[0, 1], [2, 3]]
+
+    kw = dict(options=OPTS, bundle=bundle, backend="kernel",
+              top_k=3, group_col="user")
+    routed = bulk_predict("train_classifier", in_dir,
+                          str(tmp_path / "out_routed"), workers=2,
+                          pool="thread", **kw)
+    assert routed["group_components"] == 2
+    baseline = bulk_predict("train_classifier", in_dir,
+                            str(tmp_path / "out_base"), workers=1, **kw)
+    with open(routed["topk_file"]) as fh:
+        got = fh.read()
+    with open(baseline["topk_file"]) as fh:
+        want = fh.read()
+    assert got == want and routed["topk_rows"] == baseline["topk_rows"]
+    assert np.array_equal(_scores(str(tmp_path / "out_routed")),
+                          _scores(str(tmp_path / "out_base")))
+
+    # a chain shard bridging both halves collapses routing to ONE
+    # component (transitive closure, not pairwise overlap)
+    bridge = pq.read_table(files[0]).slice(0, 2)
+    bridge = bridge.set_column(
+        bridge.column_names.index("user"), "user",
+        pa.array(np.array([3, 10], np.int64)))
+    pq.write_table(bridge, os.path.join(in_dir, "shard-bridge.parquet"))
+    comps = _group_components(_parquet_files(in_dir), "user")
+    assert sorted(len(c) for c in comps) == [5] or len(comps) == 1
+
+
 def test_bulk_promoted_pointer_default(tmp_path):
     """The promotion pointer is the default model source (the nightly-job
     contract): promoted beats newest, explicit beats both, and the scored

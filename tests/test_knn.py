@@ -59,6 +59,48 @@ def test_minhash_similarity_correlates():
     assert len(rows) == 5 and rows[0][1] == a
 
 
+@pytest.mark.parametrize("shared,unique,jaccard", [
+    (8, 1, 0.8),    # |A∩B|=8, each side +1 unique → 8/10
+    (2, 1, 0.5),    # 2/4
+    (1, 1, 1 / 3),  # 1/3
+    (1, 4, 1 / 9),  # 1/9
+])
+def test_minhash_collision_probability_tracks_jaccard(shared, unique,
+                                                      jaccard):
+    """Property: a single minhash collides with probability exactly the
+    Jaccard similarity, and an r-hash BAND collides with probability
+    J^r — the banding amplification the SRP index in knn/ann.py reuses
+    for vectors. Empirical rates over seeded corpora (many independent
+    pairs x k hash families) must track both within sampling tolerance.
+    """
+    rng = np.random.default_rng(1234 + shared * 100 + unique)
+    k, band_r, n_pairs = 128, 2, 40
+    hash_hits = band_hits = hash_n = band_n = 0
+    for p in range(n_pairs):
+        # distinct token universe per pair -> independent trials (the
+        # hash families are fixed; fresh NAMES re-randomize the draw)
+        toks = [f"p{p}_t{v}" for v in
+                rng.choice(10 ** 6, size=shared + 2 * unique,
+                           replace=False)]
+        a = toks[:shared] + toks[shared:shared + unique]
+        b = toks[:shared] + toks[shared + unique:]
+        ha, hb = minhashes(a, k), minhashes(b, k)
+        eq = [x == y for x, y in zip(ha, hb)]
+        hash_hits += sum(eq)
+        hash_n += k
+        for i in range(0, k, band_r):   # bands = consecutive r-tuples
+            band_hits += all(eq[i:i + band_r])
+            band_n += 1
+    hash_rate = hash_hits / hash_n
+    band_rate = band_hits / band_n
+    # binomial std at n=5120: <=0.007 — 4 sigma plus hash-family bias slack
+    assert hash_rate == pytest.approx(jaccard, abs=0.05), \
+        f"per-hash collision rate {hash_rate:.3f} vs J={jaccard:.3f}"
+    assert band_rate == pytest.approx(jaccard ** band_r, abs=0.05), \
+        f"band collision rate {band_rate:.3f} vs J^{band_r}=" \
+        f"{jaccard ** band_r:.3f}"
+
+
 def test_bbit_minhash_length():
     sig = bbit_minhash(["a", "b"], k=16, b=2)
     assert len(sig) == 32 and set(sig) <= {"0", "1"}

@@ -882,6 +882,32 @@ def test_stub_sections_match_live_providers(tmp_path):
     assert set(retrain_stub()["replay"]) \
         == set(rc.obs_section()["replay"])
 
+    # retrieval: RetrievalEngine.obs_section() over a real factor
+    # bundle (the engine has no lazy-construct path — it loads at init),
+    # nested index/arena dicts included
+    import numpy as np
+    from hivemall_tpu.models.mf import MFTrainer
+    from hivemall_tpu.serve.retrieve import RetrievalEngine, retrieval_stub
+    opts = "-factors 4 -users 8 -items 16 -mini_batch 64 -iters 1"
+    t = MFTrainer(opts)
+    rng = np.random.default_rng(3)
+    t.fit(rng.integers(0, 8, 256), rng.integers(0, 16, 256),
+          rng.normal(3, 1, 256).astype(np.float32), epochs=1)
+    bdir = tmp_path / "retrieval"
+    bdir.mkdir()
+    bp = str(bdir / "train_mf_sgd-step000004.npz")
+    t.save_bundle(bp)
+    reng = RetrievalEngine("train_mf_sgd", opts, bundle=bp,
+                           checkpoint_dir=None, rescore="numpy")
+    try:
+        live = reng.obs_section()
+        assert set(retrieval_stub()) == set(live), \
+            "retrieval stub drifted from live keys"
+        assert set(retrieval_stub()["index"]) == set(live["index"])
+        assert set(retrieval_stub()["arena"]) == set(live["arena"])
+    finally:
+        reng.close()
+
     # bulk: BulkProgress.obs_section() (no job run) must mirror
     # BULK_STUB key-for-key — the offline-scoring plane's section
     from hivemall_tpu.io.bulk import BulkProgress
